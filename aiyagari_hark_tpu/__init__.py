@@ -1,0 +1,14 @@
+"""aiyagari_hark_tpu — a TPU-native (JAX/XLA) heterogeneous-agent macro
+framework with the capabilities of the Aiyagari-HARK reference replication.
+
+Layers (mirroring SURVEY.md §1, rebuilt TPU-first):
+  * ``ops``      — numerics core (grids, Tauchen, CRRA, batched interp, OLS)
+  * ``models``   — EGM household solver, simulators, equilibrium loops
+  * ``parallel`` — device meshes, calibration sweeps, sharded agent panels
+  * ``utils``    — typed configs, checkpointing, logging, statistics
+  * ``facade``   — notebook-compatible AiyagariType / AiyagariEconomy classes
+"""
+
+__version__ = "0.1.0"
+
+from .utils.config import AgentConfig, EconomyConfig, SweepConfig  # noqa: F401

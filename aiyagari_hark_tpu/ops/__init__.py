@@ -1,0 +1,34 @@
+"""Numerics core: grids, Markov machinery, CRRA utility, batched
+interpolation, and masked OLS — the L1-equivalent layer (SURVEY.md §1)."""
+
+from .grids import make_asset_grid, make_grid_exp_mult
+from .interp import (
+    eval_policy_agents,
+    interp1d,
+    interp1d_rowwise,
+    interp_on_interp,
+    locate_in_grid,
+)
+from .markov import (
+    TauchenResult,
+    aggregate_markov_matrix,
+    employment_markov_matrix,
+    full_idiosyncratic_matrix,
+    normalized_labor_states,
+    stationary_distribution,
+    tauchen_ar1,
+    tauchen_labor_process,
+)
+from .regression import OLSResult, masked_ols
+from .utility import crra_utility, inverse_marginal_utility, marginal_utility
+
+__all__ = [
+    "make_asset_grid", "make_grid_exp_mult",
+    "eval_policy_agents", "interp1d", "interp1d_rowwise", "interp_on_interp",
+    "locate_in_grid",
+    "TauchenResult", "aggregate_markov_matrix", "employment_markov_matrix",
+    "full_idiosyncratic_matrix", "normalized_labor_states",
+    "stationary_distribution", "tauchen_ar1", "tauchen_labor_process",
+    "OLSResult", "masked_ols",
+    "crra_utility", "inverse_marginal_utility", "marginal_utility",
+]

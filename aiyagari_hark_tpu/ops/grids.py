@@ -1,0 +1,54 @@
+"""Grid construction utilities.
+
+TPU-native reimplementation of the grid semantics the reference relies on
+(`/root/reference/Aiyagari_Support.py:875-890` constructs the asset grid with
+HARK's ``make_grid_exp_mult(aMin, aMax, aCount, aNestFac)``).  The
+multi-exponential grid is a standard HARK/econ-ark utility: apply
+``x -> log(1+x)`` to the endpoints ``nest`` times, space linearly in that
+transformed coordinate, then invert.  Points therefore cluster near the lower
+endpoint, where the consumption function has curvature.
+
+Grids are calibration constants with static sizes — they are built **once on
+host in NumPy float64** (so the nested log/exp roundtrip doesn't erode the
+endpoints) and cast to the requested device dtype at the end.  Never called
+inside jit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def make_grid_exp_mult(ming: float, maxg: float, ng: int, timestonest: int = 20,
+                       dtype=None) -> jnp.ndarray:
+    """Multi-exponentially spaced grid on [ming, maxg] with ``ng`` points.
+
+    Matches the behavior of HARK's ``make_grid_exp_mult`` (called at
+    ``Aiyagari_Support.py:880`` with ``timestonest = aNestFac``): with
+    ``timestonest > 0`` the endpoints are pushed through ``log(1+x)`` that many
+    times, a linear grid is laid out in the nested-log coordinate, and the
+    transform is inverted pointwise.  ``timestonest == 0`` falls back to a
+    plain exponential (log-linear) grid.
+    """
+    if ng < 2:
+        raise ValueError("need at least two grid points")
+    ming = np.float64(ming)
+    maxg = np.float64(maxg)
+    if timestonest > 0:
+        lo, hi = ming, maxg
+        for _ in range(timestonest):
+            lo = np.log(lo + 1.0)
+            hi = np.log(hi + 1.0)
+        grid = np.linspace(lo, hi, ng)
+        for _ in range(timestonest):
+            grid = np.exp(grid) - 1.0
+    else:
+        grid = np.exp(np.linspace(np.log(ming), np.log(maxg), ng))
+    return jnp.asarray(grid, dtype=dtype)
+
+
+def make_asset_grid(a_min: float, a_max: float, a_count: int, nest_fac: int = 2,
+                    dtype=None) -> jnp.ndarray:
+    """End-of-period asset grid, reference defaults (0.001, 50, 32, nest 2)."""
+    return make_grid_exp_mult(a_min, a_max, a_count, nest_fac, dtype=dtype)

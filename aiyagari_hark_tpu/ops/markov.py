@@ -1,0 +1,165 @@
+"""Markov-chain numerics: Tauchen discretization, stationary distributions,
+and the composite transition matrices of the Aiyagari/Krusell-Smith state space.
+
+The reference builds these in three places:
+  * Tauchen AR(1) discretization via HARK's ``make_tauchen_ar1`` —
+    ``/root/reference/Aiyagari_Support.py:885-887, 1694-1696`` (called with
+    ``sigma = LaborSD * sqrt(1 - LaborAR**2)`` so that ``LaborSD`` is the
+    *stationary* standard deviation, and ``bound = 3.0``).
+  * A 2x2 aggregate matrix and a 4x4 employment-conditional matrix from mean
+    durations — ``Aiyagari_Support.py:1647-1683``.
+  * The full idiosyncratic transition matrix as a Kronecker blow-up of the
+    Tauchen matrix with the employment matrix, written out as 49 literal
+    blocks in the reference (``Aiyagari_Support.py:1715-1780``); here it is a
+    single ``jnp.kron`` for any number of labor states (fixes the hard-coded
+    N=7 quirk, SURVEY.md §3.6-2).
+
+State ordering convention (identical to the reference): full state
+``s = 4*labor_state + k`` with ``k`` in (Bad-Unemployed, Bad-Employed,
+Good-Unemployed, Good-Employed).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax.scipy.stats import norm
+
+
+class TauchenResult(NamedTuple):
+    """Grid of (log-)states and row-stochastic transition matrix."""
+
+    grid: jnp.ndarray        # [n] equally spaced points in the log state
+    transition: jnp.ndarray  # [n, n]; transition[j, k] = P(next=k | cur=j)
+
+
+def tauchen_ar1(n: int, sigma: float, ar_1: float, bound: float = 3.0,
+                dtype=None) -> TauchenResult:
+    """Tauchen (1986) discretization of ``y' = ar_1 * y + sigma * eps``.
+
+    Matches HARK's ``make_tauchen_ar1(N, sigma, ar_1, bound)`` semantics
+    (call sites ``Aiyagari_Support.py:887, 1696``): the grid spans
+    ``± bound * sigma / sqrt(1 - ar_1^2)`` (i.e. ``bound`` stationary standard
+    deviations), interior transition masses are normal CDF differences over
+    half-bin widths, and the edge columns absorb the tails.
+    """
+    sigma = jnp.asarray(sigma, dtype=dtype)
+    ar_1 = jnp.asarray(ar_1, dtype=dtype)
+    y_max = bound * sigma / jnp.sqrt(1.0 - ar_1 ** 2)
+    grid = jnp.linspace(-y_max, y_max, n, dtype=dtype)
+    step = grid[1] - grid[0]
+    # z[j, k] = (grid[k] - ar_1 * grid[j]) / sigma, the standardized distance
+    # from the conditional mean to each target gridpoint.
+    cond_mean = ar_1 * grid[:, None]
+    upper = norm.cdf((grid[None, :] + step / 2.0 - cond_mean) / sigma)
+    lower = norm.cdf((grid[None, :] - step / 2.0 - cond_mean) / sigma)
+    probs = upper - lower
+    # Edge columns: everything below the first half-bin / above the last.
+    probs = probs.at[:, 0].set(norm.cdf((grid[0] + step / 2.0 - cond_mean[:, 0]) / sigma))
+    probs = probs.at[:, -1].set(1.0 - norm.cdf((grid[-1] - step / 2.0 - cond_mean[:, 0]) / sigma))
+    return TauchenResult(grid=grid, transition=probs)
+
+
+def tauchen_labor_process(n_states: int, labor_ar: float, labor_sd: float,
+                          bound: float = 3.0, dtype=None) -> TauchenResult:
+    """The reference's labor-supply process: AR(1) in logs with *stationary*
+    s.d. ``labor_sd`` — innovation s.d. is ``labor_sd * sqrt(1 - ar^2)``
+    (``Aiyagari_Support.py:885-887``)."""
+    sigma_innov = labor_sd * (1.0 - labor_ar ** 2) ** 0.5
+    return tauchen_ar1(n_states, sigma_innov, labor_ar, bound=bound, dtype=dtype)
+
+
+def normalized_labor_states(tauchen_grid: jnp.ndarray) -> jnp.ndarray:
+    """Labor-supply levels: ``exp(grid) / mean(exp(grid))``.
+
+    NOTE: the reference normalizes by the *unweighted* mean over gridpoints
+    (``Aiyagari_Support.py:985, 1265``), not the stationary-distribution mean;
+    we reproduce that exactly for parity.
+    """
+    levels = jnp.exp(tauchen_grid)
+    return levels / jnp.mean(levels)
+
+
+def stationary_distribution(transition: jnp.ndarray, iters: int = 2000) -> jnp.ndarray:
+    """Stationary row vector of a row-stochastic matrix by power iteration.
+
+    Power iteration (rather than an eigensolver) keeps this jit-able and
+    backend-agnostic; ``iters`` matmuls of an [n,n] matrix are negligible.
+    """
+    n = transition.shape[0]
+    pi = jnp.full((n,), 1.0 / n, dtype=transition.dtype)
+    # Squaring the matrix log2(iters) times converges geometrically faster
+    # than repeated vector products and is still a handful of tiny matmuls.
+    mat = transition
+    steps = max(1, int(jnp.ceil(jnp.log2(iters))))
+    for _ in range(steps):
+        mat = mat @ mat
+        mat = mat / jnp.sum(mat, axis=1, keepdims=True)
+    pi = pi @ mat
+    return pi / jnp.sum(pi)
+
+
+def aggregate_markov_matrix(dur_mean_b: float, dur_mean_g: float,
+                            dtype=None) -> jnp.ndarray:
+    """2x2 aggregate (Bad/Good) transition matrix from mean state durations
+    (``Aiyagari_Support.py:1647-1651``): exit probability = 1 / duration."""
+    prob_bg = 1.0 / dur_mean_b
+    prob_gb = 1.0 / dur_mean_g
+    return jnp.asarray(
+        [[1.0 - prob_bg, prob_bg],
+         [prob_gb, 1.0 - prob_gb]], dtype=dtype)
+
+
+def employment_markov_matrix(dur_mean_b: float, dur_mean_g: float,
+                             spell_mean_b: float, spell_mean_g: float,
+                             urate_b: float, urate_g: float,
+                             rel_prob_bg: float, rel_prob_gb: float,
+                             dtype=None) -> jnp.ndarray:
+    """4x4 joint (aggregate x employment) transition matrix, Krusell-Smith
+    calibration identities (``Aiyagari_Support.py:1655-1683``).
+
+    Row/column order: (Bad-Unemp, Bad-Emp, Good-Unemp, Good-Emp).  Rows sum to
+    one; the within-quadrant entries are pinned down by mean unemployment-spell
+    lengths and the requirement that unemployment rates stay at their
+    state-specific levels; cross-quadrant entries use the relative-probability
+    fudge factors of the original KS calibration.
+    """
+    prob_bg = 1.0 / dur_mean_b
+    prob_gb = 1.0 / dur_mean_g
+    prob_bb = 1.0 - prob_bg
+    prob_gg = 1.0 - prob_gb
+
+    m = jnp.zeros((4, 4), dtype=dtype)
+    # Bad -> Bad quadrant: leave unemployment with prob 1/spell length.
+    m = m.at[0, 1].set(prob_bb / spell_mean_b)
+    m = m.at[0, 0].set(prob_bb * (1.0 - 1.0 / spell_mean_b))
+    m = m.at[1, 0].set(urate_b / (1.0 - urate_b) * m[0, 1])
+    m = m.at[1, 1].set(prob_bb - m[1, 0])
+    # Good -> Good quadrant.
+    m = m.at[2, 3].set(prob_gg / spell_mean_g)
+    m = m.at[2, 2].set(prob_gg * (1.0 - 1.0 / spell_mean_g))
+    m = m.at[3, 2].set(urate_g / (1.0 - urate_g) * m[2, 3])
+    m = m.at[3, 3].set(prob_gg - m[3, 2])
+    # Bad -> Good quadrant.
+    m = m.at[0, 2].set(rel_prob_bg * m[2, 2] / prob_gg * prob_bg)
+    m = m.at[0, 3].set(prob_bg - m[0, 2])
+    m = m.at[1, 2].set((prob_bg * urate_g - urate_b * m[0, 2]) / (1.0 - urate_b))
+    m = m.at[1, 3].set(prob_bg - m[1, 2])
+    # Good -> Bad quadrant.
+    m = m.at[2, 0].set(rel_prob_gb * m[0, 0] / prob_bb * prob_gb)
+    m = m.at[2, 1].set(prob_gb - m[2, 0])
+    m = m.at[3, 0].set((prob_gb * urate_b - urate_g * m[2, 0]) / (1.0 - urate_g))
+    m = m.at[3, 1].set(prob_gb - m[3, 0])
+    return m
+
+
+def full_idiosyncratic_matrix(tauchen_transition: jnp.ndarray,
+                              employment_matrix: jnp.ndarray) -> jnp.ndarray:
+    """[4N, 4N] composite transition matrix.
+
+    ``kron(P_tauchen, P_empl)`` — labor-state-major, employment-minor ordering,
+    exactly the blow-up the reference spells out as 49 literal AuxMatrix blocks
+    (``Aiyagari_Support.py:1712-1780``), valid for any number of labor states.
+    """
+    return jnp.kron(tauchen_transition, employment_matrix)

@@ -1,0 +1,45 @@
+"""Closed-form OLS for the Krusell-Smith aggregate-law regression.
+
+The reference calls ``scipy.stats.linregress(logM[these], logA[these])`` per
+aggregate Markov state (``Aiyagari_Support.py:1931-1935``).  Boolean fancy
+indexing has no jit-able analog, so the TPU-native version is *masked* OLS:
+a weighted closed form where the mask is the weight vector.  Identical
+estimates, fixed shapes, fuses into the simulation postprocessing.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class OLSResult(NamedTuple):
+    slope: jnp.ndarray
+    intercept: jnp.ndarray
+    r_squared: jnp.ndarray
+
+
+def masked_ols(x: jnp.ndarray, y: jnp.ndarray, mask: jnp.ndarray) -> OLSResult:
+    """Simple OLS of y on x using only entries where ``mask`` is true.
+
+    All arrays are [T]; the mask enters as 0/1 weights so shapes stay static
+    under jit.  Matches ``scipy.stats.linregress`` estimates on the selected
+    subsample.
+    """
+    w = mask.astype(x.dtype)
+    n = jnp.sum(w)
+    # Empty mask -> NaN slope/intercept (the caller must notice, not silently
+    # proceed); degenerate variance -> r_squared 0 (scipy's convention).
+    n_safe = jnp.maximum(n, 1.0)
+    xm = jnp.sum(w * x) / n_safe
+    ym = jnp.sum(w * y) / n_safe
+    sxx = jnp.sum(w * (x - xm) ** 2)
+    sxy = jnp.sum(w * (x - xm) * (y - ym))
+    syy = jnp.sum(w * (y - ym) ** 2)
+    nan = jnp.full_like(xm, jnp.nan)
+    slope = jnp.where(n > 0, sxy / sxx, nan)
+    intercept = jnp.where(n > 0, ym - slope * xm, nan)
+    r_squared = jnp.where((syy > 0) & (sxx > 0) & (n > 0),
+                          sxy ** 2 / (sxx * syy), jnp.zeros_like(syy))
+    return OLSResult(slope=slope, intercept=intercept, r_squared=r_squared)

@@ -1,0 +1,29 @@
+"""CRRA utility family.
+
+The reference reaches these through HARK's ``MargValueFuncCRRA`` (u' composed
+with the consumption function, ``Aiyagari_Support.py:1514-1515``) and the FOC
+inversion ``c = EndOfPrdvP ** (-1/CRRA)`` (``Aiyagari_Support.py:1490``).
+Closed forms, elementwise, fuse into surrounding XLA computations.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def crra_utility(c: jnp.ndarray, crra: float) -> jnp.ndarray:
+    """u(c); log utility at crra == 1 (static Python branch — crra is a
+    compile-time constant, so no lax.cond is needed)."""
+    if crra == 1.0:
+        return jnp.log(c)
+    return c ** (1.0 - crra) / (1.0 - crra)
+
+
+def marginal_utility(c: jnp.ndarray, crra: float) -> jnp.ndarray:
+    """u'(c) = c^(-crra)."""
+    return c ** (-crra)
+
+
+def inverse_marginal_utility(vp: jnp.ndarray, crra: float) -> jnp.ndarray:
+    """(u')^{-1}(x) = x^(-1/crra) — the EGM first-order-condition inversion."""
+    return vp ** (-1.0 / crra)

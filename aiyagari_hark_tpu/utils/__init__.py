@@ -1,0 +1,3 @@
+from .config import AgentConfig, EconomyConfig, SweepConfig, notebook_run_configs
+
+__all__ = ["AgentConfig", "EconomyConfig", "SweepConfig", "notebook_run_configs"]

@@ -1,0 +1,29 @@
+"""Test environment: run everything on a virtual 8-device CPU mesh so
+sharding/collective paths are exercised without TPU hardware, and enable
+float64 so tests can compare against high-precision oracles.
+
+Must set env vars before the first ``import jax`` anywhere in the test
+process — conftest import order guarantees that under pytest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs

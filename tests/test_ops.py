@@ -1,0 +1,234 @@
+"""Unit tests for the numerics core (SURVEY.md §4: the kernel-level layer of
+the test pyramid the reference lacks)."""
+
+import numpy as np
+import jax.numpy as jnp
+from scipy import stats
+
+from aiyagari_hark_tpu.ops import (
+    aggregate_markov_matrix,
+    employment_markov_matrix,
+    eval_policy_agents,
+    full_idiosyncratic_matrix,
+    interp1d,
+    interp_on_interp,
+    locate_in_grid,
+    make_grid_exp_mult,
+    marginal_utility,
+    inverse_marginal_utility,
+    crra_utility,
+    masked_ols,
+    normalized_labor_states,
+    stationary_distribution,
+    tauchen_ar1,
+    tauchen_labor_process,
+)
+
+
+# ---------------------------------------------------------------- grids
+
+def test_exp_mult_grid_endpoints_and_monotonicity():
+    g = make_grid_exp_mult(0.001, 50.0, 32, 2)
+    assert g.shape == (32,)
+    np.testing.assert_allclose(float(g[0]), 0.001, rtol=1e-9)
+    np.testing.assert_allclose(float(g[-1]), 50.0, rtol=1e-9)
+    assert np.all(np.diff(np.asarray(g)) > 0)
+    # multi-exp nesting clusters points near the lower end
+    d = np.diff(np.asarray(g))
+    assert d[0] < d[-1]
+
+
+def test_exp_mult_grid_matches_reference_algorithm():
+    # Independent NumPy implementation of the nested-log construction.
+    ming, maxg, ng, nest = 0.001, 50.0, 32, 2
+    lo, hi = ming, maxg
+    for _ in range(nest):
+        lo, hi = np.log(lo + 1), np.log(hi + 1)
+    grid = np.linspace(lo, hi, ng)
+    for _ in range(nest):
+        grid = np.exp(grid) - 1
+    np.testing.assert_allclose(np.asarray(make_grid_exp_mult(ming, maxg, ng, nest)),
+                               grid, rtol=1e-9, atol=1e-12)
+
+
+# ---------------------------------------------------------------- tauchen
+
+def test_tauchen_rows_sum_to_one_and_match_scipy():
+    n, sigma, rho, bound = 7, 0.2 * np.sqrt(1 - 0.3 ** 2), 0.3, 3.0
+    grid, P = tauchen_ar1(n, sigma, rho, bound)
+    P = np.asarray(P)
+    grid = np.asarray(grid)
+    np.testing.assert_allclose(P.sum(axis=1), np.ones(n), atol=1e-12)
+    assert np.all(P >= 0)
+    # grid spans ±bound * stationary sd, symmetric
+    sd_stat = sigma / np.sqrt(1 - rho ** 2)
+    np.testing.assert_allclose(grid[-1], bound * sd_stat, rtol=1e-12)
+    np.testing.assert_allclose(grid, -grid[::-1], atol=1e-12)
+    # interior masses are CDF differences over half-bins (scipy oracle)
+    d = grid[1] - grid[0]
+    j, k = 3, 2
+    expect = stats.norm.cdf((grid[k] + d / 2 - rho * grid[j]) / sigma) - \
+        stats.norm.cdf((grid[k] - d / 2 - rho * grid[j]) / sigma)
+    np.testing.assert_allclose(P[j, k], expect, rtol=1e-10)
+    # edge columns absorb the tails
+    expect0 = stats.norm.cdf((grid[0] + d / 2 - rho * grid[j]) / sigma)
+    np.testing.assert_allclose(P[j, 0], expect0, rtol=1e-10)
+
+
+def test_tauchen_iid_limit():
+    # rho=0: every row identical, stationary == rows
+    _, P = tauchen_ar1(5, 0.2, 0.0, 3.0)
+    P = np.asarray(P)
+    for j in range(1, 5):
+        np.testing.assert_allclose(P[j], P[0], atol=1e-12)
+
+
+def test_labor_process_normalization():
+    t = tauchen_labor_process(7, 0.3, 0.2)
+    levels = normalized_labor_states(t.grid)
+    # reference normalizes by the unweighted mean of exp(grid)
+    np.testing.assert_allclose(float(jnp.mean(levels)), 1.0, rtol=1e-12)
+    assert np.all(np.asarray(levels) > 0)
+
+
+def test_stationary_distribution_matches_eig():
+    _, P = tauchen_labor_process(7, 0.6, 0.2)
+    pi = np.asarray(stationary_distribution(P))
+    np.testing.assert_allclose(pi.sum(), 1.0, atol=1e-12)
+    np.testing.assert_allclose(pi @ np.asarray(P), pi, atol=1e-10)
+    # eigen-oracle
+    w, v = np.linalg.eig(np.asarray(P).T)
+    idx = np.argmin(np.abs(w - 1.0))
+    pi_eig = np.real(v[:, idx])
+    pi_eig = pi_eig / pi_eig.sum()
+    np.testing.assert_allclose(pi, pi_eig, atol=1e-8)
+
+
+# ---------------------------------------------------------------- markov composition
+
+def test_aggregate_matrix():
+    A = np.asarray(aggregate_markov_matrix(8.0, 8.0))
+    np.testing.assert_allclose(A.sum(axis=1), [1, 1], atol=1e-15)
+    np.testing.assert_allclose(A[0, 1], 1 / 8)
+
+
+def test_employment_matrix_degenerate_aiyagari():
+    # Urate == 0 in both states (the reference's Aiyagari configuration):
+    # employed stay employed within-quadrant.
+    E = np.asarray(employment_markov_matrix(8.0, 8.0, 2.5, 1.5, 0.0, 0.0, 0.75, 1.25))
+    np.testing.assert_allclose(E.sum(axis=1), np.ones(4), atol=1e-12)
+    assert E[1, 0] == 0.0  # employed never fired within Bad
+    assert E[3, 2] == 0.0
+
+
+def test_employment_matrix_ks_urates():
+    # True KS calibration: unemployment rates are reproduced in expectation.
+    ub, ug = 0.10, 0.04
+    E = np.asarray(employment_markov_matrix(8.0, 8.0, 2.5, 1.5, ub, ug, 0.75, 1.25))
+    np.testing.assert_allclose(E.sum(axis=1), np.ones(4), atol=1e-12)
+    assert np.all(E >= -1e-12)
+    # Conditional on staying Bad, stationary urate stays at ub:
+    # ub * P(U->U|BB) + (1-ub) * P(E->U|BB) = ub * P(B->B)
+    lhs = ub * E[0, 0] + (1 - ub) * E[1, 0]
+    np.testing.assert_allclose(lhs, ub * (1 - 1 / 8.0), rtol=1e-12)
+
+
+def test_full_matrix_is_kron_and_stochastic():
+    t = tauchen_labor_process(7, 0.6, 0.2)
+    E = employment_markov_matrix(8.0, 8.0, 2.5, 1.5, 0.0, 0.0, 0.75, 1.25)
+    F = full_idiosyncratic_matrix(t.transition, E)
+    assert F.shape == (28, 28)
+    F = np.asarray(F)
+    np.testing.assert_allclose(F.sum(axis=1), np.ones(28), atol=1e-10)
+    # block (i,j) == tauchen[i,j] * E
+    np.testing.assert_allclose(F[4 * 2:4 * 3, 4 * 5:4 * 6],
+                               np.asarray(t.transition)[2, 5] * np.asarray(E),
+                               rtol=1e-12)
+
+
+# ---------------------------------------------------------------- utility
+
+def test_crra_roundtrip_and_log_case():
+    c = jnp.array([0.5, 1.0, 2.0, 7.3])
+    for crra in (1.0, 2.0, 5.0):
+        vp = marginal_utility(c, crra)
+        np.testing.assert_allclose(np.asarray(inverse_marginal_utility(vp, crra)),
+                                   np.asarray(c), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(crra_utility(c, 1.0)),
+                               np.log(np.asarray(c)), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(crra_utility(c, 3.0)),
+                               np.asarray(c) ** (-2.0) / (-2.0), rtol=1e-12)
+
+
+# ---------------------------------------------------------------- interp
+
+def test_interp1d_matches_numpy_inside():
+    xp = jnp.array([0.0, 1.0, 2.0, 4.0, 8.0])
+    fp = jnp.array([1.0, 3.0, 2.0, 5.0, 4.0])
+    x = jnp.linspace(0.0, 8.0, 57)
+    np.testing.assert_allclose(np.asarray(interp1d(x, xp, fp)),
+                               np.interp(np.asarray(x), np.asarray(xp), np.asarray(fp)),
+                               rtol=1e-12)
+
+
+def test_interp1d_linear_extrapolation():
+    xp = jnp.array([1.0, 2.0, 3.0])
+    fp = jnp.array([2.0, 4.0, 5.0])
+    # above: last-segment slope 1 -> f(5) = 5 + 2
+    np.testing.assert_allclose(float(interp1d(jnp.array(5.0), xp, fp)), 7.0)
+    # below: first-segment slope 2 -> f(0) = 2 - 2
+    np.testing.assert_allclose(float(interp1d(jnp.array(0.0), xp, fp)), 0.0)
+
+
+def test_interp_on_interp_bilinear_oracle():
+    # With per-column knots all equal, two-level interp == bilinear interp.
+    Mgrid = jnp.array([1.0, 2.0, 4.0])
+    mk = jnp.tile(jnp.array([0.0, 1.0, 2.0]), (3, 1))
+    fk = jnp.array([[0.0, 1.0, 2.0], [1.0, 2.0, 3.0], [3.0, 4.0, 5.0]])
+    v = interp_on_interp(jnp.array(0.5), jnp.array(3.0), Mgrid, mk, fk)
+    # column values at m=0.5: 0.5, 1.5, 3.5 ; M=3 is halfway 2->4: 2.5
+    np.testing.assert_allclose(float(v), 2.5, rtol=1e-12)
+    # linear extrapolation in M above the top column: columns at M=2,4 give
+    # 1.5, 3.5 -> slope 1 -> v(6) = 5.5
+    v = interp_on_interp(jnp.array(0.5), jnp.array(6.0), Mgrid, mk, fk)
+    np.testing.assert_allclose(float(v), 5.5, rtol=1e-12)
+
+
+def test_eval_policy_agents_matches_loop():
+    rng = np.random.default_rng(0)
+    S, Mc, K, N = 6, 4, 9, 8
+    m_knots = np.sort(rng.uniform(0, 10, (S, Mc, K)), axis=-1)
+    f_knots = np.cumsum(rng.uniform(0, 1, (S, Mc, K)), axis=-1)
+    Mgrid = np.array([1.0, 2.0, 3.0, 5.0])
+    m = rng.uniform(0, 12, N)
+    sidx = rng.integers(0, S, N)
+    M = 2.7
+    got = np.asarray(eval_policy_agents(jnp.array(m), jnp.array(sidx), jnp.array(M),
+                                        jnp.array(Mgrid), jnp.array(m_knots),
+                                        jnp.array(f_knots)))
+    for i in range(N):
+        want = float(interp_on_interp(jnp.array(m[i]), jnp.array(M), jnp.array(Mgrid),
+                                      jnp.array(m_knots[sidx[i]]),
+                                      jnp.array(f_knots[sidx[i]])))
+        np.testing.assert_allclose(got[i], want, rtol=1e-10)
+
+
+def test_locate_in_grid_weights():
+    grid = jnp.array([0.0, 1.0, 3.0])
+    i, w = locate_in_grid(jnp.array([0.5, 2.0, -1.0, 9.0]), grid)
+    np.testing.assert_allclose(np.asarray(i), [0, 1, 0, 1])
+    np.testing.assert_allclose(np.asarray(w), [0.5, 0.5, 0.0, 1.0])
+
+
+# ---------------------------------------------------------------- regression
+
+def test_masked_ols_matches_scipy_linregress():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=200)
+    y = 0.7 * x - 1.3 + rng.normal(scale=0.1, size=200)
+    mask = rng.uniform(size=200) < 0.6
+    res = masked_ols(jnp.array(x), jnp.array(y), jnp.array(mask))
+    sp = stats.linregress(x[mask], y[mask])
+    np.testing.assert_allclose(float(res.slope), sp.slope, rtol=1e-10)
+    np.testing.assert_allclose(float(res.intercept), sp.intercept, rtol=1e-10)
+    np.testing.assert_allclose(float(res.r_squared), sp.rvalue ** 2, rtol=1e-10)
