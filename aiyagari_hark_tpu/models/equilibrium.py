@@ -1,0 +1,141 @@
+"""General-equilibrium solvers: capital-market bisection on the interest rate.
+
+The reference finds equilibrium Krusell-Smith style (simulate + regress the
+aggregate law, ``Aiyagari_Support.py:1896-1964``) because it inherits the KS
+machinery; the textbook Aiyagari equilibrium is the fixed point of
+    r  ->  household capital supply A(r)  vs  firm capital demand K(r)
+bisected on r (BASELINE.json's north star keeps this outer loop in Python but
+jits everything inside; here even the bisection itself is a ``lax.while_loop``
+so one XLA program solves a whole calibration cell — and a vmap of it solves
+the whole Table II sweep as one batched program).
+
+The bracket is economic: r must lie below the discount rate (1-beta)/beta
+(supply diverges there) and above -delta (demand diverges).  Excess supply
+A(r) - K(r) is increasing in r, so bisection is globally convergent.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import firm
+from .household import (
+    HouseholdPolicy,
+    SimpleModel,
+    aggregate_capital,
+    aggregate_labor,
+    build_simple_model,
+    solve_household,
+    stationary_wealth,
+)
+
+
+class EquilibriumResult(NamedTuple):
+    r_star: jnp.ndarray          # equilibrium net interest rate
+    wage: jnp.ndarray
+    capital: jnp.ndarray         # K = household asset supply at r_star
+    labor: jnp.ndarray           # effective aggregate labor
+    saving_rate: jnp.ndarray     # delta*K / Y (net saving identity in SS)
+    excess: jnp.ndarray          # residual excess supply at r_star
+    policy: HouseholdPolicy
+    distribution: jnp.ndarray    # [D, N] stationary wealth distribution
+    bisect_iters: jnp.ndarray
+
+
+def household_capital_supply(r, model: SimpleModel, disc_fac, crra,
+                             cap_share, depr_fac, prod=1.0,
+                             egm_tol=1e-6, dist_tol=1e-11):
+    """A(r): solve the household at prices implied by r, return stationary
+    capital plus the objects (policy, distribution, W)."""
+    k_to_l = firm.k_to_l_from_r(r, cap_share, depr_fac, prod)
+    W = firm.wage_rate(k_to_l, cap_share, prod)
+    R = 1.0 + r
+    policy, _, _ = solve_household(R, W, model, disc_fac, crra, tol=egm_tol)
+    dist, _, _ = stationary_wealth(policy, R, W, model, tol=dist_tol)
+    return aggregate_capital(dist, model), policy, dist, W, k_to_l
+
+
+def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
+                                cap_share, depr_fac, prod=1.0,
+                                r_tol: float | None = None,
+                                max_bisect: int = 60,
+                                egm_tol: float | None = None,
+                                dist_tol: float | None = None) -> EquilibriumResult:
+    """Bisect r until the capital market clears.
+
+    Fully jit-able/vmappable: a fixed-trip ``while_loop`` whose body solves
+    the household problem at the midpoint rate.  ``crra`` (and the traced
+    calibration inside ``model``) may be batch axes.  Tolerance defaults are
+    dtype-aware — the f64 values are unreachable in f32 and would force every
+    inner loop to its iteration cap.
+    """
+    dtype = model.a_grid.dtype
+    f64 = dtype == jnp.float64
+    if r_tol is None:
+        r_tol = 1e-10 if f64 else 1e-6
+    if egm_tol is None:
+        egm_tol = 1e-6 if f64 else 1e-5
+    if dist_tol is None:
+        dist_tol = 1e-11 if f64 else 1e-8
+    labor = aggregate_labor(model)
+
+    def excess_supply(r):
+        supply, *_ = household_capital_supply(
+            r, model, disc_fac, crra, cap_share, depr_fac, prod,
+            egm_tol=egm_tol, dist_tol=dist_tol)
+        demand = firm.k_to_l_from_r(r, cap_share, depr_fac, prod) * labor
+        return supply - demand
+
+    r_hi = jnp.asarray(1.0 / disc_fac - 1.0 - 1e-4, dtype=dtype)
+    r_lo = jnp.asarray(-depr_fac + 1e-3, dtype=dtype)
+
+    def cond(state):
+        lo, hi, it = state
+        return ((hi - lo) > r_tol) & (it < max_bisect)
+
+    def body(state):
+        lo, hi, it = state
+        mid = 0.5 * (lo + hi)
+        ex = excess_supply(mid)
+        # excess supply increasing in r: positive -> equilibrium is below mid
+        lo = jnp.where(ex > 0, lo, mid)
+        hi = jnp.where(ex > 0, mid, hi)
+        return lo, hi, it + 1
+
+    lo, hi, iters = jax.lax.while_loop(
+        cond, body, (r_lo, r_hi, jnp.asarray(0)))
+    r_star = 0.5 * (lo + hi)
+
+    supply, policy, dist, wage, k_to_l = household_capital_supply(
+        r_star, model, disc_fac, crra, cap_share, depr_fac, prod,
+        egm_tol=egm_tol, dist_tol=dist_tol)
+    demand = k_to_l * labor
+    output = prod * supply ** cap_share * labor ** (1.0 - cap_share)
+    saving_rate = depr_fac * supply / output
+    return EquilibriumResult(
+        r_star=r_star, wage=wage, capital=supply, labor=labor,
+        saving_rate=saving_rate, excess=supply - demand, policy=policy,
+        distribution=dist, bisect_iters=iters)
+
+
+def solve_calibration(crra: float, labor_ar: float, labor_sd: float = 0.2,
+                      labor_states: int = 7, disc_fac: float = 0.96,
+                      cap_share: float = 0.36, depr_fac: float = 0.08,
+                      a_min: float = 0.001, a_max: float = 50.0,
+                      a_count: int = 32, a_nest_fac: int = 2,
+                      dist_count: int = 500, dtype=None,
+                      **solver_kwargs) -> EquilibriumResult:
+    """One Table II cell: build the model for (crra, rho, sd) and solve.
+
+    ``crra``, ``labor_ar``, ``labor_sd`` may be traced (vmap over cells);
+    every other argument is static structure.
+    """
+    model = build_simple_model(
+        labor_states=labor_states, labor_ar=labor_ar, labor_sd=labor_sd,
+        a_min=a_min, a_max=a_max, a_count=a_count, a_nest_fac=a_nest_fac,
+        dist_count=dist_count, dtype=dtype)
+    return solve_bisection_equilibrium(
+        model, disc_fac, crra, cap_share, depr_fac, **solver_kwargs)

@@ -1,0 +1,60 @@
+"""Cobb-Douglas production side: factor pricing and the perfect-foresight
+steady state.
+
+Reference: ``AiyagariEconomy.update`` computes the steady-state objects
+(``Aiyagari_Support.py:1606-1615``) and ``calc_R_and_W`` prices factors each
+simulated period (``Aiyagari_Support.py:1886-1890``):
+    R = 1 + Z * alpha * (K/L)^(alpha-1) - delta
+    W = Z * (1-alpha) * (K/L)^alpha
+All closed forms, elementwise, jit/vmap-safe (inputs may be traced).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+def interest_factor(k_to_l, cap_share, depr_fac, prod=1.0):
+    """Gross return on capital R = 1 + Z a (K/L)^(a-1) - d."""
+    return 1.0 + prod * cap_share * k_to_l ** (cap_share - 1.0) - depr_fac
+
+
+def wage_rate(k_to_l, cap_share, prod=1.0):
+    """Wage W = Z (1-a) (K/L)^a."""
+    return prod * (1.0 - cap_share) * k_to_l ** cap_share
+
+
+def k_to_l_from_r(r, cap_share, depr_fac, prod=1.0):
+    """Invert the marginal product of capital: the K/L ratio at which the net
+    interest rate is ``r`` — the firm's capital demand per unit labor."""
+    return ((r + depr_fac) / (prod * cap_share)) ** (1.0 / (cap_share - 1.0))
+
+
+def aggregate_resources(k, l, cap_share, depr_fac, prod=1.0):
+    """M = (1-d) K + Z K^a L^(1-a) (``Aiyagari_Support.py:975-976``)."""
+    return (1.0 - depr_fac) * k + prod * k ** cap_share * l ** (1.0 - cap_share)
+
+
+class SteadyState(NamedTuple):
+    k_to_l: jnp.ndarray
+    K: jnp.ndarray
+    W: jnp.ndarray
+    R: jnp.ndarray
+    M: jnp.ndarray
+
+
+def perfect_foresight_steady_state(disc_fac, cap_share, depr_fac,
+                                   lbr_ind=1.0) -> SteadyState:
+    """The representative-agent steady state used to seed the simulation and
+    center the M grid (``Aiyagari_Support.py:1606-1615``): R = 1/beta pins
+    down K/L."""
+    k_to_l = ((1.0 / disc_fac - (1.0 - depr_fac)) / cap_share) ** (
+        1.0 / (cap_share - 1.0))
+    K = k_to_l * lbr_ind
+    W = wage_rate(k_to_l, cap_share)
+    R = interest_factor(k_to_l, cap_share, depr_fac)
+    M = K * R + W * lbr_ind
+    return SteadyState(k_to_l=jnp.asarray(k_to_l), K=jnp.asarray(K),
+                       W=jnp.asarray(W), R=jnp.asarray(R), M=jnp.asarray(M))

@@ -1,0 +1,217 @@
+"""Household problem on the compact (asset x labor-state) space: EGM backward
+step, infinite-horizon fixed point, and the stationary wealth distribution.
+
+This is the *native* state space of the Aiyagari model: N labor states, no
+aggregate-state machinery.  The reference runs the same economics through a
+4N-state Krusell-Smith apparatus with the aggregate shock switched off
+(SURVEY.md §0) — a documented 4x compute waste.  The KS-parity path lives in
+``models.ks_model``; this module is the fast path used by the bisection
+equilibrium and the Table II sweep.
+
+Math contract (same as the reference's one-period solver,
+``Aiyagari_Support.py:1423-1520``, minus the degenerate aggregate dimension):
+    vP'(a_i, s') = u'(c_next(R a_i + W l_{s'}))
+    EndOfPrdvP(a_i, s) = beta * R * sum_{s'} P[s,s'] vP'(a_i, s')
+    c = EndOfPrdvP^(-1/crra);  m = a + c        (endogenous gridpoints)
+    prepend the borrowing-constraint knot (~0, ~0)   (:1503-1504)
+iterated to the infinite-horizon fixed point.  A policy is a pair of knot
+arrays [N, A+1]; evaluation is the batched interp kernel.  Everything is
+jit/vmap-safe: ``crra``/``R``/``W`` may be traced (calibration sweeps vmap
+over them), shapes are static, loops are ``lax.while_loop``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.grids import make_asset_grid, make_grid_exp_mult
+from ..ops.interp import interp1d, interp1d_rowwise, locate_in_grid
+from ..ops.markov import (
+    normalized_labor_states,
+    stationary_distribution,
+    tauchen_labor_process,
+)
+from ..ops.utility import inverse_marginal_utility, marginal_utility
+
+# The reference's borrowing-constraint knot value (Aiyagari_Support.py:1503).
+CONSTRAINT_EPS = 1e-7
+
+
+class HouseholdPolicy(NamedTuple):
+    """Consumption policy as data: per-state endogenous knots, [N, A+1]."""
+
+    m_knots: jnp.ndarray
+    c_knots: jnp.ndarray
+
+
+class SimpleModel(NamedTuple):
+    """Static calibration arrays for the compact household problem."""
+
+    a_grid: jnp.ndarray          # [A] end-of-period asset grid
+    labor_levels: jnp.ndarray    # [N] normalized labor supply per state
+    transition: jnp.ndarray      # [N, N] labor-state Markov matrix
+    labor_stationary: jnp.ndarray  # [N] stationary distribution of labor states
+    dist_grid: jnp.ndarray       # [D] wealth-histogram support
+
+
+def build_simple_model(labor_states: int = 7, labor_ar: float = 0.6,
+                       labor_sd: float = 0.2, labor_bound: float = 3.0,
+                       a_min: float = 0.001, a_max: float = 50.0,
+                       a_count: int = 32, a_nest_fac: int = 2,
+                       dist_count: int = 500, dtype=None) -> SimpleModel:
+    """Assemble the calibration arrays.  ``labor_ar``/``labor_sd`` may be
+    traced scalars (sweep axes); grid sizes are static."""
+    a_grid = make_asset_grid(a_min, a_max, a_count, a_nest_fac, dtype=dtype)
+    tauchen = tauchen_labor_process(labor_states, labor_ar, labor_sd,
+                                    bound=labor_bound, dtype=dtype)
+    levels = normalized_labor_states(tauchen.grid)
+    pi = stationary_distribution(tauchen.transition)
+    # Wealth histogram support: start at the borrowing limit (0), then an
+    # exp-mult grid over (0, a_max] so mass near the constraint is resolved.
+    inner = make_grid_exp_mult(a_min, a_max, dist_count - 1, a_nest_fac,
+                               dtype=dtype)
+    dist_grid = jnp.concatenate([jnp.zeros((1,), dtype=inner.dtype), inner])
+    return SimpleModel(a_grid=a_grid, labor_levels=levels,
+                       transition=tauchen.transition, labor_stationary=pi,
+                       dist_grid=dist_grid)
+
+
+def initial_policy(model: SimpleModel) -> HouseholdPolicy:
+    """Terminal guess c(m) = m — the reference's ``IdentityFunction`` terminal
+    solution (``Aiyagari_Support.py:898``) expressed as knots with slope 1."""
+    n = model.labor_levels.shape[0]
+    eps = jnp.asarray(CONSTRAINT_EPS, dtype=model.a_grid.dtype)
+    m_row = jnp.concatenate([eps[None], model.a_grid + eps])
+    m_knots = jnp.tile(m_row, (n, 1))
+    return HouseholdPolicy(m_knots=m_knots, c_knots=m_knots)
+
+
+def egm_step(policy: HouseholdPolicy, R, W, model: SimpleModel,
+             disc_fac, crra) -> HouseholdPolicy:
+    """One EGM backward step on the [A, N] block.  The expectation over next
+    states is a single [A,N']x[N',N] matmul (MXU-friendly), replacing the
+    reference's per-state Python loop (``Aiyagari_Support.py:1479-1485``)."""
+    a = model.a_grid                                  # [A]
+    m_next = R * a[:, None] + W * model.labor_levels[None, :]   # [A, N']
+    # c_next(m) per next-state: rowwise interp with per-state knots.
+    c_next = interp1d_rowwise(m_next.T, policy.m_knots, policy.c_knots).T
+    vp_next = marginal_utility(c_next, crra)          # [A, N']
+    end_of_prd_vp = disc_fac * R * (vp_next @ model.transition.T)  # [A, N]
+    c_now = inverse_marginal_utility(end_of_prd_vp, crra)
+    m_now = a[:, None] + c_now
+    eps = jnp.full((1, c_now.shape[1]), CONSTRAINT_EPS, dtype=c_now.dtype)
+    c_knots = jnp.concatenate([eps, c_now], axis=0).T   # [N, A+1]
+    m_knots = jnp.concatenate([eps, m_now], axis=0).T
+    return HouseholdPolicy(m_knots=m_knots, c_knots=c_knots)
+
+
+def solve_household(R, W, model: SimpleModel, disc_fac, crra,
+                    tol: float = 1e-6, max_iter: int = 3000):
+    """Infinite-horizon EGM fixed point via ``lax.while_loop``.
+
+    Convergence is sup-norm on the consumption knots — the array analog of
+    HARK's ConsumerSolution distance the reference's agent loop uses
+    (SURVEY.md §3.1).  Returns (policy, n_iter, final_diff).
+    """
+    p0 = initial_policy(model)
+    big = jnp.asarray(jnp.inf, dtype=p0.c_knots.dtype)
+
+    def cond(state):
+        _, diff, it = state
+        return (diff > tol) & (it < max_iter)
+
+    def body(state):
+        policy, _, it = state
+        new = egm_step(policy, R, W, model, disc_fac, crra)
+        diff = jnp.max(jnp.abs(new.c_knots - policy.c_knots))
+        return new, diff, it + 1
+
+    policy, diff, it = jax.lax.while_loop(cond, body, (p0, big, jnp.asarray(0)))
+    return policy, it, diff
+
+
+def consumption_at(policy: HouseholdPolicy, m, state_idx=None):
+    """Evaluate c(m) — rowwise if ``m`` is [N or batch]-shaped per state."""
+    if state_idx is None:
+        return interp1d_rowwise(m, policy.m_knots, policy.c_knots)
+    return interp1d(m, policy.m_knots[state_idx], policy.c_knots[state_idx])
+
+
+class WealthTransition(NamedTuple):
+    """Precomputed Young-method lottery: where each (wealth-gridpoint, state)
+    cell's savings land on the histogram support."""
+
+    idx: jnp.ndarray     # [D, N] left-neighbor index into dist_grid
+    weight: jnp.ndarray  # [D, N] mass share on the right neighbor
+    a_next: jnp.ndarray  # [D, N] savings policy on the distribution grid
+
+
+def wealth_transition(policy: HouseholdPolicy, R, W,
+                      model: SimpleModel) -> WealthTransition:
+    """Savings policy evaluated on the histogram support, split into lottery
+    weights (Young 2010 non-stochastic simulation — the deterministic
+    replacement for the reference's 350-agent Monte Carlo panel)."""
+    x = model.dist_grid                                  # [D] capital today
+    m = R * x[:, None] + W * model.labor_levels[None, :]  # [D, N]
+    c = interp1d_rowwise(m.T, policy.m_knots, policy.c_knots).T
+    a_next = jnp.clip(m - c, 0.0, model.dist_grid[-1])
+    idx, w = locate_in_grid(a_next, model.dist_grid)
+    return WealthTransition(idx=idx, weight=w, a_next=a_next)
+
+
+def _push_forward(dist, trans: WealthTransition, transition_matrix):
+    """One distribution-iteration step: scatter mass along the asset lottery,
+    then mix labor states with a [D,N]x[N,N] matmul."""
+    d_size = dist.shape[0]
+
+    def scatter_one_state(d_col, idx_col, w_col):
+        z = jnp.zeros((d_size,), dtype=d_col.dtype)
+        z = z.at[idx_col].add(d_col * (1.0 - w_col))
+        z = z.at[idx_col + 1].add(d_col * w_col)
+        return z
+
+    moved = jax.vmap(scatter_one_state, in_axes=1, out_axes=1)(
+        dist, trans.idx, trans.weight)
+    return moved @ transition_matrix
+
+
+def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
+                      tol: float = 1e-11, max_iter: int = 20000):
+    """Stationary joint distribution over (wealth, labor state), [D, N].
+
+    Returns (dist, n_iter, final_diff).  ``tol`` is on the sup-norm of the
+    distribution update; mass is conserved exactly by the lottery scatter.
+    """
+    trans = wealth_transition(policy, R, W, model)
+    d_size, n = model.dist_grid.shape[0], model.labor_levels.shape[0]
+    dist0 = (jnp.zeros((d_size, n), dtype=model.dist_grid.dtype)
+             .at[0, :].set(model.labor_stationary))
+    big = jnp.asarray(jnp.inf, dtype=dist0.dtype)
+
+    def cond(state):
+        _, diff, it = state
+        return (diff > tol) & (it < max_iter)
+
+    def body(state):
+        dist, _, it = state
+        new = _push_forward(dist, trans, model.transition)
+        diff = jnp.max(jnp.abs(new - dist))
+        return new, diff, it + 1
+
+    dist, diff, it = jax.lax.while_loop(cond, body, (dist0, big, jnp.asarray(0)))
+    return dist, it, diff
+
+
+def aggregate_capital(dist: jnp.ndarray, model: SimpleModel) -> jnp.ndarray:
+    """E[a] under the stationary distribution — household capital supply."""
+    return jnp.sum(dist * model.dist_grid[:, None])
+
+
+def aggregate_labor(model: SimpleModel) -> jnp.ndarray:
+    """Effective labor supply E[l] under the stationary labor distribution.
+    Not exactly 1.0: the reference normalizes levels by the unweighted grid
+    mean (``Aiyagari_Support.py:985``), so the stationary mean differs."""
+    return jnp.sum(model.labor_stationary * model.labor_levels)

@@ -21,6 +21,7 @@ Good-Unemployed, Good-Employed).
 
 from __future__ import annotations
 
+import math
 from typing import NamedTuple
 
 import jax.numpy as jnp
@@ -92,7 +93,7 @@ def stationary_distribution(transition: jnp.ndarray, iters: int = 2000) -> jnp.n
     # Squaring the matrix log2(iters) times converges geometrically faster
     # than repeated vector products and is still a handful of tiny matmuls.
     mat = transition
-    steps = max(1, int(jnp.ceil(jnp.log2(iters))))
+    steps = max(1, math.ceil(math.log2(iters)))
     for _ in range(steps):
         mat = mat @ mat
         mat = mat / jnp.sum(mat, axis=1, keepdims=True)

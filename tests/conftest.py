@@ -2,28 +2,37 @@
 sharding/collective paths are exercised without TPU hardware, and enable
 float64 so tests can compare against high-precision oracles.
 
-Must set env vars before the first ``import jax`` anywhere in the test
-process — conftest import order guarantees that under pytest.
+GOTCHA (this image): ``jax`` is preloaded at interpreter startup by the axon
+TPU platform plugin, and ``JAX_PLATFORMS=axon`` is exported in the shell — so
+setting env vars here is too late to pick the platform.  ``jax.config.update``
+still works because the backend itself initializes lazily, and ``XLA_FLAGS``
+is also read at backend-init time (so the host-device-count flag does land).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import jax  # noqa: E402
+import jax  # noqa: E402  (already preloaded; config still mutable)
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _assert_cpu_mesh():
+    devs = jax.devices()
+    assert jax.default_backend() == "cpu", f"tests must run on cpu, got {jax.default_backend()}"
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    yield
+
+
 @pytest.fixture(scope="session")
 def devices():
-    devs = jax.devices()
-    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
-    return devs
+    return jax.devices()

@@ -1,0 +1,79 @@
+"""Equilibrium-level tests: market clearing, golden regression values, the
+f32-vs-f64 1bp equivalence budget (BASELINE.md), and comparative statics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.models.equilibrium import solve_calibration
+
+# Reference context (BASELINE.md): the reference's KS-style run of the same
+# calibration records r* = 4.178% with 350-agent Monte Carlo noise; Aiyagari's
+# paper value is 4.09%.  Our deterministic fine-distribution solve gives
+# 4.125% — the regression pin for this framework's CPU oracle.
+GOLDEN_R_STAR = 0.041251
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    fn = jax.jit(lambda: solve_calibration(1.0, 0.3, labor_sd=0.2,
+                                           dist_count=500))
+    return fn()
+
+
+def test_market_clears(baseline):
+    assert abs(float(baseline.excess)) < 1e-6
+
+
+def test_r_star_golden(baseline):
+    assert abs(float(baseline.r_star) - GOLDEN_R_STAR) < 5e-5
+
+
+def test_r_star_near_paper_and_reference(baseline):
+    r_pct = float(baseline.r_star) * 100
+    # Aiyagari Table II: 4.0912; reference notebook: 4.178
+    assert 3.9 < r_pct < 4.3
+    sr_pct = float(baseline.saving_rate) * 100
+    # reference notebook savings rate: 23.649%
+    assert 22.0 < sr_pct < 25.5
+
+
+def test_f32_within_1bp_of_f64(baseline):
+    """BASELINE.md equivalence target: |r*_TPU(f32) - r*_CPU(f64)| < 1 bp."""
+    res32 = jax.jit(lambda: solve_calibration(
+        1.0, 0.3, labor_sd=0.2, dist_count=500, dtype=jnp.float32,
+        r_tol=1e-6, egm_tol=1e-5, dist_tol=1e-8))()
+    diff = abs(float(res32.r_star) - float(baseline.r_star))
+    assert diff < 1e-4, f"f32/f64 gap {diff*1e4:.2f} bp"
+    assert res32.r_star.dtype == jnp.float32
+
+
+def test_comparative_statics_crra():
+    """More risk aversion -> more precautionary saving -> lower r*."""
+    r = {}
+    for crra in (1.0, 5.0):
+        res = jax.jit(lambda c: solve_calibration(c, 0.3, dist_count=300))(crra)
+        r[crra] = float(res.r_star)
+    assert r[5.0] < r[1.0]
+
+
+def test_comparative_statics_persistence():
+    """More persistent income risk -> lower r*."""
+    fn = jax.jit(lambda rho: solve_calibration(1.0, rho, dist_count=300))
+    assert float(fn(0.9).r_star) < float(fn(0.0).r_star)
+
+
+def test_vmap_over_cells_matches_serial():
+    """A vmapped (crra, rho) batch — the Table II execution shape — agrees
+    with per-cell solves."""
+    crras = jnp.array([1.0, 3.0])
+    rhos = jnp.array([0.0, 0.6])
+    batched = jax.jit(jax.vmap(
+        lambda c, r: solve_calibration(c, r, dist_count=200).r_star))
+    rb = np.asarray(batched(crras, rhos))
+    for i in range(2):
+        ci, rhoi = float(crras[i]), float(rhos[i])
+        ri = float(jax.jit(
+            lambda c, r: solve_calibration(c, r, dist_count=200).r_star)(ci, rhoi))
+        np.testing.assert_allclose(rb[i], ri, atol=1e-9)

@@ -1,0 +1,133 @@
+"""Household-solver tests: Euler-equation residuals, budget identities,
+monotonicity, and stationary-distribution invariants (SURVEY.md §4 test
+pyramid: kernel-level checks against theory the reference never had)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.models.household import (
+    aggregate_capital,
+    aggregate_labor,
+    build_simple_model,
+    consumption_at,
+    initial_policy,
+    solve_household,
+    stationary_wealth,
+    wealth_transition,
+    _push_forward,
+)
+from aiyagari_hark_tpu.models import firm
+
+DISC, CRRA, ALPHA, DELTA = 0.96, 1.0, 0.36, 0.08
+
+
+@pytest.fixture(scope="module")
+def model():
+    return build_simple_model(labor_states=7, labor_ar=0.3, labor_sd=0.2,
+                              dist_count=300)
+
+
+@pytest.fixture(scope="module")
+def prices():
+    # prices at a plausible r below the discount rate
+    r = 0.038
+    k_to_l = firm.k_to_l_from_r(r, ALPHA, DELTA)
+    return 1.0 + r, float(firm.wage_rate(k_to_l, ALPHA))
+
+
+@pytest.fixture(scope="module")
+def solved(model, prices):
+    R, W = prices
+    policy, iters, diff = solve_household(R, W, model, DISC, CRRA)
+    return policy, int(iters), float(diff)
+
+
+def test_egm_converges(solved):
+    _, iters, diff = solved
+    assert diff < 1e-6
+    assert iters < 3000
+
+
+def test_euler_equation_residual(model, prices, solved):
+    """Off the borrowing constraint, u'(c(m)) = beta R E[u'(c(R a' + W l'))]."""
+    R, W = prices
+    policy, _, _ = solved
+    n = model.labor_levels.shape[0]
+    m = jnp.linspace(2.0, 30.0, 50)
+    max_rel = 0.0
+    for s in range(n):
+        c = consumption_at(policy, m, s)
+        a_next = m - c
+        interior = np.asarray(a_next) > 0.05
+        m_next = R * a_next[:, None] + W * model.labor_levels[None, :]
+        c_next = jax.vmap(lambda mm: consumption_at(policy, mm))(m_next)
+        rhs = DISC * R * (c_next ** (-CRRA) @ model.transition[s])
+        lhs = c ** (-CRRA)
+        rel = np.abs(np.asarray(lhs - rhs)) / np.asarray(lhs)
+        if interior.any():
+            max_rel = max(max_rel, float(rel[interior].max()))
+    # linear-interp discretization error dominates; residual must be small
+    assert max_rel < 5e-3, max_rel
+
+
+def test_policy_monotone_and_budget(model, prices, solved):
+    R, W = prices
+    policy, _, _ = solved
+    m = jnp.linspace(0.5, 40.0, 200)
+    for s in (0, 3, 6):
+        c = np.asarray(consumption_at(policy, m, s))
+        assert np.all(np.diff(c) > 0), "consumption increasing in m"
+        a_next = np.asarray(m) - c
+        assert np.all(np.diff(a_next) >= -1e-10), "savings nondecreasing in m"
+        assert np.all(c > 0)
+        assert np.all(a_next > -1e-7), "borrowing constraint respected"
+
+
+def test_constrained_region_consumes_everything(model, prices, solved):
+    """Below the first endogenous knot the agent consumes ~all resources
+    (the reference's prepended (1e-7, 1e-7) constraint segment)."""
+    R, W = prices
+    policy, _, _ = solved
+    m0 = float(policy.m_knots[0, 1])  # first endogenous knot, poorest state
+    m = jnp.asarray(0.5 * m0)
+    c = float(consumption_at(policy, m, 0))
+    assert abs(c - float(m)) / float(m) < 2e-3
+
+
+def test_stationary_distribution_invariants(model, prices, solved):
+    R, W = prices
+    policy, _, _ = solved
+    dist, iters, diff = stationary_wealth(policy, R, W, model)
+    d = np.asarray(dist)
+    assert abs(d.sum() - 1.0) < 1e-8
+    assert (d >= -1e-15).all()
+    # labor marginal matches the stationary labor distribution
+    np.testing.assert_allclose(d.sum(axis=0), np.asarray(model.labor_stationary),
+                               atol=1e-6)
+    # invariance under one more push-forward
+    trans = wealth_transition(policy, R, W, model)
+    d2 = _push_forward(dist, trans, model.transition)
+    np.testing.assert_allclose(np.asarray(d2), d, atol=1e-9)
+    # aggregate capital is positive and finite
+    K = float(aggregate_capital(dist, model))
+    assert 0.1 < K < 50.0
+
+
+def test_aggregate_labor_near_one(model):
+    # normalized levels have unweighted mean 1; stationary mean is close
+    L = float(aggregate_labor(model))
+    assert 0.85 < L < 1.1
+
+
+def test_impatience_supply_rises_with_r(model):
+    """Capital supply is increasing in r near equilibrium (bisection validity)."""
+    supplies = []
+    for r in (0.02, 0.041):
+        k_to_l = firm.k_to_l_from_r(r, ALPHA, DELTA)
+        W = float(firm.wage_rate(k_to_l, ALPHA))
+        policy, _, _ = solve_household(1.0 + r, W, model, DISC, CRRA)
+        dist, _, _ = stationary_wealth(policy, 1.0 + r, W, model)
+        supplies.append(float(aggregate_capital(dist, model)))
+    assert supplies[1] > supplies[0]
