@@ -1,0 +1,233 @@
+"""Krusell-Smith-machinery Aiyagari model — the reference-parity path.
+
+This is the TPU-native rebuild of the reference's full apparatus
+(``Aiyagari_Support.py``): the 4N-state space (2 aggregate x 2 employment x N
+labor states, ordered ``s = 4*labor + (2*agg + employed)`` exactly as the
+reference's ``MrkvIndArray``), the aggregate-resource grid M, the perceived
+aggregate saving rule ``A = exp(intercept + slope log M)``, the EGM solver
+over ``[aCount, Mcount, 4N]``, and the precomputed-array factory.  The
+reference runs this machinery with the aggregate shock switched off
+(ProdB=ProdG=1, UrateB=UrateG=0 — SURVEY.md §0); with those parameters
+changed it *is* a working true Krusell-Smith model (the reference's broken
+D2/D3 intent, SURVEY.md §2.2).
+
+Design: a solution is a pair of knot arrays ``[S, Mc, A+1]`` (not 28x16
+interpolator objects); precompute is a pure jitted function of the AFunc
+parameters (re-run each outer iteration, as the reference does at
+``Aiyagari_Support.py:923-927``); the expectation step is one batched matmul
+over the composite transition matrix.  All shapes static, N-generic.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.grids import make_asset_grid
+from ..ops.interp import interp_on_interp
+from ..ops.markov import (
+    aggregate_markov_matrix,
+    employment_markov_matrix,
+    full_idiosyncratic_matrix,
+    normalized_labor_states,
+    tauchen_labor_process,
+)
+from ..ops.utility import inverse_marginal_utility, marginal_utility
+from ..utils.config import AgentConfig, EconomyConfig
+from . import firm
+from .household import CONSTRAINT_EPS
+
+
+class KSCalibration(NamedTuple):
+    """Static calibration arrays + scalars for the 4N-state model.
+
+    Columns indexed by next-period state s' carry the aggregate objects the
+    reference tiles into [.., 4N] blocks (``Aiyagari_Support.py:935-1018``):
+    ``agg_of_state`` maps s' to 0/1 (Bad/Good), ``emp_of_state`` to 0/1.
+    """
+
+    a_grid: jnp.ndarray           # [A]
+    m_grid: jnp.ndarray           # [Mc] aggregate-resource grid (MSS * MgridBase)
+    labor_levels: jnp.ndarray     # [N]
+    ind_transition: jnp.ndarray   # [S, S] composite idiosyncratic matrix
+    tauchen_transition: jnp.ndarray  # [N, N]
+    empl_transition: jnp.ndarray  # [4, 4]
+    agg_transition: jnp.ndarray   # [2, 2]
+    agg_of_state: jnp.ndarray     # [S] int 0/1
+    emp_of_state: jnp.ndarray     # [S] int 0/1
+    labor_of_state: jnp.ndarray   # [S] int 0..N-1
+    prod_by_agg: jnp.ndarray      # [2] (ProdB, ProdG)
+    urate_by_agg: jnp.ndarray     # [2] (UrateB, UrateG)
+    disc_fac: jnp.ndarray
+    crra: jnp.ndarray
+    lbr_ind: jnp.ndarray
+    cap_share: jnp.ndarray
+    depr_fac: jnp.ndarray
+    steady_state: firm.SteadyState
+    ks_employment: bool           # True: unemployed earn 0 (true KS);
+                                  # False: reference-parity Aiyagari mode
+                                  # (labor level regardless of employment,
+                                  #  Aiyagari_Support.py:991-1018)
+
+
+class KSPolicy(NamedTuple):
+    """Per-state consumption policy over (m, M): knots ``[S, Mc, A+1]``."""
+
+    m_knots: jnp.ndarray
+    c_knots: jnp.ndarray
+
+
+class AFuncParams(NamedTuple):
+    """The perceived log-linear aggregate saving rules, one per aggregate
+    state (``AggregateSavingRule``, ``Aiyagari_Support.py:1991-2005``)."""
+
+    intercept: jnp.ndarray  # [2]
+    slope: jnp.ndarray      # [2]
+
+    def __call__(self, M, agg_idx):
+        return jnp.exp(self.intercept[agg_idx] + self.slope[agg_idx] * jnp.log(M))
+
+
+def build_ks_calibration(agent: AgentConfig, econ: EconomyConfig,
+                         ks_employment: bool = False,
+                         dtype=None) -> KSCalibration:
+    """Assemble all static arrays from the two configs (the work the
+    reference spreads across ``update``/``make_MrkvArray``/
+    ``get_economy_data``, ``Aiyagari_Support.py:1593-1791, 817-873``)."""
+    n = agent.labor_states
+    s_count = 4 * n
+    a_grid = make_asset_grid(agent.a_min, agent.a_max, agent.a_count,
+                             agent.a_nest_fac, dtype=dtype)
+    tauchen = tauchen_labor_process(n, econ.labor_ar, econ.labor_sd,
+                                    bound=agent.labor_bound, dtype=dtype)
+    levels = normalized_labor_states(tauchen.grid)
+    empl = employment_markov_matrix(
+        econ.dur_mean_b, econ.dur_mean_g, econ.spell_mean_b, econ.spell_mean_g,
+        econ.urate_b, econ.urate_g, econ.rel_prob_bg, econ.rel_prob_gb,
+        dtype=dtype)
+    agg = aggregate_markov_matrix(econ.dur_mean_b, econ.dur_mean_g, dtype=dtype)
+    ind = full_idiosyncratic_matrix(tauchen.transition, empl)
+    ss = firm.perfect_foresight_steady_state(
+        econ.disc_fac, econ.cap_share, econ.depr_fac, econ.lbr_ind)
+    m_grid = ss.M * jnp.asarray(agent.mgrid_base, dtype=a_grid.dtype)
+    states = jnp.arange(s_count)
+    k = states % 4
+    return KSCalibration(
+        a_grid=a_grid, m_grid=m_grid, labor_levels=levels,
+        ind_transition=ind, tauchen_transition=tauchen.transition,
+        empl_transition=empl, agg_transition=agg,
+        agg_of_state=k // 2, emp_of_state=k % 2, labor_of_state=states // 4,
+        prod_by_agg=jnp.asarray([econ.prod_b, econ.prod_g], dtype=a_grid.dtype),
+        urate_by_agg=jnp.asarray([econ.urate_b, econ.urate_g], dtype=a_grid.dtype),
+        disc_fac=jnp.asarray(econ.disc_fac, dtype=a_grid.dtype),
+        crra=jnp.asarray(econ.crra, dtype=a_grid.dtype),
+        lbr_ind=jnp.asarray(econ.lbr_ind, dtype=a_grid.dtype),
+        cap_share=jnp.asarray(econ.cap_share, dtype=a_grid.dtype),
+        depr_fac=jnp.asarray(econ.depr_fac, dtype=a_grid.dtype),
+        steady_state=ss, ks_employment=ks_employment)
+
+
+class PrecomputedArrays(NamedTuple):
+    """Everything the one-period solver consumes, as a pure function of the
+    AFunc parameters (the reference's ``precompute_arrays``,
+    ``Aiyagari_Support.py:906-1037``, minus the redundant current-state
+    tiling: none of these depend on the current state s)."""
+
+    m_next: jnp.ndarray   # [A, Mc, S'] idiosyncratic resources next period
+    M_next: jnp.ndarray   # [Mc, S'] aggregate resources next period
+    R_next: jnp.ndarray   # [Mc, S'] interest factor next period
+
+
+def precompute(afunc: AFuncParams, cal: KSCalibration) -> PrecomputedArrays:
+    """K' = AFunc[agg(s')](M); prices and resources next period per
+    (M-gridpoint, next state).  Replaces the reference's 28-column literal
+    concatenations with N-generic gathers (fixes SURVEY.md §3.6-2)."""
+    agg_idx = cal.agg_of_state                       # [S']
+    K_next = afunc(cal.m_grid[:, None], agg_idx[None, :])   # [Mc, S']
+    L_next = (1.0 - cal.urate_by_agg[agg_idx]) * cal.lbr_ind  # [S']
+    Z_next = cal.prod_by_agg[agg_idx]                # [S']
+    k_to_l = K_next / L_next[None, :]
+    R_next = firm.interest_factor(k_to_l, cal.cap_share, cal.depr_fac, Z_next)
+    W_next = firm.wage_rate(k_to_l, cal.cap_share, Z_next)
+    M_next = firm.aggregate_resources(K_next, L_next[None, :], cal.cap_share,
+                                      cal.depr_fac, Z_next)
+    # Idiosyncratic effective labor next period: the labor level of s' —
+    # times the employment indicator only in true-KS mode
+    # (reference Aiyagari mode pays the level regardless: :991-1018).
+    l_next = cal.labor_levels[cal.labor_of_state]    # [S']
+    if cal.ks_employment:
+        l_next = l_next * cal.emp_of_state
+    m_next = (R_next[None, :, :] * cal.a_grid[:, None, None]
+              + W_next[None, :, :] * l_next[None, None, :])
+    return PrecomputedArrays(m_next=m_next, M_next=M_next, R_next=R_next)
+
+
+def initial_ks_policy(cal: KSCalibration) -> KSPolicy:
+    """c(m, M) = m per state — the reference's ``IdentityFunction`` terminal
+    guess (``Aiyagari_Support.py:898``)."""
+    s_count = cal.ind_transition.shape[0]
+    mc = cal.m_grid.shape[0]
+    eps = jnp.asarray(CONSTRAINT_EPS, dtype=cal.a_grid.dtype)
+    row = jnp.concatenate([eps[None], cal.a_grid + eps])
+    knots = jnp.tile(row, (s_count, mc, 1))
+    return KSPolicy(m_knots=knots, c_knots=knots)
+
+
+def egm_step_ks(policy: KSPolicy, pre: PrecomputedArrays,
+                cal: KSCalibration) -> KSPolicy:
+    """One EGM backward step over the ``[A, Mc, S]`` block
+    (``solve_Aiyagari``, ``Aiyagari_Support.py:1423-1520``, as pure array
+    math: the 28-interpolator Python loop becomes a vmapped two-level interp,
+    the probability-weighted sum becomes one matmul)."""
+    # c'(m', M') for every next state: vmap over (Mc, S') columns; each
+    # column interpolates the A-vector of m' queries at scalar M'.
+    def eval_col(m_col, M_scalar, s_idx):
+        return interp_on_interp(m_col, M_scalar, cal.m_grid,
+                                policy.m_knots[s_idx], policy.c_knots[s_idx])
+
+    s_count = cal.ind_transition.shape[0]
+    sp = jnp.arange(s_count)
+    # [Mc, S'] -> vmap over both: result [Mc, S', A] -> transpose to [A, Mc, S']
+    c_next = jax.vmap(
+        jax.vmap(eval_col, in_axes=(1, 0, 0)),   # over S' (m [A,S'], M [S'], s [S'])
+        in_axes=(1, 0, None),                     # over Mc
+    )(pre.m_next, pre.M_next, sp)                 # [Mc, S', A]
+    c_next = jnp.moveaxis(c_next, 2, 0)           # [A, Mc, S']
+    vp_next = marginal_utility(c_next, cal.crra)
+    weighted = pre.R_next[None, :, :] * vp_next   # [A, Mc, S']
+    # EndOfPrdvP[a, mc, s] = beta * sum_{s'} P[s, s'] weighted[a, mc, s']
+    end_vp = cal.disc_fac * jnp.einsum("ams,ks->amk", weighted,
+                                       cal.ind_transition)
+    c_now = inverse_marginal_utility(end_vp, cal.crra)    # [A, Mc, S]
+    m_now = cal.a_grid[:, None, None] + c_now
+    eps = jnp.full((1,) + c_now.shape[1:], CONSTRAINT_EPS, dtype=c_now.dtype)
+    # [A+1, Mc, S] -> [S, Mc, A+1]
+    c_knots = jnp.transpose(jnp.concatenate([eps, c_now], axis=0), (2, 1, 0))
+    m_knots = jnp.transpose(jnp.concatenate([eps, m_now], axis=0), (2, 1, 0))
+    return KSPolicy(m_knots=m_knots, c_knots=c_knots)
+
+
+def solve_ks_household(afunc: AFuncParams, cal: KSCalibration,
+                       tol: float = 1e-6, max_iter: int = 2000):
+    """Infinite-horizon fixed point of the 4N-state EGM step under the given
+    perceived aggregate law.  Sup-norm convergence on consumption knots (the
+    array analog of HARK's solution distance).  Returns (policy, iters, diff).
+    """
+    pre = precompute(afunc, cal)
+    p0 = initial_ks_policy(cal)
+    big = jnp.asarray(jnp.inf, dtype=p0.c_knots.dtype)
+
+    def cond(state):
+        _, diff, it = state
+        return (diff > tol) & (it < max_iter)
+
+    def body(state):
+        policy, _, it = state
+        new = egm_step_ks(policy, pre, cal)
+        diff = jnp.max(jnp.abs(new.c_knots - policy.c_knots))
+        return new, diff, it + 1
+
+    policy, diff, it = jax.lax.while_loop(cond, body, (p0, big, jnp.asarray(0)))
+    return policy, it, diff

@@ -1,0 +1,172 @@
+"""The Krusell-Smith outer fixed point: simulate -> regress -> damp -> repeat.
+
+Reference: ``Market.solve`` drives solve_agents / make_history /
+update_dynamics until the aggregate saving rule stops moving
+(SURVEY.md §3.1); the regression and damping live in ``calc_AFunc``
+(``Aiyagari_Support.py:1896-1964``).  Per the north star (BASELINE.json) the
+outer loop stays in host Python; everything inside an iteration — the 4N-state
+EGM fixed point, the 11,000-period panel scan, and the per-state masked
+regression — is one jitted call each.
+
+The convergence metric is HARK's distance on the rule parameters:
+``max_i max(|d slope_i|, |d intercept_i|)`` (``distance_criteria`` at
+``Aiyagari_Support.py:1989``), against ``EconomyConfig.tolerance``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.regression import masked_ols
+from ..utils.config import AgentConfig, EconomyConfig
+from .ks_model import (
+    AFuncParams,
+    KSCalibration,
+    KSPolicy,
+    build_ks_calibration,
+    solve_ks_household,
+)
+from .simulate import (
+    PanelHistory,
+    initial_panel,
+    simulate_markov_history,
+    simulate_panel,
+)
+
+
+def calc_afunc_update(history: PanelHistory, mrkv_hist: jnp.ndarray,
+                      afunc: AFuncParams, t_discard: int, damping: float):
+    """New saving-rule parameters from a simulated history (``calc_AFunc``):
+    per aggregate state, OLS of log A_t on log M_{t-1}, then a damped merge
+    with the previous parameters.  Returns (new_params, r_squared[2])."""
+    log_a = jnp.log(history.A_prev[t_discard:])
+    log_m = jnp.log(history.M_now[t_discard - 1:-1])
+    states = mrkv_hist[t_discard - 1:-1]
+    w = 1.0 - damping
+
+    def one_state(i):
+        res = masked_ols(log_m, log_a, states == i)
+        intercept = w * res.intercept + damping * afunc.intercept[i]
+        slope = w * res.slope + damping * afunc.slope[i]
+        return intercept, slope, res.r_squared
+
+    intercepts, slopes, rsqs = jax.vmap(one_state)(jnp.arange(2))
+    return AFuncParams(intercept=intercepts, slope=slopes), rsqs
+
+
+@dataclass
+class KSIterationRecord:
+    """Structured observability per outer iteration (replaces the reference's
+    ``verbose`` print at ``Aiyagari_Support.py:1954-1962``)."""
+
+    iteration: int
+    intercept: List[float]
+    slope: List[float]
+    r_squared: List[float]
+    distance: float
+    egm_iters: int
+    wall_seconds: float
+
+
+@dataclass
+class KSSolution:
+    afunc: AFuncParams
+    policy: KSPolicy
+    calibration: KSCalibration
+    history: PanelHistory
+    records: List[KSIterationRecord] = field(default_factory=list)
+    converged: bool = False
+
+    @property
+    def equilibrium_r_pct(self) -> float:
+        """(R-1)*100 at the final simulated period — the notebook's
+        equilibrium-return readout (``Aiyagari-HARK.py:257``)."""
+        A = float(self.history.A_prev[-1])
+        cal = self.calibration
+        z = int(self.history.mrkv[-1])
+        agg_l = float((1.0 - cal.urate_by_agg[z]) * cal.lbr_ind)
+        from . import firm
+        R = firm.interest_factor(A / agg_l, cal.cap_share, cal.depr_fac,
+                                 cal.prod_by_agg[z])
+        return (float(R) - 1.0) * 100.0
+
+
+def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
+                     seed: int = 0, ks_employment: bool = False,
+                     dtype=None, egm_tol: float = 1e-6,
+                     resample_each_iteration: bool = False,
+                     callback=None) -> KSSolution:
+    """Full reference-parity solve: the Krusell-Smith fixed point over the
+    aggregate saving rule.
+
+    ``resample_each_iteration=False`` holds the shock panel fixed across
+    outer iterations (deterministic fixed point — the reference instead
+    leaks fresh global-RNG draws every iteration, quirk §3.6-3, which makes
+    its outer loop stochastic).  Set True to mimic that behavior with
+    properly split keys.
+    """
+    cal = build_ks_calibration(agent, econ, ks_employment=ks_employment,
+                               dtype=dtype)
+    key = jax.random.PRNGKey(seed)
+    k_hist, k_birth, k_panel = jax.random.split(key, 3)
+    mrkv_hist = simulate_markov_history(cal.agg_transition, econ.mrkv_now_init,
+                                        econ.act_T, k_hist)
+    init = initial_panel(cal, agent.agent_count, econ.mrkv_now_init, k_birth)
+
+    solve_hh = jax.jit(lambda af: solve_ks_household(af, cal, tol=egm_tol))
+    run_panel = jax.jit(lambda pol, k: simulate_panel(pol, cal, mrkv_hist,
+                                                      init, k))
+    update = jax.jit(lambda hist, af: calc_afunc_update(
+        hist, mrkv_hist, af, econ.t_discard, econ.damping_fac))
+
+    afunc = AFuncParams(
+        intercept=jnp.asarray(econ.intercept_prev, dtype=cal.a_grid.dtype),
+        slope=jnp.asarray(econ.slope_prev, dtype=cal.a_grid.dtype))
+
+    records: List[KSIterationRecord] = []
+    history = None
+    policy = None
+    converged = False
+    for it in range(econ.max_loops):
+        t0 = time.time()
+        policy, egm_iters, _ = solve_hh(afunc)
+        k_it = jax.random.fold_in(k_panel, it) if resample_each_iteration \
+            else k_panel
+        history, _ = run_panel(policy, k_it)
+        new_afunc, rsq = update(history, afunc)
+        if not (bool(jnp.all(jnp.isfinite(new_afunc.intercept)))
+                and bool(jnp.all(jnp.isfinite(new_afunc.slope)))):
+            raise RuntimeError(
+                f"KS outer iteration {it}: saving-rule regression produced "
+                f"non-finite parameters (intercept={new_afunc.intercept}, "
+                f"slope={new_afunc.slope}). Usually an aggregate state never "
+                f"appears in the post-discard window — increase act_T or "
+                f"decrease t_discard.")
+        distance = float(jnp.max(jnp.maximum(
+            jnp.abs(new_afunc.intercept - afunc.intercept),
+            jnp.abs(new_afunc.slope - afunc.slope))))
+        afunc = new_afunc
+        rec = KSIterationRecord(
+            iteration=it,
+            intercept=[float(x) for x in afunc.intercept],
+            slope=[float(x) for x in afunc.slope],
+            r_squared=[float(x) for x in rsq],
+            distance=distance, egm_iters=int(egm_iters),
+            wall_seconds=time.time() - t0)
+        records.append(rec)
+        if econ.verbose:
+            print(f"[ks] iter {it}: intercept={rec.intercept} "
+                  f"slope={rec.slope} r2={rec.r_squared} dist={distance:.5f}")
+        if callback is not None:
+            callback(rec)
+        if distance < econ.tolerance:
+            converged = True
+            break
+
+    return KSSolution(afunc=afunc, policy=policy, calibration=cal,
+                      history=history, records=records, converged=converged)

@@ -1,0 +1,172 @@
+"""Simulators: the Monte-Carlo agent panel as one ``lax.scan`` program, and
+the aggregate-Markov history generator.
+
+The reference simulates 11,000 periods by calling four Python hooks per
+period per agent, with a per-agent ``np.random.choice`` in the inner loop —
+3.85M Python RNG calls per history (SURVEY.md §3.3, hot loop #2), drawn from
+the *global* NumPy RNG (reproducibility bug §3.6-3).  Here one period is a
+scan step: a single ``jax.random.categorical`` over the whole panel, explicit
+key threading (seed-reproducible by construction), and the factor-pricing
+"mill" fused into the same step.
+
+Timing matches HARK's ``Market.make_history`` (sow -> cultivate -> reap ->
+mill -> store, SURVEY.md §3.1): agents act at period t on the prices milled
+at t-1; the mill at t consumes ``MrkvNow_hist[t]`` and the just-saved assets.
+Employment transitions use *exact-count* draws (the reference's permutation
+machinery, ``make_emp_idx_arrays``/``get_shocks``): the number of agents
+switching employment status is deterministic given the aggregate transition;
+*which* agents switch is random.  The previous aggregate state is carried
+explicitly instead of re-derived from the realized unemployment rate (fixes
+quirk §3.6-4).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.interp import eval_policy_agents
+from . import firm
+from .ks_model import KSCalibration, KSPolicy
+
+
+def simulate_markov_history(transition: jnp.ndarray, init_state: int, length: int,
+                            key: jax.Array) -> jnp.ndarray:
+    """The aggregate Bad/Good chain (``make_Mrkv_history``,
+    ``Aiyagari_Support.py:1793-1805``) as a scan of categorical draws."""
+    logp = jnp.log(transition)
+
+    def step(state, k):
+        new = jax.random.categorical(k, logp[state])
+        return new, state
+
+    keys = jax.random.split(key, length)
+    _, hist = jax.lax.scan(step, jnp.asarray(init_state), keys)
+    return hist
+
+
+class PanelState(NamedTuple):
+    assets: jnp.ndarray       # [Nag] end-of-period assets
+    labor_state: jnp.ndarray  # [Nag] int labor-supply state
+    employed: jnp.ndarray     # [Nag] bool
+    M_now: jnp.ndarray        # aggregate resources agents will see next period
+    R_now: jnp.ndarray
+    W_now: jnp.ndarray
+    mrkv: jnp.ndarray         # aggregate state agents will see next period
+
+
+class PanelHistory(NamedTuple):
+    """The economy's ``track_vars`` (``Aiyagari_Support.py:1587``)."""
+
+    mrkv: jnp.ndarray    # [T] aggregate state consumed by the mill at t
+    A_prev: jnp.ndarray  # [T] mean end-of-period assets at t
+    M_now: jnp.ndarray   # [T] aggregate resources computed by the mill at t
+    urate: jnp.ndarray   # [T] realized unemployment rate at t
+
+
+def initial_panel(cal: KSCalibration, agent_count: int, mrkv_init: int,
+                  key: jax.Array) -> PanelState:
+    """Birth the panel at the steady state (``sim_birth``,
+    ``Aiyagari_Support.py:1173-1214``): assets at KSS, labor states spread
+    evenly then shuffled, employment at the state's unemployment rate.
+    Works for any agent count (the reference requires divisibility by N and
+    silently corrupts otherwise — here the remainder is spread by rounding).
+    """
+    n = cal.labor_levels.shape[0]
+    k1, k2 = jax.random.split(key)
+    ls = jnp.arange(agent_count) % n
+    ls = jax.random.permutation(k1, ls)
+    urate = cal.urate_by_agg[mrkv_init]
+    unemp_n = jnp.round(urate * agent_count).astype(jnp.int32)
+    emp = jax.random.permutation(k2, jnp.arange(agent_count) >= unemp_n)
+    ss = cal.steady_state
+    return PanelState(
+        assets=jnp.full((agent_count,), ss.K, dtype=cal.a_grid.dtype),
+        labor_state=ls, employed=emp,
+        M_now=ss.M.astype(cal.a_grid.dtype), R_now=ss.R.astype(cal.a_grid.dtype),
+        W_now=ss.W.astype(cal.a_grid.dtype),
+        mrkv=jnp.asarray(mrkv_init))
+
+
+def _transition_employment_exact(key, employed, mrkv_prev, mrkv_now,
+                                 cal: KSCalibration):
+    """Exact-count employment transitions, conditional on the aggregate move.
+
+    Conditional switch probabilities come from the 4x4 joint matrix:
+    P(emp' | emp, z -> z') = M[2z+emp, 2z'+emp'] / P_agg[z, z'].  The number
+    of switchers is the rounded expected count (the reference's permutation
+    apparatus achieves the same invariant); the identity of switchers is a
+    uniform random choice implemented by ranking uniform keys.
+    """
+    p_agg = cal.agg_transition[mrkv_prev, mrkv_now]
+    # rows 2*z+emp, columns 2*z'+emp' of the 4x4 (BU,BE,GU,GE) matrix
+    p_u_to_e = cal.empl_transition[2 * mrkv_prev + 0, 2 * mrkv_now + 1] / p_agg
+    p_e_to_u = cal.empl_transition[2 * mrkv_prev + 1, 2 * mrkv_now + 0] / p_agg
+
+    n_emp = jnp.sum(employed)
+    n_unemp = employed.shape[0] - n_emp
+    n_fire = jnp.round(n_emp * p_e_to_u).astype(jnp.int32)
+    n_hire = jnp.round(n_unemp * p_u_to_e).astype(jnp.int32)
+
+    # Rank agents within each group by a uniform draw; the top-k switch.
+    u = jax.random.uniform(key, employed.shape)
+    emp_rank = jnp.argsort(jnp.argsort(jnp.where(employed, u, 2.0)))
+    unemp_rank = jnp.argsort(jnp.argsort(jnp.where(~employed, u, 2.0)))
+    fired = employed & (emp_rank < n_fire)
+    hired = (~employed) & (unemp_rank < n_hire)
+    return (employed & ~fired) | hired
+
+
+def simulate_panel(policy: KSPolicy, cal: KSCalibration, mrkv_hist: jnp.ndarray,
+                   init: PanelState, key: jax.Array):
+    """Run the full panel history as one scan (act_T periods).
+
+    Scan step = the reference's period (SURVEY.md §3.3): labor/employment
+    shocks -> market resources -> consumption via the state-indexed policy ->
+    savings -> mill (factor prices from mean assets and ``mrkv_hist[t]``).
+    """
+    logp_tauchen = jnp.log(cal.tauchen_transition)
+    lbr = cal.lbr_ind
+
+    def step(state: PanelState, inputs):
+        z_t, k = inputs
+        k_labor, k_emp = jax.random.split(k)
+        # --- shocks (get_shocks, :1217-1256)
+        ls_new = jax.random.categorical(k_labor, logp_tauchen[state.labor_state])
+        emp_new = _transition_employment_exact(
+            k_emp, state.employed, state.mrkv, z_t, cal)
+        # In reference-parity (Aiyagari) mode labor income ignores employment
+        # (everyone supplies their labor level, Aiyagari_Support.py:991-1018
+        # comment trail); in true-KS mode the unemployed earn zero.
+        eff_labor = cal.labor_levels[ls_new]
+        if cal.ks_employment:
+            eff_labor = eff_labor * emp_new
+        # --- states (get_states, :1259-1283)
+        m = state.R_now * state.assets + state.W_now * eff_labor
+        # --- controls (get_controls, :1286-1409): state index 4*ls + 2*z + emp
+        s_idx = 4 * ls_new + 2 * state.mrkv + emp_new.astype(jnp.int32)
+        c = eval_policy_agents(m, s_idx, state.M_now, cal.m_grid,
+                               policy.m_knots, policy.c_knots)
+        # --- poststates (get_poststates, :1411-1415)
+        a_new = m - c
+        # --- mill (calc_R_and_W, :1839-1894) consuming mrkv_hist[t]
+        A_prev = jnp.mean(a_new)
+        urate_real = 1.0 - jnp.mean(emp_new.astype(a_new.dtype))
+        prod = cal.prod_by_agg[z_t]
+        agg_L = (1.0 - cal.urate_by_agg[z_t]) * lbr
+        k_to_l = A_prev / agg_L
+        R_new = firm.interest_factor(k_to_l, cal.cap_share, cal.depr_fac, prod)
+        W_new = firm.wage_rate(k_to_l, cal.cap_share, prod)
+        M_new = R_new * A_prev + W_new * agg_L
+        out = (z_t, A_prev, M_new, urate_real)
+        new_state = PanelState(assets=a_new, labor_state=ls_new,
+                               employed=emp_new, M_now=M_new, R_now=R_new,
+                               W_now=W_new, mrkv=z_t)
+        return new_state, out
+
+    keys = jax.random.split(key, mrkv_hist.shape[0])
+    final, (mrkv, A_prev, M_now, urate) = jax.lax.scan(
+        step, init, (mrkv_hist, keys))
+    return PanelHistory(mrkv=mrkv, A_prev=A_prev, M_now=M_now, urate=urate), final

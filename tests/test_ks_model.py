@@ -1,0 +1,148 @@
+"""Tests for the Krusell-Smith-machinery parity path: precompute, 4N-state
+EGM, panel simulation, and the outer fixed point on a short horizon."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.models.ks_model import (
+    AFuncParams,
+    build_ks_calibration,
+    initial_ks_policy,
+    precompute,
+    solve_ks_household,
+)
+from aiyagari_hark_tpu.models.ks_solver import solve_ks_economy
+from aiyagari_hark_tpu.models.simulate import (
+    initial_panel,
+    simulate_markov_history,
+    simulate_panel,
+)
+from aiyagari_hark_tpu.ops.interp import interp_on_interp
+from aiyagari_hark_tpu.utils.config import AgentConfig, EconomyConfig, notebook_run_configs
+
+
+@pytest.fixture(scope="module")
+def cal():
+    agent, econ = notebook_run_configs()
+    return build_ks_calibration(agent, econ)
+
+
+@pytest.fixture(scope="module")
+def afunc(cal):
+    return AFuncParams(intercept=jnp.zeros(2, dtype=cal.a_grid.dtype),
+                       slope=jnp.ones(2, dtype=cal.a_grid.dtype))
+
+
+def test_calibration_shapes(cal):
+    assert cal.ind_transition.shape == (28, 28)
+    assert cal.m_grid.shape == (15,)
+    np.testing.assert_allclose(np.asarray(cal.ind_transition).sum(1),
+                               np.ones(28), atol=1e-10)
+    # state indexing: s = 4*labor + 2*agg + emp
+    assert int(cal.labor_of_state[27]) == 6
+    assert int(cal.agg_of_state[27]) == 1
+    assert int(cal.emp_of_state[27]) == 1
+
+
+def test_precompute_degenerate_aggregates(cal, afunc):
+    """With ProdB=ProdG and UrateB=UrateG=0 (Aiyagari config), next-period
+    prices depend only on M, not the aggregate state: columns for the same
+    labor state must be identical across the 4 KS substates."""
+    pre = precompute(afunc, cal)
+    R = np.asarray(pre.R_next)   # [Mc, 28]
+    for i in (0, 3, 6):
+        block = R[:, 4 * i:4 * i + 4]
+        np.testing.assert_allclose(block, block[:, :1].repeat(4, axis=1),
+                                   rtol=1e-12)
+    # m_next at the same (a, M) differs across labor states
+    m = np.asarray(pre.m_next)
+    assert not np.allclose(m[:, :, 1], m[:, :, 25])
+
+
+def test_ks_egm_converges_and_is_sane(cal, afunc):
+    policy, iters, diff = jax.jit(
+        lambda a: solve_ks_household(a, cal))(afunc)
+    assert float(diff) < 1e-6
+    # consumption increasing in m at every (state, M-column)
+    c = np.asarray(policy.c_knots)
+    m = np.asarray(policy.m_knots)
+    assert (np.diff(c, axis=-1) > 0).all()
+    assert (np.diff(m, axis=-1) > 0).all()
+    # degenerate KS states: policies identical across the 4 substates of a
+    # labor state (aggregate shock off)
+    np.testing.assert_allclose(c[4 * 3 + 0], c[4 * 3 + 3], rtol=1e-6)
+
+
+def test_ks_policy_matches_simple_model_economics(cal, afunc):
+    """At M = MSS the 4N-state policy evaluated at the steady-state prices
+    should be close to the compact-model policy at the same prices (same
+    economics, different machinery)."""
+    from aiyagari_hark_tpu.models.household import (
+        build_simple_model, solve_household, consumption_at)
+    policy, _, _ = solve_ks_household(afunc, cal)
+    # With AFunc = identity (slope 1, intercept 0), perceived K' = M which is
+    # NOT steady state; so compare both at the converged-AFunc sense loosely:
+    # only check ordering: richer labor state consumes more at same m.
+    mss = cal.steady_state.M
+    m_test = jnp.linspace(2.0, 20.0, 7)
+    c_low = interp_on_interp(m_test, mss, cal.m_grid,
+                             policy.m_knots[1], policy.c_knots[1])
+    c_high = interp_on_interp(m_test, mss, cal.m_grid,
+                              policy.m_knots[25], policy.c_knots[25])
+    assert (np.asarray(c_high) > np.asarray(c_low)).all()
+
+
+def test_markov_history_properties(cal):
+    hist = simulate_markov_history(cal.agg_transition, 0, 4000,
+                                   jax.random.PRNGKey(0))
+    h = np.asarray(hist)
+    assert h[0] == 0
+    assert set(np.unique(h)) <= {0, 1}
+    # with symmetric 1/8 switching, both states occupied roughly half
+    assert 0.3 < h.mean() < 0.7
+    # mean spell duration near 8
+    switches = (np.diff(h) != 0).sum()
+    assert 4 < len(h) / max(switches, 1) < 16
+
+
+def test_panel_simulation_runs_and_is_stationary(cal, afunc):
+    policy, _, _ = solve_ks_household(afunc, cal)
+    hist = simulate_markov_history(cal.agg_transition, 0, 500,
+                                   jax.random.PRNGKey(1))
+    init = initial_panel(cal, 350, 0, jax.random.PRNGKey(2))
+    out, final = jax.jit(lambda p, k: simulate_panel(p, cal, hist, init, k))(
+        policy, jax.random.PRNGKey(3))
+    A = np.asarray(out.A_prev)
+    assert A.shape == (500,)
+    assert np.isfinite(A).all() and (A > 0).all()
+    # degenerate employment: urate identically zero
+    np.testing.assert_allclose(np.asarray(out.urate), 0.0, atol=1e-12)
+    # assets stay in a sane band (reference mean wealth 5.44)
+    assert 1.0 < A[-100:].mean() < 12.0
+
+
+def test_seed_reproducibility(cal, afunc):
+    """Fixes reference quirk §3.6-3: identical seeds -> identical histories."""
+    policy, _, _ = solve_ks_household(afunc, cal)
+    hist = simulate_markov_history(cal.agg_transition, 0, 200,
+                                   jax.random.PRNGKey(1))
+    init = initial_panel(cal, 70, 0, jax.random.PRNGKey(2))
+    f = jax.jit(lambda k: simulate_panel(policy, cal, hist, init, k)[0].A_prev)
+    a1, a2 = f(jax.random.PRNGKey(9)), f(jax.random.PRNGKey(9))
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    a3 = f(jax.random.PRNGKey(10))
+    assert not np.array_equal(np.asarray(a1), np.asarray(a3))
+
+
+def test_outer_loop_converges_short_horizon():
+    agent, econ = notebook_run_configs()
+    agent = agent.replace(agent_count=140)
+    econ = econ.replace(act_T=1500, t_discard=300, verbose=False, max_loops=12)
+    sol = solve_ks_economy(agent, econ, seed=0)
+    assert sol.converged
+    # equilibrium return in the reference's neighborhood (4.178 +- MC noise)
+    assert 3.0 < sol.equilibrium_r_pct < 5.5
+    assert len(sol.records) <= 12
+    assert sol.records[-1].distance < econ.tolerance
