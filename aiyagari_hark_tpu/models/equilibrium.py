@@ -58,6 +58,25 @@ def household_capital_supply(r, model: SimpleModel, disc_fac, crra,
     return aggregate_capital(dist, model), policy, dist, W, k_to_l
 
 
+def _bisection_setup(model: SimpleModel, disc_fac, depr_fac,
+                     r_tol, egm_tol, dist_tol):
+    """Shared bisection machinery: dtype-aware tolerance defaults (the f64
+    values are unreachable in f32 and would force every inner loop to its
+    iteration cap) and the economic bracket [-delta+eps, (1-beta)/beta-eps]
+    (supply diverges at the top, demand at the bottom)."""
+    dtype = model.a_grid.dtype
+    f64 = dtype == jnp.float64
+    if r_tol is None:
+        r_tol = 1e-10 if f64 else 1e-6
+    if egm_tol is None:
+        egm_tol = 1e-6 if f64 else 1e-5
+    if dist_tol is None:
+        dist_tol = 1e-11 if f64 else 1e-8
+    r_hi = jnp.asarray(1.0 / disc_fac - 1.0 - 1e-4, dtype=dtype)
+    r_lo = jnp.asarray(-depr_fac + 1e-3, dtype=dtype)
+    return r_tol, egm_tol, dist_tol, r_lo, r_hi
+
+
 def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
                                 cap_share, depr_fac, prod=1.0,
                                 r_tol: float | None = None,
@@ -68,18 +87,12 @@ def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
 
     Fully jit-able/vmappable: a fixed-trip ``while_loop`` whose body solves
     the household problem at the midpoint rate.  ``crra`` (and the traced
-    calibration inside ``model``) may be batch axes.  Tolerance defaults are
-    dtype-aware — the f64 values are unreachable in f32 and would force every
-    inner loop to its iteration cap.
+    calibration inside ``model``) may be batch axes.  Returns the full
+    equilibrium objects (policy, distribution) — the sweep/bench path uses
+    ``solve_equilibrium_lean`` instead, which skips the final re-solve.
     """
-    dtype = model.a_grid.dtype
-    f64 = dtype == jnp.float64
-    if r_tol is None:
-        r_tol = 1e-10 if f64 else 1e-6
-    if egm_tol is None:
-        egm_tol = 1e-6 if f64 else 1e-5
-    if dist_tol is None:
-        dist_tol = 1e-11 if f64 else 1e-8
+    r_tol, egm_tol, dist_tol, r_lo, r_hi = _bisection_setup(
+        model, disc_fac, depr_fac, r_tol, egm_tol, dist_tol)
     labor = aggregate_labor(model)
 
     def excess_supply(r):
@@ -88,9 +101,6 @@ def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
             egm_tol=egm_tol, dist_tol=dist_tol)
         demand = firm.k_to_l_from_r(r, cap_share, depr_fac, prod) * labor
         return supply - demand
-
-    r_hi = jnp.asarray(1.0 / disc_fac - 1.0 - 1e-4, dtype=dtype)
-    r_lo = jnp.asarray(-depr_fac + 1e-3, dtype=dtype)
 
     def cond(state):
         lo, hi, it = state
@@ -121,21 +131,78 @@ def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
         distribution=dist, bisect_iters=iters)
 
 
-def solve_calibration(crra: float, labor_ar: float, labor_sd: float = 0.2,
-                      labor_states: int = 7, disc_fac: float = 0.96,
-                      cap_share: float = 0.36, depr_fac: float = 0.08,
-                      a_min: float = 0.001, a_max: float = 50.0,
-                      a_count: int = 32, a_nest_fac: int = 2,
-                      dist_count: int = 500, dtype=None,
-                      **solver_kwargs) -> EquilibriumResult:
-    """One Table II cell: build the model for (crra, rho, sd) and solve.
+class LeanEquilibrium(NamedTuple):
+    """Scalar-only equilibrium outputs for sweeps: everything else a sweep
+    reports (wage, demand, excess, saving rate) is closed-form in these."""
 
-    ``crra``, ``labor_ar``, ``labor_sd`` may be traced (vmap over cells);
-    every other argument is static structure.
+    r_star: jnp.ndarray
+    capital: jnp.ndarray     # household supply at the last bisection midpoint
+    labor: jnp.ndarray
+    bisect_iters: jnp.ndarray
+
+
+def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
+                           cap_share, depr_fac, prod=1.0,
+                           r_tol: float | None = None, max_bisect: int = 60,
+                           egm_tol: float | None = None,
+                           dist_tol: float | None = None) -> LeanEquilibrium:
+    """Bisection equilibrium that carries the supply evaluation through the
+    loop state instead of re-solving the household at ``r_star`` afterwards.
+
+    Halves the compiled program relative to ``solve_bisection_equilibrium``
+    (no duplicated solve subgraph after the ``while_loop``) — the sweep/bench
+    path, where only scalars are consumed.  ``capital`` is the supply at the
+    final midpoint, within one bracket width (< ``r_tol``) of supply at
+    ``r_star``.
     """
+    r_tol, egm_tol, dist_tol, r_lo, r_hi = _bisection_setup(
+        model, disc_fac, depr_fac, r_tol, egm_tol, dist_tol)
+    labor = aggregate_labor(model)
+    zero = jnp.zeros((), dtype=model.a_grid.dtype)
+
+    def cond(state):
+        lo, hi, _, it = state
+        return ((hi - lo) > r_tol) & (it < max_bisect)
+
+    def body(state):
+        lo, hi, _, it = state
+        mid = 0.5 * (lo + hi)
+        supply, *_ = household_capital_supply(
+            mid, model, disc_fac, crra, cap_share, depr_fac, prod,
+            egm_tol=egm_tol, dist_tol=dist_tol)
+        demand = firm.k_to_l_from_r(mid, cap_share, depr_fac, prod) * labor
+        ex = supply - demand
+        lo = jnp.where(ex > 0, lo, mid)
+        hi = jnp.where(ex > 0, mid, hi)
+        return lo, hi, supply, it + 1
+
+    lo, hi, supply, iters = jax.lax.while_loop(
+        cond, body, (r_lo, r_hi, zero, jnp.asarray(0)))
+    return LeanEquilibrium(r_star=0.5 * (lo + hi), capital=supply,
+                           labor=labor, bisect_iters=iters)
+
+
+def _solve_cell(solver, crra, labor_ar, labor_sd=0.2, labor_states=7,
+                disc_fac=0.96, cap_share=0.36, depr_fac=0.08,
+                a_min=0.001, a_max=50.0, a_count=32, a_nest_fac=2,
+                dist_count=500, dtype=None, **solver_kwargs):
+    """Build the model for one (crra, rho, sd) cell and run ``solver`` on it.
+    ``crra``/``labor_ar``/``labor_sd`` may be traced (vmap over cells); every
+    other argument is static structure."""
     model = build_simple_model(
         labor_states=labor_states, labor_ar=labor_ar, labor_sd=labor_sd,
         a_min=a_min, a_max=a_max, a_count=a_count, a_nest_fac=a_nest_fac,
         dist_count=dist_count, dtype=dtype)
-    return solve_bisection_equilibrium(
-        model, disc_fac, crra, cap_share, depr_fac, **solver_kwargs)
+    return solver(model, disc_fac, crra, cap_share, depr_fac, **solver_kwargs)
+
+
+def solve_calibration(crra: float, labor_ar: float,
+                      **kwargs) -> EquilibriumResult:
+    """One Table II cell with the full equilibrium objects."""
+    return _solve_cell(solve_bisection_equilibrium, crra, labor_ar, **kwargs)
+
+
+def solve_calibration_lean(crra: float, labor_ar: float,
+                           **kwargs) -> LeanEquilibrium:
+    """One Table II cell, scalars only — the sweep/bench fast path."""
+    return _solve_cell(solve_equilibrium_lean, crra, labor_ar, **kwargs)

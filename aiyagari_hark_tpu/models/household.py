@@ -99,7 +99,10 @@ def egm_step(policy: HouseholdPolicy, R, W, model: SimpleModel,
     # c_next(m) per next-state: rowwise interp with per-state knots.
     c_next = interp1d_rowwise(m_next.T, policy.m_knots, policy.c_knots).T
     vp_next = marginal_utility(c_next, crra)          # [A, N']
-    end_of_prd_vp = disc_fac * R * (vp_next @ model.transition.T)  # [A, N]
+    # precision=HIGHEST: the TPU bf16 matmul default loses ~3 decimal digits,
+    # which the EGM fixed point then bakes into the policy (r* moves >1bp).
+    end_of_prd_vp = disc_fac * R * jnp.matmul(
+        vp_next, model.transition.T, precision=jax.lax.Precision.HIGHEST)
     c_now = inverse_marginal_utility(end_of_prd_vp, crra)
     m_now = a[:, None] + c_now
     eps = jnp.full((1, c_now.shape[1]), CONSTRAINT_EPS, dtype=c_now.dtype)
@@ -175,7 +178,10 @@ def _push_forward(dist, trans: WealthTransition, transition_matrix):
 
     moved = jax.vmap(scatter_one_state, in_axes=1, out_axes=1)(
         dist, trans.idx, trans.weight)
-    return moved @ transition_matrix
+    # precision=HIGHEST: thousands of push-forward steps compound the TPU
+    # bf16 matmul default into visible mass-distribution error.
+    return jnp.matmul(moved, transition_matrix,
+                      precision=jax.lax.Precision.HIGHEST)
 
 
 def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,

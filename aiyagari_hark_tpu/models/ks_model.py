@@ -199,7 +199,8 @@ def egm_step_ks(policy: KSPolicy, pre: PrecomputedArrays,
     weighted = pre.R_next[None, :, :] * vp_next   # [A, Mc, S']
     # EndOfPrdvP[a, mc, s] = beta * sum_{s'} P[s, s'] weighted[a, mc, s']
     end_vp = cal.disc_fac * jnp.einsum("ams,ks->amk", weighted,
-                                       cal.ind_transition)
+                                       cal.ind_transition,
+                                       precision=jax.lax.Precision.HIGHEST)
     c_now = inverse_marginal_utility(end_vp, cal.crra)    # [A, Mc, S]
     m_now = cal.a_grid[:, None, None] + c_now
     eps = jnp.full((1,) + c_now.shape[1:], CONSTRAINT_EPS, dtype=c_now.dtype)

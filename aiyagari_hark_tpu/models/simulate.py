@@ -119,13 +119,28 @@ def _transition_employment_exact(key, employed, mrkv_prev, mrkv_now,
     return (employed & ~fired) | hired
 
 
+def _panel_mean(x, axis_name):
+    """Mean over the (possibly device-sharded) agent axis: local mean, then
+    ``pmean`` over the mesh axis — the TPU equivalent of the reference's
+    ``np.mean(aNow)`` aggregation (``Aiyagari_Support.py:1868``)."""
+    m = jnp.mean(x)
+    if axis_name is not None:
+        m = jax.lax.pmean(m, axis_name)
+    return m
+
+
 def simulate_panel(policy: KSPolicy, cal: KSCalibration, mrkv_hist: jnp.ndarray,
-                   init: PanelState, key: jax.Array):
+                   init: PanelState, key: jax.Array, axis_name=None):
     """Run the full panel history as one scan (act_T periods).
 
     Scan step = the reference's period (SURVEY.md §3.3): labor/employment
     shocks -> market resources -> consumption via the state-indexed policy ->
     savings -> mill (factor prices from mean assets and ``mrkv_hist[t]``).
+
+    ``axis_name``: mesh axis the agent panel is sharded over (inside
+    ``shard_map``); aggregation then rides a ``pmean`` collective.  The
+    exact-count employment machinery applies per shard — shard counts sum to
+    the global invariant up to rounding.
     """
     logp_tauchen = jnp.log(cal.tauchen_transition)
     lbr = cal.lbr_ind
@@ -152,8 +167,8 @@ def simulate_panel(policy: KSPolicy, cal: KSCalibration, mrkv_hist: jnp.ndarray,
         # --- poststates (get_poststates, :1411-1415)
         a_new = m - c
         # --- mill (calc_R_and_W, :1839-1894) consuming mrkv_hist[t]
-        A_prev = jnp.mean(a_new)
-        urate_real = 1.0 - jnp.mean(emp_new.astype(a_new.dtype))
+        A_prev = _panel_mean(a_new, axis_name)
+        urate_real = 1.0 - _panel_mean(emp_new.astype(a_new.dtype), axis_name)
         prod = cal.prod_by_agg[z_t]
         agg_L = (1.0 - cal.urate_by_agg[z_t]) * lbr
         k_to_l = A_prev / agg_L

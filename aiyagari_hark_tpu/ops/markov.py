@@ -24,6 +24,7 @@ from __future__ import annotations
 import math
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 from jax.scipy.stats import norm
 
@@ -94,10 +95,13 @@ def stationary_distribution(transition: jnp.ndarray, iters: int = 2000) -> jnp.n
     # than repeated vector products and is still a handful of tiny matmuls.
     mat = transition
     steps = max(1, math.ceil(math.log2(iters)))
+    # precision=HIGHEST: TPU f32 matmuls default to bf16 inputs; repeated
+    # squaring amplifies that rounding into percent-level stationary-mass
+    # errors, so force the full-precision path (these are [n,n], n<=28).
     for _ in range(steps):
-        mat = mat @ mat
+        mat = jnp.matmul(mat, mat, precision=jax.lax.Precision.HIGHEST)
         mat = mat / jnp.sum(mat, axis=1, keepdims=True)
-    pi = pi @ mat
+    pi = jnp.matmul(pi, mat, precision=jax.lax.Precision.HIGHEST)
     return pi / jnp.sum(pi)
 
 

@@ -1,0 +1,13 @@
+"""Parallel layer: device meshes, the sharded Table II calibration sweep,
+and device-sharded agent panels (SURVEY.md §2.4's latent axes made
+first-class)."""
+
+from .mesh import make_mesh, pad_to_multiple, sharding
+from .panel import initial_panel_sharded, simulate_panel_sharded
+from .sweep import SweepResult, run_table2_sweep
+
+__all__ = [
+    "make_mesh", "pad_to_multiple", "sharding",
+    "initial_panel_sharded", "simulate_panel_sharded",
+    "SweepResult", "run_table2_sweep",
+]

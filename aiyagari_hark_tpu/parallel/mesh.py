@@ -1,0 +1,72 @@
+"""Device-mesh helpers.
+
+The reference is single-process NumPy (SURVEY.md §2.4); its latent parallel
+axes are the calibration sweep (embarrassingly parallel — the domain's "data
+parallelism") and the agent panel (sharded with a mean-reduction each period).
+Here those become named axes of a ``jax.sharding.Mesh``:
+
+  * ``"cells"``  — Table II calibration cells (σ×ρ); no cross-cell
+    communication, gather only at the end (DCN-friendly).
+  * ``"agents"`` — the simulated household panel; each period ends in a
+    cross-shard mean (``psum`` over ICI).
+
+Multi-chip hardware is exercised through ``--xla_force_host_platform_device_count``
+virtual CPU devices in tests and through the driver's ``dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def make_mesh(axis_names: Sequence[str] = ("cells",),
+              axis_sizes: Optional[Sequence[int]] = None,
+              devices=None) -> Mesh:
+    """Build a mesh over the available devices.
+
+    With ``axis_sizes=None`` all devices land on the first axis and the rest
+    get size 1.  ``axis_sizes`` may leave one entry ``-1`` to absorb the
+    remaining devices (numpy-reshape style).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if axis_sizes is None:
+        axis_sizes = [n] + [1] * (len(axis_names) - 1)
+    axis_sizes = list(axis_sizes)
+    if -1 in axis_sizes:
+        known = int(np.prod([s for s in axis_sizes if s != -1]))
+        if n % known:
+            raise ValueError(
+                f"cannot infer -1 axis: {n} devices not divisible by the "
+                f"known axis sizes (product {known})")
+        axis_sizes[axis_sizes.index(-1)] = n // known
+    total = int(np.prod(axis_sizes))
+    if total > n:
+        raise ValueError(f"mesh {tuple(axis_sizes)} needs {total} devices, "
+                         f"have {n}")
+    grid = np.asarray(devices[:total]).reshape(axis_sizes)
+    return Mesh(grid, tuple(axis_names))
+
+
+def sharding(mesh: Mesh, *spec) -> NamedSharding:
+    """``NamedSharding(mesh, PartitionSpec(*spec))`` shorthand."""
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def pad_to_multiple(x, multiple: int, axis: int = 0):
+    """Pad ``x`` along ``axis`` (edge-replicating) to a multiple of
+    ``multiple``; returns (padded, original_length).  Sharded axes must divide
+    the device count — sweep cells and agent panels are padded, solved, and
+    sliced back."""
+    n = x.shape[axis]
+    rem = (-n) % multiple
+    if rem == 0:
+        return x, n
+    pad_width = [(0, 0)] * x.ndim
+    pad_width[axis] = (0, rem)
+    return np.pad(np.asarray(x), pad_width, mode="edge"), n

@@ -1,0 +1,73 @@
+"""Device-sharded agent panels: the Monte-Carlo simulation with the household
+panel split across chips and the per-period aggregation riding a ``pmean``
+collective over ICI.
+
+The reference aggregates with ``np.mean`` over a single in-process array
+(``Aiyagari_Support.py:1868``; SURVEY.md §5 "Distributed communication
+backend").  Here the panel is sharded over the ``agents`` mesh axis with
+``shard_map``; each scan step computes a local mean and a ``pmean``, so the
+factor prices every shard sees are identical and the history is replicated.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.ks_model import KSCalibration, KSPolicy
+from ..models.simulate import PanelState, initial_panel, simulate_panel
+
+
+def initial_panel_sharded(cal: KSCalibration, agent_count: int,
+                          mrkv_init: int, key: jax.Array, mesh: Mesh,
+                          axis: str = "agents") -> PanelState:
+    """Birth a panel of ``agent_count`` agents sharded over ``axis``.
+
+    ``agent_count`` must divide evenly (pad upstream with
+    ``mesh.pad_to_multiple`` if not).  The global birth invariants (labor
+    states spread evenly, employment at the state's unemployment rate) hold
+    per shard, hence globally.
+    """
+    n_shards = mesh.shape[axis]
+    if agent_count % n_shards:
+        raise ValueError(f"agent_count {agent_count} must divide the "
+                         f"'{axis}' axis size {n_shards}")
+    local = agent_count // n_shards
+    keys = jax.random.split(key, n_shards)
+
+    def birth(k):
+        return initial_panel(cal, local, mrkv_init, k[0])
+
+    spec_state = PanelState(assets=P(axis), labor_state=P(axis),
+                            employed=P(axis), M_now=P(), R_now=P(),
+                            W_now=P(), mrkv=P())
+    return jax.shard_map(birth, mesh=mesh, in_specs=P(axis),
+                         out_specs=spec_state, check_vma=False)(keys)
+
+
+def simulate_panel_sharded(policy: KSPolicy, cal: KSCalibration,
+                           mrkv_hist: jnp.ndarray, init: PanelState,
+                           key: jax.Array, mesh: Mesh, axis: str = "agents"):
+    """``models.simulate.simulate_panel`` with the agent axis sharded.
+
+    Returns the same (PanelHistory, final PanelState) contract; the history
+    is replicated across shards (every shard computed identical aggregates
+    through the ``pmean``), the final panel state stays sharded.
+    """
+    n_shards = mesh.shape[axis]
+    keys = jax.random.split(key, n_shards)
+
+    def run(mh, local_init, ks):
+        return simulate_panel(policy, cal, mh, local_init, ks[0],
+                              axis_name=axis)
+
+    spec_state = PanelState(assets=P(axis), labor_state=P(axis),
+                            employed=P(axis), M_now=P(), R_now=P(),
+                            W_now=P(), mrkv=P())
+    fn = jax.shard_map(
+        run, mesh=mesh,
+        in_specs=(P(), spec_state, P(axis)),
+        out_specs=(P(), spec_state),
+        check_vma=False)
+    return fn(mrkv_hist, init, keys)

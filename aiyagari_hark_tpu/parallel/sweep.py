@@ -1,0 +1,128 @@
+"""The Table II calibration sweep as one batched, device-sharded XLA program.
+
+The reference runs Aiyagari's Table II (σ ∈ {1,3,5} × ρ ∈ {0,0.3,0.6,0.9})
+**manually, one notebook cell at a time**, editing the parameter dicts between
+runs (SURVEY.md §2.4) — each cell costing a ~27-minute ``economy.solve()``.
+Here a sweep is data: arrays of (σ, ρ) pairs, vmapped through the jitted
+bisection equilibrium (``models.equilibrium``) and sharded over the ``cells``
+mesh axis.  No communication between cells — XLA places one subset of cells
+per device and the only cross-device traffic is the final result gather.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ..models.equilibrium import solve_calibration_lean
+from ..utils.config import SweepConfig
+from .mesh import pad_to_multiple, sharding
+
+
+@dataclass
+class SweepResult:
+    """Per-cell equilibrium objects, cell-major ([C] leading axis)."""
+
+    crra: np.ndarray          # [C]
+    labor_ar: np.ndarray      # [C]
+    r_star_pct: np.ndarray    # [C] net return, percent (Table II units)
+    saving_rate_pct: np.ndarray  # [C] δK/Y, percent
+    capital: np.ndarray       # [C]
+    excess: np.ndarray        # [C] residual market-clearing error
+    bisect_iters: np.ndarray  # [C]
+    wall_seconds: float = float("nan")
+
+    def table(self) -> str:
+        """Aiyagari Table II layout: rows ρ, columns σ, entries r* (%)."""
+        sigmas = np.unique(self.crra)
+        rhos = np.unique(self.labor_ar)
+        lines = ["rho\\sigma " + "  ".join(f"{s:7.1f}" for s in sigmas)]
+        for rho in rhos:
+            row = []
+            for s in sigmas:
+                m = (self.crra == s) & (self.labor_ar == rho)
+                row.append(f"{float(self.r_star_pct[m][0]):7.4f}"
+                           if m.any() else "      –")
+            lines.append(f"{rho:9.2f} " + "  ".join(row))
+        return "\n".join(lines)
+
+
+@lru_cache(maxsize=None)
+def _batched_solver(labor_sd: float, dtype, kwargs_items=()):
+    """Jitted vmapped cell solver, memoized so repeated sweeps (benchmarks,
+    resumed runs) hit the jit cache instead of rebuilding the closure.
+
+    Uses the lean bisection (supply carried through the loop state, no
+    post-loop re-solve) so the compiled program stays small; wage, demand,
+    excess, and the saving rate are closed forms in (r*, K, L) computed
+    host-side in ``run_table2_sweep``.
+    """
+    model_kwargs = dict(kwargs_items)
+
+    def solve_one(crra, rho):
+        res = solve_calibration_lean(crra, rho, labor_sd=labor_sd,
+                                     dtype=dtype, **model_kwargs)
+        return res.r_star, res.capital, res.labor, res.bisect_iters
+
+    return jax.jit(jax.vmap(solve_one))
+
+
+def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
+                     mesh: Optional[Mesh] = None, axis: str = "cells",
+                     dtype=None, timer=None,
+                     **model_kwargs) -> SweepResult:
+    """Solve every (σ, ρ) cell as one batched program.
+
+    With ``mesh`` given, cells are sharded over ``axis`` (padded by edge
+    replication to divide the axis size); the batch is one ``jit`` whose
+    per-cell ``while_loop``s run until the *slowest* cell converges — the
+    usual vmap-of-while semantics, harmless here because cells cost within
+    ~2x of each other.  Without a mesh it is the same program on one device.
+    """
+    cells = np.asarray(sweep.cells(), dtype=np.float64)   # [C, 2] (σ, ρ)
+    crra, rho = cells[:, 0], cells[:, 1]
+    n_orig = crra.shape[0]
+    if mesh is not None:
+        shard = sharding(mesh, axis)
+        n_shards = mesh.shape[axis]
+        crra, _ = pad_to_multiple(crra, n_shards)
+        rho, _ = pad_to_multiple(rho, n_shards)
+        crra = jax.device_put(jnp.asarray(crra, dtype=dtype), shard)
+        rho = jax.device_put(jnp.asarray(rho, dtype=dtype), shard)
+    else:
+        crra = jnp.asarray(crra, dtype=dtype)
+        rho = jnp.asarray(rho, dtype=dtype)
+
+    fn = _batched_solver(sweep.labor_sd, dtype,
+                         tuple(sorted(model_kwargs.items())))
+    import time
+    t0 = time.perf_counter()
+    r, K, L, iters = jax.block_until_ready(fn(crra, rho))
+    wall = time.perf_counter() - t0
+    if timer is not None:
+        timer(wall)
+
+    sl = slice(0, n_orig)
+    r = np.asarray(r, dtype=np.float64)[sl]
+    K = np.asarray(K, dtype=np.float64)[sl]
+    L = np.asarray(L, dtype=np.float64)[sl]
+    # Host-side closed forms (firm.py identities in numpy — numpy, not jnp,
+    # so nothing touches the device after the solve): demand from the
+    # inverted marginal product of capital, Y from Cobb-Douglas, s = delta*K/Y.
+    alpha = model_kwargs.get("cap_share", 0.36)
+    delta = model_kwargs.get("depr_fac", 0.08)
+    prod = model_kwargs.get("prod", 1.0)
+    demand = ((r + delta) / (prod * alpha)) ** (1.0 / (alpha - 1.0)) * L
+    output = prod * K ** alpha * L ** (1.0 - alpha)
+    srate = delta * K / output
+    return SweepResult(
+        crra=np.asarray(crra)[sl], labor_ar=np.asarray(rho)[sl],
+        r_star_pct=r * 100.0, saving_rate_pct=srate * 100.0,
+        capital=K, excess=K - demand,
+        bisect_iters=np.asarray(iters)[sl], wall_seconds=wall)
