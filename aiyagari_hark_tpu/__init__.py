@@ -11,4 +11,11 @@ Layers (mirroring SURVEY.md §1, rebuilt TPU-first):
 
 __version__ = "0.1.0"
 
+from .facade import (  # noqa: F401
+    AggregateSavingRule,
+    AiyagariEconomy,
+    AiyagariType,
+    init_aiyagari_agents,
+    init_aiyagari_economy,
+)
 from .utils.config import AgentConfig, EconomyConfig, SweepConfig  # noqa: F401

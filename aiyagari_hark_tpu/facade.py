@@ -1,0 +1,282 @@
+"""Notebook-compatible facade: ``AiyagariType`` / ``AiyagariEconomy`` classes
+exposing the reference's driver interface (SURVEY.md §1, L5→L4) on top of the
+TPU-native engine.
+
+The reference notebook drives the model as (``Aiyagari-HARK.py:234-258``):
+
+    economy = AiyagariEconomy(**econ_dict); economy.verbose = False
+    agent = AiyagariType(**agent_dict); agent.cycles = 0
+    agent.get_economy_data(economy)
+    economy.agents = [agent]
+    economy.make_Mrkv_history()
+    economy.solve()
+    economy.sow_state['Rnow'|'Mnow']; economy.reap_state['aNow']
+    economy.AFunc[j](M); agent.solution[0].cFunc[s](m, M)
+    agent.solution[0].cFunc[s].xInterpolators   # per-M 1D plots
+
+This module reproduces that surface exactly — same attribute names, same
+parameter-dict spelling (``init_Aiyagari_agents``/``init_Aiyagari_economy``,
+``Aiyagari_Support.py:752-757, 1525-1551``), same steady-state attributes
+(``KtoLSS/KSS/WSS/RSS/MSS``, ``Aiyagari_Support.py:1606-1615``) — while
+``solve`` runs the jitted Krusell-Smith fixed point of ``models.ks_solver``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .models import firm
+from .models.ks_solver import KSSolution, solve_ks_economy
+from .models.simulate import simulate_markov_history
+from .ops.interp import interp1d, interp_on_interp
+from .ops.markov import aggregate_markov_matrix
+from .utils.config import (
+    MGRID_BASE_DEFAULT,
+    AgentConfig,
+    EconomyConfig,
+)
+
+
+def init_aiyagari_agents() -> dict:
+    """The reference's agent parameter dict, reference spelling
+    (``init_Aiyagari_agents``, ``Aiyagari_Support.py:752-757``)."""
+    a = AgentConfig()
+    return {
+        "LaborStatesNo": a.labor_states, "aMin": a.a_min, "aMax": a.a_max,
+        "aCount": a.a_count, "aNestFac": a.a_nest_fac,
+        "AgentCount": a.agent_count, "MgridBase": np.array(MGRID_BASE_DEFAULT),
+    }
+
+
+def init_aiyagari_economy() -> dict:
+    """The reference's economy parameter dict, reference spelling
+    (``init_Aiyagari_economy``, ``Aiyagari_Support.py:1525-1551``)."""
+    e = EconomyConfig()
+    return {
+        "verbose": e.verbose, "LaborStatesNo": e.labor_states,
+        "LaborAR": e.labor_ar, "LaborSD": e.labor_sd, "act_T": e.act_T,
+        "T_discard": e.t_discard, "DampingFac": e.damping_fac,
+        "intercept_prev": list(e.intercept_prev),
+        "slope_prev": list(e.slope_prev),
+        "DiscFac": e.disc_fac, "CRRA": e.crra, "LbrInd": e.lbr_ind,
+        "ProdB": e.prod_b, "ProdG": e.prod_g, "CapShare": e.cap_share,
+        "DeprFac": e.depr_fac, "DurMeanB": e.dur_mean_b,
+        "DurMeanG": e.dur_mean_g, "SpellMeanB": e.spell_mean_b,
+        "SpellMeanG": e.spell_mean_g, "UrateB": e.urate_b,
+        "UrateG": e.urate_g, "RelProbBG": e.rel_prob_bg,
+        "RelProbGB": e.rel_prob_gb, "MrkvNow_init": e.mrkv_now_init,
+    }
+
+
+class AggregateSavingRule:
+    """The perceived aggregate law of motion ``A = exp(i + s log M)``
+    (``AggregateSavingRule.__call__``, ``Aiyagari_Support.py:1991-2005``)."""
+
+    distance_criteria = ["slope", "intercept"]
+
+    def __init__(self, intercept: float, slope: float):
+        self.intercept = float(intercept)
+        self.slope = float(slope)
+
+    def __call__(self, Mnow):
+        return np.exp(self.intercept + self.slope * np.log(Mnow))
+
+    def distance(self, other: "AggregateSavingRule") -> float:
+        """HARK MetricObject distance: max over the criteria attributes."""
+        return max(abs(self.slope - other.slope),
+                   abs(self.intercept - other.intercept))
+
+
+class StatePolicy:
+    """One discrete state's consumption function c(m, M) — the facade over a
+    ``[Mcount, A+1]`` knot block (the reference's ``LinearInterpOnInterp1D``
+    of 15 ``LinearInterp`` columns, ``Aiyagari_Support.py:1509-1516``)."""
+
+    def __init__(self, m_knots: np.ndarray, c_knots: np.ndarray,
+                 m_grid: np.ndarray):
+        self._m_knots = np.asarray(m_knots)
+        self._c_knots = np.asarray(c_knots)
+        self._m_grid = np.asarray(m_grid)
+
+    def __call__(self, m, M):
+        m = np.asarray(m, dtype=np.float64)
+        M = np.asarray(M, dtype=np.float64)
+        if M.ndim == 0:
+            out = interp_on_interp(m, M, self._m_grid, self._m_knots,
+                                   self._c_knots)
+            return np.asarray(out)
+        # array-valued M (HARK interpolators accept paired (m, M) arrays,
+        # e.g. consumption along a simulated path): evaluate pointwise
+        # (jnp copies of the knots — numpy arrays can't be indexed by the
+        # vmap tracer)
+        m_b, M_b = np.broadcast_arrays(m, M)
+        grid, mk, ck = (jnp.asarray(self._m_grid), jnp.asarray(self._m_knots),
+                        jnp.asarray(self._c_knots))
+        out = jax.vmap(
+            lambda mi, Mi: interp_on_interp(mi, Mi, grid, mk, ck)
+        )(m_b.ravel(), M_b.ravel())
+        return np.asarray(out).reshape(m_b.shape)
+
+    @property
+    def xInterpolators(self) -> List:
+        """Per-M-gridpoint 1D functions m -> c, as the notebook plots them
+        (``plot_funcs(...cFunc[4j].xInterpolators``, ``Aiyagari-HARK.py:275``)."""
+        def make(k):
+            def f(m):
+                return np.asarray(interp1d(np.asarray(m), self._m_knots[k],
+                                           self._c_knots[k]))
+            return f
+        return [make(k) for k in range(self._m_grid.shape[0])]
+
+
+class AiyagariSolution:
+    """``type.solution[0]`` facade: per-state consumption policies."""
+
+    def __init__(self, cFunc: List[StatePolicy]):
+        self.cFunc = cFunc
+
+
+class AiyagariType:
+    """Household-type facade (reference ``AiyagariType``,
+    ``Aiyagari_Support.py:759-804``): a parameter bag plus, after the economy
+    solves, ``solution[0].cFunc``."""
+
+    def __init__(self, **kwds):
+        params = init_aiyagari_agents()
+        params.update(kwds)
+        self.parameters = params
+        for k, v in params.items():
+            setattr(self, k, v)
+        self.cycles = 0          # infinite horizon (Aiyagari-HARK.py:237)
+        self.solution: Optional[List[AiyagariSolution]] = None
+        self.economy: Optional["AiyagariEconomy"] = None
+
+    def get_economy_data(self, economy: "AiyagariEconomy") -> None:
+        """Import economy-level objects (the reference copies KSS, Mgrid,
+        AFunc, transition matrices onto the agent,
+        ``Aiyagari_Support.py:817-873``; here the link suffices — the jitted
+        calibration is built from both parameter sets at solve time)."""
+        self.economy = economy
+        self.Mgrid = economy.MSS * np.asarray(self.MgridBase)
+        self.kInit = economy.KSS
+
+    def agent_config(self) -> AgentConfig:
+        return AgentConfig.from_reference_dict(self.parameters)
+
+
+class AiyagariEconomy:
+    """Economy/market facade (reference ``AiyagariEconomy``,
+    ``Aiyagari_Support.py:1555-1964``): construct → ``make_Mrkv_history`` →
+    ``solve`` → read ``sow_state``/``reap_state``/``AFunc``/``history``."""
+
+    sow_vars = ["Mnow", "Aprev", "Mrkv", "Rnow", "Wnow"]
+    reap_vars = ["aNow", "EmpNow"]
+    track_vars = ["Mrkv", "Aprev", "Mnow", "Urate"]
+    dyn_vars = ["AFunc"]
+
+    def __init__(self, agents=None, tolerance: float = 0.01, **kwds):
+        params = init_aiyagari_economy()
+        params.update(kwds)
+        self.parameters = params
+        for k, v in params.items():
+            setattr(self, k, v)
+        self.agents = list(agents) if agents is not None else []
+        self.tolerance = tolerance
+        self.max_loops = int(kwds.get("max_loops", 40))
+        self.seed = int(kwds.get("seed", 0))
+        self.sow_state: dict = {}
+        self.reap_state: dict = {}
+        self.history: dict = {}
+        self.MrkvNow_hist: Optional[np.ndarray] = None
+        self.solution: Optional[KSSolution] = None
+        self.update()
+
+    # -- construction ------------------------------------------------------
+    def update(self) -> None:
+        """Steady-state objects and initial saving-rule guesses
+        (``Aiyagari_Support.py:1593-1629``)."""
+        self.AFunc = [AggregateSavingRule(self.intercept_prev[j],
+                                          self.slope_prev[j])
+                      for j in range(2)]
+        ss = firm.perfect_foresight_steady_state(
+            self.DiscFac, self.CapShare, self.DeprFac, self.LbrInd)
+        self.KtoLSS = float(ss.k_to_l)
+        self.KSS = float(ss.K)
+        self.WSS = float(ss.W)
+        self.RSS = float(ss.R)
+        self.MSS = float(ss.M)
+        self.KtoYSS = self.KtoLSS ** (1.0 - self.CapShare)
+        self.sow_init = {"KtoLnow": self.KtoLSS, "Mnow": self.MSS,
+                         "Aprev": self.KSS, "Rnow": self.RSS,
+                         "Wnow": self.WSS, "Mrkv": self.MrkvNow_init}
+
+    def economy_config(self) -> EconomyConfig:
+        cfg = EconomyConfig.from_reference_dict(self.parameters)
+        return cfg.replace(tolerance=float(self.tolerance),
+                           verbose=bool(self.verbose),
+                           max_loops=self.max_loops)
+
+    def make_Mrkv_history(self, seed: Optional[int] = None) -> np.ndarray:
+        """Draw the aggregate Bad/Good chain (``make_Mrkv_history``,
+        ``Aiyagari_Support.py:1793-1805``; the reference uses
+        ``MarkovProcess(..., seed=0)``)."""
+        seed = self.seed if seed is None else seed
+        agg = aggregate_markov_matrix(self.DurMeanB, self.DurMeanG)
+        hist = simulate_markov_history(agg, self.MrkvNow_init, self.act_T,
+                                       jax.random.PRNGKey(seed))
+        self.MrkvNow_hist = np.asarray(hist)
+        return self.MrkvNow_hist
+
+    # -- solve -------------------------------------------------------------
+    def solve(self, ks_employment: bool = False, dtype=None) -> KSSolution:
+        """Run the Krusell-Smith fixed point and populate the reference's
+        result surface."""
+        if not self.agents:
+            raise ValueError("economy.agents is empty — assign "
+                             "[AiyagariType(...)] before solve()")
+        agent = self.agents[0]
+        sol = solve_ks_economy(
+            agent.agent_config(), self.economy_config(), seed=self.seed,
+            ks_employment=ks_employment, dtype=dtype,
+            mrkv_hist=self.MrkvNow_hist)
+        self.solution = sol
+        self._populate_results(sol, agent)
+        return sol
+
+    def _populate_results(self, sol: KSSolution, agent: AiyagariType) -> None:
+        hist = sol.history
+        final = sol.final_panel
+        self.AFunc = [AggregateSavingRule(float(sol.afunc.intercept[j]),
+                                          float(sol.afunc.slope[j]))
+                      for j in range(2)]
+        # push the final parameters back as the next run's initial guesses —
+        # the reference's in-place intercept_prev/slope_prev update
+        # (Aiyagari_Support.py:1949-1951), made explicit here (parameters is
+        # what economy_config() reads, so a repeat solve() warm-starts)
+        self.intercept_prev = [float(x) for x in sol.afunc.intercept]
+        self.slope_prev = [float(x) for x in sol.afunc.slope]
+        self.parameters["intercept_prev"] = self.intercept_prev
+        self.parameters["slope_prev"] = self.slope_prev
+        self.sow_state = {
+            "Mnow": float(final.M_now), "Aprev": float(hist.A_prev[-1]),
+            "Mrkv": int(final.mrkv), "Rnow": float(final.R_now),
+            "Wnow": float(final.W_now),
+        }
+        self.reap_state = {
+            "aNow": [np.asarray(final.assets)],
+            "EmpNow": [np.asarray(final.employed)],
+        }
+        self.history = {
+            "Mrkv": np.asarray(hist.mrkv), "Aprev": np.asarray(hist.A_prev),
+            "Mnow": np.asarray(hist.M_now), "Urate": np.asarray(hist.urate),
+        }
+        cal = sol.calibration
+        m_grid = np.asarray(cal.m_grid)
+        cfuncs = [StatePolicy(sol.policy.m_knots[s], sol.policy.c_knots[s],
+                              m_grid)
+                  for s in range(sol.policy.m_knots.shape[0])]
+        agent.solution = [AiyagariSolution(cFunc=cfuncs)]

@@ -79,6 +79,8 @@ class KSSolution:
     policy: KSPolicy
     calibration: KSCalibration
     history: PanelHistory
+    mrkv_hist: object = None     # [T] aggregate-state chain used
+    final_panel: object = None   # PanelState at the last simulated period
     records: List[KSIterationRecord] = field(default_factory=list)
     converged: bool = False
 
@@ -100,7 +102,7 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
                      seed: int = 0, ks_employment: bool = False,
                      dtype=None, egm_tol: float = 1e-6,
                      resample_each_iteration: bool = False,
-                     callback=None) -> KSSolution:
+                     mrkv_hist=None, callback=None) -> KSSolution:
     """Full reference-parity solve: the Krusell-Smith fixed point over the
     aggregate saving rule.
 
@@ -108,14 +110,19 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
     outer iterations (deterministic fixed point — the reference instead
     leaks fresh global-RNG draws every iteration, quirk §3.6-3, which makes
     its outer loop stochastic).  Set True to mimic that behavior with
-    properly split keys.
+    properly split keys.  ``mrkv_hist`` injects a pre-drawn aggregate chain
+    (the facade's ``make_Mrkv_history``); default draws one from ``seed``.
     """
     cal = build_ks_calibration(agent, econ, ks_employment=ks_employment,
                                dtype=dtype)
     key = jax.random.PRNGKey(seed)
     k_hist, k_birth, k_panel = jax.random.split(key, 3)
-    mrkv_hist = simulate_markov_history(cal.agg_transition, econ.mrkv_now_init,
-                                        econ.act_T, k_hist)
+    if mrkv_hist is None:
+        mrkv_hist = simulate_markov_history(cal.agg_transition,
+                                            econ.mrkv_now_init,
+                                            econ.act_T, k_hist)
+    else:
+        mrkv_hist = jnp.asarray(mrkv_hist)
     init = initial_panel(cal, agent.agent_count, econ.mrkv_now_init, k_birth)
 
     solve_hh = jax.jit(lambda af: solve_ks_household(af, cal, tol=egm_tol))
@@ -130,6 +137,7 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
 
     records: List[KSIterationRecord] = []
     history = None
+    final_panel = None
     policy = None
     converged = False
     for it in range(econ.max_loops):
@@ -137,7 +145,7 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
         policy, egm_iters, _ = solve_hh(afunc)
         k_it = jax.random.fold_in(k_panel, it) if resample_each_iteration \
             else k_panel
-        history, _ = run_panel(policy, k_it)
+        history, final_panel = run_panel(policy, k_it)
         new_afunc, rsq = update(history, afunc)
         if not (bool(jnp.all(jnp.isfinite(new_afunc.intercept)))
                 and bool(jnp.all(jnp.isfinite(new_afunc.slope)))):
@@ -169,4 +177,6 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
             break
 
     return KSSolution(afunc=afunc, policy=policy, calibration=cal,
-                      history=history, records=records, converged=converged)
+                      history=history, mrkv_hist=mrkv_hist,
+                      final_panel=final_panel, records=records,
+                      converged=converged)

@@ -1,0 +1,121 @@
+"""Facade: the notebook's driver flow (construct -> get_economy_data ->
+make_Mrkv_history -> solve -> read results) against the reference's interface
+contract (SURVEY.md §1 L5->L4; Aiyagari-HARK.py:234-291)."""
+
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu import (
+    AggregateSavingRule,
+    AiyagariEconomy,
+    AiyagariType,
+    init_aiyagari_agents,
+    init_aiyagari_economy,
+)
+
+SMALL = dict(LaborStatesNo=5, act_T=300, T_discard=60, verbose=False)
+
+
+@pytest.fixture(scope="module")
+def solved():
+    econ_dict = init_aiyagari_economy()
+    econ_dict.update(SMALL, LaborAR=0.3, CRRA=1.0)
+    agent_dict = init_aiyagari_agents()
+    agent_dict.update(LaborStatesNo=5, AgentCount=100, aCount=16)
+    economy = AiyagariEconomy(tolerance=0.02, **econ_dict)
+    economy.verbose = False
+    agent = AiyagariType(**agent_dict)
+    agent.cycles = 0
+    agent.get_economy_data(economy)
+    economy.agents = [agent]
+    economy.make_Mrkv_history()
+    economy.solve()
+    return economy, agent
+
+
+def test_steady_state_attributes():
+    economy = AiyagariEconomy(**init_aiyagari_economy())
+    # closed forms from Aiyagari_Support.py:1606-1615 with beta=.96 a=.36 d=.08
+    assert economy.KtoLSS == pytest.approx(
+        ((1 / 0.96 - 0.92) / 0.36) ** (1 / (0.36 - 1.0)))
+    assert economy.RSS == pytest.approx(
+        1 + 0.36 * economy.KtoLSS ** (0.36 - 1) - 0.08)
+    assert economy.MSS == pytest.approx(
+        economy.KSS * economy.RSS + economy.WSS * 1.0)
+    assert economy.sow_init["Mnow"] == pytest.approx(economy.MSS)
+
+
+def test_mrkv_history_shape_and_seed():
+    economy = AiyagariEconomy(**{**init_aiyagari_economy(), "act_T": 500})
+    h1 = economy.make_Mrkv_history()
+    h2 = economy.make_Mrkv_history()
+    assert h1.shape == (500,)
+    np.testing.assert_array_equal(h1, h2)   # seeded -> reproducible
+    assert set(np.unique(h1)) <= {0, 1}
+
+
+def test_solve_populates_reference_surface(solved):
+    economy, agent = solved
+    # sow_state / reap_state (Aiyagari-HARK.py:257-258)
+    r_pct = (economy.sow_state["Rnow"] - 1) * 100
+    assert 0.0 < r_pct < 15.0
+    a_mean = np.mean(economy.reap_state["aNow"])
+    d = economy.parameters["DeprFac"]
+    saving = d * a_mean / (economy.sow_state["Mnow"] - (1 - d) * a_mean)
+    assert 0.05 < saving < 0.6
+    # track history
+    assert economy.history["Mnow"].shape == (300,)
+    assert np.all(np.isfinite(economy.history["Aprev"]))
+    # AFunc callables (Aiyagari-HARK.py:286-287)
+    x = np.linspace(0.1, 2 * economy.KSS, 50)
+    y0 = economy.AFunc[0](x)
+    assert y0.shape == x.shape and np.all(y0 > 0)
+    # solution cFunc surface (Aiyagari-HARK.py:275)
+    cf = agent.solution[0].cFunc
+    assert len(cf) == 4 * 5
+    c = cf[0](np.linspace(0.1, 10, 7), economy.MSS)
+    assert c.shape == (7,) and np.all(np.diff(c) > 0)   # monotone in m
+    xi = cf[0].xInterpolators
+    assert len(xi) == len(agent.MgridBase)
+    assert np.all(xi[3](np.linspace(0.1, 10, 7)) > 0)
+
+
+def test_consumption_below_resources(solved):
+    economy, agent = solved
+    m = np.linspace(0.5, 20, 40)
+    for s in (0, 9, 19):
+        c = agent.solution[0].cFunc[s](m, economy.MSS)
+        assert np.all(c <= m + 1e-6)
+        assert np.all(c > 0)
+
+
+def test_solve_requires_agents():
+    economy = AiyagariEconomy(**init_aiyagari_economy())
+    with pytest.raises(ValueError):
+        economy.solve()
+
+
+def test_aggregate_saving_rule_distance():
+    a = AggregateSavingRule(0.1, 1.0)
+    b = AggregateSavingRule(0.3, 0.9)
+    assert a.distance(b) == pytest.approx(0.2)
+    assert a(np.e) == pytest.approx(np.exp(0.1 + 1.0))
+
+
+def test_repeat_solve_warm_starts(solved):
+    """Solving twice continues from the converged rule (the reference's
+    in-place intercept_prev/slope_prev mutation, quirk SURVEY.md §3.6-7,
+    made explicit) — so the second solve converges in one iteration."""
+    economy, agent = solved
+    assert len(economy.solution.records) > 1
+    economy.solve()
+    assert len(economy.solution.records) == 1
+
+
+def test_cfunc_accepts_array_M(solved):
+    economy, agent = solved
+    m = np.linspace(0.5, 10, 8)
+    Ms = np.full(8, economy.MSS)
+    paired = agent.solution[0].cFunc[0](m, Ms)
+    scalar = agent.solution[0].cFunc[0](m, economy.MSS)
+    np.testing.assert_allclose(paired, scalar, rtol=1e-6)

@@ -1,0 +1,96 @@
+"""Analytics: Lorenz shares, weighted percentiles, Gini, wealth stats —
+against closed forms and degenerate cases."""
+
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.utils.stats import (
+    get_lorenz_shares,
+    get_percentiles,
+    gini,
+    histogram_sample,
+    lorenz_distance,
+    load_scf_wealth_weights,
+    wealth_stats,
+)
+
+
+def test_lorenz_equal_wealth_is_diagonal():
+    data = np.full(1000, 3.7)
+    p = np.linspace(0.05, 0.95, 10)
+    np.testing.assert_allclose(get_lorenz_shares(data, percentiles=p), p,
+                               atol=1e-3)
+
+
+def test_lorenz_concentrated_wealth():
+    # one agent owns everything: Lorenz stays at 0 below the owner's rank
+    # and reaches 1 at the top
+    data = np.concatenate([np.zeros(999), [1000.0]])
+    shares = get_lorenz_shares(data, percentiles=np.array([0.5, 0.999, 1.0]))
+    assert shares[0] < 1e-6 and shares[1] < 1e-6
+    assert shares[2] == pytest.approx(1.0)
+
+
+def test_lorenz_weights_equivalent_to_replication():
+    rng = np.random.default_rng(0)
+    data = rng.lognormal(size=200)
+    reps = rng.integers(1, 5, size=200)
+    expanded = np.repeat(data, reps)
+    p = np.linspace(0.1, 0.9, 9)
+    np.testing.assert_allclose(
+        get_lorenz_shares(data, weights=reps, percentiles=p),
+        get_lorenz_shares(expanded, percentiles=p), atol=1e-9)
+
+
+def test_percentiles_weighted():
+    d = np.array([1.0, 2.0, 3.0, 4.0])
+    assert get_percentiles(d, percentiles=(0.5,))[0] == pytest.approx(2.5)
+    # weighting the top obs heavily pulls the median up
+    w = np.array([1.0, 1.0, 1.0, 10.0])
+    assert get_percentiles(d, weights=w, percentiles=(0.5,))[0] > 3.0
+
+
+def test_gini_bounds():
+    assert gini(np.full(100, 2.0)) == pytest.approx(0.0, abs=1e-9)
+    concentrated = np.concatenate([np.zeros(9999), [1.0]])
+    assert gini(concentrated) > 0.99
+    rng = np.random.default_rng(1)
+    g = gini(rng.lognormal(sigma=1.0, size=20000))
+    # closed form for lognormal: 2*Phi(sigma/sqrt 2) - 1 ~ 0.5205
+    assert abs(g - 0.5205) < 0.02
+
+
+def test_wealth_stats_weighted_matches_expanded():
+    rng = np.random.default_rng(2)
+    d = rng.lognormal(size=300)
+    reps = rng.integers(1, 6, size=300)
+    ws = wealth_stats(d, weights=reps)
+    we = wealth_stats(np.repeat(d, reps))
+    assert ws.mean == pytest.approx(we.mean)
+    assert ws.std == pytest.approx(we.std)
+    assert ws.median == pytest.approx(we.median, rel=1e-2)
+
+
+def test_histogram_sample_collapses_states():
+    grid = np.array([0.0, 1.0, 2.0])
+    masses = np.array([[0.1, 0.2], [0.3, 0.1], [0.2, 0.1]])
+    g, m = histogram_sample(grid, masses)
+    np.testing.assert_allclose(m, [0.3, 0.4, 0.3])
+    s = wealth_stats(g, weights=m)
+    assert s.mean == pytest.approx(1.0)
+
+
+def test_lorenz_distance_zero_for_identical():
+    d = np.random.default_rng(3).lognormal(size=500)
+    assert lorenz_distance(d, d) == pytest.approx(0.0)
+
+
+def test_scf_loader_missing_raises(tmp_path, monkeypatch):
+    monkeypatch.delenv("SCF_WEALTH_CSV", raising=False)
+    with pytest.raises(FileNotFoundError):
+        load_scf_wealth_weights()
+    p = tmp_path / "scf.csv"
+    p.write_text("wealth,weight\n1.0,2.0\n5.0,1.0\n")
+    w, wt = load_scf_wealth_weights(str(p))
+    np.testing.assert_allclose(w, [1.0, 5.0])
+    np.testing.assert_allclose(wt, [2.0, 1.0])
