@@ -15,6 +15,7 @@ The convergence metric is HARK's distance on the rule parameters:
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import List
@@ -102,7 +103,8 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
                      seed: int = 0, ks_employment: bool = False,
                      dtype=None, egm_tol: float = 1e-6,
                      resample_each_iteration: bool = False,
-                     mrkv_hist=None, callback=None) -> KSSolution:
+                     mrkv_hist=None, callback=None,
+                     checkpoint_path=None, timer=None) -> KSSolution:
     """Full reference-parity solve: the Krusell-Smith fixed point over the
     aggregate saving rule.
 
@@ -112,7 +114,23 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
     its outer loop stochastic).  Set True to mimic that behavior with
     properly split keys.  ``mrkv_hist`` injects a pre-drawn aggregate chain
     (the facade's ``make_Mrkv_history``); default draws one from ``seed``.
+
+    ``checkpoint_path``: save the outer-loop state (saving rule, iteration,
+    seed) there every iteration; if the file already exists and matches this
+    ``seed``, resume from it instead of the config's initial guesses.
+    ``timer``: an optional ``utils.timing.PhaseTimer`` accumulating
+    solve/simulate/regress phases.
     """
+    from ..utils.checkpoint import (
+        config_fingerprint,
+        load_ks_checkpoint,
+        save_ks_checkpoint,
+    )
+    from ..utils.timing import PhaseTimer
+    if timer is None:
+        timer = PhaseTimer()
+    fingerprint = config_fingerprint(agent, econ, mrkv_hist,
+                                     ks_employment, egm_tol)
     cal = build_ks_calibration(agent, econ, ks_employment=ks_employment,
                                dtype=dtype)
     key = jax.random.PRNGKey(seed)
@@ -134,19 +152,58 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
     afunc = AFuncParams(
         intercept=jnp.asarray(econ.intercept_prev, dtype=cal.a_grid.dtype),
         slope=jnp.asarray(econ.slope_prev, dtype=cal.a_grid.dtype))
+    it_start = 0
+    resumed_converged = False
+    if checkpoint_path is not None and os.path.exists(checkpoint_path):
+        ck = load_ks_checkpoint(checkpoint_path)
+        if int(ck.seed) != seed or int(ck.fingerprint) != fingerprint:
+            raise ValueError(
+                f"checkpoint {checkpoint_path} was written by a different "
+                f"run (seed {int(ck.seed)} vs {seed}, config fingerprint "
+                f"mismatch: {int(ck.fingerprint) != fingerprint}) — delete "
+                f"it or use a different checkpoint_path; refusing to "
+                f"silently overwrite")
+        afunc = AFuncParams(
+            intercept=jnp.asarray(ck.intercept, dtype=cal.a_grid.dtype),
+            slope=jnp.asarray(ck.slope, dtype=cal.a_grid.dtype))
+        resumed_converged = bool(ck.converged)
+        # always leave at least one pass to (re)generate the policy/history
+        # the checkpoint does not carry
+        it_start = max(0, min(int(ck.iteration), econ.max_loops - 1))
+        if econ.verbose:
+            print(f"[ks] resumed from {checkpoint_path} at outer "
+                  f"iteration {it_start}"
+                  + (" (already converged)" if resumed_converged else ""))
+
+    if resumed_converged:
+        # idempotent reload: rebuild the policy/history the checkpoint does
+        # not carry, but leave the converged rule (and the file) untouched
+        with timer.phase("solve"):
+            policy, _, _ = jax.block_until_ready(solve_hh(afunc))
+        with timer.phase("simulate"):
+            history, final_panel = jax.block_until_ready(
+                run_panel(policy, k_panel))
+        return KSSolution(afunc=afunc, policy=policy, calibration=cal,
+                          history=history, mrkv_hist=mrkv_hist,
+                          final_panel=final_panel, records=[],
+                          converged=True)
 
     records: List[KSIterationRecord] = []
     history = None
     final_panel = None
     policy = None
     converged = False
-    for it in range(econ.max_loops):
+    for it in range(it_start, econ.max_loops):
         t0 = time.time()
-        policy, egm_iters, _ = solve_hh(afunc)
+        with timer.phase("solve"):
+            policy, egm_iters, _ = jax.block_until_ready(solve_hh(afunc))
         k_it = jax.random.fold_in(k_panel, it) if resample_each_iteration \
             else k_panel
-        history, final_panel = run_panel(policy, k_it)
-        new_afunc, rsq = update(history, afunc)
+        with timer.phase("simulate"):
+            history, final_panel = jax.block_until_ready(
+                run_panel(policy, k_it))
+        with timer.phase("regress"):
+            new_afunc, rsq = jax.block_until_ready(update(history, afunc))
         if not (bool(jnp.all(jnp.isfinite(new_afunc.intercept)))
                 and bool(jnp.all(jnp.isfinite(new_afunc.slope)))):
             raise RuntimeError(
@@ -174,6 +231,10 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
             callback(rec)
         if distance < econ.tolerance:
             converged = True
+        if checkpoint_path is not None:
+            save_ks_checkpoint(checkpoint_path, afunc, it + 1, seed,
+                               converged, fingerprint)
+        if converged:
             break
 
     return KSSolution(afunc=afunc, policy=policy, calibration=cal,

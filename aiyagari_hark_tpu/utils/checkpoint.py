@@ -1,0 +1,113 @@
+"""Checkpoint / resume of fixed-point state as flat npz pytrees.
+
+The reference has no persistence at all — partial progress survives only
+through accidental in-place mutation of ``intercept_prev``/``slope_prev``
+(``Aiyagari_Support.py:1949-1951``; SURVEY.md §5).  Here any pytree of
+arrays (an ``AFuncParams``, a ``KSPolicy``, a ``PanelState``, a whole
+``KSCheckpoint``) round-trips through one ``.npz`` file, so the multi-minute
+Krusell-Smith fixed point and long sweeps are resumable.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import NamedTuple
+
+import jax
+import numpy as np
+
+
+def save_pytree(path: str, tree) -> None:
+    """Write a pytree of arrays/scalars to ``path`` (npz, atomic rename).
+    Structure is carried by flatten order — load with a matching template."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    arrays = {f"leaf_{i:04d}": np.asarray(leaf)
+              for i, leaf in enumerate(leaves)}
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
+
+
+def load_pytree(path: str, like):
+    """Read a pytree saved by ``save_pytree`` into the structure of ``like``
+    (same treedef; leaf shapes/dtypes come from the file)."""
+    treedef = jax.tree_util.tree_structure(like)
+    n = treedef.num_leaves
+    with np.load(path) as data:
+        keys = sorted(data.files)
+        if len(keys) != n:
+            raise ValueError(
+                f"checkpoint {path} holds {len(keys)} leaves, template "
+                f"expects {n} — wrong template or corrupted file")
+        leaves = [data[k] for k in keys]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class KSCheckpoint(NamedTuple):
+    """Resumable state of the Krusell-Smith outer loop: the perceived rule,
+    how many outer iterations produced it, the RNG seed that generated the
+    shock panel, and a fingerprint of the configuration that produced it
+    (SURVEY.md §5 'Checkpoint / resume')."""
+
+    intercept: np.ndarray    # [2]
+    slope: np.ndarray        # [2]
+    iteration: np.ndarray    # scalar int
+    seed: np.ndarray         # scalar int
+    converged: np.ndarray    # scalar bool
+    fingerprint: np.ndarray  # scalar int64 — config hash
+
+
+def ks_checkpoint_template() -> KSCheckpoint:
+    return KSCheckpoint(
+        intercept=np.zeros(2), slope=np.zeros(2),
+        iteration=np.zeros((), np.int64), seed=np.zeros((), np.int64),
+        converged=np.zeros((), np.bool_),
+        fingerprint=np.zeros((), np.int64))
+
+
+def config_fingerprint(*objs) -> int:
+    """Deterministic int64 fingerprint of configs/arrays, used to detect a
+    checkpoint written under a different setup (stale-resume guard)."""
+    import dataclasses
+    import hashlib
+    import json
+
+    parts = []
+    for o in objs:
+        if o is None:
+            parts.append("none")
+        elif dataclasses.is_dataclass(o) and not isinstance(o, type):
+            parts.append(json.dumps(dataclasses.asdict(o), sort_keys=True,
+                                    default=repr))
+        elif isinstance(o, np.ndarray) or hasattr(o, "__array__"):
+            a = np.asarray(o)
+            parts.append(f"{a.dtype}{a.shape}"
+                         + hashlib.md5(a.tobytes()).hexdigest())
+        else:
+            parts.append(repr(o))
+    digest = hashlib.md5("|".join(parts).encode()).digest()
+    return int.from_bytes(digest[:8], "little", signed=True)
+
+
+def save_ks_checkpoint(path: str, afunc, iteration: int, seed: int,
+                       converged: bool, fingerprint: int = 0) -> None:
+    save_pytree(path, KSCheckpoint(
+        intercept=np.asarray(afunc.intercept),
+        slope=np.asarray(afunc.slope),
+        iteration=np.asarray(iteration, np.int64),
+        seed=np.asarray(seed, np.int64),
+        converged=np.asarray(converged, np.bool_),
+        fingerprint=np.asarray(fingerprint, np.int64)))
+
+
+def load_ks_checkpoint(path: str) -> KSCheckpoint:
+    return load_pytree(path, ks_checkpoint_template())

@@ -1,0 +1,78 @@
+"""Profiling and structured observability.
+
+The reference records wall-clock with bare ``time.time()`` pairs written to
+``runtime.txt`` (``Aiyagari-HARK.py:184-185, 352-361``) and prints regression
+parameters when ``verbose`` (SURVEY.md §5).  Here: named phase timers with an
+accumulating report, a JSON-lines writer for iteration records, and an
+optional ``jax.profiler`` trace context for device-level traces (perfetto).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import time
+from collections import defaultdict
+from typing import Dict, Iterable
+
+
+class PhaseTimer:
+    """Accumulating named timers: ``with timer.phase("solve"): ...``.
+
+    ``report()`` returns {phase: seconds}; ``counts`` holds invocation
+    counts.  Wall-clock only (device work should be bracketed with
+    ``block_until_ready`` by the caller, as the solvers do).
+    """
+
+    def __init__(self):
+        self.seconds: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.seconds[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def report(self) -> Dict[str, float]:
+        return dict(self.seconds)
+
+    def summary(self) -> str:
+        total = sum(self.seconds.values())
+        lines = [f"{name:>12s}: {sec:8.3f}s x{self.counts[name]:<4d} "
+                 f"({100.0 * sec / total:5.1f}%)"
+                 for name, sec in sorted(self.seconds.items(),
+                                         key=lambda kv: -kv[1])]
+        return "\n".join(lines + [f"{'total':>12s}: {total:8.3f}s"])
+
+
+def write_records_jsonl(path: str, records: Iterable) -> None:
+    """Persist iteration records (e.g. ``KSIterationRecord`` dataclasses or
+    dicts) as JSON lines — the structured replacement for the reference's
+    ``verbose`` prints (``Aiyagari_Support.py:1954-1962``)."""
+    with open(path, "w") as f:
+        for rec in records:
+            if dataclasses.is_dataclass(rec) and not isinstance(rec, type):
+                rec = dataclasses.asdict(rec)
+            f.write(json.dumps(rec) + "\n")
+
+
+def read_records_jsonl(path: str):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str | None):
+    """``jax.profiler`` trace context (perfetto dump under ``log_dir``);
+    no-op when ``log_dir`` is None so call sites need no branching."""
+    if log_dir is None:
+        yield
+        return
+    import jax
+    with jax.profiler.trace(log_dir):
+        yield

@@ -1,0 +1,110 @@
+"""Aux subsystems: pytree checkpointing, KS resume, phase timers, JSONL
+records (SURVEY.md §5)."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.models.ks_model import AFuncParams
+from aiyagari_hark_tpu.models.ks_solver import solve_ks_economy
+from aiyagari_hark_tpu.utils.checkpoint import (
+    load_ks_checkpoint,
+    load_pytree,
+    save_ks_checkpoint,
+    save_pytree,
+)
+from aiyagari_hark_tpu.utils.config import AgentConfig, EconomyConfig
+from aiyagari_hark_tpu.utils.timing import (
+    PhaseTimer,
+    read_records_jsonl,
+    write_records_jsonl,
+)
+
+SMALL_AGENT = AgentConfig(labor_states=4, agent_count=64, a_count=12)
+SMALL_ECON = EconomyConfig(labor_states=4, act_T=200, t_discard=40,
+                           verbose=False, tolerance=0.05)
+
+
+def test_pytree_roundtrip(tmp_path):
+    tree = {"a": np.arange(6).reshape(2, 3),
+            "b": (jnp.ones(4), np.float64(2.5))}
+    p = str(tmp_path / "t.npz")
+    save_pytree(p, tree)
+    out = load_pytree(p, tree)
+    np.testing.assert_array_equal(out["a"], tree["a"])
+    np.testing.assert_array_equal(out["b"][0], np.ones(4))
+    assert float(out["b"][1]) == 2.5
+
+
+def test_pytree_wrong_template_raises(tmp_path):
+    p = str(tmp_path / "t.npz")
+    save_pytree(p, {"a": np.ones(3)})
+    with pytest.raises(ValueError):
+        load_pytree(p, {"a": np.ones(3), "b": np.ones(3)})
+
+
+def test_ks_checkpoint_roundtrip(tmp_path):
+    p = str(tmp_path / "ks.npz")
+    afunc = AFuncParams(intercept=jnp.array([0.1, 0.2]),
+                        slope=jnp.array([0.9, 1.1]))
+    save_ks_checkpoint(p, afunc, iteration=7, seed=3, converged=False)
+    ck = load_ks_checkpoint(p)
+    np.testing.assert_allclose(ck.intercept, [0.1, 0.2])
+    np.testing.assert_allclose(ck.slope, [0.9, 1.1])
+    assert int(ck.iteration) == 7 and int(ck.seed) == 3
+    assert not bool(ck.converged)
+
+
+def test_ks_solve_resumes_from_checkpoint(tmp_path):
+    p = str(tmp_path / "ks.npz")
+    timer = PhaseTimer()
+    sol1 = solve_ks_economy(SMALL_AGENT, SMALL_ECON, seed=0,
+                            checkpoint_path=p, timer=timer)
+    n1 = len(sol1.records)
+    assert n1 >= 1
+    assert timer.seconds["solve"] > 0 and timer.seconds["simulate"] > 0
+    # converged checkpoint -> idempotent reload: rule untouched, zero
+    # iterations, policy/history rebuilt
+    sol2 = solve_ks_economy(SMALL_AGENT, SMALL_ECON, seed=0,
+                            checkpoint_path=p)
+    assert len(sol2.records) == 0 and sol2.converged
+    np.testing.assert_array_equal(np.asarray(sol2.afunc.slope),
+                                  np.asarray(sol1.afunc.slope))
+    assert sol2.history is not None and sol2.final_panel is not None
+    # a mismatched seed or config must refuse to clobber the checkpoint
+    with pytest.raises(ValueError, match="different run"):
+        solve_ks_economy(SMALL_AGENT, SMALL_ECON, seed=1, checkpoint_path=p)
+    with pytest.raises(ValueError, match="different run"):
+        solve_ks_economy(SMALL_AGENT,
+                         SMALL_ECON.replace(damping_fac=0.25),
+                         seed=0, checkpoint_path=p)
+    assert int(load_ks_checkpoint(p).seed) == 0   # file untouched
+
+
+def test_phase_timer_summary():
+    t = PhaseTimer()
+    with t.phase("a"):
+        pass
+    with t.phase("a"):
+        pass
+    with t.phase("b"):
+        pass
+    assert t.counts["a"] == 2 and t.counts["b"] == 1
+    assert "total" in t.summary()
+
+
+def test_records_jsonl_roundtrip(tmp_path):
+    from aiyagari_hark_tpu.models.ks_solver import KSIterationRecord
+    p = str(tmp_path / "r.jsonl")
+    recs = [KSIterationRecord(iteration=0, intercept=[0.1, 0.2],
+                              slope=[1.0, 1.0], r_squared=[0.9, 0.9],
+                              distance=0.5, egm_iters=100, wall_seconds=1.0),
+            {"iteration": 1, "distance": 0.1}]
+    write_records_jsonl(p, recs)
+    out = read_records_jsonl(p)
+    assert out[0]["iteration"] == 0 and out[0]["slope"] == [1.0, 1.0]
+    assert out[1]["distance"] == 0.1
+    with open(p) as f:
+        assert len(json.loads(f.readline())) == 7
