@@ -1,0 +1,223 @@
+"""Two-asset (safe + risky) portfolio-choice household — the BASELINE.json
+"Portfolio-choice Aiyagari" extension (HARK's ``ConsPortfolioModel`` family;
+the reference repo itself has no working aggregate-shock or portfolio solver,
+SURVEY.md §2.2).
+
+Model: end of period the household holds assets ``a`` split between a safe
+asset returning ``R_f`` and a risky asset returning a discrete draw ``R_k``
+(probability ``p_k``), chosen as a share ``omega ∈ [0, 1]``; labor income
+follows the same Tauchen process as the Aiyagari model.
+
+Solution is EGM with a portfolio-share first-order condition, all batched
+array math (no per-state Python objects):
+
+    FOC(share):  f(omega; a, s) = E_{k, s'} [ (R_k − R_f) u'(c'(m')) ] = 0
+                 m' = (R_f + omega (R_k − R_f)) a + W l_{s'}
+    f is decreasing in omega (u' convex, c' increasing in m'), so the
+    optimum is the sign change of f on a share grid, refined by linear
+    interpolation and clamped to [0, 1].
+    EGM:         EndOfPrdvP(a, s) = beta E_{k, s'} [ R_p(omega*) u'(c'(m')) ]
+                 c = EndOfPrdvP^{−1/gamma};  m = a + c   (+ constraint knot)
+
+Shapes: the FOC tensor is ``[A, S_shares, K_draws, N']`` reduced by one
+einsum against ``p ⊗ P`` — MXU-friendly, vmap/jit-safe, static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.grids import make_asset_grid
+from ..ops.interp import interp1d_rowwise
+from ..ops.markov import (
+    normalized_labor_states,
+    stationary_distribution,
+    tauchen_labor_process,
+)
+from ..ops.utility import inverse_marginal_utility, marginal_utility
+from .household import CONSTRAINT_EPS, HouseholdPolicy
+
+
+class PortfolioModel(NamedTuple):
+    """Static calibration for the two-asset household."""
+
+    a_grid: jnp.ndarray         # [A] end-of-period total assets
+    labor_levels: jnp.ndarray   # [N]
+    transition: jnp.ndarray     # [N, N]
+    labor_stationary: jnp.ndarray  # [N]
+    risky_returns: jnp.ndarray  # [K] gross return draws
+    risky_probs: jnp.ndarray    # [K]
+    share_grid: jnp.ndarray     # [S] candidate risky shares in [0, 1]
+
+
+class PortfolioPolicy(NamedTuple):
+    """Consumption knots per labor state plus the risky share on the
+    end-of-period asset grid."""
+
+    m_knots: jnp.ndarray   # [N, A+1]
+    c_knots: jnp.ndarray   # [N, A+1]
+    share: jnp.ndarray     # [N, A] omega*(a_i, s)
+
+
+def lognormal_risky_returns(mean: float, std: float, n: int = 7,
+                            dtype=None) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Equiprobable lognormal discretization of the gross risky return:
+    ``n`` conditional means of equal-probability slices (HARK's
+    ``Lognormal.discretize`` approach), matching ``mean``/``std``."""
+    import numpy as np
+    from scipy.stats import norm as scipy_norm
+
+    sigma2 = np.log(1.0 + (std / mean) ** 2)
+    sigma = np.sqrt(sigma2)
+    mu = np.log(mean) - 0.5 * sigma2
+    edges = scipy_norm.ppf(np.linspace(0.0, 1.0, n + 1))
+    # conditional mean of a lognormal over each z-slice:
+    # E[X | z in (a,b)] = e^{mu+s^2/2} (Phi(b-s) - Phi(a-s)) / (Phi(b)-Phi(a))
+    cdf = scipy_norm.cdf
+    num = cdf(edges[1:] - sigma) - cdf(edges[:-1] - sigma)
+    den = cdf(edges[1:]) - cdf(edges[:-1])
+    vals = np.exp(mu + 0.5 * sigma2) * num / den
+    probs = np.full(n, 1.0 / n)
+    return (jnp.asarray(vals, dtype=dtype), jnp.asarray(probs, dtype=dtype))
+
+
+def build_portfolio_model(labor_states: int = 7, labor_ar: float = 0.6,
+                          labor_sd: float = 0.2, labor_bound: float = 3.0,
+                          a_min: float = 0.001, a_max: float = 50.0,
+                          a_count: int = 48, a_nest_fac: int = 2,
+                          risky_mean: float = 1.08, risky_std: float = 0.20,
+                          risky_count: int = 7, share_count: int = 25,
+                          dtype=None) -> PortfolioModel:
+    a_grid = make_asset_grid(a_min, a_max, a_count, a_nest_fac, dtype=dtype)
+    tauchen = tauchen_labor_process(labor_states, labor_ar, labor_sd,
+                                    bound=labor_bound, dtype=dtype)
+    returns, probs = lognormal_risky_returns(risky_mean, risky_std,
+                                             risky_count, dtype=dtype)
+    return PortfolioModel(
+        a_grid=a_grid,
+        labor_levels=normalized_labor_states(tauchen.grid),
+        transition=tauchen.transition,
+        labor_stationary=stationary_distribution(tauchen.transition),
+        risky_returns=returns, risky_probs=probs,
+        share_grid=jnp.linspace(0.0, 1.0, share_count, dtype=a_grid.dtype))
+
+
+def initial_portfolio_policy(model: PortfolioModel) -> PortfolioPolicy:
+    n = model.labor_levels.shape[0]
+    eps = jnp.asarray(CONSTRAINT_EPS, dtype=model.a_grid.dtype)
+    m_row = jnp.concatenate([eps[None], model.a_grid + eps])
+    knots = jnp.tile(m_row, (n, 1))
+    share = jnp.zeros((n, model.a_grid.shape[0]), dtype=model.a_grid.dtype)
+    return PortfolioPolicy(m_knots=knots, c_knots=knots, share=share)
+
+
+def _optimal_share(gap_foc: jnp.ndarray, share_grid: jnp.ndarray):
+    """Zero crossing of the (decreasing-in-omega) excess-return FOC on the
+    share grid, linearly refined; corners when no sign change.
+
+    ``gap_foc``: [..., S] values of f(omega_j).  Returns omega* [...] .
+    """
+    pos = gap_foc >= 0
+    # index of last gridpoint with f >= 0 (f decreasing); 0 if none
+    idx = jnp.sum(pos.astype(jnp.int32), axis=-1) - 1
+    idx = jnp.clip(idx, 0, share_grid.shape[0] - 2)
+    f0 = jnp.take_along_axis(gap_foc, idx[..., None], axis=-1)[..., 0]
+    f1 = jnp.take_along_axis(gap_foc, idx[..., None] + 1, axis=-1)[..., 0]
+    w0 = share_grid[idx]
+    w1 = share_grid[idx + 1]
+    t = jnp.where(jnp.abs(f1 - f0) > 1e-30, f0 / (f0 - f1), 0.0)
+    omega = w0 + jnp.clip(t, 0.0, 1.0) * (w1 - w0)
+    all_neg = ~pos[..., 0]          # f(0) < 0  -> corner omega = 0
+    all_pos = pos[..., -1]          # f(1) >= 0 -> corner omega = 1
+    omega = jnp.where(all_neg, share_grid[0], omega)
+    omega = jnp.where(all_pos, share_grid[-1], omega)
+    return omega
+
+
+def egm_step_portfolio(policy: PortfolioPolicy, r_free, wage,
+                       model: PortfolioModel, disc_fac,
+                       crra) -> PortfolioPolicy:
+    """One backward step: share FOC on the [A, S, K, N'] tensor, then EGM on
+    consumption at the optimal share."""
+    a = model.a_grid                                   # [A]
+    excess = model.risky_returns - r_free              # [K]
+    # portfolio return per (share, draw): [S, K]
+    r_port = r_free + model.share_grid[:, None] * excess[None, :]
+    # m'[A, S, K, N'] = R_p a + W l'
+    m_next = (r_port[None, :, :, None] * a[:, None, None, None]
+              + wage * model.labor_levels[None, None, None, :])
+    n = model.labor_levels.shape[0]
+    # c'(m') with per-next-state knots: rowwise over N'
+    flat = m_next.reshape(-1, n).T                     # [N', A*S*K]
+    c_next = interp1d_rowwise(flat, policy.m_knots, policy.c_knots)
+    vp = marginal_utility(c_next.T.reshape(m_next.shape), crra)  # [A,S,K,N']
+    # joint weights over (K, N') given current state j: p_k * P[j, n'];
+    # FOC tensor f[A, j, S] = sum_{k, n'} p_k P[j,n'] (R_k - R_f) vp
+    foc = jnp.einsum("askn,k,jn->ajs", vp, excess * model.risky_probs,
+                     model.transition,
+                     precision=jax.lax.Precision.HIGHEST)
+    omega = _optimal_share(foc, model.share_grid)      # [A, j]
+    # marginal value at omega*: E[(R_f + omega* (R_k - R_f)) u'(c')]
+    # evaluate vp at the interpolated share by re-deriving m' at omega*
+    r_opt = r_free + omega[:, :, None] * excess[None, None, :]   # [A, s, K]
+    m_opt = (r_opt[:, :, :, None] * a[:, None, None, None]
+             + wage * model.labor_levels[None, None, None, :])   # [A,s,K,N']
+    flat = m_opt.reshape(-1, n).T
+    c_opt = interp1d_rowwise(flat, policy.m_knots, policy.c_knots)
+    vp_opt = marginal_utility(c_opt.T.reshape(m_opt.shape), crra)
+    weighted = r_opt[..., None] * vp_opt               # [A, s, K, N']
+    end_vp = disc_fac * jnp.einsum("ajkn,k,jn->aj", weighted,
+                                   model.risky_probs, model.transition,
+                                   precision=jax.lax.Precision.HIGHEST)
+    c_now = inverse_marginal_utility(end_vp, crra)     # [A, s]
+    m_now = a[:, None] + c_now
+    eps = jnp.full((1, n), CONSTRAINT_EPS, dtype=c_now.dtype)
+    return PortfolioPolicy(
+        m_knots=jnp.concatenate([eps, m_now], axis=0).T,
+        c_knots=jnp.concatenate([eps, c_now], axis=0).T,
+        share=omega.T)                                 # [N, A]
+
+
+def solve_portfolio_household(r_free, wage, model: PortfolioModel, disc_fac,
+                              crra, tol: float = 1e-6, max_iter: int = 3000):
+    """Infinite-horizon fixed point (sup-norm on consumption knots).
+    Returns (PortfolioPolicy, n_iter, final_diff)."""
+    p0 = initial_portfolio_policy(model)
+    big = jnp.asarray(jnp.inf, dtype=p0.c_knots.dtype)
+
+    def cond(state):
+        _, diff, it = state
+        return (diff > tol) & (it < max_iter)
+
+    def body(state):
+        policy, _, it = state
+        new = egm_step_portfolio(policy, r_free, wage, model, disc_fac, crra)
+        diff = jnp.max(jnp.abs(new.c_knots - policy.c_knots))
+        return new, diff, it + 1
+
+    policy, diff, it = jax.lax.while_loop(cond, body,
+                                          (p0, big, jnp.asarray(0)))
+    return policy, it, diff
+
+
+def consumption_policy(policy: PortfolioPolicy) -> HouseholdPolicy:
+    """View the consumption part as a plain ``HouseholdPolicy`` so the
+    single-asset analytics (interp evaluation, Lorenz pipelines) apply."""
+    return HouseholdPolicy(m_knots=policy.m_knots, c_knots=policy.c_knots)
+
+
+def share_at(policy: PortfolioPolicy, a, model: PortfolioModel,
+             state_idx=None):
+    """Risky share omega*(a) per labor state (rowwise interpolation on the
+    end-of-period asset grid)."""
+    grid = model.a_grid
+    if state_idx is None:
+        n = policy.share.shape[0]
+        queries = jnp.broadcast_to(jnp.asarray(a), (n,) + jnp.shape(a))
+        grids = jnp.broadcast_to(grid, (n,) + grid.shape)
+        return interp1d_rowwise(queries, grids, policy.share)
+    from ..ops.interp import interp1d
+    return interp1d(a, grid, policy.share[state_idx])
