@@ -27,16 +27,39 @@ from .mesh import pad_to_multiple, sharding
 
 @dataclass
 class SweepResult:
-    """Per-cell equilibrium objects, cell-major ([C] leading axis)."""
+    """Per-cell equilibrium objects, cell-major ([C] leading axis).
+
+    ``excess`` mixes household supply evaluated at the *last bisection
+    midpoint* with firm demand at ``r_star`` (the lean solver never
+    re-solves at ``r_star``), so it is a market-clearing residual accurate
+    only to O(r_tol) — a bracket-width effect, not a solver error.
+
+    ``egm_iters``/``dist_iters`` are each cell's total inner-loop work.
+    Under vmap-of-while, every lane runs until the slowest converges, so
+    ``iteration_skew()`` (max/min total work) bounds the wasted compute —
+    the supporting model for multi-chip scaling claims (VERDICT r1 #9).
+    """
 
     crra: np.ndarray          # [C]
     labor_ar: np.ndarray      # [C]
     r_star_pct: np.ndarray    # [C] net return, percent (Table II units)
     saving_rate_pct: np.ndarray  # [C] δK/Y, percent
     capital: np.ndarray       # [C]
-    excess: np.ndarray        # [C] residual market-clearing error
+    excess: np.ndarray        # [C] market-clearing residual, O(r_tol) exact
     bisect_iters: np.ndarray  # [C]
+    egm_iters: np.ndarray     # [C] total EGM steps across all midpoints
+    dist_iters: np.ndarray    # [C] total distribution-iteration steps
     wall_seconds: float = float("nan")
+
+    def total_work(self) -> np.ndarray:
+        """Per-cell inner-loop step count (EGM + distribution iterations)."""
+        return self.egm_iters + self.dist_iters
+
+    def iteration_skew(self) -> float:
+        """max/min of per-cell total work — how unevenly vmap-of-while lanes
+        finish (1.0 = perfectly balanced; the batch runs at the max)."""
+        w = self.total_work()
+        return float(w.max() / max(w.min(), 1))
 
     def table(self) -> str:
         """Aiyagari Table II layout: rows ρ, columns σ, entries r* (%)."""
@@ -57,6 +80,8 @@ class SweepResult:
 def _batched_solver(labor_sd: float, dtype, kwargs_items=()):
     """Jitted vmapped cell solver, memoized so repeated sweeps (benchmarks,
     resumed runs) hit the jit cache instead of rebuilding the closure.
+    Cached entries (jitted closures) live for the process — call
+    ``_batched_solver.cache_clear()`` to drop them.
 
     Uses the lean bisection (supply carried through the loop state, no
     post-loop re-solve) so the compiled program stays small; wage, demand,
@@ -68,9 +93,34 @@ def _batched_solver(labor_sd: float, dtype, kwargs_items=()):
     def solve_one(crra, rho):
         res = solve_calibration_lean(crra, rho, labor_sd=labor_sd,
                                      dtype=dtype, **model_kwargs)
-        return res.r_star, res.capital, res.labor, res.bisect_iters
+        return (res.r_star, res.capital, res.labor, res.bisect_iters,
+                res.egm_iters, res.dist_iters)
 
     return jax.jit(jax.vmap(solve_one))
+
+
+def _hashable_kwargs(model_kwargs: dict) -> tuple:
+    """Normalize sweep kwargs into an ``lru_cache``-safe key: sequences
+    become tuples, and anything still unhashable gets a clear error instead
+    of ``lru_cache``'s bare TypeError."""
+    items = []
+    for k, v in sorted(model_kwargs.items()):
+        if isinstance(v, (list, np.ndarray)):
+            arr = np.asarray(v)
+            if arr.ndim > 1:
+                raise TypeError(
+                    f"sweep kwarg {k!r} has shape {arr.shape}; only scalars "
+                    "and 1-D sequences can be forwarded to the cell solver")
+            v = tuple(arr.tolist())
+        try:
+            hash(v)
+        except TypeError:
+            raise TypeError(
+                f"sweep kwarg {k!r}={v!r} is not hashable; pass scalars or "
+                "tuples (grids are rebuilt per cell from scalar settings)"
+            ) from None
+        items.append((k, v))
+    return tuple(items)
 
 
 def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
@@ -99,11 +149,10 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
         crra = jnp.asarray(crra, dtype=dtype)
         rho = jnp.asarray(rho, dtype=dtype)
 
-    fn = _batched_solver(sweep.labor_sd, dtype,
-                         tuple(sorted(model_kwargs.items())))
+    fn = _batched_solver(sweep.labor_sd, dtype, _hashable_kwargs(model_kwargs))
     import time
     t0 = time.perf_counter()
-    r, K, L, iters = jax.block_until_ready(fn(crra, rho))
+    r, K, L, iters, egm_it, dist_it = jax.block_until_ready(fn(crra, rho))
     wall = time.perf_counter() - t0
     if timer is not None:
         timer(wall)
@@ -125,4 +174,6 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
         crra=np.asarray(crra)[sl], labor_ar=np.asarray(rho)[sl],
         r_star_pct=r * 100.0, saving_rate_pct=srate * 100.0,
         capital=K, excess=K - demand,
-        bisect_iters=np.asarray(iters)[sl], wall_seconds=wall)
+        bisect_iters=np.asarray(iters)[sl],
+        egm_iters=np.asarray(egm_it)[sl],
+        dist_iters=np.asarray(dist_it)[sl], wall_seconds=wall)

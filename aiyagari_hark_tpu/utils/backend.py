@@ -1,0 +1,133 @@
+"""Coherent backend selection: platform + dtype + matmul precision in one
+entry point (the north-star ``backend={"cpu","tpu"}`` flag, SURVEY.md §5
+"Config / flags").
+
+Round 1 left platform choice to env vars, and both driver artifacts died on
+it (VERDICT.md): the axon TPU tunnel can hang backend *initialization*
+indefinitely, and setting ``JAX_PLATFORMS=cpu`` in the environment hangs the
+interpreter itself (the sitecustomize PJRT registration chokes on it).  The
+working recipe — probe the ambient platform in a throwaway subprocess, then
+pin this process with ``jax.config.update`` — lives here so every entry
+point (facade, bench, reproduce, tests) shares it.
+
+Modes:
+ - ``"cpu"``:  CPU platform, float64 enabled — the oracle configuration
+   every golden/parity number is pinned against.
+ - ``"tpu"``:  requires a live accelerator (probed with a timeout);
+   float32 with HIGHEST-precision matmuls (f32 accumulation on the MXU
+   instead of bf16 passes — needed to hold the 1 bp r* budget).
+ - ``"auto"``: TPU if the probe finds a live accelerator, else CPU.
+
+Call ``select_backend`` before anything touches a jax device.  It is
+idempotent per process for the same mode; switching modes after device use
+only works CPU->CPU (the backend re-initializes lazily after
+``_clear_backends``) — x64 cannot be enabled once arrays exist, so pick the
+mode once at process start.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import NamedTuple, Optional
+
+
+class BackendInfo(NamedTuple):
+    """Resolved backend: the platform jax reports, the working dtype every
+    model array should use, and whether x64 is on."""
+
+    name: str          # jax.default_backend() after selection
+    dtype: object      # jnp.float64 (cpu oracle) or jnp.float32
+    x64: bool
+
+    @property
+    def is_oracle(self) -> bool:
+        return self.x64
+
+
+def probe_ambient_backend(timeout_s: float = 120.0) -> Optional[str]:
+    """Name of the backend the ambient environment would initialize, probed
+    in a subprocess so a hung TPU tunnel cannot wedge the caller.  None on
+    timeout/failure."""
+    code = "import jax; print('BACKEND=' + jax.default_backend())"
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout_s)
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    for line in out.stdout.splitlines():
+        if line.startswith("BACKEND="):
+            return line.split("=", 1)[1].strip()
+    return None
+
+
+def force_cpu_platform(n_devices: Optional[int] = None) -> None:
+    """Pin this process to the CPU platform (optionally with ``n_devices``
+    virtual devices), dropping an already-initialized backend if necessary.
+    Must run before x64 state matters; see module docstring."""
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+
+    import jax
+    from jax._src import xla_bridge as xb
+
+    if xb.backends_are_initialized():
+        if (jax.default_backend() != "cpu"
+                or (n_devices is not None
+                    and len(jax.devices()) < n_devices)):
+            xb._clear_backends()
+            jax.clear_caches()
+    jax.config.update("jax_platforms", "cpu")
+
+
+_RESOLVED: dict = {}
+
+
+def select_backend(backend: str = "auto",
+                   probe_timeout_s: float = 120.0) -> BackendInfo:
+    """Resolve ``backend`` ∈ {"auto", "cpu", "tpu"} into a live platform +
+    dtype + precision configuration.  Raises RuntimeError for ``"tpu"`` when
+    no accelerator answers the probe.
+
+    Memoized per mode: the subprocess probe (seconds normally, up to the
+    timeout on a hung tunnel) runs at most once per process — repeated
+    ``solve(backend="auto")`` calls are free after the first."""
+    import jax
+    import jax.numpy as jnp
+
+    if backend not in ("auto", "cpu", "tpu"):
+        raise ValueError(f"backend must be 'auto', 'cpu' or 'tpu', "
+                         f"got {backend!r}")
+    if backend in _RESOLVED:
+        return _RESOLVED[backend]
+
+    if backend in ("auto", "tpu"):
+        ambient = probe_ambient_backend(probe_timeout_s)
+        accel = ambient is not None and ambient != "cpu"
+        if backend == "tpu" and not accel:
+            raise RuntimeError(
+                f"backend='tpu' requested but the ambient platform probe "
+                f"returned {ambient!r} (tunnel down or CPU-only host); use "
+                f"backend='auto' to fall back to CPU")
+        if accel:
+            # f32 everywhere, but force full-precision matmul accumulation:
+            # the FOC inversion and log-log regression cannot hold the 1 bp
+            # r* budget through bf16 MXU passes (SURVEY.md §7 "Precision").
+            jax.config.update("jax_default_matmul_precision", "highest")
+            info = BackendInfo(name=jax.default_backend(),
+                               dtype=jnp.float32, x64=False)
+            _RESOLVED[backend] = info
+            return info
+
+    # CPU oracle: force the platform and enable float64.
+    force_cpu_platform()
+    jax.config.update("jax_enable_x64", True)
+    info = BackendInfo(name="cpu", dtype=jnp.float64, x64=True)
+    _RESOLVED[backend] = info
+    return info
