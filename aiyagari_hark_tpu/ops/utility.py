@@ -8,15 +8,28 @@ Closed forms, elementwise, fuse into surrounding XLA computations.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
-def crra_utility(c: jnp.ndarray, crra: float) -> jnp.ndarray:
-    """u(c); log utility at crra == 1 (static Python branch — crra is a
-    compile-time constant, so no lax.cond is needed)."""
-    if crra == 1.0:
-        return jnp.log(c)
-    return c ** (1.0 - crra) / (1.0 - crra)
+def crra_utility(c: jnp.ndarray, crra) -> jnp.ndarray:
+    """u(c); log utility at crra == 1.
+
+    ``crra`` may be a traced scalar (it is a vmapped sweep axis): the
+    branch must then be data-dependent, so both limbs are evaluated and
+    selected with ``jnp.where``.  The power limb is guarded against the
+    crra == 1 pole (division by 1-crra) with the usual double-where.
+    A concrete Python float keeps the old static branch (one limb compiled).
+    """
+    if not isinstance(crra, jax.core.Tracer):
+        crra = float(crra)
+        if crra == 1.0:
+            return jnp.log(c)
+        return c ** (1.0 - crra) / (1.0 - crra)
+    is_log = crra == 1.0
+    safe = jnp.where(is_log, 2.0, crra)          # keep 1-crra away from 0
+    power = c ** (1.0 - safe) / (1.0 - safe)
+    return jnp.where(is_log, jnp.log(c), power)
 
 
 def marginal_utility(c: jnp.ndarray, crra: float) -> jnp.ndarray:

@@ -27,7 +27,12 @@ def initial_panel_sharded(cal: KSCalibration, agent_count: int,
     ``agent_count`` must divide evenly (pad upstream with
     ``mesh.pad_to_multiple`` if not).  The global birth invariants (labor
     states spread evenly, employment at the state's unemployment rate) hold
-    per shard, hence globally.
+    per shard, hence globally — but the *exact-count* employment machinery
+    rounds per shard, so the realized global unemployment rate matches the
+    target only to within n_shards/agent_count.  Keep at least ~100 agents
+    per shard for that rounding bias to stay below other Monte-Carlo noise
+    (tiny per-shard panels, e.g. the 8/shard in ``dryrun_multichip``, are
+    for compile validation, not statistics).
     """
     n_shards = mesh.shape[axis]
     if agent_count % n_shards:

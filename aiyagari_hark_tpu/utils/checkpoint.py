@@ -20,10 +20,12 @@ import numpy as np
 
 def save_pytree(path: str, tree) -> None:
     """Write a pytree of arrays/scalars to ``path`` (npz, atomic rename).
-    Structure is carried by flatten order — load with a matching template."""
-    leaves = jax.tree_util.tree_leaves(tree)
-    arrays = {f"leaf_{i:04d}": np.asarray(leaf)
+    The treedef repr rides along so a load against the wrong template is a
+    hard error, not a silent leaf reinterpretation."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrays = {f"leaf_{i:06d}": np.asarray(leaf)
               for i, leaf in enumerate(leaves)}
+    arrays["__treedef__"] = np.asarray(str(treedef))
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
@@ -39,11 +41,20 @@ def save_pytree(path: str, tree) -> None:
 
 def load_pytree(path: str, like):
     """Read a pytree saved by ``save_pytree`` into the structure of ``like``
-    (same treedef; leaf shapes/dtypes come from the file)."""
+    (validated against the stored treedef; leaf shapes/dtypes come from the
+    file).  Leaf keys are ordered numerically by their index, so the count
+    is unbounded (no lexicographic rollover at 4 digits)."""
     treedef = jax.tree_util.tree_structure(like)
     n = treedef.num_leaves
     with np.load(path) as data:
-        keys = sorted(data.files)
+        stored_def = (str(data["__treedef__"])
+                      if "__treedef__" in data.files else None)
+        keys = sorted((k for k in data.files if k.startswith("leaf_")),
+                      key=lambda k: int(k[5:]))
+        if stored_def is not None and stored_def != str(treedef):
+            raise ValueError(
+                f"checkpoint {path} was written for pytree structure\n  "
+                f"{stored_def}\nbut the template is\n  {treedef}")
         if len(keys) != n:
             raise ValueError(
                 f"checkpoint {path} holds {len(keys)} leaves, template "

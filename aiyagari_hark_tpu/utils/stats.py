@@ -33,11 +33,12 @@ def _sorted_weighted(data, weights, presorted: bool = False):
 
 def get_percentiles(data, weights=None,
                     percentiles=(0.5,), presorted: bool = False):
-    """Weighted empirical quantiles (HARK ``get_percentiles`` semantics:
-    linear interpolation on the cumulative-weight midpoint grid)."""
+    """Weighted empirical quantiles, HARK ``get_percentiles`` semantics:
+    linear interpolation of the sorted data against the plain normalized
+    cumulative weights (no midpoint shift — e.g. [1,2,3,4] at p=0.5 gives
+    2.0, matching HARK, not the midpoint variant's 2.5)."""
     d, w = _sorted_weighted(data, weights, presorted)
-    cum = np.cumsum(w)
-    cum = (cum - 0.5 * w) / cum[-1]
+    cum = np.cumsum(w) / np.sum(w)
     return np.interp(np.asarray(percentiles), cum, d)
 
 
@@ -104,6 +105,25 @@ def histogram_sample(dist_grid, masses) -> Tuple[np.ndarray, np.ndarray]:
     if m.ndim == 2:
         m = m.sum(axis=1)
     return g, m
+
+
+def synthetic_scf_wealth(n: int = 20000,
+                         seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic synthetic stand-in for the SCF wealth sample, so the
+    Lorenz-comparison code path is exercisable without the real data (which
+    the reference gets from HARK's bundled dataset,
+    ``load_SCF_wealth_weights``, ``Aiyagari-HARK.py:303`` — unavailable
+    here: no network, HARK not vendored).
+
+    NOT the real SCF: a lognormal with sigma=1.9, whose Gini (~0.82)
+    matches the well-known top-heaviness of U.S. net worth.  Any distance
+    computed against it is a smoke value, not the reference's 0.9714
+    golden — ``reproduce.py`` labels it accordingly.
+    """
+    rng = np.random.default_rng(seed)
+    wealth = rng.lognormal(mean=0.0, sigma=1.9, size=n)
+    weights = np.ones(n)
+    return wealth, weights
 
 
 def load_scf_wealth_weights(path: Optional[str] = None

@@ -45,6 +45,29 @@ def test_pytree_wrong_template_raises(tmp_path):
         load_pytree(p, {"a": np.ones(3), "b": np.ones(3)})
 
 
+def test_select_backend_cpu_oracle():
+    """backend='cpu' resolves to the x64 CPU oracle coherently (platform +
+    dtype + x64 in one call); bad names are rejected."""
+    from aiyagari_hark_tpu.utils.backend import select_backend
+
+    info = select_backend("cpu")
+    assert info.name == "cpu" and info.x64 and info.is_oracle
+    assert jnp.zeros((), dtype=info.dtype).dtype == jnp.float64
+    with pytest.raises(ValueError):
+        select_backend("gpu")
+
+
+def test_pytree_same_leaf_count_different_structure_raises(tmp_path):
+    """Same leaf count but different treedef must be rejected (the stored
+    treedef guard), not silently reinterpreted."""
+    p = str(tmp_path / "t.npz")
+    save_pytree(p, {"a": np.ones(3), "b": np.ones(2)})
+    with pytest.raises(ValueError, match="structure"):
+        load_pytree(p, {"x": np.ones(3), "y": np.ones(2)})
+    with pytest.raises(ValueError, match="structure"):
+        load_pytree(p, (np.ones(3), np.ones(2)))
+
+
 def test_ks_checkpoint_roundtrip(tmp_path):
     p = str(tmp_path / "ks.npz")
     afunc = AFuncParams(intercept=jnp.array([0.1, 0.2]),

@@ -119,3 +119,26 @@ def test_cfunc_accepts_array_M(solved):
     paired = agent.solution[0].cFunc[0](m, Ms)
     scalar = agent.solution[0].cFunc[0](m, economy.MSS)
     np.testing.assert_allclose(paired, scalar, rtol=1e-6)
+
+
+def test_agent_level_crra_discfac_honored():
+    """CRRA/DiscFac set only on AiyagariType must reach the solver instead of
+    the economy default (VERDICT r1 weak-item 5)."""
+    economy = AiyagariEconomy(tolerance=0.02,
+                              **{**SMALL, "LaborAR": 0.3})
+    economy.verbose = False
+    agent = AiyagariType(LaborStatesNo=5, AgentCount=100, aCount=16,
+                         CRRA=3.0, DiscFac=0.94)
+    cfg = economy._economy_config_for(agent)
+    assert cfg.crra == 3.0
+    assert cfg.disc_fac == 0.94
+    # and the agent-side config agrees
+    acfg = agent.agent_config()
+    assert acfg.crra == 3.0 and acfg.disc_fac == 0.94
+
+
+def test_agent_economy_conflict_raises():
+    economy = AiyagariEconomy(CRRA=1.0, verbose=False)
+    agent = AiyagariType(CRRA=5.0)
+    with pytest.raises(ValueError, match="CRRA"):
+        economy._economy_config_for(agent)

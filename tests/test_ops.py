@@ -160,6 +160,22 @@ def test_crra_roundtrip_and_log_case():
                                np.asarray(c) ** (-2.0) / (-2.0), rtol=1e-12)
 
 
+def test_crra_utility_traced_crra():
+    """crra may be a vmapped sweep axis (VERDICT r1 weak-item 4): the traced
+    path must match the static path, including exactly at the log pole."""
+    import jax
+
+    c = jnp.array([0.5, 1.0, 2.0, 7.3])
+    crras = jnp.array([1.0, 2.0, 3.0, 5.0])
+    traced = jax.vmap(lambda g: crra_utility(c, g))(crras)
+    for i, g in enumerate([1.0, 2.0, 3.0, 5.0]):
+        np.testing.assert_allclose(np.asarray(traced[i]),
+                                   np.asarray(crra_utility(c, g)), rtol=1e-12)
+    # gradient through the pole-guarded branch stays finite
+    grad = jax.grad(lambda g: jnp.sum(crra_utility(c, g)))(jnp.asarray(1.0))
+    assert np.isfinite(float(grad))
+
+
 # ---------------------------------------------------------------- interp
 
 def test_interp1d_matches_numpy_inside():
