@@ -1,0 +1,10 @@
+#!/bin/sh
+# Reproduction entry point (the reference's reproduce.sh:1-4 pins deps and
+# re-executes the notebook; here deps are baked into the image and the
+# driver is a script).  Regenerates the full reference output surface —
+# equilibrium stats, Figures/*.{png,jpg,pdf,svg}, runtime.txt, results.json —
+# and then runs the test suite.
+set -e
+cd "$(dirname "$0")"
+python reproduce.py "$@"
+python -m pytest tests/ -q
