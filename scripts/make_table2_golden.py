@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Generate the committed Table II golden files from the CPU float64 oracle.
+
+Two configurations:
+ - ``table2_golden.json``       — the benchmark configuration (a_count=32,
+   dist_count=500), the canonical 12-cell table this framework publishes
+   against Aiyagari's Table II (regenerate: ~5 min on one CPU core).
+ - ``table2_golden_test.json``  — a reduced configuration solved by
+   ``tests/test_table2.py`` on every run (~1 min), so any drift in the
+   equilibrium pipeline fails the suite deterministically.
+
+Both runs are deterministic (no Monte Carlo anywhere in the bisection path:
+Tauchen discretization + EGM + distribution iteration), so the goldens are
+exact to solver tolerance, not statistical.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+TEST_KWARGS = dict(a_count=24, dist_count=150)
+FULL_KWARGS = dict(a_count=32, dist_count=500)
+
+
+def run(kwargs):
+    import jax.numpy as jnp
+
+    from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+    from aiyagari_hark_tpu.utils.config import SweepConfig
+
+    res = run_table2_sweep(SweepConfig(), dtype=jnp.float64, **kwargs)
+    return {
+        "config": {k: v for k, v in kwargs.items()},
+        "dtype": "float64",
+        "crra": [float(x) for x in res.crra],
+        "labor_ar": [float(x) for x in res.labor_ar],
+        "r_star_pct": [float(x) for x in res.r_star_pct],
+        "saving_rate_pct": [float(x) for x in res.saving_rate_pct],
+        "capital": [float(x) for x in res.capital],
+        "table": res.table(),
+    }
+
+
+def main():
+    from aiyagari_hark_tpu.utils.backend import select_backend
+
+    select_backend("cpu")
+    out_dir = os.path.join(os.path.dirname(__file__), "..", "tests", "data")
+    os.makedirs(out_dir, exist_ok=True)
+    for name, kwargs in (("table2_golden_test.json", TEST_KWARGS),
+                         ("table2_golden.json", FULL_KWARGS)):
+        payload = run(kwargs)
+        path = os.path.join(out_dir, name)
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=2)
+        print(f"wrote {path}\n{payload['table']}")
+
+
+if __name__ == "__main__":
+    main()
