@@ -18,4 +18,16 @@ from .facade import (  # noqa: F401
     init_aiyagari_agents,
     init_aiyagari_economy,
 )
+from .models.equilibrium import (  # noqa: F401
+    solve_bisection_equilibrium,
+    solve_calibration,
+    solve_calibration_lean,
+)
+from .models.portfolio import (  # noqa: F401
+    build_portfolio_model,
+    solve_portfolio_equilibrium,
+    solve_portfolio_household,
+)
+from .parallel.sweep import SweepResult, run_table2_sweep  # noqa: F401
+from .utils.backend import BackendInfo, select_backend  # noqa: F401
 from .utils.config import AgentConfig, EconomyConfig, SweepConfig  # noqa: F401

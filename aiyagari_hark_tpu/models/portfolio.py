@@ -38,7 +38,12 @@ from ..ops.markov import (
     tauchen_labor_process,
 )
 from ..ops.utility import inverse_marginal_utility, marginal_utility
-from .household import CONSTRAINT_EPS, HouseholdPolicy
+from .household import (
+    CONSTRAINT_EPS,
+    HouseholdPolicy,
+    accelerated_distribution_fixed_point,
+    initial_distribution,
+)
 
 
 class PortfolioModel(NamedTuple):
@@ -192,10 +197,13 @@ def egm_step_portfolio(policy: PortfolioPolicy, r_free, wage,
 
 
 def solve_portfolio_household(r_free, wage, model: PortfolioModel, disc_fac,
-                              crra, tol: float = 1e-6, max_iter: int = 3000):
+                              crra, tol: float = 1e-6, max_iter: int = 3000,
+                              init_policy: PortfolioPolicy | None = None):
     """Infinite-horizon fixed point (sup-norm on consumption knots).
-    Returns (PortfolioPolicy, n_iter, final_diff)."""
-    p0 = initial_portfolio_policy(model)
+    Returns (PortfolioPolicy, n_iter, final_diff).  ``init_policy``
+    warm-starts the iteration (previous bisection midpoint's policy)."""
+    p0 = (initial_portfolio_policy(model) if init_policy is None
+          else init_policy)
     big = jnp.asarray(jnp.inf, dtype=p0.c_knots.dtype)
 
     def cond(state):
@@ -329,28 +337,17 @@ def _push_forward_portfolio(dist, trans: PortfolioTransition,
 
 def stationary_portfolio_wealth(policy: PortfolioPolicy, r_free, wage,
                                 model: PortfolioModel, tol: float = 1e-10,
-                                max_iter: int = 20000):
+                                max_iter: int = 20000, init_dist=None,
+                                accel_every: int = 64):
     """Stationary joint distribution over (end-of-period assets, labor
-    state), [D, N].  Returns (dist, n_iter, final_diff)."""
+    state), [D, N].  Returns (dist, n_iter, final_diff).  Uses the shared
+    Aitken-accelerated iteration (``accelerated_distribution_fixed_point``;
+    ``accel_every=0`` disables extrapolation); ``init_dist`` warm-starts."""
     trans = portfolio_wealth_transition(policy, r_free, wage, model)
-    d_size, n = model.dist_grid.shape[0], model.labor_levels.shape[0]
-    dist0 = (jnp.zeros((d_size, n), dtype=model.dist_grid.dtype)
-             .at[0, :].set(model.labor_stationary))
-    big = jnp.asarray(jnp.inf, dtype=dist0.dtype)
-
-    def cond(state):
-        _, diff, it = state
-        return (diff > tol) & (it < max_iter)
-
-    def body(state):
-        dist, _, it = state
-        new = _push_forward_portfolio(dist, trans, model)
-        diff = jnp.max(jnp.abs(new - dist))
-        return new, diff, it + 1
-
-    dist, diff, it = jax.lax.while_loop(cond, body,
-                                        (dist0, big, jnp.asarray(0)))
-    return dist, it, diff
+    dist0 = initial_distribution(model) if init_dist is None else init_dist
+    return accelerated_distribution_fixed_point(
+        lambda d: _push_forward_portfolio(d, trans, model),
+        dist0, tol, max_iter, accel_every)
 
 
 class PortfolioEquilibrium(NamedTuple):
@@ -369,9 +366,11 @@ class PortfolioEquilibrium(NamedTuple):
 
 
 def _portfolio_supply(r, base: PortfolioModel, eps_draws, premium, disc_fac,
-                      crra, cap_share, depr_fac, prod, egm_tol, dist_tol):
+                      crra, cap_share, depr_fac, prod, egm_tol, dist_tol,
+                      init_policy=None, init_dist=None):
     """Household side at candidate rate r: returns (K_supply, total assets,
-    policy, distribution, model-at-r, r_free)."""
+    policy, distribution, model-at-r, r_free).  ``init_policy``/``init_dist``
+    warm-start the inner fixed points from the previous midpoint."""
     from . import firm
 
     r_free = 1.0 + r - premium
@@ -379,9 +378,11 @@ def _portfolio_supply(r, base: PortfolioModel, eps_draws, premium, disc_fac,
     k_to_l = firm.k_to_l_from_r(r, cap_share, depr_fac, prod)
     wage = firm.wage_rate(k_to_l, cap_share, prod)
     policy, _, _ = solve_portfolio_household(r_free, wage, model, disc_fac,
-                                             crra, tol=egm_tol)
+                                             crra, tol=egm_tol,
+                                             init_policy=init_policy)
     dist, _, _ = stationary_portfolio_wealth(policy, r_free, wage, model,
-                                             tol=dist_tol)
+                                             tol=dist_tol,
+                                             init_dist=init_dist)
     omega = _share_on_dist_grid(policy, model)
     x = model.dist_grid
     total = jnp.sum(dist * x[:, None])
@@ -435,24 +436,30 @@ def solve_portfolio_equilibrium(model: PortfolioModel, disc_fac, crra,
     r_hi = jnp.asarray(r_hi_f, dtype=dtype)
     r_lo = jnp.asarray(r_lo_f, dtype=dtype)
 
+    # warm-start carry across midpoints (same pattern as the single-asset
+    # lean solver: nearby r -> nearby fixed points)
+    p0 = initial_portfolio_policy(model)
+    d0 = initial_distribution(model)
+
     def cond(state):
-        lo, hi, it = state
+        lo, hi, it, _, _ = state
         return ((hi - lo) > r_tol) & (it < max_bisect)
 
     def body(state):
-        lo, hi, it = state
+        lo, hi, it, policy, dist = state
         mid = 0.5 * (lo + hi)
-        risky, *_ = _portfolio_supply(mid, model, eps_draws, premium,
-                                      disc_fac, crra, cap_share, depr_fac,
-                                      prod, egm_tol, dist_tol)
+        risky, _, pol, dst, *_ = _portfolio_supply(
+            mid, model, eps_draws, premium, disc_fac, crra, cap_share,
+            depr_fac, prod, egm_tol, dist_tol,
+            init_policy=policy, init_dist=dist)
         demand = firm.k_to_l_from_r(mid, cap_share, depr_fac, prod) * labor
         ex = risky - demand
         lo = jnp.where(ex > 0, lo, mid)
         hi = jnp.where(ex > 0, mid, hi)
-        return lo, hi, it + 1
+        return lo, hi, it + 1, pol, dst
 
-    lo, hi, iters = jax.lax.while_loop(cond, body,
-                                       (r_lo, r_hi, jnp.asarray(0)))
+    lo, hi, iters, _, _ = jax.lax.while_loop(
+        cond, body, (r_lo, r_hi, jnp.asarray(0), p0, d0))
     r_star = 0.5 * (lo + hi)
     risky, total, policy, dist, _, r_free, wage, k_to_l = _portfolio_supply(
         r_star, model, eps_draws, premium, disc_fac, crra, cap_share,

@@ -1,0 +1,86 @@
+"""Pallas TPU kernels for the framework's hottest loop.
+
+The single hottest computation (bench phase breakdown, ``bench.py``) is the
+stationary-wealth fixed point: thousands of sequential push-forward steps of
+a [D, N] histogram.  Under a plain XLA ``while_loop`` every iteration
+round-trips the distribution (and, in the dense formulation, re-reads the
+[N, D, D] lottery operator) through HBM.  This kernel runs the ENTIRE fixed
+point inside one ``pallas_call``: the operator ``S`` (~7 MB at the benchmark
+config D=500, N=7, f32 — comfortably inside the ~16 MB VMEM budget), the
+labor-mixing matrix ``P``, and the iterate all stay VMEM-resident, so each
+step is two on-chip matmuls (batched matvec on the MXU + the [D,N]x[N,N]
+mix) with zero HBM traffic.
+
+Correctness shares the exact same iteration code as the XLA path
+(``models.household.accelerated_distribution_fixed_point`` — including the
+Aitken extrapolation and its certification semantics), so the kernel cannot
+drift from the reference implementation; only the memory placement differs.
+
+CPU fallback / tests run the same kernel with ``interpret=True`` (the
+Pallas interpreter), asserting bit-level agreement with the XLA dense path.
+Reference for the computation being accelerated: the reference's per-period
+``np.searchsorted`` + Python-loop simulation (``Aiyagari_Support.py``
+get_shocks/get_states hot loop #2, SURVEY.md §3.3), replaced here by Young's
+deterministic method in operator form.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _fixed_point_kernel(S_ref, P_ref, d0_ref, out_ref, stats_ref, *,
+                        tol, max_iter, accel_every):
+    """Whole stationary fixed point on VMEM-resident operands."""
+    from ..models.household import accelerated_distribution_fixed_point
+
+    S = S_ref[:]          # [N, D, D] lottery operator
+    P = P_ref[:]          # [N, N] labor mixing
+    d0 = d0_ref[:]        # [D, N] initial distribution
+
+    def push(dist):
+        moved = jnp.einsum("ndk,kn->dn", S, dist,
+                           precision=jax.lax.Precision.HIGHEST)
+        return jnp.matmul(moved, P, precision=jax.lax.Precision.HIGHEST)
+
+    dist, it, diff = accelerated_distribution_fixed_point(
+        push, d0, tol, max_iter, accel_every)
+    out_ref[:] = dist
+    stats_ref[0, 0] = it.astype(d0.dtype)
+    stats_ref[0, 1] = diff.astype(d0.dtype)
+
+
+def stationary_dense_pallas(S: jnp.ndarray, P: jnp.ndarray,
+                            dist0: jnp.ndarray, tol: float,
+                            max_iter: int = 20000, accel_every: int = 64,
+                            interpret: bool | None = None):
+    """Run the stationary-distribution fixed point as ONE Pallas kernel.
+
+    Args: ``S`` [N, D, D] from ``models.household.dense_wealth_operator``,
+    ``P`` [N, N] labor transition, ``dist0`` [D, N].  Returns
+    (dist [D, N], n_iter, final_diff) — same contract as
+    ``accelerated_distribution_fixed_point``.
+
+    ``interpret``: None = interpret everywhere except a real TPU backend
+    (the interpreter is the correctness path on CPU/GPU; the compiled
+    Mosaic kernel is the TPU path).
+    """
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    n, d, _ = S.shape
+    kernel = functools.partial(_fixed_point_kernel, tol=tol,
+                               max_iter=max_iter, accel_every=accel_every)
+    call = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((d, n), dist0.dtype),
+                   jax.ShapeDtypeStruct((1, 2), dist0.dtype)),
+        interpret=interpret,
+    )
+    dist, stats = call(S, P, dist0)
+    return dist, stats[0, 0].astype(jnp.int32), stats[0, 1]

@@ -172,3 +172,22 @@ class SweepConfig:
 
     def cells(self):
         return [(s, r) for s in self.crra_values for r in self.rho_values]
+
+
+# -- named benchmark configurations (BASELINE.json "configs") ---------------
+
+def baseline_cell_kwargs() -> dict:
+    """BASELINE.json config 1 — "Baseline Aiyagari: sigma=3, rho=0.6,
+    7-state Tauchen, 100-pt asset grid": (crra, labor_ar) plus solver
+    kwargs for ``models.equilibrium.solve_calibration``."""
+    return dict(crra=3.0, labor_ar=0.6, labor_states=7, a_count=100,
+                dist_count=500)
+
+
+def fine_grid_kwargs() -> dict:
+    """BASELINE.json config 2 — "Fine-grid baseline: 1000-pt asset grid,
+    15-state income Markov".  A pure shape change for the N-generic batched
+    solver (the reference hard-codes 7 states everywhere, SURVEY.md
+    §3.6-2, and could not run this)."""
+    return dict(crra=3.0, labor_ar=0.6, labor_states=15, a_count=1000,
+                dist_count=1000)

@@ -114,24 +114,30 @@ def main():
     # The axon TPU tunnel intermittently faults on first execution of a
     # freshly compiled program; retry with cleared caches, and fall back to
     # CPU for the final attempt so the round always records a number.
+    # Attempt 2 pins dist_method="scatter" so a Pallas-kernel compile
+    # problem on an accelerator cannot cost the accelerator number.
     attempts = 4
     res = None
     backend = "unknown"
     n_devices = 0
     for attempt in range(attempts):
+        kwargs = dict(SWEEP_KWARGS)
+        if attempt == 1:
+            kwargs["dist_method"] = "scatter"
         try:
             backend = jax.default_backend()   # inside the loop: init may fail
             n_devices = len(jax.devices())
             print(f"[bench] attempt {attempt + 1}/{attempts}: "
-                  f"backend={backend} devices={n_devices}", file=sys.stderr)
+                  f"backend={backend} devices={n_devices} "
+                  f"kwargs={kwargs}", file=sys.stderr)
             # compile_s must describe the backend this attempt runs on, not
             # accumulate failed attempts on a different backend
             timer.seconds.pop("compile", None)
             timer.counts.pop("compile", None)
             with timer.phase("compile"):
-                run_table2_sweep(sweep, **SWEEP_KWARGS)   # compile + warm-up
+                run_table2_sweep(sweep, **kwargs)   # compile + warm-up
             with timer.phase("sweep"), device_trace(trace_dir):
-                res = run_table2_sweep(sweep, **SWEEP_KWARGS)  # timed, cached
+                res = run_table2_sweep(sweep, **kwargs)  # timed, cached
             break
         except Exception as e:   # noqa: BLE001 — device faults surface as
             # JaxRuntimeError; anything else is equally fatal for a bench run

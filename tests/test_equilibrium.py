@@ -77,3 +77,27 @@ def test_vmap_over_cells_matches_serial():
         ri = float(jax.jit(
             lambda c, r: solve_calibration(c, r, dist_count=200).r_star)(ci, rhoi))
         np.testing.assert_allclose(rb[i], ri, atol=1e-9)
+
+
+def test_named_benchmark_configs():
+    """BASELINE.json configs 1-2 run through the N-generic solver: the
+    100-pt-grid baseline cell and the fine-grid 1000-pt x 15-state cell
+    the reference's hard-coded N=7 machinery could never express
+    (SURVEY.md §3.6-2)."""
+    from aiyagari_hark_tpu.models.equilibrium import solve_calibration_lean
+    from aiyagari_hark_tpu.utils.config import (
+        baseline_cell_kwargs,
+        fine_grid_kwargs,
+    )
+
+    results = {}
+    for name, kw in (("baseline", baseline_cell_kwargs()),
+                     ("fine", fine_grid_kwargs())):
+        crra, rho = kw.pop("crra"), kw.pop("labor_ar")
+        res = jax.jit(lambda c, r, kw=kw: solve_calibration_lean(
+            c, r, dtype=jnp.float32, **kw))(crra, rho)
+        r_pct = float(res.r_star) * 100.0
+        assert 3.0 < r_pct < 4.17, (name, r_pct)
+        results[name] = r_pct
+    # same economy at two resolutions: answers must be close, not equal
+    assert abs(results["baseline"] - results["fine"]) < 0.1
