@@ -1,0 +1,93 @@
+"""Sanitizers: NaN/Inf detection inside jitted kernels and invariant checks.
+
+The reference has no sanitizers (single-threaded NumPy; SURVEY.md §5 "Race
+detection / sanitizers").  The TPU-native equivalents here:
+
+ - ``checked_call``: run any jitted computation under ``jax.experimental
+   .checkify`` float checks, so a NaN/Inf produced INSIDE a
+   ``lax.while_loop``/``scan`` (where ``jax_debug_nans`` cannot look)
+   surfaces as a Python exception naming the failing primitive instead of
+   silently propagating into the fixed point.
+ - ``nan_guard``: a context manager toggling ``jax_debug_nans`` for
+   eager/debug runs of host-side code.
+ - ``validate_policy`` / ``validate_distribution``: host-side invariant
+   checks (finite, monotone knots, positive consumption; mass one,
+   non-negative) for use at phase boundaries — cheap enough to leave on in
+   drivers, precise enough to localize corruption to a phase.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+
+def checked_call(fn, *args, **kwargs):
+    """Execute ``fn(*args, **kwargs)`` under checkify float checks and
+    throw on any NaN/Inf/div-by-zero generated anywhere inside — including
+    within ``lax.while_loop`` bodies, which ``jax_debug_nans`` cannot
+    instrument.  Returns ``fn``'s outputs unchanged on success.
+
+    Debug tool: the checkify transform blocks some fusions, so expect a
+    slowdown; use on failing configurations, not in production runs."""
+    import jax
+    from jax.experimental import checkify
+
+    checked = checkify.checkify(
+        fn, errors=checkify.float_checks | checkify.user_checks)
+    # args flow through jit as traced arguments (not baked-in constants),
+    # so repeated debug calls on different data reuse the compilation
+    err, out = jax.jit(checked)(*args, **kwargs)
+    err.throw()
+    return out
+
+
+@contextlib.contextmanager
+def nan_guard():
+    """Enable ``jax_debug_nans`` within the block (eager/debuggable code
+    paths; for jitted fixed-point loops use ``checked_call``)."""
+    import jax
+
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def validate_policy(policy, name: str = "policy") -> None:
+    """Host-side invariants of a consumption policy (single-asset
+    ``HouseholdPolicy``, KS ``KSPolicy`` per state, or the consumption part
+    of a ``PortfolioPolicy``): finite knots, strictly increasing endogenous
+    m-knots (EGM output must be sortable), positive consumption."""
+    m = np.asarray(policy.m_knots)
+    c = np.asarray(policy.c_knots)
+    if not np.isfinite(m).all() or not np.isfinite(c).all():
+        raise ValueError(f"{name}: non-finite knots "
+                         f"(m finite={np.isfinite(m).all()}, "
+                         f"c finite={np.isfinite(c).all()})")
+    if not (c > 0).all():
+        raise ValueError(f"{name}: non-positive consumption knots "
+                         f"(min={c.min()})")
+    dm = np.diff(m, axis=-1)
+    if not (dm > 0).all():
+        bad = int((dm <= 0).sum())
+        raise ValueError(f"{name}: {bad} non-increasing m-knot segments — "
+                         f"EGM grid not sortable (crossing policy update)")
+
+
+def validate_distribution(dist, name: str = "distribution",
+                          atol: float = 1e-8) -> None:
+    """Host-side invariants of a wealth histogram: non-negative, total mass
+    one (the lottery scatter conserves mass exactly; violation means a
+    corrupted transition or an unnormalized extrapolation)."""
+    d = np.asarray(dist)
+    if not np.isfinite(d).all():
+        raise ValueError(f"{name}: non-finite mass entries")
+    if (d < -atol).any():
+        raise ValueError(f"{name}: negative mass (min={d.min()})")
+    total = float(d.sum())
+    if abs(total - 1.0) > atol:
+        raise ValueError(f"{name}: total mass {total} != 1")

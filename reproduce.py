@@ -116,6 +116,8 @@ def main(argv=None):
     solve_minutes = (time.time() - t0) / 60.0
     print(f"Solving the Aiyagari model took {solve_minutes:.3f} minutes "
           f"(reference: 27.12 minutes). converged={sol.converged}")
+    from aiyagari_hark_tpu.utils.debug import validate_policy
+    validate_policy(sol.policy, "solved KS policy")   # sanitizer boundary
 
     # -- equilibrium stats (cell 20 / Aiyagari-HARK.py:257-258)
     depr = econ_dict["DeprFac"]

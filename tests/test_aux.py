@@ -131,3 +131,54 @@ def test_records_jsonl_roundtrip(tmp_path):
     assert out[1]["distance"] == 0.1
     with open(p) as f:
         assert len(json.loads(f.readline())) == 7
+
+
+def test_checked_call_catches_nan_inside_while_loop():
+    """checkify float checks see NaN born inside a lax.while_loop, where
+    jax_debug_nans cannot instrument (SURVEY.md §5 sanitizers row)."""
+    import jax
+    from aiyagari_hark_tpu.utils.debug import checked_call
+
+    def bad_fixed_point(x0):
+        def body(state):
+            x, it = state
+            # log of a negative number appears at iteration 3
+            return jnp.log(x - 1.5), it + 1
+
+        def cond(state):
+            return state[1] < 5
+
+        return jax.lax.while_loop(cond, body, (x0, 0))[0]
+
+    with pytest.raises(Exception, match="nan"):
+        checked_call(bad_fixed_point, jnp.asarray(2.0))
+    # clean computations pass through unchanged
+    out = checked_call(lambda a: jnp.sqrt(a) * 2.0, jnp.asarray(4.0))
+    assert float(out) == pytest.approx(4.0)
+
+
+def test_validators_catch_corruption():
+    from aiyagari_hark_tpu.models.household import (
+        build_simple_model,
+        initial_distribution,
+        initial_policy,
+    )
+    from aiyagari_hark_tpu.utils.debug import (
+        validate_distribution,
+        validate_policy,
+    )
+
+    m = build_simple_model(labor_states=3, a_count=8, dist_count=16)
+    pol = initial_policy(m)
+    validate_policy(pol)                      # sane -> passes
+    bad = pol._replace(c_knots=pol.c_knots.at[0, 3].set(jnp.nan))
+    with pytest.raises(ValueError, match="non-finite"):
+        validate_policy(bad)
+    crossed = pol._replace(m_knots=pol.m_knots.at[0, 3].set(0.0))
+    with pytest.raises(ValueError, match="non-increasing"):
+        validate_policy(crossed)
+
+    dist = initial_distribution(m)
+    validate_distribution(dist)
+    with pytest.raises(ValueError, match="mass"):
+        validate_distribution(dist * 0.5)
