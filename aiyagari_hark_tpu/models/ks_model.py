@@ -211,13 +211,18 @@ def egm_step_ks(policy: KSPolicy, pre: PrecomputedArrays,
 
 
 def solve_ks_household(afunc: AFuncParams, cal: KSCalibration,
-                       tol: float = 1e-6, max_iter: int = 2000):
+                       tol: float = 1e-6, max_iter: int = 2000,
+                       init_policy: KSPolicy | None = None):
     """Infinite-horizon fixed point of the 4N-state EGM step under the given
     perceived aggregate law.  Sup-norm convergence on consumption knots (the
     array analog of HARK's solution distance).  Returns (policy, iters, diff).
+
+    ``init_policy`` warm-starts the backward iteration — the KS outer loop
+    passes the previous outer iteration's policy (the perceived law moves a
+    little per damped update, so the fixed points are close).
     """
     pre = precompute(afunc, cal)
-    p0 = initial_ks_policy(cal)
+    p0 = initial_ks_policy(cal) if init_policy is None else init_policy
     big = jnp.asarray(jnp.inf, dtype=p0.c_knots.dtype)
 
     def cond(state):

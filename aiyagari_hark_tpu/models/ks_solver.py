@@ -104,7 +104,9 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
                      dtype=None, egm_tol: float = 1e-6,
                      resample_each_iteration: bool = False,
                      mrkv_hist=None, callback=None,
-                     checkpoint_path=None, timer=None) -> KSSolution:
+                     checkpoint_path=None, timer=None,
+                     sim_method: str = "panel",
+                     dist_count: int = 500) -> KSSolution:
     """Full reference-parity solve: the Krusell-Smith fixed point over the
     aggregate saving rule.
 
@@ -120,6 +122,12 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
     ``seed``, resume from it instead of the config's initial guesses.
     ``timer``: an optional ``utils.timing.PhaseTimer`` accumulating
     solve/simulate/regress phases.
+
+    ``sim_method``: "panel" (reference parity — ``agent_count`` Monte-Carlo
+    agents) or "distribution" (deterministic: push a ``dist_count``-point
+    wealth histogram through the same per-period operator — zero sampling
+    noise in the regression inputs; ``final_panel`` is then the final
+    ``DistPanelState`` instead of a ``PanelState``).
     """
     from ..utils.checkpoint import (
         config_fingerprint,
@@ -141,11 +149,32 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
                                             econ.act_T, k_hist)
     else:
         mrkv_hist = jnp.asarray(mrkv_hist)
-    init = initial_panel(cal, agent.agent_count, econ.mrkv_now_init, k_birth)
-
-    solve_hh = jax.jit(lambda af: solve_ks_household(af, cal, tol=egm_tol))
-    run_panel = jax.jit(lambda pol, k: simulate_panel(pol, cal, mrkv_hist,
-                                                      init, k))
+    # Warm start: each outer iteration's EGM fixed point seeds the next one
+    # (the damped rule update moves the perceived law only a little, so the
+    # household fixed points are close — same trick as the bisection carry).
+    from .ks_model import initial_ks_policy
+    solve_hh = jax.jit(lambda af, p0: solve_ks_household(
+        af, cal, tol=egm_tol, init_policy=p0))
+    policy_seed = initial_ks_policy(cal)
+    if sim_method == "panel":
+        init = initial_panel(cal, agent.agent_count, econ.mrkv_now_init,
+                             k_birth)
+        run_panel = jax.jit(lambda pol, k: simulate_panel(
+            pol, cal, mrkv_hist, init, k))
+    elif sim_method == "distribution":
+        from .simulate import (
+            initial_distribution_panel,
+            make_sim_dist_grid,
+            simulate_distribution_history,
+        )
+        dist_grid = make_sim_dist_grid(cal, dist_count)
+        init = initial_distribution_panel(cal, dist_grid,
+                                          econ.mrkv_now_init)
+        run_panel = jax.jit(lambda pol, k: simulate_distribution_history(
+            pol, cal, mrkv_hist, dist_grid, init))   # key unused
+    else:
+        raise ValueError(f"sim_method must be 'panel' or 'distribution', "
+                         f"got {sim_method!r}")
     update = jax.jit(lambda hist, af: calc_afunc_update(
         hist, mrkv_hist, af, econ.t_discard, econ.damping_fac))
 
@@ -179,7 +208,8 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
         # idempotent reload: rebuild the policy/history the checkpoint does
         # not carry, but leave the converged rule (and the file) untouched
         with timer.phase("solve"):
-            policy, _, _ = jax.block_until_ready(solve_hh(afunc))
+            policy, _, _ = jax.block_until_ready(solve_hh(afunc,
+                                                          policy_seed))
         with timer.phase("simulate"):
             history, final_panel = jax.block_until_ready(
                 run_panel(policy, k_panel))
@@ -196,7 +226,9 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
     for it in range(it_start, econ.max_loops):
         t0 = time.time()
         with timer.phase("solve"):
-            policy, egm_iters, _ = jax.block_until_ready(solve_hh(afunc))
+            policy, egm_iters, _ = jax.block_until_ready(
+                solve_hh(afunc, policy_seed))
+            policy_seed = policy
         k_it = jax.random.fold_in(k_panel, it) if resample_each_iteration \
             else k_panel
         with timer.phase("simulate"):

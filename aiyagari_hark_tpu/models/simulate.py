@@ -185,3 +185,141 @@ def simulate_panel(policy: KSPolicy, cal: KSCalibration, mrkv_hist: jnp.ndarray,
     final, (mrkv, A_prev, M_now, urate) = jax.lax.scan(
         step, init, (mrkv_hist, keys))
     return PanelHistory(mrkv=mrkv, A_prev=A_prev, M_now=M_now, urate=urate), final
+
+
+# --------------------------------------------------------------------------
+# Deterministic distribution-iteration simulator (SURVEY.md §7 step 4): push
+# a wealth histogram through the policy + transition operator instead of
+# sampling a 350-agent panel.  Same per-period timing and mill as
+# ``simulate_panel``, zero Monte-Carlo noise — the 1 bp r* equivalence
+# budget cannot be met through MC noise (SURVEY.md §7 "Hard parts"), and
+# the reference's small panel is the dominant noise source.
+# --------------------------------------------------------------------------
+
+
+class DistPanelState(NamedTuple):
+    """Histogram analog of ``PanelState``: mass over (end-of-period assets,
+    labor state, employment status)."""
+
+    dist: jnp.ndarray        # [D, N, 2]
+    M_now: jnp.ndarray
+    R_now: jnp.ndarray
+    W_now: jnp.ndarray
+    mrkv: jnp.ndarray
+
+
+def make_sim_dist_grid(cal: KSCalibration, dist_count: int = 500,
+                       top_factor: float = 2.0) -> jnp.ndarray:
+    """Histogram support for the simulator: 0 (borrowing limit) then an
+    exp-mult grid up to ``top_factor`` x the policy grid's top, so the
+    ergodic right tail is not clipped at the solution grid boundary."""
+    from ..ops.grids import make_grid_exp_mult
+
+    inner = make_grid_exp_mult(1e-3, top_factor * float(cal.a_grid[-1]),
+                               dist_count - 1, 2, dtype=cal.a_grid.dtype)
+    return jnp.concatenate([jnp.zeros((1,), dtype=inner.dtype), inner])
+
+
+def initial_distribution_panel(cal: KSCalibration, dist_grid: jnp.ndarray,
+                               mrkv_init: int) -> DistPanelState:
+    """Histogram analog of ``initial_panel``: all mass at the steady-state
+    capital (two-point lottery onto the grid), labor states uniform,
+    employment at the initial aggregate state's unemployment rate."""
+    from ..ops.interp import locate_in_grid
+
+    n = cal.labor_levels.shape[0]
+    ss = cal.steady_state
+    urate = cal.urate_by_agg[mrkv_init]
+    idx, w = locate_in_grid(jnp.asarray(ss.K, dtype=dist_grid.dtype),
+                            dist_grid)
+    asset_col = (jnp.zeros((dist_grid.shape[0],), dtype=dist_grid.dtype)
+                 .at[idx].add(1.0 - w).at[idx + 1].add(w))
+    emp_w = jnp.stack([urate, 1.0 - urate]).astype(dist_grid.dtype)
+    dist = asset_col[:, None, None] * (1.0 / n) * emp_w[None, None, :]
+    dist = jnp.broadcast_to(dist, (dist_grid.shape[0], n, 2))
+    return DistPanelState(
+        dist=dist, M_now=ss.M.astype(dist_grid.dtype),
+        R_now=ss.R.astype(dist_grid.dtype),
+        W_now=ss.W.astype(dist_grid.dtype), mrkv=jnp.asarray(mrkv_init))
+
+
+def simulate_distribution_history(policy: KSPolicy, cal: KSCalibration,
+                                  mrkv_hist: jnp.ndarray,
+                                  dist_grid: jnp.ndarray,
+                                  init: DistPanelState | None = None):
+    """Run the full history by pushing the histogram through each period.
+
+    Mirrors ``simulate_panel`` step for step — labor mixing (Tauchen row
+    mix), conditional employment flows (expected mass instead of
+    exact-count draws), consumption at state index ``4 ls + 2 z_prev + e``,
+    the same mill — but deterministically: no keys, no sampling noise.
+    Aggregates are exact expectations; the two-point lottery preserves the
+    mean, so ``A_prev`` equals the pre-scatter expectation exactly.
+    Returns the same ``(PanelHistory, final state)`` contract.
+    """
+    from ..ops.interp import eval_policy_agents, locate_in_grid
+
+    if init is None:
+        init = initial_distribution_panel(cal, dist_grid,
+                                          int(mrkv_hist[0]))
+    d_size, n = dist_grid.shape[0], cal.labor_levels.shape[0]
+    lbr = cal.lbr_ind
+
+    def step(state: DistPanelState, z_t):
+        # --- labor transition (categorical draw -> row mix)
+        dist_l = jnp.einsum("dne,nm->dme", state.dist,
+                            cal.tauchen_transition,
+                            precision=jax.lax.Precision.HIGHEST)
+        # --- employment flows conditional on the aggregate move
+        p_agg = cal.agg_transition[state.mrkv, z_t]
+        p_u_to_e = cal.empl_transition[2 * state.mrkv + 0,
+                                       2 * z_t + 1] / p_agg
+        p_e_to_u = cal.empl_transition[2 * state.mrkv + 1,
+                                       2 * z_t + 0] / p_agg
+        unemp = dist_l[:, :, 0]
+        emp = dist_l[:, :, 1]
+        new_unemp = unemp * (1.0 - p_u_to_e) + emp * p_e_to_u
+        new_emp = emp * (1.0 - p_e_to_u) + unemp * p_u_to_e
+        dist_le = jnp.stack([new_unemp, new_emp], axis=-1)   # [D, N, 2]
+        # --- resources and consumption (same state index as the panel)
+        eff = cal.labor_levels[None, :, None] * jnp.ones((1, 1, 2))
+        if cal.ks_employment:
+            eff = eff * jnp.asarray([0.0, 1.0])[None, None, :]
+        m = state.R_now * dist_grid[:, None, None] + state.W_now * eff
+        ls_idx = jnp.broadcast_to(jnp.arange(n)[None, :, None],
+                                  m.shape)
+        e_idx = jnp.broadcast_to(jnp.arange(2)[None, None, :], m.shape)
+        s_idx = 4 * ls_idx + 2 * state.mrkv + e_idx
+        c = eval_policy_agents(m.ravel(), s_idx.ravel(), state.M_now,
+                               cal.m_grid, policy.m_knots, policy.c_knots)
+        a_new = jnp.clip(m - c.reshape(m.shape), 0.0, dist_grid[-1])
+        # --- aggregates (exact expectations, pre-scatter)
+        A_prev = jnp.sum(dist_le * a_new)
+        urate_real = jnp.sum(dist_le[:, :, 0])
+        # --- scatter savings back onto the histogram support
+        idx, w = locate_in_grid(a_new, dist_grid)
+
+        def scatter_col(mass_col, idx_col, w_col):
+            z = jnp.zeros((d_size,), dtype=mass_col.dtype)
+            z = z.at[idx_col].add(mass_col * (1.0 - w_col))
+            z = z.at[idx_col + 1].add(mass_col * w_col)
+            return z
+
+        flat = lambda x: x.reshape(d_size, n * 2)   # noqa: E731
+        new_dist = jax.vmap(scatter_col, in_axes=1, out_axes=1)(
+            flat(dist_le), flat(idx), flat(w)).reshape(d_size, n, 2)
+        # --- mill (identical to simulate_panel)
+        prod = cal.prod_by_agg[z_t]
+        agg_L = (1.0 - cal.urate_by_agg[z_t]) * lbr
+        k_to_l = A_prev / agg_L
+        R_new = firm.interest_factor(k_to_l, cal.cap_share, cal.depr_fac,
+                                     prod)
+        W_new = firm.wage_rate(k_to_l, cal.cap_share, prod)
+        M_new = R_new * A_prev + W_new * agg_L
+        out = (z_t, A_prev, M_new, urate_real)
+        return DistPanelState(dist=new_dist, M_now=M_new, R_now=R_new,
+                              W_now=W_new, mrkv=z_t), out
+
+    final, (mrkv, A_prev, M_now, urate) = jax.lax.scan(step, init, mrkv_hist)
+    return PanelHistory(mrkv=mrkv, A_prev=A_prev, M_now=M_now,
+                        urate=urate), final
