@@ -44,10 +44,19 @@ def calc_afunc_update(history: PanelHistory, mrkv_hist: jnp.ndarray,
                       afunc: AFuncParams, t_discard: int, damping: float):
     """New saving-rule parameters from a simulated history (``calc_AFunc``):
     per aggregate state, OLS of log A_t on log M_{t-1}, then a damped merge
-    with the previous parameters.  Returns (new_params, r_squared[2])."""
-    log_a = jnp.log(history.A_prev[t_discard:])
-    log_m = jnp.log(history.M_now[t_discard - 1:-1])
-    states = mrkv_hist[t_discard - 1:-1]
+    with the previous parameters.  Returns (new_params, r_squared[2]).
+
+    ``history`` arrays may carry a leading fan axis ``[F, T]`` (the
+    deterministic initial-condition fan, ``initial_distribution_fan``): the
+    per-path (log M, log A) pairs are pooled into one regression sample.
+    """
+    a_prev = jnp.atleast_2d(history.A_prev)   # [F, T]; F=1 for one path
+    m_now = jnp.atleast_2d(history.M_now)
+    log_a = jnp.log(a_prev[:, t_discard:]).ravel()
+    log_m = jnp.log(m_now[:, t_discard - 1:-1]).ravel()
+    states = jnp.broadcast_to(
+        mrkv_hist[t_discard - 1:-1],
+        (a_prev.shape[0], m_now.shape[1] - t_discard)).ravel()
     w = 1.0 - damping
 
     def one_state(i):
@@ -58,6 +67,43 @@ def calc_afunc_update(history: PanelHistory, mrkv_hist: jnp.ndarray,
 
     intercepts, slopes, rsqs = jax.vmap(one_state)(jnp.arange(2))
     return AFuncParams(intercept=intercepts, slope=slopes), rsqs
+
+
+class _PinnedSecant:
+    """Safeguarded secant iteration on the scalar residual
+    ``g(i) = mean log A_settled(i) - i`` of the slope-pinned saving rule.
+
+    Plain damped iteration diverges here: the notebook calibration sits at
+    Aiyagari's knife edge (equilibrium r* just below 1/beta - 1 = 4.17%),
+    where ergodic asset supply is extremely elastic in the perceived return
+    — measured d(log A)/d(intercept) ~ -3, so the damped map has modulus
+    > 1 for any damping < 0.75.  The secant step handles the steep monotone
+    residual; a bracket on the sign change plus a step clamp keeps it safe.
+    """
+
+    def __init__(self, max_step: float = 0.10, probe: float = 0.25):
+        self.i_prev = None
+        self.g_prev = None
+        self.lo = None    # highest intercept seen with g > 0
+        self.hi = None    # lowest intercept seen with g < 0
+        self.max_step = max_step
+        self.probe = probe
+
+    def step(self, i: float, g: float) -> float:
+        if g > 0:
+            self.lo = i if self.lo is None else max(self.lo, i)
+        else:
+            self.hi = i if self.hi is None else min(self.hi, i)
+        if self.g_prev is not None and abs(g - self.g_prev) > 1e-14:
+            cand = i - g * (i - self.i_prev) / (g - self.g_prev)
+        else:
+            cand = i + self.probe * g   # relaxation probe to seed the secant
+        cand = min(max(cand, i - self.max_step), i + self.max_step)
+        if self.lo is not None and self.hi is not None and not (
+                self.lo < cand < self.hi):
+            cand = 0.5 * (self.lo + self.hi)   # bisect when secant escapes
+        self.i_prev, self.g_prev = i, g
+        return cand
 
 
 @dataclass
@@ -106,7 +152,10 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
                      mrkv_hist=None, callback=None,
                      checkpoint_path=None, timer=None,
                      sim_method: str = "panel",
-                     dist_count: int = 500) -> KSSolution:
+                     dist_count: int = 500,
+                     dist_fan: int | None = None,
+                     dist_discard: int | None = None,
+                     dist_pin_slope: bool | None = None) -> KSSolution:
     """Full reference-parity solve: the Krusell-Smith fixed point over the
     aggregate saving rule.
 
@@ -128,6 +177,35 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
     wealth histogram through the same per-period operator — zero sampling
     noise in the regression inputs; ``final_panel`` is then the final
     ``DistPanelState`` instead of a ``PanelState``).
+
+    ``dist_pin_slope``: constrain the perceived saving rule to a *constant*
+    (slope 0, ``K' = exp(intercept)``) and solve the intercept by a
+    safeguarded secant iteration on the settled aggregate (see
+    ``_PinnedSecant`` for why plain damping diverges).  Default: True exactly
+    when the calibration is aggregate-degenerate (the Aiyagari
+    configuration, ProdB=ProdG and UrateB=UrateG,
+    ``Aiyagari_Support.py:1538-1547``).  Why this is the right default —
+    a finding this framework documents rather than inherits: with no
+    aggregate shocks the rational-expectations law of motion is the
+    constant ``K' = K*``, but the *transition map* ``log A' ~ log M`` has
+    local slope ~1.2, and a log-linear rule fit to deterministic data
+    converges to that slope, whose off-path explosiveness distorts
+    household expectations enough to settle ~1.8pp above the true
+    equilibrium r*.  The reference's Monte-Carlo version lands near the
+    truth only by accident: sampling noise in log M attenuates its OLS
+    slope (errors-in-variables) toward the stable region.  Pinning the
+    slope makes the deterministic method converge to the same equilibrium
+    as the independent bisection engine (``models/equilibrium.py``).
+
+    ``dist_fan``: number of deterministic initial-condition paths for the
+    *unpinned* distribution regression (``initial_distribution_fan``) —
+    with one deterministic path and no aggregate variation the slope is
+    unidentified; a fan of transients from spread initial capital levels
+    identifies the true transition map.  Default 1 (pinned mode and
+    true-KS chains don't need it); set >1 only to *measure* the
+    unconstrained map.  ``dist_discard``: periods dropped per path before
+    the regression (default: ``econ.t_discard`` for a single path, else a
+    short mixing window — the transient *is* the signal for a fan).
     """
     from ..utils.checkpoint import (
         config_fingerprint,
@@ -137,8 +215,6 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
     from ..utils.timing import PhaseTimer
     if timer is None:
         timer = PhaseTimer()
-    fingerprint = config_fingerprint(agent, econ, mrkv_hist,
-                                     ks_employment, egm_tol)
     cal = build_ks_calibration(agent, econ, ks_employment=ks_employment,
                                dtype=dtype)
     key = jax.random.PRNGKey(seed)
@@ -163,24 +239,79 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
             pol, cal, mrkv_hist, init, k))
     elif sim_method == "distribution":
         from .simulate import (
-            initial_distribution_panel,
+            initial_distribution_fan,
             make_sim_dist_grid,
             simulate_distribution_history,
         )
+        degenerate = (bool(jnp.all(cal.prod_by_agg == cal.prod_by_agg[0]))
+                      and bool(jnp.all(cal.urate_by_agg
+                                       == cal.urate_by_agg[0])))
+        if dist_pin_slope is None:
+            dist_pin_slope = degenerate
+        if dist_fan is None:
+            dist_fan = 1
         dist_grid = make_sim_dist_grid(cal, dist_count)
-        init = initial_distribution_panel(cal, dist_grid,
-                                          econ.mrkv_now_init)
-        run_panel = jax.jit(lambda pol, k: simulate_distribution_history(
-            pol, cal, mrkv_hist, dist_grid, init))   # key unused
+        init = initial_distribution_fan(cal, dist_grid, econ.mrkv_now_init,
+                                        dist_fan)
+        run_panel = jax.jit(lambda pol, k: jax.vmap(   # key unused
+            lambda i0: simulate_distribution_history(pol, cal, mrkv_hist,
+                                                     dist_grid, i0))(init))
     else:
         raise ValueError(f"sim_method must be 'panel' or 'distribution', "
                          f"got {sim_method!r}")
-    update = jax.jit(lambda hist, af: calc_afunc_update(
-        hist, mrkv_hist, af, econ.t_discard, econ.damping_fac))
+    if dist_discard is None:
+        dist_discard = (econ.t_discard if dist_fan in (None, 1)
+                        else min(25, econ.act_T // 4))
+    discard = (dist_discard if sim_method == "distribution"
+               else econ.t_discard)
+    # fingerprint AFTER parameter resolution so a checkpoint written under a
+    # different simulation mode (panel vs distribution, fan/pin settings) is
+    # refused, not silently resumed with the wrong rule class
+    fingerprint = config_fingerprint(agent, econ, mrkv_hist, ks_employment,
+                                     egm_tol, sim_method, dist_count,
+                                     dist_fan, dist_discard, dist_pin_slope)
+    pinned = sim_method == "distribution" and bool(dist_pin_slope)
+    if pinned:
+        secant = _PinnedSecant()
+        measured = jax.jit(
+            lambda hist: jnp.log(hist.A_prev[..., discard:]).mean())
+
+        def update(hist, af):
+            i_cur = float(af.intercept[0])
+            g = float(measured(hist)) - i_cur
+            i_new = secant.step(i_cur, g)
+            new = AFuncParams(
+                intercept=jnp.full((2,), i_new, dtype=cal.a_grid.dtype),
+                slope=jnp.zeros((2,), dtype=cal.a_grid.dtype))
+            # no regression ran: report NaN so records/verbose output never
+            # claim a fit quality that does not exist
+            return new, jnp.full((2,), jnp.nan, dtype=cal.a_grid.dtype)
+    else:
+        update = jax.jit(lambda hist, af: calc_afunc_update(
+            hist, mrkv_hist, af, discard, econ.damping_fac))
+
+    def finalize(history, final_panel):
+        """Collapse the fan axis to the central (factor ~1.0) path so
+        ``KSSolution.history``/``final_panel`` keep the single-path
+        contract regardless of ``sim_method``."""
+        if sim_method == "distribution":   # fan axis exists even for fan=1
+            c = dist_fan // 2
+            history = jax.tree.map(lambda x: x[c], history)
+            final_panel = jax.tree.map(lambda x: x[c], final_panel)
+        return history, final_panel
 
     afunc = AFuncParams(
         intercept=jnp.asarray(econ.intercept_prev, dtype=cal.a_grid.dtype),
         slope=jnp.asarray(econ.slope_prev, dtype=cal.a_grid.dtype))
+    if pinned:
+        # pinned mode starts inside the rule class it iterates in: constant
+        # perceived capital at the analytic steady state (the config's
+        # identity-rule guess lies outside it and its explosive perception
+        # produces a fat-tailed transient the histogram would truncate)
+        afunc = AFuncParams(
+            intercept=jnp.full((2,), jnp.log(cal.steady_state.K),
+                               dtype=cal.a_grid.dtype),
+            slope=jnp.zeros((2,), dtype=cal.a_grid.dtype))
     it_start = 0
     resumed_converged = False
     if checkpoint_path is not None and os.path.exists(checkpoint_path):
@@ -213,6 +344,7 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
         with timer.phase("simulate"):
             history, final_panel = jax.block_until_ready(
                 run_panel(policy, k_panel))
+        history, final_panel = finalize(history, final_panel)
         return KSSolution(afunc=afunc, policy=policy, calibration=cal,
                           history=history, mrkv_hist=mrkv_hist,
                           final_panel=final_panel, records=[],
@@ -269,6 +401,7 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
         if converged:
             break
 
+    history, final_panel = finalize(history, final_panel)
     return KSSolution(afunc=afunc, policy=policy, calibration=cal,
                       history=history, mrkv_hist=mrkv_hist,
                       final_panel=final_panel, records=records,

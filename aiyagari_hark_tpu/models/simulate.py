@@ -90,20 +90,27 @@ def initial_panel(cal: KSCalibration, agent_count: int, mrkv_init: int,
         mrkv=jnp.asarray(mrkv_init))
 
 
+def _conditional_emp_probs(mrkv_prev, mrkv_now, cal: KSCalibration):
+    """Employment switch probabilities conditional on the aggregate move,
+    from the 4x4 joint (BU,BE,GU,GE) matrix: rows ``2z+emp``, columns
+    ``2z'+emp'``; ``P(emp'|emp, z->z') = M[2z+emp, 2z'+emp'] / P_agg[z,z']``.
+    Shared by the exact-count panel draw and the expected-mass histogram
+    flow so the subtle indexing lives in exactly one place."""
+    p_agg = cal.agg_transition[mrkv_prev, mrkv_now]
+    p_u_to_e = cal.empl_transition[2 * mrkv_prev + 0, 2 * mrkv_now + 1] / p_agg
+    p_e_to_u = cal.empl_transition[2 * mrkv_prev + 1, 2 * mrkv_now + 0] / p_agg
+    return p_u_to_e, p_e_to_u
+
+
 def _transition_employment_exact(key, employed, mrkv_prev, mrkv_now,
                                  cal: KSCalibration):
     """Exact-count employment transitions, conditional on the aggregate move.
 
-    Conditional switch probabilities come from the 4x4 joint matrix:
-    P(emp' | emp, z -> z') = M[2z+emp, 2z'+emp'] / P_agg[z, z'].  The number
-    of switchers is the rounded expected count (the reference's permutation
-    apparatus achieves the same invariant); the identity of switchers is a
-    uniform random choice implemented by ranking uniform keys.
+    The number of switchers is the rounded expected count (the reference's
+    permutation apparatus achieves the same invariant); the identity of
+    switchers is a uniform random choice implemented by ranking uniform keys.
     """
-    p_agg = cal.agg_transition[mrkv_prev, mrkv_now]
-    # rows 2*z+emp, columns 2*z'+emp' of the 4x4 (BU,BE,GU,GE) matrix
-    p_u_to_e = cal.empl_transition[2 * mrkv_prev + 0, 2 * mrkv_now + 1] / p_agg
-    p_e_to_u = cal.empl_transition[2 * mrkv_prev + 1, 2 * mrkv_now + 0] / p_agg
+    p_u_to_e, p_e_to_u = _conditional_emp_probs(mrkv_prev, mrkv_now, cal)
 
     n_emp = jnp.sum(employed)
     n_unemp = employed.shape[0] - n_emp
@@ -221,16 +228,25 @@ def make_sim_dist_grid(cal: KSCalibration, dist_count: int = 500,
 
 
 def initial_distribution_panel(cal: KSCalibration, dist_grid: jnp.ndarray,
-                               mrkv_init: int) -> DistPanelState:
-    """Histogram analog of ``initial_panel``: all mass at the steady-state
-    capital (two-point lottery onto the grid), labor states uniform,
-    employment at the initial aggregate state's unemployment rate."""
+                               mrkv_init: int,
+                               k0=None) -> DistPanelState:
+    """Histogram analog of ``initial_panel``: all mass at capital ``k0``
+    (default: the steady state; two-point lottery onto the grid), labor
+    states uniform, employment at the initial aggregate state's unemployment
+    rate.  Prices are milled from ``k0`` so the first simulated period sees
+    the same factor prices a panel started at ``k0`` would."""
     from ..ops.interp import locate_in_grid
 
     n = cal.labor_levels.shape[0]
     ss = cal.steady_state
+    k0 = ss.K if k0 is None else jnp.asarray(k0)
     urate = cal.urate_by_agg[mrkv_init]
-    idx, w = locate_in_grid(jnp.asarray(ss.K, dtype=dist_grid.dtype),
+    agg_l = (1.0 - urate) * cal.lbr_ind
+    prod = cal.prod_by_agg[mrkv_init]
+    r0 = firm.interest_factor(k0 / agg_l, cal.cap_share, cal.depr_fac, prod)
+    w0 = firm.wage_rate(k0 / agg_l, cal.cap_share, prod)
+    m0 = r0 * k0 + w0 * agg_l
+    idx, w = locate_in_grid(jnp.asarray(k0, dtype=dist_grid.dtype),
                             dist_grid)
     asset_col = (jnp.zeros((dist_grid.shape[0],), dtype=dist_grid.dtype)
                  .at[idx].add(1.0 - w).at[idx + 1].add(w))
@@ -238,9 +254,34 @@ def initial_distribution_panel(cal: KSCalibration, dist_grid: jnp.ndarray,
     dist = asset_col[:, None, None] * (1.0 / n) * emp_w[None, None, :]
     dist = jnp.broadcast_to(dist, (dist_grid.shape[0], n, 2))
     return DistPanelState(
-        dist=dist, M_now=ss.M.astype(dist_grid.dtype),
-        R_now=ss.R.astype(dist_grid.dtype),
-        W_now=ss.W.astype(dist_grid.dtype), mrkv=jnp.asarray(mrkv_init))
+        dist=dist, M_now=m0.astype(dist_grid.dtype),
+        R_now=r0.astype(dist_grid.dtype),
+        W_now=w0.astype(dist_grid.dtype), mrkv=jnp.asarray(mrkv_init))
+
+
+def initial_distribution_fan(cal: KSCalibration, dist_grid: jnp.ndarray,
+                             mrkv_init: int, fan: int,
+                             spread: float = 0.75) -> DistPanelState:
+    """A fan of ``fan`` histogram initial states with initial capital spread
+    geometrically over ``[spread, 1/spread] x KSS`` (stacked on a leading
+    axis, ready for ``jax.vmap`` over ``simulate_distribution_history``).
+
+    Why: with the aggregate shock switched off (the Aiyagari configuration,
+    ``Aiyagari_Support.py:1538-1547``), a *deterministic* simulated path sits
+    exactly at its fixed point after the transient, so the Krusell-Smith
+    ``log A on log M`` regression has no variation to identify the slope —
+    in the reference that identification is supplied accidentally by
+    Monte-Carlo sampling noise.  The fan restores identification
+    deterministically: each path's transient traces the true aggregate map
+    ``M -> A'`` through a neighborhood of the fixed point.
+    """
+    ss = cal.steady_state
+    factors = (jnp.geomspace(spread, 1.0 / spread, fan)
+               if fan > 1 else jnp.ones((1,)))
+    inits = [initial_distribution_panel(cal, dist_grid, mrkv_init,
+                                        k0=f * ss.K)
+             for f in factors]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *inits)
 
 
 def simulate_distribution_history(policy: KSPolicy, cal: KSCalibration,
@@ -260,8 +301,9 @@ def simulate_distribution_history(policy: KSPolicy, cal: KSCalibration,
     from ..ops.interp import eval_policy_agents, locate_in_grid
 
     if init is None:
-        init = initial_distribution_panel(cal, dist_grid,
-                                          int(mrkv_hist[0]))
+        # mrkv_hist[0] may be traced (inside jit) — initial_distribution_panel
+        # only indexes with it, so no concretization is needed
+        init = initial_distribution_panel(cal, dist_grid, mrkv_hist[0])
     d_size, n = dist_grid.shape[0], cal.labor_levels.shape[0]
     lbr = cal.lbr_ind
 
@@ -270,12 +312,9 @@ def simulate_distribution_history(policy: KSPolicy, cal: KSCalibration,
         dist_l = jnp.einsum("dne,nm->dme", state.dist,
                             cal.tauchen_transition,
                             precision=jax.lax.Precision.HIGHEST)
-        # --- employment flows conditional on the aggregate move
-        p_agg = cal.agg_transition[state.mrkv, z_t]
-        p_u_to_e = cal.empl_transition[2 * state.mrkv + 0,
-                                       2 * z_t + 1] / p_agg
-        p_e_to_u = cal.empl_transition[2 * state.mrkv + 1,
-                                       2 * z_t + 0] / p_agg
+        # --- employment flows conditional on the aggregate move (expected
+        # mass instead of the panel's exact-count draws)
+        p_u_to_e, p_e_to_u = _conditional_emp_probs(state.mrkv, z_t, cal)
         unemp = dist_l[:, :, 0]
         emp = dist_l[:, :, 1]
         new_unemp = unemp * (1.0 - p_u_to_e) + emp * p_e_to_u

@@ -1,0 +1,187 @@
+"""Tests for the deterministic distribution-iteration simulator
+(``simulate_distribution_history``) and its ``sim_method="distribution"``
+hookup in the KS outer loop.
+
+The simulator replaces the reference's 350-agent Monte-Carlo panel
+(``Aiyagari_Support.py:1161-1162`` + hooks, SURVEY.md §3.3) with an exact
+histogram push-forward — the oracle here is the panel simulator itself in the
+large-agent limit (MC error ~ N^{-1/2}), plus conservation-law invariants the
+histogram operator must satisfy exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.models.ks_model import (
+    AFuncParams,
+    build_ks_calibration,
+    solve_ks_household,
+)
+from aiyagari_hark_tpu.models.ks_solver import solve_ks_economy
+from aiyagari_hark_tpu.models.simulate import (
+    initial_distribution_panel,
+    initial_panel,
+    make_sim_dist_grid,
+    simulate_distribution_history,
+    simulate_markov_history,
+    simulate_panel,
+)
+from aiyagari_hark_tpu.utils.config import notebook_run_configs
+
+
+@pytest.fixture(scope="module")
+def cal():
+    agent, econ = notebook_run_configs()
+    return build_ks_calibration(agent, econ)
+
+
+@pytest.fixture(scope="module")
+def policy(cal):
+    # A stationary perceived rule (K' = KSS regardless of M).  The identity
+    # rule (slope 1) is NOT usable here: it makes households expect K' = M
+    # (~2.6x steady state), the implied policy has an explosive right tail
+    # (panel max assets > 2000), and a histogram with any finite top would
+    # truncate it.  Under a stationary rule wealth is bounded (reference
+    # max 22.05, BASELINE.md), which is the regime the KS loop operates in.
+    ss = cal.steady_state
+    afunc = AFuncParams(
+        intercept=jnp.full((2,), jnp.log(ss.K), dtype=cal.a_grid.dtype),
+        slope=jnp.zeros(2, dtype=cal.a_grid.dtype))
+    pol, _, _ = solve_ks_household(afunc, cal)
+    return pol
+
+
+@pytest.fixture(scope="module")
+def mrkv_hist(cal):
+    return simulate_markov_history(cal.agg_transition, 0, 300,
+                                   jax.random.PRNGKey(1))
+
+
+def test_initial_distribution_mass_and_mean(cal):
+    """The birth lottery conserves mass exactly and places the mean at the
+    steady-state capital (the two-point lottery is mean-preserving)."""
+    grid = make_sim_dist_grid(cal, 200)
+    init = initial_distribution_panel(cal, grid, 0)
+    d = np.asarray(init.dist)
+    assert d.shape == (200, cal.labor_levels.shape[0], 2)
+    np.testing.assert_allclose(d.sum(), 1.0, atol=1e-12)
+    mean_a = float((d.sum(axis=(1, 2)) * np.asarray(grid)).sum())
+    np.testing.assert_allclose(mean_a, float(cal.steady_state.K), rtol=1e-10)
+    # parity mode: UrateB=UrateG=0 -> all mass employed
+    np.testing.assert_allclose(d[:, :, 0].sum(), 0.0, atol=1e-12)
+
+
+def test_distribution_history_conserves_mass(cal, policy, mrkv_hist):
+    grid = make_sim_dist_grid(cal, 200)
+    hist, final = jax.jit(
+        lambda p: simulate_distribution_history(p, cal, mrkv_hist, grid))(
+            policy)
+    total = float(np.asarray(final.dist).sum())
+    np.testing.assert_allclose(total, 1.0, atol=1e-9)
+    assert (np.asarray(final.dist) >= -1e-15).all()
+    # track_vars contract identical to the panel simulator
+    A = np.asarray(hist.A_prev)
+    assert A.shape == (300,)
+    assert np.isfinite(A).all() and (A > 0).all()
+    # degenerate employment (Aiyagari mode): urate identically ~0
+    np.testing.assert_allclose(np.asarray(hist.urate), 0.0, atol=1e-12)
+
+
+def test_distribution_is_deterministic(cal, policy, mrkv_hist):
+    """No keys anywhere: two runs are bit-identical (the property the panel
+    simulator cannot offer and the 1 bp budget needs, SURVEY.md §7)."""
+    grid = make_sim_dist_grid(cal, 150)
+    f = jax.jit(lambda p: simulate_distribution_history(
+        p, cal, mrkv_hist, grid)[0].A_prev)
+    a1, a2 = f(policy), f(policy)
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+
+
+def test_distribution_matches_large_panel(cal, policy, mrkv_hist):
+    """The histogram push-forward is the N -> infinity limit of the panel:
+    with a large agent panel on the same policy and aggregate chain, the
+    simulated aggregate-assets path must agree to within MC error."""
+    grid = make_sim_dist_grid(cal, 400)
+    hist_d, _ = jax.jit(lambda p: simulate_distribution_history(
+        p, cal, mrkv_hist, grid))(policy)
+    init = initial_panel(cal, 4000, 0, jax.random.PRNGKey(2))
+    hist_p, _ = jax.jit(lambda p, k: simulate_panel(
+        p, cal, mrkv_hist, init, k))(policy, jax.random.PRNGKey(3))
+    a_d = np.asarray(hist_d.A_prev)[100:]
+    a_p = np.asarray(hist_p.A_prev)[100:]
+    # time-mean of aggregate assets: MC std of the panel mean is well under
+    # 1% here; allow 3% for the histogram's finite-grid bias
+    np.testing.assert_allclose(a_d.mean(), a_p.mean(), rtol=0.03)
+    # the paths themselves co-move (same chain, same policy)
+    corr = np.corrcoef(a_d, a_p)[0, 1]
+    assert corr > 0.95
+
+
+def test_solve_ks_economy_distribution_method():
+    """The deterministic (slope-pinned secant) equilibrium mode: converges,
+    reproduces exactly, and cross-validates against the *independent*
+    bisection engine — the rational-expectations r* of the shockless
+    economy, 4.125% (``tests/test_equilibrium.py`` golden), NOT the
+    reference's MC-attenuated 4.178% (see ``solve_ks_economy`` docstring
+    on ``dist_pin_slope``)."""
+    agent, econ = notebook_run_configs()
+    econ = econ.replace(act_T=1500, t_discard=300, verbose=False,
+                        max_loops=15, tolerance=1e-3)
+    sol = solve_ks_economy(agent, econ, seed=0, sim_method="distribution",
+                           dist_count=300)
+    assert sol.converged
+    # |r* - bisection golden| small: independent-method cross-validation
+    # (histogram grid / M-interpolation differences allow a few bp)
+    assert abs(sol.equilibrium_r_pct - 4.125) < 0.05
+    # pinned rule: slope identically zero
+    np.testing.assert_array_equal(np.asarray(sol.afunc.slope), 0.0)
+    # final_panel is the histogram state; mass still sums to one
+    np.testing.assert_allclose(float(np.asarray(sol.final_panel.dist).sum()),
+                               1.0, atol=1e-8)
+    # exact reproducibility of the whole outer loop
+    sol2 = solve_ks_economy(agent, econ, seed=0, sim_method="distribution",
+                            dist_count=300)
+    np.testing.assert_array_equal(np.asarray(sol.afunc.intercept),
+                                  np.asarray(sol2.afunc.intercept))
+
+
+def test_initial_condition_fan_and_pooled_regression(cal, policy):
+    """``initial_distribution_fan`` stacks mill-consistent starts on a
+    leading axis, and ``calc_afunc_update`` pools that axis into one
+    regression sample (the deterministic-dithering machinery for measuring
+    the unconstrained aggregate map)."""
+    from aiyagari_hark_tpu.models.ks_solver import calc_afunc_update
+    from aiyagari_hark_tpu.models.simulate import initial_distribution_fan
+    from aiyagari_hark_tpu.models.ks_model import AFuncParams as AFP
+
+    grid = make_sim_dist_grid(cal, 150)
+    fan = initial_distribution_fan(cal, grid, 0, 5)
+    assert fan.dist.shape == (5, 150, cal.labor_levels.shape[0], 2)
+    # per-path mass is 1 and initial capital is spread geometrically
+    np.testing.assert_allclose(np.asarray(fan.dist).sum(axis=(1, 2, 3)),
+                               1.0, atol=1e-12)
+    k0 = (np.asarray(fan.dist).sum(axis=(2, 3)) * np.asarray(grid)).sum(1)
+    assert k0[0] < k0[2] < k0[4]
+    np.testing.assert_allclose(k0[2], float(cal.steady_state.K), rtol=1e-9)
+    # prices are milled from each path's own k0, not the steady state's
+    assert float(fan.R_now[0]) > float(fan.R_now[4])
+    # pooled regression over the fan identifies the transition map: slope
+    # is finite, R^2 high (deterministic transients are near log-linear)
+    mrkv = simulate_markov_history(cal.agg_transition, 0, 200,
+                                   jax.random.PRNGKey(5))
+    hist = jax.vmap(lambda i0: simulate_distribution_history(
+        policy, cal, mrkv, grid, i0))(fan)[0]
+    assert hist.A_prev.shape == (5, 200)
+    afunc0 = AFP(intercept=jnp.zeros(2), slope=jnp.ones(2))
+    new, rsq = calc_afunc_update(hist, mrkv, afunc0, 25, 0.0)
+    assert np.isfinite(np.asarray(new.slope)).all()
+    assert (np.asarray(rsq) > 0.95).all()
+
+
+def test_sim_method_rejects_unknown():
+    agent, econ = notebook_run_configs()
+    with pytest.raises(ValueError, match="sim_method"):
+        solve_ks_economy(agent, econ.replace(act_T=40, t_discard=8),
+                         sim_method="typo")
