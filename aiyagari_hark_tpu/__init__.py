@@ -23,10 +23,21 @@ from .models.equilibrium import (  # noqa: F401
     solve_calibration,
     solve_calibration_lean,
 )
+from .models.lifecycle import (  # noqa: F401
+    simulate_cohort,
+    solve_lifecycle,
+)
 from .models.portfolio import (  # noqa: F401
     build_portfolio_model,
     solve_portfolio_equilibrium,
     solve_portfolio_household,
+)
+from .models.value import (  # noqa: F401
+    aggregate_welfare,
+    consumption_equivalent,
+    marginal_value_at,
+    policy_value,
+    value_at,
 )
 from .parallel.sweep import SweepResult, run_table2_sweep  # noqa: F401
 from .utils.backend import BackendInfo, select_backend  # noqa: F401

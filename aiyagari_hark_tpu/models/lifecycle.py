@@ -1,0 +1,128 @@
+"""Finite-horizon (life-cycle) household problem: backward induction as a
+``lax.scan`` over ages, plus a cohort simulator.
+
+The reference inherits HARK's finite-horizon machinery (``AgentType`` with
+``cycles >= 1`` — the lifecycle mode of the same ``solve_one_period``
+apparatus the notebook runs with ``cycles=0`` at ``Aiyagari-HARK.py:237``)
+but never exercises it.  This module provides the working TPU-native
+equivalent: the same EGM backward step as the infinite-horizon solver
+(``models.household.egm_step``), scanned ``horizon`` times from the terminal
+consume-everything solution, with optional age-varying income profiles and
+survival probabilities — enough to express the standard life-cycle
+consumption/saving model (hump-shaped wealth, retirement dissaving).
+
+Everything is one jitted program: ages are a scan axis, the age-stacked
+policy is a single ``[T, N, K]`` array pytree, and the cohort simulator
+scans forward over the same arrays.  No Python loops over ages or agents.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.interp import interp1d_rowwise
+from .household import (
+    HouseholdPolicy,
+    SimpleModel,
+    egm_step,
+    initial_policy,
+)
+
+
+class LifecyclePolicy(NamedTuple):
+    """Age-stacked consumption policy: index 0 is the first age."""
+
+    m_knots: jnp.ndarray    # [T, N, K]
+    c_knots: jnp.ndarray    # [T, N, K]
+
+
+def solve_lifecycle(R, W, model: SimpleModel, disc_fac, crra,
+                    horizon: int, income_profile=None,
+                    survival=None) -> LifecyclePolicy:
+    """Backward induction over ``horizon`` ages.
+
+    ``income_profile`` ([T], default ones): age-specific scaling of labor
+    income — age t earns ``W * income_profile[t] * l``.  ``survival``
+    ([T], default ones): probability of reaching age t+1 from age t,
+    multiplying the discount factor (utility after death is zero, the
+    standard perishable-annuity-free formulation).  The terminal age
+    consumes everything (c = m), the reference's ``IdentityFunction``
+    terminal guess made exact (``Aiyagari_Support.py:898``).
+
+    Returns the age-stacked policy; scalars may be traced.
+    """
+    dtype = model.a_grid.dtype
+    if income_profile is None:
+        income_profile = jnp.ones((horizon,), dtype=dtype)
+    else:
+        income_profile = jnp.asarray(income_profile, dtype=dtype)
+    if survival is None:
+        survival = jnp.ones((horizon,), dtype=dtype)
+    else:
+        survival = jnp.asarray(survival, dtype=dtype)
+    terminal = initial_policy(model)   # c = m exactly at the last age
+
+    def step(pol_next, x):
+        w_next_scale, disc_t = x
+        pol = egm_step(pol_next, R, W * w_next_scale, model, disc_t, crra)
+        return pol, pol
+
+    # age t's step consumes age t+1's policy, income scale, and t's survival
+    xs = (income_profile[1:][::-1], disc_fac * survival[:-1][::-1])
+    _, stacked = jax.lax.scan(step, terminal, xs)
+    m_all = jnp.concatenate([stacked.m_knots[::-1],
+                             terminal.m_knots[None]], axis=0)
+    c_all = jnp.concatenate([stacked.c_knots[::-1],
+                             terminal.c_knots[None]], axis=0)
+    return LifecyclePolicy(m_knots=m_all, c_knots=c_all)
+
+
+class CohortProfile(NamedTuple):
+    """Mean per-age outcomes of a simulated cohort."""
+
+    assets: jnp.ndarray        # [T] mean end-of-age assets
+    consumption: jnp.ndarray   # [T] mean consumption
+    income: jnp.ndarray        # [T] mean labor income
+
+
+def simulate_cohort(policy: LifecyclePolicy, R, W, model: SimpleModel,
+                    n_agents: int, key: jax.Array, income_profile=None,
+                    a0: float = 0.0) -> CohortProfile:
+    """Forward-simulate a birth cohort through the whole life cycle.
+
+    Agents are born with assets ``a0`` and labor states drawn from the
+    ergodic distribution; each age is one scan step (categorical labor
+    draw over the panel, age-indexed policy evaluation, budget identity) —
+    the lifecycle analog of ``models.simulate.simulate_panel``.
+    """
+    horizon = policy.m_knots.shape[0]
+    dtype = model.a_grid.dtype
+    if income_profile is None:
+        income_profile = jnp.ones((horizon,), dtype=dtype)
+    else:
+        income_profile = jnp.asarray(income_profile, dtype=dtype)
+    k_birth, k_sim = jax.random.split(key)
+    logp = jnp.log(model.transition)
+    s0 = jax.random.categorical(k_birth, jnp.log(model.labor_stationary),
+                                shape=(n_agents,))
+    a_init = jnp.full((n_agents,), a0, dtype=dtype)
+
+    def step(carry, x):
+        a, s = carry
+        t, k = x
+        s = jax.random.categorical(k, logp[s]).astype(s.dtype)
+        income = W * income_profile[t] * model.labor_levels[s]
+        m = R * a + income
+        # rowwise interp with per-agent gathered knots (agent i uses its
+        # state's knot row of the age-t policy)
+        c = interp1d_rowwise(m, policy.m_knots[t][s], policy.c_knots[t][s])
+        a_new = m - c
+        return (a_new, s), (jnp.mean(a_new), jnp.mean(c), jnp.mean(income))
+
+    keys = jax.random.split(k_sim, horizon)
+    (_, _), (a_prof, c_prof, y_prof) = jax.lax.scan(
+        step, (a_init, s0), (jnp.arange(horizon), keys))
+    return CohortProfile(assets=a_prof, consumption=c_prof, income=y_prof)

@@ -1,0 +1,203 @@
+"""Value functions and welfare analytics for the compact household model.
+
+The reference *intends* to carry value-function machinery — ``MargValueFunc2D``
+is defined at ``Aiyagari_Support.py:71-102`` — but never instantiates it
+(dead component D1, SURVEY.md §2.2), and its one live value object is the
+marginal-value wrapper rebuilt inside the solver
+(``MargValueFuncCRRA``, ``Aiyagari_Support.py:1514-1515``).  This module is
+the *working* replacement: given a converged consumption policy, recover the
+level value function v(m, s) by policy evaluation, expose the marginal value
+through the envelope condition, and provide the welfare comparisons (aggregate
+welfare, consumption equivalents) the level function exists for.
+
+Numerics: v is stored through the *constant-equivalent consumption*
+transform ``vnvrs = u^{-1}((1 - beta) v)`` — the constant consumption stream
+whose discounted utility equals v (a sharper version of HARK's "vNvrs"
+inverse-utility trick).  Along any policy with consumption proportional to
+resources, v is homogeneous of the same degree as u, so this vnvrs is
+*linear* in m for every CRRA including log (plain ``u^{-1}(v)`` is linear
+only for crra != 1; for log utility it is ``m^{1/(1-beta)}``, hopeless for
+piecewise-linear knots).  Storing raw v instead would put a ``-1e7``-scale
+kink at the borrowing-constraint knot and poison every interpolation below
+the second gridpoint.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.interp import interp1d, interp1d_rowwise
+from ..ops.utility import (
+    crra_utility,
+    inverse_utility,
+    marginal_utility,
+)
+from .household import HouseholdPolicy, SimpleModel
+
+
+class ValueFunction(NamedTuple):
+    """v(m, s) as data: per-state knots on the policy's endogenous grid.
+    ``vnvrs_knots`` holds the constant-equivalent consumption
+    ``u^{-1}((1-beta) v)``; evaluate with ``value_at``.  ``disc_fac`` rides
+    along because the transform needs it."""
+
+    m_knots: jnp.ndarray       # [N, K] same knots as the policy
+    vnvrs_knots: jnp.ndarray   # [N, K] u^{-1}((1-beta) v) at the knots
+    disc_fac: jnp.ndarray      # scalar beta
+
+
+def _clamp_positive(x):
+    """vnvrs is a consumption equivalent, nonnegative by construction;
+    linear extrapolation below the borrowing-constraint knot can cross zero
+    (query m' = 0 happens when W = 0), which u(.) would turn into NaN —
+    clamp to the smallest positive normal instead (u then reports the
+    appropriately catastrophic value).  Single clamping policy for every
+    vnvrs evaluation path."""
+    return jnp.maximum(x, jnp.finfo(x.dtype).tiny)
+
+
+def _eval_vnvrs(vf_m, vf_vnvrs, m):
+    """Interpolate vnvrs rowwise ([N, ...] queries with per-state knots)."""
+    return _clamp_positive(interp1d_rowwise(m, vf_m, vf_vnvrs))
+
+
+def policy_value(policy: HouseholdPolicy, R, W, model: SimpleModel,
+                 disc_fac, crra, tol: float = 1e-9,
+                 max_iter: int = 5000, constrained_knots: int = 24):
+    """Recover v(m, s) for a fixed consumption policy by iterating the policy
+    evaluation operator
+
+        v(m, s) = u(c(m, s)) + beta * sum_{s'} P[s, s'] v(R a + W l', s'),
+        a = m - c(m, s)
+
+    on the policy's knots to its fixed point (a beta-contraction).
+    Returns (ValueFunction, n_iter, final_diff) with the diff measured
+    sup-norm on the vnvrs knots.
+
+    ``constrained_knots``: extra log-spaced knots inserted into the
+    borrowing-constrained segment (below the first endogenous gridpoint,
+    where the exact policy is c = m).  The policy is *linear* there, so one
+    chord represents it exactly — but vnvrs is a concave hyperbola there
+    (``u^{-1}`` of ``u(m) + const``), and leaving it as one chord
+    understates continuation values enough to bias v by several percent
+    even far from the constraint (the error rides expectations up the whole
+    state space; grid refinement in ``a`` cannot fix it because EGM never
+    places knots below the first endogenous point).  Validated against a
+    Monte-Carlo discounted-utility oracle in ``tests/test_value.py``.
+
+    All scalars (R, W, disc_fac, crra) may be traced — the sweep vmaps
+    welfare over calibration cells like everything else.
+    """
+    m_knots = policy.m_knots                    # [N, K]
+    c_knots = policy.c_knots
+    if constrained_knots > 0:
+        from .household import CONSTRAINT_EPS
+        eps = jnp.asarray(10.0 * CONSTRAINT_EPS, dtype=m_knots.dtype)
+        m1 = m_knots[:, 1][:, None]             # first endogenous knot [N,1]
+        frac = jnp.linspace(0.0, 1.0, constrained_knots + 1,
+                            dtype=m_knots.dtype)[:-1]
+        extra = jnp.exp(jnp.log(eps)
+                        + frac[None, :] * (jnp.log(m1 * (1.0 - 1e-6))
+                                           - jnp.log(eps)))   # [N, E]
+        m_aug = jnp.sort(jnp.concatenate([m_knots, extra], axis=1), axis=1)
+        c_aug = interp1d_rowwise(m_aug, m_knots, c_knots)
+        c_aug = jnp.where(m_aug <= m1, m_aug, c_aug)   # exact constrained c
+        m_knots, c_knots = m_aug, c_aug
+    a_knots = m_knots - c_knots                 # end-of-period assets
+    n = m_knots.shape[0]
+    # next-period resources per (state-knot, next-state): [N, K, N']
+    m_next = R * a_knots[:, :, None] + W * model.labor_levels[None, None, :]
+    u_now = crra_utility(c_knots, crra)
+    trans = model.transition                    # [N, N']
+
+    one_minus_beta = 1.0 - disc_fac
+
+    def bellman_rhs(vnvrs):
+        # v' at m_next: interp vnvrs in the NEXT state's knots, then invert
+        # the constant-equivalent transform v = u(vnvrs) / (1-beta)
+        q = jnp.moveaxis(m_next, 2, 0).reshape(n, -1)       # [N', N*K]
+        v_next = crra_utility(_eval_vnvrs(m_knots, vnvrs, q),
+                              crra) / one_minus_beta
+        v_next = jnp.moveaxis(v_next.reshape(n, n, -1), 0, 2)   # [N, K, N']
+        ev = jnp.einsum("nkj,nj->nk", v_next, trans,
+                        precision=jax.lax.Precision.HIGHEST)
+        return inverse_utility(one_minus_beta * (u_now + disc_fac * ev),
+                               crra)
+
+    # start at v = u(c)/(1-beta) (consume current c forever), whose
+    # constant-equivalent is exactly the consumption knots
+    v0 = c_knots
+    big = jnp.asarray(jnp.inf, dtype=m_knots.dtype)
+
+    def cond(state):
+        _, diff, it = state
+        return (diff > tol) & (it < max_iter)
+
+    def body(state):
+        vnvrs, _, it = state
+        new = bellman_rhs(vnvrs)
+        return new, jnp.max(jnp.abs(new - vnvrs)), it + 1
+
+    vnvrs, diff, it = jax.lax.while_loop(cond, body,
+                                         (v0, big, jnp.asarray(0)))
+    return (ValueFunction(m_knots=m_knots, vnvrs_knots=vnvrs,
+                          disc_fac=jnp.asarray(disc_fac)), it, diff)
+
+
+def value_at(vf: ValueFunction, m, crra, state_idx=None):
+    """v(m, s): interpolate vnvrs, then undo the constant-equivalent
+    transform (v = u(vnvrs)/(1-beta)).  ``m`` is rowwise per state
+    ([N, ...]) by default, or per-state-indexed when ``state_idx`` given."""
+    scale = 1.0 - vf.disc_fac
+    if state_idx is None:
+        vn = _eval_vnvrs(vf.m_knots, vf.vnvrs_knots, m)
+        return crra_utility(vn, crra) / scale
+    vn = _clamp_positive(
+        interp1d(m, vf.m_knots[state_idx], vf.vnvrs_knots[state_idx]))
+    return crra_utility(vn, crra) / scale
+
+
+def marginal_value_at(policy: HouseholdPolicy, m, crra, state_idx=None):
+    """v'(m, s) = u'(c(m, s)) — the envelope condition.  This is the working
+    analog of the reference's marginal-value wrappers (``MargValueFuncCRRA``
+    at ``Aiyagari_Support.py:1514``, dead ``MargValueFunc2D`` at ``:71-102``):
+    marginal value is *data derived from the policy*, not a stored object."""
+    from .household import consumption_at
+    return marginal_utility(consumption_at(policy, m, state_idx), crra)
+
+
+def aggregate_welfare(vf: ValueFunction, dist, R, W, model: SimpleModel,
+                      crra):
+    """Population welfare E[v(m, s)] under a wealth histogram ``dist``
+    [D, N] over ``model.dist_grid`` (e.g. the stationary distribution):
+    each (gridpoint, state) cell enters the period with
+    m = R x + W l_s."""
+    m = R * model.dist_grid[:, None] + W * model.labor_levels[None, :]
+    v = value_at(vf, m.T, crra)                 # [N, D]
+    return jnp.sum(dist * v.T)
+
+
+def consumption_equivalent(v_base, v_alt, crra, disc_fac):
+    """The permanent consumption change lambda making the base allocation as
+    good as the alternative: scale all base-path consumption by (1+lambda).
+
+    CRRA utility is homogeneous of degree 1-crra, so
+    ``v((1+lam) c-path) = (1+lam)^(1-crra) v`` and
+    ``lam = (v_alt/v_base)^(1/(1-crra)) - 1``; for log utility the scaling
+    is additive, ``lam = exp((1-beta)(v_alt - v_base)) - 1``.
+    """
+    v_base = jnp.asarray(v_base)
+    v_alt = jnp.asarray(v_alt)
+    if not isinstance(crra, jax.core.Tracer):
+        crra = float(crra)
+        if crra == 1.0:
+            return jnp.expm1((1.0 - disc_fac) * (v_alt - v_base))
+        return (v_alt / v_base) ** (1.0 / (1.0 - crra)) - 1.0
+    is_log = crra == 1.0
+    safe = jnp.where(is_log, 2.0, crra)
+    power = (v_alt / v_base) ** (1.0 / (1.0 - safe)) - 1.0
+    return jnp.where(is_log,
+                     jnp.expm1((1.0 - disc_fac) * (v_alt - v_base)), power)

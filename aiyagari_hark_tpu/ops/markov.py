@@ -46,6 +46,13 @@ def tauchen_ar1(n: int, sigma: float, ar_1: float, bound: float = 3.0,
     deviations), interior transition masses are normal CDF differences over
     half-bin widths, and the edge columns absorb the tails.
     """
+    if n == 1:
+        # Degenerate chain (deterministic income): one state at the
+        # unconditional mean.  The general formulas below break here — with
+        # a size-1 grid, ``grid[1]`` silently clamps to ``grid[0]`` (step 0)
+        # and the absorbing-edge overwrites leave a non-stochastic [[~0.93]].
+        return TauchenResult(grid=jnp.zeros((1,), dtype=dtype),
+                             transition=jnp.ones((1, 1), dtype=dtype))
     sigma = jnp.asarray(sigma, dtype=dtype)
     ar_1 = jnp.asarray(ar_1, dtype=dtype)
     y_max = bound * sigma / jnp.sqrt(1.0 - ar_1 ** 2)

@@ -40,3 +40,20 @@ def marginal_utility(c: jnp.ndarray, crra: float) -> jnp.ndarray:
 def inverse_marginal_utility(vp: jnp.ndarray, crra: float) -> jnp.ndarray:
     """(u')^{-1}(x) = x^(-1/crra) — the EGM first-order-condition inversion."""
     return vp ** (-1.0 / crra)
+
+
+def inverse_utility(v: jnp.ndarray, crra) -> jnp.ndarray:
+    """u^{-1}(v): the consumption level whose one-period utility is ``v`` —
+    the "value-inverse" (HARK's vNvrs) transform that makes CRRA value
+    functions near-linear in resources, so piecewise-linear knots represent
+    them accurately (``models.value``).  Same traced-``crra`` handling as
+    ``crra_utility``."""
+    if not isinstance(crra, jax.core.Tracer):
+        crra = float(crra)
+        if crra == 1.0:
+            return jnp.exp(v)
+        return ((1.0 - crra) * v) ** (1.0 / (1.0 - crra))
+    is_log = crra == 1.0
+    safe = jnp.where(is_log, 2.0, crra)
+    power = ((1.0 - safe) * v) ** (1.0 / (1.0 - safe))
+    return jnp.where(is_log, jnp.exp(v), power)

@@ -1,0 +1,100 @@
+"""Tests for the finite-horizon life-cycle solver (models/lifecycle.py) —
+the working analog of HARK's ``cycles >= 1`` mode that the reference
+inherits but never exercises (``cycles=0`` at ``Aiyagari-HARK.py:237``).
+
+Oracles: the terminal consume-everything condition, convergence of the
+long-horizon age-0 policy to the infinite-horizon fixed point (the
+``cycles=0`` limit), and the textbook hump-shaped wealth profile under a
+retirement income path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.models.household import (
+    build_simple_model,
+    consumption_at,
+    solve_household,
+)
+from aiyagari_hark_tpu.models.lifecycle import (
+    simulate_cohort,
+    solve_lifecycle,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return build_simple_model(labor_states=5, a_count=40)
+
+
+R, W, BETA, CRRA = 1.02, 1.0, 0.96, 2.0
+
+
+def test_terminal_age_consumes_everything(model):
+    pol = solve_lifecycle(R, W, model, BETA, CRRA, horizon=10)
+    assert pol.m_knots.shape[0] == 10
+    np.testing.assert_allclose(np.asarray(pol.c_knots[-1]),
+                               np.asarray(pol.m_knots[-1]), rtol=1e-12)
+
+
+def test_consumption_rises_with_age_at_fixed_resources(model):
+    """Shorter remaining horizon => higher marginal propensity to consume:
+    at the same m, an older agent consumes more."""
+    pol = solve_lifecycle(R, W, model, BETA, CRRA, horizon=40)
+    m_test = jnp.full((5, 3), 6.0).at[:].set(jnp.asarray([4.0, 6.0, 9.0]))
+    ages = [0, 20, 35, 39]
+    c_by_age = [np.asarray(jax.vmap(
+        lambda mk, ck, mq: jnp.interp(mq, mk, ck))(
+            pol.m_knots[t], pol.c_knots[t], m_test)) for t in ages]
+    for younger, older in zip(c_by_age[:-1], c_by_age[1:]):
+        assert (older >= younger - 1e-9).all()
+
+
+def test_long_horizon_converges_to_infinite_horizon(model):
+    """With many ages ahead, the age-0 policy is the cycles=0 fixed point —
+    backward induction and the while_loop solver must agree."""
+    inf_policy, _, _ = solve_household(R, W, model, BETA, CRRA)
+    pol = jax.jit(lambda: solve_lifecycle(R, W, model, BETA, CRRA,
+                                          horizon=300))()
+    m_test = jnp.tile(jnp.linspace(0.5, 30.0, 12), (5, 1))
+    c_inf = np.asarray(consumption_at(inf_policy, m_test))
+    c_age0 = np.asarray(jax.vmap(
+        lambda mk, ck, mq: jnp.interp(mq, mk, ck))(
+            pol.m_knots[0], pol.c_knots[0], m_test))
+    np.testing.assert_allclose(c_age0, c_inf, rtol=1e-5)
+
+
+def test_hump_shaped_wealth_under_retirement(model):
+    """Classic life-cycle shape: earn for 45 years, retire on 30% income for
+    15 — mean wealth rises through working life, peaks near retirement,
+    then is drawn down."""
+    horizon, retire_age = 60, 45
+    prof = jnp.concatenate([jnp.ones((retire_age,)),
+                            jnp.full((horizon - retire_age,), 0.3)])
+    pol = solve_lifecycle(R, W, model, BETA, CRRA, horizon=horizon,
+                          income_profile=prof)
+    out = jax.jit(lambda k: simulate_cohort(pol, R, W, model, 4000, k,
+                                            income_profile=prof))(
+        jax.random.PRNGKey(0))
+    a = np.asarray(out.assets)
+    peak = int(a.argmax())
+    assert retire_age - 8 <= peak <= retire_age + 2
+    assert a[peak] > 4 * a[10]          # accumulation through working life
+    assert a[-1] < 0.35 * a[peak]       # retirement drawdown
+    assert np.isfinite(np.asarray(out.consumption)).all()
+
+
+def test_survival_probabilities_lower_saving(model):
+    """Mortality risk discounts the future: with survival < 1 everywhere,
+    consumption at the same age and resources is higher."""
+    pol_immortal = solve_lifecycle(R, W, model, BETA, CRRA, horizon=30)
+    pol_mortal = solve_lifecycle(R, W, model, BETA, CRRA, horizon=30,
+                                 survival=jnp.full((30,), 0.95))
+    m_test = jnp.tile(jnp.linspace(2.0, 20.0, 8), (5, 1))
+    c_i = np.asarray(jax.vmap(lambda mk, ck, mq: jnp.interp(mq, mk, ck))(
+        pol_immortal.m_knots[0], pol_immortal.c_knots[0], m_test))
+    c_m = np.asarray(jax.vmap(lambda mk, ck, mq: jnp.interp(mq, mk, ck))(
+        pol_mortal.m_knots[0], pol_mortal.c_knots[0], m_test))
+    assert (c_m > c_i).all()

@@ -1,0 +1,177 @@
+"""Tests for value-function recovery and welfare analytics (models/value.py)
+— the working replacement for the reference's dead value machinery
+(``MargValueFunc2D``, ``Aiyagari_Support.py:71-102``, SURVEY.md §2.2 D1).
+
+Oracles: an exact closed-form value function (log utility, no labor income),
+the envelope condition against finite differences, and homogeneity-based
+welfare identities.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.models.household import (
+    build_simple_model,
+    solve_household,
+    stationary_wealth,
+)
+from aiyagari_hark_tpu.models.value import (
+    aggregate_welfare,
+    consumption_equivalent,
+    marginal_value_at,
+    policy_value,
+    value_at,
+)
+
+
+@pytest.fixture(scope="module")
+def stochastic_case():
+    model = build_simple_model(labor_states=5, a_count=48)
+    R, W, beta, crra = 1.02, 1.1, 0.96, 2.0
+    policy, _, _ = solve_household(R, W, model, beta, crra)
+    vf, it, diff = jax.jit(
+        lambda: policy_value(policy, R, W, model, beta, crra))()
+    assert float(diff) < 1e-9
+    return model, policy, vf, R, W, beta, crra
+
+
+def test_log_utility_closed_form():
+    """With log utility and no labor income (W=0), the problem is
+    cake-eating with return R: c = (1-beta) m exactly, and
+    v(m) = ln((1-beta)m)/(1-beta) + beta ln(R beta)/(1-beta)^2 + ln(1-beta)
+    terms — an exact oracle for both the EGM solver and the recovered value.
+    """
+    beta, R = 0.9, 1.05
+    model = build_simple_model(labor_states=1, a_count=64, a_max=100.0)
+    policy, _, _ = solve_household(R, 0.0, model, beta, 1.0)
+    m_test = jnp.asarray([[2.0, 10.0, 30.0]])
+    c = np.asarray(policy.c_knots)[0]
+    m = np.asarray(policy.m_knots)[0]
+    np.testing.assert_allclose(c[5:], (1 - beta) * m[5:], rtol=1e-5)
+
+    vf, _, diff = policy_value(policy, R, 0.0, model, beta, 1.0)
+    assert float(diff) < 1e-9
+    v = np.asarray(value_at(vf, m_test, 1.0))[0]
+    B = 1.0 / (1.0 - beta)
+    A = (np.log(1 - beta) + beta * B * np.log(R * beta)) / (1 - beta)
+    v_exact = A + B * np.log(np.asarray(m_test)[0])
+    np.testing.assert_allclose(v, v_exact, rtol=2e-4)
+
+
+def test_envelope_condition(stochastic_case):
+    """dv/dm = u'(c(m)) at interior points — the envelope theorem ties the
+    recovered level function to the policy it was built from."""
+    model, policy, vf, R, W, beta, crra = stochastic_case
+    m0 = jnp.linspace(3.0, 20.0, 6)
+    h = 1e-4
+    for s in (0, 2, 4):
+        v_hi = np.asarray(value_at(vf, m0 + h, crra, state_idx=s))
+        v_lo = np.asarray(value_at(vf, m0 - h, crra, state_idx=s))
+        dv = (v_hi - v_lo) / (2 * h)
+        vp = np.asarray(marginal_value_at(policy, m0, crra, state_idx=s))
+        # the finite difference reads the piecewise-linear segment slope, so
+        # agreement is limited by knot spacing (~0.5 near m=3), not by h
+        np.testing.assert_allclose(dv, vp, rtol=3e-2)
+
+
+def test_value_matches_monte_carlo_discounted_utility(stochastic_case):
+    """The strongest oracle: v(m0, s0) = E sum beta^t u(c_t) estimated by
+    forward-simulating the policy itself.  This is what exposed the
+    constrained-segment interpolation bias the ``constrained_knots``
+    augmentation now corrects (see ``policy_value`` docstring)."""
+    from aiyagari_hark_tpu.ops.interp import interp1d
+    from aiyagari_hark_tpu.ops.utility import crra_utility
+
+    model, policy, vf, R, W, beta, crra = stochastic_case
+    m0, s0 = 5.0, 2
+    v_rec = float(value_at(vf, jnp.asarray(m0), crra, state_idx=s0))
+
+    n_paths, horizon = 8000, 300
+    logp = jnp.log(model.transition)
+
+    def step(carry, key):
+        m, s, disc, acc = carry
+        c = jax.vmap(lambda mi, si: interp1d(mi, policy.m_knots[si],
+                                             policy.c_knots[si]))(m, s)
+        acc = acc + disc * crra_utility(c, crra)
+        s_new = jax.random.categorical(key, logp[s]).astype(s.dtype)
+        m_new = R * (m - c) + W * model.labor_levels[s_new]
+        return (m_new, s_new, disc * beta, acc), None
+
+    init = (jnp.full((n_paths,), m0),
+            jnp.full((n_paths,), s0, dtype=jnp.int32),
+            jnp.asarray(1.0), jnp.zeros((n_paths,)))
+    keys = jax.random.split(jax.random.PRNGKey(7), horizon)
+    (_, _, _, acc), _ = jax.lax.scan(step, init, keys)
+    mc = np.asarray(acc)
+    se = mc.std() / np.sqrt(n_paths)
+    # within 4 std errors + a small discretization allowance
+    assert abs(v_rec - mc.mean()) < 4 * se + 0.08, (v_rec, mc.mean(), se)
+
+
+def test_value_increasing_and_monotone_in_state(stochastic_case):
+    model, policy, vf, R, W, beta, crra = stochastic_case
+    m0 = jnp.linspace(1.0, 25.0, 10)
+    v_low = np.asarray(value_at(vf, m0, crra, state_idx=0))
+    v_high = np.asarray(value_at(vf, m0, crra, state_idx=4))
+    assert (np.diff(v_low) > 0).all() and (np.diff(v_high) > 0).all()
+    # better labor state => strictly better off at the same resources
+    assert (v_high > v_low).all()
+
+
+def test_aggregate_welfare_and_consumption_equivalent(stochastic_case):
+    model, policy, vf, R, W, beta, crra = stochastic_case
+    dist, _, _ = stationary_wealth(policy, R, W, model)
+    wel = float(aggregate_welfare(vf, dist, R, W, model, crra))
+    assert np.isfinite(wel)
+    # a 5% wage rise is a strict welfare improvement
+    policy2, _, _ = solve_household(R, 1.05 * W, model, beta, crra)
+    vf2, _, _ = policy_value(policy2, R, 1.05 * W, model, beta, crra)
+    wel2 = float(aggregate_welfare(vf2, dist, R, 1.05 * W, model, crra))
+    assert wel2 > wel
+    ce = float(consumption_equivalent(wel, wel2, crra, beta))
+    assert 0.0 < ce < 0.10
+    # identity: comparing an allocation with itself costs nothing
+    np.testing.assert_allclose(
+        float(consumption_equivalent(wel, wel, crra, beta)), 0.0, atol=1e-12)
+    # homogeneity oracle: scaling consumption by (1+g) scales v by
+    # (1+g)^(1-crra), so the recovered CE must be exactly g
+    g = 0.03
+    v_scaled = wel * (1 + g) ** (1 - crra)
+    np.testing.assert_allclose(
+        float(consumption_equivalent(wel, v_scaled, crra, beta)), g,
+        rtol=1e-10)
+
+
+def test_consumption_equivalent_log_branch():
+    beta = 0.95
+    # log utility: v shifts by ln(1+g)/(1-beta) under scaling
+    v = -12.0
+    g = 0.02
+    v_alt = v + np.log(1 + g) / (1 - beta)
+    np.testing.assert_allclose(
+        float(consumption_equivalent(v, v_alt, 1.0, beta)), g, rtol=1e-10)
+    # traced-crra path agrees with the static branch
+    f = jax.jit(lambda c: consumption_equivalent(v, v_alt, c, beta))
+    np.testing.assert_allclose(float(f(1.0)), g, rtol=1e-8)
+    np.testing.assert_allclose(
+        float(f(3.0)),
+        float(consumption_equivalent(v, v_alt, 3.0, beta)), rtol=1e-8)
+
+
+def test_welfare_sweepable_under_jit_and_vmap(stochastic_case):
+    """The whole recovery + welfare path compiles with traced scalars —
+    welfare rides the Table II sweep like everything else."""
+    model, policy, vf, R, W, beta, crra = stochastic_case
+
+    def welfare(w_scale):
+        p, _, _ = solve_household(R, w_scale * W, model, beta, crra)
+        v, _, _ = policy_value(p, R, w_scale * W, model, beta, crra)
+        dist, _, _ = stationary_wealth(p, R, w_scale * W, model)
+        return aggregate_welfare(v, dist, R, w_scale * W, model, crra)
+
+    out = jax.jit(jax.vmap(welfare))(jnp.asarray([1.0, 1.05]))
+    assert out.shape == (2,)
+    assert float(out[1]) > float(out[0])
