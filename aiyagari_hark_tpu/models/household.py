@@ -269,13 +269,21 @@ def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
     d_size = model.dist_grid.shape[0]
     n = model.labor_levels.shape[0]
     if method == "auto":
-        # Only TPU backends get the Pallas kernel ("axon" is the tunneled
-        # TPU platform in this environment); a CUDA/ROCm backend would hit
-        # unsupported Triton lowerings, so anything else takes the scatter
-        # path that works everywhere.
+        # TPU backends ("axon" is the tunneled TPU platform here) prefer the
+        # VMEM-resident Pallas kernel, probed once per process because Mosaic
+        # lowering gaps vary by TPU generation / jax version; if it is
+        # unusable they still take the MXU-friendly dense-matmul path rather
+        # than the scatter path (XLA serializes .at[].add on TPU).  CPU (and
+        # any other backend) takes the scatter path that works everywhere.
         on_tpu = jax.default_backend() in ("tpu", "axon")
         fits = n * d_size * d_size * dist0.dtype.itemsize <= 8 * 2 ** 20
-        method = "pallas" if (on_tpu and fits) else "scatter"
+        if on_tpu and fits:
+            from ..ops.pallas_kernels import pallas_tpu_available
+            method = "pallas" if pallas_tpu_available() else "dense"
+        elif on_tpu:
+            method = "dense"
+        else:
+            method = "scatter"
     if method == "pallas":
         from ..ops.pallas_kernels import stationary_dense_pallas
         S = dense_wealth_operator(trans, d_size)

@@ -105,6 +105,17 @@ class _PinnedSecant:
         self.i_prev, self.g_prev = i, g
         return cand
 
+    def to_array(self):
+        """(i_prev, g_prev, lo, hi) with NaN for unset — checkpoint form."""
+        import numpy as np
+        return np.asarray([np.nan if v is None else v for v in
+                           (self.i_prev, self.g_prev, self.lo, self.hi)])
+
+    def restore(self, arr) -> None:
+        import numpy as np
+        vals = [None if np.isnan(v) else float(v) for v in np.asarray(arr)]
+        self.i_prev, self.g_prev, self.lo, self.hi = vals
+
 
 @dataclass
 class KSIterationRecord:
@@ -266,10 +277,18 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
                else econ.t_discard)
     # fingerprint AFTER parameter resolution so a checkpoint written under a
     # different simulation mode (panel vs distribution, fan/pin settings) is
-    # refused, not silently resumed with the wrong rule class
-    fingerprint = config_fingerprint(agent, econ, mrkv_hist, ks_employment,
-                                     egm_tol, sim_method, dist_count,
-                                     dist_fan, dist_discard, dist_pin_slope)
+    # refused, not silently resumed with the wrong rule class.  Run-control
+    # fields (max_loops, verbose, tolerance) are excluded: resuming with a
+    # larger iteration budget or tighter tolerance IS the resume use case —
+    # it extends the same trajectory rather than defining a different run.
+    import dataclasses
+    econ_fp = tuple(sorted(
+        (k, v) for k, v in dataclasses.asdict(econ).items()
+        if k not in ("max_loops", "verbose", "tolerance")))
+    fingerprint = config_fingerprint(agent, econ_fp, mrkv_hist,
+                                     ks_employment, egm_tol, sim_method,
+                                     dist_count, dist_fan, dist_discard,
+                                     dist_pin_slope)
     pinned = sim_method == "distribution" and bool(dist_pin_slope)
     if pinned:
         secant = _PinnedSecant()
@@ -326,6 +345,10 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
         afunc = AFuncParams(
             intercept=jnp.asarray(ck.intercept, dtype=cal.a_grid.dtype),
             slope=jnp.asarray(ck.slope, dtype=cal.a_grid.dtype))
+        if pinned:
+            # continue the same secant trajectory (bracket + last residual),
+            # not a cold re-probe
+            secant.restore(ck.secant)
         resumed_converged = bool(ck.converged)
         # always leave at least one pass to (re)generate the policy/history
         # the checkpoint does not carry
@@ -397,7 +420,8 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
             converged = True
         if checkpoint_path is not None:
             save_ks_checkpoint(checkpoint_path, afunc, it + 1, seed,
-                               converged, fingerprint)
+                               converged, fingerprint,
+                               secant=secant.to_array() if pinned else None)
         if converged:
             break
 

@@ -40,17 +40,26 @@ def _fixed_point_kernel(S_ref, P_ref, d0_ref, out_ref, stats_ref, *,
     S = S_ref[:]          # [N, D, D] lottery operator
     P = P_ref[:]          # [N, N] labor mixing
     d0 = d0_ref[:]        # [D, N] initial distribution
+    n_states = S.shape[0]
 
     def push(dist):
-        moved = jnp.einsum("ndk,kn->dn", S, dist,
+        # batched matvec moved[:, i] = S[i] @ dist[:, i], written as a
+        # statically-unrolled list of plain 2D matmuls: Mosaic rejects the
+        # batched-dot dimension numbers the einsum formulation lowers to
+        # ("#tpu.dot_dimension_numbers ... expected integer value" on a
+        # v5-lite), and N is a small static constant anyway
+        cols = [jnp.matmul(S[i], dist[:, i:i + 1],
                            precision=jax.lax.Precision.HIGHEST)
+                for i in range(n_states)]
+        moved = jnp.concatenate(cols, axis=1)
         return jnp.matmul(moved, P, precision=jax.lax.Precision.HIGHEST)
 
     dist, it, diff = accelerated_distribution_fixed_point(
         push, d0, tol, max_iter, accel_every)
     out_ref[:] = dist
-    stats_ref[0, 0] = it.astype(d0.dtype)
-    stats_ref[0, 1] = diff.astype(d0.dtype)
+    # full-row store: Mosaic rejects scalar stores into a VMEM ref
+    stats_ref[:] = jnp.stack([it.astype(d0.dtype),
+                              diff.astype(d0.dtype)]).reshape(1, 2)
 
 
 def stationary_dense_pallas(S: jnp.ndarray, P: jnp.ndarray,
@@ -84,3 +93,25 @@ def stationary_dense_pallas(S: jnp.ndarray, P: jnp.ndarray,
     )
     dist, stats = call(S, P, dist0)
     return dist, stats[0, 0].astype(jnp.int32), stats[0, 1]
+
+
+@functools.lru_cache(maxsize=1)
+def pallas_tpu_available() -> bool:
+    """Whether the compiled Mosaic kernel actually works on the ambient TPU
+    backend — probed once per process by compiling and running a tiny
+    instance.  Guards the "auto" method choice: a Mosaic lowering gap (e.g.
+    the batched-dot attribute bug this kernel had to work around on a
+    v5-lite) must degrade to the XLA dense path, not kill the caller."""
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    try:
+        n, d = 2, 16
+        S = jnp.stack([jnp.eye(d), jnp.eye(d)])
+        P = jnp.full((n, n), 0.5)
+        d0 = jnp.full((d, n), 1.0 / (d * n))
+        dist, _, _ = stationary_dense_pallas(S, P, d0, tol=1e-6,
+                                             max_iter=8, interpret=False)
+        return bool(jnp.isfinite(dist).all())
+    except Exception:   # noqa: BLE001 — any compile/runtime failure means
+        # the kernel is unusable here; the caller falls back to XLA
+        return False

@@ -66,8 +66,11 @@ def load_pytree(path: str, like):
 class KSCheckpoint(NamedTuple):
     """Resumable state of the Krusell-Smith outer loop: the perceived rule,
     how many outer iterations produced it, the RNG seed that generated the
-    shock panel, and a fingerprint of the configuration that produced it
-    (SURVEY.md §5 'Checkpoint / resume')."""
+    shock panel, a fingerprint of the configuration that produced it
+    (SURVEY.md §5 'Checkpoint / resume'), and — for the slope-pinned
+    deterministic mode — the secant iteration's memory (previous iterate,
+    previous residual, bracket), so a resumed run continues the same
+    trajectory instead of re-probing from scratch."""
 
     intercept: np.ndarray    # [2]
     slope: np.ndarray        # [2]
@@ -75,6 +78,7 @@ class KSCheckpoint(NamedTuple):
     seed: np.ndarray         # scalar int
     converged: np.ndarray    # scalar bool
     fingerprint: np.ndarray  # scalar int64 — config hash
+    secant: np.ndarray       # [4] (i_prev, g_prev, lo, hi); NaN = unset
 
 
 def ks_checkpoint_template() -> KSCheckpoint:
@@ -82,7 +86,8 @@ def ks_checkpoint_template() -> KSCheckpoint:
         intercept=np.zeros(2), slope=np.zeros(2),
         iteration=np.zeros((), np.int64), seed=np.zeros((), np.int64),
         converged=np.zeros((), np.bool_),
-        fingerprint=np.zeros((), np.int64))
+        fingerprint=np.zeros((), np.int64),
+        secant=np.full((4,), np.nan))
 
 
 def config_fingerprint(*objs) -> int:
@@ -110,14 +115,17 @@ def config_fingerprint(*objs) -> int:
 
 
 def save_ks_checkpoint(path: str, afunc, iteration: int, seed: int,
-                       converged: bool, fingerprint: int = 0) -> None:
+                       converged: bool, fingerprint: int = 0,
+                       secant=None) -> None:
     save_pytree(path, KSCheckpoint(
         intercept=np.asarray(afunc.intercept),
         slope=np.asarray(afunc.slope),
         iteration=np.asarray(iteration, np.int64),
         seed=np.asarray(seed, np.int64),
         converged=np.asarray(converged, np.bool_),
-        fingerprint=np.asarray(fingerprint, np.int64)))
+        fingerprint=np.asarray(fingerprint, np.int64),
+        secant=(np.full((4,), np.nan) if secant is None
+                else np.asarray(secant, np.float64))))
 
 
 def load_ks_checkpoint(path: str) -> KSCheckpoint:

@@ -180,6 +180,34 @@ def test_initial_condition_fan_and_pooled_regression(cal, policy):
     assert (np.asarray(rsq) > 0.95).all()
 
 
+def test_pinned_resume_continues_secant_trajectory(tmp_path):
+    """Killing a pinned run and resuming from its checkpoint reproduces the
+    uninterrupted trajectory exactly — the secant memory (previous iterate,
+    residual, bracket) rides in the checkpoint."""
+    agent, econ = notebook_run_configs()
+    econ = econ.replace(act_T=800, t_discard=160, verbose=False,
+                        max_loops=15, tolerance=1e-3)
+    kwargs = dict(seed=0, sim_method="distribution", dist_count=200)
+    full = solve_ks_economy(agent, econ, **kwargs)
+    assert full.converged
+
+    ck = str(tmp_path / "pinned.npz")
+    part = solve_ks_economy(agent, econ.replace(max_loops=3), **kwargs,
+                            checkpoint_path=ck)
+    assert not part.converged
+    resumed = solve_ks_economy(agent, econ, **kwargs, checkpoint_path=ck)
+    assert resumed.converged
+    # same trajectory up to EGM-tolerance noise: the secant memory is
+    # restored exactly, but the EGM warm-start policy is not checkpointed,
+    # so each resumed household solve re-converges from cold within its
+    # 1e-6 tolerance — differences stay at that level, far inside the
+    # outer tolerance
+    np.testing.assert_allclose(np.asarray(resumed.afunc.intercept),
+                               np.asarray(full.afunc.intercept), atol=1e-5)
+    # and the resumed run did fewer iterations than the full one
+    assert len(resumed.records) < len(full.records)
+
+
 def test_sim_method_rejects_unknown():
     agent, econ = notebook_run_configs()
     with pytest.raises(ValueError, match="sim_method"):
