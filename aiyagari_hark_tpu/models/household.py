@@ -261,8 +261,10 @@ def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
     per-state lottery operator once and iterates batched matvecs
     (MXU-friendly — the TPU choice when ``N·D²`` fits on chip, see
     ``dense_wealth_operator``); "pallas" runs the whole dense fixed point
-    VMEM-resident in one kernel (``ops.pallas_kernels``); "auto" picks by
-    backend and size.
+    VMEM-resident in one kernel (``ops.pallas_kernels``); "solve" replaces
+    the fixed point with one dense LU solve + refinement (uniform cost per
+    cell — the skew-free choice under a vmapped sweep, see
+    ``_stationary_solve``); "auto" picks by backend and size.
     """
     trans = wealth_transition(policy, R, W, model)
     dist0 = initial_distribution(model) if init_dist is None else init_dist
@@ -276,41 +278,116 @@ def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
         # than the scatter path (XLA serializes .at[].add on TPU).  CPU (and
         # any other backend) takes the scatter path that works everywhere.
         on_tpu = jax.default_backend() in ("tpu", "axon")
-        fits = n * d_size * d_size * dist0.dtype.itemsize <= 8 * 2 ** 20
-        if on_tpu and fits:
+        op_bytes = n * d_size * d_size * dist0.dtype.itemsize
+        fits_vmem = op_bytes <= 8 * 2 ** 20
+        fits_hbm = op_bytes <= 2 ** 31   # dense operator must be buildable
+        if on_tpu and fits_vmem:
             from ..ops.pallas_kernels import pallas_tpu_available
             method = "pallas" if pallas_tpu_available() else "dense"
-        elif on_tpu:
+        elif on_tpu and fits_hbm:
             method = "dense"
         else:
-            method = "scatter"
+            method = "scatter"   # CPU, or operator too large to materialize
     if method == "pallas":
         from ..ops.pallas_kernels import stationary_dense_pallas
         S = dense_wealth_operator(trans, d_size)
         return stationary_dense_pallas(S, model.transition, dist0, tol,
                                        max_iter, accel_every)
+    if method == "solve":
+        S = dense_wealth_operator(trans, d_size)
+        return _stationary_solve(S, model.transition, dist0, tol)
     if method == "dense":
         S = dense_wealth_operator(trans, d_size)
         push = lambda d: _push_forward_dense(d, S, model.transition)  # noqa: E731
     elif method == "scatter":
         push = lambda d: _push_forward(d, trans, model.transition)  # noqa: E731
     else:
-        raise ValueError(f"method must be 'auto', 'scatter', 'dense' or "
-                         f"'pallas', got {method!r}")
+        raise ValueError(f"method must be 'auto', 'scatter', 'dense', "
+                         f"'pallas' or 'solve', got {method!r}")
     return accelerated_distribution_fixed_point(
         push, dist0, tol, max_iter, accel_every)
 
 
+def _stationary_solve(S, transition, dist0, tol, refine: int = 2,
+                      polish_max_iter: int = 20000):
+    """Stationary distribution by a DIRECT linear solve instead of power
+    iteration: the fixed point satisfies ``(I - A) x = 0`` with ``A`` the
+    dense push-forward operator, made nonsingular by replacing one equation
+    with the normalization ``sum x = 1`` (bordered system), then LU-solved.
+
+    Why: power iteration's cost is the chain's mixing time — the
+    high-persistence Table II cells (rho = 0.9) need ~10x the distribution
+    steps of the easy cells, and under the sweep's vmap-of-while every lane
+    waits for the slowest (the iteration-skew the bench records).  The
+    direct solve costs the same O((D N)^3) LU for every cell — MXU-friendly
+    and skew-free — at D*N = 3500 that is ~28 GFLOP, well under the
+    slow-mixing cells' iteration cost.
+
+    Accuracy: the bordered matrix's conditioning is ~1/(1 - lambda_2), poor
+    in f32 exactly for slow-mixing chains, so the solve gets ``refine``
+    rounds of iterative refinement (reusing the LU) and then a certified
+    warm-started fixed-point continuation down to ``tol`` — the caller's
+    tolerance contract holds exactly as for the iterative methods, with the
+    continuation normally exiting after a couple of push-forwards.
+    """
+    n, d, _ = S.shape
+    dtype = dist0.dtype
+    T = jnp.transpose(S, (1, 2, 0))                       # [D', D, N]
+    A = (T[:, None, :, :]
+         * transition.T[None, :, None, :]).reshape(d * n, d * n)
+    B = (jnp.eye(d * n, dtype=dtype) - A).at[-1, :].set(1.0)
+    rhs = jnp.zeros((d * n,), dtype=dtype).at[-1].set(1.0)
+    lu, piv = jax.scipy.linalg.lu_factor(B)
+    x = jax.scipy.linalg.lu_solve((lu, piv), rhs)
+    for _ in range(refine):
+        resid = rhs - jnp.matmul(B, x, precision=jax.lax.Precision.HIGHEST)
+        x = x + jax.scipy.linalg.lu_solve((lu, piv), resid)
+    x = jnp.clip(x, 0.0, None)
+    dist = (x / jnp.sum(x)).reshape(d, n)
+    # Certified continuation to the REQUESTED tol: warm-started accelerated
+    # power iteration from the solved point.  When the LU+refinement was
+    # accurate (the usual case) this exits in a couple of push-forwards and
+    # the per-cell cost stays uniform; when f32 conditioning left residual
+    # error (slow-mixing chains), it iterates it away instead of silently
+    # returning a distribution that misses the caller's dist_tol — the
+    # bisection relies on every midpoint meeting the full tolerance.
+    push = lambda dd: _push_forward_dense(dd, S, transition)   # noqa: E731
+    # aggressive Aitken (short period, near-1 rate cap): the remaining error
+    # after the LU sits almost entirely in the slowest mode, exactly what
+    # the extrapolation removes — and certification makes overshoot safe
+    dist, it, diff = accelerated_distribution_fixed_point(
+        push, dist, tol, polish_max_iter, accel_every=16, lam_max=0.9999)
+    return dist, it + jnp.asarray(refine + 1), diff
+
+
 def accelerated_distribution_fixed_point(push, dist0, tol, max_iter,
-                                         accel_every: int = 64):
+                                         accel_every: int = 64,
+                                         lam_max: float = 0.995):
     """Iterate ``dist <- push(dist)`` to its fixed point with periodic
     Anderson(1)/Aitken extrapolation (see ``stationary_wealth``), for any
-    mass-conserving push-forward operator.  Returns (dist, n_iter, diff)."""
+    mass-conserving push-forward operator.  Returns (dist, n_iter, diff).
+
+    ``lam_max`` caps the estimated contraction rate (extrapolation factor
+    ``lam/(1-lam)``).  The default is conservative for cold starts; a
+    warm start that is already near the fixed point (e.g. the direct-solve
+    continuation) can afford a cap much closer to 1 — the extrapolation is
+    clipped to nonnegative mass and renormalized, and convergence is still
+    certified by a plain-step diff, so an overshoot costs iterations, not
+    correctness.
+
+    Stall exit: if the certified diff makes no new best for 512 consecutive
+    steps, the iteration stops and reports the best achieved diff — the
+    requested ``tol`` may sit below the dtype's rounding floor for a
+    slow-mixing chain (observed in f32 around 1e-8..3e-8), and burning
+    ``max_iter`` steps against an unreachable tolerance starves every other
+    lane of a vmapped batch.  Callers see the honest residual either way.
+    """
     big = jnp.asarray(jnp.inf, dtype=dist0.dtype)
+    stall_window = 512
 
     def cond(state):
-        _, _, diff, it = state
-        return (diff > tol) & (it < max_iter)
+        _, _, diff, it, _, since = state
+        return (diff > tol) & (it < max_iter) & (since < stall_window)
 
     def step(dist, prev, it):
         new = push(dist)
@@ -324,7 +401,7 @@ def accelerated_distribution_fixed_point(push, dist0, tol, max_iter,
         d2 = new - dist                     # increment t
         lam = jnp.sum(d2 * d1) / jnp.maximum(jnp.sum(d1 * d1),
                                              jnp.finfo(new.dtype).tiny)
-        lam = jnp.clip(lam, 0.0, 0.995)
+        lam = jnp.clip(lam, 0.0, lam_max)
         extrap = jnp.clip(new + lam / (1.0 - lam) * d2, 0.0, None)
         extrap = extrap / jnp.sum(extrap)
         # If this plain step already converged, the loop exits now — return
@@ -334,13 +411,18 @@ def accelerated_distribution_fixed_point(push, dist0, tol, max_iter,
         return out, new, diff, it + 1
 
     def body(state):
-        dist, prev, _, it = state
+        dist, prev, _, it, best, since = state
         use_accel = (accel_every > 0) & (jnp.mod(it + 1, max(accel_every, 1))
                                          == 0)
-        return jax.lax.cond(use_accel, step_accel, step, dist, prev, it)
+        dist, prev, diff, it = jax.lax.cond(use_accel, step_accel, step,
+                                            dist, prev, it)
+        improved = diff < best
+        best = jnp.minimum(best, diff)
+        since = jnp.where(improved, 0, since + 1)
+        return dist, prev, diff, it, best, since
 
-    dist, _, diff, it = jax.lax.while_loop(
-        cond, body, (dist0, dist0, big, jnp.asarray(0)))
+    dist, _, diff, it, _, _ = jax.lax.while_loop(
+        cond, body, (dist0, dist0, big, jnp.asarray(0), big, jnp.asarray(0)))
     return dist, it, diff
 
 

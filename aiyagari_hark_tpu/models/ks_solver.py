@@ -349,7 +349,12 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
             # continue the same secant trajectory (bracket + last residual),
             # not a cold re-probe
             secant.restore(ck.secant)
-        resumed_converged = bool(ck.converged)
+        # a checkpoint is only "converged" relative to the tolerance it was
+        # written under (excluded from the fingerprint so resumes may
+        # tighten it); re-check against the CURRENT tolerance so a resume
+        # with a tighter one keeps iterating instead of short-circuiting
+        resumed_converged = bool(ck.converged) and (
+            float(ck.last_distance) < econ.tolerance)
         # always leave at least one pass to (re)generate the policy/history
         # the checkpoint does not carry
         it_start = max(0, min(int(ck.iteration), econ.max_loops - 1))
@@ -421,7 +426,8 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
         if checkpoint_path is not None:
             save_ks_checkpoint(checkpoint_path, afunc, it + 1, seed,
                                converged, fingerprint,
-                               secant=secant.to_array() if pinned else None)
+                               secant=secant.to_array() if pinned else None,
+                               last_distance=distance)
         if converged:
             break
 

@@ -79,6 +79,7 @@ class KSCheckpoint(NamedTuple):
     converged: np.ndarray    # scalar bool
     fingerprint: np.ndarray  # scalar int64 — config hash
     secant: np.ndarray       # [4] (i_prev, g_prev, lo, hi); NaN = unset
+    last_distance: np.ndarray  # scalar: rule distance at the saved iteration
 
 
 def ks_checkpoint_template() -> KSCheckpoint:
@@ -87,7 +88,8 @@ def ks_checkpoint_template() -> KSCheckpoint:
         iteration=np.zeros((), np.int64), seed=np.zeros((), np.int64),
         converged=np.zeros((), np.bool_),
         fingerprint=np.zeros((), np.int64),
-        secant=np.full((4,), np.nan))
+        secant=np.full((4,), np.nan),
+        last_distance=np.full((), np.inf))
 
 
 def config_fingerprint(*objs) -> int:
@@ -116,7 +118,7 @@ def config_fingerprint(*objs) -> int:
 
 def save_ks_checkpoint(path: str, afunc, iteration: int, seed: int,
                        converged: bool, fingerprint: int = 0,
-                       secant=None) -> None:
+                       secant=None, last_distance: float = np.inf) -> None:
     save_pytree(path, KSCheckpoint(
         intercept=np.asarray(afunc.intercept),
         slope=np.asarray(afunc.slope),
@@ -125,7 +127,8 @@ def save_ks_checkpoint(path: str, afunc, iteration: int, seed: int,
         converged=np.asarray(converged, np.bool_),
         fingerprint=np.asarray(fingerprint, np.int64),
         secant=(np.full((4,), np.nan) if secant is None
-                else np.asarray(secant, np.float64))))
+                else np.asarray(secant, np.float64)),
+        last_distance=np.asarray(last_distance, np.float64)))
 
 
 def load_ks_checkpoint(path: str) -> KSCheckpoint:

@@ -206,6 +206,13 @@ def test_pinned_resume_continues_secant_trajectory(tmp_path):
                                np.asarray(full.afunc.intercept), atol=1e-5)
     # and the resumed run did fewer iterations than the full one
     assert len(resumed.records) < len(full.records)
+    # resuming a CONVERGED checkpoint with a tighter tolerance must keep
+    # iterating (the stored last_distance fails the new tolerance), not
+    # short-circuit through the idempotent-reload path
+    tighter = solve_ks_economy(agent, econ.replace(tolerance=1e-5),
+                               **kwargs, checkpoint_path=ck)
+    assert len(tighter.records) > 0
+    assert tighter.records[-1].distance < 1e-5
 
 
 def test_sim_method_rejects_unknown():

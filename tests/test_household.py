@@ -146,6 +146,12 @@ def test_stationary_methods_agree(model, prices, solved):
         np.testing.assert_allclose(np.asarray(d), np.asarray(ref),
                                    atol=1e-9, err_msg=method)
         assert int(it) > 0 and float(diff) <= 1e-11
+    # the direct LU solve targets the same fixed point but certifies via a
+    # plain-step residual rather than iterating to 1e-11
+    d, it, diff = stationary_wealth(policy, R, W, model, method="solve")
+    np.testing.assert_allclose(np.asarray(d), np.asarray(ref),
+                               atol=1e-8, err_msg="solve")
+    assert float(diff) < 1e-9
     with pytest.raises(ValueError):
         stationary_wealth(policy, R, W, model, method="bogus")
 
