@@ -264,10 +264,16 @@ class AiyagariEconomy:
         return self.MrkvNow_hist
 
     # -- solve -------------------------------------------------------------
-    def solve(self, ks_employment: bool = False, dtype=None) -> KSSolution:
+    def solve(self, ks_employment: bool = False, dtype=None,
+              **solve_kwargs) -> KSSolution:
         """Run the Krusell-Smith fixed point and populate the reference's
         result surface.  With ``backend`` set on the economy, the platform/
-        dtype/precision are resolved coherently first (utils.backend)."""
+        dtype/precision are resolved coherently first (utils.backend).
+
+        Extra keyword arguments flow to ``solve_ks_economy`` — notably
+        ``sim_method="distribution"`` selects the deterministic histogram
+        simulator (``reap_state["aNow"]`` then carries the histogram support
+        with weights in ``reap_state["aNowWeights"]``)."""
         if not self.agents:
             raise ValueError("economy.agents is empty — assign "
                              "[AiyagariType(...)] before solve()")
@@ -280,7 +286,7 @@ class AiyagariEconomy:
         sol = solve_ks_economy(
             agent.agent_config(), self._economy_config_for(agent),
             seed=self.seed, ks_employment=ks_employment, dtype=dtype,
-            mrkv_hist=self.MrkvNow_hist)
+            mrkv_hist=self.MrkvNow_hist, **solve_kwargs)
         self.solution = sol
         self._populate_results(sol, agent)
         return sol
@@ -304,10 +310,20 @@ class AiyagariEconomy:
             "Mrkv": int(final.mrkv), "Rnow": float(final.R_now),
             "Wnow": float(final.W_now),
         }
-        self.reap_state = {
-            "aNow": [np.asarray(final.assets)],
-            "EmpNow": [np.asarray(final.employed)],
-        }
+        if hasattr(final, "assets"):      # Monte-Carlo panel (PanelState)
+            self.reap_state = {
+                "aNow": [np.asarray(final.assets)],
+                "EmpNow": [np.asarray(final.employed)],
+            }
+        else:                             # DistPanelState histogram
+            masses = np.asarray(final.dist)          # [D, N, 2]
+            self.reap_state = {
+                # weighted support of the wealth histogram: analytics take
+                # (values, weights) pairs (utils.stats all accept weights)
+                "aNow": [np.asarray(sol.dist_grid)],
+                "aNowWeights": [masses.sum(axis=(1, 2))],
+                "EmpNow": [masses[:, :, 1].sum()],   # employed mass share
+            }
         self.history = {
             "Mrkv": np.asarray(hist.mrkv), "Aprev": np.asarray(hist.A_prev),
             "Mnow": np.asarray(hist.M_now), "Urate": np.asarray(hist.urate),

@@ -352,11 +352,8 @@ def _stationary_solve(S, transition, dist0, tol, refine: int = 2,
     # returning a distribution that misses the caller's dist_tol — the
     # bisection relies on every midpoint meeting the full tolerance.
     push = lambda dd: _push_forward_dense(dd, S, transition)   # noqa: E731
-    # aggressive Aitken (short period, near-1 rate cap): the remaining error
-    # after the LU sits almost entirely in the slowest mode, exactly what
-    # the extrapolation removes — and certification makes overshoot safe
     dist, it, diff = accelerated_distribution_fixed_point(
-        push, dist, tol, polish_max_iter, accel_every=16, lam_max=0.9999)
+        push, dist, tol, polish_max_iter)
     return dist, it + jnp.asarray(refine + 1), diff
 
 
@@ -376,23 +373,26 @@ def accelerated_distribution_fixed_point(push, dist0, tol, max_iter,
     correctness.
 
     Stall exit: if the certified diff makes no new best for 512 consecutive
-    steps, the iteration stops and reports the best achieved diff — the
-    requested ``tol`` may sit below the dtype's rounding floor for a
-    slow-mixing chain (observed in f32 around 1e-8..3e-8), and burning
-    ``max_iter`` steps against an unreachable tolerance starves every other
-    lane of a vmapped batch.  Callers see the honest residual either way.
+    steps, the iteration stops — the requested ``tol`` may sit below the
+    dtype's rounding floor for a slow-mixing chain (observed in f32 around
+    1e-8..3e-8), and burning ``max_iter`` steps against an unreachable
+    tolerance starves every other lane of a vmapped batch.  The BEST
+    certified (iterate, diff) pair seen is what is returned (the current
+    iterate can be worse, e.g. mid-recovery from an extrapolation
+    overshoot), so callers always get the honest best residual.
     """
     big = jnp.asarray(jnp.inf, dtype=dist0.dtype)
     stall_window = 512
 
     def cond(state):
-        _, _, diff, it, _, since = state
+        _, _, diff, it, _, _, since = state
         return (diff > tol) & (it < max_iter) & (since < stall_window)
 
     def step(dist, prev, it):
         new = push(dist)
         diff = jnp.max(jnp.abs(new - dist))
-        return new, dist, diff, it + 1
+        # last element: the iterate the certified diff describes
+        return new, dist, diff, it + 1, new
 
     def step_accel(dist, prev, it):
         new = push(dist)
@@ -404,26 +404,27 @@ def accelerated_distribution_fixed_point(push, dist0, tol, max_iter,
         lam = jnp.clip(lam, 0.0, lam_max)
         extrap = jnp.clip(new + lam / (1.0 - lam) * d2, 0.0, None)
         extrap = extrap / jnp.sum(extrap)
-        # If this plain step already converged, the loop exits now — return
-        # the CERTIFIED iterate, not the unchecked extrapolation, so the
-        # (dist, diff) pair returned always describes a plain-step result.
+        # If this plain step already converged, the loop exits now — carry
+        # the CERTIFIED iterate, not the unchecked extrapolation.
         out = jnp.where(diff <= tol, new, extrap)
-        return out, new, diff, it + 1
+        return out, new, diff, it + 1, new
 
     def body(state):
-        dist, prev, _, it, best, since = state
+        dist, prev, _, it, best, best_dist, since = state
         use_accel = (accel_every > 0) & (jnp.mod(it + 1, max(accel_every, 1))
                                          == 0)
-        dist, prev, diff, it = jax.lax.cond(use_accel, step_accel, step,
-                                            dist, prev, it)
+        dist, prev, diff, it, certified = jax.lax.cond(
+            use_accel, step_accel, step, dist, prev, it)
         improved = diff < best
+        best_dist = jnp.where(improved, certified, best_dist)
         best = jnp.minimum(best, diff)
         since = jnp.where(improved, 0, since + 1)
-        return dist, prev, diff, it, best, since
+        return dist, prev, diff, it, best, best_dist, since
 
-    dist, _, diff, it, _, _ = jax.lax.while_loop(
-        cond, body, (dist0, dist0, big, jnp.asarray(0), big, jnp.asarray(0)))
-    return dist, it, diff
+    _, _, _, it, best, best_dist, _ = jax.lax.while_loop(
+        cond, body,
+        (dist0, dist0, big, jnp.asarray(0), big, dist0, jnp.asarray(0)))
+    return best_dist, it, best
 
 
 def aggregate_capital(dist: jnp.ndarray, model: SimpleModel) -> jnp.ndarray:

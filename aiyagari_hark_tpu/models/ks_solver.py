@@ -139,6 +139,8 @@ class KSSolution:
     history: PanelHistory
     mrkv_hist: object = None     # [T] aggregate-state chain used
     final_panel: object = None   # PanelState at the last simulated period
+    # (``DistPanelState`` under sim_method="distribution")
+    dist_grid: object = None     # [D] histogram support (distribution mode)
     records: List[KSIterationRecord] = field(default_factory=list)
     converged: bool = False
 
@@ -312,11 +314,24 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
     def finalize(history, final_panel):
         """Collapse the fan axis to the central (factor ~1.0) path so
         ``KSSolution.history``/``final_panel`` keep the single-path
-        contract regardless of ``sim_method``."""
+        contract regardless of ``sim_method`` — and flag histogram-top
+        truncation, which can silently absorb a divergent wealth tail and
+        stabilize a pseudo-equilibrium (an r* above 1/beta - 1 is the
+        telltale: true supply there is infinite)."""
         if sim_method == "distribution":   # fan axis exists even for fan=1
             c = dist_fan // 2
             history = jax.tree.map(lambda x: x[c], history)
             final_panel = jax.tree.map(lambda x: x[c], final_panel)
+            top_mass = float(final_panel.dist[-1].sum())
+            if top_mass > 1e-6:
+                import warnings
+                warnings.warn(
+                    f"histogram top node holds {top_mass:.2e} mass — the "
+                    f"ergodic wealth tail is being truncated at "
+                    f"dist_grid[-1] and the reported equilibrium may be a "
+                    f"clip artifact (check r* < 1/beta - 1; raise "
+                    f"make_sim_dist_grid's top_factor or refine the "
+                    f"solution grids)", stacklevel=2)
         return history, final_panel
 
     afunc = AFuncParams(
@@ -375,8 +390,10 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
         history, final_panel = finalize(history, final_panel)
         return KSSolution(afunc=afunc, policy=policy, calibration=cal,
                           history=history, mrkv_hist=mrkv_hist,
-                          final_panel=final_panel, records=[],
-                          converged=True)
+                          final_panel=final_panel,
+                          dist_grid=(dist_grid if sim_method == "distribution"
+                                     else None),
+                          records=[], converged=True)
 
     records: List[KSIterationRecord] = []
     history = None
@@ -434,5 +451,7 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
     history, final_panel = finalize(history, final_panel)
     return KSSolution(afunc=afunc, policy=policy, calibration=cal,
                       history=history, mrkv_hist=mrkv_hist,
-                      final_panel=final_panel, records=records,
-                      converged=converged)
+                      final_panel=final_panel,
+                      dist_grid=(dist_grid if sim_method == "distribution"
+                                 else None),
+                      records=records, converged=converged)

@@ -131,5 +131,49 @@ def save_ks_checkpoint(path: str, afunc, iteration: int, seed: int,
         last_distance=np.asarray(last_distance, np.float64)))
 
 
+class _KSCheckpointV1(NamedTuple):
+    """Round-1 layout (no secant memory, no last_distance)."""
+
+    intercept: np.ndarray
+    slope: np.ndarray
+    iteration: np.ndarray
+    seed: np.ndarray
+    converged: np.ndarray
+    fingerprint: np.ndarray
+
+
+class _KSCheckpointV2(NamedTuple):
+    """Intermediate layout (secant memory, no last_distance)."""
+
+    intercept: np.ndarray
+    slope: np.ndarray
+    iteration: np.ndarray
+    seed: np.ndarray
+    converged: np.ndarray
+    fingerprint: np.ndarray
+    secant: np.ndarray
+
+
 def load_ks_checkpoint(path: str) -> KSCheckpoint:
-    return load_pytree(path, ks_checkpoint_template())
+    """Load a KS checkpoint, migrating older layouts in place of failing.
+
+    Missing fields get conservative defaults: ``secant`` unset (the pinned
+    iteration re-probes) and ``last_distance`` +inf — a migrated
+    "converged" checkpoint therefore re-runs at least one outer iteration
+    against the CURRENT tolerance instead of short-circuiting, which costs
+    one iteration and can never return a stale convergence claim."""
+    try:
+        return load_pytree(path, ks_checkpoint_template())
+    except ValueError:
+        pass
+    zeros6 = (np.zeros(2), np.zeros(2), np.zeros((), np.int64),
+              np.zeros((), np.int64), np.zeros((), np.bool_),
+              np.zeros((), np.int64))
+    try:
+        old = load_pytree(path, _KSCheckpointV2(*zeros6,
+                                                secant=np.zeros(4)))
+        return KSCheckpoint(*old, last_distance=np.asarray(np.inf))
+    except ValueError:
+        old = load_pytree(path, _KSCheckpointV1(*zeros6))
+        return KSCheckpoint(*old, secant=np.full((4,), np.nan),
+                            last_distance=np.asarray(np.inf))

@@ -92,7 +92,10 @@ def wealth_stats(assets, weights=None) -> WealthStats:
     w = np.asarray(weights, dtype=np.float64).ravel()
     mean = float(np.average(a, weights=w))
     var = float(np.average((a - mean) ** 2, weights=w))
-    return WealthStats(max=float(a.max()), mean=mean, std=var ** 0.5,
+    # max over the OCCUPIED support: histogram inputs carry zero-weight
+    # grid nodes above the ergodic right tail
+    occupied = a[w > 1e-12 * w.sum()]
+    return WealthStats(max=float(occupied.max()), mean=mean, std=var ** 0.5,
                        median=float(get_percentiles(a, w, (0.5,))[0]))
 
 

@@ -60,6 +60,13 @@ def main(argv=None):
     ap.add_argument("--output-dir", default=".",
                     help="where runtime.txt / results.json go")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sim-method", default="panel",
+                    choices=["panel", "distribution"],
+                    help="'panel' = reference-parity Monte-Carlo agents; "
+                         "'distribution' = deterministic histogram "
+                         "simulator + slope-pinned secant equilibrium "
+                         "(matches the bisection engine, not the "
+                         "reference's MC-attenuated KS fit)")
     ap.add_argument("--scf-csv", default=None,
                     help="wealth,weight CSV exported from HARK's "
                          "load_SCF_wealth_weights; without it the Lorenz "
@@ -112,7 +119,7 @@ def main(argv=None):
           f"Aiyagari (1994) model...")
     t0 = time.time()
     with timer.phase("solve"):
-        sol = economy.solve(dtype=info.dtype)
+        sol = economy.solve(dtype=info.dtype, sim_method=args.sim_method)
     solve_minutes = (time.time() - t0) / 60.0
     print(f"Solving the Aiyagari model took {solve_minutes:.3f} minutes "
           f"(reference: 27.12 minutes). converged={sol.converged}")
@@ -121,7 +128,12 @@ def main(argv=None):
 
     # -- equilibrium stats (cell 20 / Aiyagari-HARK.py:257-258)
     depr = econ_dict["DeprFac"]
-    a_mean = float(np.mean(economy.reap_state["aNow"]))
+    sim_weights = economy.reap_state.get("aNowWeights", [None])[0]
+    if sim_weights is None:
+        a_mean = float(np.mean(economy.reap_state["aNow"]))
+    else:   # distribution mode: histogram support + weights
+        a_mean = float(np.average(economy.reap_state["aNow"][0],
+                                  weights=sim_weights))
     r_pct = (economy.sow_state["Rnow"] - 1.0) * 100.0
     saving_pct = 100.0 * depr * a_mean / (
         economy.sow_state["Mnow"] - (1.0 - depr) * a_mean)
@@ -163,7 +175,7 @@ def main(argv=None):
 
     # -- wealth stats (cell 24)
     sim_wealth = np.asarray(economy.reap_state["aNow"][0])
-    ws = stats.wealth_stats(sim_wealth)
+    ws = stats.wealth_stats(sim_wealth, sim_weights)
     print(f"Simulated wealth: max={ws.max:.3f} mean={ws.mean:.3f} "
           f"std={ws.std:.3f} median={ws.median:.3f} "
           f"(reference 22.046 / 5.439 / 3.697 / 4.718)")
@@ -182,7 +194,8 @@ def main(argv=None):
             scf_label = "SCF (synthetic stand-in)"
         scf_lorenz = stats.get_lorenz_shares(
             scf_wealth, weights=scf_weights, percentiles=pctiles)
-        sim_lorenz = stats.get_lorenz_shares(sim_wealth, percentiles=pctiles)
+        sim_lorenz = stats.get_lorenz_shares(sim_wealth, weights=sim_weights,
+                                             percentiles=pctiles)
         lorenz_dist = float(np.sqrt(np.sum((scf_lorenz - sim_lorenz) ** 2)))
 
         fig = plt.figure(figsize=(5, 5))
@@ -214,6 +227,7 @@ def main(argv=None):
         "backend": info.name,
         "x64": info.x64,
         "quick": args.quick,
+        "sim_method": args.sim_method,
         "converged": bool(sol.converged),
         "outer_iterations": len(sol.records),
         "equilibrium_return_pct": r_pct,

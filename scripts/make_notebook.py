@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Generate (and optionally execute) the driver notebook
+``Aiyagari-HARK-tpu.ipynb`` — the framework's analog of the reference's
+canonical entry point (``Aiyagari-HARK.ipynb``, SURVEY.md §2.1 C6).
+
+The notebook mirrors the reference's cell flow (build -> solve ->
+equilibrium stats -> consumption/saving-rule plots -> wealth stats ->
+Lorenz vs SCF -> runtime) through this framework's facade, so a reference
+user can follow the same narrative.  ``reproduce.py`` remains the scripted
+equivalent; the notebook is the human-readable tour.
+
+Usage: python scripts/make_notebook.py [--execute] [--quick]
+"""
+
+import argparse
+import os
+import sys
+
+import nbformat as nbf
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def build(quick: bool) -> nbf.NotebookNode:
+    nb = nbf.v4.new_notebook()
+    md = nbf.v4.new_markdown_cell
+    code = nbf.v4.new_code_cell
+    cfg_quick = ("econ_dict.update(LaborStatesNo=5, act_T=600, "
+                 "T_discard=120)\n"
+                 "agent_dict.update(LaborStatesNo=5, AgentCount=100, "
+                 "aCount=16)\n" if quick else "")
+    cells = [
+        md("# Aiyagari (1994) on TPU — driver notebook\n\n"
+           "TPU-native (JAX/XLA) replication of *Uninsured Idiosyncratic "
+           "Risk and Aggregate Saving*, with the capabilities of the "
+           "`Aiyagari-HARK` reference replication.  This notebook follows "
+           "the reference notebook's flow (its cells 13–30): build the "
+           "economy and agents, solve the Krusell–Smith general "
+           "equilibrium, then reproduce the equilibrium statistics, "
+           "consumption functions, aggregate saving rule, wealth "
+           "distribution, and Lorenz comparison.\n\n"
+           "Reference golden numbers: equilibrium return **4.178 %**, "
+           "saving rate **23.649 %**, `economy.solve()` wall-clock "
+           "**27.12 min** (this framework: seconds)."),
+        code("import time\n\n"
+             "import matplotlib.pyplot as plt\n"
+             "import numpy as np\n\n"
+             "from aiyagari_hark_tpu import (AiyagariEconomy, AiyagariType,\n"
+             "                               init_aiyagari_agents,\n"
+             "                               init_aiyagari_economy)\n"
+             "from aiyagari_hark_tpu.utils import stats\n"
+             "from aiyagari_hark_tpu.utils.backend import select_backend\n\n"
+             "info = select_backend('auto')\n"
+             "print(f'backend={info.name} x64={info.x64}')"),
+        md("## Build the economy and agents\n\n"
+           "Parameter dictionaries use the reference's exact spelling and "
+           "defaults (`init_Aiyagari_agents`/`init_Aiyagari_economy`, "
+           "`Aiyagari_Support.py:752-757,1525-1551`); the notebook "
+           "calibration overrides match its cells 16–17."),
+        code("econ_dict = init_aiyagari_economy()\n"
+             "econ_dict.update(LaborAR=0.3, LaborSD=0.2, CRRA=1.0, "
+             "verbose=False)\n"
+             "agent_dict = init_aiyagari_agents()\n"
+             "agent_dict.update(AgentCount=350)\n"
+             + cfg_quick +
+             "economy = AiyagariEconomy(seed=0, **econ_dict)\n"
+             "agent = AiyagariType(**agent_dict)\n"
+             "agent.cycles = 0\n"
+             "agent.get_economy_data(economy)\n"
+             "economy.agents = [agent]\n"
+             "economy.make_Mrkv_history()\n"
+             "print(f'KSS={economy.KSS:.4f}  MSS={economy.MSS:.4f}')"),
+        md("## Solve for the general equilibrium\n\n"
+           "The reference's `economy.solve()` took **27.12 minutes** "
+           "(notebook cell 19).  Here the same Krusell–Smith fixed point — "
+           "EGM household solve, 11,000-period panel, saving-rule "
+           "regression — runs as three jitted XLA programs per outer "
+           "iteration."),
+        code("t0 = time.time()\n"
+             "sol = economy.solve(dtype=info.dtype)\n"
+             "mins = (time.time() - t0) / 60\n"
+             "print(f'Solving the Aiyagari model took {mins:.3f} minutes '\n"
+             "      f'(reference: 27.12).  converged={sol.converged} in '\n"
+             "      f'{len(sol.records)} outer iterations')"),
+        md("## Equilibrium statistics (reference cell 20)"),
+        code("depr = econ_dict['DeprFac']\n"
+             "a_mean = float(np.mean(economy.reap_state['aNow']))\n"
+             "r_pct = (economy.sow_state['Rnow'] - 1.0) * 100.0\n"
+             "s_pct = 100.0 * depr * a_mean / (economy.sow_state['Mnow']\n"
+             "                                 - (1 - depr) * a_mean)\n"
+             "print(f'Equilibrium Return to Capital: {r_pct:.4f} % "
+             "(reference 4.178 %)')\n"
+             "print(f'Equilibrium Savings Rate: {s_pct:.4f} % "
+             "(reference 23.649 %)')"),
+        md("## Consumption functions by labor-supply state "
+           "(reference cell 21)\n\nOne panel per labor state; each line is "
+           "one aggregate-resources gridpoint of the two-level policy "
+           "`solution[0].cFunc[4j].xInterpolators`."),
+        code("n = econ_dict['LaborStatesNo']\n"
+             "fig, axes = plt.subplots(1, n, figsize=(2.6 * n, 2.8), "
+             "sharey=True)\n"
+             "m = np.linspace(0.0, 50.0, 200)\n"
+             "for j, ax in enumerate(np.atleast_1d(axes)):\n"
+             "    for interp in agent.solution[0].cFunc[4 * j]"
+             ".xInterpolators:\n"
+             "        ax.plot(m, interp(m), lw=0.8)\n"
+             "    ax.set_title(f'labor state {j + 1}/{n}', fontsize=9)\n"
+             "plt.tight_layout(); plt.show()"),
+        md("## Aggregate saving rule (reference cell 22)"),
+        code("x = np.linspace(0.1, 2.0 * economy.KSS, 500)\n"
+             "plt.plot(x, economy.AFunc[0](x), label='bad state')\n"
+             "plt.plot(x, economy.AFunc[1](x), '--', label='good state')\n"
+             "plt.xlabel('Aggregate market resources $M$')\n"
+             "plt.ylabel('Aggregate savings $A$')\n"
+             "plt.legend(); plt.show()"),
+        md("## Simulated wealth distribution (reference cells 24–27)"),
+        code("sim_wealth = np.asarray(economy.reap_state['aNow'][0])\n"
+             "ws = stats.wealth_stats(sim_wealth)\n"
+             "print(f'max={ws.max:.3f} mean={ws.mean:.3f} std={ws.std:.3f} '\n"
+             "      f'median={ws.median:.3f}  (reference 22.046 / 5.439 / '\n"
+             "      f'3.697 / 4.718)')\n"
+             "pct = np.linspace(0.01, 0.999, 15)\n"
+             "scf_w, scf_wt = stats.synthetic_scf_wealth()\n"
+             "lor_scf = stats.get_lorenz_shares(scf_w, weights=scf_wt, "
+             "percentiles=pct)\n"
+             "lor_sim = stats.get_lorenz_shares(sim_wealth, "
+             "percentiles=pct)\n"
+             "plt.figure(figsize=(5, 5))\n"
+             "plt.plot(pct, lor_scf, '--k', label='SCF (synthetic "
+             "stand-in)')\n"
+             "plt.plot(pct, lor_sim, '-b', label='Aiyagari')\n"
+             "plt.plot(pct, pct, 'g-.', label='45 degree')\n"
+             "plt.legend(loc=2); plt.ylim([0, 1]); plt.show()\n"
+             "print(f'Lorenz distance: '\n"
+             "      f'{float(np.sqrt(((lor_scf - lor_sim) ** 2).sum())):"
+             ".4f}')"),
+        md("## Beyond the reference\n\n"
+           "Capabilities the reference does not have, one call away:\n\n"
+           "- **Deterministic equilibrium** — "
+           "`economy.solve(sim_method='distribution')` replaces the "
+           "Monte-Carlo panel with a histogram push-forward and a "
+           "slope-pinned secant (cross-validates the bisection engine "
+           "to <1bp).\n"
+           "- **Table II sweep** — `run_table2_sweep()` solves all 12 "
+           "(σ, ρ) calibration cells as one batched XLA program "
+           "(~5 s on one TPU chip vs 12 × 27 min of reference-equivalent "
+           "work).\n"
+           "- **Welfare** — `policy_value` / `aggregate_welfare` / "
+           "`consumption_equivalent` (models/value.py).\n"
+           "- **Life cycle** — `solve_lifecycle` / `simulate_cohort` "
+           "(models/lifecycle.py).\n"
+           "- **Two-asset portfolio choice** — "
+           "`solve_portfolio_equilibrium` (models/portfolio.py)."),
+    ]
+    nb.cells = cells
+    nb.metadata.kernelspec = {"display_name": "Python 3",
+                              "language": "python", "name": "python3"}
+    return nb
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--execute", action="store_true")
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "Aiyagari-HARK-tpu.ipynb"))
+    args = ap.parse_args()
+    nb = build(args.quick)
+    if args.execute:
+        from nbclient import NotebookClient
+        client = NotebookClient(nb, timeout=1200, kernel_name="python3",
+                                resources={"metadata": {"path": REPO}})
+        client.execute()
+    with open(args.out, "w") as f:
+        nbf.write(nb, f)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -182,3 +182,29 @@ def test_validators_catch_corruption():
     validate_distribution(dist)
     with pytest.raises(ValueError, match="mass"):
         validate_distribution(dist * 0.5)
+
+
+def test_legacy_ks_checkpoint_migrates(tmp_path):
+    """Checkpoints written by earlier layouts (no secant memory / no
+    last_distance) load with conservative defaults instead of hard-failing
+    — resumability of long runs is this module's purpose."""
+    import numpy as np
+
+    from aiyagari_hark_tpu.utils.checkpoint import (
+        _KSCheckpointV1,
+        load_ks_checkpoint,
+        save_pytree,
+    )
+
+    p = str(tmp_path / "legacy.npz")
+    save_pytree(p, _KSCheckpointV1(
+        intercept=np.asarray([0.1, 0.2]), slope=np.asarray([1.0, 1.1]),
+        iteration=np.asarray(7, np.int64), seed=np.asarray(3, np.int64),
+        converged=np.asarray(True), fingerprint=np.asarray(42, np.int64)))
+    ck = load_ks_checkpoint(p)
+    np.testing.assert_array_equal(ck.intercept, [0.1, 0.2])
+    assert int(ck.iteration) == 7 and bool(ck.converged)
+    assert np.isnan(ck.secant).all()
+    # migrated "converged" must NOT short-circuit a resume: inf distance
+    # fails any tolerance check
+    assert np.isinf(ck.last_distance)

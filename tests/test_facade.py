@@ -142,3 +142,32 @@ def test_agent_economy_conflict_raises():
     agent = AiyagariType(CRRA=5.0)
     with pytest.raises(ValueError, match="CRRA"):
         economy._economy_config_for(agent)
+
+
+def test_solve_distribution_method_through_facade():
+    """sim_method='distribution' flows through the facade: the result
+    surface carries the wealth histogram as (support, weights) and the
+    equilibrium sits at the deterministic (bisection-consistent) r*."""
+    econ_dict = init_aiyagari_economy()
+    econ_dict.update(SMALL, act_T=800, T_discard=160, LaborAR=0.3, CRRA=1.0)
+    agent_dict = init_aiyagari_agents()
+    agent_dict.update(LaborStatesNo=5, AgentCount=100, aCount=16)
+    economy = AiyagariEconomy(tolerance=1e-3, **econ_dict)
+    economy.verbose = False
+    agent = AiyagariType(**agent_dict)
+    agent.cycles = 0
+    agent.get_economy_data(economy)
+    economy.agents = [agent]
+    economy.make_Mrkv_history()
+    sol = economy.solve(sim_method="distribution", dist_count=200)
+    assert sol.converged
+    support = economy.reap_state["aNow"][0]
+    weights = economy.reap_state["aNowWeights"][0]
+    assert support.shape == weights.shape
+    np.testing.assert_allclose(weights.sum(), 1.0, atol=1e-8)
+    # weighted mean of the histogram == the history's final aggregate
+    mean_a = float(np.average(support, weights=weights))
+    np.testing.assert_allclose(mean_a, float(sol.history.A_prev[-1]),
+                               rtol=1e-6)
+    # pinned rule: slope 0 on the populated saving-rule surface
+    assert economy.AFunc[0].slope == 0.0
