@@ -2,6 +2,7 @@
 and device-sharded agent panels (SURVEY.md §2.4's latent axes made
 first-class)."""
 
+from . import multihost
 from .mesh import make_mesh, pad_to_multiple, sharding
 from .panel import initial_panel_sharded, simulate_panel_sharded
 from .sweep import SweepResult, run_table2_sweep

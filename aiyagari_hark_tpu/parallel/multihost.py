@@ -1,0 +1,78 @@
+"""Multi-host (multi-process) execution: the distributed backend.
+
+The reference has no distributed story at all (single-process NumPy,
+SURVEY.md §2.4).  This framework's cross-device communication is XLA
+collectives over a ``jax.sharding.Mesh`` — ``pmean`` inside the sharded
+panel scan, the result gather of the cell-sharded sweep — which ride ICI
+within a slice and DCN across hosts once the *processes* are connected.
+Connecting them is all this module does: ``jax.distributed.initialize``
+with environment autodetection, plus the small host-side conventions
+(process-0 guard, global mesh construction) a multi-host sweep needs.
+
+Typical multi-host Table II run (one process per host, all hosts run the
+same script):
+
+    from aiyagari_hark_tpu.parallel import multihost, make_mesh
+    from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+
+    multihost.initialize()                    # no-op when single-process
+    mesh = make_mesh(("cells",))              # ALL hosts' devices
+    res = run_table2_sweep(mesh=mesh, axis="cells")
+    if multihost.is_coordinator():
+        print(res.table())
+
+Cells are communication-free until the final gather, so the only DCN
+traffic is scalars at the end — the sweep scales to as many hosts as
+there are cells.  (On TPU pods the coordinator address/process ids come
+from the runtime environment and ``initialize()`` needs no arguments;
+elsewhere pass them explicitly or via ``JAX_COORDINATOR_ADDRESS`` /
+``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``.)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> bool:
+    """Connect this process to the multi-host job; returns True if a
+    multi-process runtime was initialized, False for the single-process
+    no-op (so scripts work unchanged on one host).
+
+    Resolution order per argument: explicit parameter, then the
+    ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``
+    environment variables, then the platform's own autodetection (TPU pod
+    runtimes publish these — ``jax.distributed.initialize()`` with no
+    arguments is the documented call there).
+    """
+    coordinator_address = (coordinator_address
+                           or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+
+    explicit = coordinator_address is not None
+    on_pod_runtime = any(v in os.environ for v in
+                         ("TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS"))
+    if not explicit and not on_pod_runtime:
+        return False   # single-process run: nothing to connect
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+def is_coordinator() -> bool:
+    """True on process 0 — guard host-side side effects (printing, file
+    writes) so a multi-host sweep emits one copy of its outputs."""
+    return jax.process_index() == 0
+
+
+def process_count() -> int:
+    return jax.process_count()

@@ -150,14 +150,17 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
         rho = jnp.asarray(rho, dtype=dtype)
 
     if "dist_method" not in model_kwargs:
-        # Sweep-level default, distinct from stationary_wealth's "auto": the
-        # batch runs at the SLOWEST lane's iteration count, so on
-        # accelerators the uniform-cost direct solve beats the per-cell
-        # fastest iterative method (measured: 8.6s -> 5.2s and skew
-        # 12.7 -> 1.2 on one TPU chip).  On CPU, dense LU at (D*N)^3 per
-        # midpoint would be far slower than scatter iteration — keep "auto".
+        # Sweep-level default, distinct from stationary_wealth's "auto".
+        # On accelerators: "dense" (batched MXU matvecs).  NOT "pallas" —
+        # under a 12-wide vmap all lanes land in one kernel and the
+        # VMEM-resident design exceeds the scoped-vmem budget at compile
+        # time.  NOT "solve" — with the EGM Anderson acceleration and the
+        # stall exit in place, iterating the dense operator beats paying a
+        # (D*N)^3 LU per midpoint (measured on one TPU chip: dense 2.8s vs
+        # solve 4.8s vs the pre-stall-exit pallas 8.6s, identical r*).
+        # On CPU, "auto" (scatter) — dense/LU are the wrong trade there.
         model_kwargs["dist_method"] = (
-            "solve" if jax.default_backend() in ("tpu", "axon") else "auto")
+            "dense" if jax.default_backend() in ("tpu", "axon") else "auto")
 
     fn = _batched_solver(sweep.labor_sd, dtype, _hashable_kwargs(model_kwargs))
     import time

@@ -46,6 +46,29 @@ class BackendInfo(NamedTuple):
         return self.x64
 
 
+def enable_compilation_cache(path: Optional[str] = None) -> str:
+    """Turn on jax's persistent compilation cache so repeated entry-point
+    runs (bench, reproduce, sweeps) skip XLA compilation entirely.
+
+    The bench's Table II program compiles in ~40s on the tunneled TPU and
+    runs in ~5s — without the cache every invocation pays 8x its runtime
+    in compilation.  The cache key covers the HLO and the jaxlib/backend
+    version, so code changes recompile automatically.  Default location:
+    ``$AIYAGARI_CACHE_DIR`` or ``<repo>/.jax_cache`` (gitignored).
+    """
+    import jax
+
+    if path is None:
+        path = os.environ.get(
+            "AIYAGARI_CACHE_DIR",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"))
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return path
+
+
 def probe_ambient_backend(timeout_s: float = 120.0) -> Optional[str]:
     """Name of the backend the ambient environment would initialize, probed
     in a subprocess so a hung TPU tunnel cannot wedge the caller.  None on

@@ -48,6 +48,8 @@ _ORACLE_CODE = """
 import json, jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+from aiyagari_hark_tpu.utils.backend import enable_compilation_cache
+enable_compilation_cache()
 import jax.numpy as jnp
 from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
 from aiyagari_hark_tpu.utils.config import SweepConfig
@@ -90,8 +92,12 @@ def _oracle_r_star(timeout_s: float = 1800.0):
 
 
 def main():
+    from aiyagari_hark_tpu.utils.backend import enable_compilation_cache
     from aiyagari_hark_tpu.utils.timing import PhaseTimer, device_trace
 
+    cache_dir = enable_compilation_cache()
+    print(f"[bench] persistent compilation cache: {cache_dir}",
+          file=sys.stderr)
     timer = PhaseTimer()
     with timer.phase("probe"):
         ambient = _probe_default_backend()

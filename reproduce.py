@@ -75,7 +75,9 @@ def main(argv=None):
 
     start_time = time.time()
 
-    from aiyagari_hark_tpu.utils.backend import select_backend
+    from aiyagari_hark_tpu.utils.backend import (enable_compilation_cache,
+                                                 select_backend)
+    enable_compilation_cache()
     info = select_backend(args.backend)
     print(f"[reproduce] backend={info.name} "
           f"dtype={'f64' if info.x64 else 'f32'}")

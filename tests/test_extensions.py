@@ -77,3 +77,28 @@ def test_fine_grid_baseline():
     assert np.isfinite(r_fine)
     assert 2.5 < r_fine < 4.17
     assert abs(r_fine - r_coarse) < 0.15
+
+
+def test_true_ks_distribution_method():
+    """True Krusell-Smith solved DETERMINISTICALLY: aggregate shocks on,
+    the histogram simulator replacing the Monte-Carlo panel (Young's
+    method — the modern KS standard).  The aggregate chain identifies the
+    saving-rule regression, so no slope pinning; expected-mass employment
+    flows make the state-conditional unemployment rates exact (the panel
+    only matches them to rounding)."""
+    sol = solve_ks_economy(KS_AGENT, KS_ECON, seed=0, ks_employment=True,
+                           sim_method="distribution", dist_count=200)
+    assert sol.converged
+    hist = sol.history
+    mrkv = np.asarray(hist.mrkv)
+    urate = np.asarray(hist.urate)
+    np.testing.assert_allclose(urate[mrkv == 0].mean(), 0.10, atol=1e-10)
+    np.testing.assert_allclose(urate[mrkv == 1].mean(), 0.04, atol=1e-10)
+    last = sol.records[-1]
+    assert min(last.r_squared) > 0.9
+    assert 0.8 < min(last.slope) and max(last.slope) < 1.3
+    # deterministic: a second run reproduces the rule exactly
+    sol2 = solve_ks_economy(KS_AGENT, KS_ECON, seed=0, ks_employment=True,
+                            sim_method="distribution", dist_count=200)
+    np.testing.assert_array_equal(np.asarray(sol.afunc.slope),
+                                  np.asarray(sol2.afunc.slope))

@@ -117,3 +117,17 @@ def test_sharded_panel_rejects_indivisible_agents(ks_setup):
     mesh = make_mesh(("agents",))
     with pytest.raises(ValueError):
         initial_panel_sharded(cal, 63, 0, jax.random.PRNGKey(1), mesh)
+
+
+def test_multihost_single_process_noop(monkeypatch):
+    """multihost.initialize() is a clean no-op without a coordinator (the
+    single-host path every script takes by default), and the coordinator
+    guard reports this process as process 0 of 1."""
+    from aiyagari_hark_tpu.parallel import multihost
+
+    for var in ("JAX_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
+                "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(var, raising=False)
+    assert multihost.initialize() is False
+    assert multihost.is_coordinator()
+    assert multihost.process_count() == 1
