@@ -149,13 +149,13 @@ def solve_household(R, W, model: SimpleModel, disc_fac, crra,
     big = jnp.asarray(jnp.inf, dtype=p0.c_knots.dtype)
 
     def cond(state):
-        _, _, diff, it = state
+        _, _, _, diff, it = state
         return (diff > tol) & (it < max_iter)
 
     def step(policy, prev, it):
         new = egm_step(policy, R, W, model, disc_fac, crra)
         diff = jnp.max(jnp.abs(new.c_knots - policy.c_knots))
-        return new, policy, diff, it + 1
+        return new, policy, new, diff, it + 1
 
     def step_accel(policy, prev, it):
         new = egm_step(policy, R, W, model, disc_fac, crra)
@@ -173,17 +173,20 @@ def solve_household(R, W, model: SimpleModel, disc_fac, crra,
         out = HouseholdPolicy(
             m_knots=jnp.where(ok, m_x, new.m_knots),
             c_knots=jnp.where(ok, c_x, new.c_knots))
-        return out, new, diff, it + 1
+        # third slot: the plain EGM iterate the diff certifies — what the
+        # loop returns, so a max_iter exit on an acceleration step can
+        # never hand the caller an unevaluated extrapolation
+        return out, new, new, diff, it + 1
 
     def body(state):
-        policy, prev, _, it = state
+        policy, prev, _, _, it = state
         use_accel = (accel_every > 0) & (jnp.mod(it + 1,
                                                  max(accel_every, 1)) == 0)
         return jax.lax.cond(use_accel, step_accel, step, policy, prev, it)
 
-    policy, _, diff, it = jax.lax.while_loop(
-        cond, body, (p0, p0, big, jnp.asarray(0)))
-    return policy, it, diff
+    _, _, certified, diff, it = jax.lax.while_loop(
+        cond, body, (p0, p0, p0, big, jnp.asarray(0)))
+    return certified, it, diff
 
 
 def consumption_at(policy: HouseholdPolicy, m, state_idx=None):

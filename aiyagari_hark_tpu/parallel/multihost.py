@@ -61,6 +61,12 @@ def initialize(coordinator_address: Optional[str] = None,
     on_pod_runtime = any(v in os.environ for v in
                          ("TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS"))
     if not explicit and not on_pod_runtime:
+        if num_processes is not None and num_processes > 1:
+            raise ValueError(
+                f"num_processes={num_processes} requested but no "
+                "coordinator address (argument or JAX_COORDINATOR_ADDRESS) "
+                "and no pod runtime detected — refusing to silently run "
+                f"{num_processes} independent duplicate single-process jobs")
         return False   # single-process run: nothing to connect
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,

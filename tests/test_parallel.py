@@ -131,3 +131,17 @@ def test_multihost_single_process_noop(monkeypatch):
     assert multihost.initialize() is False
     assert multihost.is_coordinator()
     assert multihost.process_count() == 1
+
+
+def test_multihost_refuses_silent_duplicate_jobs(monkeypatch):
+    """num_processes > 1 without a coordinator must raise — N independent
+    duplicate single-process jobs would otherwise run silently."""
+    import pytest
+
+    from aiyagari_hark_tpu.parallel import multihost
+
+    for var in ("JAX_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
+                "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(var, raising=False)
+    with pytest.raises(ValueError, match="duplicate"):
+        multihost.initialize(num_processes=4, process_id=0)
