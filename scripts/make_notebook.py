@@ -143,7 +143,7 @@ def build(quick: bool) -> nbf.NotebookNode:
            "to <1bp).\n"
            "- **Table II sweep** — `run_table2_sweep()` solves all 12 "
            "(σ, ρ) calibration cells as one batched XLA program "
-           "(~5 s on one TPU chip vs 12 × 27 min of reference-equivalent "
+           "(~2 s on one TPU chip vs 12 × 27 min of reference-equivalent "
            "work).\n"
            "- **Welfare** — `policy_value` / `aggregate_welfare` / "
            "`consumption_equivalent` (models/value.py).\n"
