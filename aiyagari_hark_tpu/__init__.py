@@ -23,6 +23,7 @@ from .models.equilibrium import (  # noqa: F401
     solve_calibration,
     solve_calibration_lean,
 )
+from .models.diagnostics import DenHaanStats, den_haan_forecast  # noqa: F401
 from .models.lifecycle import (  # noqa: F401
     simulate_cohort,
     solve_lifecycle,

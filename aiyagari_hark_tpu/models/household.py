@@ -121,6 +121,65 @@ def egm_step(policy: HouseholdPolicy, R, W, model: SimpleModel,
     return HouseholdPolicy(m_knots=m_knots, c_knots=c_knots)
 
 
+def accelerated_policy_fixed_point(step_fn, p0, tol: float, max_iter: int,
+                                   accel_every: int = 32):
+    """EGM fixed point with certified Anderson(1)/Aitken acceleration, for
+    any policy NamedTuple carrying ``m_knots``/``c_knots`` (the compact
+    ``HouseholdPolicy`` and the 4N-state ``KSPolicy`` share this).
+
+    ``step_fn``: one EGM backward step, policy -> policy.  Convergence is
+    sup-norm on the consumption knots; every ``accel_every`` steps one
+    extrapolation along the dominant contraction mode (rate ~ disc_fac, so
+    plain iteration needs ~log(tol)/log(beta) steps) is taken.  Safety
+    mirrors the distribution iterator's: the extrapolation is only the next
+    ITERATE (any error is washed out by subsequent exact EGM steps), it is
+    rejected wholesale if it breaks the strict monotonicity of the
+    endogenous grid (``searchsorted`` needs sorted knots) or consumption
+    positivity, and the loop returns the last PLAIN iterate its diff
+    certifies — a ``max_iter`` exit landing on an acceleration step can
+    never hand the caller an unevaluated extrapolation.  ``accel_every=0``
+    disables.  Returns (policy, n_iter, final_diff).
+    """
+    ctor = type(p0)
+    big = jnp.asarray(jnp.inf, dtype=p0.c_knots.dtype)
+
+    def cond(state):
+        _, _, _, diff, it = state
+        return (diff > tol) & (it < max_iter)
+
+    def step(policy, prev, it):
+        new = step_fn(policy)
+        diff = jnp.max(jnp.abs(new.c_knots - policy.c_knots))
+        return new, policy, new, diff, it + 1
+
+    def step_accel(policy, prev, it):
+        new = step_fn(policy)
+        diff = jnp.max(jnp.abs(new.c_knots - policy.c_knots))
+        d1c = policy.c_knots - prev.c_knots
+        d2c = new.c_knots - policy.c_knots
+        lam = jnp.sum(d2c * d1c) / jnp.maximum(jnp.sum(d1c * d1c),
+                                               jnp.finfo(d2c.dtype).tiny)
+        lam = jnp.clip(lam, 0.0, 0.995)
+        fac = lam / (1.0 - lam)
+        c_x = new.c_knots + fac * d2c
+        m_x = new.m_knots + fac * (new.m_knots - policy.m_knots)
+        ok = (jnp.all(jnp.diff(m_x, axis=-1) > 0)
+              & jnp.all(c_x > 0) & (diff > tol))
+        out = ctor(m_knots=jnp.where(ok, m_x, new.m_knots),
+                   c_knots=jnp.where(ok, c_x, new.c_knots))
+        return out, new, new, diff, it + 1
+
+    def body(state):
+        policy, prev, _, _, it = state
+        use_accel = (accel_every > 0) & (jnp.mod(it + 1,
+                                                 max(accel_every, 1)) == 0)
+        return jax.lax.cond(use_accel, step_accel, step, policy, prev, it)
+
+    _, _, certified, diff, it = jax.lax.while_loop(
+        cond, body, (p0, p0, p0, big, jnp.asarray(0)))
+    return certified, it, diff
+
+
 def solve_household(R, W, model: SimpleModel, disc_fac, crra,
                     tol: float = 1e-6, max_iter: int = 3000,
                     init_policy: HouseholdPolicy | None = None,
@@ -133,60 +192,13 @@ def solve_household(R, W, model: SimpleModel, disc_fac, crra,
 
     ``init_policy`` warm-starts the iteration (e.g. the previous bisection
     midpoint's policy — nearby prices → nearby fixed points → far fewer
-    backward steps than the identity terminal guess).
-
-    ``accel_every``: every that many backward steps, one Anderson(1)/Aitken
-    extrapolation of the knot arrays along the dominant contraction mode
-    (rate ~ disc_fac, so plain iteration needs ~log(tol)/log(beta) steps).
-    Safety mirrors the distribution iterator's: the extrapolation is only
-    the next ITERATE (any error is washed out by subsequent exact EGM
-    steps; convergence is still certified by a plain-step diff), and it is
-    rejected wholesale if it breaks the strict monotonicity of the
-    endogenous grid (``searchsorted`` in the next step requires sorted
-    knots).  Set 0 to disable.
+    backward steps than the identity terminal guess).  Acceleration
+    semantics: ``accelerated_policy_fixed_point``.
     """
     p0 = initial_policy(model) if init_policy is None else init_policy
-    big = jnp.asarray(jnp.inf, dtype=p0.c_knots.dtype)
-
-    def cond(state):
-        _, _, _, diff, it = state
-        return (diff > tol) & (it < max_iter)
-
-    def step(policy, prev, it):
-        new = egm_step(policy, R, W, model, disc_fac, crra)
-        diff = jnp.max(jnp.abs(new.c_knots - policy.c_knots))
-        return new, policy, new, diff, it + 1
-
-    def step_accel(policy, prev, it):
-        new = egm_step(policy, R, W, model, disc_fac, crra)
-        diff = jnp.max(jnp.abs(new.c_knots - policy.c_knots))
-        d1c = policy.c_knots - prev.c_knots
-        d2c = new.c_knots - policy.c_knots
-        lam = jnp.sum(d2c * d1c) / jnp.maximum(jnp.sum(d1c * d1c),
-                                               jnp.finfo(d2c.dtype).tiny)
-        lam = jnp.clip(lam, 0.0, 0.995)
-        fac = lam / (1.0 - lam)
-        c_x = new.c_knots + fac * d2c
-        m_x = new.m_knots + fac * (new.m_knots - policy.m_knots)
-        ok = (jnp.all(jnp.diff(m_x, axis=-1) > 0)
-              & jnp.all(c_x > 0) & (diff > tol))
-        out = HouseholdPolicy(
-            m_knots=jnp.where(ok, m_x, new.m_knots),
-            c_knots=jnp.where(ok, c_x, new.c_knots))
-        # third slot: the plain EGM iterate the diff certifies — what the
-        # loop returns, so a max_iter exit on an acceleration step can
-        # never hand the caller an unevaluated extrapolation
-        return out, new, new, diff, it + 1
-
-    def body(state):
-        policy, prev, _, _, it = state
-        use_accel = (accel_every > 0) & (jnp.mod(it + 1,
-                                                 max(accel_every, 1)) == 0)
-        return jax.lax.cond(use_accel, step_accel, step, policy, prev, it)
-
-    _, _, certified, diff, it = jax.lax.while_loop(
-        cond, body, (p0, p0, p0, big, jnp.asarray(0)))
-    return certified, it, diff
+    return accelerated_policy_fixed_point(
+        lambda p: egm_step(p, R, W, model, disc_fac, crra),
+        p0, tol, max_iter, accel_every)
 
 
 def consumption_at(policy: HouseholdPolicy, m, state_idx=None):

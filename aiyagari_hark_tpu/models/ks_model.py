@@ -212,7 +212,8 @@ def egm_step_ks(policy: KSPolicy, pre: PrecomputedArrays,
 
 def solve_ks_household(afunc: AFuncParams, cal: KSCalibration,
                        tol: float = 1e-6, max_iter: int = 2000,
-                       init_policy: KSPolicy | None = None):
+                       init_policy: KSPolicy | None = None,
+                       accel_every: int = 32):
     """Infinite-horizon fixed point of the 4N-state EGM step under the given
     perceived aggregate law.  Sup-norm convergence on consumption knots (the
     array analog of HARK's solution distance).  Returns (policy, iters, diff).
@@ -220,20 +221,15 @@ def solve_ks_household(afunc: AFuncParams, cal: KSCalibration,
     ``init_policy`` warm-starts the backward iteration — the KS outer loop
     passes the previous outer iteration's policy (the perceived law moves a
     little per damped update, so the fixed points are close).
+
+    ``accel_every``: certified Anderson(1)/Aitken extrapolation — the
+    shared safeguarded machinery of
+    ``household.accelerated_policy_fixed_point`` (KSPolicy carries the
+    same ``m_knots``/``c_knots`` interface).  0 disables.
     """
+    from .household import accelerated_policy_fixed_point
+
     pre = precompute(afunc, cal)
     p0 = initial_ks_policy(cal) if init_policy is None else init_policy
-    big = jnp.asarray(jnp.inf, dtype=p0.c_knots.dtype)
-
-    def cond(state):
-        _, diff, it = state
-        return (diff > tol) & (it < max_iter)
-
-    def body(state):
-        policy, _, it = state
-        new = egm_step_ks(policy, pre, cal)
-        diff = jnp.max(jnp.abs(new.c_knots - policy.c_knots))
-        return new, diff, it + 1
-
-    policy, diff, it = jax.lax.while_loop(cond, body, (p0, big, jnp.asarray(0)))
-    return policy, it, diff
+    return accelerated_policy_fixed_point(
+        lambda p: egm_step_ks(p, pre, cal), p0, tol, max_iter, accel_every)

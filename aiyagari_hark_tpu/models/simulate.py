@@ -90,6 +90,21 @@ def initial_panel(cal: KSCalibration, agent_count: int, mrkv_init: int,
         mrkv=jnp.asarray(mrkv_init))
 
 
+def mill_aggregates(cal: KSCalibration, A, z):
+    """The factor-pricing "mill" (``calc_R_and_W``,
+    ``Aiyagari_Support.py:1839-1894``): aggregate capital ``A`` and
+    aggregate state ``z`` -> (R, W, M).  ONE implementation shared by the
+    panel step, the histogram step, and the den Haan forecast diagnostic —
+    the diagnostic's validity depends on exact timing parity with the
+    simulators, so the formula must not fork."""
+    prod = cal.prod_by_agg[z]
+    agg_l = (1.0 - cal.urate_by_agg[z]) * cal.lbr_ind
+    k_to_l = A / agg_l
+    R = firm.interest_factor(k_to_l, cal.cap_share, cal.depr_fac, prod)
+    W = firm.wage_rate(k_to_l, cal.cap_share, prod)
+    return R, W, R * A + W * agg_l
+
+
 def _conditional_emp_probs(mrkv_prev, mrkv_now, cal: KSCalibration):
     """Employment switch probabilities conditional on the aggregate move,
     from the 4x4 joint (BU,BE,GU,GE) matrix: rows ``2z+emp``, columns
@@ -150,7 +165,6 @@ def simulate_panel(policy: KSPolicy, cal: KSCalibration, mrkv_hist: jnp.ndarray,
     the global invariant up to rounding.
     """
     logp_tauchen = jnp.log(cal.tauchen_transition)
-    lbr = cal.lbr_ind
 
     def step(state: PanelState, inputs):
         z_t, k = inputs
@@ -176,12 +190,7 @@ def simulate_panel(policy: KSPolicy, cal: KSCalibration, mrkv_hist: jnp.ndarray,
         # --- mill (calc_R_and_W, :1839-1894) consuming mrkv_hist[t]
         A_prev = _panel_mean(a_new, axis_name)
         urate_real = 1.0 - _panel_mean(emp_new.astype(a_new.dtype), axis_name)
-        prod = cal.prod_by_agg[z_t]
-        agg_L = (1.0 - cal.urate_by_agg[z_t]) * lbr
-        k_to_l = A_prev / agg_L
-        R_new = firm.interest_factor(k_to_l, cal.cap_share, cal.depr_fac, prod)
-        W_new = firm.wage_rate(k_to_l, cal.cap_share, prod)
-        M_new = R_new * A_prev + W_new * agg_L
+        R_new, W_new, M_new = mill_aggregates(cal, A_prev, z_t)
         out = (z_t, A_prev, M_new, urate_real)
         new_state = PanelState(assets=a_new, labor_state=ls_new,
                                employed=emp_new, M_now=M_new, R_now=R_new,
@@ -241,11 +250,7 @@ def initial_distribution_panel(cal: KSCalibration, dist_grid: jnp.ndarray,
     ss = cal.steady_state
     k0 = ss.K if k0 is None else jnp.asarray(k0)
     urate = cal.urate_by_agg[mrkv_init]
-    agg_l = (1.0 - urate) * cal.lbr_ind
-    prod = cal.prod_by_agg[mrkv_init]
-    r0 = firm.interest_factor(k0 / agg_l, cal.cap_share, cal.depr_fac, prod)
-    w0 = firm.wage_rate(k0 / agg_l, cal.cap_share, prod)
-    m0 = r0 * k0 + w0 * agg_l
+    r0, w0, m0 = mill_aggregates(cal, k0, mrkv_init)
     idx, w = locate_in_grid(jnp.asarray(k0, dtype=dist_grid.dtype),
                             dist_grid)
     asset_col = (jnp.zeros((dist_grid.shape[0],), dtype=dist_grid.dtype)
@@ -305,7 +310,6 @@ def simulate_distribution_history(policy: KSPolicy, cal: KSCalibration,
         # only indexes with it, so no concretization is needed
         init = initial_distribution_panel(cal, dist_grid, mrkv_hist[0])
     d_size, n = dist_grid.shape[0], cal.labor_levels.shape[0]
-    lbr = cal.lbr_ind
 
     def step(state: DistPanelState, z_t):
         # --- labor transition (categorical draw -> row mix)
@@ -348,13 +352,7 @@ def simulate_distribution_history(policy: KSPolicy, cal: KSCalibration,
         new_dist = jax.vmap(scatter_col, in_axes=1, out_axes=1)(
             flat(dist_le), flat(idx), flat(w)).reshape(d_size, n, 2)
         # --- mill (identical to simulate_panel)
-        prod = cal.prod_by_agg[z_t]
-        agg_L = (1.0 - cal.urate_by_agg[z_t]) * lbr
-        k_to_l = A_prev / agg_L
-        R_new = firm.interest_factor(k_to_l, cal.cap_share, cal.depr_fac,
-                                     prod)
-        W_new = firm.wage_rate(k_to_l, cal.cap_share, prod)
-        M_new = R_new * A_prev + W_new * agg_L
+        R_new, W_new, M_new = mill_aggregates(cal, A_prev, z_t)
         out = (z_t, A_prev, M_new, urate_real)
         return DistPanelState(dist=new_dist, M_now=M_new, R_now=R_new,
                               W_now=W_new, mrkv=z_t), out

@@ -144,6 +144,14 @@ def main(argv=None):
     print(f"Equilibrium Savings Rate: {saving_pct:.4f} % "
           f"(reference 23.649 %)")
 
+    # den Haan (2010) dynamic-forecast accuracy of the converged rule —
+    # the aggregate-law diagnostic the reference lacks (models/diagnostics)
+    from aiyagari_hark_tpu.models.diagnostics import den_haan_forecast
+    dh = den_haan_forecast(sol, t_start=econ_dict["T_discard"])
+    print(f"den Haan dynamic forecast error: "
+          f"max {float(dh.max_error_pct):.3f} %  "
+          f"mean {float(dh.mean_error_pct):.3f} %")
+
     # -- consumption functions by labor-supply state (cell 21)
     with timer.phase("figures"):
         n = n_states
@@ -234,6 +242,8 @@ def main(argv=None):
         "outer_iterations": len(sol.records),
         "equilibrium_return_pct": r_pct,
         "equilibrium_saving_rate_pct": saving_pct,
+        "den_haan_max_error_pct": float(dh.max_error_pct),
+        "den_haan_mean_error_pct": float(dh.mean_error_pct),
         "wealth_stats": {"max": ws.max, "mean": ws.mean,
                          "std": ws.std, "median": ws.median},
         "lorenz_distance": lorenz_dist,
