@@ -23,6 +23,10 @@ from .models.equilibrium import (  # noqa: F401
     solve_calibration,
     solve_calibration_lean,
 )
+from .models.huggett import (  # noqa: F401
+    HuggettEquilibrium,
+    solve_huggett_equilibrium,
+)
 from .models.diagnostics import DenHaanStats, den_haan_forecast  # noqa: F401
 from .models.lifecycle import (  # noqa: F401
     simulate_cohort,

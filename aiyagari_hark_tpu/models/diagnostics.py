@@ -57,8 +57,7 @@ def den_haan_forecast(sol, t_start: int | None = None) -> DenHaanStats:
 
     def step(m_hat, zz):
         z_prev, z_now = zz
-        a_hat = jnp.exp(afunc.intercept[z_prev]
-                        + afunc.slope[z_prev] * jnp.log(m_hat))
+        a_hat = afunc(m_hat, z_prev)   # the ONE perceived-law implementation
         return mill_m(a_hat, z_now), a_hat
 
     a0 = hist.A_prev[t_start]

@@ -55,28 +55,43 @@ class SimpleModel(NamedTuple):
     transition: jnp.ndarray      # [N, N] labor-state Markov matrix
     labor_stationary: jnp.ndarray  # [N] stationary distribution of labor states
     dist_grid: jnp.ndarray       # [D] wealth-histogram support
+    borrow_limit: jnp.ndarray = 0.0   # scalar b <= 0: a >= b each period
 
 
 def build_simple_model(labor_states: int = 7, labor_ar: float = 0.6,
                        labor_sd: float = 0.2, labor_bound: float = 3.0,
                        a_min: float = 0.001, a_max: float = 50.0,
                        a_count: int = 32, a_nest_fac: int = 2,
-                       dist_count: int = 500, dtype=None) -> SimpleModel:
+                       dist_count: int = 500, borrow_limit: float = 0.0,
+                       dtype=None) -> SimpleModel:
     """Assemble the calibration arrays.  ``labor_ar``/``labor_sd`` may be
-    traced scalars (sweep axes); grid sizes are static."""
-    a_grid = make_asset_grid(a_min, a_max, a_count, a_nest_fac, dtype=dtype)
+    traced scalars (sweep axes); grid sizes are static.
+
+    ``borrow_limit`` b <= 0 shifts both grids so end-of-period assets live
+    in [b, a_max] with the exp-mult point density concentrated just above
+    the constraint (Huggett-style ad-hoc debt limits; b = 0 reproduces the
+    reference's no-borrowing Aiyagari setup exactly).  The caller must keep
+    b above the natural limit at the prices it solves under
+    (``-W l_min / r`` for r > 0), else the constrained agent cannot service
+    debt and consumption turns negative.
+    """
+    a_grid = borrow_limit + make_asset_grid(a_min, a_max - borrow_limit,
+                                            a_count, a_nest_fac, dtype=dtype)
     tauchen = tauchen_labor_process(labor_states, labor_ar, labor_sd,
                                     bound=labor_bound, dtype=dtype)
     levels = normalized_labor_states(tauchen.grid)
     pi = stationary_distribution(tauchen.transition)
-    # Wealth histogram support: start at the borrowing limit (0), then an
-    # exp-mult grid over (0, a_max] so mass near the constraint is resolved.
-    inner = make_grid_exp_mult(a_min, a_max, dist_count - 1, a_nest_fac,
-                               dtype=dtype)
-    dist_grid = jnp.concatenate([jnp.zeros((1,), dtype=inner.dtype), inner])
+    # Wealth histogram support: start at the borrowing limit, then an
+    # exp-mult grid up to a_max so mass near the constraint is resolved.
+    inner = make_grid_exp_mult(a_min, a_max - borrow_limit, dist_count - 1,
+                               a_nest_fac, dtype=dtype)
+    dist_grid = borrow_limit + jnp.concatenate(
+        [jnp.zeros((1,), dtype=inner.dtype), inner])
     return SimpleModel(a_grid=a_grid, labor_levels=levels,
                        transition=tauchen.transition, labor_stationary=pi,
-                       dist_grid=dist_grid)
+                       dist_grid=dist_grid,
+                       borrow_limit=jnp.asarray(borrow_limit,
+                                                dtype=a_grid.dtype))
 
 
 def initial_distribution(model) -> jnp.ndarray:
@@ -90,13 +105,16 @@ def initial_distribution(model) -> jnp.ndarray:
 
 
 def initial_policy(model: SimpleModel) -> HouseholdPolicy:
-    """Terminal guess c(m) = m — the reference's ``IdentityFunction`` terminal
-    solution (``Aiyagari_Support.py:898``) expressed as knots with slope 1."""
+    """Terminal guess c(m) = m - b (consume all resources above the debt
+    limit) — the reference's ``IdentityFunction`` terminal solution
+    (``Aiyagari_Support.py:898``) expressed as knots with slope 1, shifted
+    so consumption stays positive under a negative borrowing limit."""
     n = model.labor_levels.shape[0]
     eps = jnp.asarray(CONSTRAINT_EPS, dtype=model.a_grid.dtype)
-    m_row = jnp.concatenate([eps[None], model.a_grid + eps])
+    b = jnp.asarray(model.borrow_limit, dtype=model.a_grid.dtype)
+    m_row = jnp.concatenate([b[None] + eps, model.a_grid + eps])
     m_knots = jnp.tile(m_row, (n, 1))
-    return HouseholdPolicy(m_knots=m_knots, c_knots=m_knots)
+    return HouseholdPolicy(m_knots=m_knots, c_knots=m_knots - b)
 
 
 def egm_step(policy: HouseholdPolicy, R, W, model: SimpleModel,
@@ -115,9 +133,13 @@ def egm_step(policy: HouseholdPolicy, R, W, model: SimpleModel,
         vp_next, model.transition.T, precision=jax.lax.Precision.HIGHEST)
     c_now = inverse_marginal_utility(end_of_prd_vp, crra)
     m_now = a[:, None] + c_now
+    # borrowing-constraint knot: at m = b + eps the agent consumes eps and
+    # carries a = b; interpolation below the first endogenous knot then has
+    # slope ~1 in c — the exact constrained policy c = m - b
     eps = jnp.full((1, c_now.shape[1]), CONSTRAINT_EPS, dtype=c_now.dtype)
+    b = jnp.asarray(model.borrow_limit, dtype=c_now.dtype)
     c_knots = jnp.concatenate([eps, c_now], axis=0).T   # [N, A+1]
-    m_knots = jnp.concatenate([eps, m_now], axis=0).T
+    m_knots = jnp.concatenate([b + eps, m_now], axis=0).T
     return HouseholdPolicy(m_knots=m_knots, c_knots=c_knots)
 
 
@@ -225,7 +247,7 @@ def wealth_transition(policy: HouseholdPolicy, R, W,
     x = model.dist_grid                                  # [D] capital today
     m = R * x[:, None] + W * model.labor_levels[None, :]  # [D, N]
     c = interp1d_rowwise(m.T, policy.m_knots, policy.c_knots).T
-    a_next = jnp.clip(m - c, 0.0, model.dist_grid[-1])
+    a_next = jnp.clip(m - c, model.borrow_limit, model.dist_grid[-1])
     idx, w = locate_in_grid(a_next, model.dist_grid)
     return WealthTransition(idx=idx, weight=w, a_next=a_next)
 

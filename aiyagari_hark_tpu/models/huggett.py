@@ -1,0 +1,143 @@
+"""Huggett (1993): the pure-exchange complement to Aiyagari's production
+economy — households trade a bond in ZERO net supply under idiosyncratic
+endowment risk and an ad-hoc debt limit, and the interest rate clears the
+credit market.
+
+The reference framework covers only the production (Aiyagari) economy;
+this module reuses the identical household machinery — EGM solver,
+stationary histogram, bisection — with two substitutions: labor income is
+an endowment (no firm, wage = 1) and market clearing is ``E[a] = 0``
+instead of capital supply = firm demand.  The borrowing-limit
+generalization it rides on (``SimpleModel.borrow_limit``) is exact for
+b = 0, so the Aiyagari path is untouched.
+
+Economics pinned by the tests: r* < (1-beta)/beta (the autarky bound —
+with binding debt limits the bond carries a liquidity premium), a strictly
+positive mass of borrowers in equilibrium, and r* increasing in the debt
+limit's looseness (easier credit -> less precautionary demand for the
+bond -> a higher rate clears the market).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .household import (
+    SimpleModel,
+    aggregate_capital,
+    initial_distribution,
+    initial_policy,
+    solve_household,
+    stationary_wealth,
+)
+
+
+class HuggettEquilibrium(NamedTuple):
+    r_star: jnp.ndarray         # equilibrium net bond rate
+    net_demand: jnp.ndarray     # E[a] at r_star (~0)
+    policy: object              # HouseholdPolicy at r_star
+    distribution: jnp.ndarray   # [D, N] stationary wealth distribution
+    borrower_share: jnp.ndarray  # stationary mass with a < 0
+    bisect_iters: jnp.ndarray
+    bracketed: jnp.ndarray      # bool: net demand was negative at the low
+    # end of the (auto-widened) bracket; False means r_star is NOT an
+    # equilibrium (check net_demand)
+
+
+def net_bond_demand(r, model: SimpleModel, disc_fac, crra,
+                    egm_tol=1e-6, dist_tol=1e-11,
+                    init_policy_=None, init_dist=None,
+                    dist_method: str = "auto"):
+    """E[a] at rate ``r``: aggregate net bond position of the household
+    sector (positive = net savers).  Endowment economy: R = 1 + r, W = 1."""
+    policy, _, _ = solve_household(1.0 + r, 1.0, model, disc_fac, crra,
+                                   tol=egm_tol, init_policy=init_policy_)
+    dist, _, _ = stationary_wealth(policy, 1.0 + r, 1.0, model,
+                                   tol=dist_tol, init_dist=init_dist,
+                                   method=dist_method)
+    return aggregate_capital(dist, model), policy, dist
+
+
+def solve_huggett_equilibrium(model: SimpleModel, disc_fac, crra,
+                              r_tol: float | None = None,
+                              max_bisect: int = 60,
+                              egm_tol: float | None = None,
+                              dist_tol: float | None = None,
+                              r_lo: float = -0.10,
+                              dist_method: str = "auto"
+                              ) -> HuggettEquilibrium:
+    """Bisect the bond rate until the credit market clears (E[a] = 0).
+
+    Net demand is increasing in r (the same monotonicity as Aiyagari's
+    asset supply) and diverges as r approaches the discount rate from
+    below, so the upper end always brackets; the LOWER end is validated —
+    tight debt limits can keep net demand positive at ``r_lo`` — and
+    widened toward -90% for up to 6 doublings.  If it still fails to turn
+    negative, ``bracketed=False`` is returned and ``r_star`` is NOT an
+    equilibrium (a hard error is impossible here: the function is
+    jit/vmap-able, so the condition is data).  Warm-starts the household
+    fixed points across midpoints like the Aiyagari bisection.
+    """
+    dtype = model.a_grid.dtype
+    f64 = dtype == jnp.float64
+    if r_tol is None:
+        r_tol = 1e-10 if f64 else 1e-6
+    if egm_tol is None:
+        egm_tol = 1e-6 if f64 else 1e-5
+    if dist_tol is None:
+        dist_tol = 1e-11 if f64 else 1e-8
+    hi0 = jnp.asarray(1.0 / disc_fac - 1.0 - 1e-4, dtype=dtype)
+    lo0 = jnp.asarray(r_lo, dtype=dtype)
+    p0 = initial_policy(model)
+    d0 = initial_distribution(model)
+    zi = jnp.asarray(0)
+
+    # validate / widen the lower bracket end: walk lo toward -90% until
+    # net demand turns negative (bounded — each probe is a full solve)
+    def widen_cond(state):
+        lo, ex, k = state
+        return (ex > 0) & (k < 6) & (lo > -0.9)
+
+    def widen_body(state):
+        lo, _, k = state
+        lo = jnp.maximum(jnp.asarray(-0.9, dtype=dtype),
+                         lo - (2.0 ** k) * 0.1)
+        ex, _, _ = net_bond_demand(lo, model, disc_fac, crra,
+                                   egm_tol=egm_tol, dist_tol=dist_tol,
+                                   dist_method=dist_method)
+        return lo, ex, k + 1
+
+    ex_lo0, _, _ = net_bond_demand(lo0, model, disc_fac, crra,
+                                   egm_tol=egm_tol, dist_tol=dist_tol,
+                                   dist_method=dist_method)
+    lo0, ex_lo, _ = jax.lax.while_loop(widen_cond, widen_body,
+                                       (lo0, ex_lo0, zi))
+    bracketed = ex_lo <= 0
+
+    def cond(state):
+        lo, hi, it, _, _ = state
+        return ((hi - lo) > r_tol) & (it < max_bisect)
+
+    def body(state):
+        lo, hi, it, policy, dist = state
+        mid = 0.5 * (lo + hi)
+        ex, policy, dist = net_bond_demand(
+            mid, model, disc_fac, crra, egm_tol=egm_tol, dist_tol=dist_tol,
+            init_policy_=policy, init_dist=dist, dist_method=dist_method)
+        lo = jnp.where(ex > 0, lo, mid)
+        hi = jnp.where(ex > 0, mid, hi)
+        return lo, hi, it + 1, policy, dist
+
+    lo, hi, iters, policy, dist = jax.lax.while_loop(
+        cond, body, (lo0, hi0, zi, p0, d0))
+    r_star = 0.5 * (lo + hi)
+    ex, policy, dist = net_bond_demand(
+        r_star, model, disc_fac, crra, egm_tol=egm_tol, dist_tol=dist_tol,
+        init_policy_=policy, init_dist=dist, dist_method=dist_method)
+    borrowers = jnp.sum(jnp.where(model.dist_grid[:, None] < 0, dist, 0.0))
+    return HuggettEquilibrium(r_star=r_star, net_demand=ex, policy=policy,
+                              distribution=dist, borrower_share=borrowers,
+                              bisect_iters=iters, bracketed=bracketed)

@@ -95,16 +95,21 @@ def policy_value(policy: HouseholdPolicy, R, W, model: SimpleModel,
     c_knots = policy.c_knots
     if constrained_knots > 0:
         from .household import CONSTRAINT_EPS
+        b = jnp.asarray(getattr(model, "borrow_limit", 0.0),
+                        dtype=m_knots.dtype)
         eps = jnp.asarray(10.0 * CONSTRAINT_EPS, dtype=m_knots.dtype)
         m1 = m_knots[:, 1][:, None]             # first endogenous knot [N,1]
+        # log-spaced DISTANCE above the borrowing limit (m itself may be
+        # negative under a debt limit b < 0)
         frac = jnp.linspace(0.0, 1.0, constrained_knots + 1,
                             dtype=m_knots.dtype)[:-1]
-        extra = jnp.exp(jnp.log(eps)
-                        + frac[None, :] * (jnp.log(m1 * (1.0 - 1e-6))
-                                           - jnp.log(eps)))   # [N, E]
+        extra = b + jnp.exp(
+            jnp.log(eps) + frac[None, :] * (jnp.log((m1 - b) * (1.0 - 1e-6))
+                                            - jnp.log(eps)))   # [N, E]
         m_aug = jnp.sort(jnp.concatenate([m_knots, extra], axis=1), axis=1)
         c_aug = interp1d_rowwise(m_aug, m_knots, c_knots)
-        c_aug = jnp.where(m_aug <= m1, m_aug, c_aug)   # exact constrained c
+        # exact constrained policy c = m - b below the first endogenous knot
+        c_aug = jnp.where(m_aug <= m1, m_aug - b, c_aug)
         m_knots, c_knots = m_aug, c_aug
     a_knots = m_knots - c_knots                 # end-of-period assets
     n = m_knots.shape[0]

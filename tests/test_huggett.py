@@ -1,0 +1,105 @@
+"""Huggett (1993) bond economy (models/huggett.py) and the borrowing-limit
+generalization it rides on.  Oracles: the autarky/complete-markets bound
+r* < (1-beta)/beta, exact market clearing, comparative statics in the debt
+limit, and exactness of the b = 0 reduction (the Aiyagari goldens pin that
+separately in test_table2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.models.household import (
+    build_simple_model,
+    consumption_at,
+    solve_household,
+    stationary_wealth,
+)
+from aiyagari_hark_tpu.models.huggett import solve_huggett_equilibrium
+
+BETA, CRRA = 0.96, 2.0
+
+
+@pytest.fixture(scope="module")
+def huggett_model():
+    return build_simple_model(labor_states=5, labor_ar=0.9, labor_sd=0.2,
+                              a_count=48, a_max=30.0, borrow_limit=-4.0,
+                              dist_count=400)
+
+
+@pytest.fixture(scope="module")
+def equilibrium(huggett_model):
+    return solve_huggett_equilibrium(huggett_model, BETA, CRRA)
+
+
+def test_borrowing_constrained_policy_is_exact(huggett_model):
+    """Below the first endogenous knot the policy must be c = m - b (consume
+    everything above the debt limit).  The constrained zone is thin — it
+    ends at m1 = (b + a_min) + c(b + a_min), a few cents above the limit —
+    so test just inside it; beyond it the household is *optimally* interior
+    (c < m - b, a > b), which a separate assertion checks."""
+    b = -4.0
+    policy, _, diff = solve_household(1.03, 1.0, huggett_model, BETA, CRRA)
+    assert float(diff) < 1e-6
+    for s in range(5):
+        m1 = float(policy.m_knots[s, 1])       # state's constraint kink
+        assert m1 > b + 0.05                   # a genuine constrained zone
+        m_in = jnp.linspace(b + 0.02, m1 - 0.02, 5)
+        c = np.asarray(consumption_at(policy, m_in, state_idx=s))
+        np.testing.assert_allclose(c, np.asarray(m_in) - b, rtol=5e-3)
+        # above the kink the unconstrained optimum takes over: c < m - b
+        m_out = jnp.asarray([m1 + 0.3, m1 + 1.0])
+        c_out = np.asarray(consumption_at(policy, m_out, state_idx=s))
+        assert (c_out < np.asarray(m_out) - b - 1e-3).all()
+
+
+def test_wealth_distribution_reaches_negative_assets(huggett_model):
+    policy, _, _ = solve_household(1.03, 1.0, huggett_model, BETA, CRRA)
+    dist, _, _ = stationary_wealth(policy, 1.03, 1.0, huggett_model)
+    d = np.asarray(dist)
+    grid = np.asarray(huggett_model.dist_grid)
+    assert grid[0] == pytest.approx(-4.0)
+    np.testing.assert_allclose(d.sum(), 1.0, atol=1e-9)
+    assert d[grid < 0, :].sum() > 0.05   # real mass in debt
+
+
+def test_equilibrium_clears_credit_market(equilibrium):
+    eq = equilibrium
+    r = float(eq.r_star)
+    # liquidity premium: r* strictly below the complete-markets rate
+    assert r < 1.0 / BETA - 1.0
+    assert abs(float(eq.net_demand)) < 1e-3
+    # both sides of the market populated
+    assert 0.2 < float(eq.borrower_share) < 0.9
+
+
+def test_looser_debt_limit_raises_rate(equilibrium):
+    """Easier credit lowers precautionary bond demand, so a higher rate is
+    needed to clear the market (Huggett's comparative static)."""
+    tight = build_simple_model(labor_states=5, labor_ar=0.9, labor_sd=0.2,
+                               a_count=48, a_max=30.0, borrow_limit=-2.0,
+                               dist_count=400)
+    eq_tight = solve_huggett_equilibrium(tight, BETA, CRRA)
+    assert float(eq_tight.r_star) < float(equilibrium.r_star)
+
+
+def test_huggett_is_jittable(huggett_model):
+    f = jax.jit(lambda: solve_huggett_equilibrium(huggett_model, BETA, CRRA,
+                                                  max_bisect=20))
+    eq = f()
+    assert np.isfinite(float(eq.r_star))
+
+
+def test_tight_limit_auto_widens_bracket():
+    """With a very tight debt limit, net demand at the default r_lo is
+    still positive; the solver must widen the bracket (or honestly report
+    bracketed=False), never return a non-clearing r* labeled as an
+    equilibrium."""
+    tight = build_simple_model(labor_states=5, labor_ar=0.9, labor_sd=0.2,
+                               a_count=48, a_max=30.0, borrow_limit=-0.05,
+                               dist_count=300)
+    eq = solve_huggett_equilibrium(tight, BETA, CRRA)
+    assert bool(eq.bracketed)
+    assert abs(float(eq.net_demand)) < 1e-3
+    # near-autarky: the rate must fall far below the loose-limit values
+    assert float(eq.r_star) < 0.0
