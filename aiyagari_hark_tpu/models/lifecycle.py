@@ -23,13 +23,30 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+import jax.numpy as _jnp
+
 from ..ops.interp import interp1d_rowwise
 from .household import (
+    CONSTRAINT_EPS,
     HouseholdPolicy,
     SimpleModel,
     egm_step,
-    initial_policy,
 )
+
+
+def _terminal_consume_everything(model: SimpleModel) -> HouseholdPolicy:
+    """Finite-horizon terminal policy: c = m exactly (die with nothing —
+    no terminal debt).  NOT ``initial_policy``: that returns c = m - b,
+    correct as an infinite-horizon seed but wrong as a last age under a
+    negative borrowing limit (agents would die owing b).  Knot positions
+    are irrelevant for representing the identity — any increasing positive
+    knots on the line c = m interpolate AND extrapolate it exactly."""
+    n = model.labor_levels.shape[0]
+    eps = _jnp.asarray(CONSTRAINT_EPS, dtype=model.a_grid.dtype)
+    m_row = _jnp.concatenate(
+        [eps[None], model.a_grid - model.a_grid[0] + 2.0 * eps])
+    m_knots = _jnp.tile(m_row, (n, 1))
+    return HouseholdPolicy(m_knots=m_knots, c_knots=m_knots)
 
 
 class LifecyclePolicy(NamedTuple):
@@ -63,7 +80,7 @@ def solve_lifecycle(R, W, model: SimpleModel, disc_fac, crra,
         survival = jnp.ones((horizon,), dtype=dtype)
     else:
         survival = jnp.asarray(survival, dtype=dtype)
-    terminal = initial_policy(model)   # c = m exactly at the last age
+    terminal = _terminal_consume_everything(model)
 
     def step(pol_next, x):
         w_next_scale, disc_t = x

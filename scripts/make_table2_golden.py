@@ -24,13 +24,14 @@ TEST_KWARGS = dict(a_count=24, dist_count=150)
 FULL_KWARGS = dict(a_count=32, dist_count=500)
 
 
-def run(kwargs):
+def run(kwargs, labor_sd: float = 0.2):
     import jax.numpy as jnp
 
     from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
     from aiyagari_hark_tpu.utils.config import SweepConfig
 
-    res = run_table2_sweep(SweepConfig(), dtype=jnp.float64, **kwargs)
+    res = run_table2_sweep(SweepConfig(labor_sd=labor_sd),
+                           dtype=jnp.float64, **kwargs)
     return {
         "config": {k: v for k, v in kwargs.items()},
         "dtype": "float64",
@@ -49,9 +50,15 @@ def main():
     select_backend("cpu")
     out_dir = os.path.join(os.path.dirname(__file__), "..", "tests", "data")
     os.makedirs(out_dir, exist_ok=True)
-    for name, kwargs in (("table2_golden_test.json", TEST_KWARGS),
-                         ("table2_golden.json", FULL_KWARGS)):
-        payload = run(kwargs)
+    # panel A (sigma_stationary = 0.2 — the reference's configuration) in
+    # both test and benchmark resolutions, plus Aiyagari's panel B
+    # (sigma = 0.4), which the reference never ran
+    jobs = (("table2_golden_test.json", TEST_KWARGS, 0.2),
+            ("table2_golden.json", FULL_KWARGS, 0.2),
+            ("table2_sd04_golden.json", FULL_KWARGS, 0.4))
+    for name, kwargs, sd in jobs:
+        payload = run(kwargs, labor_sd=sd)
+        payload["labor_sd"] = sd
         path = os.path.join(out_dir, name)
         with open(path, "w") as f:
             json.dump(payload, f, indent=2)

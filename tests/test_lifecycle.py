@@ -98,3 +98,17 @@ def test_survival_probabilities_lower_saving(model):
     c_m = np.asarray(jax.vmap(lambda mk, ck, mq: jnp.interp(mq, mk, ck))(
         pol_mortal.m_knots[0], pol_mortal.c_knots[0], m_test))
     assert (c_m > c_i).all()
+
+
+def test_terminal_no_debt_under_borrowing_limit():
+    """With a negative borrowing limit the terminal age must still consume
+    exactly m (die debt-free), not m - b — and every age's policy must
+    keep end-of-life assets feasible."""
+    m_debt = build_simple_model(labor_states=3, a_count=24,
+                                borrow_limit=-2.0)
+    pol = solve_lifecycle(R, W, m_debt, BETA, CRRA, horizon=8)
+    np.testing.assert_allclose(np.asarray(pol.c_knots[-1]),
+                               np.asarray(pol.m_knots[-1]), rtol=1e-12)
+    # simulate a cohort: final-age assets are ~0, never negative
+    out = simulate_cohort(pol, R, W, m_debt, 500, jax.random.PRNGKey(3))
+    assert abs(float(out.assets[-1])) < 1e-8
