@@ -37,6 +37,10 @@ from .models.portfolio import (  # noqa: F401
     solve_portfolio_equilibrium,
     solve_portfolio_household,
 )
+from .models.transition import (  # noqa: F401
+    TransitionResult,
+    solve_transition,
+)
 from .models.value import (  # noqa: F401
     aggregate_welfare,
     consumption_equivalent,

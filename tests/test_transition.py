@@ -1,0 +1,79 @@
+"""Perfect-foresight transition dynamics (models/transition.py).
+
+Oracles: exact steady-state invariance (a transition that starts at the
+stationary equilibrium with no shock must stay there), and the textbook
+impulse response to a transitory TFP shock (capital hump, reversion to the
+stationary level)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.models.equilibrium import solve_bisection_equilibrium
+from aiyagari_hark_tpu.models.household import build_simple_model
+from aiyagari_hark_tpu.models.transition import solve_transition
+
+ALPHA, DELTA, BETA, CRRA = 0.36, 0.08, 0.96, 2.0
+
+
+@pytest.fixture(scope="module")
+def steady_state():
+    model = build_simple_model(labor_states=5, a_count=40, dist_count=300)
+    eq = solve_bisection_equilibrium(model, BETA, CRRA, ALPHA, DELTA)
+    return model, eq
+
+
+def test_steady_state_is_invariant(steady_state):
+    """No shock + stationary initial distribution => the path IS the
+    steady state, to solver tolerance, at every horizon point."""
+    model, eq = steady_state
+    res = solve_transition(model, BETA, CRRA, ALPHA, DELTA,
+                           init_dist=eq.distribution,
+                           terminal_policy=eq.policy,
+                           k_terminal=eq.capital, horizon=60)
+    assert bool(res.converged)
+    k = np.asarray(res.k_path)
+    np.testing.assert_allclose(k, float(eq.capital), rtol=2e-3)
+    np.testing.assert_allclose(np.asarray(res.r_path), float(eq.r_star),
+                               atol=2e-4)
+
+
+def test_transitory_tfp_shock_impulse_response(steady_state):
+    """A 2% TFP shock decaying at 0.8/period: on impact the rental rate
+    jumps and households save the windfall; capital humps above the
+    stationary level, then everything reverts."""
+    model, eq = steady_state
+    horizon = 120
+    prod = 1.0 + 0.02 * 0.8 ** jnp.arange(horizon)
+    res = solve_transition(model, BETA, CRRA, ALPHA, DELTA,
+                           init_dist=eq.distribution,
+                           terminal_policy=eq.policy,
+                           k_terminal=eq.capital, horizon=horizon,
+                           prod_path=prod)
+    assert bool(res.converged)
+    k = np.asarray(res.k_path)
+    r = np.asarray(res.r_path)
+    k_ss = float(eq.capital)
+    # impact: r above its stationary level (TFP raises the MPK)
+    assert r[0] > float(eq.r_star) + 1e-4
+    # capital is predetermined on impact, then accumulates above SS
+    np.testing.assert_allclose(k[0], k_ss, rtol=1e-6)
+    assert k[1:40].max() > k_ss * 1.002
+    # hump shape: the peak is interior
+    peak = int(k.argmax())
+    assert 1 < peak < horizon - 10
+    # reversion: the tail is back at the stationary level
+    np.testing.assert_allclose(k[-1], k_ss, rtol=5e-3)
+    # aggregate consumption rises during the boom
+    c = np.asarray(res.c_agg_path)
+    assert c[:20].mean() > c[-20:].mean() * 1.001
+
+
+def test_transition_is_jittable(steady_state):
+    model, eq = steady_state
+    f = jax.jit(lambda d: solve_transition(
+        model, BETA, CRRA, ALPHA, DELTA, init_dist=d,
+        terminal_policy=eq.policy, k_terminal=eq.capital, horizon=40))
+    res = f(eq.distribution)
+    assert np.isfinite(np.asarray(res.k_path)).all()
