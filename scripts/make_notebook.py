@@ -150,7 +150,15 @@ def build(quick: bool) -> nbf.NotebookNode:
            "- **Life cycle** — `solve_lifecycle` / `simulate_cohort` "
            "(models/lifecycle.py).\n"
            "- **Two-asset portfolio choice** — "
-           "`solve_portfolio_equilibrium` (models/portfolio.py)."),
+           "`solve_portfolio_equilibrium` (models/portfolio.py).\n"
+           "- **Huggett bond economy** — negative borrowing limits + "
+           "zero-net-supply credit-market clearing "
+           "(`solve_huggett_equilibrium`, models/huggett.py).\n"
+           "- **MIT-shock transitions** — perfect-foresight impulse "
+           "responses (`solve_transition`, models/transition.py).\n"
+           "- **Accuracy diagnostics** — den Haan (2010) dynamic-forecast "
+           "errors of the aggregate law (`den_haan_forecast`, "
+           "models/diagnostics.py)."),
     ]
     nb.cells = cells
     nb.metadata.kernelspec = {"display_name": "Python 3",
