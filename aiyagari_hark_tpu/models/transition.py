@@ -38,6 +38,7 @@ from .household import (
     HouseholdPolicy,
     SimpleModel,
     _push_forward,
+    aggregate_capital,
     aggregate_labor,
     egm_step,
     wealth_transition,
@@ -70,6 +71,79 @@ def _forward_step(dist, policy_t, R, W, model: SimpleModel):
     return new_dist, c_agg, k_next
 
 
+def _transition_prices(k_path, prod_path, model: SimpleModel, cap_share,
+                       depr_fac):
+    labor = aggregate_labor(model)
+    k_to_l = k_path / labor
+    r = firm.interest_factor(k_to_l, cap_share, depr_fac, prod_path) - 1.0
+    w = firm.wage_rate(k_to_l, cap_share, prod_path)
+    return r, w
+
+
+def household_path_response(r_path, w_path, model: SimpleModel, disc_fac,
+                            crra, init_dist,
+                            terminal_policy: HouseholdPolicy):
+    """The heterogeneous-agent block as a map on PRICE paths: perfectly
+    foreseen ``(r_path, w_path)`` in, the implied aggregate capital and
+    consumption paths out.
+
+    One evaluation is a *backward* ``lax.scan`` of the EGM step along the
+    price path (seeded by the terminal stationary policy) followed by a
+    *forward* ``lax.scan`` of the histogram push-forward from
+    ``init_dist`` — both differentiable, no Python loops.  This is the map
+    whose derivative is the household sequence-space Jacobian
+    (``models/jacobian.py`` takes it with one ``jax.jacrev``).
+
+    The first implied-capital entry is ``E[a]`` under ``init_dist``
+    (capital in production at t=0 was saved before the paths began), a
+    CONSTANT in the price paths — so the implied path never moves ``K_0``
+    and ``I - dH/dK`` is nonsingular for the general-equilibrium solve.
+
+    Returns ``(k_implied [T], c_agg [T])``.
+    """
+
+    def backward_step(pol_next, rw):
+        r_next, w_next = rw
+        pol = egm_step(pol_next, 1.0 + r_next, w_next, model, disc_fac,
+                       crra)
+        return pol, pol
+
+    # policies for t = T-2..0, each consuming period t+1's prices; period
+    # T-1 uses the terminal stationary policy (beyond the horizon the
+    # economy is stationary)
+    _, pols = jax.lax.scan(backward_step, terminal_policy,
+                           (r_path[1:][::-1], w_path[1:][::-1]))
+    pols = jax.tree.map(
+        lambda s, term: jnp.concatenate([s[::-1], term[None]], axis=0),
+        pols, terminal_policy)
+
+    def forward_step(dist, inputs):
+        pol, r, w = inputs
+        new_dist, c_agg, k_next = _forward_step(dist, pol, 1.0 + r, w,
+                                                model)
+        return new_dist, (c_agg, k_next)
+
+    _, (c_agg, k_next) = jax.lax.scan(forward_step, init_dist,
+                                      (pols, r_path, w_path))
+    k0 = aggregate_capital(init_dist, model)
+    k_implied = jnp.concatenate([k0[None], k_next[:-1]])
+    return k_implied, c_agg
+
+
+def transition_path_map(k_path, prod_path, model: SimpleModel, disc_fac,
+                        crra, cap_share, depr_fac, init_dist,
+                        terminal_policy: HouseholdPolicy):
+    """The sequence-space map ``H``: a guessed capital path and a TFP path
+    in, the household-implied capital path (and the aggregate-consumption
+    path) out — prices from the firm block composed with
+    ``household_path_response``.  ``solve_transition`` iterates ``H`` to
+    its fixed point.  Returns ``(k_implied [T], c_agg [T])``."""
+    r_path, w_path = _transition_prices(k_path, prod_path, model, cap_share,
+                                        depr_fac)
+    return household_path_response(r_path, w_path, model, disc_fac, crra,
+                                   init_dist, terminal_policy)
+
+
 def solve_transition(model: SimpleModel, disc_fac, crra, cap_share,
                      depr_fac, init_dist: jnp.ndarray,
                      terminal_policy: HouseholdPolicy,
@@ -96,55 +170,15 @@ def solve_transition(model: SimpleModel, disc_fac, crra, cap_share,
     Returns the path with aggregate consumption and convergence info.
     """
     dtype = model.a_grid.dtype
-    labor = aggregate_labor(model)
     if prod_path is None:
         prod_path = jnp.ones((horizon,), dtype=dtype)
     else:
         prod_path = jnp.asarray(prod_path, dtype=dtype)
-    k0 = jnp.sum(init_dist * model.dist_grid[:, None])
+    k0 = aggregate_capital(init_dist, model)
     # initial guess: geometric interpolation from K_0 to the terminal K
     frac = jnp.linspace(0.0, 1.0, horizon, dtype=dtype)
     k_guess = jnp.exp((1.0 - frac) * jnp.log(k0)
                       + frac * jnp.log(jnp.asarray(k_terminal, dtype=dtype)))
-
-    def prices(k_path):
-        k_to_l = k_path / labor
-        r = firm.interest_factor(k_to_l, cap_share, depr_fac,
-                                 prod_path) - 1.0
-        w = firm.wage_rate(k_to_l, cap_share, prod_path)
-        return r, w
-
-    def backward(r_path, w_path):
-        """Policies for t = 0..T-1; the step at t uses t+1's prices.  For
-        the last period, t+1 prices are the terminal steady state's —
-        represented by scanning over (R, W) paths shifted by one with the
-        terminal policy as the initial carry."""
-
-        def step(pol_next, rw):
-            r_next, w_next = rw
-            pol = egm_step(pol_next, 1.0 + r_next, w_next, model,
-                           disc_fac, crra)
-            return pol, pol
-
-        # reversed over t = T-2..0 consuming prices at t+1
-        _, pols = jax.lax.scan(step, terminal_policy,
-                               (r_path[1:][::-1], w_path[1:][::-1]))
-        # index 0 = period 0's policy; period T-1 uses the terminal policy
-        # (beyond the horizon the economy is stationary)
-        return jax.tree.map(
-            lambda s, term: jnp.concatenate([s[::-1], term[None]], axis=0),
-            pols, terminal_policy)
-
-    def simulate(pols, r_path, w_path):
-        def step(dist, inputs):
-            pol, r, w = inputs
-            new_dist, c_agg, k_next = _forward_step(dist, pol, 1.0 + r, w,
-                                                    model)
-            return new_dist, (c_agg, k_next)
-
-        _, (c_agg, k_next) = jax.lax.scan(
-            step, init_dist, (pols, r_path, w_path))
-        return c_agg, k_next
 
     big = jnp.asarray(jnp.inf, dtype=dtype)
 
@@ -154,20 +188,20 @@ def solve_transition(model: SimpleModel, disc_fac, crra, cap_share,
 
     def body(state):
         k_path, _, it = state
-        r_path, w_path = prices(k_path)
-        pols = backward(r_path, w_path)
-        _, k_next = simulate(pols, r_path, w_path)
-        # implied path: K_0 fixed, K_{t+1} = E[savings at t]
-        k_implied = jnp.concatenate([k_path[:1], k_next[:-1]])
+        k_implied, _ = transition_path_map(k_path, prod_path, model,
+                                           disc_fac, crra, cap_share,
+                                           depr_fac, init_dist,
+                                           terminal_policy)
         diff = jnp.max(jnp.abs(k_implied - k_path))
         new = damping * k_path + (1.0 - damping) * k_implied
         return new, diff, it + 1
 
     k_path, diff, it = jax.lax.while_loop(
         cond, body, (k_guess, big, jnp.asarray(0)))
-    r_path, w_path = prices(k_path)
-    pols = backward(r_path, w_path)
-    c_agg, _ = simulate(pols, r_path, w_path)
+    r_path, w_path = _transition_prices(k_path, prod_path, model, cap_share,
+                                        depr_fac)
+    _, c_agg = household_path_response(r_path, w_path, model, disc_fac,
+                                       crra, init_dist, terminal_policy)
     return TransitionResult(k_path=k_path, r_path=r_path, w_path=w_path,
                             c_agg_path=c_agg, converged=diff <= tol,
                             iterations=it, max_diff=diff)
