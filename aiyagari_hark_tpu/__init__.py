@@ -37,8 +37,21 @@ from .models.portfolio import (  # noqa: F401
     solve_portfolio_equilibrium,
     solve_portfolio_household,
 )
+from .models.jacobian import (  # noqa: F401
+    BusinessCycleMoments,
+    HouseholdJacobians,
+    LinearIRF,
+    SequenceJacobians,
+    business_cycle_moments,
+    household_jacobians,
+    innovation_irf,
+    linear_impulse_response,
+    sequence_jacobians,
+    simulate_linear,
+)
 from .models.transition import (  # noqa: F401
     TransitionResult,
+    household_path_response,
     solve_transition,
 )
 from .models.value import (  # noqa: F401

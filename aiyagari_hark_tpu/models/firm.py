@@ -32,9 +32,14 @@ def k_to_l_from_r(r, cap_share, depr_fac, prod=1.0):
     return ((r + depr_fac) / (prod * cap_share)) ** (1.0 / (cap_share - 1.0))
 
 
+def output(k, l, cap_share, prod=1.0):
+    """Gross output Y = Z K^a L^(1-a)."""
+    return prod * k ** cap_share * l ** (1.0 - cap_share)
+
+
 def aggregate_resources(k, l, cap_share, depr_fac, prod=1.0):
     """M = (1-d) K + Z K^a L^(1-a) (``Aiyagari_Support.py:975-976``)."""
-    return (1.0 - depr_fac) * k + prod * k ** cap_share * l ** (1.0 - cap_share)
+    return (1.0 - depr_fac) * k + output(k, l, cap_share, prod)
 
 
 class SteadyState(NamedTuple):

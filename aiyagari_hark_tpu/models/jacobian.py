@@ -64,12 +64,14 @@ class SequenceJacobians(NamedTuple):
     g_r: jnp.ndarray     # [T, T]  dr_s/dZ_t
     g_w: jnp.ndarray     # [T, T]  dw_s/dZ_t
     g_c: jnp.ndarray     # [T, T]  dC_s/dZ_t
+    g_y: jnp.ndarray     # [T, T]  dY_s/dZ_t (output)
     household: HouseholdJacobians
     h_k: jnp.ndarray     # [T, T]  ∂H_s/∂K_t of the K-path fixed-point map
     h_z: jnp.ndarray     # [T, T]  ∂H_s/∂Z_t
     k_ss: jnp.ndarray
     r_ss: jnp.ndarray
     w_ss: jnp.ndarray
+    y_ss: jnp.ndarray
 
 
 class LinearIRF(NamedTuple):
@@ -79,6 +81,7 @@ class LinearIRF(NamedTuple):
     dr: jnp.ndarray      # [T] net-interest-rate deviation
     dw: jnp.ndarray      # [T] wage deviation
     dc: jnp.ndarray      # [T] aggregate-consumption deviation
+    dy: jnp.ndarray      # [T] output deviation
 
 
 def household_jacobians(model: SimpleModel, disc_fac, crra,
@@ -135,8 +138,12 @@ def sequence_jacobians(model: SimpleModel, disc_fac, crra, cap_share,
     def w_of(k, z):
         return firm.wage_rate(k / labor, cap_share, z)
 
+    def y_of(k, z):
+        return firm.output(k, labor, cap_share, z)
+
     r_k, r_z = jax.grad(r_of, argnums=(0, 1))(eq.capital, one)
     w_k, w_z = jax.grad(w_of, argnums=(0, 1))(eq.capital, one)
+    y_k, y_z = jax.grad(y_of, argnums=(0, 1))(eq.capital, one)
 
     h_k = r_k * hh.k_r + w_k * hh.k_w
     h_z = r_z * hh.k_r + w_z * hh.k_w
@@ -144,11 +151,13 @@ def sequence_jacobians(model: SimpleModel, disc_fac, crra, cap_share,
     g_k = jnp.linalg.solve(eye - h_k, h_z)
     g_r = r_k * g_k + r_z * eye
     g_w = w_k * g_k + w_z * eye
+    g_y = y_k * g_k + y_z * eye
     g_c = (r_k * hh.c_r + w_k * hh.c_w) @ g_k + (r_z * hh.c_r
                                                  + w_z * hh.c_w)
-    return SequenceJacobians(g_k=g_k, g_r=g_r, g_w=g_w, g_c=g_c,
+    return SequenceJacobians(g_k=g_k, g_r=g_r, g_w=g_w, g_c=g_c, g_y=g_y,
                              household=hh, h_k=h_k, h_z=h_z,
-                             k_ss=eq.capital, r_ss=eq.r_star, w_ss=eq.wage)
+                             k_ss=eq.capital, r_ss=eq.r_star, w_ss=eq.wage,
+                             y_ss=y_of(eq.capital, one))
 
 
 def linear_impulse_response(jac: SequenceJacobians,
@@ -160,4 +169,94 @@ def linear_impulse_response(jac: SequenceJacobians,
     ``tests/test_jacobian.py`` checks it)."""
     dz = jnp.asarray(dz_path, dtype=jac.g_k.dtype)
     return LinearIRF(dk=jac.g_k @ dz, dr=jac.g_r @ dz, dw=jac.g_w @ dz,
-                     dc=jac.g_c @ dz)
+                     dc=jac.g_c @ dz, dy=jac.g_y @ dz)
+
+
+# ---------------------------------------------------------------------------
+# Linearized stochastic aggregate dynamics: once TFP follows an AR(1)
+# log-deviation process dz_t = rho dz_{t-1} + eps_t, certainty equivalence
+# makes the date-0 innovation IRF (response to the foreseen path rho^s)
+# the MA(infinity) kernel of every aggregate, and business-cycle second
+# moments are inner products of those kernels — no simulation, no
+# sampling noise.  This is the "estimate" half of the sequence-space
+# method: a likelihood needs exactly these model-implied covariances.
+# ---------------------------------------------------------------------------
+
+
+class BusinessCycleMoments(NamedTuple):
+    """Model-implied second moments of the linearized aggregates under
+    AR(1) TFP innovations with std ``sigma_eps``."""
+
+    std: dict            # {"k","r","w","c","y","z"} -> unconditional std
+    autocorr1: dict      # first-order autocorrelations
+    corr_with_y: dict    # contemporaneous correlations with output
+
+
+def innovation_irf(jac: SequenceJacobians, rho: float) -> LinearIRF:
+    """IRF to a UNIT TFP innovation at date 0 under AR(1) persistence
+    ``rho``: the foreseen path is rho^s, so this is one matvec per
+    aggregate.  In the stationary linear model the same kernel, shifted,
+    is the response to an innovation at any date — i.e. the MA
+    coefficients.  Validity of the truncation-at-T reading is checked by
+    the horizon-invariance and IRF-decay tests in
+    ``tests/test_jacobian.py``."""
+    T = jac.g_k.shape[0]
+    rho = jnp.asarray(rho, dtype=jac.g_k.dtype)
+    return linear_impulse_response(jac, rho ** jnp.arange(T))
+
+
+def _ma_moments(kernels: dict, sigma_eps) -> BusinessCycleMoments:
+    """Second moments from MA kernels: for X_t = sum_j m_j eps_{t-j},
+    cov(X_t, Y_{t-k}) = sigma² sum_j mX_{j+k} mY_j, truncated at the
+    Jacobian horizon (the kernels have decayed — the IRF-decay test pins
+    this)."""
+
+    def cov(mx, my, lag=0):
+        return sigma_eps ** 2 * jnp.sum(mx[lag:] * my[:mx.shape[0] - lag])
+
+    std = {k: jnp.sqrt(cov(m, m)) for k, m in kernels.items()}
+    autocorr1 = {k: cov(m, m, lag=1) / cov(m, m)
+                 for k, m in kernels.items()}
+    my = kernels["y"]
+    corr_with_y = {k: cov(m, my) / (std[k] * std["y"])
+                   for k, m in kernels.items()}
+    return BusinessCycleMoments(std=std, autocorr1=autocorr1,
+                                corr_with_y=corr_with_y)
+
+
+def _ma_kernels(jac: SequenceJacobians, rho: float) -> dict:
+    """The MA kernels of every aggregate (plus the exogenous z itself)
+    under AR(1) TFP — the ONE place the kernel dict is built, shared by
+    the analytic moments and the simulator so they cannot diverge."""
+    irf = innovation_irf(jac, rho)
+    T = jac.g_k.shape[0]
+    z_kernel = jnp.asarray(rho, dtype=jac.g_k.dtype) ** jnp.arange(T)
+    return {"k": irf.dk, "r": irf.dr, "w": irf.dw, "c": irf.dc,
+            "y": irf.dy, "z": z_kernel}
+
+
+def business_cycle_moments(jac: SequenceJacobians, rho: float,
+                           sigma_eps: float) -> BusinessCycleMoments:
+    """Unconditional second moments of (K, r, w, C, Y, Z) in the
+    linearized economy with AR(1) TFP (persistence ``rho``, innovation
+    std ``sigma_eps``) — closed form from the innovation IRF."""
+    return _ma_moments(_ma_kernels(jac, rho), sigma_eps)
+
+
+def simulate_linear(jac: SequenceJacobians, rho: float, sigma_eps: float,
+                    length: int, key) -> dict:
+    """Monte-Carlo sample path of the linearized aggregates: draw
+    innovations, convolve with the MA kernels.  Mainly a cross-check on
+    ``business_cycle_moments`` (the analytic moments are exact; the
+    simulated ones carry O(1/sqrt(length)) sampling error) and a way to
+    produce aggregate paths for external consumers.  Returns
+    ``{"k","r","w","c","y","z"}`` -> [length] deviation paths (the first
+    ``T`` entries carry kernel warm-up and are dropped)."""
+    T = jac.g_k.shape[0]
+    eps = sigma_eps * jax.random.normal(key, (length + T,),
+                                        dtype=jac.g_k.dtype)
+    out = {}
+    for name, m in _ma_kernels(jac, rho).items():
+        full = jnp.convolve(eps, m, mode="full")[:length + T]
+        out[name] = full[T:]
+    return out
