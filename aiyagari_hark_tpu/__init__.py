@@ -23,6 +23,12 @@ from .models.equilibrium import (  # noqa: F401
     solve_calibration,
     solve_calibration_lean,
 )
+from .models.heterogeneity import (  # noqa: F401
+    HeterogeneousEquilibrium,
+    population_distribution,
+    solve_heterogeneous_equilibrium,
+    uniform_beta_types,
+)
 from .models.huggett import (  # noqa: F401
     HuggettEquilibrium,
     solve_huggett_equilibrium,

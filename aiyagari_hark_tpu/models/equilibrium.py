@@ -103,6 +103,29 @@ def _bisection_setup(model: SimpleModel, disc_fac, depr_fac,
     return r_tol, egm_tol, dist_tol, r_lo, r_hi
 
 
+def _bisect(excess_fn, r_lo, r_hi, r_tol, max_bisect: int):
+    """Fixed-trip bisection on an excess map that is increasing in r:
+    positive excess moves the upper bracket down.  Shared by every
+    interest-rate market-clearing loop (homogeneous, beta-dist).
+    Returns ``(r_star, iterations)``; fully jit/vmap-safe."""
+
+    def cond(state):
+        lo, hi, it = state
+        return ((hi - lo) > r_tol) & (it < max_bisect)
+
+    def body(state):
+        lo, hi, it = state
+        mid = 0.5 * (lo + hi)
+        ex = excess_fn(mid)
+        lo = jnp.where(ex > 0, lo, mid)
+        hi = jnp.where(ex > 0, mid, hi)
+        return lo, hi, it + 1
+
+    lo, hi, iters = jax.lax.while_loop(
+        cond, body, (r_lo, r_hi, jnp.asarray(0)))
+    return 0.5 * (lo + hi), iters
+
+
 def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
                                 cap_share, depr_fac, prod=1.0,
                                 r_tol: float | None = None,
@@ -128,22 +151,7 @@ def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
         demand = firm.k_to_l_from_r(r, cap_share, depr_fac, prod) * labor
         return supply - demand
 
-    def cond(state):
-        lo, hi, it = state
-        return ((hi - lo) > r_tol) & (it < max_bisect)
-
-    def body(state):
-        lo, hi, it = state
-        mid = 0.5 * (lo + hi)
-        ex = excess_supply(mid)
-        # excess supply increasing in r: positive -> equilibrium is below mid
-        lo = jnp.where(ex > 0, lo, mid)
-        hi = jnp.where(ex > 0, mid, hi)
-        return lo, hi, it + 1
-
-    lo, hi, iters = jax.lax.while_loop(
-        cond, body, (r_lo, r_hi, jnp.asarray(0)))
-    r_star = 0.5 * (lo + hi)
+    r_star, iters = _bisect(excess_supply, r_lo, r_hi, r_tol, max_bisect)
 
     supply, policy, dist, wage, k_to_l, _, _ = household_capital_supply(
         r_star, model, disc_fac, crra, cap_share, depr_fac, prod,

@@ -1,0 +1,136 @@
+"""Preference heterogeneity: general equilibrium with a distribution of
+discount factors ("beta-dist" economies).
+
+The homogeneous Aiyagari model famously concentrates too little wealth
+(the reference's own Lorenz comparison against the SCF shows it,
+`Aiyagari-HARK.py:299-335`); Krusell & Smith (1998, §3) and Carroll,
+Slacalek, Tokuoka & White (2017) fix this with a small spread of
+discount factors — patient types accumulate most of the wealth, matching
+the empirical concentration.  The reference repo has no machinery for
+this at all (one agent type, one beta).
+
+TPU shape: a type is just one more batch axis.  The per-type capital
+supply A_j(r) is the existing ``household_capital_supply`` vmapped over
+``disc_fac``; aggregate supply is the population-weighted sum; the
+equilibrium is the same fixed-trip bisection as the homogeneous engine.
+J types cost one vmap lane each inside the same jitted program — no
+Python loop over types, and the whole solve remains vmappable over
+calibration cells (a beta-dist Table II sweep is a nested vmap).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import firm
+from .equilibrium import _bisect, _bisection_setup, household_capital_supply
+from .household import (
+    HouseholdPolicy,
+    SimpleModel,
+    aggregate_labor,
+)
+
+
+class HeterogeneousEquilibrium(NamedTuple):
+    r_star: jnp.ndarray
+    wage: jnp.ndarray
+    capital: jnp.ndarray         # aggregate K = sum_j weight_j * A_j(r*)
+    labor: jnp.ndarray
+    saving_rate: jnp.ndarray
+    excess: jnp.ndarray
+    type_capital: jnp.ndarray    # [J] per-type mean asset holdings
+    policies: HouseholdPolicy    # [J, ...] stacked per-type policies
+    distributions: jnp.ndarray   # [J, D, N] per-type stationary wealth
+    weights: jnp.ndarray         # [J] population shares (echoed back)
+    bisect_iters: jnp.ndarray
+
+
+def uniform_beta_types(center: float, spread: float,
+                       n_types: int) -> jnp.ndarray:
+    """Carroll et al. (2017)-style discrete uniform approximation of a
+    beta distribution on ``[center - spread, center + spread]``: type j
+    sits at the midpoint of the j-th of ``n_types`` equal bands."""
+    j = jnp.arange(n_types, dtype=jnp.result_type(float))
+    return center - spread + spread * (2.0 * j + 1.0) / n_types
+
+
+def heterogeneous_capital_supply(r, model: SimpleModel, disc_facs,
+                                 weights, crra, cap_share, depr_fac,
+                                 prod=1.0, egm_tol=1e-6, dist_tol=1e-11):
+    """Population capital supply at rate ``r``: vmap the per-type supply
+    over the discount-factor axis and weight (weights are normalized to
+    population shares internally, so counts are fine).  Returns
+    (aggregate supply, per-type supply [J], stacked policies, stacked
+    distributions, wage)."""
+    disc_facs = jnp.asarray(disc_facs, dtype=model.a_grid.dtype)
+    weights = jnp.asarray(weights, dtype=model.a_grid.dtype)
+    weights = weights / jnp.sum(weights)
+
+    def one_type(beta):
+        ev = household_capital_supply(r, model, beta, crra, cap_share,
+                                      depr_fac, prod, egm_tol=egm_tol,
+                                      dist_tol=dist_tol)
+        return ev.supply, ev.policy, ev.distribution, ev.wage
+
+    supply_j, policies, dists, wage_j = jax.vmap(one_type)(disc_facs)
+    return (jnp.sum(weights * supply_j), supply_j, policies, dists,
+            wage_j[0])
+
+
+def solve_heterogeneous_equilibrium(model: SimpleModel, disc_facs,
+                                    weights, crra, cap_share, depr_fac,
+                                    prod=1.0, r_tol: float | None = None,
+                                    max_bisect: int = 60,
+                                    egm_tol: float | None = None,
+                                    dist_tol: float | None = None
+                                    ) -> HeterogeneousEquilibrium:
+    """Bisect r until the capital market clears against the
+    population-weighted supply of all discount-factor types.
+
+    The stationarity requirement caps the most patient type:
+    ``max(disc_facs) * (1 + r*) < 1`` must hold, so the bisection's upper
+    bracket is set by ``max(disc_facs)`` (the impatient types just hold
+    less wealth).  Weights are normalized internally.
+
+    Degenerate check (tests): with all types at the same beta this
+    reproduces ``solve_bisection_equilibrium`` exactly.
+    """
+    disc_facs = jnp.asarray(disc_facs, dtype=model.a_grid.dtype)
+    weights = jnp.asarray(weights, dtype=model.a_grid.dtype)
+    weights = weights / jnp.sum(weights)
+    # the binding stationarity bound is the most patient type's; keep it
+    # traced so the whole solver jits/vmaps (a beta-dist sweep is a
+    # nested vmap over calibration cells)
+    r_tol, egm_tol, dist_tol, r_lo, r_hi = _bisection_setup(
+        model, jnp.max(disc_facs), depr_fac, r_tol, egm_tol, dist_tol)
+    labor = aggregate_labor(model)
+
+    def excess_supply(r):
+        supply, _, _, _, _ = heterogeneous_capital_supply(
+            r, model, disc_facs, weights, crra, cap_share, depr_fac,
+            prod, egm_tol=egm_tol, dist_tol=dist_tol)
+        demand = firm.k_to_l_from_r(r, cap_share, depr_fac, prod) * labor
+        return supply - demand
+
+    r_star, iters = _bisect(excess_supply, r_lo, r_hi, r_tol, max_bisect)
+
+    supply, supply_j, policies, dists, wage = heterogeneous_capital_supply(
+        r_star, model, disc_facs, weights, crra, cap_share, depr_fac,
+        prod, egm_tol=egm_tol, dist_tol=dist_tol)
+    demand = firm.k_to_l_from_r(r_star, cap_share, depr_fac, prod) * labor
+    y = firm.output(supply, labor, cap_share, prod)
+    return HeterogeneousEquilibrium(
+        r_star=r_star, wage=wage, capital=supply, labor=labor,
+        saving_rate=depr_fac * supply / y, excess=supply - demand,
+        type_capital=supply_j, policies=policies, distributions=dists,
+        weights=weights, bisect_iters=iters)
+
+
+def population_distribution(eq: HeterogeneousEquilibrium) -> jnp.ndarray:
+    """The economy-wide stationary wealth distribution: the
+    population-weighted mixture of the per-type distributions, on the
+    shared ``dist_grid`` — feed it to ``utils.stats`` for Lorenz/Gini."""
+    return jnp.einsum("j,jdn->dn", eq.weights, eq.distributions)
