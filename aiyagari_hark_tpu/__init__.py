@@ -48,7 +48,9 @@ from .models.jacobian import (  # noqa: F401
     HouseholdJacobians,
     LinearIRF,
     SequenceJacobians,
+    ShockFit,
     business_cycle_moments,
+    fit_shock_process,
     household_jacobians,
     innovation_irf,
     linear_impulse_response,

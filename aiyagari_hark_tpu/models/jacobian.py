@@ -243,6 +243,77 @@ def business_cycle_moments(jac: SequenceJacobians, rho: float,
     return _ma_moments(_ma_kernels(jac, rho), sigma_eps)
 
 
+class ShockFit(NamedTuple):
+    rho: jnp.ndarray
+    sigma_eps: jnp.ndarray
+    loss: jnp.ndarray        # final squared relative moment distance
+    iterations: jnp.ndarray
+    converged: jnp.ndarray
+
+
+def fit_shock_process(jac: SequenceJacobians, target_std_y,
+                      target_autocorr1_y, max_iter: int = 50,
+                      tol: float | None = None) -> ShockFit:
+    """Estimate the AR(1) TFP process (rho, sigma_eps) from observed
+    output moments — the simplest instance of sequence-space estimation
+    (Auclert et al. 2021 §5): model moments are *differentiable*
+    functions of the shock parameters through the MA kernels, so the
+    two-moment match is a square system solved by Newton with
+    ``jax.jacfwd`` — no simulation anywhere in the loop.
+
+    Matches (std(Y), autocorr1(Y)).  Parameters live in unconstrained
+    space (logit rho, log sigma); residuals are relative so the two
+    targets are comparably scaled; steps are clipped to ±1 in the
+    unconstrained space to keep early iterations inside the basin.  The
+    Jacobians ``jac`` are fixed — only the shock process is
+    re-estimated, which is exactly the division of labor that makes
+    sequence-space estimation fast (the expensive household block
+    enters through kernels computed once)."""
+    dtype = jac.g_k.dtype
+    if tol is None:
+        # squared relative residuals bottom out near dtype epsilon²; an
+        # f64 tolerance on f32 would burn max_iter without certifying
+        # (the same hazard _bisection_setup documents)
+        tol = 1e-12 if dtype == jnp.float64 else 1e-10
+    t_std = jnp.asarray(target_std_y, dtype=dtype)
+    t_ac = jnp.asarray(target_autocorr1_y, dtype=dtype)
+    T = jac.g_k.shape[0]
+    idx = jnp.arange(T, dtype=dtype)
+
+    def residuals(theta):
+        rho = jax.nn.sigmoid(theta[0])
+        sigma = jnp.exp(theta[1])
+        # inline MA moments for Y only (differentiable in rho via rho**t)
+        kernel = jac.g_y @ (rho ** idx)
+        var = sigma ** 2 * jnp.sum(kernel * kernel)
+        cov1 = sigma ** 2 * jnp.sum(kernel[1:] * kernel[:-1])
+        return jnp.asarray([jnp.sqrt(var) / t_std - 1.0,
+                            (cov1 / var - t_ac) / jnp.maximum(t_ac, 0.1)])
+
+    jac_fn = jax.jacfwd(residuals)
+
+    def loss_of(r):
+        return jnp.sum(r * r)
+
+    def cond(state):
+        _, r, it = state
+        return (loss_of(r) > tol) & (it < max_iter)
+
+    def body(state):
+        theta, r, it = state
+        step = jnp.linalg.solve(jac_fn(theta), r)
+        theta = theta - jnp.clip(step, -1.0, 1.0)
+        return theta, residuals(theta), it + 1
+
+    theta0 = jnp.asarray([jnp.log(0.9 / 0.1), jnp.log(0.01)], dtype=dtype)
+    theta, r, iters = jax.lax.while_loop(
+        cond, body, (theta0, residuals(theta0), jnp.asarray(0)))
+    loss = loss_of(r)
+    return ShockFit(rho=jax.nn.sigmoid(theta[0]),
+                    sigma_eps=jnp.exp(theta[1]), loss=loss,
+                    iterations=iters, converged=loss <= tol)
+
+
 def simulate_linear(jac: SequenceJacobians, rho: float, sigma_eps: float,
                     length: int, key) -> dict:
     """Monte-Carlo sample path of the linearized aggregates: draw

@@ -156,9 +156,80 @@ def build(quick: bool) -> nbf.NotebookNode:
            "(`solve_huggett_equilibrium`, models/huggett.py).\n"
            "- **MIT-shock transitions** — perfect-foresight impulse "
            "responses (`solve_transition`, models/transition.py).\n"
+           "- **Sequence-space Jacobians** — `jax.jacrev` through the "
+           "transition path map; linear GE IRFs and business-cycle "
+           "moments (`sequence_jacobians`, models/jacobian.py).\n"
+           "- **Discount-factor heterogeneity** — beta-dist wealth "
+           "concentration (`solve_heterogeneous_equilibrium`, "
+           "models/heterogeneity.py).\n"
            "- **Accuracy diagnostics** — den Haan (2010) dynamic-forecast "
            "errors of the aggregate law (`den_haan_forecast`, "
-           "models/diagnostics.py)."),
+           "models/diagnostics.py).\n\n"
+           "Two live examples below."),
+        md("### GE impulse response to a 1% TFP shock\n\n"
+           "The nonlinear MIT-shock path (damped fixed point on the "
+           "capital path) against its sequence-space linearization (one "
+           "`jax.jacrev` + one linear solve) — they agree to O(‖shock‖²)."),
+        code("import jax.numpy as jnp\n"
+             "from aiyagari_hark_tpu.models.household import "
+             "build_simple_model\n"
+             "from aiyagari_hark_tpu.models.equilibrium import "
+             "solve_bisection_equilibrium\n"
+             "from aiyagari_hark_tpu.models.jacobian import "
+             "(sequence_jacobians,\n"
+             "                                             "
+             "linear_impulse_response)\n"
+             "from aiyagari_hark_tpu.models.transition import "
+             "solve_transition\n\n"
+             "T = 40\n"
+             "m5 = build_simple_model(labor_states=5, labor_ar=0.3, "
+             "a_count=32,\n"
+             "                        dist_count=150, dtype=info.dtype)\n"
+             "eq = solve_bisection_equilibrium(m5, 0.96, 1.0, "
+             "econ_dict['CapShare'], depr)\n"
+             "dz = 0.01 * 0.8 ** np.arange(T)\n"
+             "jac = sequence_jacobians(m5, 0.96, 1.0, "
+             "econ_dict['CapShare'], depr, eq, T)\n"
+             "lin = linear_impulse_response(jac, jnp.asarray(dz))\n"
+             "nl = solve_transition(m5, 0.96, 1.0, econ_dict['CapShare'], "
+             "depr,\n"
+             "                      init_dist=eq.distribution, "
+             "terminal_policy=eq.policy,\n"
+             "                      k_terminal=eq.capital, horizon=T, "
+             "prod_path=1 + dz)\n"
+             "k_ss = float(eq.capital)\n"
+             "plt.plot(100 * (np.asarray(nl.k_path) / k_ss - 1), "
+             "label='K nonlinear')\n"
+             "plt.plot(100 * np.asarray(lin.dk) / k_ss, ':', "
+             "label='K linear (Jacobian)')\n"
+             "plt.plot(100 * dz, 'k--', label='TFP (%)')\n"
+             "plt.xlabel('quarters'); plt.ylabel('% dev from SS'); "
+             "plt.legend(); plt.show()"),
+        md("### Beta-dist wealth concentration\n\n"
+           "A ±0.012 uniform spread of discount factors around the "
+           "notebook β: the patient quartile accumulates most of the "
+           "wealth and the Gini jumps toward its empirical level — the "
+           "Krusell–Smith §3 / Carroll et al. (2017) mechanism."),
+        code("from aiyagari_hark_tpu.models.heterogeneity import "
+             "(uniform_beta_types,\n"
+             "    solve_heterogeneous_equilibrium, "
+             "population_distribution)\n\n"
+             "betas = uniform_beta_types(0.96, 0.012, 4)\n"
+             "het = solve_heterogeneous_equilibrium(m5, betas, "
+             "np.ones(4), 1.0,\n"
+             "                                      "
+             "econ_dict['CapShare'], depr)\n"
+             "grid = np.asarray(m5.dist_grid)\n"
+             "g_hom = stats.gini(grid, "
+             "np.asarray(eq.distribution).sum(1))\n"
+             "g_het = stats.gini(grid, "
+             "np.asarray(population_distribution(het)).sum(1))\n"
+             "print(f'r*: homogeneous {float(eq.r_star):.4%}  beta-dist "
+             "{float(het.r_star):.4%}')\n"
+             "print(f'wealth Gini: homogeneous {g_hom:.3f}  beta-dist "
+             "{g_het:.3f}')\n"
+             "print('per-type mean wealth:', "
+             "np.round(np.asarray(het.type_capital), 2))"),
     ]
     nb.cells = cells
     nb.metadata.kernelspec = {"display_name": "Python 3",

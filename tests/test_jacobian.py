@@ -173,6 +173,21 @@ def test_business_cycle_moments_match_simulation(jacobians):
     np.testing.assert_allclose(float(mom.autocorr1["z"]), rho, atol=5e-3)
 
 
+def test_fit_shock_process_recovers_truth(jacobians):
+    """Self-consistency of sequence-space estimation: generate output
+    moments at known (rho, sigma), re-estimate by gradient descent
+    through the analytic moments, recover the truth."""
+    from aiyagari_hark_tpu.models.jacobian import fit_shock_process
+
+    rho_true, sigma_true = 0.92, 0.011
+    mom = business_cycle_moments(jacobians, rho_true, sigma_true)
+    fit = fit_shock_process(jacobians, mom.std["y"], mom.autocorr1["y"])
+    assert bool(fit.converged), float(fit.loss)
+    np.testing.assert_allclose(float(fit.rho), rho_true, atol=2e-4)
+    np.testing.assert_allclose(float(fit.sigma_eps), sigma_true,
+                               rtol=2e-3)
+
+
 def test_business_cycle_facts(jacobians):
     """The linearized Aiyagari economy reproduces the qualitative RBC
     facts: consumption is smoother than output, both procyclical, capital
