@@ -34,6 +34,12 @@ from .models.huggett import (  # noqa: F401
     solve_huggett_equilibrium,
 )
 from .models.diagnostics import DenHaanStats, den_haan_forecast  # noqa: F401
+from .models.labor import (  # noqa: F401
+    LaborEquilibrium,
+    build_labor_model,
+    solve_labor_equilibrium,
+    solve_labor_household,
+)
 from .models.lifecycle import (  # noqa: F401
     simulate_cohort,
     solve_lifecycle,

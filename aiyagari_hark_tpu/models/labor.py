@@ -1,0 +1,288 @@
+"""Endogenous labor supply: the Aiyagari economy with a
+consumption-leisure choice (Pijoan-Mas 2006-style).
+
+The reference fixes hours exogenously (its `IdioLS` grid is a pure
+endowment process, `Aiyagari_Support.py:985-1018`); here households also
+choose hours n with separable preferences
+
+    u(c) - chi * n^(1+1/nu) / (1 + 1/nu)
+
+(CRRA consumption, constant Frisch elasticity ``nu``), so effective
+labor ``E[e·n]`` — and with it the firm's labor input — becomes an
+equilibrium object.
+
+TPU shape: the intratemporal first-order condition
+``chi n^(1/nu) = W e u'(c)`` has the closed form ``n = (W e u'(c)/chi)^nu``,
+so the EGM backward step stays one batched array program: expectation
+matmul → FOC inversion → hours from the closed form → endogenous
+BEGINNING-OF-PERIOD asset knots from the budget (the state is beginning
+assets ``a``, not cash-on-hand, because income now depends on the
+choice).  Only the borrowing-constrained region has no closed form —
+there consumption and hours solve a one-equation static problem, handled
+by a vectorized, fixed-trip Newton at *evaluation* points (masked where
+the constraint doesn't bind) instead of interpolated constrained knots,
+so the constrained policy is exact, shapes stay static, and the knot
+arrays stay sorted by construction.
+
+The wealth-distribution machinery (Young lottery, accelerated power
+iteration) is reused from ``household`` unchanged; the equilibrium
+bisection reuses ``equilibrium._bisect`` with BOTH capital supply and
+effective labor supply endogenous.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.interp import interp1d_rowwise
+from ..ops.utility import inverse_marginal_utility, marginal_utility
+from . import firm
+from .equilibrium import _bisect, _bisection_setup
+from .household import (
+    SimpleModel,
+    WealthTransition,
+    _push_forward,
+    accelerated_distribution_fixed_point,
+    build_simple_model,
+    initial_distribution,
+    locate_in_grid,
+)
+
+
+class LaborModel(NamedTuple):
+    """A ``SimpleModel`` plus labor-supply preferences.  ``base.labor_levels``
+    is reinterpreted as idiosyncratic PRODUCTIVITY e (hours get chosen)."""
+
+    base: SimpleModel
+    frisch: jnp.ndarray        # nu: constant Frisch elasticity of hours
+    labor_weight: jnp.ndarray  # chi: disutility weight (calibrates mean hours)
+
+
+class LaborPolicy(NamedTuple):
+    """Per-state endogenous knots on BEGINNING-OF-PERIOD assets, [N, A]."""
+
+    a_knots: jnp.ndarray
+    c_knots: jnp.ndarray
+    n_knots: jnp.ndarray
+
+
+def build_labor_model(frisch: float = 1.0, labor_weight: float = 12.0,
+                      **kwargs) -> LaborModel:
+    """Calibration arrays for the labor-choice economy; ``kwargs`` pass
+    through to ``build_simple_model``.  The default ``labor_weight`` puts
+    mean hours around 1/3 at the notebook prices."""
+    base = build_simple_model(**kwargs)
+    dtype = base.a_grid.dtype
+    return LaborModel(base=base,
+                      frisch=jnp.asarray(frisch, dtype=dtype),
+                      labor_weight=jnp.asarray(labor_weight, dtype=dtype))
+
+
+def hours_from_foc(c, e, W, model: LaborModel, crra):
+    """The intratemporal FOC in closed form: n = (W e u'(c)/chi)^nu."""
+    return (W * e * marginal_utility(c, crra)
+            / model.labor_weight) ** model.frisch
+
+
+def _constrained_solve(a_beg, e, R, W, model: LaborModel, crra,
+                       newton_iters: int = 40):
+    """Static problem where the borrowing constraint binds (a' = b):
+    solve chi n^(1/nu) = W e u'(R a + W e n - b) for hours by fixed-trip
+    Newton — the residual is strictly increasing in n, so the root is
+    unique; iterates are clipped to keep consumption positive.  All
+    arguments broadcast elementwise."""
+    b = model.base.borrow_limit
+    we = W * e
+    c_floor = jnp.asarray(1e-10, dtype=model.base.a_grid.dtype)
+    # feasibility: c = R a + we n - b > 0
+    n_min = jnp.maximum((b + c_floor - R * a_beg) / we, 1e-9)
+
+    def body(n, _):
+        c = jnp.maximum(R * a_beg + we * n - b, c_floor)
+        g = (model.labor_weight * n ** (1.0 / model.frisch)
+             - we * marginal_utility(c, crra))
+        gp = (model.labor_weight / model.frisch
+              * n ** (1.0 / model.frisch - 1.0)
+              + we * we * crra * c ** (-crra - 1.0))
+        n = jnp.maximum(n - g / gp, n_min)
+        return n, None
+
+    n0 = jnp.maximum(jnp.full_like(a_beg + e, 0.3), n_min)
+    n, _ = jax.lax.scan(body, n0, None, length=newton_iters)
+    c = jnp.maximum(R * a_beg + we * n - b, c_floor)
+    return c, n
+
+
+def labor_policy_at(policy: LaborPolicy, a, R, W, model: LaborModel,
+                    crra):
+    """Evaluate (c, n, a') at beginning-of-period assets ``a`` [P] for
+    every productivity state: interpolation on the endogenous knots where
+    unconstrained, the exact Newton static solve where the constraint
+    binds (a below the state's first endogenous knot).  Returns
+    [P, N] arrays; the budget identity a' = R a + W e n - c holds
+    exactly in the unconstrained region and a' = b exactly in the
+    constrained one."""
+    e = model.base.labor_levels                         # [N]
+    a_tiled = jnp.broadcast_to(a[None, :],
+                               (e.shape[0], a.shape[0]))  # [N, P]
+    c_i = interp1d_rowwise(a_tiled, policy.a_knots, policy.c_knots).T
+    n_i = interp1d_rowwise(a_tiled, policy.a_knots, policy.n_knots).T
+    a_next_i = R * a[:, None] + W * e[None, :] * n_i - c_i
+    c_con, n_con = _constrained_solve(a[:, None], e[None, :], R, W,
+                                      model, crra)
+    constrained = a[:, None] < policy.a_knots.T[0][None, :]
+    c = jnp.where(constrained, c_con, c_i)
+    n = jnp.where(constrained, n_con, n_i)
+    a_next = jnp.where(constrained, model.base.borrow_limit, a_next_i)
+    return c, n, a_next
+
+
+def initial_labor_policy(model: LaborModel) -> LaborPolicy:
+    """Terminal-style guess: consume beginning resources at fixed hours
+    1/3 — only a starting point for the fixed-point iteration."""
+    base = model.base
+    n = base.labor_levels.shape[0]
+    a = jnp.tile(base.a_grid[None, :], (n, 1))          # [N, A]
+    n0 = jnp.full_like(a, 1.0 / 3.0)
+    c0 = jnp.maximum(a - base.borrow_limit, 1e-3) + 0.5
+    return LaborPolicy(a_knots=a, c_knots=c0, n_knots=n0)
+
+
+def egm_step_labor(policy: LaborPolicy, R, W, model: LaborModel,
+                   disc_fac, crra) -> LaborPolicy:
+    """One EGM backward step.  Next-period consumption is evaluated at
+    beginning assets = today's end-of-period grid (constraint-exact via
+    ``labor_policy_at``); the envelope v'(a) = R u'(c) makes the
+    expectation one [A,N']x[N',N] matmul; hours come from the closed-form
+    intratemporal FOC; the endogenous knot is beginning assets from the
+    budget."""
+    base = model.base
+    a = base.a_grid                                     # [A] end-of-period
+    e = base.labor_levels
+    c_next, _, _ = labor_policy_at(policy, a, R, W, model, crra)  # [A, N']
+    vp_next = marginal_utility(c_next, crra)
+    end_vp = disc_fac * R * jnp.matmul(
+        vp_next, base.transition.T, precision=jax.lax.Precision.HIGHEST)
+    c_now = inverse_marginal_utility(end_vp, crra)      # [A, N]
+    n_now = hours_from_foc(c_now, e[None, :], W, model, crra)
+    a_beg = (c_now + a[:, None] - W * e[None, :] * n_now) / R
+    return LaborPolicy(a_knots=a_beg.T, c_knots=c_now.T,
+                       n_knots=n_now.T)
+
+
+def solve_labor_household(R, W, model: LaborModel, disc_fac, crra,
+                          tol: float = 1e-6, max_iter: int = 3000,
+                          init_policy: LaborPolicy | None = None):
+    """Infinite-horizon fixed point of ``egm_step_labor`` (sup-norm on
+    consumption knots).  Returns (policy, n_iter, final_diff)."""
+    p0 = initial_labor_policy(model) if init_policy is None else init_policy
+    big = jnp.asarray(jnp.inf, dtype=p0.c_knots.dtype)
+
+    def cond(state):
+        _, diff, it = state
+        return (diff > tol) & (it < max_iter)
+
+    def body(state):
+        policy, _, it = state
+        new = egm_step_labor(policy, R, W, model, disc_fac, crra)
+        diff = jnp.max(jnp.abs(new.c_knots - policy.c_knots))
+        return new, diff, it + 1
+
+    policy, diff, it = jax.lax.while_loop(
+        cond, body, (p0, big, jnp.asarray(0)))
+    return policy, it, diff
+
+
+def labor_wealth_transition(policy: LaborPolicy, R, W,
+                            model: LaborModel, crra):
+    """Young-lottery transition on the histogram support, plus the (c, n)
+    policies on that support (reused for the aggregates)."""
+    base = model.base
+    c, n, a_next = labor_policy_at(policy, base.dist_grid, R, W, model,
+                                   crra)
+    a_next = jnp.clip(a_next, base.borrow_limit, base.dist_grid[-1])
+    idx, w = locate_in_grid(a_next, base.dist_grid)
+    return WealthTransition(idx=idx, weight=w, a_next=a_next), c, n
+
+
+def stationary_labor_wealth(policy: LaborPolicy, R, W, model: LaborModel,
+                            crra, tol: float = 1e-11,
+                            max_iter: int = 20000, init_dist=None):
+    """Stationary joint distribution over (wealth, productivity) via the
+    shared accelerated power iteration.  Returns (dist, c, n, iters,
+    diff) with the policies on the histogram support."""
+    base = model.base
+    trans, c, n = labor_wealth_transition(policy, R, W, model, crra)
+    dist0 = (initial_distribution(base) if init_dist is None
+             else init_dist)
+    dist, it, diff = accelerated_distribution_fixed_point(
+        lambda d: _push_forward(d, trans, base.transition),
+        dist0, tol, max_iter)
+    return dist, c, n, it, diff
+
+
+class LaborEquilibrium(NamedTuple):
+    r_star: jnp.ndarray
+    wage: jnp.ndarray
+    capital: jnp.ndarray
+    effective_labor: jnp.ndarray   # E[e n] — now an equilibrium object
+    mean_hours: jnp.ndarray        # E[n]
+    saving_rate: jnp.ndarray
+    excess: jnp.ndarray
+    policy: LaborPolicy
+    distribution: jnp.ndarray
+    bisect_iters: jnp.ndarray
+
+
+def _labor_supply_eval(r, model: LaborModel, disc_fac, crra, cap_share,
+                       depr_fac, egm_tol, dist_tol):
+    """Household side at rate r: (capital supply, effective labor supply,
+    mean hours, policy, distribution, wage)."""
+    base = model.base
+    k_to_l = firm.k_to_l_from_r(r, cap_share, depr_fac)
+    W = firm.wage_rate(k_to_l, cap_share)
+    policy, _, _ = solve_labor_household(1.0 + r, W, model, disc_fac,
+                                         crra, tol=egm_tol)
+    dist, _, n, _, _ = stationary_labor_wealth(policy, 1.0 + r, W, model,
+                                               crra, tol=dist_tol)
+    k_supply = jnp.sum(dist * base.dist_grid[:, None])
+    l_supply = jnp.sum(dist * base.labor_levels[None, :] * n)
+    hours = jnp.sum(dist * n)
+    return k_supply, l_supply, hours, policy, dist, W
+
+
+def solve_labor_equilibrium(model: LaborModel, disc_fac, crra, cap_share,
+                            depr_fac, r_tol: float | None = None,
+                            max_bisect: int = 60,
+                            egm_tol: float | None = None,
+                            dist_tol: float | None = None
+                            ) -> LaborEquilibrium:
+    """Bisect r until the capital market clears with BOTH sides moving:
+    household capital supply and effective labor supply respond to r, the
+    firm's demand is ``k_to_l(r) * L_supply(r)``.  Excess supply is still
+    increasing in r (labor supply falls with the wealth effect as r
+    rises, lowering demand further), so the shared bisection applies."""
+    r_tol, egm_tol, dist_tol, r_lo, r_hi = _bisection_setup(
+        model.base, disc_fac, depr_fac, r_tol, egm_tol, dist_tol)
+
+    def excess(r):
+        k_s, l_s, _, _, _, _ = _labor_supply_eval(
+            r, model, disc_fac, crra, cap_share, depr_fac, egm_tol,
+            dist_tol)
+        demand = firm.k_to_l_from_r(r, cap_share, depr_fac) * l_s
+        return k_s - demand
+
+    r_star, iters = _bisect(excess, r_lo, r_hi, r_tol, max_bisect)
+    k_s, l_s, hours, policy, dist, W = _labor_supply_eval(
+        r_star, model, disc_fac, crra, cap_share, depr_fac, egm_tol,
+        dist_tol)
+    demand = firm.k_to_l_from_r(r_star, cap_share, depr_fac) * l_s
+    y = firm.output(k_s, l_s, cap_share)
+    return LaborEquilibrium(
+        r_star=r_star, wage=W, capital=k_s, effective_labor=l_s,
+        mean_hours=hours, saving_rate=depr_fac * k_s / y,
+        excess=k_s - demand, policy=policy, distribution=dist,
+        bisect_iters=iters)

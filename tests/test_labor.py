@@ -1,0 +1,127 @@
+"""Endogenous labor supply (models/labor.py).
+
+Oracles: the household optimality conditions themselves (Euler and
+intratemporal FOC residuals at off-knot evaluation points), exactness of
+the Newton-solved constrained region, the separable-preferences wealth
+effect (richer households work less), the Frisch elasticity comparative
+static, and general-equilibrium market clearing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.models.labor import (
+    build_labor_model,
+    hours_from_foc,
+    labor_policy_at,
+    solve_labor_equilibrium,
+    solve_labor_household,
+)
+from aiyagari_hark_tpu.ops.utility import marginal_utility
+
+ALPHA, DELTA, BETA, CRRA = 0.36, 0.08, 0.96, 2.0
+R, W = 1.03, 1.2
+
+
+@pytest.fixture(scope="module")
+def model():
+    return build_labor_model(frisch=1.0, labor_weight=12.0,
+                             labor_states=3, a_count=40, dist_count=120)
+
+
+@pytest.fixture(scope="module")
+def policy(model):
+    pol, it, diff = solve_labor_household(R, W, model, BETA, CRRA,
+                                          tol=1e-9)
+    assert float(diff) < 1e-9
+    return pol
+
+
+def test_euler_and_intratemporal_residuals(model, policy):
+    """At off-knot interior points the interpolated policy must satisfy
+    both FOCs up to interpolation error: u'(c) = beta R E u'(c') and
+    chi n^(1/nu) = W e u'(c)."""
+    a = jnp.linspace(1.0, 15.0, 60)          # interior, unconstrained
+    c, n, a_next = labor_policy_at(policy, a, R, W, model, CRRA)
+    # next-period consumption state by state at a' (clip to the grid)
+    c_next, _, _ = labor_policy_at(
+        policy, jnp.clip(a_next.reshape(-1), 0.0, 50.0), R, W, model,
+        CRRA)
+    c_next = c_next.reshape(a.shape[0], -1, c_next.shape[1])  # [P, N, N']
+    evp = BETA * R * jnp.einsum("pnm,nm->pn",
+                                marginal_utility(c_next, CRRA),
+                                model.base.transition)
+    euler_rel = np.asarray(jnp.abs(marginal_utility(c, CRRA) / evp - 1.0))
+    assert euler_rel.max() < 5e-3
+    intra = np.asarray(jnp.abs(
+        hours_from_foc(c, model.base.labor_levels[None, :], W, model,
+                       CRRA) / n - 1.0))
+    assert intra.max() < 5e-3
+
+
+def test_constrained_region_is_exact(model, policy):
+    """Where the borrowing constraint binds: savings exactly at the
+    limit, and the static FOC solved to Newton precision (no
+    interpolation in the constrained region)."""
+    a = jnp.asarray([0.0, 0.002, 0.01])
+    c, n, a_next = labor_policy_at(policy, a, R, W, model, CRRA)
+    first_knot = np.asarray(policy.a_knots[:, 0])
+    constrained = np.asarray(a)[:, None] < first_knot[None, :]
+    assert constrained.any(), "pick smaller a: nothing binds"
+    np.testing.assert_allclose(np.asarray(a_next)[constrained], 0.0,
+                               atol=1e-12)
+    # budget + FOC residual at the Newton solution
+    e = np.asarray(model.base.labor_levels)
+    cc, nn = np.asarray(c), np.asarray(n)
+    budget = R * np.asarray(a)[:, None] + W * e[None, :] * nn - cc
+    np.testing.assert_allclose(budget[constrained], 0.0, atol=1e-8)
+    foc = (float(model.labor_weight)
+           * nn ** (1.0 / float(model.frisch))
+           - W * e[None, :] * cc ** (-CRRA))
+    np.testing.assert_allclose(foc[constrained], 0.0, atol=1e-7)
+
+
+def test_wealth_effect_on_hours(policy):
+    """Separable preferences: hours fall with wealth along every
+    productivity state's knot line."""
+    n_knots = np.asarray(policy.n_knots)
+    assert (np.diff(n_knots, axis=1) < 1e-12).all()
+
+
+def test_frisch_elasticity_comparative_static(model):
+    """Higher Frisch elasticity -> hours respond more to productivity:
+    cross-state hours dispersion at fixed wealth rises with nu."""
+    stiff = build_labor_model(frisch=0.2, labor_weight=12.0,
+                              labor_states=3, a_count=40, dist_count=120)
+    pol_stiff, _, _ = solve_labor_household(R, W, stiff, BETA, CRRA)
+    pol_elastic, _, _ = solve_labor_household(R, W, model, BETA, CRRA)
+    a = jnp.asarray([5.0])
+    _, n_s, _ = labor_policy_at(pol_stiff, a, R, W, stiff, CRRA)
+    _, n_e, _ = labor_policy_at(pol_elastic, a, R, W, model, CRRA)
+    spread = lambda n: float(n.max() - n.min())   # noqa: E731
+    assert spread(np.asarray(n_e)) > 2.0 * spread(np.asarray(n_s))
+
+
+@pytest.fixture(scope="module")
+def equilibrium(model):
+    return solve_labor_equilibrium(model, BETA, CRRA, ALPHA, DELTA)
+
+
+def test_equilibrium_clears(model, equilibrium):
+    eq = equilibrium
+    assert abs(float(eq.excess)) < 1e-6 * float(eq.capital)
+    assert 0.0 < float(eq.r_star) < 1.0 / BETA - 1.0
+    assert 0.05 < float(eq.mean_hours) < 1.5
+    # capital-output consistency: K/Y = s/delta
+    y = float(eq.capital) ** ALPHA * float(eq.effective_labor) ** (
+        1 - ALPHA)
+    np.testing.assert_allclose(float(eq.saving_rate),
+                               DELTA * float(eq.capital) / y, rtol=1e-10)
+
+
+def test_equilibrium_is_jittable(model):
+    f = jax.jit(lambda: solve_labor_equilibrium(
+        model, BETA, CRRA, ALPHA, DELTA, max_bisect=25))
+    res = f()
+    assert np.isfinite(float(res.r_star))
