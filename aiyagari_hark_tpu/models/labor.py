@@ -46,6 +46,7 @@ from .household import (
     WealthTransition,
     _push_forward,
     accelerated_distribution_fixed_point,
+    aggregate_capital,
     build_simple_model,
     initial_distribution,
     locate_in_grid,
@@ -117,22 +118,30 @@ def _constrained_solve(a_beg, e, R, W, model: LaborModel, crra,
 
 
 def labor_policy_at(policy: LaborPolicy, a, R, W, model: LaborModel,
-                    crra):
+                    crra, constrained_values=None):
     """Evaluate (c, n, a') at beginning-of-period assets ``a`` [P] for
     every productivity state: interpolation on the endogenous knots where
     unconstrained, the exact Newton static solve where the constraint
     binds (a below the state's first endogenous knot).  Returns
     [P, N] arrays; the budget identity a' = R a + W e n - c holds
     exactly in the unconstrained region and a' = b exactly in the
-    constrained one."""
+    constrained one.
+
+    ``constrained_values``: optional precomputed ``(c_con, n_con)`` at
+    these evaluation points — the static problem depends only on
+    (a, e, R, W), not on the evolving policy, so fixed-point loops hoist
+    the 40-trip Newton out of the iteration (XLA's loop-invariant motion
+    is not guaranteed across a nested scan)."""
     e = model.base.labor_levels                         # [N]
     a_tiled = jnp.broadcast_to(a[None, :],
                                (e.shape[0], a.shape[0]))  # [N, P]
     c_i = interp1d_rowwise(a_tiled, policy.a_knots, policy.c_knots).T
     n_i = interp1d_rowwise(a_tiled, policy.a_knots, policy.n_knots).T
     a_next_i = R * a[:, None] + W * e[None, :] * n_i - c_i
-    c_con, n_con = _constrained_solve(a[:, None], e[None, :], R, W,
-                                      model, crra)
+    if constrained_values is None:
+        constrained_values = _constrained_solve(a[:, None], e[None, :],
+                                                R, W, model, crra)
+    c_con, n_con = constrained_values
     constrained = a[:, None] < policy.a_knots.T[0][None, :]
     c = jnp.where(constrained, c_con, c_i)
     n = jnp.where(constrained, n_con, n_i)
@@ -152,17 +161,18 @@ def initial_labor_policy(model: LaborModel) -> LaborPolicy:
 
 
 def egm_step_labor(policy: LaborPolicy, R, W, model: LaborModel,
-                   disc_fac, crra) -> LaborPolicy:
+                   disc_fac, crra, constrained_values=None) -> LaborPolicy:
     """One EGM backward step.  Next-period consumption is evaluated at
     beginning assets = today's end-of-period grid (constraint-exact via
     ``labor_policy_at``); the envelope v'(a) = R u'(c) makes the
     expectation one [A,N']x[N',N] matmul; hours come from the closed-form
     intratemporal FOC; the endogenous knot is beginning assets from the
-    budget."""
+    budget.  ``constrained_values``: see ``labor_policy_at``."""
     base = model.base
     a = base.a_grid                                     # [A] end-of-period
     e = base.labor_levels
-    c_next, _, _ = labor_policy_at(policy, a, R, W, model, crra)  # [A, N']
+    c_next, _, _ = labor_policy_at(policy, a, R, W, model, crra,
+                                   constrained_values)  # [A, N']
     vp_next = marginal_utility(c_next, crra)
     end_vp = disc_fac * R * jnp.matmul(
         vp_next, base.transition.T, precision=jax.lax.Precision.HIGHEST)
@@ -180,6 +190,12 @@ def solve_labor_household(R, W, model: LaborModel, disc_fac, crra,
     consumption knots).  Returns (policy, n_iter, final_diff)."""
     p0 = initial_labor_policy(model) if init_policy is None else init_policy
     big = jnp.asarray(jnp.inf, dtype=p0.c_knots.dtype)
+    base = model.base
+    # policy-independent: hoist the constrained-region Newton out of the
+    # fixed-point loop (one solve per (R, W), not one per EGM step)
+    con = _constrained_solve(base.a_grid[:, None],
+                             base.labor_levels[None, :], R, W, model,
+                             crra)
 
     def cond(state):
         _, diff, it = state
@@ -187,7 +203,8 @@ def solve_labor_household(R, W, model: LaborModel, disc_fac, crra,
 
     def body(state):
         policy, _, it = state
-        new = egm_step_labor(policy, R, W, model, disc_fac, crra)
+        new = egm_step_labor(policy, R, W, model, disc_fac, crra,
+                             constrained_values=con)
         diff = jnp.max(jnp.abs(new.c_knots - policy.c_knots))
         return new, diff, it + 1
 
@@ -248,7 +265,7 @@ def _labor_supply_eval(r, model: LaborModel, disc_fac, crra, cap_share,
                                          crra, tol=egm_tol)
     dist, _, n, _, _ = stationary_labor_wealth(policy, 1.0 + r, W, model,
                                                crra, tol=dist_tol)
-    k_supply = jnp.sum(dist * base.dist_grid[:, None])
+    k_supply = aggregate_capital(dist, base)
     l_supply = jnp.sum(dist * base.labor_levels[None, :] * n)
     hours = jnp.sum(dist * n)
     return k_supply, l_supply, hours, policy, dist, W
