@@ -23,6 +23,11 @@ from .models.equilibrium import (  # noqa: F401
     solve_calibration,
     solve_calibration_lean,
 )
+from .models.calibrate import (  # noqa: F401
+    CalibrationResult,
+    calibrate_discount_factor,
+    calibrate_labor_weight,
+)
 from .models.heterogeneity import (  # noqa: F401
     HeterogeneousEquilibrium,
     population_distribution,

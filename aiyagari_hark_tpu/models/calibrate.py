@@ -1,0 +1,103 @@
+"""Calibration utilities: invert the equilibrium map for a structural
+parameter.
+
+The reference hard-codes its calibration (SURVEY.md §6); real workflows
+run the inverse problem — "what discount factor makes the equilibrium
+return 4.09%?", "what disutility weight makes mean hours 1/3?".  Each
+target here is monotone in its parameter, so the robust tool is the same
+fixed-trip bracketed bisection the equilibrium solvers already use
+(``equilibrium._bisect``), wrapped around a full jitted equilibrium
+solve per evaluation.  Derivative-free on purpose: a bisection's output
+is piecewise-constant in its inputs at the bracket tolerance, so
+autodiff through the nested solve returns zero a.e. — gradients are the
+wrong tool for this outer problem.
+
+Everything compiles to one XLA program (nested ``while_loop``s), so a
+calibration is itself vmappable — e.g. a whole row of Table II
+re-calibrated to the paper's target return in one batched call.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from .equilibrium import _bisect, solve_equilibrium_lean
+from .household import SimpleModel
+from .labor import LaborModel, solve_labor_equilibrium
+
+
+class CalibrationResult(NamedTuple):
+    value: jnp.ndarray       # the calibrated parameter
+    achieved: jnp.ndarray    # target quantity at the last evaluated
+                             # parameter (within bracket tol of `value`)
+    iterations: jnp.ndarray
+
+
+def calibrate_discount_factor(model: SimpleModel, target_r, crra,
+                              cap_share, depr_fac,
+                              beta_lo: float = 0.90,
+                              beta_hi: float = 0.995,
+                              beta_tol: float = 1e-6,
+                              max_iter: int = 40,
+                              **solver_kwargs) -> CalibrationResult:
+    """Find the discount factor whose equilibrium interest rate is
+    ``target_r``: r*(beta) is decreasing (patience raises supply,
+    depressing the return), so ``target_r - r*(beta)`` is increasing in
+    beta — a ``_bisect`` root.  The bracket must satisfy
+    ``beta_hi * (1 + r*(beta_hi)) < 1`` (stationarity); the default
+    upper end is safe for standard calibrations.
+
+    Each evaluation is one full ``solve_equilibrium_lean``; the whole
+    nested program jits/vmaps.  Self-consistency is the test oracle:
+    calibrating to the r* of a known beta recovers that beta."""
+    dtype = model.a_grid.dtype
+    target_r = jnp.asarray(target_r, dtype=dtype)
+
+    def excess(beta):
+        eq = solve_equilibrium_lean(model, beta, crra, cap_share,
+                                    depr_fac, **solver_kwargs)
+        return target_r - eq.r_star, eq.r_star
+
+    beta, iters, achieved = _bisect(excess,
+                                    jnp.asarray(beta_lo, dtype=dtype),
+                                    jnp.asarray(beta_hi, dtype=dtype),
+                                    beta_tol, max_iter,
+                                    aux_init=jnp.zeros((), dtype=dtype))
+    return CalibrationResult(value=beta, achieved=achieved,
+                             iterations=iters)
+
+
+def calibrate_labor_weight(model: LaborModel, target_hours, disc_fac,
+                           crra, cap_share, depr_fac,
+                           chi_lo: float = 1.0, chi_hi: float = 200.0,
+                           chi_tol: float = 1e-4,
+                           max_iter: int = 40,
+                           egm_tol: float = 1e-6,
+                           dist_tol: float = 1e-11) -> CalibrationResult:
+    """Find the disutility weight chi whose GENERAL-EQUILIBRIUM mean
+    hours hit ``target_hours`` (e.g. 1/3): hours are decreasing in chi,
+    so ``target - hours(chi)`` is increasing — bisected in log space
+    (chi is a scale parameter spanning orders of magnitude).
+
+    Each evaluation solves the full labor-supply equilibrium at the
+    trial chi (its own inner bisection on r)."""
+    base_dtype = model.base.a_grid.dtype
+    target_hours = jnp.asarray(target_hours, dtype=base_dtype)
+
+    def excess(log_chi):
+        trial = model._replace(labor_weight=jnp.exp(log_chi))
+        eq = solve_labor_equilibrium(trial, disc_fac, crra, cap_share,
+                                     depr_fac, egm_tol=egm_tol,
+                                     dist_tol=dist_tol)
+        return target_hours - eq.mean_hours, eq.mean_hours
+
+    log_chi, iters, achieved = _bisect(
+        excess,
+        jnp.asarray(jnp.log(chi_lo), dtype=base_dtype),
+        jnp.asarray(jnp.log(chi_hi), dtype=base_dtype),
+        chi_tol, max_iter, aux_init=jnp.zeros((), dtype=base_dtype))
+    return CalibrationResult(value=jnp.exp(log_chi),
+                             achieved=achieved,
+                             iterations=iters)

@@ -103,27 +103,42 @@ def _bisection_setup(model: SimpleModel, disc_fac, depr_fac,
     return r_tol, egm_tol, dist_tol, r_lo, r_hi
 
 
-def _bisect(excess_fn, r_lo, r_hi, r_tol, max_bisect: int):
+def _bisect(excess_fn, r_lo, r_hi, r_tol, max_bisect: int,
+            aux_init=None):
     """Fixed-trip bisection on an excess map that is increasing in r:
     positive excess moves the upper bracket down.  Shared by every
-    interest-rate market-clearing loop (homogeneous, beta-dist).
-    Returns ``(r_star, iterations)``; fully jit/vmap-safe."""
+    interest-rate market-clearing loop (homogeneous, beta-dist) and the
+    calibration inversions.  Returns ``(r_star, iterations)``; fully
+    jit/vmap-safe.
+
+    ``aux_init``: if given, ``excess_fn`` must return ``(excess, aux)``
+    and the last evaluation's aux rides the loop state — callers that
+    want the quantity AT the root (e.g. calibration's "achieved") get it
+    without re-solving after the loop.  Returns
+    ``(r_star, iterations, aux_last)`` in that mode."""
+    with_aux = aux_init is not None
 
     def cond(state):
-        lo, hi, it = state
+        lo, hi, it = state[0], state[1], state[2]
         return ((hi - lo) > r_tol) & (it < max_bisect)
 
     def body(state):
-        lo, hi, it = state
+        lo, hi, it = state[0], state[1], state[2]
         mid = 0.5 * (lo + hi)
-        ex = excess_fn(mid)
+        if with_aux:
+            ex, aux = excess_fn(mid)
+        else:
+            ex = excess_fn(mid)
         lo = jnp.where(ex > 0, lo, mid)
         hi = jnp.where(ex > 0, mid, hi)
-        return lo, hi, it + 1
+        return (lo, hi, it + 1, aux) if with_aux else (lo, hi, it + 1)
 
-    lo, hi, iters = jax.lax.while_loop(
-        cond, body, (r_lo, r_hi, jnp.asarray(0)))
-    return 0.5 * (lo + hi), iters
+    init = ((r_lo, r_hi, jnp.asarray(0), aux_init) if with_aux
+            else (r_lo, r_hi, jnp.asarray(0)))
+    out = jax.lax.while_loop(cond, body, init)
+    if with_aux:
+        return 0.5 * (out[0] + out[1]), out[2], out[3]
+    return 0.5 * (out[0] + out[1]), out[2]
 
 
 def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
@@ -175,7 +190,8 @@ class LeanEquilibrium(NamedTuple):
     across sweep lanes (VERDICT r1 weak-item 7)."""
 
     r_star: jnp.ndarray
-    capital: jnp.ndarray     # household supply at the last bisection midpoint
+    capital: jnp.ndarray     # household supply at the last evaluated rate
+                             # (bisection midpoint, or Illinois secant point)
     labor: jnp.ndarray
     bisect_iters: jnp.ndarray
     egm_iters: jnp.ndarray   # total EGM backward steps across all midpoints

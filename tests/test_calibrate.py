@@ -1,0 +1,57 @@
+"""Calibration utilities (models/calibrate.py).
+
+Oracle: self-consistency — calibrating to the equilibrium quantity of a
+KNOWN parameter must recover that parameter (round trip through two
+independent directions of the equilibrium map)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.models.calibrate import (
+    calibrate_discount_factor,
+    calibrate_labor_weight,
+)
+from aiyagari_hark_tpu.models.equilibrium import solve_equilibrium_lean
+from aiyagari_hark_tpu.models.household import build_simple_model
+from aiyagari_hark_tpu.models.labor import (
+    build_labor_model,
+    solve_labor_equilibrium,
+)
+
+ALPHA, DELTA, CRRA = 0.36, 0.08, 2.0
+
+
+@pytest.fixture(scope="module")
+def model():
+    return build_simple_model(labor_states=3, a_count=30, dist_count=120)
+
+
+def test_discount_factor_round_trip(model):
+    beta_true = 0.955
+    r_target = solve_equilibrium_lean(model, beta_true, CRRA, ALPHA,
+                                      DELTA).r_star
+    cal = calibrate_discount_factor(model, r_target, CRRA, ALPHA, DELTA)
+    np.testing.assert_allclose(float(cal.value), beta_true, atol=2e-5)
+    np.testing.assert_allclose(float(cal.achieved), float(r_target),
+                               atol=1e-5)
+
+
+def test_discount_factor_hits_paper_target(model):
+    """Calibrate to Aiyagari's paper value r* = 4.09% and verify the
+    achieved equilibrium return."""
+    cal = calibrate_discount_factor(model, 0.0409, CRRA, ALPHA, DELTA)
+    assert 0.90 < float(cal.value) < 0.995
+    np.testing.assert_allclose(float(cal.achieved), 0.0409, atol=1e-5)
+
+
+def test_labor_weight_round_trip():
+    lmodel = build_labor_model(frisch=1.0, labor_weight=12.0,
+                               labor_states=3, a_count=24, dist_count=80)
+    hours_target = solve_labor_equilibrium(lmodel, 0.96, CRRA, ALPHA,
+                                           DELTA).mean_hours
+    cal = calibrate_labor_weight(lmodel, hours_target, 0.96, CRRA,
+                                 ALPHA, DELTA)
+    np.testing.assert_allclose(float(cal.value), 12.0, rtol=2e-3)
+    np.testing.assert_allclose(float(cal.achieved), float(hours_target),
+                               rtol=1e-4)
