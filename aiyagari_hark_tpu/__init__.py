@@ -70,8 +70,11 @@ from .models.jacobian import (  # noqa: F401
 )
 from .models.transition import (  # noqa: F401
     TransitionResult,
+    TransitionWelfare,
     household_path_response,
+    path_policies,
     solve_transition,
+    transition_welfare,
 )
 from .models.value import (  # noqa: F401
     aggregate_welfare,

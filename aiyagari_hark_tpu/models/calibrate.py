@@ -33,6 +33,9 @@ class CalibrationResult(NamedTuple):
     achieved: jnp.ndarray    # target quantity at the last evaluated
                              # parameter (within bracket tol of `value`)
     iterations: jnp.ndarray
+    converged: jnp.ndarray   # |achieved - target| <= target_tol; False
+                             # when the target is outside the bracket's
+                             # range (bisection collapses to an endpoint)
 
 
 def calibrate_discount_factor(model: SimpleModel, target_r, crra,
@@ -41,6 +44,7 @@ def calibrate_discount_factor(model: SimpleModel, target_r, crra,
                               beta_hi: float = 0.995,
                               beta_tol: float = 1e-6,
                               max_iter: int = 40,
+                              target_tol: float = 1e-4,
                               **solver_kwargs) -> CalibrationResult:
     """Find the discount factor whose equilibrium interest rate is
     ``target_r``: r*(beta) is decreasing (patience raises supply,
@@ -65,8 +69,9 @@ def calibrate_discount_factor(model: SimpleModel, target_r, crra,
                                     jnp.asarray(beta_hi, dtype=dtype),
                                     beta_tol, max_iter,
                                     aux_init=jnp.zeros((), dtype=dtype))
-    return CalibrationResult(value=beta, achieved=achieved,
-                             iterations=iters)
+    return CalibrationResult(
+        value=beta, achieved=achieved, iterations=iters,
+        converged=jnp.abs(achieved - target_r) <= target_tol)
 
 
 def calibrate_labor_weight(model: LaborModel, target_hours, disc_fac,
@@ -74,6 +79,7 @@ def calibrate_labor_weight(model: LaborModel, target_hours, disc_fac,
                            chi_lo: float = 1.0, chi_hi: float = 200.0,
                            chi_tol: float = 1e-4,
                            max_iter: int = 40,
+                           target_tol: float = 1e-3,
                            egm_tol: float = 1e-6,
                            dist_tol: float = 1e-11) -> CalibrationResult:
     """Find the disutility weight chi whose GENERAL-EQUILIBRIUM mean
@@ -98,6 +104,6 @@ def calibrate_labor_weight(model: LaborModel, target_hours, disc_fac,
         jnp.asarray(jnp.log(chi_lo), dtype=base_dtype),
         jnp.asarray(jnp.log(chi_hi), dtype=base_dtype),
         chi_tol, max_iter, aux_init=jnp.zeros((), dtype=base_dtype))
-    return CalibrationResult(value=jnp.exp(log_chi),
-                             achieved=achieved,
-                             iterations=iters)
+    return CalibrationResult(
+        value=jnp.exp(log_chi), achieved=achieved, iterations=iters,
+        converged=jnp.abs(achieved - target_hours) <= target_tol)

@@ -80,6 +80,27 @@ def _transition_prices(k_path, prod_path, model: SimpleModel, cap_share,
     return r, w
 
 
+def path_policies(r_path, w_path, model: SimpleModel, disc_fac, crra,
+                  terminal_policy: HouseholdPolicy) -> HouseholdPolicy:
+    """Policies for every date of a foreseen price path, as one stacked
+    pytree [T, ...]: a backward ``lax.scan`` of the EGM step seeded by
+    the terminal stationary policy.  The step for date t consumes date
+    t+1's prices; date T-1 uses the terminal policy (beyond the horizon
+    the economy is stationary)."""
+
+    def backward_step(pol_next, rw):
+        r_next, w_next = rw
+        pol = egm_step(pol_next, 1.0 + r_next, w_next, model, disc_fac,
+                       crra)
+        return pol, pol
+
+    _, pols = jax.lax.scan(backward_step, terminal_policy,
+                           (r_path[1:][::-1], w_path[1:][::-1]))
+    return jax.tree.map(
+        lambda s, term: jnp.concatenate([s[::-1], term[None]], axis=0),
+        pols, terminal_policy)
+
+
 def household_path_response(r_path, w_path, model: SimpleModel, disc_fac,
                             crra, init_dist,
                             terminal_policy: HouseholdPolicy):
@@ -102,20 +123,8 @@ def household_path_response(r_path, w_path, model: SimpleModel, disc_fac,
     Returns ``(k_implied [T], c_agg [T])``.
     """
 
-    def backward_step(pol_next, rw):
-        r_next, w_next = rw
-        pol = egm_step(pol_next, 1.0 + r_next, w_next, model, disc_fac,
-                       crra)
-        return pol, pol
-
-    # policies for t = T-2..0, each consuming period t+1's prices; period
-    # T-1 uses the terminal stationary policy (beyond the horizon the
-    # economy is stationary)
-    _, pols = jax.lax.scan(backward_step, terminal_policy,
-                           (r_path[1:][::-1], w_path[1:][::-1]))
-    pols = jax.tree.map(
-        lambda s, term: jnp.concatenate([s[::-1], term[None]], axis=0),
-        pols, terminal_policy)
+    pols = path_policies(r_path, w_path, model, disc_fac, crra,
+                         terminal_policy)
 
     def forward_step(dist, inputs):
         pol, r, w = inputs
@@ -205,3 +214,86 @@ def solve_transition(model: SimpleModel, disc_fac, crra, cap_share,
     return TransitionResult(k_path=k_path, r_path=r_path, w_path=w_path,
                             c_agg_path=c_agg, converged=diff <= tol,
                             iterations=it, max_diff=diff)
+
+
+class TransitionWelfare(NamedTuple):
+    """Welfare accounting of a transition path for the date-0 population."""
+
+    ce: jnp.ndarray                 # consumption-equivalent of the path vs
+                                    # staying at the terminal steady state
+    welfare_path: jnp.ndarray       # E[v_0] living through the path
+    welfare_steady: jnp.ndarray     # E[v] at the terminal steady state
+
+
+def transition_welfare(model: SimpleModel, disc_fac, crra,
+                       init_dist: jnp.ndarray,
+                       terminal_policy: HouseholdPolicy,
+                       r_path, w_path,
+                       constrained_knots: int = 24,
+                       value_tol: float = 1e-9) -> TransitionWelfare:
+    """The welfare question a transition exists to answer: what is the
+    shock path WORTH to the initial population, in permanent-consumption
+    units?
+
+    One backward value recursion along the price path (a ``lax.scan``
+    of the non-stationary Bellman evaluation, seeded by the terminal
+    stationary value function), then utilitarian aggregation of date-0
+    values over ``init_dist`` and the consumption-equivalent against
+    remaining at the (terminal) steady state forever.  Values are
+    carried in constant-equivalent-consumption form on
+    constraint-augmented knots — the same numerics as
+    ``value.policy_value`` (and its accuracy caveats).
+
+    ``r_path``/``w_path`` come from a solved ``TransitionResult``.  The
+    steady-state comparison uses the terminal prices (the path's tail),
+    so for a transitory shock — where initial and terminal steady states
+    coincide — ``ce`` is the pure value of the shock: positive for a
+    beneficial TFP impulse, ~0 for a no-shock path (tested)."""
+    from .value import (
+        augment_constrained_knots,
+        aggregate_welfare,
+        bellman_vnvrs_step,
+        consumption_equivalent,
+        policy_value,
+        ValueFunction,
+    )
+
+    r_term, w_term = r_path[-1], w_path[-1]
+    vf_term, _, _ = policy_value(terminal_policy, 1.0 + r_term, w_term,
+                                 model, disc_fac, crra, tol=value_tol,
+                                 constrained_knots=constrained_knots)
+    pols = path_policies(r_path, w_path, model, disc_fac, crra,
+                         terminal_policy)
+    b = getattr(model, "borrow_limit", 0.0)
+    levels = model.labor_levels
+
+    def backward(carry, inputs):
+        m_next_knots, vnvrs_next = carry
+        pol_m, pol_c, r_next, w_next = inputs
+        m_aug, c_aug = augment_constrained_knots(pol_m, pol_c, b,
+                                                 constrained_knots)
+        a_knots = m_aug - c_aug
+        m_next = ((1.0 + r_next) * a_knots[:, :, None]
+                  + w_next * levels[None, None, :])       # [N, K, N']
+        vnvrs = bellman_vnvrs_step(c_aug, m_next, m_next_knots,
+                                   vnvrs_next, model.transition,
+                                   disc_fac, crra)
+        return (m_aug, vnvrs), None
+
+    # date-t continuation prices are date t+1's; beyond the horizon the
+    # terminal steady state applies
+    r_shift = jnp.concatenate([r_path[1:], r_term[None]])
+    w_shift = jnp.concatenate([w_path[1:], w_term[None]])
+    (m0_knots, vnvrs0), _ = jax.lax.scan(
+        backward, (vf_term.m_knots, vf_term.vnvrs_knots),
+        (pols.m_knots, pols.c_knots, r_shift, w_shift), reverse=True)
+    vf0 = ValueFunction(m_knots=m0_knots, vnvrs_knots=vnvrs0,
+                        disc_fac=jnp.asarray(disc_fac))
+    welfare_path = aggregate_welfare(vf0, init_dist, 1.0 + r_path[0],
+                                     w_path[0], model, crra)
+    welfare_steady = aggregate_welfare(vf_term, init_dist, 1.0 + r_term,
+                                       w_term, model, crra)
+    ce = consumption_equivalent(welfare_steady, welfare_path, crra,
+                                disc_fac)
+    return TransitionWelfare(ce=ce, welfare_path=welfare_path,
+                             welfare_steady=welfare_steady)

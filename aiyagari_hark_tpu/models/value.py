@@ -64,6 +64,58 @@ def _eval_vnvrs(vf_m, vf_vnvrs, m):
     return _clamp_positive(interp1d_rowwise(m, vf_m, vf_vnvrs))
 
 
+def augment_constrained_knots(m_knots, c_knots, borrow_limit,
+                              constrained_knots: int):
+    """Insert log-spaced knots into the borrowing-constrained segment
+    (below the first endogenous gridpoint, where the exact policy is
+    ``c = m - b``): the policy is linear there, but any *value* object
+    built on it is a concave hyperbola, and one chord understates
+    continuation values model-wide (see ``policy_value``).  Returns
+    (m_knots, c_knots) with ``constrained_knots`` extra columns."""
+    if constrained_knots <= 0:
+        return m_knots, c_knots
+    from .household import CONSTRAINT_EPS
+    b = jnp.asarray(borrow_limit, dtype=m_knots.dtype)
+    eps = jnp.asarray(10.0 * CONSTRAINT_EPS, dtype=m_knots.dtype)
+    m1 = m_knots[:, 1][:, None]             # first endogenous knot [N,1]
+    # log-spaced DISTANCE above the borrowing limit (m itself may be
+    # negative under a debt limit b < 0)
+    frac = jnp.linspace(0.0, 1.0, constrained_knots + 1,
+                        dtype=m_knots.dtype)[:-1]
+    extra = b + jnp.exp(
+        jnp.log(eps) + frac[None, :] * (jnp.log((m1 - b) * (1.0 - 1e-6))
+                                        - jnp.log(eps)))   # [N, E]
+    m_aug = jnp.sort(jnp.concatenate([m_knots, extra], axis=1), axis=1)
+    c_aug = interp1d_rowwise(m_aug, m_knots, c_knots)
+    # exact constrained policy c = m - b below the first endogenous knot
+    c_aug = jnp.where(m_aug <= m1, m_aug - b, c_aug)
+    return m_aug, c_aug
+
+
+def bellman_vnvrs_step(c_knots, m_next, next_m_knots, next_vnvrs,
+                       transition, disc_fac, crra):
+    """One Bellman policy-evaluation step in constant-equivalent form:
+    u(c at the knots) + beta E[v'] with v' read from a next-period
+    ``(m_knots, vnvrs)`` pair at resources ``m_next [N, K, N']``,
+    recombined through the vnvrs transform.  The ONE implementation of
+    the value numerics (clamping, HIGHEST-precision expectation,
+    transform) — shared by the stationary fixed point
+    (``policy_value``) and the non-stationary backward recursion
+    (``transition.transition_welfare``), whose error-cancellation
+    argument requires them to be identical."""
+    n = next_m_knots.shape[0]
+    one_minus_beta = 1.0 - disc_fac
+    q = jnp.moveaxis(m_next, 2, 0).reshape(n, -1)       # [N', N*K]
+    v_next = crra_utility(_eval_vnvrs(next_m_knots, next_vnvrs, q),
+                          crra) / one_minus_beta
+    v_next = jnp.moveaxis(v_next.reshape(n, n, -1), 0, 2)   # [N, K, N']
+    ev = jnp.einsum("nkj,nj->nk", v_next, transition,
+                    precision=jax.lax.Precision.HIGHEST)
+    return inverse_utility(
+        one_minus_beta * (crra_utility(c_knots, crra) + disc_fac * ev),
+        crra)
+
+
 def policy_value(policy: HouseholdPolicy, R, W, model: SimpleModel,
                  disc_fac, crra, tol: float = 1e-9,
                  max_iter: int = 5000, constrained_knots: int = 24):
@@ -91,46 +143,16 @@ def policy_value(policy: HouseholdPolicy, R, W, model: SimpleModel,
     All scalars (R, W, disc_fac, crra) may be traced — the sweep vmaps
     welfare over calibration cells like everything else.
     """
-    m_knots = policy.m_knots                    # [N, K]
-    c_knots = policy.c_knots
-    if constrained_knots > 0:
-        from .household import CONSTRAINT_EPS
-        b = jnp.asarray(getattr(model, "borrow_limit", 0.0),
-                        dtype=m_knots.dtype)
-        eps = jnp.asarray(10.0 * CONSTRAINT_EPS, dtype=m_knots.dtype)
-        m1 = m_knots[:, 1][:, None]             # first endogenous knot [N,1]
-        # log-spaced DISTANCE above the borrowing limit (m itself may be
-        # negative under a debt limit b < 0)
-        frac = jnp.linspace(0.0, 1.0, constrained_knots + 1,
-                            dtype=m_knots.dtype)[:-1]
-        extra = b + jnp.exp(
-            jnp.log(eps) + frac[None, :] * (jnp.log((m1 - b) * (1.0 - 1e-6))
-                                            - jnp.log(eps)))   # [N, E]
-        m_aug = jnp.sort(jnp.concatenate([m_knots, extra], axis=1), axis=1)
-        c_aug = interp1d_rowwise(m_aug, m_knots, c_knots)
-        # exact constrained policy c = m - b below the first endogenous knot
-        c_aug = jnp.where(m_aug <= m1, m_aug - b, c_aug)
-        m_knots, c_knots = m_aug, c_aug
+    m_knots, c_knots = augment_constrained_knots(
+        policy.m_knots, policy.c_knots,
+        getattr(model, "borrow_limit", 0.0), constrained_knots)
     a_knots = m_knots - c_knots                 # end-of-period assets
-    n = m_knots.shape[0]
     # next-period resources per (state-knot, next-state): [N, K, N']
     m_next = R * a_knots[:, :, None] + W * model.labor_levels[None, None, :]
-    u_now = crra_utility(c_knots, crra)
-    trans = model.transition                    # [N, N']
-
-    one_minus_beta = 1.0 - disc_fac
 
     def bellman_rhs(vnvrs):
-        # v' at m_next: interp vnvrs in the NEXT state's knots, then invert
-        # the constant-equivalent transform v = u(vnvrs) / (1-beta)
-        q = jnp.moveaxis(m_next, 2, 0).reshape(n, -1)       # [N', N*K]
-        v_next = crra_utility(_eval_vnvrs(m_knots, vnvrs, q),
-                              crra) / one_minus_beta
-        v_next = jnp.moveaxis(v_next.reshape(n, n, -1), 0, 2)   # [N, K, N']
-        ev = jnp.einsum("nkj,nj->nk", v_next, trans,
-                        precision=jax.lax.Precision.HIGHEST)
-        return inverse_utility(one_minus_beta * (u_now + disc_fac * ev),
-                               crra)
+        return bellman_vnvrs_step(c_knots, m_next, m_knots, vnvrs,
+                                  model.transition, disc_fac, crra)
 
     # start at v = u(c)/(1-beta) (consume current c forever), whose
     # constant-equivalent is exactly the consumption knots

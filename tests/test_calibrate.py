@@ -32,9 +32,17 @@ def test_discount_factor_round_trip(model):
     r_target = solve_equilibrium_lean(model, beta_true, CRRA, ALPHA,
                                       DELTA).r_star
     cal = calibrate_discount_factor(model, r_target, CRRA, ALPHA, DELTA)
+    assert bool(cal.converged)
     np.testing.assert_allclose(float(cal.value), beta_true, atol=2e-5)
     np.testing.assert_allclose(float(cal.achieved), float(r_target),
                                atol=1e-5)
+
+
+def test_unreachable_target_flags_nonconvergence(model):
+    """A target outside the bracket's attainable range must come back
+    converged=False (the bisection collapses onto an endpoint)."""
+    cal = calibrate_discount_factor(model, 0.20, CRRA, ALPHA, DELTA)
+    assert not bool(cal.converged)
 
 
 def test_discount_factor_hits_paper_target(model):

@@ -70,6 +70,50 @@ def test_transitory_tfp_shock_impulse_response(steady_state):
     assert c[:20].mean() > c[-20:].mean() * 1.001
 
 
+def test_transition_welfare_no_shock_is_zero(steady_state):
+    """Living through a no-shock 'transition' that starts at the
+    stationary equilibrium is worth exactly nothing: the backward value
+    recursion along flat prices must reproduce the stationary value, so
+    the consumption equivalent is ~0 (both sides share the same value
+    numerics, so approximation errors cancel)."""
+    from aiyagari_hark_tpu.models.transition import transition_welfare
+
+    model, eq = steady_state
+    res = solve_transition(model, BETA, CRRA, ALPHA, DELTA,
+                           init_dist=eq.distribution,
+                           terminal_policy=eq.policy,
+                           k_terminal=eq.capital, horizon=60)
+    tw = transition_welfare(model, BETA, CRRA, eq.distribution,
+                            eq.policy, res.r_path, res.w_path)
+    assert abs(float(tw.ce)) < 1e-4
+
+
+def test_transition_welfare_of_tfp_shock(steady_state):
+    """A beneficial transitory TFP impulse has positive, small, and
+    monotone-in-size consumption-equivalent value."""
+    from aiyagari_hark_tpu.models.transition import transition_welfare
+
+    model, eq = steady_state
+    horizon = 100
+
+    def ce_of(size):
+        prod = 1.0 + size * 0.8 ** jnp.arange(horizon)
+        res = solve_transition(model, BETA, CRRA, ALPHA, DELTA,
+                               init_dist=eq.distribution,
+                               terminal_policy=eq.policy,
+                               k_terminal=eq.capital, horizon=horizon,
+                               prod_path=prod)
+        assert bool(res.converged)
+        tw = transition_welfare(model, BETA, CRRA, eq.distribution,
+                                eq.policy, res.r_path, res.w_path)
+        return float(tw.ce)
+
+    ce2 = ce_of(0.02)
+    ce4 = ce_of(0.04)
+    assert 0.0 < ce2 < 0.02        # a 5-quarter-ish 2% shock is worth
+    assert ce4 > 1.8 * ce2         # <2% permanent consumption, ~linear
+
+
 def test_transition_is_jittable(steady_state):
     model, eq = steady_state
     f = jax.jit(lambda d: solve_transition(
