@@ -35,7 +35,9 @@ from .models.heterogeneity import (  # noqa: F401
     uniform_beta_types,
 )
 from .models.huggett import (  # noqa: F401
+    CreditCrunchResult,
     HuggettEquilibrium,
+    solve_credit_crunch,
     solve_huggett_equilibrium,
 )
 from .models.diagnostics import DenHaanStats, den_haan_forecast  # noqa: F401
