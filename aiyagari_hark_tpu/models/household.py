@@ -143,6 +143,19 @@ def egm_step(policy: HouseholdPolicy, R, W, model: SimpleModel,
     return HouseholdPolicy(m_knots=m_knots, c_knots=c_knots)
 
 
+def anderson_rate(d1, d2, lam_max: float = 0.995):
+    """Dominant contraction rate from two successive increments —
+    the Anderson(1)/Aitken estimate lam = <d2,d1>/<d1,d1>, clipped to
+    [0, lam_max].  The extrapolation factor is lam/(1-lam).  The ONE
+    implementation shared by the policy, distribution, and rate-path
+    (credit-crunch tatonnement) accelerators; each site keeps its own
+    domain safeguards (knot monotonicity / mass renormalization /
+    bracket clipping)."""
+    lam = jnp.sum(d2 * d1) / jnp.maximum(jnp.sum(d1 * d1),
+                                         jnp.finfo(d2.dtype).tiny)
+    return jnp.clip(lam, 0.0, lam_max)
+
+
 def accelerated_policy_fixed_point(step_fn, p0, tol: float, max_iter: int,
                                    accel_every: int = 32):
     """EGM fixed point with certified Anderson(1)/Aitken acceleration, for
@@ -179,9 +192,7 @@ def accelerated_policy_fixed_point(step_fn, p0, tol: float, max_iter: int,
         diff = jnp.max(jnp.abs(new.c_knots - policy.c_knots))
         d1c = policy.c_knots - prev.c_knots
         d2c = new.c_knots - policy.c_knots
-        lam = jnp.sum(d2c * d1c) / jnp.maximum(jnp.sum(d1c * d1c),
-                                               jnp.finfo(d2c.dtype).tiny)
-        lam = jnp.clip(lam, 0.0, 0.995)
+        lam = anderson_rate(d1c, d2c)
         fac = lam / (1.0 - lam)
         c_x = new.c_knots + fac * d2c
         m_x = new.m_knots + fac * (new.m_knots - policy.m_knots)
@@ -471,9 +482,7 @@ def accelerated_distribution_fixed_point(push, dist0, tol, max_iter,
         diff = jnp.max(jnp.abs(new - dist))
         d1 = dist - prev                    # increment t-1
         d2 = new - dist                     # increment t
-        lam = jnp.sum(d2 * d1) / jnp.maximum(jnp.sum(d1 * d1),
-                                             jnp.finfo(new.dtype).tiny)
-        lam = jnp.clip(lam, 0.0, lam_max)
+        lam = anderson_rate(d1, d2, lam_max)
         extrap = jnp.clip(new + lam / (1.0 - lam) * d2, 0.0, None)
         extrap = extrap / jnp.sum(extrap)
         # If this plain step already converged, the loop exits now — carry

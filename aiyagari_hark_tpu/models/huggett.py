@@ -166,7 +166,7 @@ class CreditCrunchResult(NamedTuple):
 def solve_credit_crunch(model_loose: SimpleModel, disc_fac, crra,
                         b_path, init_dist: jnp.ndarray,
                         terminal_policy, r_pre, r_terminal,
-                        a_min: float = 0.001, a_nest_fac: int = 2,
+                        a_nest_fac: int = 2,
                         damping: float = 0.02, tol: float | None = None,
                         max_iter: int = 4000) -> CreditCrunchResult:
     """The credit-crunch experiment: the economy sits in the loose-limit
@@ -214,11 +214,23 @@ def solve_credit_crunch(model_loose: SimpleModel, disc_fac, crra,
     T = b_path.shape[0]
     a_count = model_loose.a_grid.shape[0]
     a_max = float(model_loose.a_grid[-1])
+    b_loose = float(model_loose.borrow_limit)
+    # the grid offset above the limit is derivable from the loose model,
+    # so date-t grids stay consistent with the one the pre-shock
+    # equilibrium was solved on (only nest_fac is not recoverable)
+    a_min = float(model_loose.a_grid[0]) - b_loose
     # per-date end-of-period grids, host-built like build_simple_model's
     a_grids = jnp.asarray(np.stack([
         b + np.asarray(make_asset_grid(a_min, a_max - b, a_count,
                                        a_nest_fac, dtype=jnp.float64))
         for b in b_path]), dtype=dtype)
+    if np.isclose(b_path[0], b_loose) and not np.allclose(
+            np.asarray(a_grids[0]), np.asarray(model_loose.a_grid),
+            rtol=1e-6):
+        raise ValueError(
+            "date-0 asset grid does not reproduce model_loose.a_grid — "
+            "model_loose was built with a non-default a_nest_fac; pass "
+            "the same value to solve_credit_crunch(a_nest_fac=...)")
     b_arr = jnp.asarray(b_path, dtype=dtype)
     r_pre = jnp.asarray(r_pre, dtype=dtype)
     r_term = jnp.asarray(r_terminal, dtype=dtype)
@@ -265,28 +277,44 @@ def solve_credit_crunch(model_loose: SimpleModel, disc_fac, crra,
             (pols.m_knots, pols.c_knots, r_path))
         return a_agg, c_agg, borrowers, debt
 
+    from .household import anderson_rate
+
     big = jnp.asarray(jnp.inf, dtype=dtype)
+    accel_every = 32
 
     def cond(state):
-        _, ex_max, it = state
-        return (ex_max > tol) & (it < max_iter)
+        ex_best = state[3]
+        it = state[4]
+        return (ex_best > tol) & (it < max_iter)
 
     def body(state):
-        r_path, _, it = state
+        r_path, r_prev, r_best, ex_best, it = state
         a_agg, _, _, _ = implied_excess(r_path)
         ex_max = jnp.max(jnp.abs(a_agg[:-1]))
+        # best-iterate carry: whatever the loop hands back on ANY exit
+        # (tolerance or max_iter) is the iterate its ex_best certifies —
+        # an extrapolation can only ever be the next trial, never the
+        # result (same guarantee as the policy/distribution iterators)
+        improved = ex_max < ex_best
+        r_best = jnp.where(improved, r_path, r_best)
+        ex_best = jnp.minimum(ex_best, ex_max)
         # r_{t+1} clears E[a_t]; excess demand for bonds -> rate falls.
         # The last market (t = T-1) is closed by the terminal condition.
         r_new = r_path.at[1:].add(-damping * a_agg[:-1])
         r_new = jnp.clip(r_new, -0.5, r_cap).at[0].set(r_pre)
-        # keep the CERTIFIED path: ex_max describes r_path, so when it
-        # passes the tolerance return r_path itself, not one more nudge
-        # (max_excess and the recomputed excess_path then agree exactly)
-        r_new = jnp.where(ex_max <= tol, r_path, r_new)
-        return r_new, ex_max, it + 1
+        # Anderson(1)/Aitken every accel_every steps: the small damping
+        # the dense cross-period Jacobian forces makes the plain map a
+        # slow contraction, so jump along its dominant mode; clipped,
+        # pinned, and never returned directly (see best-iterate carry)
+        lam = anderson_rate(r_path - r_prev, r_new - r_path)
+        r_x = jnp.clip(r_new + lam / (1.0 - lam) * (r_new - r_path),
+                       -0.5, r_cap).at[0].set(r_pre)
+        use_accel = (jnp.mod(it + 1, accel_every) == 0) & (ex_max > tol)
+        r_next = jnp.where(use_accel, r_x, r_new)
+        return r_next, r_path, r_best, ex_best, it + 1
 
-    r_path, ex_max, it = jax.lax.while_loop(
-        cond, body, (r_guess, big, jnp.asarray(0)))
+    _, _, r_path, ex_max, it = jax.lax.while_loop(
+        cond, body, (r_guess, r_guess, r_guess, big, jnp.asarray(0)))
     a_agg, c_agg, borrowers, debt = implied_excess(r_path)
     return CreditCrunchResult(
         r_path=r_path, excess_path=a_agg, c_agg_path=c_agg,

@@ -153,7 +153,18 @@ def build(quick: bool) -> nbf.NotebookNode:
            "`solve_portfolio_equilibrium` (models/portfolio.py).\n"
            "- **Huggett bond economy** — negative borrowing limits + "
            "zero-net-supply credit-market clearing "
-           "(`solve_huggett_equilibrium`, models/huggett.py).\n"
+           "(`solve_huggett_equilibrium`), and Guerrieri–Lorenzoni-style "
+           "**credit-crunch deleveraging transitions** "
+           "(`solve_credit_crunch`, models/huggett.py).\n"
+           "- **Endogenous labor supply** — consumption-leisure EGM with "
+           "equilibrium effective labor (`solve_labor_equilibrium`, "
+           "models/labor.py).\n"
+           "- **Calibration** — invert the equilibrium map "
+           "(`calibrate_discount_factor`, `calibrate_labor_weight`, "
+           "models/calibrate.py).\n"
+           "- **Transition welfare** — the consumption-equivalent value "
+           "of a shock path (`transition_welfare`, "
+           "models/transition.py).\n"
            "- **MIT-shock transitions** — perfect-foresight impulse "
            "responses (`solve_transition`, models/transition.py).\n"
            "- **Sequence-space Jacobians** — `jax.jacrev` through the "
