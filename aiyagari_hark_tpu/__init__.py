@@ -28,6 +28,12 @@ from .models.calibrate import (  # noqa: F401
     calibrate_discount_factor,
     calibrate_labor_weight,
 )
+from .models.epstein_zin import (  # noqa: F401
+    EZEquilibrium,
+    EZPolicy,
+    solve_ez_equilibrium,
+    solve_ez_household,
+)
 from .models.heterogeneity import (  # noqa: F401
     HeterogeneousEquilibrium,
     population_distribution,

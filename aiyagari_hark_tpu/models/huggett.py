@@ -32,6 +32,7 @@ from .household import (
     HouseholdPolicy,
     SimpleModel,
     aggregate_capital,
+    anderson_rate,
     egm_step,
     initial_distribution,
     initial_policy,
@@ -276,8 +277,6 @@ def solve_credit_crunch(model_loose: SimpleModel, disc_fac, crra,
             forward_step, init_dist,
             (pols.m_knots, pols.c_knots, r_path))
         return a_agg, c_agg, borrowers, debt
-
-    from .household import anderson_rate
 
     big = jnp.asarray(jnp.inf, dtype=dtype)
     accel_every = 32

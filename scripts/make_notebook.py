@@ -159,6 +159,9 @@ def build(quick: bool) -> nbf.NotebookNode:
            "- **Endogenous labor supply** — consumption-leisure EGM with "
            "equilibrium effective labor (`solve_labor_equilibrium`, "
            "models/labor.py).\n"
+           "- **Epstein–Zin preferences** — risk aversion decoupled from "
+           "the EIS, exact CRRA reduction at γ = 1/ψ "
+           "(`solve_ez_equilibrium`, models/epstein_zin.py).\n"
            "- **Calibration** — invert the equilibrium map "
            "(`calibrate_discount_factor`, `calibrate_labor_weight`, "
            "models/calibrate.py).\n"

@@ -1,0 +1,99 @@
+"""Epstein-Zin recursive preferences (models/epstein_zin.py).
+
+Oracles: the exact CRRA reduction at gamma = rho (policy knots AND the
+general-equilibrium rate must reproduce the CRRA solver), fixed-point
+self-consistency of the converged (c, V) pair, value monotonicity in
+risk aversion, and the precautionary comparative static gamma alone
+drives."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.models.epstein_zin import (
+    as_household_policy,
+    egm_step_ez,
+    solve_ez_equilibrium,
+    solve_ez_household,
+)
+from aiyagari_hark_tpu.models.equilibrium import solve_bisection_equilibrium
+from aiyagari_hark_tpu.models.household import (
+    build_simple_model,
+    solve_household,
+)
+
+ALPHA, DELTA, BETA = 0.36, 0.08, 0.96
+R, W = 1.03, 1.2
+
+
+@pytest.fixture(scope="module")
+def model():
+    return build_simple_model(labor_states=3, a_count=30, dist_count=120)
+
+
+def test_crra_reduction_policy(model):
+    """gamma = rho = 2 must reproduce the CRRA household exactly (the
+    risk-adjustment weights collapse to one)."""
+    ez, _, _ = solve_ez_household(R, W, model, BETA, 2.0, 2.0, tol=1e-9)
+    crra, _, _ = solve_household(R, W, model, BETA, 2.0, tol=1e-9)
+    np.testing.assert_allclose(np.asarray(ez.c_knots),
+                               np.asarray(crra.c_knots), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ez.m_knots),
+                               np.asarray(crra.m_knots), atol=1e-6)
+
+
+def test_converged_policy_is_fixed_point(model):
+    """The Euler and aggregator equations in one check: a further EZ-EGM
+    step from the converged (c, V) pair must not move it."""
+    ez, _, diff = solve_ez_household(R, W, model, BETA, 2.0, 8.0,
+                                     tol=1e-10)
+    stepped = egm_step_ez(ez, R, W, model, BETA, 2.0, 8.0)
+    assert float(jnp.max(jnp.abs(stepped.c_knots - ez.c_knots))) < 1e-9
+    assert float(jnp.max(jnp.abs(stepped.v_knots - ez.v_knots))) < 1e-8
+
+
+def test_value_falls_with_risk_aversion(model):
+    """Same EIS, more risk aversion: lifetime value (in consumption
+    units) falls at interior wealth — risk is priced harder.  (Near the
+    borrowing constraint the comparison is between two DIFFERENT optimal
+    policies and the ordering is not a theorem, so the check starts
+    above it.)"""
+    lo, _, _ = solve_ez_household(R, W, model, BETA, 2.0, 2.0)
+    hi, _, _ = solve_ez_household(R, W, model, BETA, 2.0, 8.0)
+    from aiyagari_hark_tpu.ops.interp import interp1d_rowwise
+
+    m = jnp.tile(jnp.linspace(4.0, 20.0, 10)[None, :], (3, 1))
+    v_lo = interp1d_rowwise(m, lo.m_knots, lo.v_knots)
+    v_hi = interp1d_rowwise(m, hi.m_knots, hi.v_knots)
+    assert (np.asarray(v_hi) < np.asarray(v_lo)).all()
+
+
+@pytest.fixture(scope="module")
+def equilibria(model):
+    eq_crra = solve_bisection_equilibrium(model, BETA, 2.0, ALPHA, DELTA)
+    eq_ez = solve_ez_equilibrium(model, BETA, 2.0, 2.0, ALPHA, DELTA)
+    eq_ra = solve_ez_equilibrium(model, BETA, 2.0, 8.0, ALPHA, DELTA)
+    return eq_crra, eq_ez, eq_ra
+
+
+def test_crra_reduction_equilibrium(equilibria):
+    eq_crra, eq_ez, _ = equilibria
+    np.testing.assert_allclose(float(eq_ez.r_star), float(eq_crra.r_star),
+                               atol=2e-6)
+    assert abs(float(eq_ez.excess)) < 1e-5 * float(eq_ez.capital)
+
+
+def test_risk_aversion_alone_is_precautionary(equilibria):
+    """Raising gamma at fixed rho (EIS unchanged) must lower r* — the
+    separation CRRA cannot express."""
+    _, eq_ez, eq_ra = equilibria
+    assert float(eq_ra.r_star) < float(eq_ez.r_star) - 1e-3
+    assert float(eq_ra.capital) > float(eq_ez.capital)
+
+
+def test_ez_equilibrium_is_jittable(model):
+    f = jax.jit(lambda g: solve_ez_equilibrium(
+        model, BETA, 2.0, g, ALPHA, DELTA, max_bisect=20))
+    res = f(jnp.asarray(4.0))
+    assert np.isfinite(float(res.r_star))
