@@ -31,6 +31,7 @@ from .models.calibrate import (  # noqa: F401
 from .models.epstein_zin import (  # noqa: F401
     EZEquilibrium,
     EZPolicy,
+    aggregate_ez_welfare,
     solve_ez_equilibrium,
     solve_ez_household,
 )

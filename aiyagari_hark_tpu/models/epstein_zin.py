@@ -36,12 +36,13 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .equilibrium import _bisection_setup
+from .equilibrium import _bisect, _bisection_setup
 from .firm import k_to_l_from_r, output, wage_rate
 from .household import (
     CONSTRAINT_EPS,
     HouseholdPolicy,
     SimpleModel,
+    accelerated_policy_fixed_point,
     aggregate_capital,
     aggregate_labor,
     initial_policy,
@@ -112,23 +113,27 @@ def solve_ez_household(R, W, model: SimpleModel, disc_fac, rho, gamma,
                        tol: float = 1e-6, max_iter: int = 5000,
                        init_policy: EZPolicy | None = None):
     """Infinite-horizon fixed point of the EZ-EGM step (sup-norm on the
-    consumption knots).  Returns (EZPolicy, n_iter, final_diff)."""
+    consumption knots), via the shared certified-Anderson iterator (the
+    value knots ride the extrapolation untouched and are refreshed by
+    the next exact step).  Returns (EZPolicy, n_iter, final_diff)."""
     p0 = initial_ez_policy(model) if init_policy is None else init_policy
-    big = jnp.asarray(jnp.inf, dtype=p0.c_knots.dtype)
+    return accelerated_policy_fixed_point(
+        lambda p: egm_step_ez(p, R, W, model, disc_fac, rho, gamma),
+        p0, tol, max_iter)
 
-    def cond(state):
-        _, diff, it = state
-        return (diff > tol) & (it < max_iter)
 
-    def body(state):
-        policy, _, it = state
-        new = egm_step_ez(policy, R, W, model, disc_fac, rho, gamma)
-        diff = jnp.max(jnp.abs(new.c_knots - policy.c_knots))
-        return new, diff, it + 1
-
-    policy, diff, it = jax.lax.while_loop(
-        cond, body, (p0, big, jnp.asarray(0)))
-    return policy, it, diff
+def aggregate_ez_welfare(policy: EZPolicy, dist, R, W,
+                         model: SimpleModel):
+    """Population welfare E[V(m, s)] under a wealth histogram [D, N]:
+    each cell enters the period with m = R x + W l_s.  Because V is
+    already in consumption units (degree-one homogeneous), the result
+    reads as a permanent-consumption level, and the consumption
+    equivalent between two allocations under the SAME (rho, gamma) is
+    simply ``welfare_alt / welfare_base - 1`` — no curvature transform
+    (contrast ``value.consumption_equivalent`` for CRRA levels)."""
+    m = R * model.dist_grid[:, None] + W * model.labor_levels[None, :]
+    v = interp1d_rowwise(m.T, policy.m_knots, policy.v_knots)    # [N, D]
+    return jnp.sum(dist * v.T)
 
 
 class EZEquilibrium(NamedTuple):
@@ -178,22 +183,11 @@ def solve_ez_equilibrium(model: SimpleModel, disc_fac, rho, gamma,
                                        W, model, tol=dist_tol)
         return aggregate_capital(dist, model), pol, dist, W
 
-    def cond(state):
-        lo, hi, it = state
-        return ((hi - lo) > r_tol) & (it < max_bisect)
+    def excess(r):
+        supply, _, _, _ = supply_at(r)
+        return supply - k_to_l_from_r(r, cap_share, depr_fac) * labor
 
-    def body(state):
-        lo, hi, it = state
-        mid = 0.5 * (lo + hi)
-        supply, _, _, _ = supply_at(mid)
-        ex = supply - k_to_l_from_r(mid, cap_share, depr_fac) * labor
-        lo = jnp.where(ex > 0, lo, mid)
-        hi = jnp.where(ex > 0, mid, hi)
-        return lo, hi, it + 1
-
-    lo, hi, iters = jax.lax.while_loop(
-        cond, body, (r_lo, r_hi, jnp.asarray(0)))
-    r_star = 0.5 * (lo + hi)
+    r_star, iters = _bisect(excess, r_lo, r_hi, r_tol, max_bisect)
     supply, pol, dist, W = supply_at(r_star)
     demand = k_to_l_from_r(r_star, cap_share, depr_fac) * labor
     y = output(supply, labor, cap_share)

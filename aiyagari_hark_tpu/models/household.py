@@ -160,7 +160,9 @@ def accelerated_policy_fixed_point(step_fn, p0, tol: float, max_iter: int,
                                    accel_every: int = 32):
     """EGM fixed point with certified Anderson(1)/Aitken acceleration, for
     any policy NamedTuple carrying ``m_knots``/``c_knots`` (the compact
-    ``HouseholdPolicy`` and the 4N-state ``KSPolicy`` share this).
+    ``HouseholdPolicy``, the 4N-state ``KSPolicy``, and ``EZPolicy`` —
+    extra fields such as the EZ value knots ride through ``_replace``
+    untouched by the extrapolation; the next exact step refreshes them).
 
     ``step_fn``: one EGM backward step, policy -> policy.  Convergence is
     sup-norm on the consumption knots; every ``accel_every`` steps one
@@ -175,7 +177,6 @@ def accelerated_policy_fixed_point(step_fn, p0, tol: float, max_iter: int,
     never hand the caller an unevaluated extrapolation.  ``accel_every=0``
     disables.  Returns (policy, n_iter, final_diff).
     """
-    ctor = type(p0)
     big = jnp.asarray(jnp.inf, dtype=p0.c_knots.dtype)
 
     def cond(state):
@@ -198,8 +199,8 @@ def accelerated_policy_fixed_point(step_fn, p0, tol: float, max_iter: int,
         m_x = new.m_knots + fac * (new.m_knots - policy.m_knots)
         ok = (jnp.all(jnp.diff(m_x, axis=-1) > 0)
               & jnp.all(c_x > 0) & (diff > tol))
-        out = ctor(m_knots=jnp.where(ok, m_x, new.m_knots),
-                   c_knots=jnp.where(ok, c_x, new.c_knots))
+        out = new._replace(m_knots=jnp.where(ok, m_x, new.m_knots),
+                           c_knots=jnp.where(ok, c_x, new.c_knots))
         return out, new, new, diff, it + 1
 
     def body(state):

@@ -92,6 +92,24 @@ def test_risk_aversion_alone_is_precautionary(equilibria):
     assert float(eq_ra.capital) > float(eq_ez.capital)
 
 
+def test_aggregate_ez_welfare(model, equilibria):
+    """Welfare in consumption units sits inside the consumption range,
+    and a uniformly scaled-up value function scales welfare one-for-one
+    (the homogeneity that makes EZ CE comparisons a plain ratio)."""
+    from aiyagari_hark_tpu.models.epstein_zin import aggregate_ez_welfare
+
+    _, eq_ez, _ = equilibria
+    R_, W_ = 1.0 + float(eq_ez.r_star), float(eq_ez.wage)
+    w0 = float(aggregate_ez_welfare(eq_ez.policy, eq_ez.distribution,
+                                    R_, W_, model))
+    c = np.asarray(eq_ez.policy.c_knots)
+    assert c.min() < w0 < c.max() * 2
+    scaled = eq_ez.policy._replace(v_knots=1.1 * eq_ez.policy.v_knots)
+    w1 = float(aggregate_ez_welfare(scaled, eq_ez.distribution, R_, W_,
+                                    model))
+    np.testing.assert_allclose(w1 / w0, 1.1, rtol=1e-10)
+
+
 def test_ez_equilibrium_is_jittable(model):
     f = jax.jit(lambda g: solve_ez_equilibrium(
         model, BETA, 2.0, g, ALPHA, DELTA, max_bisect=20))
