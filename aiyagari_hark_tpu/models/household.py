@@ -159,25 +159,44 @@ def anderson_rate(d1, d2, lam_max: float = 0.995):
 def accelerated_policy_fixed_point(step_fn, p0, tol: float, max_iter: int,
                                    accel_every: int = 32):
     """EGM fixed point with certified Anderson(1)/Aitken acceleration, for
-    any policy NamedTuple carrying ``m_knots``/``c_knots`` (the compact
-    ``HouseholdPolicy``, the 4N-state ``KSPolicy``, and ``EZPolicy`` —
-    extra fields such as the EZ value knots ride through ``_replace``
-    untouched by the extrapolation; the next exact step refreshes them).
+    any policy NamedTuple whose fields are knot arrays with ``m_knots``
+    first among them (the compact ``HouseholdPolicy``, the 4N-state
+    ``KSPolicy``, and ``EZPolicy``).
 
     ``step_fn``: one EGM backward step, policy -> policy.  Convergence is
-    sup-norm on the consumption knots; every ``accel_every`` steps one
-    extrapolation along the dominant contraction mode (rate ~ disc_fac, so
-    plain iteration needs ~log(tol)/log(beta) steps) is taken.  Safety
-    mirrors the distribution iterator's: the extrapolation is only the next
-    ITERATE (any error is washed out by subsequent exact EGM steps), it is
-    rejected wholesale if it breaks the strict monotonicity of the
-    endogenous grid (``searchsorted`` needs sorted knots) or consumption
-    positivity, and the loop returns the last PLAIN iterate its diff
-    certifies — a ``max_iter`` exit landing on an acceleration step can
-    never hand the caller an unevaluated extrapolation.  ``accel_every=0``
-    disables.  Returns (policy, n_iter, final_diff).
+    sup-norm over ALL fields — not consumption alone: a field the step's
+    own feedback is blind to must not escape uncertified (EZPolicy's
+    value scale is exactly such a mode — homogeneity cancels it inside
+    the Euler weights, so it decays at the plain rate no matter how fast
+    c converges; certifying c only was measured to leave V ~40x less
+    converged).  For the CRRA policies the broadened certificate changes
+    nothing: m = a + c on a fixed a-grid, so the m-diff IS the c-diff.
+
+    Every ``accel_every`` steps one extrapolation along the dominant
+    contraction mode (rate ~ disc_fac, so plain iteration needs
+    ~log(tol)/log(beta) steps) is applied to EVERY field, with the rate
+    estimated over the whole tree.  Safety mirrors the distribution
+    iterator's: the extrapolation is only the next ITERATE (any error is
+    washed out by subsequent exact EGM steps), it is rejected wholesale
+    if it breaks the strict monotonicity of the endogenous grid
+    (``searchsorted`` needs sorted knots) or the positivity of any
+    non-grid field (consumption, value), and the loop returns the last
+    PLAIN iterate its diff certifies — a ``max_iter`` exit landing on an
+    acceleration step can never hand the caller an unevaluated
+    extrapolation.  ``accel_every=0`` disables.  Returns
+    (policy, n_iter, final_diff).
     """
     big = jnp.asarray(jnp.inf, dtype=p0.c_knots.dtype)
+    fields = p0._fields
+
+    def tree_diff(a, b):
+        return jnp.max(jnp.asarray(
+            [jnp.max(jnp.abs(getattr(a, f) - getattr(b, f)))
+             for f in fields]))
+
+    def flat(a, b):
+        return jnp.concatenate(
+            [(getattr(a, f) - getattr(b, f)).ravel() for f in fields])
 
     def cond(state):
         _, _, _, diff, it = state
@@ -185,22 +204,23 @@ def accelerated_policy_fixed_point(step_fn, p0, tol: float, max_iter: int,
 
     def step(policy, prev, it):
         new = step_fn(policy)
-        diff = jnp.max(jnp.abs(new.c_knots - policy.c_knots))
-        return new, policy, new, diff, it + 1
+        return new, policy, new, tree_diff(new, policy), it + 1
 
     def step_accel(policy, prev, it):
         new = step_fn(policy)
-        diff = jnp.max(jnp.abs(new.c_knots - policy.c_knots))
-        d1c = policy.c_knots - prev.c_knots
-        d2c = new.c_knots - policy.c_knots
-        lam = anderson_rate(d1c, d2c)
+        diff = tree_diff(new, policy)
+        lam = anderson_rate(flat(policy, prev), flat(new, policy))
         fac = lam / (1.0 - lam)
-        c_x = new.c_knots + fac * d2c
-        m_x = new.m_knots + fac * (new.m_knots - policy.m_knots)
-        ok = (jnp.all(jnp.diff(m_x, axis=-1) > 0)
-              & jnp.all(c_x > 0) & (diff > tol))
-        out = new._replace(m_knots=jnp.where(ok, m_x, new.m_knots),
-                           c_knots=jnp.where(ok, c_x, new.c_knots))
+        extr = {f: getattr(new, f) + fac * (getattr(new, f)
+                                            - getattr(policy, f))
+                for f in fields}
+        ok = (jnp.all(jnp.diff(extr["m_knots"], axis=-1) > 0)
+              & jnp.all(jnp.asarray(
+                  [jnp.all(extr[f] > 0) for f in fields
+                   if f != "m_knots"]))
+              & (diff > tol))
+        out = new._replace(**{f: jnp.where(ok, extr[f], getattr(new, f))
+                              for f in fields})
         return out, new, new, diff, it + 1
 
     def body(state):

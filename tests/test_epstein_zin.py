@@ -102,8 +102,18 @@ def test_aggregate_ez_welfare(model, equilibria):
     R_, W_ = 1.0 + float(eq_ez.r_star), float(eq_ez.wage)
     w0 = float(aggregate_ez_welfare(eq_ez.policy, eq_ez.distribution,
                                     R_, W_, model))
-    c = np.asarray(eq_ez.policy.c_knots)
-    assert c.min() < w0 < c.max() * 2
+    # lifetime CE consumption sits near mean consumption under the
+    # stationary distribution (a real bound, unlike the knot range whose
+    # ends are the 1e-7 constraint eps and the top of the grid)
+    m = R_ * np.asarray(model.dist_grid)[:, None] \
+        + W_ * np.asarray(model.labor_levels)[None, :]
+    from aiyagari_hark_tpu.models.household import consumption_at
+
+    c_bar = float(np.sum(np.asarray(eq_ez.distribution)
+                         * np.asarray(consumption_at(
+                             as_household_policy(eq_ez.policy),
+                             jnp.asarray(m.T))).T))
+    assert 0.5 * c_bar < w0 < 2.0 * c_bar
     scaled = eq_ez.policy._replace(v_knots=1.1 * eq_ez.policy.v_knots)
     w1 = float(aggregate_ez_welfare(scaled, eq_ez.distribution, R_, W_,
                                     model))
@@ -115,3 +125,14 @@ def test_ez_equilibrium_is_jittable(model):
         model, BETA, 2.0, g, ALPHA, DELTA, max_bisect=20))
     res = f(jnp.asarray(4.0))
     assert np.isfinite(float(res.r_star))
+
+
+def test_vmap_over_risk_aversion(model):
+    """A gamma sweep is one batched XLA program (the same pattern as the
+    Table II sweep), and r* is monotone decreasing in gamma across it."""
+    gammas = jnp.asarray([2.0, 4.0, 8.0])
+    r = jax.vmap(lambda g: solve_ez_equilibrium(
+        model, BETA, 2.0, g, ALPHA, DELTA, max_bisect=25).r_star)(gammas)
+    r = np.asarray(r)
+    assert np.isfinite(r).all()
+    assert (np.diff(r) < 0).all()
