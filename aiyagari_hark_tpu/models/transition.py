@@ -223,6 +223,11 @@ class TransitionWelfare(NamedTuple):
                                     # staying at the terminal steady state
     welfare_path: jnp.ndarray       # E[v_0] living through the path
     welfare_steady: jnp.ndarray     # E[v] at the terminal steady state
+    ce_by_cell: jnp.ndarray         # [D, N] per-household CE — the
+                                    # distributional incidence the
+                                    # aggregate scalar hides (who gains:
+                                    # workers via the wage path, the
+                                    # wealthy via the return path)
 
 
 def transition_welfare(model: SimpleModel, disc_fac, crra,
@@ -251,10 +256,10 @@ def transition_welfare(model: SimpleModel, disc_fac, crra,
     beneficial TFP impulse, ~0 for a no-shock path (tested)."""
     from .value import (
         augment_constrained_knots,
-        aggregate_welfare,
         bellman_vnvrs_step,
         consumption_equivalent,
         policy_value,
+        value_on_histogram,
         ValueFunction,
     )
 
@@ -289,11 +294,15 @@ def transition_welfare(model: SimpleModel, disc_fac, crra,
         (pols.m_knots, pols.c_knots, r_shift, w_shift), reverse=True)
     vf0 = ValueFunction(m_knots=m0_knots, vnvrs_knots=vnvrs0,
                         disc_fac=jnp.asarray(disc_fac))
-    welfare_path = aggregate_welfare(vf0, init_dist, 1.0 + r_path[0],
-                                     w_path[0], model, crra)
-    welfare_steady = aggregate_welfare(vf_term, init_dist, 1.0 + r_term,
-                                       w_term, model, crra)
+    v_path = value_on_histogram(vf0, 1.0 + r_path[0], w_path[0], model,
+                                crra)                         # [D, N]
+    v_steady = value_on_histogram(vf_term, 1.0 + r_term, w_term, model,
+                                  crra)
+    welfare_path = jnp.sum(init_dist * v_path)
+    welfare_steady = jnp.sum(init_dist * v_steady)
     ce = consumption_equivalent(welfare_steady, welfare_path, crra,
                                 disc_fac)
-    return TransitionWelfare(ce=ce, welfare_path=welfare_path,
-                             welfare_steady=welfare_steady)
+    return TransitionWelfare(
+        ce=ce, welfare_path=welfare_path, welfare_steady=welfare_steady,
+        ce_by_cell=consumption_equivalent(v_steady, v_path, crra,
+                                          disc_fac))

@@ -196,15 +196,20 @@ def marginal_value_at(policy: HouseholdPolicy, m, crra, state_idx=None):
     return marginal_utility(consumption_at(policy, m, state_idx), crra)
 
 
+def value_on_histogram(vf: ValueFunction, R, W, model: SimpleModel,
+                       crra):
+    """v evaluated at every histogram cell's period-entry resources
+    m = R x + W l_s — the [D, N] field behind both the aggregate welfare
+    scalar and distributional incidence."""
+    m = R * model.dist_grid[:, None] + W * model.labor_levels[None, :]
+    return value_at(vf, m.T, crra).T            # [D, N]
+
+
 def aggregate_welfare(vf: ValueFunction, dist, R, W, model: SimpleModel,
                       crra):
     """Population welfare E[v(m, s)] under a wealth histogram ``dist``
-    [D, N] over ``model.dist_grid`` (e.g. the stationary distribution):
-    each (gridpoint, state) cell enters the period with
-    m = R x + W l_s."""
-    m = R * model.dist_grid[:, None] + W * model.labor_levels[None, :]
-    v = value_at(vf, m.T, crra)                 # [N, D]
-    return jnp.sum(dist * v.T)
+    [D, N] over ``model.dist_grid`` (e.g. the stationary distribution)."""
+    return jnp.sum(dist * value_on_histogram(vf, R, W, model, crra))
 
 
 def consumption_equivalent(v_base, v_alt, crra, disc_fac):

@@ -86,32 +86,59 @@ def test_transition_welfare_no_shock_is_zero(steady_state):
     tw = transition_welfare(model, BETA, CRRA, eq.distribution,
                             eq.policy, res.r_path, res.w_path)
     assert abs(float(tw.ce)) < 1e-4
+    # and nobody's individual CE moves either (populated cells only —
+    # empty top-of-grid cells never entered the aggregate)
+    mass = np.asarray(eq.distribution) > 1e-9
+    assert np.abs(np.asarray(tw.ce_by_cell))[mass].max() < 5e-4
 
 
-def test_transition_welfare_of_tfp_shock(steady_state):
-    """A beneficial transitory TFP impulse has positive, small, and
-    monotone-in-size consumption-equivalent value."""
+def _shock_welfare(steady_state, size, horizon=100):
     from aiyagari_hark_tpu.models.transition import transition_welfare
 
     model, eq = steady_state
-    horizon = 100
+    prod = 1.0 + size * 0.8 ** jnp.arange(horizon)
+    res = solve_transition(model, BETA, CRRA, ALPHA, DELTA,
+                           init_dist=eq.distribution,
+                           terminal_policy=eq.policy,
+                           k_terminal=eq.capital, horizon=horizon,
+                           prod_path=prod)
+    assert bool(res.converged)
+    return transition_welfare(model, BETA, CRRA, eq.distribution,
+                              eq.policy, res.r_path, res.w_path)
 
-    def ce_of(size):
-        prod = 1.0 + size * 0.8 ** jnp.arange(horizon)
-        res = solve_transition(model, BETA, CRRA, ALPHA, DELTA,
-                               init_dist=eq.distribution,
-                               terminal_policy=eq.policy,
-                               k_terminal=eq.capital, horizon=horizon,
-                               prod_path=prod)
-        assert bool(res.converged)
-        tw = transition_welfare(model, BETA, CRRA, eq.distribution,
-                                eq.policy, res.r_path, res.w_path)
-        return float(tw.ce)
 
-    ce2 = ce_of(0.02)
-    ce4 = ce_of(0.04)
+@pytest.fixture(scope="module")
+def tfp_shock_2pct(steady_state):
+    """The 2% impulse's welfare, shared by the size and incidence
+    tests (the transition + value recursion is the expensive part)."""
+    return _shock_welfare(steady_state, 0.02)
+
+
+def test_transition_welfare_of_tfp_shock(steady_state, tfp_shock_2pct):
+    """A beneficial transitory TFP impulse has positive, small, and
+    monotone-in-size consumption-equivalent value."""
+    ce2 = float(tfp_shock_2pct.ce)
+    ce4 = float(_shock_welfare(steady_state, 0.04).ce)
     assert 0.0 < ce2 < 0.02        # a 5-quarter-ish 2% shock is worth
     assert ce4 > 1.8 * ce2         # <2% permanent consumption, ~linear
+
+
+def test_tfp_shock_welfare_incidence(steady_state, tfp_shock_2pct):
+    """Distributional incidence of a beneficial TFP impulse: every
+    populated household type gains (wages and returns both rise on
+    impact), and the gains are NOT uniform — the aggregate CE hides
+    real dispersion across the wealth distribution."""
+    model, eq = steady_state
+    tw = tfp_shock_2pct
+    ce = np.asarray(tw.ce_by_cell)
+    mass = np.asarray(eq.distribution) > 1e-9
+    assert (ce[mass] > -1e-5).all()            # nobody loses
+    spread = ce[mass].max() - ce[mass].min()
+    assert spread > 0.1 * abs(float(tw.ce))    # real dispersion
+    # population-weighted mean CE is consistent with the aggregate CE
+    mean_ce = float(np.sum(np.asarray(eq.distribution) * ce))
+    np.testing.assert_allclose(mean_ce, float(tw.ce),
+                               atol=0.3 * abs(float(tw.ce)))
 
 
 def test_transition_is_jittable(steady_state):
