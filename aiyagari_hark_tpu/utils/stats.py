@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import csv
 import os
-from typing import NamedTuple, Optional, Sequence, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
 

@@ -4,7 +4,6 @@ Oracle: self-consistency — calibrating to the equilibrium quantity of a
 KNOWN parameter must recover that parameter (round trip through two
 independent directions of the equilibrium map)."""
 
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

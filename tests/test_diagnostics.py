@@ -3,7 +3,6 @@
 lacks (its only signal is one-step R², which den Haan showed can sit at
 0.9999 while the iterated law drifts)."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

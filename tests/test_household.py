@@ -12,7 +12,6 @@ from aiyagari_hark_tpu.models.household import (
     aggregate_labor,
     build_simple_model,
     consumption_at,
-    initial_policy,
     solve_household,
     stationary_wealth,
     wealth_transition,

@@ -9,7 +9,6 @@ import pytest
 from aiyagari_hark_tpu.models.ks_model import (
     AFuncParams,
     build_ks_calibration,
-    initial_ks_policy,
     precompute,
     solve_ks_household,
 )
@@ -20,7 +19,7 @@ from aiyagari_hark_tpu.models.simulate import (
     simulate_panel,
 )
 from aiyagari_hark_tpu.ops.interp import interp_on_interp
-from aiyagari_hark_tpu.utils.config import AgentConfig, EconomyConfig, notebook_run_configs
+from aiyagari_hark_tpu.utils.config import notebook_run_configs
 
 
 @pytest.fixture(scope="module")
@@ -79,8 +78,6 @@ def test_ks_policy_matches_simple_model_economics(cal, afunc):
     """At M = MSS the 4N-state policy evaluated at the steady-state prices
     should be close to the compact-model policy at the same prices (same
     economics, different machinery)."""
-    from aiyagari_hark_tpu.models.household import (
-        build_simple_model, solve_household, consumption_at)
     policy, _, _ = solve_ks_household(afunc, cal)
     # With AFunc = identity (slope 1, intercept 0), perceived K' = M which is
     # NOT steady state; so compare both at the converged-AFunc sense loosely:
