@@ -50,9 +50,11 @@ from .models.huggett import (  # noqa: F401
 from .models.diagnostics import DenHaanStats, den_haan_forecast  # noqa: F401
 from .models.labor import (  # noqa: F401
     LaborEquilibrium,
+    LaborTransitionResult,
     build_labor_model,
     solve_labor_equilibrium,
     solve_labor_household,
+    solve_labor_transition,
 )
 from .models.lifecycle import (  # noqa: F401
     simulate_cohort,

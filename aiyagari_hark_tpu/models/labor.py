@@ -161,24 +161,33 @@ def initial_labor_policy(model: LaborModel) -> LaborPolicy:
 
 
 def egm_step_labor(policy: LaborPolicy, R, W, model: LaborModel,
-                   disc_fac, crra, constrained_values=None) -> LaborPolicy:
+                   disc_fac, crra, constrained_values=None,
+                   R_today=None, W_today=None) -> LaborPolicy:
     """One EGM backward step.  Next-period consumption is evaluated at
     beginning assets = today's end-of-period grid (constraint-exact via
     ``labor_policy_at``); the envelope v'(a) = R u'(c) makes the
     expectation one [A,N']x[N',N] matmul; hours come from the closed-form
     intratemporal FOC; the endogenous knot is beginning assets from the
-    budget.  ``constrained_values``: see ``labor_policy_at``."""
+    budget.  ``constrained_values``: see ``labor_policy_at``.
+
+    ``(R, W)`` price the CONTINUATION (next period's resources and
+    policy); today's hours FOC and budget use ``(R_today, W_today)``,
+    defaulting to the same prices — the stationary case.  Transition
+    paths pass both (date-t step: R/W at t+1, R_today/W_today at t)."""
     base = model.base
     a = base.a_grid                                     # [A] end-of-period
     e = base.labor_levels
+    R_today = R if R_today is None else R_today
+    W_today = W if W_today is None else W_today
     c_next, _, _ = labor_policy_at(policy, a, R, W, model, crra,
                                    constrained_values)  # [A, N']
     vp_next = marginal_utility(c_next, crra)
     end_vp = disc_fac * R * jnp.matmul(
         vp_next, base.transition.T, precision=jax.lax.Precision.HIGHEST)
     c_now = inverse_marginal_utility(end_vp, crra)      # [A, N]
-    n_now = hours_from_foc(c_now, e[None, :], W, model, crra)
-    a_beg = (c_now + a[:, None] - W * e[None, :] * n_now) / R
+    n_now = hours_from_foc(c_now, e[None, :], W_today, model, crra)
+    a_beg = (c_now + a[:, None]
+             - W_today * e[None, :] * n_now) / R_today
     return LaborPolicy(a_knots=a_beg.T, c_knots=c_now.T,
                        n_knots=n_now.T)
 
@@ -269,6 +278,132 @@ def _labor_supply_eval(r, model: LaborModel, disc_fac, crra, cap_share,
     l_supply = jnp.sum(dist * base.labor_levels[None, :] * n)
     hours = jnp.sum(dist * n)
     return k_supply, l_supply, hours, policy, dist, W
+
+
+class LaborTransitionResult(NamedTuple):
+    """Perfect-foresight path of the labor-supply economy after a TFP
+    impulse: with hours chosen each period, BOTH factor inputs are
+    equilibrium paths."""
+
+    k_path: jnp.ndarray        # [T] capital in production at t
+    l_path: jnp.ndarray        # [T] effective labor at t
+    hours_path: jnp.ndarray    # [T] mean hours
+    r_path: jnp.ndarray        # [T]
+    w_path: jnp.ndarray        # [T]
+    y_path: jnp.ndarray        # [T] output
+    c_agg_path: jnp.ndarray    # [T]
+    converged: jnp.ndarray
+    iterations: jnp.ndarray
+    max_diff: jnp.ndarray
+
+
+def solve_labor_transition(model: LaborModel, disc_fac, crra, cap_share,
+                           depr_fac, init_dist: jnp.ndarray,
+                           terminal_policy: LaborPolicy,
+                           k_terminal, l_terminal, horizon: int,
+                           prod_path=None, damping: float = 0.85,
+                           tol: float = 1e-6,
+                           max_iter: int = 400) -> LaborTransitionResult:
+    """MIT-shock transition with endogenous hours: the fixed point runs
+    on the JOINT (K, L) path — prices from both marginal products,
+    backward ``lax.scan`` of the labor-EGM step (continuation prices at
+    t+1, intratemporal FOC and budget at t, per-date constrained Newton),
+    forward histogram scan giving implied capital AND effective labor.
+
+    This is where the labor margin earns its keep dynamically: a TFP
+    impulse raises the wage, hours rise on impact (substitution beats
+    the wealth effect for the calibrated Frisch), and output amplifies
+    above the TFP shock itself — the RBC hallmark the fixed-labor
+    transition cannot produce (its L is a constant).  ``l_terminal``
+    comes from the terminal stationary equilibrium
+    (``solve_labor_equilibrium(...).effective_labor``)."""
+    base = model.base
+    dtype = base.a_grid.dtype
+    if prod_path is None:
+        prod_path = jnp.ones((horizon,), dtype=dtype)
+    else:
+        prod_path = jnp.asarray(prod_path, dtype=dtype)
+    k0 = aggregate_capital(init_dist, base)
+    frac = jnp.linspace(0.0, 1.0, horizon, dtype=dtype)
+    k_guess = jnp.exp((1.0 - frac) * jnp.log(k0)
+                      + frac * jnp.log(jnp.asarray(k_terminal,
+                                                   dtype=dtype)))
+    l_guess = jnp.full((horizon,), l_terminal, dtype=dtype)
+    e = base.labor_levels
+
+    def prices(k_path, l_path):
+        k_to_l = k_path / l_path
+        r = firm.interest_factor(k_to_l, cap_share, depr_fac,
+                                 prod_path) - 1.0
+        w = firm.wage_rate(k_to_l, cap_share, prod_path)
+        return r, w
+
+    def implied(k_path, l_path):
+        r_path, w_path = prices(k_path, l_path)
+
+        def backward_step(pol_next, inputs):
+            r_next, w_next, r_t, w_t = inputs
+            con = _constrained_solve(base.a_grid[:, None], e[None, :],
+                                     1.0 + r_next, w_next, model, crra)
+            pol = egm_step_labor(pol_next, 1.0 + r_next, w_next, model,
+                                 disc_fac, crra, constrained_values=con,
+                                 R_today=1.0 + r_t, W_today=w_t)
+            return pol, pol
+
+        # date t consumes t+1's continuation prices; beyond the horizon
+        # the terminal steady state applies
+        r_next = jnp.concatenate([r_path[1:], r_path[-1:]])
+        w_next = jnp.concatenate([w_path[1:], w_path[-1:]])
+        _, pols = jax.lax.scan(backward_step, terminal_policy,
+                               (r_next, w_next, r_path, w_path),
+                               reverse=True)
+
+        def forward_step(dist, inputs):
+            pol, r_t, w_t = inputs
+            trans, c, n = labor_wealth_transition(pol, 1.0 + r_t, w_t,
+                                                  model, crra)
+            k_next = jnp.sum(dist * trans.a_next)
+            l_t = jnp.sum(dist * e[None, :] * n)
+            hours = jnp.sum(dist * n)
+            # budget-consistent consumption against the FEASIBLE
+            # (post-clip) savings, so C_t + K_{t+1} = (1-d)K_t + Y_t
+            # holds exactly along the reported path — the same
+            # invariant transition._forward_step keeps
+            income = ((1.0 + r_t) * base.dist_grid[:, None]
+                      + w_t * e[None, :] * n)
+            c_agg = jnp.sum(dist * (income - trans.a_next))
+            new = _push_forward(dist, trans, base.transition)
+            return new, (k_next, l_t, hours, c_agg)
+
+        _, (k_next, l_t, hours, c_agg) = jax.lax.scan(
+            forward_step, init_dist, (pols, r_path, w_path))
+        k_implied = jnp.concatenate([k0[None], k_next[:-1]])
+        return k_implied, l_t, hours, c_agg
+
+    big = jnp.asarray(jnp.inf, dtype=dtype)
+
+    def cond(state):
+        _, _, diff, it = state
+        return (diff > tol) & (it < max_iter)
+
+    def body(state):
+        k_path, l_path, _, it = state
+        k_implied, l_implied, _, _ = implied(k_path, l_path)
+        diff = jnp.maximum(jnp.max(jnp.abs(k_implied - k_path)),
+                           jnp.max(jnp.abs(l_implied - l_path)))
+        k_new = damping * k_path + (1.0 - damping) * k_implied
+        l_new = damping * l_path + (1.0 - damping) * l_implied
+        return k_new, l_new, diff, it + 1
+
+    k_path, l_path, diff, it = jax.lax.while_loop(
+        cond, body, (k_guess, l_guess, big, jnp.asarray(0)))
+    r_path, w_path = prices(k_path, l_path)
+    _, _, hours, c_agg = implied(k_path, l_path)
+    y_path = firm.output(k_path, l_path, cap_share, prod_path)
+    return LaborTransitionResult(
+        k_path=k_path, l_path=l_path, hours_path=hours, r_path=r_path,
+        w_path=w_path, y_path=y_path, c_agg_path=c_agg,
+        converged=diff <= tol, iterations=it, max_diff=diff)
 
 
 def solve_labor_equilibrium(model: LaborModel, disc_fac, crra, cap_share,

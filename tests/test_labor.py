@@ -125,3 +125,53 @@ def test_equilibrium_is_jittable(model):
         model, BETA, CRRA, ALPHA, DELTA, max_bisect=25))
     res = f()
     assert np.isfinite(float(res.r_star))
+
+
+# ---------------------------------------------------------------------------
+# Transition dynamics with endogenous hours
+# ---------------------------------------------------------------------------
+
+
+def test_labor_transition_steady_state_invariance(model, equilibrium):
+    """No shock + stationary start: the joint (K, L) path must sit at
+    the steady state throughout."""
+    from aiyagari_hark_tpu.models.labor import solve_labor_transition
+
+    eq = equilibrium
+    res = solve_labor_transition(model, BETA, CRRA, ALPHA, DELTA,
+                                 eq.distribution, eq.policy, eq.capital,
+                                 eq.effective_labor, horizon=50)
+    assert bool(res.converged)
+    np.testing.assert_allclose(np.asarray(res.k_path),
+                               float(eq.capital), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(res.l_path),
+                               float(eq.effective_labor), rtol=1e-5)
+
+
+def test_labor_transition_rbc_hallmarks(model, equilibrium):
+    """A transitory TFP impulse with endogenous hours: hours rise on
+    impact (substitution beats the wealth effect), output amplifies
+    above the shock itself, capital is predetermined then humps — the
+    RBC pattern the fixed-labor transition cannot produce."""
+    from aiyagari_hark_tpu.models.labor import solve_labor_transition
+
+    eq = equilibrium
+    horizon = 80
+    dz = 0.01 * 0.8 ** jnp.arange(horizon)
+    res = solve_labor_transition(model, BETA, CRRA, ALPHA, DELTA,
+                                 eq.distribution, eq.policy, eq.capital,
+                                 eq.effective_labor, horizon=horizon,
+                                 prod_path=1.0 + dz)
+    assert bool(res.converged)
+    h = np.asarray(res.hours_path)
+    h_ss = float(eq.mean_hours)
+    assert h[0] > h_ss * 1.0005            # procyclical hours on impact
+    y = np.asarray(res.y_path)
+    y_ss = y[-1]
+    assert (y[0] / y_ss - 1.0) > 0.01      # amplification above dZ=1%
+    k = np.asarray(res.k_path)
+    k_ss = float(eq.capital)
+    np.testing.assert_allclose(k[0], k_ss, rtol=1e-6)  # predetermined
+    assert k[1:40].max() > k_ss * 1.001    # investment boom
+    np.testing.assert_allclose(k[-1], k_ss, rtol=5e-3)  # reversion
+    assert abs(h[-1] / h_ss - 1.0) < 5e-3
