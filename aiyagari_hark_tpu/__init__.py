@@ -68,6 +68,7 @@ from .models.portfolio import (  # noqa: F401
 from .models.jacobian import (  # noqa: F401
     BusinessCycleMoments,
     HouseholdJacobians,
+    LaborSequenceJacobians,
     LinearIRF,
     SequenceJacobians,
     ShockFit,
@@ -75,6 +76,8 @@ from .models.jacobian import (  # noqa: F401
     fit_shock_process,
     household_jacobians,
     innovation_irf,
+    labor_business_cycle_moments,
+    labor_sequence_jacobians,
     linear_impulse_response,
     sequence_jacobians,
     simulate_linear,

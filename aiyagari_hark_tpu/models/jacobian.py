@@ -314,6 +314,90 @@ def fit_shock_process(jac: SequenceJacobians, target_std_y,
                     iterations=iters, converged=loss <= tol)
 
 
+# ---------------------------------------------------------------------------
+# The labor-supply economy: the same sequence-space construction on the
+# JOINT (K, L) path map — hours become an equilibrium kernel, so the
+# linearized model produces the hours/output statistics the fixed-labor
+# block cannot (std(hours)/std(Y), hours-output correlation).
+# ---------------------------------------------------------------------------
+
+
+class LaborSequenceJacobians(NamedTuple):
+    """GE Jacobians of the labor economy wrt a foreseen TFP path."""
+
+    g_k: jnp.ndarray     # [T, T] dK/dZ
+    g_l: jnp.ndarray     # [T, T] d(effective labor)/dZ
+    g_h: jnp.ndarray     # [T, T] d(mean hours)/dZ
+    g_c: jnp.ndarray     # [T, T] dC/dZ
+    g_y: jnp.ndarray     # [T, T] dY/dZ
+    k_ss: jnp.ndarray
+    l_ss: jnp.ndarray
+    h_ss: jnp.ndarray
+    y_ss: jnp.ndarray
+
+
+def labor_sequence_jacobians(model, disc_fac, crra, cap_share, depr_fac,
+                             eq, horizon: int) -> LaborSequenceJacobians:
+    """Differentiate the labor economy's joint path map
+    (``labor.labor_path_map``) with one ``jax.jacrev`` and solve the
+    2T-by-2T implicit-function system
+
+        [dK; dL] = (I - F_x)^{-1} F_z dZ,
+
+    where F maps stacked (K, L) paths to their household-implied values
+    (K_0 predetermined, L free).  Consumption, hours, and output
+    responses follow by chain rule.  ``eq`` is a
+    ``labor.LaborEquilibrium``; everything is evaluated at its
+    stationary point."""
+    from .labor import labor_path_map
+
+    dtype = model.base.a_grid.dtype
+    T = horizon
+    k_flat = jnp.full((T,), eq.capital, dtype=dtype)
+    l_flat = jnp.full((T,), eq.effective_labor, dtype=dtype)
+    z_flat = jnp.ones((T,), dtype=dtype)
+
+    def stacked(x, z):
+        k_i, l_i, hours, c = labor_path_map(
+            x[:T], x[T:], z, model, disc_fac, crra, cap_share, depr_fac,
+            eq.distribution, eq.policy)
+        return jnp.concatenate([k_i, l_i]), hours, c
+
+    x0 = jnp.concatenate([k_flat, l_flat])
+    (f_x, f_z), (h_x, h_z), (c_x, c_z) = jax.jacrev(
+        stacked, argnums=(0, 1))(x0, z_flat)
+    eye = jnp.eye(2 * T, dtype=dtype)
+    g_x = jnp.linalg.solve(eye - f_x, f_z)       # [2T, T]
+    g_k, g_l = g_x[:T], g_x[T:]
+    g_h = h_x @ g_x + h_z
+    g_c = c_x @ g_x + c_z
+
+    def y_of(k, l, z):
+        return firm.output(k, l, cap_share, z)
+
+    y_k, y_l, y_z = jax.grad(y_of, argnums=(0, 1, 2))(
+        eq.capital, eq.effective_labor, jnp.asarray(1.0, dtype=dtype))
+    g_y = y_k * g_k + y_l * g_l + y_z * jnp.eye(T, dtype=dtype)
+    return LaborSequenceJacobians(
+        g_k=g_k, g_l=g_l, g_h=g_h, g_c=g_c, g_y=g_y,
+        k_ss=eq.capital, l_ss=eq.effective_labor, h_ss=eq.mean_hours,
+        y_ss=y_of(eq.capital, eq.effective_labor, 1.0))
+
+
+def labor_business_cycle_moments(jac: LaborSequenceJacobians, rho: float,
+                                 sigma_eps: float) -> BusinessCycleMoments:
+    """Second moments of the linearized labor economy under AR(1) TFP —
+    now including hours and effective labor, so the RBC ratios
+    (std(hours)/std(Y), corr(hours, Y)) are model outputs."""
+    dtype = jac.g_k.dtype
+    T = jac.g_k.shape[0]
+    rho_t = jnp.asarray(rho, dtype=dtype) ** jnp.arange(T)
+    kernels = {"k": jac.g_k @ rho_t, "l": jac.g_l @ rho_t,
+               "h": jac.g_h @ rho_t, "c": jac.g_c @ rho_t,
+               "y": jac.g_y @ rho_t, "z": rho_t}
+    return _ma_moments(kernels, sigma_eps)
+
+
 def simulate_linear(jac: SequenceJacobians, rho: float, sigma_eps: float,
                     length: int, key) -> dict:
     """Monte-Carlo sample path of the linearized aggregates: draw

@@ -297,6 +297,75 @@ class LaborTransitionResult(NamedTuple):
     max_diff: jnp.ndarray
 
 
+def _labor_prices(k_path, l_path, prod_path, cap_share, depr_fac):
+    """Factor prices along a joint (K, L) path — the ONE price block
+    shared by the path map and the transition epilogue."""
+    k_to_l = k_path / l_path
+    r = firm.interest_factor(k_to_l, cap_share, depr_fac, prod_path) - 1.0
+    w = firm.wage_rate(k_to_l, cap_share, prod_path)
+    return r, w
+
+
+def labor_path_map(k_path, l_path, prod_path, model: LaborModel,
+                   disc_fac, crra, cap_share, depr_fac, init_dist,
+                   terminal_policy: LaborPolicy):
+    """The labor economy's sequence-space map: guessed (K, L) paths and a
+    TFP path in, household-implied (K, L) paths plus the consumption and
+    mean-hours paths out — one backward labor-EGM scan (continuation
+    prices at t+1, intratemporal FOC/budget at t, per-date constrained
+    Newton) and one forward histogram scan.  ``solve_labor_transition``
+    iterates it to a fixed point; ``jacobian.labor_sequence_jacobians``
+    differentiates it.  K_0 is pinned to E[a] under ``init_dist``
+    (constant in the inputs), L has no predetermined entry.
+
+    Returns ``(k_implied [T], l_implied [T], hours [T], c_agg [T])``.
+    """
+    base = model.base
+    e = base.labor_levels
+    k0 = aggregate_capital(init_dist, base)
+    r_path, w_path = _labor_prices(k_path, l_path, prod_path, cap_share,
+                                   depr_fac)
+
+    def backward_step(pol_next, inputs):
+        r_next, w_next, r_t, w_t = inputs
+        con = _constrained_solve(base.a_grid[:, None], e[None, :],
+                                 1.0 + r_next, w_next, model, crra)
+        pol = egm_step_labor(pol_next, 1.0 + r_next, w_next, model,
+                             disc_fac, crra, constrained_values=con,
+                             R_today=1.0 + r_t, W_today=w_t)
+        return pol, pol
+
+    # date t consumes t+1's continuation prices; beyond the horizon the
+    # terminal steady state applies
+    r_next = jnp.concatenate([r_path[1:], r_path[-1:]])
+    w_next = jnp.concatenate([w_path[1:], w_path[-1:]])
+    _, pols = jax.lax.scan(backward_step, terminal_policy,
+                           (r_next, w_next, r_path, w_path),
+                           reverse=True)
+
+    def forward_step(dist, inputs):
+        pol, r_t, w_t = inputs
+        trans, c, n = labor_wealth_transition(pol, 1.0 + r_t, w_t,
+                                              model, crra)
+        k_next = jnp.sum(dist * trans.a_next)
+        l_t = jnp.sum(dist * e[None, :] * n)
+        hours = jnp.sum(dist * n)
+        # budget-consistent consumption against the FEASIBLE (post-clip)
+        # savings, so C_t + K_{t+1} = (1-d)K_t + Y_t holds exactly along
+        # the reported path — the same invariant transition._forward_step
+        # keeps
+        income = ((1.0 + r_t) * base.dist_grid[:, None]
+                  + w_t * e[None, :] * n)
+        c_agg = jnp.sum(dist * (income - trans.a_next))
+        new = _push_forward(dist, trans, base.transition)
+        return new, (k_next, l_t, hours, c_agg)
+
+    _, (k_next, l_t, hours, c_agg) = jax.lax.scan(
+        forward_step, init_dist, (pols, r_path, w_path))
+    k_implied = jnp.concatenate([k0[None], k_next[:-1]])
+    return k_implied, l_t, hours, c_agg
+
+
 def solve_labor_transition(model: LaborModel, disc_fac, crra, cap_share,
                            depr_fac, init_dist: jnp.ndarray,
                            terminal_policy: LaborPolicy,
@@ -329,56 +398,11 @@ def solve_labor_transition(model: LaborModel, disc_fac, crra, cap_share,
                       + frac * jnp.log(jnp.asarray(k_terminal,
                                                    dtype=dtype)))
     l_guess = jnp.full((horizon,), l_terminal, dtype=dtype)
-    e = base.labor_levels
-
-    def prices(k_path, l_path):
-        k_to_l = k_path / l_path
-        r = firm.interest_factor(k_to_l, cap_share, depr_fac,
-                                 prod_path) - 1.0
-        w = firm.wage_rate(k_to_l, cap_share, prod_path)
-        return r, w
 
     def implied(k_path, l_path):
-        r_path, w_path = prices(k_path, l_path)
-
-        def backward_step(pol_next, inputs):
-            r_next, w_next, r_t, w_t = inputs
-            con = _constrained_solve(base.a_grid[:, None], e[None, :],
-                                     1.0 + r_next, w_next, model, crra)
-            pol = egm_step_labor(pol_next, 1.0 + r_next, w_next, model,
-                                 disc_fac, crra, constrained_values=con,
-                                 R_today=1.0 + r_t, W_today=w_t)
-            return pol, pol
-
-        # date t consumes t+1's continuation prices; beyond the horizon
-        # the terminal steady state applies
-        r_next = jnp.concatenate([r_path[1:], r_path[-1:]])
-        w_next = jnp.concatenate([w_path[1:], w_path[-1:]])
-        _, pols = jax.lax.scan(backward_step, terminal_policy,
-                               (r_next, w_next, r_path, w_path),
-                               reverse=True)
-
-        def forward_step(dist, inputs):
-            pol, r_t, w_t = inputs
-            trans, c, n = labor_wealth_transition(pol, 1.0 + r_t, w_t,
-                                                  model, crra)
-            k_next = jnp.sum(dist * trans.a_next)
-            l_t = jnp.sum(dist * e[None, :] * n)
-            hours = jnp.sum(dist * n)
-            # budget-consistent consumption against the FEASIBLE
-            # (post-clip) savings, so C_t + K_{t+1} = (1-d)K_t + Y_t
-            # holds exactly along the reported path — the same
-            # invariant transition._forward_step keeps
-            income = ((1.0 + r_t) * base.dist_grid[:, None]
-                      + w_t * e[None, :] * n)
-            c_agg = jnp.sum(dist * (income - trans.a_next))
-            new = _push_forward(dist, trans, base.transition)
-            return new, (k_next, l_t, hours, c_agg)
-
-        _, (k_next, l_t, hours, c_agg) = jax.lax.scan(
-            forward_step, init_dist, (pols, r_path, w_path))
-        k_implied = jnp.concatenate([k0[None], k_next[:-1]])
-        return k_implied, l_t, hours, c_agg
+        return labor_path_map(k_path, l_path, prod_path, model, disc_fac,
+                              crra, cap_share, depr_fac, init_dist,
+                              terminal_policy)
 
     big = jnp.asarray(jnp.inf, dtype=dtype)
 
@@ -397,7 +421,8 @@ def solve_labor_transition(model: LaborModel, disc_fac, crra, cap_share,
 
     k_path, l_path, diff, it = jax.lax.while_loop(
         cond, body, (k_guess, l_guess, big, jnp.asarray(0)))
-    r_path, w_path = prices(k_path, l_path)
+    r_path, w_path = _labor_prices(k_path, l_path, prod_path, cap_share,
+                                   depr_fac)
     _, _, hours, c_agg = implied(k_path, l_path)
     y_path = firm.output(k_path, l_path, cap_share, prod_path)
     return LaborTransitionResult(

@@ -188,6 +188,70 @@ def test_fit_shock_process_recovers_truth(jacobians):
                                rtol=2e-3)
 
 
+@pytest.fixture(scope="module")
+def labor_jacobians():
+    from aiyagari_hark_tpu.models.jacobian import labor_sequence_jacobians
+    from aiyagari_hark_tpu.models.labor import (
+        build_labor_model,
+        solve_labor_equilibrium,
+    )
+
+    model = build_labor_model(frisch=1.0, labor_weight=12.0,
+                              labor_states=3, a_count=24, dist_count=80)
+    eq = solve_labor_equilibrium(model, BETA, CRRA, ALPHA, DELTA)
+    jac = labor_sequence_jacobians(model, BETA, CRRA, ALPHA, DELTA, eq,
+                                   40)
+    return model, eq, jac
+
+
+def test_labor_jacobians_match_nonlinear_transition(labor_jacobians):
+    """The 2T-by-2T implicit-function solve must linearize the joint
+    (K, L) transition: both paths match the nonlinear MIT solve to
+    first order in the shock."""
+    from aiyagari_hark_tpu.models.labor import solve_labor_transition
+
+    model, eq, jac = labor_jacobians
+    T = jac.g_k.shape[0]
+    dz = 1e-3 * 0.8 ** jnp.arange(T)
+    res = solve_labor_transition(model, BETA, CRRA, ALPHA, DELTA,
+                                 eq.distribution, eq.policy, eq.capital,
+                                 eq.effective_labor, T,
+                                 prod_path=1.0 + dz, tol=1e-9)
+    assert bool(res.converged)
+    dk_nl = np.asarray(res.k_path) - float(eq.capital)
+    dl_nl = np.asarray(res.l_path) - float(eq.effective_labor)
+    dk_lin = np.asarray(jac.g_k @ dz)
+    dl_lin = np.asarray(jac.g_l @ dz)
+    assert np.abs(dk_lin - dk_nl).max() < 0.02 * np.abs(dk_nl).max()
+    assert np.abs(dl_lin - dl_nl).max() < 0.02 * np.abs(dl_nl).max()
+
+
+def test_hours_cyclicality_depends_on_persistence(labor_jacobians):
+    """The labor economy's signature pattern: hours respond positively
+    to TRANSITORY TFP (substitution effect) but turn countercyclical as
+    shock persistence rises (the wealth effect of a long-lived
+    productivity gain takes over) — corr(hours, Y) is monotone
+    decreasing in rho, positive at 0.2, negative at 0.95, and the
+    impact response of the hours kernel is positive for transitory
+    shocks."""
+    from aiyagari_hark_tpu.models.jacobian import (
+        labor_business_cycle_moments,
+    )
+
+    _, _, jac = labor_jacobians
+    corrs = [float(labor_business_cycle_moments(jac, rho,
+                                                0.007).corr_with_y["h"])
+             for rho in (0.2, 0.5, 0.8, 0.95)]
+    assert corrs[0] > 0.5
+    assert corrs[-1] < -0.5
+    assert all(a > b for a, b in zip(corrs, corrs[1:]))
+    kern_h = np.asarray(jac.g_h @ (0.5 ** jnp.arange(jac.g_h.shape[0])))
+    assert kern_h[0] > 0  # substitution wins on impact
+    # consumption smoother than output here too
+    mom = labor_business_cycle_moments(jac, 0.95, 0.007)
+    assert float(mom.std["c"]) < float(mom.std["y"])
+
+
 def test_business_cycle_facts(jacobians):
     """The linearized Aiyagari economy reproduces the qualitative RBC
     facts: consumption is smoother than output, both procyclical, capital
