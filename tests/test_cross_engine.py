@@ -1,0 +1,69 @@
+"""Cross-engine validation: the framework's two DYNAMIC engines must
+agree about aggregate fluctuations.
+
+Engine A — the true Krusell-Smith machinery (reference-parity 4N-state
+EGM, Monte-Carlo panel, estimated log-linear aggregate law) simulating a
+pure 2-state TFP shock with employment held constant.
+
+Engine B — the sequence-space linearization (compact N-state model, one
+jax.jacrev through the transition path map, analytic MA moments) driven
+by the AR(1) with the SAME persistence (1 - 2/spell) and stationary
+standard deviation (half the TFP gap) as the 2-state chain.
+
+The engines share no dynamic code: different state spaces, solvers,
+simulators, and aggregation (regression-based law vs implicit-function
+linearization).  Agreement of their volatility/persistence predictions
+is a joint test of both — measured ~7% on std(log K) and ~0.002 on
+autocorrelation, against MC sampling noise, the approximate KS law, the
+2-state-vs-AR(1) substitution, and second-order effects."""
+
+import jax
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.models.equilibrium import solve_bisection_equilibrium
+from aiyagari_hark_tpu.models.household import build_simple_model
+from aiyagari_hark_tpu.models.jacobian import (
+    business_cycle_moments,
+    sequence_jacobians,
+)
+from aiyagari_hark_tpu.models.ks_solver import solve_ks_economy
+from aiyagari_hark_tpu.utils.config import AgentConfig, EconomyConfig
+
+SPELL = 8.0          # mean aggregate-state duration
+TFP_GAP = 0.02       # prod_g - prod_b
+
+
+@pytest.fixture(scope="module")
+def ks_moments():
+    agent = AgentConfig(labor_states=3, a_count=24, agent_count=3000,
+                        mgrid_base=(0.7, 0.85, 0.95, 1.0, 1.05, 1.15,
+                                    1.3))
+    econ = EconomyConfig(labor_states=3, prod_b=1.0 - TFP_GAP / 2,
+                         prod_g=1.0 + TFP_GAP / 2, urate_b=0.0,
+                         urate_g=0.0, dur_mean_b=SPELL, dur_mean_g=SPELL,
+                         act_T=9000, t_discard=1000, verbose=False)
+    sol = solve_ks_economy(agent, econ, sim_method="panel")
+    assert sol.converged
+    log_k = np.log(np.asarray(sol.history.A_prev)[econ.t_discard:])
+    # hand engine B the preferences the KS solver ACTUALLY used (the
+    # economy config's — build_ks_calibration reads them there), so a
+    # recalibration moves both engines together
+    return (log_k.std(), np.corrcoef(log_k[1:], log_k[:-1])[0, 1],
+            econ.disc_fac, econ.crra)
+
+
+def test_ks_simulation_matches_linearization(ks_moments):
+    std_ks, ac1_ks, disc_fac, crra = ks_moments
+    model = build_simple_model(labor_states=3, a_count=24,
+                               dist_count=200)
+    eq = solve_bisection_equilibrium(model, disc_fac, crra, 0.36, 0.08)
+    jac = sequence_jacobians(model, disc_fac, crra, 0.36, 0.08, eq, 60)
+    rho = 1.0 - 2.0 / SPELL
+    sigma_z = TFP_GAP / 2.0
+    mom = business_cycle_moments(jac, rho,
+                                 sigma_z * np.sqrt(1.0 - rho ** 2))
+    std_lin = float(mom.std["k"]) / float(eq.capital)
+    ac1_lin = float(mom.autocorr1["k"])
+    assert abs(std_lin / std_ks - 1.0) < 0.20
+    assert abs(ac1_lin - ac1_ks) < 0.01
