@@ -3,7 +3,8 @@
 The reference runs Aiyagari's Table II (σ ∈ {1,3,5} × ρ ∈ {0,0.3,0.6,0.9})
 **manually, one notebook cell at a time**, editing the parameter dicts between
 runs (SURVEY.md §2.4) — each cell costing a ~27-minute ``economy.solve()``.
-Here a sweep is data: arrays of (σ, ρ) pairs, vmapped through the jitted
+Here a sweep is data: arrays of (σ, ρ, sd) triples — ``labor_sd`` as a
+tuple batches BOTH of Aiyagari's panels — vmapped through the jitted
 bisection equilibrium (``models.equilibrium``) and sharded over the ``cells``
 mesh axis.  No communication between cells — XLA places one subset of cells
 per device and the only cross-device traffic is the final result gather.
@@ -42,6 +43,7 @@ class SweepResult:
 
     crra: np.ndarray          # [C]
     labor_ar: np.ndarray      # [C]
+    labor_sd: np.ndarray      # [C] (one value per panel; 0.2 in panel A)
     r_star_pct: np.ndarray    # [C] net return, percent (Table II units)
     saving_rate_pct: np.ndarray  # [C] δK/Y, percent
     capital: np.ndarray       # [C]
@@ -62,36 +64,46 @@ class SweepResult:
         return float(w.max() / max(w.min(), 1))
 
     def table(self) -> str:
-        """Aiyagari Table II layout: rows ρ, columns σ, entries r* (%)."""
+        """Aiyagari Table II layout: rows ρ, columns σ, entries r* (%);
+        one block per stationary-s.d. panel when the sweep carries both."""
         sigmas = np.unique(self.crra)
         rhos = np.unique(self.labor_ar)
-        lines = ["rho\\sigma " + "  ".join(f"{s:7.1f}" for s in sigmas)]
-        for rho in rhos:
-            row = []
-            for s in sigmas:
-                m = (self.crra == s) & (self.labor_ar == rho)
-                row.append(f"{float(self.r_star_pct[m][0]):7.4f}"
-                           if m.any() else "      –")
-            lines.append(f"{rho:9.2f} " + "  ".join(row))
+        sds = np.unique(self.labor_sd)
+        lines = []
+        for sd in sds:
+            if len(sds) > 1:
+                lines.append(f"panel sd={sd:g}")
+            lines.append("rho\\sigma "
+                         + "  ".join(f"{s:7.1f}" for s in sigmas))
+            for rho in rhos:
+                row = []
+                for s in sigmas:
+                    m = ((self.crra == s) & (self.labor_ar == rho)
+                         & (self.labor_sd == sd))
+                    row.append(f"{float(self.r_star_pct[m][0]):7.4f}"
+                               if m.any() else "      –")
+                lines.append(f"{rho:9.2f} " + "  ".join(row))
         return "\n".join(lines)
 
 
 @lru_cache(maxsize=None)
-def _batched_solver(labor_sd: float, dtype, kwargs_items=()):
+def _batched_solver(dtype, kwargs_items=()):
     """Jitted vmapped cell solver, memoized so repeated sweeps (benchmarks,
     resumed runs) hit the jit cache instead of rebuilding the closure.
     Cached entries (jitted closures) live for the process — call
     ``_batched_solver.cache_clear()`` to drop them.
 
-    Uses the lean bisection (supply carried through the loop state, no
-    post-loop re-solve) so the compiled program stays small; wage, demand,
-    excess, and the saving rate are closed forms in (r*, K, L) computed
-    host-side in ``run_table2_sweep``.
+    The stationary s.d. is a vmapped axis alongside (σ, ρ), so both
+    Table II panels batch into one program.  Uses the lean bisection
+    (supply carried through the loop state, no post-loop re-solve) so the
+    compiled program stays small; wage, demand, excess, and the saving
+    rate are closed forms in (r*, K, L) computed host-side in
+    ``run_table2_sweep``.
     """
     model_kwargs = dict(kwargs_items)
 
-    def solve_one(crra, rho):
-        res = solve_calibration_lean(crra, rho, labor_sd=labor_sd,
+    def solve_one(crra, rho, sd):
+        res = solve_calibration_lean(crra, rho, labor_sd=sd,
                                      dtype=dtype, **model_kwargs)
         return (res.r_star, res.capital, res.labor, res.bisect_iters,
                 res.egm_iters, res.dist_iters)
@@ -127,27 +139,33 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
                      mesh: Optional[Mesh] = None, axis: str = "cells",
                      dtype=None, timer=None,
                      **model_kwargs) -> SweepResult:
-    """Solve every (σ, ρ) cell as one batched program.
+    """Solve every (σ, ρ, sd) cell as one batched program.
 
     With ``mesh`` given, cells are sharded over ``axis`` (padded by edge
     replication to divide the axis size); the batch is one ``jit`` whose
-    per-cell ``while_loop``s run until the *slowest* cell converges — the
-    usual vmap-of-while semantics, harmless here because cells cost within
-    ~2x of each other.  Without a mesh it is the same program on one device.
+    per-cell ``while_loop``s run until the *slowest* cell converges —
+    the usual vmap-of-while semantics.  Measured straggler cost: ~2.5x
+    total-work skew within one panel, ~3.5x across both Table II panels
+    (the high-risk sd=0.4 cells mix slowest) — still far cheaper than
+    separate launches.  Without a mesh it is the same program on one
+    device.
     """
-    cells = np.asarray(sweep.cells(), dtype=np.float64)   # [C, 2] (σ, ρ)
-    crra, rho = cells[:, 0], cells[:, 1]
+    cells = np.asarray(sweep.cells(), dtype=np.float64)  # [C, 3] (σ, ρ, sd)
+    crra, rho, sd = cells[:, 0], cells[:, 1], cells[:, 2]
     n_orig = crra.shape[0]
     if mesh is not None:
         shard = sharding(mesh, axis)
         n_shards = mesh.shape[axis]
         crra, _ = pad_to_multiple(crra, n_shards)
         rho, _ = pad_to_multiple(rho, n_shards)
+        sd, _ = pad_to_multiple(sd, n_shards)
         crra = jax.device_put(jnp.asarray(crra, dtype=dtype), shard)
         rho = jax.device_put(jnp.asarray(rho, dtype=dtype), shard)
+        sd = jax.device_put(jnp.asarray(sd, dtype=dtype), shard)
     else:
         crra = jnp.asarray(crra, dtype=dtype)
         rho = jnp.asarray(rho, dtype=dtype)
+        sd = jnp.asarray(sd, dtype=dtype)
 
     if "dist_method" not in model_kwargs:
         # Sweep-level default, distinct from stationary_wealth's "auto".
@@ -162,10 +180,11 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
         model_kwargs["dist_method"] = (
             "dense" if jax.default_backend() in ("tpu", "axon") else "auto")
 
-    fn = _batched_solver(sweep.labor_sd, dtype, _hashable_kwargs(model_kwargs))
+    fn = _batched_solver(dtype, _hashable_kwargs(model_kwargs))
     import time
     t0 = time.perf_counter()
-    r, K, L, iters, egm_it, dist_it = jax.block_until_ready(fn(crra, rho))
+    r, K, L, iters, egm_it, dist_it = jax.block_until_ready(
+        fn(crra, rho, sd))
     wall = time.perf_counter() - t0
     if timer is not None:
         timer(wall)
@@ -185,6 +204,7 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
     srate = delta * K / output
     return SweepResult(
         crra=np.asarray(crra)[sl], labor_ar=np.asarray(rho)[sl],
+        labor_sd=np.asarray(sd)[sl],
         r_star_pct=r * 100.0, saving_rate_pct=srate * 100.0,
         capital=K, excess=K - demand,
         bisect_iters=np.asarray(iters)[sl],

@@ -164,14 +164,27 @@ def notebook_run_configs() -> Tuple[AgentConfig, EconomyConfig]:
 @dataclass(frozen=True)
 class SweepConfig:
     """A calibration sweep over (CRRA sigma, labor AR rho) cells — Aiyagari
-    Table II (sigma in {1,3,5} x rho in {0,0.3,0.6,0.9}, BASELINE.json)."""
+    Table II (sigma in {1,3,5} x rho in {0,0.3,0.6,0.9}, BASELINE.json).
+
+    ``labor_sd`` may be a tuple to add the stationary-s.d. panel axis:
+    ``labor_sd=(0.2, 0.4)`` runs BOTH of Aiyagari's Table II panels as
+    one batched program (24 cells)."""
 
     crra_values: Tuple[float, ...] = (1.0, 3.0, 5.0)
     rho_values: Tuple[float, ...] = (0.0, 0.3, 0.6, 0.9)
-    labor_sd: float = 0.2
+    labor_sd: float | Tuple[float, ...] = 0.2
+
+    def sd_values(self) -> Tuple[float, ...]:
+        # normalize sequences to tuples (same policy as the sweep's
+        # _hashable_kwargs) so a list doesn't leak into cells() and die
+        # in np.asarray with an unhelpful error
+        if isinstance(self.labor_sd, (tuple, list)):
+            return tuple(float(s) for s in self.labor_sd)
+        return (float(self.labor_sd),)
 
     def cells(self):
-        return [(s, r) for s in self.crra_values for r in self.rho_values]
+        return [(s, r, sd) for sd in self.sd_values()
+                for s in self.crra_values for r in self.rho_values]
 
 
 # -- named benchmark configurations (BASELINE.json "configs") ---------------

@@ -56,6 +56,23 @@ def test_sharded_sweep_matches_single_device():
     assert "rho\\sigma" in res1.table()
 
 
+def test_both_panels_batch_into_one_sweep():
+    """labor_sd as a tuple adds the Table II panel axis: the sd=0.2 half
+    of the 2-panel batch must equal the single-panel sweep cell for
+    cell, and panel B (sd=0.4) must show lower r* (more income risk,
+    more precautionary saving)."""
+    both = run_table2_sweep(SweepConfig(crra_values=(1.0, 3.0),
+                                        rho_values=(0.3, 0.6),
+                                        labor_sd=(0.2, 0.4)), **SMALL_KW)
+    assert both.r_star_pct.shape == (8,)
+    one = run_table2_sweep(SMALL_SWEEP, **SMALL_KW)
+    a_half = both.labor_sd == 0.2
+    np.testing.assert_allclose(both.r_star_pct[a_half], one.r_star_pct,
+                               atol=1e-9)
+    assert (both.r_star_pct[~a_half] < both.r_star_pct[a_half]).all()
+    assert "panel sd=0.4" in both.table()
+
+
 def test_sweep_pads_odd_cell_counts():
     sweep = SweepConfig(crra_values=(1.0, 3.0, 5.0), rho_values=(0.3,))
     mesh = make_mesh(("cells",), (2,), devices=jax.devices()[:2])
