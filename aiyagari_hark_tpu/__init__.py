@@ -25,6 +25,7 @@ from .models.equilibrium import (  # noqa: F401
 )
 from .models.calibrate import (  # noqa: F401
     CalibrationResult,
+    calibrate_beta_spread,
     calibrate_discount_factor,
     calibrate_labor_weight,
 )
