@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -68,10 +69,14 @@ def main(argv=None):
                          "(matches the bisection engine, not the "
                          "reference's MC-attenuated KS fit)")
     ap.add_argument("--scf-csv", default=None,
-                    help="wealth,weight CSV exported from HARK's "
+                    help="optional wealth,weight CSV exported from HARK's "
                          "load_SCF_wealth_weights; without it the Lorenz "
-                         "comparison uses a documented synthetic stand-in")
+                         "comparison uses the SCF curve vendored from the "
+                         "reference's committed vector figure "
+                         "(aiyagari_hark_tpu/data/scf_lorenz.csv)")
     args = ap.parse_args(argv)
+    if args.scf_csv and not os.path.exists(args.scf_csv):
+        ap.error(f"--scf-csv {args.scf_csv!r} does not exist")
 
     start_time = time.time()
 
@@ -193,17 +198,15 @@ def main(argv=None):
     # -- Lorenz vs SCF (cells 25-27 -> Figures/wealth_distribution_1.*)
     with timer.phase("lorenz"):
         pctiles = np.linspace(0.01, 0.999, 15)   # Aiyagari-HARK.py:312
-        try:
+        if args.scf_csv:
             scf_wealth, scf_weights = stats.load_scf_wealth_weights(
                 args.scf_csv)
+            scf_lorenz = stats.get_lorenz_shares(
+                scf_wealth, weights=scf_weights, percentiles=pctiles)
+            scf_label = "SCF (raw microdata)"
+        else:
+            scf_lorenz = stats.load_scf_lorenz().scf_shares
             scf_label = "SCF"
-        except (FileNotFoundError, ValueError) as e:
-            print(f"[reproduce] SCF data unavailable ({e}); using the "
-                  f"synthetic stand-in (documented in utils/stats.py)")
-            scf_wealth, scf_weights = stats.synthetic_scf_wealth()
-            scf_label = "SCF (synthetic stand-in)"
-        scf_lorenz = stats.get_lorenz_shares(
-            scf_wealth, weights=scf_weights, percentiles=pctiles)
         sim_lorenz = stats.get_lorenz_shares(sim_wealth, weights=sim_weights,
                                              percentiles=pctiles)
         lorenz_dist = float(np.sqrt(np.sum((scf_lorenz - sim_lorenz) ** 2)))
@@ -284,8 +287,6 @@ def main(argv=None):
           f"{irf_gap:.4f} pp of K)")
 
     # -- runtime + structured results (cell 30 / runtime.txt:1-2)
-    import os
-
     os.makedirs(args.output_dir, exist_ok=True)
     total_time = time.time() - start_time
     with open(os.path.join(args.output_dir, "runtime.txt"), "w") as f:

@@ -1,0 +1,114 @@
+"""Recover the SCF Lorenz curve from the reference's committed vector figure.
+
+The reference compares its simulated wealth distribution against the U.S.
+Survey of Consumer Finances via HARK's bundled dataset
+(``load_SCF_wealth_weights``, ``Aiyagari-HARK.py:303``) and prints a
+Euclidean Lorenz distance of 0.9714 (``Aiyagari-HARK.py:332-333``).  That
+dataset is not available in this environment (no network, HARK not
+vendored) — but the reference's committed
+``Figures/wealth_distribution_1.svg`` is a matplotlib *vector* figure whose
+path data encodes all three plotted curves at the exact 15-point percentile
+grid ``np.linspace(0.01, 0.999, 15)`` (``Aiyagari-HARK.py:312``):
+
+  - ``line2d_13``: the SCF Lorenz curve   (dashed black, ``'--k'``)
+  - ``line2d_14``: the reference's simulated Lorenz curve (solid blue)
+  - ``line2d_15``: the 45-degree line     (green dash-dot)
+
+The 45-degree line's data coordinates are known exactly (y = x = pctiles),
+so it calibrates the affine SVG->data transform on both axes with no
+reliance on tick parsing; the residual of that calibration is ~2e-9 data
+units, and matplotlib writes 6-decimal SVG coordinates (~4e-6 data-unit
+quantization), so the recovered shares are good to ~1e-5.
+
+Verification built in: the Euclidean distance between the two recovered
+curves must reproduce the reference's printed golden 0.9714 (we recover
+0.97144) — if the figure or the extraction drifted, this script fails.
+
+Output: ``aiyagari_hark_tpu/data/scf_lorenz.csv`` with columns
+``pctile,scf_share,ref_sim_share``.
+
+Usage::
+
+    python scripts/extract_scf_lorenz.py [--svg PATH] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+
+import numpy as np
+
+DEFAULT_SVG = "/root/reference/Figures/wealth_distribution_1.svg"
+DEFAULT_OUT = os.path.join(os.path.dirname(__file__), os.pardir,
+                           "aiyagari_hark_tpu", "data", "scf_lorenz.csv")
+GOLDEN_DISTANCE = 0.9714        # printed by Aiyagari-HARK.py:333
+
+
+def path_points(svg_text: str, group_id: str) -> np.ndarray:
+    """Vertices of the ``<path>`` inside ``<g id=group_id>`` as [N, 2]."""
+    m = re.search(r'<g id="%s">(.*?)</g>' % re.escape(group_id),
+                  svg_text, re.S)
+    if m is None:
+        raise ValueError(f"no group {group_id!r} in SVG")
+    pts = re.findall(r"[ML] ([0-9.e+-]+) ([0-9.e+-]+)", m.group(1))
+    return np.array([[float(x), float(y)] for x, y in pts])
+
+
+def extract(svg_path: str):
+    svg = open(svg_path).read()
+    scf = path_points(svg, "line2d_13")
+    sim = path_points(svg, "line2d_14")
+    diag = path_points(svg, "line2d_15")
+    pct = np.linspace(0.01, 0.999, 15)
+    if not (scf.shape == sim.shape == diag.shape == (15, 2)):
+        raise ValueError("expected 15-vertex curves; figure layout changed?")
+
+    # Calibrate SVG->data affine from the 45-degree line (exact data coords).
+    ax = np.polyfit(diag[:, 0], pct, 1)
+    ay = np.polyfit(diag[:, 1], pct, 1)
+    resid = max(np.abs(np.polyval(ax, diag[:, 0]) - pct).max(),
+                np.abs(np.polyval(ay, diag[:, 1]) - pct).max())
+    if resid > 1e-6:
+        raise ValueError(f"axis calibration residual {resid:.2e} too large")
+
+    for curve in (scf, sim):   # x-vertices must sit on the percentile grid
+        if np.abs(np.polyval(ax, curve[:, 0]) - pct).max() > 1e-6:
+            raise ValueError("curve x-vertices off the percentile grid")
+
+    scf_share = np.polyval(ay, scf[:, 1])
+    sim_share = np.polyval(ay, sim[:, 1])
+
+    dist = float(np.sqrt(np.sum((scf_share - sim_share) ** 2)))
+    if abs(dist - GOLDEN_DISTANCE) > 5e-4:
+        raise ValueError(
+            f"recovered distance {dist:.6f} does not reproduce the "
+            f"reference golden {GOLDEN_DISTANCE}")
+    return pct, scf_share, sim_share, dist
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--svg", default=DEFAULT_SVG)
+    ap.add_argument("--out", default=os.path.normpath(DEFAULT_OUT))
+    args = ap.parse_args(argv)
+
+    pct, scf_share, sim_share, dist = extract(args.svg)
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        f.write("# SCF Lorenz curve recovered from the reference's committed "
+                "vector figure\n"
+                "# (Figures/wealth_distribution_1.svg; see "
+                "scripts/extract_scf_lorenz.py for method).\n"
+                f"# Recovered SCF-vs-ref-sim distance {dist:.6f} reproduces "
+                f"the printed golden {GOLDEN_DISTANCE}.\n"
+                "pctile,scf_share,ref_sim_share\n")
+        for p, s, r in zip(pct, scf_share, sim_share):
+            f.write(f"{p:.10g},{s:.6f},{r:.6f}\n")
+    print(f"wrote {args.out}  (recovered distance {dist:.6f}, "
+          f"golden {GOLDEN_DISTANCE})")
+
+
+if __name__ == "__main__":
+    main()

@@ -10,6 +10,8 @@ from aiyagari_hark_tpu.utils.stats import (
     gini,
     histogram_sample,
     lorenz_distance,
+    lorenz_distance_vs_scf,
+    load_scf_lorenz,
     load_scf_wealth_weights,
     wealth_stats,
 )
@@ -96,6 +98,35 @@ def test_scf_loader_missing_raises(tmp_path, monkeypatch):
     w, wt = load_scf_wealth_weights(str(p))
     np.testing.assert_allclose(w, [1.0, 5.0])
     np.testing.assert_allclose(wt, [2.0, 1.0])
+
+
+def test_vendored_scf_lorenz_reproduces_reference_golden():
+    """The SCF curve vendored from the reference's committed vector figure
+    must reproduce the reference's printed Lorenz-vs-SCF golden: the
+    Euclidean distance between the vendored SCF curve and the reference's
+    own simulated curve (both recovered from the same figure) is 0.9714
+    (``Aiyagari-HARK.py:332-333``, BASELINE.md).  This pins the LAST
+    reference golden — VERDICT r2 next-round item 1."""
+    scf = load_scf_lorenz()
+    np.testing.assert_allclose(scf.pctiles, np.linspace(0.01, 0.999, 15),
+                               atol=1e-9)                # Aiyagari-HARK.py:312
+    d = float(np.sqrt(np.sum((scf.scf_shares - scf.ref_sim_shares) ** 2)))
+    assert d == pytest.approx(0.9714, abs=5e-4)
+    # sanity on the recovered curve itself: monotone after the debtor
+    # bottom, top-percentile share ~0.896 (top 0.1% hold the rest), and the
+    # bottom shares slightly negative (SCF net worth includes debtors)
+    assert scf.scf_shares[0] < 0.0
+    assert np.all(np.diff(scf.scf_shares[3:]) > 0)
+    assert scf.scf_shares[-1] == pytest.approx(0.8957, abs=1e-3)
+
+
+def test_lorenz_distance_vs_scf_closed_form():
+    """Equal wealth has Lorenz = diagonal, so the distance to the vendored
+    SCF curve has a closed form computable directly from the CSV."""
+    scf = load_scf_lorenz()
+    expected = float(np.sqrt(np.sum((scf.scf_shares - scf.pctiles) ** 2)))
+    d = lorenz_distance_vs_scf(np.full(5000, 4.0))
+    assert d == pytest.approx(expected, abs=1e-3)
 
 
 def test_synthetic_scf_smoke_path():
