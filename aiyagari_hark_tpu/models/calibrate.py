@@ -88,8 +88,14 @@ def gini_histogram(grid, masses):
     cum_pop = jnp.concatenate([jnp.zeros((1,), dtype=w.dtype),
                                jnp.cumsum(w)])
     cw = jnp.cumsum(grid * w)
+    # floor the total-wealth normalizer: all mass at zero wealth would give
+    # 0/0 -> NaN, and a NaN here silently one-sides calibrate_beta_spread's
+    # bisection (NaN comparisons are False); with the floor, zero aggregate
+    # wealth reads as Gini 1 (all-zero Lorenz curve) — a finite, documented
+    # value instead of a NaN that corrupts the bracket
     cum_wealth = jnp.concatenate([jnp.zeros((1,), dtype=w.dtype),
-                                  cw / cw[-1]])
+                                  cw / jnp.maximum(cw[-1],
+                                                   jnp.finfo(w.dtype).tiny)])
     area = jnp.sum(0.5 * (cum_wealth[1:] + cum_wealth[:-1])
                    * jnp.diff(cum_pop))
     return 1.0 - 2.0 * area

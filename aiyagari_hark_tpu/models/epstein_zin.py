@@ -98,7 +98,11 @@ def egm_step_ez(policy: EZPolicy, R, W, model: SimpleModel, disc_fac,
     v_now = ((1.0 - disc_fac) * c_now ** (1.0 - rho)
              + disc_fac * mu ** (1.0 - rho)) ** (1.0 / (1.0 - rho))
     # constraint knot: at m = b + eps consumption is eps and savings sit
-    # at the limit, so the continuation CE is the first-gridpoint mu row
+    # at the limit, so the continuation CE is the first-gridpoint mu row.
+    # mu[:1] is mu at a_grid[0] = borrow_limit + a_min, not exactly at
+    # savings = borrow_limit — an O(a_min) approximation (fine at the
+    # default a_min=1e-3) that the CRRA path doesn't need (its constraint
+    # knot reads no continuation value); not an exact identity.
     eps = jnp.full((1, c_now.shape[1]), CONSTRAINT_EPS, dtype=c_now.dtype)
     b = jnp.asarray(model.borrow_limit, dtype=c_now.dtype)
     v_con = ((1.0 - disc_fac) * eps ** (1.0 - rho)

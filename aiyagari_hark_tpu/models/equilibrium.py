@@ -115,7 +115,12 @@ def _bisect(excess_fn, r_lo, r_hi, r_tol, max_bisect: int,
     and the last evaluation's aux rides the loop state — callers that
     want the quantity AT the root (e.g. calibration's "achieved") get it
     without re-solving after the loop.  Returns
-    ``(r_star, iterations, aux_last)`` in that mode."""
+    ``(r_star, iterations, aux_last)`` in that mode.  The first midpoint
+    evaluation runs eagerly (before the ``while_loop``) so aux is a real
+    evaluation even when the loop body never executes (initial bracket
+    already within ``r_tol``, or ``max_bisect=0`` — which therefore still
+    costs one evaluation in aux mode); the total evaluation cap stays
+    ``max_bisect``."""
     with_aux = aux_init is not None
 
     def cond(state):
@@ -133,8 +138,10 @@ def _bisect(excess_fn, r_lo, r_hi, r_tol, max_bisect: int,
         hi = jnp.where(ex > 0, mid, hi)
         return (lo, hi, it + 1, aux) if with_aux else (lo, hi, it + 1)
 
-    init = ((r_lo, r_hi, jnp.asarray(0), aux_init) if with_aux
-            else (r_lo, r_hi, jnp.asarray(0)))
+    if with_aux:
+        init = body((r_lo, r_hi, jnp.asarray(0), aux_init))
+    else:
+        init = (r_lo, r_hi, jnp.asarray(0))
     out = jax.lax.while_loop(cond, body, init)
     if with_aux:
         return 0.5 * (out[0] + out[1]), out[2], out[3]

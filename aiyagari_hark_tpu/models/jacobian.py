@@ -301,7 +301,15 @@ def fit_shock_process(jac: SequenceJacobians, target_std_y,
 
     def body(state):
         theta, r, it = state
-        step = jnp.linalg.solve(jac_fn(theta), r)
+        # Levenberg-damped Gauss-Newton instead of a raw 2x2 solve: near the
+        # rho->1 boundary the Jacobian goes singular and jnp.linalg.solve
+        # would propagate NaN into theta (silent NaN exit with
+        # converged=False); the tiny trace-scaled ridge keeps the system
+        # nonsingular while matching Newton to ~1e-9 when well-conditioned.
+        J = jac_fn(theta)
+        JtJ = J.T @ J
+        lam = 1e-9 * (jnp.trace(JtJ) + 1.0)
+        step = jnp.linalg.solve(JtJ + lam * jnp.eye(2, dtype=dtype), J.T @ r)
         theta = theta - jnp.clip(step, -1.0, 1.0)
         return theta, residuals(theta), it + 1
 
