@@ -22,6 +22,7 @@ from typing import List
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..ops.regression import masked_ols
 from ..utils.config import AgentConfig, EconomyConfig
@@ -90,14 +91,33 @@ class _PinnedSecant:
         self.probe = probe
 
     def step(self, i: float, g: float) -> float:
+        # The residual map is monotone decreasing in i, but with carried
+        # simulation state early evaluations are transient-biased: a bound
+        # recorded from a stale evaluation can contradict fresh data and
+        # pinch the bracket onto a non-root (seen as a frozen intercept with
+        # the bisect fallback halving a width of ~1e-13 while |g| > tol).
+        # A fresh evaluation that contradicts a stored bound evicts it.
+        if (self.lo is not None and self.hi is not None
+                and self.hi - self.lo < 1e-6 and abs(g) > 1e-6):
+            # bracket pinched to numerical nothing around a point that is
+            # demonstrably not a root: every recorded bound is stale
+            self.lo = self.hi = None
         if g > 0:
+            if self.hi is not None and i >= self.hi:
+                self.hi = None   # stale: g>0 cannot sit at/above the hi bound
             self.lo = i if self.lo is None else max(self.lo, i)
         else:
+            if self.lo is not None and i <= self.lo:
+                self.lo = None
             self.hi = i if self.hi is None else min(self.hi, i)
-        if self.g_prev is not None and abs(g - self.g_prev) > 1e-14:
+        if (self.g_prev is not None and abs(g - self.g_prev) > 1e-14
+                and abs(i - self.i_prev) > 1e-12):
             cand = i - g * (i - self.i_prev) / (g - self.g_prev)
         else:
-            cand = i + self.probe * g   # relaxation probe to seed the secant
+            # seed the secant — or recover from a frozen iterate, where the
+            # slope estimate degenerates to 0/dg (g still moves between
+            # identical iterates while the carried simulation state relaxes)
+            cand = i + self.probe * g
         cand = min(max(cand, i - self.max_step), i + self.max_step)
         if self.lo is not None and self.hi is not None and not (
                 self.lo < cand < self.hi):
@@ -248,8 +268,9 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
     if sim_method == "panel":
         init = initial_panel(cal, agent.agent_count, econ.mrkv_now_init,
                              k_birth)
-        run_panel = jax.jit(lambda pol, k: simulate_panel(
-            pol, cal, mrkv_hist, init, k))
+        run_panel = jax.jit(lambda pol, k, i0, kbar: simulate_panel(
+            pol, cal, mrkv_hist, i0, k))   # kbar unused: realized prices
+        carry_init = False    # reference parity: fresh birth panel per loop
     elif sim_method == "distribution":
         from .simulate import (
             initial_distribution_fan,
@@ -266,12 +287,34 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
         dist_grid = make_sim_dist_grid(cal, dist_count)
         init = initial_distribution_fan(cal, dist_grid, econ.mrkv_now_init,
                                         dist_fan)
-        run_panel = jax.jit(lambda pol, k: jax.vmap(   # key unused
-            lambda i0: simulate_distribution_history(pol, cal, mrkv_hist,
-                                                     dist_grid, i0))(init))
+        # Pinned mode simulates under FIXED prices R(K-bar): the measured
+        # path is then the household supply curve and the secant root is
+        # the bisection engine's market-clearing condition — realized-price
+        # feedback at this calibration stabilizes a truncation
+        # pseudo-equilibrium instead (see simulate_distribution_history's
+        # docstring for the measured mechanism).
+        fixed_prices = bool(dist_pin_slope)
+        run_panel = jax.jit(lambda pol, k, i0, kbar: jax.vmap(  # key unused
+            lambda one: simulate_distribution_history(
+                pol, cal, mrkv_hist, dist_grid, one,
+                fixed_K=(kbar if fixed_prices else None)))(i0))
+        # Carry each outer iteration's final distribution into the next
+        # iteration's initial condition.  From a point mass at the
+        # perfect-foresight steady state — where r sits exactly at the
+        # 1/beta - 1 supply cap, so wealth mixes glacially — a single
+        # act_T window never reaches the ergodic distribution: the
+        # time-mean the rule update reads is transient-biased, and the
+        # secant can settle on a truncation pseudo-equilibrium (measured
+        # at the notebook calibration: r 4.32% > the 4.1667% cap with 2.3%
+        # of mass clipped at the grid top).  Carrying the state makes the
+        # effective chain length grow with the outer iteration count, the
+        # same warm-start trick the EGM policy seed uses.  Not in fan
+        # mode: its spread initial conditions ARE the identification.
+        carry_init = dist_fan == 1
     else:
         raise ValueError(f"sim_method must be 'panel' or 'distribution', "
                          f"got {sim_method!r}")
+    sim_init = init
     if dist_discard is None:
         dist_discard = (econ.t_discard if dist_fan in (None, 1)
                         else min(25, econ.act_T // 4))
@@ -292,6 +335,7 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
                                      dist_count, dist_fan, dist_discard,
                                      dist_pin_slope)
     pinned = sim_method == "distribution" and bool(dist_pin_slope)
+    last_residual = [float("inf")]   # pinned mode's |g| at the last update
     if pinned:
         secant = _PinnedSecant()
         measured = jax.jit(
@@ -300,6 +344,7 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
         def update(hist, af):
             i_cur = float(af.intercept[0])
             g = float(measured(hist)) - i_cur
+            last_residual[0] = abs(g)
             i_new = secant.step(i_cur, g)
             new = AFuncParams(
                 intercept=jnp.full((2,), i_new, dtype=cal.a_grid.dtype),
@@ -367,12 +412,48 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
         # a checkpoint is only "converged" relative to the tolerance it was
         # written under (excluded from the fingerprint so resumes may
         # tighten it); re-check against the CURRENT tolerance so a resume
-        # with a tighter one keeps iterating instead of short-circuiting
+        # with a tighter one keeps iterating instead of short-circuiting.
+        # Pinned mode re-checks the fixed-point residual |g| too.
         resumed_converged = bool(ck.converged) and (
-            float(ck.last_distance) < econ.tolerance)
+            float(ck.last_distance) < econ.tolerance) and (
+            not pinned or float(ck.last_residual) < econ.tolerance)
         # always leave at least one pass to (re)generate the policy/history
         # the checkpoint does not carry
         it_start = max(0, min(int(ck.iteration), econ.max_loops - 1))
+        # The carried simulation state rides in a sidecar (shape depends on
+        # the dist config, which the fingerprint already gates); restoring
+        # it keeps a resumed trajectory identical to the uninterrupted one.
+        # The sidecar is written BEFORE the main checkpoint each iteration
+        # and carries the iteration tag, so a half-written pair (kill
+        # between the two writes) or a checkpoint copied without its
+        # sidecar degrades to a LOUD approximate resume, never a silently
+        # divergent "exact" one.
+        sidecar = checkpoint_path + ".dist.npz"
+        if carry_init:
+            import warnings
+
+            from ..utils.checkpoint import load_pytree
+            if os.path.exists(sidecar):
+                tag, state = load_pytree(
+                    sidecar, (np.zeros((), np.int64), sim_init))
+                if int(tag) == int(ck.iteration):
+                    sim_init = jax.tree.map(
+                        lambda leaf, like: jnp.asarray(leaf,
+                                                       dtype=like.dtype),
+                        state, sim_init)
+                else:
+                    warnings.warn(
+                        f"checkpoint sidecar {sidecar} is tagged for "
+                        f"iteration {int(tag)} but the checkpoint is at "
+                        f"{int(ck.iteration)} (interrupted between the "
+                        f"two writes?) — resuming from a fresh initial "
+                        f"distribution; the continued trajectory is "
+                        f"approximate, not exact", stacklevel=2)
+            elif int(ck.iteration) > 0:
+                warnings.warn(
+                    f"no {sidecar} next to the checkpoint — resuming from "
+                    f"a fresh initial distribution; the continued "
+                    f"trajectory is approximate, not exact", stacklevel=2)
         if econ.verbose:
             print(f"[ks] resumed from {checkpoint_path} at outer "
                   f"iteration {it_start}"
@@ -386,7 +467,8 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
                                                           policy_seed))
         with timer.phase("simulate"):
             history, final_panel = jax.block_until_ready(
-                run_panel(policy, k_panel))
+                run_panel(policy, k_panel, sim_init,
+                          jnp.exp(afunc.intercept[0])))
         history, final_panel = finalize(history, final_panel)
         return KSSolution(afunc=afunc, policy=policy, calibration=cal,
                           history=history, mrkv_hist=mrkv_hist,
@@ -410,7 +492,10 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
             else k_panel
         with timer.phase("simulate"):
             history, final_panel = jax.block_until_ready(
-                run_panel(policy, k_it))
+                run_panel(policy, k_it, sim_init,
+                          jnp.exp(afunc.intercept[0])))
+            if carry_init:
+                sim_init = final_panel
         with timer.phase("regress"):
             new_afunc, rsq = jax.block_until_ready(update(history, afunc))
         if not (bool(jnp.all(jnp.isfinite(new_afunc.intercept)))
@@ -438,13 +523,28 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
                   f"slope={rec.slope} r2={rec.r_squared} dist={distance:.5f}")
         if callback is not None:
             callback(rec)
-        if distance < econ.tolerance:
+        # Pinned mode must ALSO clear the fixed-point residual |g|: near the
+        # 1/beta - 1 cap the supply map's log-slope is O(100) (measured
+        # ~-190 at the notebook calibration), so a small secant STEP does
+        # not imply a small residual — the step-only criterion accepted a
+        # point with |g| = 0.56 (measured), i.e. supply 43% off the
+        # perceived stock.
+        if distance < econ.tolerance and (
+                not pinned or last_residual[0] < econ.tolerance):
             converged = True
         if checkpoint_path is not None:
+            # sidecar first: the main checkpoint is the commit point, so a
+            # kill between the writes leaves (old checkpoint, new sidecar)
+            # — detected on resume via the iteration tag
+            if carry_init:
+                from ..utils.checkpoint import save_pytree
+                save_pytree(checkpoint_path + ".dist.npz",
+                            (np.asarray(it + 1, np.int64), sim_init))
             save_ks_checkpoint(checkpoint_path, afunc, it + 1, seed,
                                converged, fingerprint,
                                secant=secant.to_array() if pinned else None,
-                               last_distance=distance)
+                               last_distance=distance,
+                               last_residual=last_residual[0])
         if converged:
             break
 

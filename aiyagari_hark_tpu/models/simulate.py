@@ -292,7 +292,8 @@ def initial_distribution_fan(cal: KSCalibration, dist_grid: jnp.ndarray,
 def simulate_distribution_history(policy: KSPolicy, cal: KSCalibration,
                                   mrkv_hist: jnp.ndarray,
                                   dist_grid: jnp.ndarray,
-                                  init: DistPanelState | None = None):
+                                  init: DistPanelState | None = None,
+                                  fixed_K=None):
     """Run the full history by pushing the histogram through each period.
 
     Mirrors ``simulate_panel`` step for step — labor mixing (Tauchen row
@@ -302,6 +303,20 @@ def simulate_distribution_history(policy: KSPolicy, cal: KSCalibration,
     Aggregates are exact expectations; the two-point lottery preserves the
     mean, so ``A_prev`` equals the pre-scatter expectation exactly.
     Returns the same ``(PanelHistory, final state)`` contract.
+
+    ``fixed_K``: mill factor prices from this capital stock instead of the
+    realized ``A_prev`` — the fixed-price relaxation the slope-pinned
+    secant needs.  Motivation (measured at the notebook calibration): with
+    realized-price feedback, histogram-top truncation caps the measured
+    mean capital, realized r reads ABOVE the 1/beta - 1 supply cap, beta*R
+    exceeds one, every wealth level drifts upward, and the clipped tail
+    re-feeds the truncation — a self-consistent pseudo-equilibrium (r
+    4.32% with 2.3% of mass parked at the grid top).  Under fixed prices
+    the simulated path is exactly the household supply curve A(r(K)), so
+    the secant's fixed point is the bisection engine's market-clearing
+    equation, and r can never run above the cap at a root.  ``history``
+    still records the realized ``A_prev``; ``M_now``/prices record what
+    households actually faced.
     """
     from ..ops.interp import eval_policy_agents, locate_in_grid
 
@@ -309,6 +324,11 @@ def simulate_distribution_history(policy: KSPolicy, cal: KSCalibration,
         # mrkv_hist[0] may be traced (inside jit) — initial_distribution_panel
         # only indexes with it, so no concretization is needed
         init = initial_distribution_panel(cal, dist_grid, mrkv_hist[0])
+    if fixed_K is not None:
+        r0, w0, m0 = mill_aggregates(cal, fixed_K, init.mrkv)
+        dt = dist_grid.dtype
+        init = init._replace(R_now=r0.astype(dt), W_now=w0.astype(dt),
+                             M_now=m0.astype(dt))
     d_size, n = dist_grid.shape[0], cal.labor_levels.shape[0]
 
     def step(state: DistPanelState, z_t):
@@ -351,8 +371,10 @@ def simulate_distribution_history(policy: KSPolicy, cal: KSCalibration,
         flat = lambda x: x.reshape(d_size, n * 2)   # noqa: E731
         new_dist = jax.vmap(scatter_col, in_axes=1, out_axes=1)(
             flat(dist_le), flat(idx), flat(w)).reshape(d_size, n, 2)
-        # --- mill (identical to simulate_panel)
-        R_new, W_new, M_new = mill_aggregates(cal, A_prev, z_t)
+        # --- mill (identical to simulate_panel; fixed_K pins the price
+        # feedback to the perceived stock — see the docstring)
+        R_new, W_new, M_new = mill_aggregates(
+            cal, A_prev if fixed_K is None else fixed_K, z_t)
         out = (z_t, A_prev, M_new, urate_real)
         return DistPanelState(dist=new_dist, M_now=M_new, R_now=R_new,
                               W_now=W_new, mrkv=z_t), out

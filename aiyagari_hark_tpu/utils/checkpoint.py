@@ -39,11 +39,28 @@ def save_pytree(path: str, tree) -> None:
         raise
 
 
+def _canonical_treedef(s: str) -> str:
+    """Treedef repr with NamedTuple class names erased.
+
+    Validation must be *structural*: the stored repr embeds the writer's
+    class name, and migration templates are necessarily aliases with
+    different names (a file written by round-2's 8-field ``KSCheckpoint``
+    must load into today's ``_KSCheckpointV3``).  Comparing raw strings
+    made every cross-version migration tier dead code — the load raised on
+    the name before structure was ever considered (round-3 review
+    finding).  Shapes/dtypes still come from the file; config fingerprints
+    guard semantic compatibility."""
+    import re
+
+    return re.sub(r"namedtuple\[\w+\]", "namedtuple[_]", s)
+
+
 def load_pytree(path: str, like):
     """Read a pytree saved by ``save_pytree`` into the structure of ``like``
-    (validated against the stored treedef; leaf shapes/dtypes come from the
-    file).  Leaf keys are ordered numerically by their index, so the count
-    is unbounded (no lexicographic rollover at 4 digits)."""
+    (validated structurally against the stored treedef — NamedTuple class
+    names are ignored, see ``_canonical_treedef``; leaf shapes/dtypes come
+    from the file).  Leaf keys are ordered numerically by their index, so
+    the count is unbounded (no lexicographic rollover at 4 digits)."""
     treedef = jax.tree_util.tree_structure(like)
     n = treedef.num_leaves
     with np.load(path) as data:
@@ -51,7 +68,9 @@ def load_pytree(path: str, like):
                       if "__treedef__" in data.files else None)
         keys = sorted((k for k in data.files if k.startswith("leaf_")),
                       key=lambda k: int(k[5:]))
-        if stored_def is not None and stored_def != str(treedef):
+        if stored_def is not None and (
+                _canonical_treedef(stored_def)
+                != _canonical_treedef(str(treedef))):
             raise ValueError(
                 f"checkpoint {path} was written for pytree structure\n  "
                 f"{stored_def}\nbut the template is\n  {treedef}")
@@ -80,6 +99,8 @@ class KSCheckpoint(NamedTuple):
     fingerprint: np.ndarray  # scalar int64 — config hash
     secant: np.ndarray       # [4] (i_prev, g_prev, lo, hi); NaN = unset
     last_distance: np.ndarray  # scalar: rule distance at the saved iteration
+    last_residual: np.ndarray  # scalar: pinned |g| at the saved iteration
+    #                            (+inf when not pinned / unknown)
 
 
 def ks_checkpoint_template() -> KSCheckpoint:
@@ -89,7 +110,8 @@ def ks_checkpoint_template() -> KSCheckpoint:
         converged=np.zeros((), np.bool_),
         fingerprint=np.zeros((), np.int64),
         secant=np.full((4,), np.nan),
-        last_distance=np.full((), np.inf))
+        last_distance=np.full((), np.inf),
+        last_residual=np.full((), np.inf))
 
 
 def config_fingerprint(*objs) -> int:
@@ -118,7 +140,8 @@ def config_fingerprint(*objs) -> int:
 
 def save_ks_checkpoint(path: str, afunc, iteration: int, seed: int,
                        converged: bool, fingerprint: int = 0,
-                       secant=None, last_distance: float = np.inf) -> None:
+                       secant=None, last_distance: float = np.inf,
+                       last_residual: float = np.inf) -> None:
     save_pytree(path, KSCheckpoint(
         intercept=np.asarray(afunc.intercept),
         slope=np.asarray(afunc.slope),
@@ -128,7 +151,8 @@ def save_ks_checkpoint(path: str, afunc, iteration: int, seed: int,
         fingerprint=np.asarray(fingerprint, np.int64),
         secant=(np.full((4,), np.nan) if secant is None
                 else np.asarray(secant, np.float64)),
-        last_distance=np.asarray(last_distance, np.float64)))
+        last_distance=np.asarray(last_distance, np.float64),
+        last_residual=np.asarray(last_residual, np.float64)))
 
 
 class _KSCheckpointV1(NamedTuple):
@@ -154,6 +178,19 @@ class _KSCheckpointV2(NamedTuple):
     secant: np.ndarray
 
 
+class _KSCheckpointV3(NamedTuple):
+    """Round-2 layout (last_distance, no last_residual)."""
+
+    intercept: np.ndarray
+    slope: np.ndarray
+    iteration: np.ndarray
+    seed: np.ndarray
+    converged: np.ndarray
+    fingerprint: np.ndarray
+    secant: np.ndarray
+    last_distance: np.ndarray
+
+
 def load_ks_checkpoint(path: str) -> KSCheckpoint:
     """Load a KS checkpoint, migrating older layouts in place of failing.
 
@@ -170,10 +207,18 @@ def load_ks_checkpoint(path: str) -> KSCheckpoint:
               np.zeros((), np.int64), np.zeros((), np.bool_),
               np.zeros((), np.int64))
     try:
+        old = load_pytree(path, _KSCheckpointV3(*zeros6, secant=np.zeros(4),
+                                                last_distance=np.zeros(())))
+        return KSCheckpoint(*old, last_residual=np.asarray(np.inf))
+    except ValueError:
+        pass
+    try:
         old = load_pytree(path, _KSCheckpointV2(*zeros6,
                                                 secant=np.zeros(4)))
-        return KSCheckpoint(*old, last_distance=np.asarray(np.inf))
+        return KSCheckpoint(*old, last_distance=np.asarray(np.inf),
+                            last_residual=np.asarray(np.inf))
     except ValueError:
         old = load_pytree(path, _KSCheckpointV1(*zeros6))
         return KSCheckpoint(*old, secant=np.full((4,), np.nan),
-                            last_distance=np.asarray(np.inf))
+                            last_distance=np.asarray(np.inf),
+                            last_residual=np.asarray(np.inf))

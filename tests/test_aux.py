@@ -186,18 +186,31 @@ def test_validators_catch_corruption():
 
 def test_legacy_ks_checkpoint_migrates(tmp_path):
     """Checkpoints written by earlier layouts (no secant memory / no
-    last_distance) load with conservative defaults instead of hard-failing
-    — resumability of long runs is this module's purpose."""
+    last_distance / no last_residual) load with conservative defaults
+    instead of hard-failing — resumability of long runs is this module's
+    purpose.
+
+    The legacy files are written under a NamedTuple literally named
+    ``KSCheckpoint`` (what the old code actually wrote) — NOT the loader's
+    private alias classes.  The stored treedef embeds the writer's class
+    name, so writing with the alias masked a dead migration path where
+    every tier raised on the name before structure was considered
+    (round-3 review finding)."""
+    import collections
+
     import numpy as np
 
     from aiyagari_hark_tpu.utils.checkpoint import (
-        _KSCheckpointV1,
         load_ks_checkpoint,
         save_pytree,
     )
 
-    p = str(tmp_path / "legacy.npz")
-    save_pytree(p, _KSCheckpointV1(
+    # round-1 layout: 6 fields, class named KSCheckpoint
+    V1 = collections.namedtuple(
+        "KSCheckpoint",
+        "intercept slope iteration seed converged fingerprint")
+    p = str(tmp_path / "legacy_v1.npz")
+    save_pytree(p, V1(
         intercept=np.asarray([0.1, 0.2]), slope=np.asarray([1.0, 1.1]),
         iteration=np.asarray(7, np.int64), seed=np.asarray(3, np.int64),
         converged=np.asarray(True), fingerprint=np.asarray(42, np.int64)))
@@ -208,3 +221,23 @@ def test_legacy_ks_checkpoint_migrates(tmp_path):
     # migrated "converged" must NOT short-circuit a resume: inf distance
     # fails any tolerance check
     assert np.isinf(ck.last_distance)
+    assert np.isinf(ck.last_residual)
+
+    # round-2 layout: 8 fields (secant + last_distance), same class name
+    V3 = collections.namedtuple(
+        "KSCheckpoint",
+        "intercept slope iteration seed converged fingerprint secant "
+        "last_distance")
+    p3 = str(tmp_path / "legacy_v3.npz")
+    save_pytree(p3, V3(
+        intercept=np.asarray([0.3, 0.4]), slope=np.asarray([0.0, 0.0]),
+        iteration=np.asarray(9, np.int64), seed=np.asarray(0, np.int64),
+        converged=np.asarray(True), fingerprint=np.asarray(7, np.int64),
+        secant=np.asarray([1.0, 2.0, 3.0, 4.0]),
+        last_distance=np.asarray(1e-4)))
+    ck3 = load_ks_checkpoint(p3)
+    np.testing.assert_array_equal(ck3.secant, [1.0, 2.0, 3.0, 4.0])
+    assert float(ck3.last_distance) == 1e-4
+    # the residual is unknown for a round-2 file: +inf forces a pinned
+    # resume to re-certify instead of trusting a stale convergence claim
+    assert np.isinf(ck3.last_residual)

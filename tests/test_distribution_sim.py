@@ -185,8 +185,13 @@ def test_pinned_resume_continues_secant_trajectory(tmp_path):
     uninterrupted trajectory exactly — the secant memory (previous iterate,
     residual, bracket) rides in the checkpoint."""
     agent, econ = notebook_run_configs()
+    # max_loops=40: with the fixed-price pinned iteration the convergence
+    # criterion includes the fixed-point residual |g|, which at this short
+    # act_T decays one carry-over window at a time (near the 1/beta - 1 cap
+    # the wealth distribution mixes with time constant ~1/(1 - beta R)
+    # periods, several times act_T here)
     econ = econ.replace(act_T=800, t_discard=160, verbose=False,
-                        max_loops=15, tolerance=1e-3)
+                        max_loops=40, tolerance=1e-3)
     kwargs = dict(seed=0, sim_method="distribution", dist_count=200)
     full = solve_ks_economy(agent, econ, **kwargs)
     assert full.converged
