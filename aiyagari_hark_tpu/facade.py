@@ -328,7 +328,7 @@ class AiyagariEconomy:
             # rides alongside for weighted analytics.  Round-2 shipped the
             # support itself under "aNow", which silently broke unweighted
             # consumers (VERDICT r2 weak-item 6).
-            n_agents = int(agent.parameters.get("AgentCount", 350))
+            n_agents = int(agent.parameters["AgentCount"])
             # midpoint CDF positions: right-edge cumsum would smear every
             # bin's mass one cell left and bias the unweighted mean down
             cdf = (np.cumsum(weights) - 0.5 * weights) / weights.sum()

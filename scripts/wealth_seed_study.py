@@ -127,6 +127,7 @@ def main(argv=None):
         "config": {"n_seeds": args.n_seeds, "agent_count": agent_count,
                    "act_T": int(econ_dict["act_T"]),
                    "T_discard": int(econ_dict["T_discard"]),
+                   "mrkv_init": mrkv_init,
                    "backend": "cpu-x64"},
         "reference_goldens": REFERENCE_GOLDENS,
         "band": {},

@@ -96,7 +96,8 @@ def test_seed_zero_resimulation_reproduces_study(study):
 
     keys = jax.random.split(jax.random.PRNGKey(12345), cfg["n_seeds"])
     k_init, k_sim = jax.random.split(keys[0])
-    init = initial_panel(sol.calibration, cfg["agent_count"], 0, k_init)
+    init = initial_panel(sol.calibration, cfg["agent_count"],
+                         cfg.get("mrkv_init", 0), k_init)
     _, final = simulate_panel(sol.policy, sol.calibration,
                               jnp.asarray(sol.mrkv_hist), init, k_sim)
     assets = np.asarray(final.assets)
