@@ -52,6 +52,7 @@ class SweepResult:
     egm_iters: np.ndarray     # [C] total EGM steps across all midpoints
     dist_iters: np.ndarray    # [C] total distribution-iteration steps
     wall_seconds: float = float("nan")
+    dist_method: str = "auto"   # the distribution method that actually ran
 
     def total_work(self) -> np.ndarray:
         """Per-cell inner-loop step count (EGM + distribution iterations)."""
@@ -169,16 +170,24 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
 
     if "dist_method" not in model_kwargs:
         # Sweep-level default, distinct from stationary_wealth's "auto".
-        # On accelerators: "dense" (batched MXU matvecs).  NOT "pallas" —
-        # under a 12-wide vmap all lanes land in one kernel and the
-        # VMEM-resident design exceeds the scoped-vmem budget at compile
-        # time.  NOT "solve" — with the EGM Anderson acceleration and the
-        # stall exit in place, iterating the dense operator beats paying a
-        # (D*N)^3 LU per midpoint (measured on one TPU chip: dense 2.8s vs
-        # solve 4.8s vs the pre-stall-exit pallas 8.6s, identical r*).
-        # On CPU, "auto" (scatter) — dense/LU are the wrong trade there.
-        model_kwargs["dist_method"] = (
-            "dense" if jax.default_backend() in ("tpu", "axon") else "auto")
+        # On accelerators: "pallas" — the lane-grid kernel (one program
+        # instance per cell via the custom_vmap batching rule,
+        # ``household._pallas_fixed_point_vmappable``) lets every cell's
+        # distribution fixed point exit at its OWN convergence instead of
+        # vmap-of-while lock-step, measured 1.26 s vs dense's 2.16 s on
+        # the 12-cell sweep (one v5e chip, identical r*).  Fallback
+        # "dense" (batched MXU matvecs) when Mosaic can't compile the
+        # kernel.  NOT "solve" — with the EGM Anderson acceleration and
+        # the stall exit in place, iterating the dense operator beats
+        # paying a (D*N)^3 LU per midpoint (measured: dense 2.8s vs solve
+        # 4.8s).  On CPU, "auto" (scatter) — dense/LU/pallas are the
+        # wrong trade there.
+        if jax.default_backend() in ("tpu", "axon"):
+            from ..ops.pallas_kernels import pallas_grid_tpu_available
+            model_kwargs["dist_method"] = (
+                "pallas" if pallas_grid_tpu_available() else "dense")
+        else:
+            model_kwargs["dist_method"] = "auto"
 
     fn = _batched_solver(dtype, _hashable_kwargs(model_kwargs))
     import time
@@ -209,4 +218,5 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
         capital=K, excess=K - demand,
         bisect_iters=np.asarray(iters)[sl],
         egm_iters=np.asarray(egm_it)[sl],
-        dist_iters=np.asarray(dist_it)[sl], wall_seconds=wall)
+        dist_iters=np.asarray(dist_it)[sl], wall_seconds=wall,
+        dist_method=str(model_kwargs["dist_method"]))

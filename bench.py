@@ -277,11 +277,9 @@ def main():
         total_egm_steps * A_COUNT * LABOR_STATES / wall / max(n_devices, 1))
 
     # FLOP accounting (VERDICT r2 weak-item 1): model FLOPs from the
-    # counters, vs the chip's nominal peak.  ``kwargs`` still holds the
-    # successful attempt's settings, so the dense/scatter split is the one
-    # that actually executed.
-    dist_method = kwargs.get("dist_method") or (
-        "dense" if backend in ("tpu", "axon") else "scatter")
+    # counters, vs the chip's nominal peak.  The result records which
+    # distribution method actually executed.
+    dist_method = res.dist_method if res.dist_method != "auto" else "scatter"
     sweep_flops = _model_flops(
         total_egm_steps, float(res.dist_iters.sum()), A_COUNT, LABOR_STATES,
         DIST_COUNT, dense_dist=(dist_method in ("dense", "pallas")))

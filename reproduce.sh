@@ -4,7 +4,15 @@
 # driver is a script).  Regenerates the full reference output surface —
 # equilibrium stats, Figures/*.{png,jpg,pdf,svg}, runtime.txt, results.json —
 # and then runs the test suite.
+#
+# Test profiles (pytest.ini): the default here is the fast profile
+# (-m "not slow", ~1 min on this box); set FULL_SUITE=1 for every test
+# including the heavyweight equilibrium solves (~15-20 min single-core).
 set -e
 cd "$(dirname "$0")"
 python reproduce.py "$@"
-python -m pytest tests/ -q
+if [ "${FULL_SUITE:-0}" = "1" ]; then
+    python -m pytest tests/ -q
+else
+    python -m pytest tests/ -q -m "not slow"
+fi

@@ -80,6 +80,7 @@ def test_ks_checkpoint_roundtrip(tmp_path):
     assert not bool(ck.converged)
 
 
+@pytest.mark.slow
 def test_ks_solve_resumes_from_checkpoint(tmp_path):
     p = str(tmp_path / "ks.npz")
     timer = PhaseTimer()

@@ -18,6 +18,8 @@ from aiyagari_hark_tpu.models.labor import (
     solve_labor_equilibrium,
 )
 
+pytestmark = pytest.mark.slow   # heavyweight equilibrium solves (fast profile: -m 'not slow')
+
 ALPHA, DELTA, CRRA = 0.36, 0.08, 2.0
 
 
@@ -86,7 +88,7 @@ def test_beta_spread_round_trip(model):
     g_target = float(gini_histogram(
         model.dist_grid, population_distribution(eq).sum(axis=1)))
     cal = calibrate_beta_spread(model, g_target, 0.96, CRRA, ALPHA,
-                                DELTA)
+                                DELTA, spread_tol=1e-4)
     assert bool(cal.converged)
     np.testing.assert_allclose(float(cal.value), spread_true, atol=5e-4)
     np.testing.assert_allclose(float(cal.achieved), g_target, atol=5e-3)

@@ -15,6 +15,8 @@ from aiyagari_hark_tpu.utils.config import (
     notebook_run_configs,
 )
 
+pytestmark = pytest.mark.slow   # heavyweight equilibrium solves (fast profile: -m 'not slow')
+
 
 @pytest.fixture(scope="module")
 def parity_solution():
@@ -28,8 +30,12 @@ def test_forecast_alignment_is_exact_for_pinned_rule():
     constant, so the dynamic forecast equals exp(intercept) everywhere and
     its error against the settled path is bounded by the outer tolerance."""
     agent, econ = notebook_run_configs()
+    # tolerance 1e-3 (was 1e-4): with the residual convergence criterion
+    # the pinned solve must now drive |g| under tolerance too, and each
+    # factor of 10 costs several relaxation windows on one core; 1e-3
+    # keeps the forecast-error bound below the 0.3% assertion
     econ = econ.replace(act_T=1200, t_discard=240, verbose=False,
-                        tolerance=1e-4)
+                        tolerance=1e-3)
     sol = solve_ks_economy(agent, econ, seed=0, sim_method="distribution",
                            dist_count=300)
     st = den_haan_forecast(sol, t_start=600)

@@ -99,6 +99,7 @@ def test_distribution_is_deterministic(cal, policy, mrkv_hist):
     np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
 
 
+@pytest.mark.slow
 def test_distribution_matches_large_panel(cal, policy, mrkv_hist):
     """The histogram push-forward is the N -> infinity limit of the panel:
     with a large agent panel on the same policy and aggregate chain, the
@@ -119,6 +120,7 @@ def test_distribution_matches_large_panel(cal, policy, mrkv_hist):
     assert corr > 0.95
 
 
+@pytest.mark.slow
 def test_solve_ks_economy_distribution_method():
     """The deterministic (slope-pinned secant) equilibrium mode: converges,
     reproduces exactly, and cross-validates against the *independent*
@@ -147,6 +149,7 @@ def test_solve_ks_economy_distribution_method():
                                   np.asarray(sol2.afunc.intercept))
 
 
+@pytest.mark.slow
 def test_initial_condition_fan_and_pooled_regression(cal, policy):
     """``initial_distribution_fan`` stacks mill-consistent starts on a
     leading axis, and ``calc_afunc_update`` pools that axis into one
@@ -180,6 +183,7 @@ def test_initial_condition_fan_and_pooled_regression(cal, policy):
     assert (np.asarray(rsq) > 0.95).all()
 
 
+@pytest.mark.slow
 def test_pinned_resume_continues_secant_trajectory(tmp_path):
     """Killing a pinned run and resuming from its checkpoint reproduces the
     uninterrupted trajectory exactly — the secant memory (previous iterate,

@@ -23,6 +23,8 @@ from aiyagari_hark_tpu.models.household import (
     solve_household,
 )
 
+pytestmark = pytest.mark.slow   # heavyweight equilibrium solves (fast profile: -m 'not slow')
+
 ALPHA, DELTA, BETA = 0.36, 0.08, 0.96
 R, W = 1.03, 1.2
 

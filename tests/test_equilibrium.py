@@ -8,6 +8,9 @@ import pytest
 
 from aiyagari_hark_tpu.models.equilibrium import solve_calibration
 
+pytestmark = pytest.mark.slow   # heavyweight equilibrium solves (fast profile: -m 'not slow')
+
+
 # Reference context (BASELINE.md): the reference's KS-style run of the same
 # calibration records r* = 4.178% with 350-agent Monte Carlo noise; Aiyagari's
 # paper value is 4.09%.  Our deterministic fine-distribution solve gives

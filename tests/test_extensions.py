@@ -12,6 +12,9 @@ from aiyagari_hark_tpu.models.equilibrium import solve_calibration_lean
 from aiyagari_hark_tpu.models.ks_solver import solve_ks_economy
 from aiyagari_hark_tpu.utils.config import AgentConfig, EconomyConfig
 
+pytestmark = pytest.mark.slow   # heavyweight equilibrium solves (fast profile: -m 'not slow')
+
+
 # Classic Krusell-Smith (1998) calibration: bad state has lower TFP and
 # 10% unemployment, good state 4%.
 KS_ECON = EconomyConfig(labor_states=3, act_T=600, t_discard=100,

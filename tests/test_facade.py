@@ -121,6 +121,7 @@ def test_cfunc_accepts_array_M(solved):
     np.testing.assert_allclose(paired, scalar, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_agent_level_crra_discfac_honored():
     """CRRA/DiscFac set only on AiyagariType must reach the solver instead of
     the economy default (VERDICT r1 weak-item 5)."""
@@ -144,6 +145,7 @@ def test_agent_economy_conflict_raises():
         economy._economy_config_for(agent)
 
 
+@pytest.mark.slow
 def test_solve_distribution_method_through_facade():
     """sim_method='distribution' flows through the facade: the result
     surface carries the wealth histogram as (support, weights) and the

@@ -20,6 +20,9 @@ from aiyagari_hark_tpu.models.heterogeneity import (
 from aiyagari_hark_tpu.models.household import build_simple_model
 from aiyagari_hark_tpu.utils.stats import get_lorenz_shares, gini
 
+pytestmark = pytest.mark.slow   # heavyweight equilibrium solves (fast profile: -m 'not slow')
+
+
 ALPHA, DELTA, CRRA, BETA = 0.36, 0.08, 2.0, 0.96
 
 

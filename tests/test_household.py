@@ -132,6 +132,7 @@ def test_impatience_supply_rises_with_r(model):
     assert supplies[1] > supplies[0]
 
 
+@pytest.mark.slow
 def test_stationary_methods_agree(model, prices, solved):
     """The three distribution-iteration backends — scatter (CPU), dense
     operator (MXU matvecs), and the Pallas VMEM-resident kernel (interpret
@@ -176,6 +177,7 @@ def test_dense_operator_is_push_forward(model, prices, solved):
                                np.asarray(one_scatter), atol=1e-12)
 
 
+@pytest.mark.slow
 def test_pallas_kernel_under_vmap():
     """The sweep vmaps the whole cell solve; the Pallas fixed-point kernel
     must survive that transformation (interpret mode on CPU)."""
@@ -205,6 +207,31 @@ def test_pallas_kernel_under_vmap():
     serial = jnp.stack([solve_at(rs[0]), solve_at(rs[1])])
     np.testing.assert_allclose(np.asarray(batched), np.asarray(serial),
                                rtol=1e-8)
+
+
+@pytest.mark.slow
+def test_pallas_lane_grid_dispatch_under_vmap():
+    """``stationary_wealth(method='pallas')`` under vmap must reroute
+    through the custom_vmap batching rule to the LANE-GRID kernel (one
+    program instance per lane — the round-3 change that lets the Table II
+    sweep use Pallas at all) and agree with the serial scatter oracle."""
+    from aiyagari_hark_tpu.models.household import stationary_wealth
+
+    m = build_simple_model(labor_states=3, a_count=12, dist_count=40)
+
+    def dist_at(r, method):
+        k_to_l = firm.k_to_l_from_r(r, ALPHA, DELTA)
+        W = firm.wage_rate(k_to_l, ALPHA)
+        pol, _, _ = solve_household(1.0 + r, W, m, DISC, CRRA)
+        d, _, _ = stationary_wealth(pol, 1.0 + r, W, m, tol=1e-10,
+                                    method=method)
+        return d
+
+    rs = jnp.array([0.02, 0.03, 0.035])
+    batched = jax.vmap(lambda r: dist_at(r, "pallas"))(rs)
+    serial = jnp.stack([dist_at(r, "scatter") for r in rs])
+    np.testing.assert_allclose(np.asarray(batched), np.asarray(serial),
+                               atol=1e-8)
 
 
 @pytest.mark.skipif(

@@ -17,6 +17,9 @@ from aiyagari_hark_tpu.models.household import (
 )
 from aiyagari_hark_tpu.models.huggett import solve_huggett_equilibrium
 
+pytestmark = pytest.mark.slow   # heavyweight equilibrium solves (fast profile: -m 'not slow')
+
+
 BETA, CRRA = 0.96, 2.0
 
 

@@ -25,6 +25,8 @@ from aiyagari_hark_tpu.models.transition import (
     solve_transition,
 )
 
+pytestmark = pytest.mark.slow   # heavyweight equilibrium solves (fast profile: -m 'not slow')
+
 ALPHA, DELTA, BETA, CRRA = 0.36, 0.08, 0.96, 2.0
 HORIZON = 50
 

@@ -31,6 +31,9 @@ import pytest
 from aiyagari_hark_tpu.models.ks_solver import solve_ks_economy
 from aiyagari_hark_tpu.utils.config import AgentConfig, EconomyConfig
 
+pytestmark = pytest.mark.slow   # heavyweight equilibrium solves (fast profile: -m 'not slow')
+
+
 KS_SLOPE_GOOD = 0.962     # Krusell-Smith (1998), good-state law
 SLOPE_TOL = 0.02          # discretization/estimator differences
 R2_FLOOR = 0.999          # approximate aggregation (KS report 0.999998)

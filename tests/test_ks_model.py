@@ -104,6 +104,7 @@ def test_markov_history_properties(cal):
     assert 4 < len(h) / max(switches, 1) < 16
 
 
+@pytest.mark.slow
 def test_panel_simulation_runs_and_is_stationary(cal, afunc):
     policy, _, _ = solve_ks_household(afunc, cal)
     hist = simulate_markov_history(cal.agg_transition, 0, 500,
@@ -120,6 +121,7 @@ def test_panel_simulation_runs_and_is_stationary(cal, afunc):
     assert 1.0 < A[-100:].mean() < 12.0
 
 
+@pytest.mark.slow
 def test_seed_reproducibility(cal, afunc):
     """Fixes reference quirk §3.6-3: identical seeds -> identical histories."""
     policy, _, _ = solve_ks_household(afunc, cal)
@@ -133,6 +135,7 @@ def test_seed_reproducibility(cal, afunc):
     assert not np.array_equal(np.asarray(a1), np.asarray(a3))
 
 
+@pytest.mark.slow
 def test_outer_loop_converges_short_horizon():
     agent, econ = notebook_run_configs()
     agent = agent.replace(agent_count=140)

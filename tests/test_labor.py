@@ -20,6 +20,9 @@ from aiyagari_hark_tpu.models.labor import (
 )
 from aiyagari_hark_tpu.ops.utility import marginal_utility
 
+pytestmark = pytest.mark.slow   # heavyweight equilibrium solves (fast profile: -m 'not slow')
+
+
 ALPHA, DELTA, BETA, CRRA = 0.36, 0.08, 0.96, 2.0
 R, W = 1.03, 1.2
 

@@ -23,6 +23,8 @@ from aiyagari_hark_tpu.models.lifecycle import (
     solve_lifecycle,
 )
 
+pytestmark = pytest.mark.slow   # heavyweight equilibrium solves (fast profile: -m 'not slow')
+
 
 @pytest.fixture(scope="module")
 def model():

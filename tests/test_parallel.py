@@ -25,6 +25,9 @@ from aiyagari_hark_tpu.parallel import (
 )
 from aiyagari_hark_tpu.utils.config import AgentConfig, EconomyConfig, SweepConfig
 
+pytestmark = pytest.mark.slow   # heavyweight equilibrium solves (fast profile: -m 'not slow')
+
+
 SMALL_SWEEP = SweepConfig(crra_values=(1.0, 3.0), rho_values=(0.3, 0.6))
 SMALL_KW = dict(a_count=16, dist_count=64, labor_states=5)
 

@@ -24,6 +24,8 @@ from aiyagari_hark_tpu.models.portfolio import (
     stationary_portfolio_wealth,
 )
 
+pytestmark = pytest.mark.slow   # heavyweight equilibrium solves (fast profile: -m 'not slow')
+
 R_FREE = 1.02
 WAGE = 1.0
 BETA = 0.96

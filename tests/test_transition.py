@@ -14,6 +14,9 @@ from aiyagari_hark_tpu.models.equilibrium import solve_bisection_equilibrium
 from aiyagari_hark_tpu.models.household import build_simple_model
 from aiyagari_hark_tpu.models.transition import solve_transition
 
+pytestmark = pytest.mark.slow   # heavyweight equilibrium solves (fast profile: -m 'not slow')
+
+
 ALPHA, DELTA, BETA, CRRA = 0.36, 0.08, 0.96, 2.0
 
 

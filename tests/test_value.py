@@ -37,6 +37,7 @@ def stochastic_case():
     return model, policy, vf, R, W, beta, crra
 
 
+@pytest.mark.slow
 def test_log_utility_closed_form():
     """With log utility and no labor income (W=0), the problem is
     cake-eating with return R: c = (1-beta) m exactly, and
@@ -121,6 +122,7 @@ def test_value_increasing_and_monotone_in_state(stochastic_case):
     assert (v_high > v_low).all()
 
 
+@pytest.mark.slow
 def test_aggregate_welfare_and_consumption_equivalent(stochastic_case):
     model, policy, vf, R, W, beta, crra = stochastic_case
     dist, _, _ = stationary_wealth(policy, R, W, model)
@@ -161,6 +163,7 @@ def test_consumption_equivalent_log_branch():
         float(consumption_equivalent(v, v_alt, 3.0, beta)), rtol=1e-8)
 
 
+@pytest.mark.slow
 def test_welfare_sweepable_under_jit_and_vmap(stochastic_case):
     """The whole recovery + welfare path compiles with traced scalars —
     welfare rides the Table II sweep like everything else."""
