@@ -36,6 +36,13 @@ from .models.epstein_zin import (  # noqa: F401
     solve_ez_equilibrium,
     solve_ez_household,
 )
+from .models.fiscal import (  # noqa: F401
+    FiscalEquilibrium,
+    build_fiscal_model,
+    progressive_labor_levels,
+    redistributive_labor_levels,
+    solve_fiscal_equilibrium,
+)
 from .models.heterogeneity import (  # noqa: F401
     HeterogeneousEquilibrium,
     population_distribution,

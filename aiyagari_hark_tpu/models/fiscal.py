@@ -1,0 +1,118 @@
+"""Fiscal redistribution in the Aiyagari economy: revenue-neutral labor
+taxation as a general-equilibrium experiment.
+
+Beyond the reference (which has no government), but built entirely on the
+reference-parity machinery: the key observation is that both canonical
+balanced-budget schemes are STATIC relabelings of the labor states, so the
+whole equilibrium stack (EGM, stationary distribution, bisection, sweeps,
+welfare) applies unchanged:
+
+- **Linear tax + lump-sum transfer** (tax rate ``tau``, transfer
+  ``T = tau * W * L_bar``): post-fiscal earnings
+  ``(1-tau) W l_s + T = W ((1-tau) l_s + tau L_bar)`` — a mean-preserving
+  compression of the labor levels toward ``L_bar``.
+- **HSV progressivity** (Heathcote-Storesletten-Violante 2017: post-tax
+  earnings ``lambda (W l)^(1-p)`` with ``lambda`` set for revenue
+  neutrality at equilibrium prices): the wage factors cancel,
+  ``y_eff = W * L_bar * l^(1-p) / E[l^(1-p)]`` — again a static,
+  mean-preserving compression, for ANY equilibrium W.
+
+Because both transforms preserve the stationary mean of labor, the firm's
+labor input ``aggregate_labor(model)`` is unchanged and the government
+budget balances identically at every interest rate the bisection visits —
+no extra fixed point.
+
+Economics these experiments expose (tested): redistribution insures
+idiosyncratic risk, so precautionary saving falls, capital supply shifts
+in, and the equilibrium interest rate RISES toward the complete-markets
+1/beta - 1 (Aiyagari 1994 §III's mechanism run in reverse); utilitarian
+welfare trades that crowding-out against the insurance gain.
+
+Reference anchor: the machinery reused here is the reference's Aiyagari
+stack (SURVEY.md §1 L4); the reference itself has no fiscal block.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from .equilibrium import EquilibriumResult, solve_bisection_equilibrium
+from .household import SimpleModel, aggregate_labor, build_simple_model
+
+
+def redistributive_labor_levels(labor_levels, stationary, tax_rate):
+    """Post-fiscal labor levels under a linear tax + lump-sum transfer:
+    ``(1-tau) l + tau L_bar`` (mean-preserving compression toward the
+    stationary mean).  ``tax_rate`` may be a traced scalar (sweep axis)."""
+    l_bar = jnp.sum(stationary * labor_levels)
+    return (1.0 - tax_rate) * labor_levels + tax_rate * l_bar
+
+
+def progressive_labor_levels(labor_levels, stationary, progressivity):
+    """Post-fiscal labor levels under revenue-neutral HSV progressivity:
+    ``L_bar * l^(1-p) / E[l^(1-p)]``.  ``p=0`` is the identity; ``p=1``
+    full pooling.  ``progressivity`` may be a traced scalar."""
+    l_bar = jnp.sum(stationary * labor_levels)
+    compressed = labor_levels ** (1.0 - progressivity)
+    return l_bar * compressed / jnp.sum(stationary * compressed)
+
+
+class FiscalEquilibrium(NamedTuple):
+    """Equilibrium of the fiscal economy plus the fiscal-account readout."""
+
+    equilibrium: EquilibriumResult
+    model: SimpleModel            # the transformed (post-fiscal) model
+    tax_rate: jnp.ndarray         # linear rate (0 when using progressivity)
+    progressivity: jnp.ndarray    # HSV p (0 when using the linear scheme)
+    transfer: jnp.ndarray         # lump-sum transfer at equilibrium prices
+    revenue: jnp.ndarray          # tax revenue (= transfer: balanced)
+    post_tax_income_sd: jnp.ndarray   # sd of post-fiscal earnings / W
+
+
+def build_fiscal_model(tax_rate=0.0, progressivity=0.0,
+                       **model_kwargs) -> SimpleModel:
+    """An Aiyagari model whose labor levels carry the balanced-budget
+    fiscal transform.  Exactly one of ``tax_rate``/``progressivity`` should
+    be nonzero (they compose mathematically, but calibrations don't)."""
+    base = build_simple_model(**model_kwargs)
+    levels = redistributive_labor_levels(base.labor_levels,
+                                         base.labor_stationary, tax_rate)
+    levels = progressive_labor_levels(levels, base.labor_stationary,
+                                      progressivity)
+    return base._replace(labor_levels=levels)
+
+
+def solve_fiscal_equilibrium(disc_fac, crra, cap_share, depr_fac,
+                             tax_rate=0.0, progressivity=0.0,
+                             prod: float = 1.0,
+                             **kwargs) -> FiscalEquilibrium:
+    """General equilibrium of the fiscal economy (bisection engine on the
+    transformed model) with the fiscal accounts evaluated at equilibrium
+    prices.  Extra kwargs split between ``build_simple_model`` sizes and
+    solver settings the same way ``models.equilibrium._solve_cell`` does —
+    pass grid settings (``a_count=...``) or solver tolerances."""
+    model_keys = ("labor_states", "labor_ar", "labor_sd", "labor_bound",
+                  "a_min", "a_max", "a_count", "a_nest_fac", "dist_count",
+                  "borrow_limit", "dtype")
+    model_kwargs = {k: kwargs.pop(k) for k in list(kwargs)
+                    if k in model_keys}
+    model = build_fiscal_model(tax_rate=tax_rate,
+                               progressivity=progressivity, **model_kwargs)
+    eq = solve_bisection_equilibrium(model, disc_fac, crra, cap_share,
+                                     depr_fac, prod=prod, **kwargs)
+    # fiscal accounts at equilibrium prices (pre-tax labor aggregates are
+    # invariant to the transform, so eq.wage IS the untransformed
+    # economy's wage)
+    W = eq.wage
+    l_bar = aggregate_labor(model)        # == pre-tax mean by construction
+    revenue = tax_rate * W * l_bar
+    pi = model.labor_stationary
+    mean_l = jnp.sum(pi * model.labor_levels)
+    sd_l = jnp.sqrt(jnp.sum(pi * (model.labor_levels - mean_l) ** 2))
+    return FiscalEquilibrium(
+        equilibrium=eq, model=model,
+        tax_rate=jnp.asarray(tax_rate),
+        progressivity=jnp.asarray(progressivity),
+        transfer=revenue, revenue=revenue, post_tax_income_sd=sd_l)

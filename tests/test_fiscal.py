@@ -1,0 +1,132 @@
+"""Fiscal redistribution (models/fiscal.py): revenue-neutral labor
+taxation as a static relabeling of the labor states.
+
+Oracles: exact identities of the balanced-budget transforms (mean
+preservation, risk compression, limiting cases) plus Aiyagari's own
+general-equilibrium mechanism run in reverse — redistribution insures
+idiosyncratic risk, precautionary saving falls, and r* rises toward the
+complete-markets 1/beta - 1 — and the classic hump-shaped utilitarian
+welfare (insurance gains vs capital crowding-out) with an interior
+optimum.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.models.fiscal import (
+    build_fiscal_model,
+    progressive_labor_levels,
+    redistributive_labor_levels,
+    solve_fiscal_equilibrium,
+)
+from aiyagari_hark_tpu.models.household import (
+    aggregate_labor,
+    build_simple_model,
+)
+
+CFG = dict(labor_states=5, labor_ar=0.6, labor_sd=0.3, a_count=24,
+           dist_count=120)
+BETA, CRRA, ALPHA, DELTA = 0.96, 2.0, 0.36, 0.08
+
+
+def _sd(levels, pi):
+    m = float(jnp.sum(pi * levels))
+    return float(jnp.sqrt(jnp.sum(pi * (levels - m) ** 2)))
+
+
+def test_transforms_preserve_mean_and_compress_risk():
+    base = build_simple_model(**CFG)
+    pi = base.labor_stationary
+    l_bar = float(jnp.sum(pi * base.labor_levels))
+    for tau in (0.0, 0.2, 0.7, 1.0):
+        lev = redistributive_labor_levels(base.labor_levels, pi, tau)
+        assert float(jnp.sum(pi * lev)) == pytest.approx(l_bar, rel=1e-12)
+        assert _sd(lev, pi) == pytest.approx(
+            (1.0 - tau) * _sd(base.labor_levels, pi), rel=1e-10)
+    for p in (0.0, 0.18, 0.5, 1.0):
+        lev = progressive_labor_levels(base.labor_levels, pi, p)
+        assert float(jnp.sum(pi * lev)) == pytest.approx(l_bar, rel=1e-12)
+    # limits: tau=0 / p=0 identity; tau=1 / p=1 full pooling at the mean
+    np.testing.assert_allclose(
+        redistributive_labor_levels(base.labor_levels, pi, 0.0),
+        base.labor_levels)
+    np.testing.assert_allclose(
+        np.asarray(redistributive_labor_levels(base.labor_levels, pi, 1.0)),
+        l_bar, rtol=1e-12)
+    np.testing.assert_allclose(
+        np.asarray(progressive_labor_levels(base.labor_levels, pi, 1.0)),
+        l_bar, rtol=1e-12)
+
+
+def test_fiscal_model_keeps_firm_side_labor():
+    """The firm's labor input must be invariant to the transform (this is
+    what makes the budget balance at every bisection midpoint)."""
+    base = build_simple_model(**CFG)
+    for kwargs in (dict(tax_rate=0.3), dict(progressivity=0.4)):
+        fm = build_fiscal_model(**kwargs, **CFG)
+        np.testing.assert_allclose(float(aggregate_labor(fm)),
+                                   float(aggregate_labor(base)), rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(fm.a_grid),
+                                   np.asarray(base.a_grid))
+
+
+@pytest.mark.slow
+def test_redistribution_raises_equilibrium_rate():
+    """Aiyagari's mechanism in reverse: compressing income risk reduces
+    precautionary saving, so r* rises monotonically toward 1/beta - 1 and
+    capital falls; the budget balances and markets clear at every tau.
+    Measured at this config: r* 3.633% -> 3.795% -> 3.923% -> 4.046% for
+    tau in {0, .15, .3, .5} against the 4.167% complete-markets cap."""
+    r_cap = 1.0 / BETA - 1.0
+    prev_r, prev_k = -1.0, np.inf
+    for tau in (0.0, 0.15, 0.3, 0.5):
+        feq = solve_fiscal_equilibrium(BETA, CRRA, ALPHA, DELTA,
+                                       tax_rate=tau, **CFG)
+        eq = feq.equilibrium
+        r = float(eq.r_star)
+        assert abs(float(eq.excess)) < 1e-6
+        assert prev_r < r < r_cap
+        assert float(eq.capital) < prev_k
+        # balanced budget: transfer == tau * W * L_bar, with L_bar the
+        # UNtransformed aggregate labor
+        W = float(eq.wage)
+        l_bar = float(aggregate_labor(feq.model))
+        assert float(feq.transfer) == pytest.approx(tau * W * l_bar,
+                                                    rel=1e-10)
+        prev_r, prev_k = r, float(eq.capital)
+    # HSV progressivity moves the same direction
+    feq_p = solve_fiscal_equilibrium(BETA, CRRA, ALPHA, DELTA,
+                                     progressivity=0.18, **CFG)
+    feq_0 = solve_fiscal_equilibrium(BETA, CRRA, ALPHA, DELTA, **CFG)
+    assert float(feq_p.equilibrium.r_star) > float(feq_0.equilibrium.r_star)
+
+
+@pytest.mark.slow
+def test_utilitarian_welfare_is_hump_shaped():
+    """The optimal-redistribution trade-off: moderate taxation raises
+    utilitarian welfare (insurance of uninsurable risk) but heavy taxation
+    crowds out capital enough to reverse the gain — an interior optimum.
+    Measured CE vs laissez-faire at this config: +0.157% (tau=.15),
+    +0.250% (tau=.3), +0.180% (tau=.6)."""
+    from aiyagari_hark_tpu.models.value import (
+        aggregate_welfare,
+        consumption_equivalent,
+        policy_value,
+    )
+
+    welf = {}
+    for tau in (0.0, 0.3, 0.6):
+        feq = solve_fiscal_equilibrium(BETA, CRRA, ALPHA, DELTA,
+                                       tax_rate=tau, **CFG)
+        eq = feq.equilibrium
+        R = 1.0 + eq.r_star
+        vf, _, _ = policy_value(eq.policy, R, eq.wage, feq.model, BETA,
+                                CRRA)
+        welf[tau] = float(aggregate_welfare(vf, eq.distribution, R,
+                                            eq.wage, feq.model, CRRA))
+    ce_30 = float(consumption_equivalent(welf[0.0], welf[0.3], CRRA, BETA))
+    ce_60 = float(consumption_equivalent(welf[0.0], welf[0.6], CRRA, BETA))
+    assert ce_30 > 0.001           # insurance gain, > 0.1% CE
+    assert ce_60 < ce_30           # crowding-out bends the hump down
+    assert ce_60 > -0.01           # but moderate enough not to collapse
