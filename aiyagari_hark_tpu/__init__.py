@@ -38,10 +38,12 @@ from .models.epstein_zin import (  # noqa: F401
 )
 from .models.fiscal import (  # noqa: F401
     FiscalEquilibrium,
+    TaxSweepResult,
     build_fiscal_model,
     progressive_labor_levels,
     redistributive_labor_levels,
     solve_fiscal_equilibrium,
+    tax_rate_sweep,
 )
 from .models.heterogeneity import (  # noqa: F401
     HeterogeneousEquilibrium,

@@ -34,12 +34,25 @@ stack (SURVEY.md §1 L4); the reference itself has no fiscal block.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 from .equilibrium import EquilibriumResult, solve_bisection_equilibrium
 from .household import SimpleModel, aggregate_labor, build_simple_model
+
+_MODEL_KEYS = ("labor_states", "labor_ar", "labor_sd", "labor_bound",
+               "a_min", "a_max", "a_count", "a_nest_fac", "dist_count",
+               "borrow_limit", "dtype")
+
+
+def _split_model_kwargs(kwargs: dict) -> dict:
+    """Pop ``build_simple_model`` settings out of a mixed kwargs dict,
+    leaving solver settings (r_tol, max_bisect, ...) behind — the same
+    split ``models.equilibrium._solve_cell`` encodes in its signature."""
+    return {k: kwargs.pop(k) for k in list(kwargs) if k in _MODEL_KEYS}
 
 
 def redistributive_labor_levels(labor_levels, stationary, tax_rate):
@@ -84,6 +97,85 @@ def build_fiscal_model(tax_rate=0.0, progressivity=0.0,
     return base._replace(labor_levels=levels)
 
 
+class TaxSweepResult(NamedTuple):
+    """Per-rate equilibrium outcomes of a vmapped tax sweep, [T]-leading."""
+
+    tax_rates: jnp.ndarray
+    r_star: jnp.ndarray
+    capital: jnp.ndarray
+    welfare: jnp.ndarray          # utilitarian E[v] at each equilibrium
+
+
+@functools.lru_cache(maxsize=None)
+def _batched_tax_solver(disc_fac, crra, cap_share, depr_fac, prod,
+                        with_welfare, model_items, solver_items):
+    """Jitted vmapped (GE + welfare) lane solver, memoized on the static
+    configuration so refining the tax grid (or re-calling with identical
+    settings) hits the jit cache instead of recompiling the whole batched
+    program — the `parallel.sweep._batched_solver` pattern."""
+    from .equilibrium import solve_equilibrium_lean
+    from .value import aggregate_welfare, policy_value
+
+    base = build_simple_model(**dict(model_items))
+    solver_kwargs = dict(solver_items)
+
+    def solve_one(tau):
+        model = base._replace(labor_levels=redistributive_labor_levels(
+            base.labor_levels, base.labor_stationary, tau))
+        if not with_welfare:
+            # scalars-only solver: the same small compiled program the
+            # Table II sweep uses (no post-loop policy/distribution
+            # re-solve per lane)
+            lean = solve_equilibrium_lean(model, disc_fac, crra, cap_share,
+                                          depr_fac, prod=prod,
+                                          **solver_kwargs)
+            return (lean.r_star, lean.capital,
+                    jnp.full_like(lean.r_star, jnp.nan))
+        eq = solve_bisection_equilibrium(model, disc_fac, crra, cap_share,
+                                         depr_fac, prod=prod,
+                                         **solver_kwargs)
+        R = 1.0 + eq.r_star
+        vf, _, _ = policy_value(eq.policy, R, eq.wage, model, disc_fac,
+                                crra)
+        w = aggregate_welfare(vf, eq.distribution, R, eq.wage, model, crra)
+        return eq.r_star, eq.capital, w
+
+    return jax.jit(jax.vmap(solve_one))
+
+
+def tax_rate_sweep(tax_rates, disc_fac, crra, cap_share, depr_fac,
+                   prod: float = 1.0, with_welfare: bool = True,
+                   **kwargs) -> TaxSweepResult:
+    """The optimal-redistribution search as ONE batched XLA program: vmap
+    whole general-equilibrium solves (plus the welfare recovery) over the
+    tax-rate axis — the same lanes-are-cheap thesis as the Table II sweep
+    (`parallel.sweep`), applied to a policy question the reference could
+    never ask.  The welfare curve is hump-shaped (see
+    ``tests/test_fiscal.py``), so its argmax is the optimal linear
+    redistribution rate at this calibration.  Extra kwargs split between
+    ``build_simple_model`` sizes and solver settings (r_tol, max_bisect,
+    ...) like ``solve_fiscal_equilibrium``.
+
+    ``with_welfare=False`` skips the vmapped value recovery (welfare
+    comes back NaN): the rate/capital sweep then compiles like the
+    Table II sweep.  Measured on the v5e: the full welfare program's XLA
+    compile did not complete within a 10-minute budget (the vmapped
+    value-iteration while_loop on top of the nested bisection), so on
+    TPU prefer the lean sweep + serial welfare at the argmax
+    neighborhood; on CPU the full program compiles and runs in ~30 s at
+    test sizes."""
+    from ..parallel.sweep import _hashable_kwargs
+
+    model_kwargs = _split_model_kwargs(kwargs)
+    fn = _batched_tax_solver(disc_fac, crra, cap_share, depr_fac, prod,
+                             bool(with_welfare),
+                             _hashable_kwargs(model_kwargs),
+                             _hashable_kwargs(kwargs))
+    taus = jnp.asarray(tax_rates)
+    r, k, w = fn(taus)
+    return TaxSweepResult(tax_rates=taus, r_star=r, capital=k, welfare=w)
+
+
 def solve_fiscal_equilibrium(disc_fac, crra, cap_share, depr_fac,
                              tax_rate=0.0, progressivity=0.0,
                              prod: float = 1.0,
@@ -93,11 +185,7 @@ def solve_fiscal_equilibrium(disc_fac, crra, cap_share, depr_fac,
     prices.  Extra kwargs split between ``build_simple_model`` sizes and
     solver settings the same way ``models.equilibrium._solve_cell`` does —
     pass grid settings (``a_count=...``) or solver tolerances."""
-    model_keys = ("labor_states", "labor_ar", "labor_sd", "labor_bound",
-                  "a_min", "a_max", "a_count", "a_nest_fac", "dist_count",
-                  "borrow_limit", "dtype")
-    model_kwargs = {k: kwargs.pop(k) for k in list(kwargs)
-                    if k in model_keys}
+    model_kwargs = _split_model_kwargs(kwargs)
     model = build_fiscal_model(tax_rate=tax_rate,
                                progressivity=progressivity, **model_kwargs)
     eq = solve_bisection_equilibrium(model, disc_fac, crra, cap_share,

@@ -103,6 +103,37 @@ def test_redistribution_raises_equilibrium_rate():
 
 
 @pytest.mark.slow
+def test_tax_sweep_is_one_batched_program():
+    """``tax_rate_sweep`` vmaps whole GE solves + welfare recovery over
+    the tax axis; lanes must agree with serial solves, and the welfare
+    argmax sits in the interior (measured optimum tau* = 0.4 on this
+    grid at this calibration)."""
+    from aiyagari_hark_tpu.models.fiscal import tax_rate_sweep
+    from aiyagari_hark_tpu.models.value import (
+        aggregate_welfare,
+        policy_value,
+    )
+
+    taus = np.linspace(0.0, 0.6, 7)
+    res = tax_rate_sweep(taus, BETA, CRRA, ALPHA, DELTA, **CFG)
+    # lane 3 (tau=0.3) vs the serial path
+    feq = solve_fiscal_equilibrium(BETA, CRRA, ALPHA, DELTA, tax_rate=0.3,
+                                   **CFG)
+    assert float(res.r_star[3]) == pytest.approx(
+        float(feq.equilibrium.r_star), abs=1e-8)
+    eq = feq.equilibrium
+    vf, _, _ = policy_value(eq.policy, 1.0 + eq.r_star, eq.wage, feq.model,
+                            BETA, CRRA)
+    w_serial = float(aggregate_welfare(vf, eq.distribution, 1.0 + eq.r_star,
+                                       eq.wage, feq.model, CRRA))
+    assert float(res.welfare[3]) == pytest.approx(w_serial, rel=1e-8)
+    # interior optimum on the hump
+    i = int(np.argmax(np.asarray(res.welfare)))
+    assert 0 < i < len(taus) - 1
+    assert float(res.tax_rates[i]) == pytest.approx(0.4, abs=0.101)
+
+
+@pytest.mark.slow
 def test_utilitarian_welfare_is_hump_shaped():
     """The optimal-redistribution trade-off: moderate taxation raises
     utilitarian welfare (insurance of uninsurable risk) but heavy taxation
