@@ -430,16 +430,13 @@ def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
         fits_vmem = op_bytes <= 8 * 2 ** 20
         fits_hbm = op_bytes <= 2 ** 31   # dense operator must be buildable
         if on_tpu and fits_vmem:
-            # require BOTH kernel probes: an "auto" caller may be vmapped
-            # later (the sweep), where the custom_vmap rule dispatches the
-            # lane-GRID kernel — choosing pallas on the single-lane probe
-            # alone could pass here and die at sweep compile time
-            from ..ops.pallas_kernels import (
-                pallas_grid_tpu_available,
-                pallas_tpu_available,
-            )
-            method = ("pallas" if pallas_tpu_available()
-                      and pallas_grid_tpu_available() else "dense")
+            # probe the lane-GRID kernel (which subsumes the single-lane
+            # probe): an "auto" caller may be vmapped later (the sweep),
+            # where the custom_vmap rule dispatches the grid kernel —
+            # passing on the single-lane probe alone could die at sweep
+            # compile time
+            from ..ops.pallas_kernels import pallas_grid_tpu_available
+            method = ("pallas" if pallas_grid_tpu_available() else "dense")
         elif on_tpu and fits_hbm:
             method = "dense"
         else:
