@@ -25,9 +25,11 @@ from .models.equilibrium import (  # noqa: F401
 )
 from .models.calibrate import (  # noqa: F401
     CalibrationResult,
+    LorenzFit,
     calibrate_beta_spread,
     calibrate_discount_factor,
     calibrate_labor_weight,
+    calibrate_spread_to_lorenz,
 )
 from .models.epstein_zin import (  # noqa: F401
     EZEquilibrium,
