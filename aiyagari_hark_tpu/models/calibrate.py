@@ -98,7 +98,13 @@ def gini_histogram(grid, masses):
                                                    jnp.finfo(w.dtype).tiny)])
     area = jnp.sum(0.5 * (cum_wealth[1:] + cum_wealth[:-1])
                    * jnp.diff(cum_pop))
-    return 1.0 - 2.0 * area
+    # NEGATIVE aggregate wealth (possible with borrow_limit < 0) would ride
+    # the same floor and return an astronomically scaled non-number-like
+    # Gini; the standard coefficient is undefined there, so report NaN
+    # explicitly (callers that bisect on Gini target nonnegative-wealth
+    # economies; a NaN marks the config as out of the measure's domain
+    # rather than smuggling in a garbage magnitude — round-3 review)
+    return jnp.where(cw[-1] < 0, jnp.nan, 1.0 - 2.0 * area)
 
 
 def calibrate_beta_spread(model: SimpleModel, target_gini, center, crra,

@@ -350,12 +350,38 @@ def _pallas_fixed_point_vmappable(tol: float, max_iter: int,
     on straggler-skewed sweeps (12-cell Table II sweep end-to-end:
     1.85 s vs 2.75 s on one v5e chip; measurement notes in
     ``scripts/pallas_ab.py`` and DESIGN §4).
-    One level of batching only — a doubly-vmapped call fails on shapes.
+    Nested batching (e.g. ``heterogeneity``'s beta-dist sweep vmapped over
+    cells) is handled by the grid dispatch's OWN batching rule, which
+    collapses each extra batch axis into the lane axis — a doubly-vmapped
+    caller runs one flat lane grid instead of dying at Mosaic compile time
+    on a ``vmap``-batched ``pallas_call`` whose grid rank no longer
+    matches its dimension semantics (round-3 review).
     """
     from ..ops.pallas_kernels import (
         stationary_dense_pallas,
         stationary_dense_pallas_grid,
     )
+
+    def _bcast(axis_size, in_batched, *args):
+        return tuple(a if b else jnp.broadcast_to(a, (axis_size,) + a.shape)
+                     for b, a in zip(in_batched, args))
+
+    @jax.custom_batching.custom_vmap
+    def fp_grid(S, P, d0):
+        return stationary_dense_pallas_grid(S, P, d0, tol, max_iter,
+                                            accel_every)
+
+    @fp_grid.def_vmap
+    def _grid_batched(axis_size, in_batched, S, P, d0):  # noqa: ANN001
+        S, P, d0 = _bcast(axis_size, in_batched, S, P, d0)
+        b, c = S.shape[0], S.shape[1]
+        dist, iters, diffs = fp_grid(
+            S.reshape((b * c,) + S.shape[2:]),
+            P.reshape((b * c,) + P.shape[2:]),
+            d0.reshape((b * c,) + d0.shape[2:]))
+        return ((dist.reshape((b, c) + dist.shape[1:]),
+                 iters.reshape(b, c), diffs.reshape(b, c)),
+                (True, True, True))
 
     @jax.custom_batching.custom_vmap
     def fp(S, P, d0):
@@ -363,16 +389,8 @@ def _pallas_fixed_point_vmappable(tol: float, max_iter: int,
 
     @fp.def_vmap
     def _batched(axis_size, in_batched, S, P, d0):  # noqa: ANN001
-        s_b, p_b, d_b = in_batched
-        if not s_b:
-            S = jnp.broadcast_to(S, (axis_size,) + S.shape)
-        if not p_b:
-            P = jnp.broadcast_to(P, (axis_size,) + P.shape)
-        if not d_b:
-            d0 = jnp.broadcast_to(d0, (axis_size,) + d0.shape)
-        out = stationary_dense_pallas_grid(S, P, d0, tol, max_iter,
-                                           accel_every)
-        return out, (True, True, True)
+        S, P, d0 = _bcast(axis_size, in_batched, S, P, d0)
+        return fp_grid(S, P, d0), (True, True, True)
 
     return fp
 

@@ -155,7 +155,8 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
     output has materialized on the host (``np.asarray``), because through
     the tunneled TPU ``block_until_ready`` alone does not reliably block
     for XLA executables.  Benchmark callers should also pass a tiny
-    ``perturb`` (added to the ρ inputs, e.g. 1e-9) on the timed call so
+    ``perturb`` (added to the ρ inputs, e.g. 1e-6 — it must survive the
+    f32 cast: f32 spacing at ρ=0.3 is ~3e-8) on the timed call so
     an identical-execution cache anywhere in the stack cannot serve the
     warm-up run's results — same compiled program, same fixed point to
     within the perturbation (methodology of ``scripts/pallas_ab.py``).

@@ -55,12 +55,20 @@ def _canonical_treedef(s: str) -> str:
     return re.sub(r"namedtuple\[\w+\]", "namedtuple[_]", s)
 
 
-def load_pytree(path: str, like):
+def load_pytree(path: str, like, strict: bool = True):
     """Read a pytree saved by ``save_pytree`` into the structure of ``like``
-    (validated structurally against the stored treedef — NamedTuple class
-    names are ignored, see ``_canonical_treedef``; leaf shapes/dtypes come
-    from the file).  Leaf keys are ordered numerically by their index, so
-    the count is unbounded (no lexicographic rollover at 4 digits)."""
+    (validated against the stored treedef; leaf shapes/dtypes come from the
+    file).  Leaf keys are ordered numerically by their index, so the count
+    is unbounded (no lexicographic rollover at 4 digits).
+
+    ``strict=True`` (default) requires the exact treedef repr, NamedTuple
+    class names included — two structurally isomorphic but semantically
+    different NamedTuples must not silently load into each other.
+    ``strict=False`` erases NamedTuple class names before comparing
+    (``_canonical_treedef``) — reserved for *migration* loaders like
+    ``load_ks_checkpoint``, whose version-tier templates are necessarily
+    aliases with different names (round-3 review scoped this relaxation
+    here; it used to apply to every caller)."""
     treedef = jax.tree_util.tree_structure(like)
     n = treedef.num_leaves
     with np.load(path) as data:
@@ -68,12 +76,15 @@ def load_pytree(path: str, like):
                       if "__treedef__" in data.files else None)
         keys = sorted((k for k in data.files if k.startswith("leaf_")),
                       key=lambda k: int(k[5:]))
-        if stored_def is not None and (
-                _canonical_treedef(stored_def)
-                != _canonical_treedef(str(treedef))):
-            raise ValueError(
-                f"checkpoint {path} was written for pytree structure\n  "
-                f"{stored_def}\nbut the template is\n  {treedef}")
+        if stored_def is not None:
+            want = str(treedef)
+            match = (stored_def == want if strict else
+                     _canonical_treedef(stored_def)
+                     == _canonical_treedef(want))
+            if not match:
+                raise ValueError(
+                    f"checkpoint {path} was written for pytree structure\n  "
+                    f"{stored_def}\nbut the template is\n  {treedef}")
         if len(keys) != n:
             raise ValueError(
                 f"checkpoint {path} holds {len(keys)} leaves, template "
@@ -208,17 +219,19 @@ def load_ks_checkpoint(path: str) -> KSCheckpoint:
               np.zeros((), np.int64))
     try:
         old = load_pytree(path, _KSCheckpointV3(*zeros6, secant=np.zeros(4),
-                                                last_distance=np.zeros(())))
+                                                last_distance=np.zeros(())),
+                          strict=False)
         return KSCheckpoint(*old, last_residual=np.asarray(np.inf))
     except ValueError:
         pass
     try:
         old = load_pytree(path, _KSCheckpointV2(*zeros6,
-                                                secant=np.zeros(4)))
+                                                secant=np.zeros(4)),
+                          strict=False)
         return KSCheckpoint(*old, last_distance=np.asarray(np.inf),
                             last_residual=np.asarray(np.inf))
     except ValueError:
-        old = load_pytree(path, _KSCheckpointV1(*zeros6))
+        old = load_pytree(path, _KSCheckpointV1(*zeros6), strict=False)
         return KSCheckpoint(*old, secant=np.full((4,), np.nan),
                             last_distance=np.asarray(np.inf),
                             last_residual=np.asarray(np.inf))

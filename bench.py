@@ -163,7 +163,7 @@ def solve(rho):
 
 solve(0.3)                                  # compile + warm-up
 t0 = time.perf_counter()
-r, egm, dist = solve(0.3 + 1e-9)            # perturbed, honest wall
+r, egm, dist = solve(0.3 + {perturb})       # perturbed, honest wall
 wall = time.perf_counter() - t0
 print("FINECPU=" + json.dumps({{"wall_s": wall, "r_star": r,
                                 "egm_iters": egm, "dist_iters": dist}}))
@@ -236,7 +236,7 @@ def _fine_cpu_metrics(timeout_s: float = 600.0):
     may hold the TPU), for the honest side-by-side (VERDICT r3 weak-item
     3).  Returns the parsed dict or None."""
     code = _FINE_CPU_CODE.format(ns=FINE_LABOR_STATES, na=FINE_A_COUNT,
-                                 nd=FINE_DIST_COUNT)
+                                 nd=FINE_DIST_COUNT, perturb=PERTURB)
     # the metric is labeled "one CPU core": pin XLA's CPU thread pool so
     # the label is honest on any host (this box has 1 core; a bigger host
     # would otherwise record a whole-host number against one chip)

@@ -242,3 +242,27 @@ def test_legacy_ks_checkpoint_migrates(tmp_path):
     # the residual is unknown for a round-2 file: +inf forces a pinned
     # resume to re-certify instead of trusting a stale convergence claim
     assert np.isinf(ck3.last_residual)
+
+
+def test_pytree_strict_rejects_isomorphic_namedtuple(tmp_path):
+    """Exact treedef matching is the DEFAULT again: a structurally
+    isomorphic but differently named NamedTuple must not silently load
+    (the name-erasing comparison is scoped to migration loaders via
+    strict=False — round-3 review)."""
+    from typing import NamedTuple
+
+    class WriterState(NamedTuple):
+        a: np.ndarray
+        b: np.ndarray
+
+    class OtherState(NamedTuple):
+        a: np.ndarray
+        b: np.ndarray
+
+    p = str(tmp_path / "nt.npz")
+    save_pytree(p, WriterState(a=np.ones(3), b=np.zeros(2)))
+    with pytest.raises(ValueError):
+        load_pytree(p, OtherState(a=np.ones(3), b=np.zeros(2)))
+    out = load_pytree(p, OtherState(a=np.ones(3), b=np.zeros(2)),
+                      strict=False)
+    np.testing.assert_allclose(out.a, np.ones(3))

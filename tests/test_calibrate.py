@@ -124,3 +124,18 @@ def test_labor_weight_round_trip():
     np.testing.assert_allclose(float(cal.value), 12.0, rtol=2e-3)
     np.testing.assert_allclose(float(cal.achieved), float(hours_target),
                                rtol=1e-4)
+
+
+def test_gini_negative_total_wealth_is_nan():
+    """Negative aggregate wealth (borrow_limit < 0 economies) is outside
+    the Gini's domain: report NaN, not a floor-scaled garbage magnitude
+    (round-3 review); zero total wealth keeps its documented Gini-1."""
+    import jax.numpy as jnp
+
+    from aiyagari_hark_tpu.models.calibrate import gini_histogram
+
+    grid = jnp.asarray([-2.0, -1.0, 0.5])
+    masses = jnp.asarray([0.5, 0.3, 0.2])       # total wealth < 0
+    assert bool(jnp.isnan(gini_histogram(grid, masses)))
+    zero = gini_histogram(jnp.asarray([0.0, 0.0]), jnp.asarray([0.5, 0.5]))
+    np.testing.assert_allclose(float(zero), 1.0)

@@ -257,3 +257,28 @@ def test_pallas_kernel_compiled_on_tpu(model, prices, solved):
                                       initial_distribution(model), 1e-11,
                                       interpret=False)
     np.testing.assert_allclose(np.asarray(d), np.asarray(ref), atol=1e-8)
+
+
+def test_pallas_nested_vmap_collapses_to_lane_grid():
+    """A doubly-vmapped 'pallas' fixed point (heterogeneity's beta-dist
+    sweep over cells) must degrade gracefully: the grid dispatch's own
+    batching rule collapses the extra axis into the lane axis instead of
+    vmap-batching the pallas_call itself (round-3 review)."""
+    from aiyagari_hark_tpu.models import firm
+
+    m = build_simple_model(labor_states=5, a_count=24, dist_count=60)
+
+    def one(r, beta, method):
+        W = firm.wage_rate(firm.k_to_l_from_r(r, 0.36, 0.08), 0.36)
+        pol, _, _ = solve_household(1.0 + r, W, m, beta, 2.0)
+        d, _, _ = stationary_wealth(pol, 1.0 + r, W, m, method=method)
+        return d
+
+    rs = jnp.asarray([0.02, 0.03])
+    betas = jnp.asarray([0.95, 0.97])
+    dp = jax.vmap(lambda b: jax.vmap(lambda r: one(r, b, "pallas"))(rs))(
+        betas)
+    dd = jax.vmap(lambda b: jax.vmap(lambda r: one(r, b, "dense"))(rs))(
+        betas)
+    assert dp.shape == dd.shape == (2, 2, 60, 5)
+    np.testing.assert_allclose(np.asarray(dp), np.asarray(dd), atol=1e-12)
