@@ -396,13 +396,22 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
         intercept=jnp.asarray(econ.intercept_prev, dtype=cal.a_grid.dtype),
         slope=jnp.asarray(econ.slope_prev, dtype=cal.a_grid.dtype))
     if pinned:
-        # pinned mode starts inside the rule class it iterates in: constant
-        # perceived capital at the analytic steady state (the config's
-        # identity-rule guess lies outside it and its explosive perception
-        # produces a fat-tailed transient the histogram would truncate)
+        # pinned mode starts inside the rule class it iterates in: a
+        # CONSTANT perceived capital.  A configured guess that already has
+        # slope 0 is honored (warm starts from a committed converged
+        # intercept — tests/fixture_configs.py); the default identity-rule
+        # guess (slope 1) lies outside the class and its explosive
+        # perception produces a fat-tailed transient the histogram would
+        # truncate, so anything with nonzero slope falls back to the
+        # analytic steady state.
+        if all(abs(float(s)) < 1e-12 for s in econ.slope_prev):
+            start = jnp.asarray(econ.intercept_prev,
+                                dtype=cal.a_grid.dtype)
+        else:
+            start = jnp.full((2,), jnp.log(cal.steady_state.K),
+                             dtype=cal.a_grid.dtype)
         afunc = AFuncParams(
-            intercept=jnp.full((2,), jnp.log(cal.steady_state.K),
-                               dtype=cal.a_grid.dtype),
+            intercept=start,
             slope=jnp.zeros((2,), dtype=cal.a_grid.dtype))
     it_start = 0
     resumed_converged = False

@@ -129,6 +129,25 @@ def main(argv=None):
                    "T_discard": int(econ_dict["T_discard"]),
                    "mrkv_init": mrkv_init,
                    "backend": "cpu-x64"},
+        # the COLD-converged saving rule: the layer-3 regression test
+        # warm-starts its re-solve from this (initial guess only — its
+        # solver re-certifies convergence at the same tolerance)
+        "afunc": {"intercept": [float(x)
+                                for x in np.asarray(sol.afunc.intercept)],
+                  "slope": [float(x) for x in np.asarray(sol.afunc.slope)]},
+        # the rule sol.policy was actually SOLVED under (the final
+        # iteration's pre-update rule = the penultimate record): the test
+        # warm-starts from THIS one, so its first-iteration policy matches
+        # the study's policy up to EGM tolerance instead of sitting one
+        # outer-update (up to the 0.01 outer tolerance, ~1% in K) away
+        # (round-4 review)
+        "policy_afunc": (
+            {"intercept": sol.records[-2].intercept,
+             "slope": sol.records[-2].slope}
+            if len(sol.records) >= 2 else
+            {"intercept": [float(x)
+                           for x in np.asarray(sol.afunc.intercept)],
+             "slope": [float(x) for x in np.asarray(sol.afunc.slope)]}),
         "reference_goldens": REFERENCE_GOLDENS,
         "band": {},
         "histogram_stats": hist_stats,

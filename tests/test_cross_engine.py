@@ -28,13 +28,15 @@ from aiyagari_hark_tpu.models.jacobian import (
     sequence_jacobians,
 )
 from aiyagari_hark_tpu.models.ks_solver import solve_ks_economy
-from aiyagari_hark_tpu.utils.config import AgentConfig, EconomyConfig
+
+from fixture_configs import (
+    CROSS_ENGINE_SPELL as SPELL,
+    CROSS_ENGINE_TFP_GAP as TFP_GAP,
+    SOLVE_KWARGS,
+    cross_engine_configs,
+)
 
 pytestmark = pytest.mark.slow   # heavyweight equilibrium solves (fast profile: -m 'not slow')
-
-
-SPELL = 8.0          # mean aggregate-state duration
-TFP_GAP = 0.02       # prod_g - prod_b
 
 
 @pytest.fixture(scope="module")
@@ -42,15 +44,10 @@ def ks_moments():
     # 2000 agents x 7000 periods: the smallest budget that keeps the MC
     # moments inside the 20%/0.01 agreement tolerances with ~3x margin
     # (measured gap ~7% and ~0.002 at 3000x9000; shrunk in round 3 to cut
-    # the single-core fixture cost ~40%, gaps remeasured ~8%/0.003)
-    agent = AgentConfig(labor_states=3, a_count=24, agent_count=2000,
-                        mgrid_base=(0.7, 0.85, 0.95, 1.0, 1.05, 1.15,
-                                    1.3))
-    econ = EconomyConfig(labor_states=3, prod_b=1.0 - TFP_GAP / 2,
-                         prod_g=1.0 + TFP_GAP / 2, urate_b=0.0,
-                         urate_g=0.0, dur_mean_b=SPELL, dur_mean_g=SPELL,
-                         act_T=7000, t_discard=1000, verbose=False)
-    sol = solve_ks_economy(agent, econ, sim_method="panel")
+    # the single-core fixture cost ~40%, gaps remeasured ~8%/0.003).
+    # Config + committed warm start: tests/fixture_configs.py.
+    agent, econ = cross_engine_configs()
+    sol = solve_ks_economy(agent, econ, **SOLVE_KWARGS["cross_engine"])
     assert sol.converged
     log_k = np.log(np.asarray(sol.history.A_prev)[econ.t_discard:])
     # hand engine B the preferences the KS solver ACTUALLY used (the

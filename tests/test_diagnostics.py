@@ -9,10 +9,11 @@ import pytest
 
 from aiyagari_hark_tpu.models.diagnostics import den_haan_forecast
 from aiyagari_hark_tpu.models.ks_solver import solve_ks_economy
-from aiyagari_hark_tpu.utils.config import (
-    AgentConfig,
-    EconomyConfig,
-    notebook_run_configs,
+from fixture_configs import (
+    SOLVE_KWARGS,
+    diag_parity_configs,
+    diag_pinned_configs,
+    diag_true_ks_configs,
 )
 
 pytestmark = pytest.mark.slow   # heavyweight equilibrium solves (fast profile: -m 'not slow')
@@ -20,24 +21,21 @@ pytestmark = pytest.mark.slow   # heavyweight equilibrium solves (fast profile: 
 
 @pytest.fixture(scope="module")
 def parity_solution():
-    agent, econ = notebook_run_configs()
-    econ = econ.replace(act_T=1500, t_discard=300, verbose=False)
-    return solve_ks_economy(agent, econ, seed=0)
+    # Config + committed warm start: tests/fixture_configs.py.
+    agent, econ = diag_parity_configs()
+    return solve_ks_economy(agent, econ, **SOLVE_KWARGS["diag_parity"])
 
 
 def test_forecast_alignment_is_exact_for_pinned_rule():
     """For the slope-pinned deterministic solution the perceived law IS a
     constant, so the dynamic forecast equals exp(intercept) everywhere and
     its error against the settled path is bounded by the outer tolerance."""
-    agent, econ = notebook_run_configs()
     # tolerance 1e-3 (was 1e-4): with the residual convergence criterion
     # the pinned solve must now drive |g| under tolerance too, and each
     # factor of 10 costs several relaxation windows on one core; 1e-3
     # keeps the forecast-error bound below the 0.3% assertion
-    econ = econ.replace(act_T=1200, t_discard=240, verbose=False,
-                        tolerance=1e-3)
-    sol = solve_ks_economy(agent, econ, seed=0, sim_method="distribution",
-                           dist_count=300)
+    agent, econ = diag_pinned_configs()
+    sol = solve_ks_economy(agent, econ, **SOLVE_KWARGS["diag_pinned"])
     st = den_haan_forecast(sol, t_start=600)
     np.testing.assert_allclose(np.asarray(st.forecast),
                                float(jnp.exp(sol.afunc.intercept[0])),
@@ -63,13 +61,8 @@ def test_true_ks_forecast_tracks_aggregate_shocks():
     """In a genuinely stochastic economy the dynamic forecast must follow
     the realized regime switches (correlate with the actual path), not
     just sit at a constant."""
-    econ = EconomyConfig(labor_states=3, act_T=800, t_discard=160,
-                         verbose=False, tolerance=0.02,
-                         prod_b=0.99, prod_g=1.01,
-                         urate_b=0.10, urate_g=0.04)
-    agent = AgentConfig(labor_states=3, agent_count=200, a_count=16)
-    sol = solve_ks_economy(agent, econ, seed=0, ks_employment=True,
-                           sim_method="distribution", dist_count=150)
+    agent, econ = diag_true_ks_configs()
+    sol = solve_ks_economy(agent, econ, **SOLVE_KWARGS["diag_true_ks"])
     st = den_haan_forecast(sol, t_start=200)
     actual = np.asarray(sol.history.A_prev)[201:]
     corr = np.corrcoef(np.asarray(st.forecast), actual)[0, 1]

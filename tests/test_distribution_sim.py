@@ -128,11 +128,11 @@ def test_solve_ks_economy_distribution_method():
     economy, 4.125% (``tests/test_equilibrium.py`` golden), NOT the
     reference's MC-attenuated 4.178% (see ``solve_ks_economy`` docstring
     on ``dist_pin_slope``)."""
-    agent, econ = notebook_run_configs()
-    econ = econ.replace(act_T=1500, t_discard=300, verbose=False,
-                        max_loops=15, tolerance=1e-3)
-    sol = solve_ks_economy(agent, econ, seed=0, sim_method="distribution",
-                           dist_count=300)
+    # Config + committed warm start: tests/fixture_configs.py.
+    from fixture_configs import SOLVE_KWARGS, dist_method_configs
+    agent, econ = dist_method_configs()
+    kwargs = SOLVE_KWARGS["dist_method"]
+    sol = solve_ks_economy(agent, econ, **kwargs)
     assert sol.converged
     # |r* - bisection golden| small: independent-method cross-validation
     # (histogram grid / M-interpolation differences allow a few bp)
@@ -143,8 +143,7 @@ def test_solve_ks_economy_distribution_method():
     np.testing.assert_allclose(float(np.asarray(sol.final_panel.dist).sum()),
                                1.0, atol=1e-8)
     # exact reproducibility of the whole outer loop
-    sol2 = solve_ks_economy(agent, econ, seed=0, sim_method="distribution",
-                            dist_count=300)
+    sol2 = solve_ks_economy(agent, econ, **kwargs)
     np.testing.assert_array_equal(np.asarray(sol.afunc.intercept),
                                   np.asarray(sol2.afunc.intercept))
 

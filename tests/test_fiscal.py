@@ -30,6 +30,26 @@ CFG = dict(labor_states=5, labor_ar=0.6, labor_sd=0.3, a_count=24,
 BETA, CRRA, ALPHA, DELTA = 0.96, 2.0, 0.36, 0.08
 
 
+@pytest.fixture(scope="module")
+def fiscal_eq():
+    """Memoized GE solves at this module's calibration: the three slow
+    tests overlap on tau ∈ {0, 0.3}, and each solve_fiscal_equilibrium is
+    a full nested bisection — share converged equilibria instead of
+    re-solving them (VERDICT r3 weak-item 5).  Keyed on the exact fiscal
+    kwargs; assertions are unchanged (a cache hit returns the identical
+    object a fresh call would compute — the solver is deterministic)."""
+    cache = {}
+
+    def get(**fiscal_kwargs):
+        key = tuple(sorted(fiscal_kwargs.items()))
+        if key not in cache:
+            cache[key] = solve_fiscal_equilibrium(
+                BETA, CRRA, ALPHA, DELTA, **fiscal_kwargs, **CFG)
+        return cache[key]
+
+    return get
+
+
 def _sd(levels, pi):
     m = float(jnp.sum(pi * levels))
     return float(jnp.sqrt(jnp.sum(pi * (levels - m) ** 2)))
@@ -72,7 +92,7 @@ def test_fiscal_model_keeps_firm_side_labor():
 
 
 @pytest.mark.slow
-def test_redistribution_raises_equilibrium_rate():
+def test_redistribution_raises_equilibrium_rate(fiscal_eq):
     """Aiyagari's mechanism in reverse: compressing income risk reduces
     precautionary saving, so r* rises monotonically toward 1/beta - 1 and
     capital falls; the budget balances and markets clear at every tau.
@@ -81,8 +101,7 @@ def test_redistribution_raises_equilibrium_rate():
     r_cap = 1.0 / BETA - 1.0
     prev_r, prev_k = -1.0, np.inf
     for tau in (0.0, 0.15, 0.3, 0.5):
-        feq = solve_fiscal_equilibrium(BETA, CRRA, ALPHA, DELTA,
-                                       tax_rate=tau, **CFG)
+        feq = fiscal_eq(tax_rate=tau)
         eq = feq.equilibrium
         r = float(eq.r_star)
         assert abs(float(eq.excess)) < 1e-6
@@ -96,14 +115,13 @@ def test_redistribution_raises_equilibrium_rate():
                                                     rel=1e-10)
         prev_r, prev_k = r, float(eq.capital)
     # HSV progressivity moves the same direction
-    feq_p = solve_fiscal_equilibrium(BETA, CRRA, ALPHA, DELTA,
-                                     progressivity=0.18, **CFG)
-    feq_0 = solve_fiscal_equilibrium(BETA, CRRA, ALPHA, DELTA, **CFG)
+    feq_p = fiscal_eq(progressivity=0.18)
+    feq_0 = fiscal_eq(tax_rate=0.0)
     assert float(feq_p.equilibrium.r_star) > float(feq_0.equilibrium.r_star)
 
 
 @pytest.mark.slow
-def test_tax_sweep_is_one_batched_program():
+def test_tax_sweep_is_one_batched_program(fiscal_eq):
     """``tax_rate_sweep`` vmaps whole GE solves + welfare recovery over
     the tax axis; lanes must agree with serial solves, and the welfare
     argmax sits in the interior (measured optimum tau* = 0.4 on this
@@ -117,8 +135,7 @@ def test_tax_sweep_is_one_batched_program():
     taus = np.linspace(0.0, 0.6, 7)
     res = tax_rate_sweep(taus, BETA, CRRA, ALPHA, DELTA, **CFG)
     # lane 3 (tau=0.3) vs the serial path
-    feq = solve_fiscal_equilibrium(BETA, CRRA, ALPHA, DELTA, tax_rate=0.3,
-                                   **CFG)
+    feq = fiscal_eq(tax_rate=0.3)
     assert float(res.r_star[3]) == pytest.approx(
         float(feq.equilibrium.r_star), abs=1e-8)
     eq = feq.equilibrium
@@ -134,7 +151,7 @@ def test_tax_sweep_is_one_batched_program():
 
 
 @pytest.mark.slow
-def test_utilitarian_welfare_is_hump_shaped():
+def test_utilitarian_welfare_is_hump_shaped(fiscal_eq):
     """The optimal-redistribution trade-off: moderate taxation raises
     utilitarian welfare (insurance of uninsurable risk) but heavy taxation
     crowds out capital enough to reverse the gain — an interior optimum.
@@ -148,8 +165,7 @@ def test_utilitarian_welfare_is_hump_shaped():
 
     welf = {}
     for tau in (0.0, 0.3, 0.6):
-        feq = solve_fiscal_equilibrium(BETA, CRRA, ALPHA, DELTA,
-                                       tax_rate=tau, **CFG)
+        feq = fiscal_eq(tax_rate=tau)
         eq = feq.equilibrium
         R = 1.0 + eq.r_star
         vf, _, _ = policy_value(eq.policy, R, eq.wage, feq.model, BETA,

@@ -29,7 +29,7 @@ import numpy as np
 import pytest
 
 from aiyagari_hark_tpu.models.ks_solver import solve_ks_economy
-from aiyagari_hark_tpu.utils.config import AgentConfig, EconomyConfig
+from fixture_configs import SOLVE_KWARGS, ks98_configs
 
 pytestmark = pytest.mark.slow   # heavyweight equilibrium solves (fast profile: -m 'not slow')
 
@@ -41,16 +41,9 @@ R2_FLOOR = 0.999          # approximate aggregation (KS report 0.999998)
 
 @pytest.fixture(scope="module")
 def ks98_solution():
-    agent = AgentConfig(labor_states=1, disc_fac=0.99, crra=1.0,
-                        a_max=300.0, a_count=48)
-    econ = EconomyConfig(labor_states=1, disc_fac=0.99, crra=1.0,
-                         depr_fac=0.025, prod_b=0.99, prod_g=1.01,
-                         urate_b=0.10, urate_g=0.04,
-                         act_T=11000, t_discard=1000,
-                         tolerance=1e-3, max_loops=60, verbose=False)
-    return solve_ks_economy(agent, econ, ks_employment=True,
-                            sim_method="distribution", dist_count=500,
-                            seed=0)
+    # Config + committed warm start: tests/fixture_configs.py.
+    agent, econ = ks98_configs()
+    return solve_ks_economy(agent, econ, **SOLVE_KWARGS["ks98"])
 
 
 def _k_law(sol, state):

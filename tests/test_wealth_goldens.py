@@ -82,6 +82,15 @@ def test_seed_zero_resimulation_reproduces_study(study):
     cfg = study["config"]
     econ_dict = init_aiyagari_economy()
     econ_dict.update(LaborAR=0.3, LaborSD=0.2, CRRA=1.0, verbose=False)
+    warm = study.get("policy_afunc")
+    if warm and not os.environ.get("AIYAGARI_COLD_START"):
+        # warm-start from the rule the study's policy was SOLVED under
+        # (its final iteration's pre-update rule, not the post-update
+        # afunc — one outer-update of difference is up to the 0.01 outer
+        # tolerance, which would eat the rel=0.01 mean budget below).
+        # Initial guess only; the solve re-certifies convergence.
+        econ_dict.update(intercept_prev=list(warm["intercept"]),
+                         slope_prev=list(warm["slope"]))
     agent_dict = init_aiyagari_agents()
     agent_dict.update(AgentCount=cfg["agent_count"])
 
