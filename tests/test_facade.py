@@ -33,6 +33,44 @@ def solved():
     return economy, agent
 
 
+def test_quantile_resample_half_agent_tail_rule():
+    """The equal-weight resample's top-agent pin (round-3 advisor fix,
+    corrected in round 4): a ~1e-12 truncation-tail bucket at the top of
+    the support must NOT capture an agent (1% of the panel standing on
+    1e-12 of the mass dragged the unweighted mean 14% off the weighted
+    mean), while a top bin holding at least half an agent's share (0.5/n)
+    must still pin max(aNow) to the true support max."""
+    from aiyagari_hark_tpu.facade import quantile_resample
+
+    grid = np.linspace(0.0, 100.0, 201)          # support 0..100
+    # lognormal-ish bulk around 5, hard-truncated: top bin gets 1e-12
+    weights = np.exp(-0.5 * ((grid - 5.0) / 2.0) ** 2)
+    weights[-1] = 1e-12
+    weights /= weights.sum()
+    panel = quantile_resample(grid, weights, 100)
+    w_mean = float(np.average(grid, weights=weights))
+    assert panel.max() < 20.0                     # no teleport to a_max
+    assert abs(panel.mean() - w_mean) < 0.02 * abs(w_mean)
+    assert np.all(np.diff(panel) >= 0)            # quantiles are ordered
+
+    # material top-bin mass (>= 0.5/n): the support max IS the honest max
+    weights2 = weights.copy()
+    weights2[-1] = 0.01                           # 1% >> 0.5/100
+    weights2 /= weights2.sum()
+    panel2 = quantile_resample(grid, weights2, 100)
+    assert panel2.max() == grid[-1]
+
+    # adversarial half-mass gap (round-4 review): a 1e-12 bucket far above
+    # the bulk must not drag ANY high quantile into the empty gap — the
+    # trailing-tail trim protects agents 76..99, not just the pinned last
+    g3 = np.array([0.0, 1.0, 2.0])
+    w3 = np.array([0.5, 0.5 - 1e-12, 1e-12])
+    panel3 = quantile_resample(g3, w3, 100)
+    assert panel3.max() == 1.0                    # trimmed support max
+    assert np.all(np.diff(panel3) >= 0)           # monotone panel
+    assert np.all(panel3 <= 1.0)                  # nobody in the gap
+
+
 def test_steady_state_attributes():
     economy = AiyagariEconomy(**init_aiyagari_economy())
     # closed forms from Aiyagari_Support.py:1606-1615 with beta=.96 a=.36 d=.08
@@ -150,18 +188,21 @@ def test_solve_distribution_method_through_facade():
     """sim_method='distribution' flows through the facade: the result
     surface carries the wealth histogram as (support, weights) and the
     equilibrium sits at the deterministic (bisection-consistent) r*."""
+    from fixture_configs import SOLVE_KWARGS, facade_distribution_updates
+    fk = dict(SOLVE_KWARGS["facade_dist"])   # single source with the registry
     econ_dict = init_aiyagari_economy()
-    econ_dict.update(SMALL, act_T=800, T_discard=160, LaborAR=0.3, CRRA=1.0)
+    econ_dict.update(facade_distribution_updates())   # + committed warm start
     agent_dict = init_aiyagari_agents()
-    agent_dict.update(LaborStatesNo=5, AgentCount=100, aCount=16)
-    economy = AiyagariEconomy(tolerance=1e-3, **econ_dict)
+    agent_dict.update(LaborStatesNo=5, AgentCount=fk.pop("AgentCount"),
+                      aCount=fk.pop("aCount"))
+    economy = AiyagariEconomy(tolerance=fk.pop("tolerance"), **econ_dict)
     economy.verbose = False
     agent = AiyagariType(**agent_dict)
     agent.cycles = 0
     agent.get_economy_data(economy)
     economy.agents = [agent]
     economy.make_Mrkv_history()
-    sol = economy.solve(sim_method="distribution", dist_count=200)
+    sol = economy.solve(**fk)
     assert sol.converged
     support = economy.reap_state["aNowGrid"][0]
     weights = economy.reap_state["aNowWeights"][0]
