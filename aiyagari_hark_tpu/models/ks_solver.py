@@ -339,10 +339,16 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
     # fields (max_loops, verbose, tolerance) are excluded: resuming with a
     # larger iteration budget or tighter tolerance IS the resume use case —
     # it extends the same trajectory rather than defining a different run.
+    # The initial-guess fields (intercept_prev/slope_prev) are excluded for
+    # the same reason: a resume replaces the rule with the checkpoint's
+    # wholesale, so the guess cannot affect the continued trajectory — and
+    # gating on it made a checkpoint frozen under a cold config unusable
+    # from a warm-started one (the round-4 committed-checkpoint fixture).
     import dataclasses
     econ_fp = tuple(sorted(
         (k, v) for k, v in dataclasses.asdict(econ).items()
-        if k not in ("max_loops", "verbose", "tolerance")))
+        if k not in ("max_loops", "verbose", "tolerance",
+                     "intercept_prev", "slope_prev")))
     fingerprint = config_fingerprint(agent, econ_fp, mrkv_hist,
                                      ks_employment, egm_tol, sim_method,
                                      dist_count, dist_fan, dist_discard,
