@@ -254,6 +254,7 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
     short mixing window — the transient *is* the signal for a fan).
     """
     from ..utils.checkpoint import (
+        CheckpointMismatchError,
         config_fingerprint,
         load_ks_checkpoint,
         save_ks_checkpoint,
@@ -424,7 +425,7 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
     if checkpoint_path is not None and os.path.exists(checkpoint_path):
         ck = load_ks_checkpoint(checkpoint_path)
         if int(ck.seed) != seed or int(ck.fingerprint) != fingerprint:
-            raise ValueError(
+            raise CheckpointMismatchError(
                 f"checkpoint {checkpoint_path} was written by a different "
                 f"run (seed {int(ck.seed)} vs {seed}, config fingerprint "
                 f"mismatch: {int(ck.fingerprint) != fingerprint}) — delete "

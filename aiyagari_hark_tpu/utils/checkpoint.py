@@ -18,6 +18,15 @@ import jax
 import numpy as np
 
 
+class CheckpointMismatchError(ValueError):
+    """A checkpoint was written by a different run (seed or config
+    fingerprint mismatch) and resuming from it is refused.  A typed
+    subclass so callers with a legitimate degrade-to-cold path (e.g. a
+    committed warm-start checkpoint gone stale after a config change) can
+    catch exactly this, not every ValueError the resume machinery might
+    raise."""
+
+
 def save_pytree(path: str, tree) -> None:
     """Write a pytree of arrays/scalars to ``path`` (npz, atomic rename).
     The treedef repr rides along so a load against the wrong template is a

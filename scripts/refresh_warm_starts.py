@@ -70,23 +70,71 @@ FACADE_CASES = {
 CHECKPOINT_CASES = ("dist_method",)
 
 
-def _freeze_checkpoint(key: str, build, kwargs: dict, cold_iters: int):
-    os.makedirs(fc.CHECKPOINTS, exist_ok=True)
-    path = os.path.join(fc.CHECKPOINTS, key + ".npz")
-    for p in (path, path + ".dist.npz"):
+def _registry_solve_with_rolls(key: str, build, kwargs: dict,
+                               scratch_dir: str):
+    """The ONE cold registry solve, additionally capturing a rolling copy
+    of its checkpoint pair each outer iteration, so the near-converged
+    freeze needs no second solve and no mid-run assertion (round-4
+    review: the freeze re-ran the entire cold solve, and its assert
+    could abort main() before the registry was written).
+
+    Timing: the solver writes the pair tagged ``it+1`` at the END of
+    iteration ``it``'s body, AFTER the callback fires — so during
+    ``callback(rec.iteration == t)`` the pair on disk is tagged ``t``."""
+    import shutil
+
+    path = os.path.join(scratch_dir, key + ".npz")
+
+    def roll(rec):
+        t = rec.iteration
+        if t >= 1 and os.path.exists(path):
+            slot = os.path.join(scratch_dir, f"{key}.roll{t % 3}.npz")
+            shutil.copy(path, slot)
+            if os.path.exists(path + ".dist.npz"):
+                shutil.copy(path + ".dist.npz", slot + ".dist.npz")
+
+    agent, econ = build()
+    return _solve(agent, econ, checkpoint_path=path, callback=roll,
+                  **kwargs)
+
+
+def _finalize_freeze(key: str, cold_iters: int, scratch_dir: str):
+    """Promote the roll tagged ``cold_iters - 2`` into the committed
+    checkpoint location, validating it is genuinely unconverged.  Runs
+    AFTER the registry JSON is written; failures only cost this key's
+    checkpoint (reported, never raised) and never leave a stale pair."""
+    import shutil
+
+    from aiyagari_hark_tpu.utils.checkpoint import load_ks_checkpoint
+
+    target = cold_iters - 2
+    src = None
+    for s in range(3):
+        slot = os.path.join(scratch_dir, f"{key}.roll{s}.npz")
+        if (os.path.exists(slot)
+                and int(load_ks_checkpoint(slot).iteration) == target):
+            src = slot
+            break
+    dst = os.path.join(fc.CHECKPOINTS, key + ".npz")
+    for p in (dst, dst + ".dist.npz"):   # never leave a stale/mismatched pair
         if os.path.exists(p):
             os.remove(p)
-    agent, econ = build()
-    econ = econ.replace(max_loops=max(1, cold_iters - 2))
-    t0 = time.time()
-    part = _solve(agent, econ, checkpoint_path=path, **kwargs)
-    assert not part.converged, (
-        f"{key}: the frozen checkpoint must be NEAR-converged, not "
-        f"converged (got convergence in {len(part.records)} loops)")
+    if target < 1 or src is None:
+        print(f"[warm] {key:14s} no near-converged roll at tag {target} "
+              f"(cold={cold_iters}) — checkpoint not frozen")
+        return
+    if bool(load_ks_checkpoint(src).converged):
+        print(f"[warm] {key:14s} roll at tag {target} is already converged "
+              f"— a frozen copy would short-circuit the resume; not frozen")
+        return
+    os.makedirs(fc.CHECKPOINTS, exist_ok=True)
+    shutil.copy(src, dst)
+    if os.path.exists(src + ".dist.npz"):
+        shutil.copy(src + ".dist.npz", dst + ".dist.npz")
     sizes = {os.path.basename(p): os.path.getsize(p)
-             for p in (path, path + ".dist.npz") if os.path.exists(p)}
-    print(f"[warm] {key:14s} {time.time() - t0:7.1f}s  froze checkpoint at "
-          f"iteration {cold_iters - 2}/{cold_iters}: {sizes}")
+             for p in (dst, dst + ".dist.npz") if os.path.exists(p)}
+    print(f"[warm] {key:14s} froze checkpoint at iteration "
+          f"{target}/{cold_iters}: {sizes}")
 
 
 def _solve_facade(updates: dict, *, AgentCount, aCount, tolerance,
@@ -122,33 +170,52 @@ def main(argv=None):
     except (OSError, ValueError):
         registry = {}
 
-    for key, build in {**CASES, **FACADE_CASES}.items():
-        if keys is not None and key not in keys:
-            continue
-        t0 = time.time()
-        kwargs = fc.SOLVE_KWARGS[key]
-        if key in FACADE_CASES:
-            sol = _solve_facade(build(), **kwargs)
-        else:
-            agent, econ = build()
-            sol = _solve(agent, econ, **kwargs)
-        assert sol.converged, f"{key}: cold solve did not converge"
-        registry[key] = {
-            "intercept": [float(x) for x in np.asarray(sol.afunc.intercept)],
-            "slope": [float(x) for x in np.asarray(sol.afunc.slope)],
-            "outer_iterations": len(sol.records),
-        }
-        print(f"[warm] {key:14s} {time.time() - t0:7.1f}s  "
-              f"intercept {registry[key]['intercept']} "
-              f"slope {registry[key]['slope']} "
-              f"({registry[key]['outer_iterations']} cold iters)")
-        if key in CHECKPOINT_CASES:
-            _freeze_checkpoint(key, build, kwargs, len(sol.records))
+    import shutil
+    import tempfile
 
-    with open(args.out, "w") as f:
-        json.dump(registry, f, indent=1, sort_keys=True)
-        f.write("\n")
-    print(f"[warm] wrote {args.out}")
+    scratch = tempfile.mkdtemp(prefix="warm_rolls_")
+    freezes = []
+    try:
+        for key, build in {**CASES, **FACADE_CASES}.items():
+            if keys is not None and key not in keys:
+                continue
+            t0 = time.time()
+            kwargs = fc.SOLVE_KWARGS[key]
+            if key in FACADE_CASES:
+                sol = _solve_facade(build(), **kwargs)
+            elif key in CHECKPOINT_CASES:
+                sol = _registry_solve_with_rolls(key, build, kwargs, scratch)
+            else:
+                agent, econ = build()
+                sol = _solve(agent, econ, **kwargs)
+            assert sol.converged, f"{key}: cold solve did not converge"
+            registry[key] = {
+                "intercept": [float(x)
+                              for x in np.asarray(sol.afunc.intercept)],
+                "slope": [float(x) for x in np.asarray(sol.afunc.slope)],
+                "outer_iterations": len(sol.records),
+            }
+            print(f"[warm] {key:14s} {time.time() - t0:7.1f}s  "
+                  f"intercept {registry[key]['intercept']} "
+                  f"slope {registry[key]['slope']} "
+                  f"({registry[key]['outer_iterations']} cold iters)")
+            if key in CHECKPOINT_CASES:
+                freezes.append((key, len(sol.records)))
+
+        # registry first: a freeze problem must not discard the solves
+        with open(args.out, "w") as f:
+            json.dump(registry, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"[warm] wrote {args.out}")
+
+        for key, cold_iters in freezes:
+            try:
+                _finalize_freeze(key, cold_iters, scratch)
+            except Exception as e:   # noqa: BLE001 — freeze is best-effort
+                print(f"[warm] {key}: freeze failed "
+                      f"({type(e).__name__}: {e}) — checkpoint not frozen")
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
 
 
 if __name__ == "__main__":

@@ -139,19 +139,19 @@ def test_solve_ks_economy_distribution_method(tmp_path):
     agent, econ = dist_method_configs()
     kwargs = SOLVE_KWARGS["dist_method"]
 
+    from aiyagari_hark_tpu.utils.checkpoint import CheckpointMismatchError
+
     def solve(tag):
         ck = committed_checkpoint("dist_method", tmp_path, tag)
         if ck is not None:
             try:
                 return solve_ks_economy(agent, econ, **kwargs,
                                         checkpoint_path=ck)
-            except ValueError as e:
+            except CheckpointMismatchError:
                 # ONLY the stale-fingerprint refusal may degrade to a cold
                 # solve (config drift -> rerun refresh_warm_starts.py);
-                # any other ValueError is a real resume-path regression
-                # and must fail the test, not vanish into a 47 s fallback
-                if "written by a different run" not in str(e):
-                    raise
+                # any other error is a real resume-path regression and
+                # must fail the test, not vanish into a 47 s fallback
                 import warnings
                 warnings.warn(
                     "committed dist_method checkpoint is stale (config "
