@@ -691,11 +691,12 @@ def main():
         try:
             with open(os.path.join(_repo_dir(),
                                    "bench_tpu_last.json")) as f:
-                record["last_tpu"] = json.load(f)
-            print("[bench] CPU fallback: embedded the committed TPU "
-                  "record (bench_tpu_last.json, captured_at="
-                  f"{record['last_tpu'].get('captured_at')})",
-                  file=sys.stderr)
+                last = json.load(f)
+            if isinstance(last, dict):   # a truncated write can yield
+                record["last_tpu"] = last   # valid-but-non-object JSON
+                print("[bench] CPU fallback: embedded the committed TPU "
+                      "record (bench_tpu_last.json, captured_at="
+                      f"{last.get('captured_at')})", file=sys.stderr)
         except (OSError, ValueError):
             pass
 

@@ -67,7 +67,7 @@ FACADE_CASES = {
 # for real (a CONVERGED checkpoint would short-circuit through the
 # idempotent reload and the test's reproducibility assertion would go
 # vacuous).
-CHECKPOINT_CASES = ("dist_method",)
+CHECKPOINT_CASES = ("dist_method", "diag_pinned")
 
 
 def _registry_solve_with_rolls(key: str, build, kwargs: dict,

@@ -88,6 +88,32 @@ def committed_checkpoint(key: str, tmp_dir, tag: str = "a"):
     return dst
 
 
+def solve_with_committed_checkpoint(key: str, tmp_dir, solve_fn,
+                                    tag: str = "a"):
+    """Run ``solve_fn(checkpoint_path)`` resumed from the committed
+    near-converged checkpoint for ``key``, degrading to a cold
+    ``solve_fn(None)`` when the checkpoint is absent, bypassed
+    (``AIYAGARI_COLD_START``), or stale (the solver's typed
+    ``CheckpointMismatchError`` — config drift; rerun
+    ``scripts/refresh_warm_starts.py --only <key>``).  Any other
+    exception propagates: it is a resume-path regression, not
+    staleness.  One helper so every CHECKPOINT_CASES test shares one
+    staleness semantics (round-4 review)."""
+    from aiyagari_hark_tpu.utils.checkpoint import CheckpointMismatchError
+
+    ck = committed_checkpoint(key, tmp_dir, tag)
+    if ck is not None:
+        try:
+            return solve_fn(ck)
+        except CheckpointMismatchError:
+            import warnings
+            warnings.warn(
+                f"committed {key} checkpoint is stale (config drift?) — "
+                f"cold-solving; rerun scripts/refresh_warm_starts.py "
+                f"--only {key}", stacklevel=2)
+    return solve_fn(None)
+
+
 def warm_start(key: str) -> dict:
     """``{"intercept_prev": (...), "slope_prev": (...)}`` for the key, or
     ``{}`` when the registry lacks it / ``AIYAGARI_COLD_START=1``."""

@@ -132,33 +132,18 @@ def test_solve_ks_economy_distribution_method(tmp_path):
     # (this fixture's cost is the carried distribution settling, which an
     # intercept warm start cannot cut): tests/fixture_configs.py.  The
     # resume runs the final iterations and the convergence certification
-    # for real; a stale checkpoint (config drift) raises on the
-    # fingerprint and falls back to a full cold solve.
-    from fixture_configs import (SOLVE_KWARGS, committed_checkpoint,
-                                 dist_method_configs)
+    # for real; staleness semantics live in
+    # solve_with_committed_checkpoint.
+    from fixture_configs import (SOLVE_KWARGS, dist_method_configs,
+                                 solve_with_committed_checkpoint)
     agent, econ = dist_method_configs()
     kwargs = SOLVE_KWARGS["dist_method"]
 
-    from aiyagari_hark_tpu.utils.checkpoint import CheckpointMismatchError
-
     def solve(tag):
-        ck = committed_checkpoint("dist_method", tmp_path, tag)
-        if ck is not None:
-            try:
-                return solve_ks_economy(agent, econ, **kwargs,
-                                        checkpoint_path=ck)
-            except CheckpointMismatchError:
-                # ONLY the stale-fingerprint refusal may degrade to a cold
-                # solve (config drift -> rerun refresh_warm_starts.py);
-                # any other error is a real resume-path regression and
-                # must fail the test, not vanish into a 47 s fallback
-                import warnings
-                warnings.warn(
-                    "committed dist_method checkpoint is stale (config "
-                    "drift?) — cold-solving; rerun "
-                    "scripts/refresh_warm_starts.py --only dist_method",
-                    stacklevel=2)
-        return solve_ks_economy(agent, econ, **kwargs)
+        return solve_with_committed_checkpoint(
+            "dist_method", tmp_path,
+            lambda ck: solve_ks_economy(agent, econ, **kwargs,
+                                        checkpoint_path=ck), tag)
 
     sol = solve("a")
     assert sol.converged
