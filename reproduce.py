@@ -50,7 +50,6 @@ def make_figs(fig, name: str, figures_dir: str) -> list:
 
     import matplotlib
 
-    matplotlib.rcParams["svg.hashsalt"] = "aiyagari-hark-tpu"
     os.makedirs(figures_dir, exist_ok=True)
     paths = []
     for ext in ("png", "jpg", "pdf", "svg"):
@@ -59,7 +58,10 @@ def make_figs(fig, name: str, figures_dir: str) -> list:
         # reject date keys entirely
         metadata = {"pdf": {"CreationDate": None, "ModDate": None},
                     "svg": {"Date": None}}.get(ext)
-        fig.savefig(p, metadata=metadata)
+        # rc_context: the salt must not leak into other SVG saves of an
+        # importing process (round-4 review)
+        with matplotlib.rc_context({"svg.hashsalt": "aiyagari-hark-tpu"}):
+            fig.savefig(p, metadata=metadata)
         paths.append(p)
     return paths
 

@@ -1,0 +1,112 @@
+"""The suite's warm-start tooling itself: registry lookup, the
+committed-checkpoint resume-or-degrade helper, and the bench's fine-grid
+dense hazard sentinel (all fast — no solves)."""
+
+import os
+
+import pytest
+
+from fixture_configs import (
+    SOLVE_KWARGS,
+    committed_checkpoint,
+    solve_with_committed_checkpoint,
+    warm_start,
+)
+
+
+def test_warm_start_registry_and_cold_bypass(monkeypatch):
+    ws = warm_start("dist_method")
+    assert set(ws) == {"intercept_prev", "slope_prev"}
+    assert all(isinstance(v, tuple) and len(v) == 2 for v in ws.values())
+    # pinned-mode entries are inside the constant-rule class (slope 0) —
+    # the condition under which ks_solver honors them
+    assert ws["slope_prev"] == (0.0, 0.0)
+    assert warm_start("no_such_fixture") == {}
+    monkeypatch.setenv("AIYAGARI_COLD_START", "1")
+    assert warm_start("dist_method") == {}
+
+
+def test_committed_checkpoint_copies_pair(tmp_path, monkeypatch):
+    ck = committed_checkpoint("dist_method", tmp_path, tag="x")
+    assert ck is not None and ck.endswith("dist_method_x.npz")
+    assert os.path.exists(ck) and os.path.exists(ck + ".dist.npz")
+    # the committed pair is NEAR-converged, not converged (a converged
+    # copy would short-circuit the resume and void the reproducibility
+    # assertions that ride on it)
+    from aiyagari_hark_tpu.utils.checkpoint import load_ks_checkpoint
+    assert not bool(load_ks_checkpoint(ck).converged)
+    assert committed_checkpoint("no_such_fixture", tmp_path) is None
+    monkeypatch.setenv("AIYAGARI_COLD_START", "1")
+    assert committed_checkpoint("dist_method", tmp_path) is None
+
+
+def test_resume_or_degrade_semantics(tmp_path):
+    """Stale fingerprint (CheckpointMismatchError) degrades to a warned
+    cold solve; any other failure propagates — a resume-path regression
+    must fail tests, not silently cost a cold solve."""
+    from aiyagari_hark_tpu.utils.checkpoint import CheckpointMismatchError
+
+    calls = []
+
+    def stale_then_cold(ck):
+        calls.append(ck)
+        if ck is not None:
+            raise CheckpointMismatchError("written by a different run")
+        return "cold-result"
+
+    with pytest.warns(UserWarning, match="stale"):
+        out = solve_with_committed_checkpoint("dist_method", tmp_path,
+                                              stale_then_cold)
+    assert out == "cold-result"
+    assert calls[0] is not None and calls[1] is None
+
+    def broken(ck):
+        raise RuntimeError("resume-path regression")
+
+    with pytest.raises(RuntimeError, match="regression"):
+        solve_with_committed_checkpoint("dist_method", tmp_path, broken,
+                                        tag="b")
+
+
+def test_solve_kwargs_cover_every_registry_key():
+    """Every registry entry has its solve kwargs defined in the ONE shared
+    mapping — the invariant that keeps the refresh script and the tests
+    solving the same program."""
+    import json
+
+    from fixture_configs import REGISTRY
+    with open(REGISTRY) as f:
+        for key in json.load(f):
+            assert key in SOLVE_KWARGS, key
+
+
+def test_bench_fine_sentinel_lifecycle(tmp_path, monkeypatch):
+    import bench
+
+    monkeypatch.setattr(bench, "_repo_dir", lambda: str(tmp_path))
+    assert not bench._fine_dense_hazard_pending()
+    bench._fine_sentinel_write()
+    assert bench._fine_dense_hazard_pending()
+    # the explicit recovery override re-enables dense despite the sentinel
+    monkeypatch.setenv("AIYAGARI_BENCH_FORCE_DENSE", "1")
+    assert not bench._fine_dense_hazard_pending()
+    monkeypatch.delenv("AIYAGARI_BENCH_FORCE_DENSE")
+    assert bench._fine_dense_hazard_pending()
+    bench._fine_sentinel_clear()
+    assert not bench._fine_dense_hazard_pending()
+    bench._fine_sentinel_clear()          # idempotent on a missing file
+
+
+def test_bench_model_flops_scatter_vs_dense():
+    """The FLOP model's structure: dense distribution steps dominate the
+    scatter ones by the D^2/D matvec ratio, and EGM work is identical."""
+    import bench
+
+    egm_only = bench._model_flops(10, 0, 32, 7, 500, dense_dist=True)
+    assert egm_only == bench._model_flops(10, 0, 32, 7, 500,
+                                          dense_dist=False)
+    dense = bench._model_flops(0, 10, 32, 7, 500, dense_dist=True)
+    scatter = bench._model_flops(0, 10, 32, 7, 500, dense_dist=False)
+    assert dense > 50 * scatter
+    # per the documented model: dense per-step = 2*N*D^2 + 2*D*N^2
+    assert dense == 10 * (2.0 * 7 * 500 ** 2 + 2.0 * 500 * 7 ** 2)
