@@ -80,21 +80,26 @@ def test_solve_kwargs_cover_every_registry_key():
             assert key in SOLVE_KWARGS, key
 
 
-def test_bench_fine_sentinel_lifecycle(tmp_path, monkeypatch):
+@pytest.mark.parametrize("name", ["_FINE_SENTINEL", "_WELFARE_SENTINEL"])
+def test_bench_hazard_sentinel_lifecycle(tmp_path, monkeypatch, name):
+    """Both compile-hazard guards (fine-grid dense, welfare sweep) share
+    one lifecycle: write → pending, force-env override, clear → not
+    pending, idempotent clear."""
     import bench
 
     monkeypatch.setattr(bench, "_repo_dir", lambda: str(tmp_path))
-    assert not bench._fine_dense_hazard_pending()
-    bench._fine_sentinel_write()
-    assert bench._fine_dense_hazard_pending()
-    # the explicit recovery override re-enables dense despite the sentinel
-    monkeypatch.setenv("AIYAGARI_BENCH_FORCE_DENSE", "1")
-    assert not bench._fine_dense_hazard_pending()
-    monkeypatch.delenv("AIYAGARI_BENCH_FORCE_DENSE")
-    assert bench._fine_dense_hazard_pending()
-    bench._fine_sentinel_clear()
-    assert not bench._fine_dense_hazard_pending()
-    bench._fine_sentinel_clear()          # idempotent on a missing file
+    sentinel = getattr(bench, name)
+    assert not sentinel.pending()
+    sentinel.write()
+    assert sentinel.pending()
+    # the explicit recovery override re-enables the phase despite the file
+    monkeypatch.setenv(sentinel.force_env, "1")
+    assert not sentinel.pending()
+    monkeypatch.delenv(sentinel.force_env)
+    assert sentinel.pending()
+    sentinel.clear()
+    assert not sentinel.pending()
+    sentinel.clear()                      # idempotent on a missing file
 
 
 def test_bench_model_flops_scatter_vs_dense():
