@@ -12,6 +12,28 @@ and mean percent error against the actually-simulated aggregates.
 
 Accepted practice: a KS solution is considered accurate when the max
 dynamic forecast error over a long simulation is a fraction of a percent.
+
+Which engine meets that bar here is a measured and explained fact, not an
+aspiration.  The deterministic pinned-histogram engine does: its rule is
+a constant (slope 0), so there is no off-path slope to be wrong about,
+and its forecast error is bounded by the secant tolerance plus settled-
+path drift — measured max 0.43% / mean 0.13% on the committed parity
+calibration (``results.json`` ``den_haan_pinned_*``), asserted <0.3% at
+the test config (``tests/test_diagnostics.py``).  The reference-parity
+Monte-Carlo panel rule does NOT — the same committed run measures
+max 2.28% / mean 0.42%, reported side by side.  That is a property of the reference's
+own construction, not a solver bug (DESIGN §3): at the aggregate-
+degenerate Aiyagari calibration the correct rational-expectations law is
+the CONSTANT ``K' = K*`` (slope 0), the deterministic transition map
+``log A' ~ log M`` has local slope ~1.2, and the MC regression's fitted
+slope (~1.11) sits between them only by errors-in-variables attenuation
+from sampling noise in log M.  Iterated forward with no feedback — the
+den Haan test — any slope that large compounds each period's sampling
+deviation instead of forgetting it, which is exactly the off-path
+behavior the dynamic forecast scores.  The panel rule's error is
+therefore bounded as *moderate* (<5%
+mean, <10% max at the test config) to catch regressions; the accuracy
+standard above belongs to, and is asserted for, the pinned engine.
 """
 
 from __future__ import annotations

@@ -133,16 +133,15 @@ def _run_irf_extra(args, econ_dict, info, depr, n_states, timer, plt, np):
         "r_star_bisection_pct": 100.0 * float(eq.r_star)}
 
 
-def _run_histogram_extra(args, econ_dict, agent_dict, info, timer, stats):
-    """Beyond-parity: the deterministic histogram engine's own fixed point
-    on the same calibration, so results.json reports BOTH simulators'
-    wealth statistics (VERDICT r2 next-round item 3).  Skipped when the
-    main run already used the distribution engine."""
-    if args.sim_method == "distribution":
-        return None
+def _solve_histogram_engine(args, econ_dict, agent_dict, info, timer,
+                            phase: str):
+    """Solve the deterministic (pinned-histogram) engine at the main run's
+    calibration.  Shared by the den Haan side-by-side (default path) and
+    the ``--extras`` histogram block so the engine is solved once per
+    reproduction, not once per consumer."""
     from aiyagari_hark_tpu import AiyagariEconomy, AiyagariType
 
-    with timer.phase("histogram_engine"):
+    with timer.phase(phase):
         economy = AiyagariEconomy(seed=args.seed, **econ_dict)
         agent = AiyagariType(**agent_dict)
         agent.cycles = 0
@@ -150,6 +149,59 @@ def _run_histogram_extra(args, econ_dict, agent_dict, info, timer, stats):
         economy.agents = [agent]
         economy.make_Mrkv_history()
         sol = economy.solve(dtype=info.dtype, sim_method="distribution")
+    return sol, economy
+
+
+def _pinned_den_haan(args, econ_dict, agent_dict, info, timer):
+    """den Haan side-by-side (VERDICT r4 weak-item 4): solve the
+    deterministic pinned-histogram engine at the same calibration and
+    report its dynamic-forecast stats NEXT TO the panel rule's, so the
+    committed artifact no longer quotes a 2.28% max error against a
+    "fraction of a percent" standard without the engine that meets it.
+    The pinned rule is a constant (slope 0), so it has no off-path slope
+    to be wrong about — its forecast error is bounded by the secant
+    tolerance plus settled-path drift; the reference-parity MC panel
+    rule's slope (~1.11) is errors-in-variables-attenuated and compounds
+    percent-level drift when iterated without feedback
+    (``models/diagnostics.py``, DESIGN §3).
+
+    Returns ``((sol, economy), fields)`` so ``--extras`` can reuse the
+    solve."""
+    from aiyagari_hark_tpu.models.diagnostics import den_haan_forecast
+
+    sol, economy = _solve_histogram_engine(args, econ_dict, agent_dict,
+                                           info, timer, "den_haan_pinned")
+    dh = den_haan_forecast(sol, t_start=econ_dict["T_discard"])
+    fields = {
+        "den_haan_pinned_max_error_pct": float(dh.max_error_pct),
+        "den_haan_pinned_mean_error_pct": float(dh.mean_error_pct),
+        "den_haan_pinned_converged": bool(sol.converged),
+    }
+    print(f"den Haan dynamic forecast error (pinned-histogram engine): "
+          f"max {fields['den_haan_pinned_max_error_pct']:.3f} %  "
+          f"mean {fields['den_haan_pinned_mean_error_pct']:.3f} %  "
+          f"(panel rule above: the MC-fit slope's off-path drift; "
+          f"see models/diagnostics.py)")
+    return (sol, economy), fields
+
+
+def _run_histogram_extra(args, econ_dict, agent_dict, info, timer, stats,
+                         solved=None):
+    """Beyond-parity: the deterministic histogram engine's own fixed point
+    on the same calibration, so results.json reports BOTH simulators'
+    wealth statistics (VERDICT r2 next-round item 3).  Skipped when the
+    main run already used the distribution engine.  ``solved``: an
+    already-computed ``(sol, economy)`` pair from the den Haan
+    side-by-side, reused instead of re-solving."""
+    if args.sim_method == "distribution":
+        return None
+    if solved is not None:
+        sol, economy = solved
+    else:
+        sol, economy = _solve_histogram_engine(args, econ_dict, agent_dict,
+                                               info, timer,
+                                               "histogram_engine")
+    with timer.phase("histogram_stats"):
         grid = economy.reap_state["aNowGrid"][0]
         w = economy.reap_state["aNowWeights"][0]
         ws = stats.wealth_stats(grid, w)
@@ -197,10 +249,15 @@ def main(argv=None):
                          "(aiyagari_hark_tpu/data/scf_lorenz.csv)")
     ap.add_argument("--extras", action="store_true",
                     help="also run the beyond-parity reporting (GE impulse "
-                         "response figure, the histogram engine's own "
-                         "equilibrium for a second wealth-stats readout); "
-                         "off by default so runtime.txt measures the "
-                         "reference-comparable notebook pipeline")
+                         "response figure, the histogram engine's "
+                         "wealth-stats readout); off by default so the "
+                         "'solve' phase in runtime.txt stays the "
+                         "reference-comparable notebook pipeline.  One "
+                         "diagnostic runs regardless: the pinned-engine "
+                         "den Haan side-by-side, in its own "
+                         "'den_haan_pinned' timer phase — compare the "
+                         "reference's 27.12 min against 'solve', not "
+                         "against the total")
     args = ap.parse_args(argv)
     if args.scf_csv and not os.path.exists(args.scf_csv):
         ap.error(f"--scf-csv {args.scf_csv!r} does not exist")
@@ -286,6 +343,14 @@ def main(argv=None):
     print(f"den Haan dynamic forecast error: "
           f"max {float(dh.max_error_pct):.3f} %  "
           f"mean {float(dh.mean_error_pct):.3f} %")
+    # ... and the same diagnostic for the engine that MEETS the den Haan
+    # bar (VERDICT r4 weak-item 4): the deterministic pinned-histogram
+    # solve, reported side by side in results.json.
+    if args.sim_method == "distribution":
+        hist_solved, dh_pin_fields = None, {}
+    else:
+        hist_solved, dh_pin_fields = _pinned_den_haan(
+            args, econ_dict, agent_dict, info, timer)
 
     # -- consumption functions by labor-supply state (cell 21)
     with timer.phase("figures"):
@@ -358,17 +423,23 @@ def main(argv=None):
           f"and the {scf_label} estimates is {lorenz_dist:.4f} "
           f"(reference vs real SCF: 0.9714)")
 
-    # -- beyond-parity extras, OFF by default so runtime.txt measures the
-    # reference-comparable pipeline (VERDICT r2 next-round item 8): the
-    # committed reference runtime covers only the notebook cells, so the
-    # default run must too.
+    # -- beyond-parity extras, OFF by default so the reference-comparable
+    # pipeline stays separately measured (VERDICT r2 next-round item 8):
+    # the committed reference runtime covers only the notebook cells, so
+    # the notebook-cell cost must remain legible.  The den Haan
+    # side-by-side above is the one default-path exception (VERDICT r4
+    # weak-item 4 wants it in the committed artifact); it runs in its own
+    # 'den_haan_pinned' timer phase, so the phase breakdown — not the
+    # total — is the honest comparison surface ('solve' vs the
+    # reference's 27.12 min).
     extras_results: dict = {}
     irf_paths: list = []
     if args.extras:
         irf_paths, extras_results["irf"] = _run_irf_extra(
             args, econ_dict, info, depr, n_states, timer, plt, np)
         extras_results["histogram_engine"] = _run_histogram_extra(
-            args, econ_dict, agent_dict, info, timer, stats)
+            args, econ_dict, agent_dict, info, timer, stats,
+            solved=hist_solved)
 
     # -- runtime + structured results (cell 30 / runtime.txt:1-2)
     os.makedirs(args.output_dir, exist_ok=True)
@@ -390,6 +461,7 @@ def main(argv=None):
         "equilibrium_saving_rate_pct": saving_pct,
         "den_haan_max_error_pct": float(dh.max_error_pct),
         "den_haan_mean_error_pct": float(dh.mean_error_pct),
+        **dh_pin_fields,
         "wealth_stats": {"max": ws.max, "mean": ws.mean,
                          "std": ws.std, "median": ws.median},
         "lorenz_distance": lorenz_dist,

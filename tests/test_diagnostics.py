@@ -56,10 +56,17 @@ def test_forecast_alignment_is_exact_for_pinned_rule(tmp_path):
 
 
 def test_panel_rule_forecast_error_moderate(parity_solution):
-    """The MC-fit rule (the reference's construction) should forecast its
-    own simulation within a few percent — and the diagnostic must be
-    strictly worse than the one-step R² suggests (that is den Haan's
-    point)."""
+    """The MC-fit rule (the reference's construction) is bounded as
+    MODERATE, not accurate: its EIV-attenuated slope (~1.11) compounds
+    sampling deviations off path, so percent-level dynamic error is the
+    expected behavior (committed parity run: max 2.28% / mean 0.42%,
+    ``results.json``; the full explanation lives in the
+    ``models/diagnostics`` module docstring and DESIGN §3).  The engine
+    that claims the den Haan "fraction of a percent" standard is the
+    pinned one — ``test_forecast_alignment_is_exact_for_pinned_rule``
+    asserts its <0.3% bound.  This test catches regressions (a broken
+    rule or simulator blows past these bounds) and den Haan's point that
+    the diagnostic is strictly worse than the one-step R² suggests."""
     st = den_haan_forecast(parity_solution)
     assert 0.0 < float(st.mean_error_pct) < 5.0
     assert float(st.max_error_pct) < 10.0
