@@ -106,8 +106,17 @@ def _batched_solver(dtype, kwargs_items=()):
     def solve_one(crra, rho, sd):
         res = solve_calibration_lean(crra, rho, labor_sd=sd,
                                      dtype=dtype, **model_kwargs)
-        return (res.r_star, res.capital, res.labor, res.bisect_iters,
-                res.egm_iters, res.dist_iters)
+        # ONE stacked output -> ONE device->host materialization: through
+        # the tunneled TPU every np.asarray is its own RPC round trip, so
+        # six separate outputs put ~6 round trips inside the timed wall —
+        # a lane-count-independent cost the lanes_scaling fit measured as
+        # ~0.7 s fixed overhead (VERDICT r4 weak-item 5).  The iteration
+        # counters ride along exactly in the float dtype (values ≪ 2^24).
+        f = res.r_star.dtype
+        return jnp.stack([res.r_star, res.capital, res.labor,
+                          res.bisect_iters.astype(f),
+                          res.egm_iters.astype(f),
+                          res.dist_iters.astype(f)])
 
     return jax.jit(jax.vmap(solve_one))
 
@@ -205,9 +214,9 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
     fn = _batched_solver(dtype, _hashable_kwargs(model_kwargs))
     import time
     t0 = time.perf_counter()
-    r, K, L, iters, egm_it, dist_it = (
-        np.asarray(o) for o in fn(crra, rho, sd))
+    packed = np.asarray(fn(crra, rho, sd))        # [C, 6], one transfer
     wall = time.perf_counter() - t0
+    r, K, L, iters, egm_it, dist_it = packed.T
     if timer is not None:
         timer(wall)
 

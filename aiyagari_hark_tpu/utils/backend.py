@@ -69,10 +69,34 @@ def enable_compilation_cache(path: Optional[str] = None) -> str:
     return path
 
 
-def probe_ambient_backend(timeout_s: float = 120.0) -> Optional[str]:
+def default_probe_timeout_s() -> float:
+    """Shared probe-timeout default: ``AIYAGARI_PROBE_TIMEOUT_S`` env
+    override, else 180 s.  Raised from 120 s because two rounds of
+    driver-time bench captures fell back to CPU on probe timeout while the
+    tunnel was merely slow to init, not down (VERDICT r4 minor item 6) —
+    a longer wait costs one extra minute when the tunnel is genuinely
+    down, but buys headline freshness when it is up.  Lives HERE so every
+    prober (bench, reproduce/facade via ``select_backend``) inherits it,
+    not just one wrapper.  A malformed env value falls back to the
+    default with a warning instead of killing the caller."""
+    raw = os.environ.get("AIYAGARI_PROBE_TIMEOUT_S")
+    if raw is None:
+        return 180.0
+    try:
+        return float(raw)
+    except ValueError:
+        print(f"[backend] ignoring malformed AIYAGARI_PROBE_TIMEOUT_S="
+              f"{raw!r}; using 180", file=sys.stderr)
+        return 180.0
+
+
+def probe_ambient_backend(timeout_s: Optional[float] = None) -> Optional[str]:
     """Name of the backend the ambient environment would initialize, probed
     in a subprocess so a hung TPU tunnel cannot wedge the caller.  None on
-    timeout/failure."""
+    timeout/failure.  ``timeout_s=None`` uses the shared
+    ``default_probe_timeout_s`` (env-tunable)."""
+    if timeout_s is None:
+        timeout_s = default_probe_timeout_s()
     code = "import jax; print('BACKEND=' + jax.default_backend())"
     try:
         out = subprocess.run([sys.executable, "-c", code],
@@ -113,7 +137,7 @@ _RESOLVED: dict = {}
 
 
 def select_backend(backend: str = "auto",
-                   probe_timeout_s: float = 120.0) -> BackendInfo:
+                   probe_timeout_s: Optional[float] = None) -> BackendInfo:
     """Resolve ``backend`` ∈ {"auto", "cpu", "tpu"} into a live platform +
     dtype + precision configuration.  Raises RuntimeError for ``"tpu"`` when
     no accelerator answers the probe.
