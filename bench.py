@@ -273,10 +273,23 @@ def _fine_cpu_metrics(timeout_s: float = 600.0):
     return None
 
 
+def _pack3(res):
+    """Stack (r_star, egm_iters, dist_iters) into ONE array so the timed
+    wall contains a single device->host transfer (the round-5 packing
+    rationale, ``parallel/sweep._batched_solver``); the counters ride
+    along exactly in the float dtype (values ≪ 2^24)."""
+    import jax.numpy as jnp
+
+    f = res.r_star.dtype
+    return jnp.stack([res.r_star, res.egm_iters.astype(f),
+                      res.dist_iters.astype(f)])
+
+
 def _timed_fine_solve(dist_method: str, timer, phase: str):
     """Compile + honestly time one fine-grid GE solve with the given
     distribution method.  Returns (wall, r_star, egm_iters, dist_iters)."""
     import jax
+    import numpy as np
 
     from aiyagari_hark_tpu.models.equilibrium import solve_calibration_lean
 
@@ -285,16 +298,13 @@ def _timed_fine_solve(dist_method: str, timer, phase: str):
 
     @jax.jit
     def solve_fine(rho):
-        r = solve_calibration_lean(1.0, rho, **kwargs)
-        return r.r_star, r.egm_iters, r.dist_iters
+        return _pack3(solve_calibration_lean(1.0, rho, **kwargs))
 
-    import numpy as np
     with timer.phase(f"{phase}_compile"):
         jax.block_until_ready(solve_fine(0.3))       # compile + warm-up
     with timer.phase(phase):
         t0 = time.perf_counter()
-        r_star, egm_it, dist_it = (np.asarray(o)
-                                   for o in solve_fine(0.3 + PERTURB))
+        r_star, egm_it, dist_it = np.asarray(solve_fine(0.3 + PERTURB))
         wall = time.perf_counter() - t0
     return wall, float(r_star), float(egm_it), float(dist_it)
 
@@ -315,17 +325,17 @@ def _timed_fine_lanes(n_lanes: int, dist_method: str, timer):
     @jax.jit
     def solve_lanes(rho_vec):
         def one(rho):
-            r = solve_calibration_lean(1.0, rho, **kwargs)
-            return r.r_star, r.egm_iters, r.dist_iters
+            # one stacked output per lane -> one [L, 3] transfer total
+            return _pack3(solve_calibration_lean(1.0, rho, **kwargs))
         return jax.vmap(one)(rho_vec)
 
     with timer.phase("fine_lanes_compile"):
         jax.block_until_ready(solve_lanes(rhos))     # compile + warm-up
     with timer.phase("fine_lanes"):
         t0 = time.perf_counter()
-        _, egm_it, dist_it = (np.asarray(o)
-                              for o in solve_lanes(rhos + PERTURB))
+        packed = np.asarray(solve_lanes(rhos + PERTURB))   # [L, 3]
         wall = time.perf_counter() - t0
+    _, egm_it, dist_it = packed.T
     return wall, float(egm_it.sum()), float(dist_it.sum())
 
 
