@@ -110,5 +110,16 @@ from .models.value import (  # noqa: F401
     value_at,
 )
 from .parallel.sweep import SweepResult, run_table2_sweep  # noqa: F401
+from .solver_health import (  # noqa: F401
+    CONVERGED,
+    MAX_ITER,
+    NONFINITE,
+    STALLED,
+    SolverDivergenceError,
+    combine_status,
+    inject_fault,
+    is_failure,
+    status_name,
+)
 from .utils.backend import BackendInfo, select_backend  # noqa: F401
 from .utils.config import AgentConfig, EconomyConfig, SweepConfig  # noqa: F401

@@ -34,6 +34,11 @@ from .models.ks_solver import KSSolution, solve_ks_economy
 from .models.simulate import simulate_markov_history
 from .ops.interp import interp1d, interp_on_interp
 from .ops.markov import aggregate_markov_matrix
+from .solver_health import (
+    NONFINITE,
+    SolverDivergenceError,
+    status_name,
+)
 from .utils.config import (
     MGRID_BASE_DEFAULT,
     AgentConfig,
@@ -300,6 +305,15 @@ class AiyagariEconomy:
         result surface.  With ``backend`` set on the economy, the platform/
         dtype/precision are resolved coherently first (utils.backend).
 
+        Solver health: a diverged solve raises
+        ``solver_health.SolverDivergenceError`` — carrying the per-
+        iteration status trail — instead of returning silent garbage:
+        either from inside ``solve_ks_economy`` (non-finite saving-rule
+        regression) or here, when the solved history/prices come back
+        non-finite.  A merely-unconverged solve (``max_loops`` exhausted)
+        still returns, with ``solution.converged=False`` and
+        ``solution.status`` carrying the ``solver_health`` code.
+
         Extra keyword arguments flow to ``solve_ks_economy`` — notably
         ``sim_method="distribution"`` selects the deterministic histogram
         simulator; ``reap_state["aNow"]`` then carries an equal-weight
@@ -319,6 +333,22 @@ class AiyagariEconomy:
             agent.agent_config(), self._economy_config_for(agent),
             seed=self.seed, ks_employment=ks_employment, dtype=dtype,
             mrkv_hist=self.MrkvNow_hist, **solve_kwargs)
+        # the regression tripwire inside solve_ks_economy catches rule
+        # divergence; this guard catches garbage that never reaches the
+        # rule (e.g. a non-finite simulated price path on the final pass)
+        final_vals = np.asarray([float(sol.history.A_prev[-1]),
+                                 float(sol.history.M_now[-1])])
+        if sol.status == NONFINITE or not np.isfinite(final_vals).all():
+            raise SolverDivergenceError(
+                f"economy.solve() produced non-finite results "
+                f"(status={status_name(sol.status)}, final A/M="
+                f"{final_vals.tolist()}); the status trail is attached — "
+                f"refusing to populate sow_state/reap_state with garbage",
+                status=NONFINITE,
+                trail=[{"iteration": r.iteration, "distance": r.distance,
+                        "egm_status": r.egm_status,
+                        "egm_status_name": status_name(r.egm_status)}
+                       for r in sol.records])
         self.solution = sol
         self._populate_results(sol, agent)
         return sol

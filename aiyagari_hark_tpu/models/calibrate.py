@@ -23,6 +23,7 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
+from ..solver_health import CONVERGED, is_failure
 from .equilibrium import _bisect, solve_equilibrium_lean
 from .heterogeneity import (
     population_distribution,
@@ -38,9 +39,13 @@ class CalibrationResult(NamedTuple):
     achieved: jnp.ndarray    # target quantity at the last evaluated
                              # parameter (within bracket tol of `value`)
     iterations: jnp.ndarray
-    converged: jnp.ndarray   # |achieved - target| <= target_tol; False
-                             # when the target is outside the bracket's
-                             # range (bisection collapses to an endpoint)
+    converged: jnp.ndarray   # |achieved - target| <= target_tol AND the
+                             # bisection exited healthy; False when the
+                             # target is outside the bracket's range
+                             # (bisection collapses to an endpoint) or
+                             # the solve tripped a solver_health failure
+    status: jnp.ndarray = CONVERGED  # the _bisect exit's solver_health
+                             # code (NONFINITE = a trial solve went NaN)
 
 
 def calibrate_discount_factor(model: SimpleModel, target_r, crra,
@@ -69,14 +74,15 @@ def calibrate_discount_factor(model: SimpleModel, target_r, crra,
                                     depr_fac, **solver_kwargs)
         return target_r - eq.r_star, eq.r_star
 
-    beta, iters, achieved = _bisect(excess,
+    beta, iters, achieved, status = _bisect(excess,
                                     jnp.asarray(beta_lo, dtype=dtype),
                                     jnp.asarray(beta_hi, dtype=dtype),
                                     beta_tol, max_iter,
                                     aux_init=jnp.zeros((), dtype=dtype))
     return CalibrationResult(
         value=beta, achieved=achieved, iterations=iters,
-        converged=jnp.abs(achieved - target_r) <= target_tol)
+        converged=((jnp.abs(achieved - target_r) <= target_tol)
+                   & ~is_failure(status)), status=status)
 
 
 def gini_histogram(grid, masses):
@@ -143,13 +149,14 @@ def calibrate_beta_spread(model: SimpleModel, target_gini, center, crra,
         # increasing-excess contract directly
         return g - target_gini, g
 
-    spread, iters, achieved = _bisect(
+    spread, iters, achieved, status = _bisect(
         excess, jnp.asarray(spread_lo, dtype=dtype),
         jnp.asarray(spread_hi, dtype=dtype), spread_tol, max_iter,
         aux_init=jnp.zeros((), dtype=dtype))
     return CalibrationResult(
         value=spread, achieved=achieved, iterations=iters,
-        converged=jnp.abs(achieved - target_gini) <= target_tol)
+        converged=((jnp.abs(achieved - target_gini) <= target_tol)
+                   & ~is_failure(status)), status=status)
 
 
 class LorenzFit(NamedTuple):
@@ -259,11 +266,12 @@ def calibrate_labor_weight(model: LaborModel, target_hours, disc_fac,
                                      dist_tol=dist_tol)
         return target_hours - eq.mean_hours, eq.mean_hours
 
-    log_chi, iters, achieved = _bisect(
+    log_chi, iters, achieved, status = _bisect(
         excess,
         jnp.asarray(jnp.log(chi_lo), dtype=base_dtype),
         jnp.asarray(jnp.log(chi_hi), dtype=base_dtype),
         chi_tol, max_iter, aux_init=jnp.zeros((), dtype=base_dtype))
     return CalibrationResult(
         value=jnp.exp(log_chi), achieved=achieved, iterations=iters,
-        converged=jnp.abs(achieved - target_hours) <= target_tol)
+        converged=((jnp.abs(achieved - target_hours) <= target_tol)
+                   & ~is_failure(status)), status=status)

@@ -124,7 +124,7 @@ def solve_ez_household(R, W, model: SimpleModel, disc_fac, rho, gamma,
     plain beta rate regardless of c, and a c-only certificate would hand
     ``aggregate_ez_welfare`` an under-converged V (measured ~40x).
     ``accel_every=0`` disables acceleration.  Returns
-    (EZPolicy, n_iter, final_diff)."""
+    (EZPolicy, n_iter, final_diff, status)."""
     p0 = initial_ez_policy(model) if init_policy is None else init_policy
     return accelerated_policy_fixed_point(
         lambda p: egm_step_ez(p, R, W, model, disc_fac, rho, gamma),
@@ -155,6 +155,7 @@ class EZEquilibrium(NamedTuple):
     policy: EZPolicy
     distribution: jnp.ndarray
     bisect_iters: jnp.ndarray
+    status: jnp.ndarray = 0    # solver_health code of the bisection exit
 
 
 def solve_ez_equilibrium(model: SimpleModel, disc_fac, rho, gamma,
@@ -186,9 +187,9 @@ def solve_ez_equilibrium(model: SimpleModel, disc_fac, rho, gamma,
     def supply_at(r):
         k_to_l = k_to_l_from_r(r, cap_share, depr_fac)
         W = wage_rate(k_to_l, cap_share)
-        pol, _, _ = solve_ez_household(1.0 + r, W, model, disc_fac, rho,
+        pol, _, _, _ = solve_ez_household(1.0 + r, W, model, disc_fac, rho,
                                        gamma, tol=egm_tol)
-        dist, _, _ = stationary_wealth(as_household_policy(pol), 1.0 + r,
+        dist, _, _, _ = stationary_wealth(as_household_policy(pol), 1.0 + r,
                                        W, model, tol=dist_tol)
         return aggregate_capital(dist, model), pol, dist, W
 
@@ -196,11 +197,12 @@ def solve_ez_equilibrium(model: SimpleModel, disc_fac, rho, gamma,
         supply, _, _, _ = supply_at(r)
         return supply - k_to_l_from_r(r, cap_share, depr_fac) * labor
 
-    r_star, iters = _bisect(excess, r_lo, r_hi, r_tol, max_bisect)
+    r_star, iters, status = _bisect(excess, r_lo, r_hi, r_tol, max_bisect)
     supply, pol, dist, W = supply_at(r_star)
     demand = k_to_l_from_r(r_star, cap_share, depr_fac) * labor
     y = output(supply, labor, cap_share)
     return EZEquilibrium(r_star=r_star, wage=W, capital=supply,
                          labor=labor, saving_rate=depr_fac * supply / y,
                          excess=supply - demand, policy=pol,
-                         distribution=dist, bisect_iters=iters)
+                         distribution=dist, bisect_iters=iters,
+                         status=status)

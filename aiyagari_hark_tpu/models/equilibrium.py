@@ -22,6 +22,12 @@ import jax
 import jax.numpy as jnp
 
 from . import firm
+from ..solver_health import (
+    CONVERGED,
+    MAX_ITER,
+    NONFINITE,
+    combine_status,
+)
 from .household import (
     HouseholdPolicy,
     SimpleModel,
@@ -45,6 +51,7 @@ class EquilibriumResult(NamedTuple):
     policy: HouseholdPolicy
     distribution: jnp.ndarray    # [D, N] stationary wealth distribution
     bisect_iters: jnp.ndarray
+    status: jnp.ndarray = CONVERGED  # worst solver_health code observed
 
 
 class SupplyEval(NamedTuple):
@@ -57,39 +64,55 @@ class SupplyEval(NamedTuple):
     k_to_l: jnp.ndarray
     egm_iters: jnp.ndarray       # EGM backward steps taken to the fixed point
     dist_iters: jnp.ndarray      # distribution-iteration steps taken
+    status: jnp.ndarray = CONVERGED  # worst of the two inner loops' codes
 
 
 def household_capital_supply(r, model: SimpleModel, disc_fac, crra,
                              cap_share, depr_fac, prod=1.0,
                              egm_tol=1e-6, dist_tol=1e-11,
                              init_policy=None, init_dist=None,
-                             dist_method: str = "auto") -> SupplyEval:
+                             dist_method: str = "auto",
+                             accel_every: int | None = None) -> SupplyEval:
     """A(r): solve the household at prices implied by r, return stationary
-    capital plus the objects (policy, distribution, W) and iteration counts
-    (the work model behind the grid-points/sec benchmark metric).
+    capital plus the objects (policy, distribution, W), iteration counts
+    (the work model behind the grid-points/sec benchmark metric), and the
+    worst ``solver_health`` status of the two inner fixed points.
 
     ``init_policy``/``init_dist`` warm-start the two inner fixed points —
     the bisection loop passes the previous midpoint's solution, cutting the
     inner iteration counts severalfold at identical answers (both loops
-    converge to r-dependent fixed points regardless of start)."""
+    converge to r-dependent fixed points regardless of start).
+
+    ``accel_every=0`` disables the Anderson extrapolation in BOTH inner
+    loops (plain damped iteration — the sweep retry ladder's safe mode);
+    ``None`` keeps each loop's own default cadence."""
     k_to_l = firm.k_to_l_from_r(r, cap_share, depr_fac, prod)
     W = firm.wage_rate(k_to_l, cap_share, prod)
     R = 1.0 + r
-    policy, egm_it, _ = solve_household(R, W, model, disc_fac, crra,
-                                        tol=egm_tol, init_policy=init_policy)
-    dist, dist_it, _ = stationary_wealth(policy, R, W, model, tol=dist_tol,
-                                         init_dist=init_dist,
-                                         method=dist_method)
+    egm_kw = {} if accel_every is None else {"accel_every": accel_every}
+    policy, egm_it, _, egm_status = solve_household(
+        R, W, model, disc_fac, crra, tol=egm_tol, init_policy=init_policy,
+        **egm_kw)
+    dist, dist_it, _, dist_status = stationary_wealth(
+        policy, R, W, model, tol=dist_tol, init_dist=init_dist,
+        method=dist_method, **egm_kw)
     return SupplyEval(aggregate_capital(dist, model), policy, dist, W,
-                      k_to_l, egm_it, dist_it)
+                      k_to_l, egm_it, dist_it,
+                      combine_status(egm_status, dist_status))
 
 
 def _bisection_setup(model: SimpleModel, disc_fac, depr_fac,
-                     r_tol, egm_tol, dist_tol):
+                     r_tol, egm_tol, dist_tol, bracket_pad: float = 1.0):
     """Shared bisection machinery: dtype-aware tolerance defaults (the f64
     values are unreachable in f32 and would force every inner loop to its
     iteration cap) and the economic bracket [-delta+eps, (1-beta)/beta-eps]
-    (supply diverges at the top, demand at the bottom)."""
+    (supply diverges at the top, demand at the bottom).
+
+    ``bracket_pad`` scales the edge margins: the supply map loses
+    contraction near the bracket edges (Cao-Luo-Nie 1905.13045 /
+    Ma-Stachurski-Toda 1812.01320), so the sweep's retry ladder re-runs a
+    failed cell with a larger pad, trading a few basis points of bracket
+    reach for distance from the singular endpoints."""
     dtype = model.a_grid.dtype
     f64 = dtype == jnp.float64
     if r_tol is None:
@@ -98,8 +121,9 @@ def _bisection_setup(model: SimpleModel, disc_fac, depr_fac,
         egm_tol = 1e-6 if f64 else 1e-5
     if dist_tol is None:
         dist_tol = 1e-11 if f64 else 1e-8
-    r_hi = jnp.asarray(1.0 / disc_fac - 1.0 - 1e-4, dtype=dtype)
-    r_lo = jnp.asarray(-depr_fac + 1e-3, dtype=dtype)
+    r_hi = jnp.asarray(1.0 / disc_fac - 1.0 - 1e-4 * bracket_pad,
+                       dtype=dtype)
+    r_lo = jnp.asarray(-depr_fac + 1e-3 * bracket_pad, dtype=dtype)
     return r_tol, egm_tol, dist_tol, r_lo, r_hi
 
 
@@ -108,24 +132,30 @@ def _bisect(excess_fn, r_lo, r_hi, r_tol, max_bisect: int,
     """Fixed-trip bisection on an excess map that is increasing in r:
     positive excess moves the upper bracket down.  Shared by every
     interest-rate market-clearing loop (homogeneous, beta-dist) and the
-    calibration inversions.  Returns ``(r_star, iterations)``; fully
-    jit/vmap-safe.
+    calibration inversions.  Returns ``(r_star, iterations, status)``;
+    fully jit/vmap-safe.
+
+    Solver health: a non-finite excess evaluation trips the in-carry
+    flag — the bracket is NOT moved by the garbage sign (``NaN > 0`` is
+    False, which would silently collapse the upper bracket) and the loop
+    exits immediately with status NONFINITE.  A bracket still wider than
+    ``r_tol`` at the trip cap is MAX_ITER; otherwise CONVERGED.
 
     ``aux_init``: if given, ``excess_fn`` must return ``(excess, aux)``
     and the last evaluation's aux rides the loop state — callers that
     want the quantity AT the root (e.g. calibration's "achieved") get it
     without re-solving after the loop.  Returns
-    ``(r_star, iterations, aux_last)`` in that mode.  The first midpoint
-    evaluation runs eagerly (before the ``while_loop``) so aux is a real
-    evaluation even when the loop body never executes (initial bracket
-    already within ``r_tol``, or ``max_bisect=0`` — which therefore still
-    costs one evaluation in aux mode); the total evaluation cap stays
-    ``max_bisect``."""
+    ``(r_star, iterations, aux_last, status)`` in that mode.  The first
+    midpoint evaluation runs eagerly (before the ``while_loop``) so aux
+    is a real evaluation even when the loop body never executes (initial
+    bracket already within ``r_tol``, or ``max_bisect=0`` — which
+    therefore still costs one evaluation in aux mode); the total
+    evaluation cap stays ``max_bisect``."""
     with_aux = aux_init is not None
 
     def cond(state):
-        lo, hi, it = state[0], state[1], state[2]
-        return ((hi - lo) > r_tol) & (it < max_bisect)
+        lo, hi, it, ok = state[0], state[1], state[2], state[3]
+        return ((hi - lo) > r_tol) & (it < max_bisect) & ok
 
     def body(state):
         lo, hi, it = state[0], state[1], state[2]
@@ -134,18 +164,26 @@ def _bisect(excess_fn, r_lo, r_hi, r_tol, max_bisect: int,
             ex, aux = excess_fn(mid)
         else:
             ex = excess_fn(mid)
-        lo = jnp.where(ex > 0, lo, mid)
-        hi = jnp.where(ex > 0, mid, hi)
-        return (lo, hi, it + 1, aux) if with_aux else (lo, hi, it + 1)
+        ok = jnp.isfinite(ex)
+        up = ex > 0
+        lo = jnp.where(ok & ~up, mid, lo)
+        hi = jnp.where(ok & up, mid, hi)
+        return (lo, hi, it + 1, ok, aux) if with_aux else (lo, hi, it + 1,
+                                                           ok)
 
     if with_aux:
-        init = body((r_lo, r_hi, jnp.asarray(0), aux_init))
+        init = body((r_lo, r_hi, jnp.asarray(0), jnp.asarray(True),
+                     aux_init))
     else:
-        init = (r_lo, r_hi, jnp.asarray(0))
+        init = (r_lo, r_hi, jnp.asarray(0), jnp.asarray(True))
     out = jax.lax.while_loop(cond, body, init)
+    lo, hi, it, ok = out[0], out[1], out[2], out[3]
+    status = jnp.where(~ok, jnp.int32(NONFINITE),
+                       jnp.where((hi - lo) > r_tol, jnp.int32(MAX_ITER),
+                                 jnp.int32(CONVERGED)))
     if with_aux:
-        return 0.5 * (out[0] + out[1]), out[2], out[3]
-    return 0.5 * (out[0] + out[1]), out[2]
+        return 0.5 * (lo + hi), it, out[4], status
+    return 0.5 * (lo + hi), it, status
 
 
 def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
@@ -173,18 +211,21 @@ def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
         demand = firm.k_to_l_from_r(r, cap_share, depr_fac, prod) * labor
         return supply - demand
 
-    r_star, iters = _bisect(excess_supply, r_lo, r_hi, r_tol, max_bisect)
+    r_star, iters, bisect_status = _bisect(excess_supply, r_lo, r_hi,
+                                           r_tol, max_bisect)
 
-    supply, policy, dist, wage, k_to_l, _, _ = household_capital_supply(
+    ev = household_capital_supply(
         r_star, model, disc_fac, crra, cap_share, depr_fac, prod,
         egm_tol=egm_tol, dist_tol=dist_tol)
+    supply, wage, k_to_l = ev.supply, ev.wage, ev.k_to_l
     demand = k_to_l * labor
     output = prod * supply ** cap_share * labor ** (1.0 - cap_share)
     saving_rate = depr_fac * supply / output
     return EquilibriumResult(
         r_star=r_star, wage=wage, capital=supply, labor=labor,
-        saving_rate=saving_rate, excess=supply - demand, policy=policy,
-        distribution=dist, bisect_iters=iters)
+        saving_rate=saving_rate, excess=supply - demand, policy=ev.policy,
+        distribution=ev.distribution, bisect_iters=iters,
+        status=combine_status(bisect_status, ev.status))
 
 
 class LeanEquilibrium(NamedTuple):
@@ -203,6 +244,9 @@ class LeanEquilibrium(NamedTuple):
     bisect_iters: jnp.ndarray
     egm_iters: jnp.ndarray   # total EGM backward steps across all midpoints
     dist_iters: jnp.ndarray  # total distribution-iteration steps
+    status: jnp.ndarray = CONVERGED  # solver_health code for the cell:
+    # worst of (bracket exit, last midpoint's inner fixed points, the
+    # non-finite tripwire); `parallel.sweep` quarantines on is_failure()
 
 
 def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
@@ -211,7 +255,11 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
                            egm_tol: float | None = None,
                            dist_tol: float | None = None,
                            dist_method: str = "auto",
-                           root_method: str = "bisect") -> LeanEquilibrium:
+                           root_method: str = "bisect",
+                           accel_every: int | None = None,
+                           bracket_pad: float = 1.0,
+                           fault_iter=None,
+                           fault_mode: str = "nan") -> LeanEquilibrium:
     """Bracketed root-finding equilibrium that carries the supply evaluation
     through the loop state instead of re-solving the household at ``r_star``
     afterwards.
@@ -232,9 +280,29 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
     rose ~17%).  Fewer-but-colder beats more-but-warmer only without the
     warm-start carry — use "illinois" for single cold solves at loose
     inner tolerances, "bisect" for warm-started sweep lanes.
+
+    Solver health: the returned ``status`` is the worst ``solver_health``
+    code seen — the bracket exit (MAX_ITER when the trip cap leaves the
+    bracket wider than ``r_tol``), the LAST midpoint's inner fixed-point
+    statuses (they ride the loop state like the supply does), and an
+    in-loop non-finite tripwire on the excess (a NaN excess would
+    otherwise one-side the bracket silently AND poison every later
+    midpoint through the warm-start carry; the loop instead freezes the
+    bracket and exits NONFINITE immediately).  ``accel_every=0`` /
+    ``bracket_pad`` are the sweep retry ladder's knobs (see
+    ``household_capital_supply`` / ``_bisection_setup``).
+
+    ``fault_iter``/``fault_mode`` are the deterministic fault-injection
+    hook (``solver_health``): at bisection trip ``fault_iter`` (may be
+    traced; negative = never, which is the vmapped sweep's "this lane is
+    healthy" encoding), mode "nan" poisons the excess evaluation (the
+    NONFINITE tripwire path), mode "stall" freezes the bracket so the
+    loop burns its trip cap (the MAX_ITER path).  ``None`` compiles the
+    hook out entirely.
     """
     r_tol, egm_tol, dist_tol, r_lo, r_hi = _bisection_setup(
-        model, disc_fac, depr_fac, r_tol, egm_tol, dist_tol)
+        model, disc_fac, depr_fac, r_tol, egm_tol, dist_tol,
+        bracket_pad=bracket_pad)
     labor = aggregate_labor(model)
     dtype = model.a_grid.dtype
     zero = jnp.zeros((), dtype=dtype)
@@ -256,10 +324,12 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
     def cond(state):
         lo, hi = state[0], state[1]
         it = state[4]
-        return ((hi - lo) > r_tol) & (it < max_bisect)
+        ok = state[11]
+        return ((hi - lo) > r_tol) & (it < max_bisect) & ok
 
     def body(state):
-        lo, hi, f_lo, f_hi, it, _, egm_acc, dist_acc, policy, dist = state
+        (lo, hi, f_lo, f_hi, it, _, egm_acc, dist_acc, policy, dist,
+         _, _) = state
         if use_illinois:
             # Illinois (modified regula falsi): secant point from the
             # stored endpoint values, clipped to the bracket interior.
@@ -277,12 +347,31 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
         ev = household_capital_supply(
             mid, model, disc_fac, crra, cap_share, depr_fac, prod,
             egm_tol=egm_tol, dist_tol=dist_tol,
-            init_policy=policy, init_dist=dist, dist_method=dist_method)
+            init_policy=policy, init_dist=dist, dist_method=dist_method,
+            accel_every=accel_every)
         demand = firm.k_to_l_from_r(mid, cap_share, depr_fac, prod) * labor
         ex = ev.supply - demand
+        freeze = jnp.asarray(False)
+        if fault_iter is not None:
+            # deterministic fault injection (see docstring): active only
+            # when the traced fault_iter is non-negative
+            hit = (jnp.asarray(fault_iter) >= 0) & (it
+                                                    >= jnp.asarray(fault_iter))
+            if fault_mode == "nan":
+                ex = jnp.where(hit, jnp.nan, ex)
+            elif fault_mode == "stall":
+                freeze = hit
+            else:
+                raise ValueError(f"fault_mode={fault_mode!r}: expected "
+                                 "'nan' or 'stall'")
+        ok = jnp.isfinite(ex)
         up = ex > 0   # excess supply increasing in r: root is below mid
-        new_lo = jnp.where(up, lo, mid)
-        new_hi = jnp.where(up, mid, hi)
+        # a non-finite excess (or an injected stall) must not move the
+        # bracket: NaN > 0 is False, which would silently collapse the
+        # upper end — freeze it and let the tripwire exit the loop
+        move = ok & ~freeze
+        new_lo = jnp.where(move & ~up, mid, lo)
+        new_hi = jnp.where(move & up, mid, hi)
         # replace the moved endpoint's value with the real one; HALVE the
         # retained endpoint's value (the Illinois anti-stagnation rule —
         # pulls the next secant point toward the stale side)
@@ -290,15 +379,27 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
         new_f_hi = jnp.where(up, ex, 0.5 * f_hi)
         return (new_lo, new_hi, new_f_lo, new_f_hi, it + 1, ev.supply,
                 egm_acc + ev.egm_iters, dist_acc + ev.dist_iters,
-                ev.policy, ev.distribution)
+                ev.policy, ev.distribution, ev.status, ok)
 
-    lo, hi, _, _, iters, supply, egm_iters, dist_iters, _, _ = \
-        jax.lax.while_loop(cond, body,
-                           (r_lo, r_hi, -one, one, zi, zero, zi, zi,
-                            p0, d0))
+    (lo, hi, _, _, iters, supply, egm_iters, dist_iters, _, _,
+     inner_status, ok) = jax.lax.while_loop(
+        cond, body,
+        (r_lo, r_hi, -one, one, zi, zero, zi, zi, p0, d0,
+         jnp.int32(CONVERGED), jnp.asarray(True)))
+    # worst of: the non-finite tripwire, the bracket exit, and the LAST
+    # midpoint's inner fixed-point statuses (earlier midpoints' inner
+    # exits don't certify anything about the returned objects; a
+    # NONFINITE one cannot be missed — it poisons the excess and trips
+    # `ok` on that very evaluation)
+    status = combine_status(
+        jnp.where(~ok, jnp.int32(NONFINITE), jnp.int32(CONVERGED)),
+        jnp.where((hi - lo) > r_tol, jnp.int32(MAX_ITER),
+                  jnp.int32(CONVERGED)),
+        inner_status)
     return LeanEquilibrium(r_star=0.5 * (lo + hi), capital=supply,
                            labor=labor, bisect_iters=iters,
-                           egm_iters=egm_iters, dist_iters=dist_iters)
+                           egm_iters=egm_iters, dist_iters=dist_iters,
+                           status=status)
 
 
 def _solve_cell(solver, crra, labor_ar, labor_sd=0.2, labor_states=7,

@@ -46,6 +46,7 @@ class HeterogeneousEquilibrium(NamedTuple):
     distributions: jnp.ndarray   # [J, D, N] per-type stationary wealth
     weights: jnp.ndarray         # [J] population shares (echoed back)
     bisect_iters: jnp.ndarray
+    status: jnp.ndarray = 0      # solver_health code of the bisection exit
 
 
 def uniform_beta_types(center: float, spread: float,
@@ -115,7 +116,8 @@ def solve_heterogeneous_equilibrium(model: SimpleModel, disc_facs,
         demand = firm.k_to_l_from_r(r, cap_share, depr_fac, prod) * labor
         return supply - demand
 
-    r_star, iters = _bisect(excess_supply, r_lo, r_hi, r_tol, max_bisect)
+    r_star, iters, status = _bisect(excess_supply, r_lo, r_hi, r_tol,
+                                    max_bisect)
 
     supply, supply_j, policies, dists, wage = heterogeneous_capital_supply(
         r_star, model, disc_facs, weights, crra, cap_share, depr_fac,
@@ -126,7 +128,7 @@ def solve_heterogeneous_equilibrium(model: SimpleModel, disc_facs,
         r_star=r_star, wage=wage, capital=supply, labor=labor,
         saving_rate=depr_fac * supply / y, excess=supply - demand,
         type_capital=supply_j, policies=policies, distributions=dists,
-        weights=weights, bisect_iters=iters)
+        weights=weights, bisect_iters=iters, status=status)
 
 
 def population_distribution(eq: HeterogeneousEquilibrium) -> jnp.ndarray:

@@ -37,6 +37,11 @@ from ..ops.markov import (
     tauchen_labor_process,
 )
 from ..ops.utility import inverse_marginal_utility, marginal_utility
+from ..solver_health import (
+    NONFINITE,
+    call_step,
+    classify_fixed_point_exit,
+)
 
 # The reference's borrowing-constraint knot value (Aiyagari_Support.py:1503).
 CONSTRAINT_EPS = 1e-7
@@ -186,9 +191,19 @@ def accelerated_policy_fixed_point(step_fn, p0, tol: float, max_iter: int,
     PLAIN iterate its diff certifies — a ``max_iter`` exit landing on an
     acceleration step can never hand the caller an unevaluated
     extrapolation.  ``accel_every=0`` disables.  Returns
-    (policy, n_iter, final_diff).
+    (policy, n_iter, final_diff, status).
+
+    Solver health: a non-finite sup-norm diff (NaN compares False against
+    ``tol``, so it would otherwise exit looking exactly like convergence;
+    +inf would burn the whole ``max_iter`` budget) trips the in-carry
+    finiteness flag and exits immediately; the trailing ``status`` is a
+    ``solver_health`` code (CONVERGED / MAX_ITER / NONFINITE here — this
+    loop has no stall exit).  ``step_fn`` may advertise
+    ``takes_iteration`` to receive the iteration index
+    (``solver_health.inject_fault``).
     """
-    big = jnp.asarray(jnp.inf, dtype=p0.c_knots.dtype)
+    big = jnp.asarray(jnp.finfo(p0.c_knots.dtype).max,
+                      dtype=p0.c_knots.dtype)
     fields = p0._fields
 
     def tree_diff(a, b):
@@ -201,15 +216,15 @@ def accelerated_policy_fixed_point(step_fn, p0, tol: float, max_iter: int,
             [(getattr(a, f) - getattr(b, f)).ravel() for f in fields])
 
     def cond(state):
-        _, _, _, diff, it = state
-        return (diff > tol) & (it < max_iter)
+        _, _, _, diff, it, finite = state
+        return (diff > tol) & (it < max_iter) & finite
 
     def step(policy, prev, it):
-        new = step_fn(policy)
+        new = call_step(step_fn, policy, it)
         return new, policy, new, tree_diff(new, policy), it + 1
 
     def step_accel(policy, prev, it):
-        new = step_fn(policy)
+        new = call_step(step_fn, policy, it)
         diff = tree_diff(new, policy)
         lam = anderson_rate(flat(policy, prev), flat(new, policy))
         fac = lam / (1.0 - lam)
@@ -226,14 +241,17 @@ def accelerated_policy_fixed_point(step_fn, p0, tol: float, max_iter: int,
         return out, new, new, diff, it + 1
 
     def body(state):
-        policy, prev, _, _, it = state
+        policy, prev, _, _, it, _ = state
         use_accel = (accel_every > 0) & (jnp.mod(it + 1,
                                                  max(accel_every, 1)) == 0)
-        return jax.lax.cond(use_accel, step_accel, step, policy, prev, it)
+        policy, prev, certified, diff, it = jax.lax.cond(
+            use_accel, step_accel, step, policy, prev, it)
+        return policy, prev, certified, diff, it, jnp.isfinite(diff)
 
-    _, _, certified, diff, it = jax.lax.while_loop(
-        cond, body, (p0, p0, p0, big, jnp.asarray(0)))
-    return certified, it, diff
+    _, _, certified, diff, it, _ = jax.lax.while_loop(
+        cond, body, (p0, p0, p0, big, jnp.asarray(0), jnp.asarray(True)))
+    return certified, it, diff, classify_fixed_point_exit(diff, tol, it,
+                                                          max_iter)
 
 
 def solve_household(R, W, model: SimpleModel, disc_fac, crra,
@@ -244,7 +262,8 @@ def solve_household(R, W, model: SimpleModel, disc_fac, crra,
 
     Convergence is sup-norm on the consumption knots — the array analog of
     HARK's ConsumerSolution distance the reference's agent loop uses
-    (SURVEY.md §3.1).  Returns (policy, n_iter, final_diff).
+    (SURVEY.md §3.1).  Returns (policy, n_iter, final_diff, status) with
+    ``status`` a ``solver_health`` code.
 
     ``init_policy`` warm-starts the iteration (e.g. the previous bisection
     midpoint's policy — nearby prices → nearby fixed points → far fewer
@@ -401,7 +420,8 @@ def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
                       method: str = "auto"):
     """Stationary joint distribution over (wealth, labor state), [D, N].
 
-    Returns (dist, n_iter, final_diff).  ``tol`` is on the sup-norm of the
+    Returns (dist, n_iter, final_diff, status) — ``status`` a
+    ``solver_health`` code.  ``tol`` is on the sup-norm of the
     distribution update; mass is conserved exactly by the lottery scatter
     and restored exactly after each extrapolation.
 
@@ -463,7 +483,12 @@ def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
         S = dense_wealth_operator(trans, d_size)
         fp = _pallas_fixed_point_vmappable(float(tol), int(max_iter),
                                            int(accel_every))
-        return fp(S, model.transition, dist0)
+        dist, it, diff = fp(S, model.transition, dist0)
+        # The kernel's stats contract stays (iters, diff); the status is
+        # fully reconstructible outside: a finite diff > tol before
+        # max_iter can only be the stall window.
+        return dist, it, diff, classify_fixed_point_exit(diff, tol, it,
+                                                         max_iter)
     if method == "solve":
         S = dense_wealth_operator(trans, d_size)
         return _stationary_solve(S, model.transition, dist0, tol)
@@ -523,9 +548,9 @@ def _stationary_solve(S, transition, dist0, tol, refine: int = 2,
     # returning a distribution that misses the caller's dist_tol — the
     # bisection relies on every midpoint meeting the full tolerance.
     push = lambda dd: _push_forward_dense(dd, S, transition)   # noqa: E731
-    dist, it, diff = accelerated_distribution_fixed_point(
+    dist, it, diff, status = accelerated_distribution_fixed_point(
         push, dist, tol, polish_max_iter)
-    return dist, it + jnp.asarray(refine + 1), diff
+    return dist, it + jnp.asarray(refine + 1), diff, status
 
 
 def accelerated_distribution_fixed_point(push, dist0, tol, max_iter,
@@ -533,7 +558,14 @@ def accelerated_distribution_fixed_point(push, dist0, tol, max_iter,
                                          lam_max: float = 0.995):
     """Iterate ``dist <- push(dist)`` to its fixed point with periodic
     Anderson(1)/Aitken extrapolation (see ``stationary_wealth``), for any
-    mass-conserving push-forward operator.  Returns (dist, n_iter, diff).
+    mass-conserving push-forward operator.  Returns
+    (dist, n_iter, diff, status) with ``status`` a ``solver_health`` code:
+    a non-finite step diff trips the in-carry finiteness flag and exits
+    immediately as NONFINITE (NaN would otherwise masquerade as
+    convergence, +inf would burn the budget), the stall window exits
+    STALLED, the budget MAX_ITER, a certified residual CONVERGED.
+    ``push`` may advertise ``takes_iteration``
+    (``solver_health.inject_fault``).
 
     ``lam_max`` caps the estimated contraction rate (extrapolation factor
     ``lam/(1-lam)``).  The default is conservative for cold starts; a
@@ -552,21 +584,22 @@ def accelerated_distribution_fixed_point(push, dist0, tol, max_iter,
     iterate can be worse, e.g. mid-recovery from an extrapolation
     overshoot), so callers always get the honest best residual.
     """
-    big = jnp.asarray(jnp.inf, dtype=dist0.dtype)
+    big = jnp.asarray(jnp.finfo(dist0.dtype).max, dtype=dist0.dtype)
     stall_window = 512
 
     def cond(state):
-        _, _, diff, it, _, _, since = state
-        return (diff > tol) & (it < max_iter) & (since < stall_window)
+        _, _, diff, it, _, _, since, finite = state
+        return ((diff > tol) & (it < max_iter) & (since < stall_window)
+                & finite)
 
     def step(dist, prev, it):
-        new = push(dist)
+        new = call_step(push, dist, it)
         diff = jnp.max(jnp.abs(new - dist))
         # last element: the iterate the certified diff describes
         return new, dist, diff, it + 1, new
 
     def step_accel(dist, prev, it):
-        new = push(dist)
+        new = call_step(push, dist, it)
         diff = jnp.max(jnp.abs(new - dist))
         d1 = dist - prev                    # increment t-1
         d2 = new - dist                     # increment t
@@ -579,7 +612,7 @@ def accelerated_distribution_fixed_point(push, dist0, tol, max_iter,
         return out, new, diff, it + 1, new
 
     def body(state):
-        dist, prev, _, it, best, best_dist, since = state
+        dist, prev, _, it, best, best_dist, since, _ = state
         use_accel = (accel_every > 0) & (jnp.mod(it + 1, max(accel_every, 1))
                                          == 0)
         dist, prev, diff, it, certified = jax.lax.cond(
@@ -588,12 +621,20 @@ def accelerated_distribution_fixed_point(push, dist0, tol, max_iter,
         best_dist = jnp.where(improved, certified, best_dist)
         best = jnp.minimum(best, diff)
         since = jnp.where(improved, 0, since + 1)
-        return dist, prev, diff, it, best, best_dist, since
+        return (dist, prev, diff, it, best, best_dist, since,
+                jnp.isfinite(diff))
 
-    _, _, _, it, best, best_dist, _ = jax.lax.while_loop(
+    _, _, diff, it, best, best_dist, _, _ = jax.lax.while_loop(
         cond, body,
-        (dist0, dist0, big, jnp.asarray(0), big, dist0, jnp.asarray(0)))
-    return best_dist, it, best
+        (dist0, dist0, big, jnp.asarray(0), big, dist0, jnp.asarray(0),
+         jnp.asarray(True)))
+    # Classify on the BEST certified residual (what the returned iterate
+    # honestly achieves), except that a non-finite LAST diff means the
+    # iteration itself was poisoned — that must surface as NONFINITE even
+    # though the returned best iterate predates the poisoning.
+    status = jnp.where(~jnp.isfinite(diff), jnp.int32(NONFINITE),
+                       classify_fixed_point_exit(best, tol, it, max_iter))
+    return best_dist, it, best, status
 
 
 def aggregate_capital(dist: jnp.ndarray, model: SimpleModel) -> jnp.ndarray:

@@ -60,9 +60,9 @@ def net_bond_demand(r, model: SimpleModel, disc_fac, crra,
                     dist_method: str = "auto"):
     """E[a] at rate ``r``: aggregate net bond position of the household
     sector (positive = net savers).  Endowment economy: R = 1 + r, W = 1."""
-    policy, _, _ = solve_household(1.0 + r, 1.0, model, disc_fac, crra,
+    policy, _, _, _ = solve_household(1.0 + r, 1.0, model, disc_fac, crra,
                                    tol=egm_tol, init_policy=init_policy_)
-    dist, _, _ = stationary_wealth(policy, 1.0 + r, 1.0, model,
+    dist, _, _, _ = stationary_wealth(policy, 1.0 + r, 1.0, model,
                                    tol=dist_tol, init_dist=init_dist,
                                    method=dist_method)
     return aggregate_capital(dist, model), policy, dist

@@ -216,7 +216,8 @@ def solve_ks_household(afunc: AFuncParams, cal: KSCalibration,
                        accel_every: int = 32):
     """Infinite-horizon fixed point of the 4N-state EGM step under the given
     perceived aggregate law.  Sup-norm convergence on consumption knots (the
-    array analog of HARK's solution distance).  Returns (policy, iters, diff).
+    array analog of HARK's solution distance).  Returns
+    (policy, iters, diff, status) — ``status`` a ``solver_health`` code.
 
     ``init_policy`` warm-starts the backward iteration — the KS outer loop
     passes the previous outer iteration's policy (the perceived law moves a

@@ -25,6 +25,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.regression import masked_ols
+from ..solver_health import (
+    CONVERGED,
+    MAX_ITER,
+    NONFINITE,
+    SolverDivergenceError,
+    combine_status,
+    status_name,
+)
 from ..utils.config import AgentConfig, EconomyConfig
 from .ks_model import (
     AFuncParams,
@@ -162,6 +170,7 @@ class KSIterationRecord:
     distance: float
     egm_iters: int
     wall_seconds: float
+    egm_status: int = CONVERGED   # solver_health code of the EGM inner solve
 
 
 @dataclass
@@ -176,6 +185,10 @@ class KSSolution:
     dist_grid: object = None     # [D] histogram support (distribution mode)
     records: List[KSIterationRecord] = field(default_factory=list)
     converged: bool = False
+    status: int = CONVERGED      # worst-of-run solver_health code:
+    # CONVERGED, or MAX_ITER when the outer loop exhausted max_loops /
+    # an inner EGM solve left its budget uncertified (a NONFINITE run
+    # never returns — solve_ks_economy raises SolverDivergenceError)
 
     @property
     def equilibrium_r_pct(self) -> float:
@@ -504,8 +517,8 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
         # idempotent reload: rebuild the policy/history the checkpoint does
         # not carry, but leave the converged rule (and the file) untouched
         with timer.phase("solve"):
-            policy, _, _ = jax.block_until_ready(solve_hh(afunc,
-                                                          policy_seed))
+            policy, _, _, egm_status = jax.block_until_ready(
+                solve_hh(afunc, policy_seed))
         with timer.phase("simulate"):
             history, final_panel = jax.block_until_ready(
                 run_panel(policy, k_panel, sim_init,
@@ -516,7 +529,8 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
                           final_panel=final_panel,
                           dist_grid=(dist_grid if sim_method == "distribution"
                                      else None),
-                          records=[], converged=True)
+                          records=[], converged=True,
+                          status=int(egm_status))
 
     records: List[KSIterationRecord] = []
     history = None
@@ -526,7 +540,7 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
     for it in range(it_start, econ.max_loops):
         t0 = time.time()
         with timer.phase("solve"):
-            policy, egm_iters, _ = jax.block_until_ready(
+            policy, egm_iters, _, egm_status = jax.block_until_ready(
                 solve_hh(afunc, policy_seed))
             policy_seed = policy
         k_it = jax.random.fold_in(k_panel, it) if resample_each_iteration \
@@ -541,12 +555,20 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
             new_afunc, rsq = jax.block_until_ready(update(history, afunc))
         if not (bool(jnp.all(jnp.isfinite(new_afunc.intercept)))
                 and bool(jnp.all(jnp.isfinite(new_afunc.slope)))):
-            raise RuntimeError(
+            raise SolverDivergenceError(
                 f"KS outer iteration {it}: saving-rule regression produced "
                 f"non-finite parameters (intercept={new_afunc.intercept}, "
                 f"slope={new_afunc.slope}). Usually an aggregate state never "
                 f"appears in the post-discard window — increase act_T or "
-                f"decrease t_discard.")
+                f"decrease t_discard.",
+                status=NONFINITE,
+                trail=[dataclasses.asdict(r) for r in records] + [{
+                    "iteration": it,
+                    "intercept": [float(x) for x in new_afunc.intercept],
+                    "slope": [float(x) for x in new_afunc.slope],
+                    "egm_status": int(egm_status),
+                    "egm_status_name": status_name(egm_status),
+                }])
         distance = float(jnp.max(jnp.maximum(
             jnp.abs(new_afunc.intercept - afunc.intercept),
             jnp.abs(new_afunc.slope - afunc.slope))))
@@ -557,7 +579,8 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
             slope=[float(x) for x in afunc.slope],
             r_squared=[float(x) for x in rsq],
             distance=distance, egm_iters=int(egm_iters),
-            wall_seconds=time.time() - t0)
+            wall_seconds=time.time() - t0,
+            egm_status=int(egm_status))
         records.append(rec)
         if econ.verbose:
             print(f"[ks] iter {it}: intercept={rec.intercept} "
@@ -590,9 +613,14 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
             break
 
     history, final_panel = finalize(history, final_panel)
+    # worst-of-run health code: the outer loop's own exit combined with
+    # the last inner EGM solve's (a NONFINITE anywhere raised above)
+    last_egm = records[-1].egm_status if records else CONVERGED
+    status = int(combine_status(CONVERGED if converged else MAX_ITER,
+                                last_egm))
     return KSSolution(afunc=afunc, policy=policy, calibration=cal,
                       history=history, mrkv_hist=mrkv_hist,
                       final_panel=final_panel,
                       dist_grid=(dist_grid if sim_method == "distribution"
                                  else None),
-                      records=records, converged=converged)
+                      records=records, converged=converged, status=status)

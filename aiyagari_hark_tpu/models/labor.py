@@ -244,7 +244,7 @@ def stationary_labor_wealth(policy: LaborPolicy, R, W, model: LaborModel,
     trans, c, n = labor_wealth_transition(policy, R, W, model, crra)
     dist0 = (initial_distribution(base) if init_dist is None
              else init_dist)
-    dist, it, diff = accelerated_distribution_fixed_point(
+    dist, it, diff, _ = accelerated_distribution_fixed_point(
         lambda d: _push_forward(d, trans, base.transition),
         dist0, tol, max_iter)
     return dist, c, n, it, diff
@@ -261,6 +261,7 @@ class LaborEquilibrium(NamedTuple):
     policy: LaborPolicy
     distribution: jnp.ndarray
     bisect_iters: jnp.ndarray
+    status: jnp.ndarray = 0        # solver_health code of the bisection exit
 
 
 def _labor_supply_eval(r, model: LaborModel, disc_fac, crra, cap_share,
@@ -452,7 +453,7 @@ def solve_labor_equilibrium(model: LaborModel, disc_fac, crra, cap_share,
         demand = firm.k_to_l_from_r(r, cap_share, depr_fac) * l_s
         return k_s - demand
 
-    r_star, iters = _bisect(excess, r_lo, r_hi, r_tol, max_bisect)
+    r_star, iters, status = _bisect(excess, r_lo, r_hi, r_tol, max_bisect)
     k_s, l_s, hours, policy, dist, W = _labor_supply_eval(
         r_star, model, disc_fac, crra, cap_share, depr_fac, egm_tol,
         dist_tol)
@@ -462,4 +463,4 @@ def solve_labor_equilibrium(model: LaborModel, disc_fac, crra, cap_share,
         r_star=r_star, wage=W, capital=k_s, effective_labor=l_s,
         mean_hours=hours, saving_rate=depr_fac * k_s / y,
         excess=k_s - demand, policy=policy, distribution=dist,
-        bisect_iters=iters)
+        bisect_iters=iters, status=status)

@@ -340,7 +340,8 @@ def stationary_portfolio_wealth(policy: PortfolioPolicy, r_free, wage,
                                 max_iter: int = 20000, init_dist=None,
                                 accel_every: int = 64):
     """Stationary joint distribution over (end-of-period assets, labor
-    state), [D, N].  Returns (dist, n_iter, final_diff).  Uses the shared
+    state), [D, N].  Returns (dist, n_iter, final_diff, status).  Uses
+    the shared
     Aitken-accelerated iteration (``accelerated_distribution_fixed_point``;
     ``accel_every=0`` disables extrapolation); ``init_dist`` warm-starts."""
     trans = portfolio_wealth_transition(policy, r_free, wage, model)
@@ -380,7 +381,7 @@ def _portfolio_supply(r, base: PortfolioModel, eps_draws, premium, disc_fac,
     policy, _, _ = solve_portfolio_household(r_free, wage, model, disc_fac,
                                              crra, tol=egm_tol,
                                              init_policy=init_policy)
-    dist, _, _ = stationary_portfolio_wealth(policy, r_free, wage, model,
+    dist, _, _, _ = stationary_portfolio_wealth(policy, r_free, wage, model,
                                              tol=dist_tol,
                                              init_dist=init_dist)
     omega = _share_on_dist_grid(policy, model)

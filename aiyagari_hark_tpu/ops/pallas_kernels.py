@@ -54,7 +54,9 @@ def _fixed_point_kernel(S_ref, P_ref, d0_ref, out_ref, stats_ref, *,
         moved = jnp.concatenate(cols, axis=1)
         return jnp.matmul(moved, P, precision=jax.lax.Precision.HIGHEST)
 
-    dist, it, diff = accelerated_distribution_fixed_point(
+    # status is dropped at the kernel boundary: the (iters, diff) stats
+    # pair reconstructs it exactly (see ``stationary_wealth``)
+    dist, it, diff, _ = accelerated_distribution_fixed_point(
         push, d0, tol, max_iter, accel_every)
     out_ref[:] = dist
     # full-row store: Mosaic rejects scalar stores into a VMEM ref
@@ -113,7 +115,7 @@ def _fixed_point_kernel_lane(S_ref, P_ref, d0_ref, out_ref, stats_ref, *,
         moved = jnp.concatenate(cols, axis=1)
         return jnp.matmul(moved, P, precision=jax.lax.Precision.HIGHEST)
 
-    dist, it, diff = accelerated_distribution_fixed_point(
+    dist, it, diff, _ = accelerated_distribution_fixed_point(
         push, d0, tol, max_iter, accel_every)
     out_ref[0] = dist
     stats_ref[0] = jnp.stack([it.astype(d0.dtype),

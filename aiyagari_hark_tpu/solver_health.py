@@ -1,0 +1,161 @@
+"""Solver-health layer: typed convergence status for every fixed point.
+
+Every fixed-point loop in the engine — the EGM policy iteration, the
+stationary-distribution push, the interest-rate bisection, the KS outer
+loop — exits on ``diff > tol`` or ``max_iter``.  Before this layer none of
+them *reported* which exit it took, and ``NaN > tol`` evaluates False, so a
+NaN-poisoned iterate terminated a ``lax.while_loop`` looking exactly like
+convergence and propagated garbage into sweep results silently.  Cao-Luo-Nie
+(arXiv:1905.13045) and Ma-Stachurski-Toda (arXiv:1812.01320) both show the
+Aiyagari supply map loses contraction near the bracket edges, so
+non-convergence under aggressive parameters (sigma=5, rho=0.9, fine grids)
+is an expected operating condition, not a bug to hope away.
+
+The contract:
+
+* every fixed point returns a trailing **status code** (int32, jit/vmap
+  safe).  Codes are ordered by severity, so the worst status of a composite
+  solve is ``jnp.maximum`` over the components (``combine_status``):
+
+      CONVERGED (0) < STALLED (1) < MAX_ITER (2) < NONFINITE (3)
+
+  - ``CONVERGED``: the certified residual met the tolerance.
+  - ``STALLED``: the loop's stall window fired — the residual stopped
+    improving above tol (typically the dtype rounding floor for a
+    slow-mixing chain).  The returned iterate is the honest best; benign
+    but worth surfacing.
+  - ``MAX_ITER``: the iteration budget ran out with ``diff > tol`` (or the
+    bisection bracket still wider than ``r_tol``).  The result is
+    uncertified — treat as a failure.
+  - ``NONFINITE``: a non-finite iterate tripped the in-loop
+    ``isfinite(diff)`` tripwire.  The numbers are garbage.
+
+* ``is_failure(status)`` is the caller-side gate: True for ``MAX_ITER`` and
+  ``NONFINITE``; the batched sweep quarantines and retries exactly those
+  cells (``parallel.sweep``), and the facade raises
+  ``SolverDivergenceError`` instead of returning silent garbage.
+
+* the deterministic fault-injection hook (``inject_fault``) wraps a step
+  function to emit a NaN or a stall at iteration k, so every tripwire and
+  retry path is exercisable in CPU tests without waiting for natural
+  divergence.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Severity-ordered status codes: combine with jnp.maximum.
+CONVERGED = 0
+STALLED = 1
+MAX_ITER = 2
+NONFINITE = 3
+
+STATUS_NAMES = ("CONVERGED", "STALLED", "MAX_ITER", "NONFINITE")
+
+
+def status_name(code) -> str:
+    """Host-side pretty name for one integer status code."""
+    code = int(code)
+    if 0 <= code < len(STATUS_NAMES):
+        return STATUS_NAMES[code]
+    return f"UNKNOWN({code})"
+
+
+def combine_status(*codes):
+    """Worst (most severe) of several status codes — elementwise, so it
+    works on per-cell status arrays as well as scalars."""
+    out = jnp.asarray(codes[0], dtype=jnp.int32)
+    for c in codes[1:]:
+        out = jnp.maximum(out, jnp.asarray(c, dtype=jnp.int32))
+    return out
+
+
+def classify_fixed_point_exit(diff, tol, it, max_iter):
+    """Status code from a fixed-point loop's exit state, jit/vmap safe.
+
+    ``diff`` is the loop's LAST certified residual (non-finite iff the
+    tripwire fired), ``it`` the iterations taken.  The residual order of
+    the tests matters: a non-finite diff must not read as anything else,
+    and ``diff <= tol`` is False for NaN.  An exit with a finite
+    ``diff > tol`` before ``max_iter`` can only be a stall window.
+    """
+    diff = jnp.asarray(diff)
+    return jnp.where(
+        ~jnp.isfinite(diff), jnp.int32(NONFINITE),
+        jnp.where(diff <= tol, jnp.int32(CONVERGED),
+                  jnp.where(it >= max_iter, jnp.int32(MAX_ITER),
+                            jnp.int32(STALLED))))
+
+
+def is_failure(status):
+    """True where a status means the result is uncertified or garbage
+    (``MAX_ITER`` or ``NONFINITE``).  Works on numpy/JAX arrays and ints;
+    ``STALLED`` is deliberately benign — the stall exit returns the honest
+    best iterate when the tolerance sits below the dtype floor."""
+    return status >= MAX_ITER
+
+
+class SolverDivergenceError(RuntimeError):
+    """A solve produced an uncertified or non-finite result.
+
+    Carries the machine-readable context so callers can escalate instead
+    of parsing the message: ``status`` (the worst status code observed)
+    and ``trail`` (a list of per-stage/per-iteration dicts describing what
+    was tried and how each attempt exited)."""
+
+    def __init__(self, message: str, status=None, trail=None):
+        super().__init__(message)
+        self.status = None if status is None else int(status)
+        self.trail = list(trail) if trail is not None else []
+
+
+def inject_fault(step_fn, mode: str = "nan", at_iter: int = 0,
+                 amplitude: float = 1e-3):
+    """Deterministic fault-injection hook for the accelerated fixed points.
+
+    Wraps a ``x -> x'`` step function into an iteration-aware one (the
+    loops detect the ``takes_iteration`` attribute and pass the current
+    iteration index) that misbehaves from iteration ``at_iter`` onward:
+
+    * ``mode="nan"``: every leaf of the output becomes NaN — exercises the
+      ``NONFINITE`` tripwire (the loop must exit immediately, not
+      masquerade as converged).
+    * ``mode="stall"``: adds an alternating-sign ``amplitude`` offset to
+      every leaf, pinning the sup-norm diff near ``2*amplitude`` forever —
+      exercises the ``MAX_ITER`` exit (policy loop) and the stall-window
+      ``STALLED`` exit (distribution loop).  Pick ``amplitude > tol``.
+      The offset is uniform across a leaf, so strictly-monotone knot grids
+      stay monotone.
+
+    Purely a test/diagnostic helper: nothing in the production paths calls
+    it.  The sweep-level analogue is ``run_table2_sweep(inject_fault=...)``,
+    which poisons one cell inside the jitted bisection.
+    """
+    if mode not in ("nan", "stall"):
+        raise ValueError(f"inject_fault mode must be 'nan' or 'stall', "
+                         f"got {mode!r}")
+
+    def wrapped(x, it):
+        out = step_fn(x)
+        hit = it >= at_iter
+        if mode == "nan":
+            return jax.tree.map(
+                lambda leaf: jnp.where(hit, jnp.nan, leaf), out)
+        sign = jnp.where(jnp.mod(it, 2) == 0, 1.0, -1.0)
+        return jax.tree.map(
+            lambda leaf: leaf + jnp.where(hit, sign * amplitude,
+                                          0.0).astype(leaf.dtype), out)
+
+    wrapped.takes_iteration = True
+    return wrapped
+
+
+def call_step(step_fn, x, it):
+    """Invoke a fixed-point step, passing the iteration index iff the step
+    advertises ``takes_iteration`` (the ``inject_fault`` wrapper does).
+    The shared shim of both accelerated fixed points."""
+    if getattr(step_fn, "takes_iteration", False):
+        return step_fn(x, it)
+    return step_fn(x)

@@ -247,13 +247,21 @@ def main(argv=None):
                          "comparison uses the SCF curve vendored from the "
                          "reference's committed vector figure "
                          "(aiyagari_hark_tpu/data/scf_lorenz.csv)")
+    ap.add_argument("--no-den-haan-pinned", action="store_true",
+                    help="skip the pinned-histogram den Haan side-by-side "
+                         "(a second full engine solve, ~2.5 min on CPU at "
+                         "parity size) — the default pipeline pays it only "
+                         "because the committed results.json carries the "
+                         "side-by-side fields; use this flag for iteration "
+                         "runs that don't regenerate the artifact")
     ap.add_argument("--extras", action="store_true",
                     help="also run the beyond-parity reporting (GE impulse "
                          "response figure, the histogram engine's "
                          "wealth-stats readout); off by default so the "
                          "'solve' phase in runtime.txt stays the "
                          "reference-comparable notebook pipeline.  One "
-                         "diagnostic runs regardless: the pinned-engine "
+                         "diagnostic runs regardless (unless "
+                         "--no-den-haan-pinned): the pinned-engine "
                          "den Haan side-by-side, in its own "
                          "'den_haan_pinned' timer phase — compare the "
                          "reference's 27.12 min against 'solve', not "
@@ -346,7 +354,12 @@ def main(argv=None):
     # ... and the same diagnostic for the engine that MEETS the den Haan
     # bar (VERDICT r4 weak-item 4): the deterministic pinned-histogram
     # solve, reported side by side in results.json.
-    if args.sim_method == "distribution":
+    if args.sim_method == "distribution" or args.no_den_haan_pinned:
+        # distribution mode IS the pinned engine (nothing to compare), and
+        # --no-den-haan-pinned skips the 151.6 s side-by-side explicitly;
+        # results.json then simply lacks the den_haan_pinned_* fields
+        # (tests/test_artifacts.py only gates the COMMITTED artifact,
+        # which the default full run still regenerates with them)
         hist_solved, dh_pin_fields = None, {}
     else:
         hist_solved, dh_pin_fields = _pinned_den_haan(

@@ -57,7 +57,7 @@ def main():
                                dist_count=D)
         k_to_l = firm.k_to_l_from_r(r, 0.36, 0.08)
         W = firm.wage_rate(k_to_l, 0.36)
-        pol, _, _ = solve_household(1.0 + r, W, m, 0.96, crra)
+        pol, _, _, _ = solve_household(1.0 + r, W, m, 0.96, crra)
         trans = wealth_transition(pol, 1.0 + r, W, m)
         Ss.append(dense_wealth_operator(trans, D))
         Ps.append(m.transition)             # per-cell: rho varies
@@ -100,7 +100,7 @@ def main():
         return outs, sorted(ts)[len(ts) // 2], ts
 
     jax.block_until_ready(f_a(S, Pb, d0))      # compile
-    (da, ia, _), t_a, ts_a = timed(f_a, S, Pb, d0)
+    (da, ia, _, _), t_a, ts_a = timed(f_a, S, Pb, d0)
     print(f"   A raw timings: {[f'{t*1e3:.0f}ms' for t in ts_a]}")
     print(f"A vmap(dense):  {t_a*1e3:8.1f} ms   iters={np.asarray(ia)} "
           f"(lock-step: every lane pays max)")

@@ -54,11 +54,15 @@ def test_results_equilibrium_sanity():
     assert res["converged"] is True
     assert 3.5 < res["equilibrium_return_pct"] < 4.5
     assert 20.0 < res["equilibrium_saving_rate_pct"] < 27.0
-    # the EIV-attenuation story quoted in diagnostics.py/DESIGN §3:
-    # the MC-fit slope sits between the constant truth (0) and the
-    # explosive deterministic transition slope (~1.2)
+    # the EIV-attenuation story quoted in diagnostics.py/DESIGN §3 as an
+    # ORDERING, not a band: the MC-fit slope sits strictly between the
+    # constant truth (0) and the ~1.2 deterministic transition slope.
+    # Pinning a tighter band (the old 1.0 < slope < 1.2) made the suite
+    # fail on any legitimate reseed of results.json whose draw attenuates
+    # harder (ADVICE r5 #3) — the attenuation direction is the claim, the
+    # exact magnitude is seed-dependent.
     for slope in res["afunc_slope"]:
-        assert 1.0 < slope < 1.2
+        assert 0.0 < slope < 1.2
     ref = res["reference_goldens"]
     assert ref["r_pct"] == 4.178 and ref["solve_minutes"] == 27.12
 

@@ -129,9 +129,12 @@ def test_records_jsonl_roundtrip(tmp_path):
     write_records_jsonl(p, recs)
     out = read_records_jsonl(p)
     assert out[0]["iteration"] == 0 and out[0]["slope"] == [1.0, 1.0]
+    assert out[0]["egm_status"] == 0          # solver-health code rides along
     assert out[1]["distance"] == 0.1
     with open(p) as f:
-        assert len(json.loads(f.readline())) == 7
+        import dataclasses
+        assert (len(json.loads(f.readline()))
+                == len(dataclasses.fields(KSIterationRecord)))
 
 
 def test_checked_call_catches_nan_inside_while_loop():

@@ -49,7 +49,7 @@ def policy(cal):
     afunc = AFuncParams(
         intercept=jnp.full((2,), jnp.log(ss.K), dtype=cal.a_grid.dtype),
         slope=jnp.zeros(2, dtype=cal.a_grid.dtype))
-    pol, _, _ = solve_ks_household(afunc, cal)
+    pol, _, _, _ = solve_ks_household(afunc, cal)
     return pol
 
 

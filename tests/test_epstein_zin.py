@@ -37,8 +37,8 @@ def model():
 def test_crra_reduction_policy(model):
     """gamma = rho = 2 must reproduce the CRRA household exactly (the
     risk-adjustment weights collapse to one)."""
-    ez, _, _ = solve_ez_household(R, W, model, BETA, 2.0, 2.0, tol=1e-9)
-    crra, _, _ = solve_household(R, W, model, BETA, 2.0, tol=1e-9)
+    ez, _, _, _ = solve_ez_household(R, W, model, BETA, 2.0, 2.0, tol=1e-9)
+    crra, _, _, _ = solve_household(R, W, model, BETA, 2.0, tol=1e-9)
     np.testing.assert_allclose(np.asarray(ez.c_knots),
                                np.asarray(crra.c_knots), atol=1e-6)
     np.testing.assert_allclose(np.asarray(ez.m_knots),
@@ -48,7 +48,7 @@ def test_crra_reduction_policy(model):
 def test_converged_policy_is_fixed_point(model):
     """The Euler and aggregator equations in one check: a further EZ-EGM
     step from the converged (c, V) pair must not move it."""
-    ez, _, diff = solve_ez_household(R, W, model, BETA, 2.0, 8.0,
+    ez, _, diff, _ = solve_ez_household(R, W, model, BETA, 2.0, 8.0,
                                      tol=1e-10)
     stepped = egm_step_ez(ez, R, W, model, BETA, 2.0, 8.0)
     assert float(jnp.max(jnp.abs(stepped.c_knots - ez.c_knots))) < 1e-9
@@ -61,8 +61,8 @@ def test_value_falls_with_risk_aversion(model):
     borrowing constraint the comparison is between two DIFFERENT optimal
     policies and the ordering is not a theorem, so the check starts
     above it.)"""
-    lo, _, _ = solve_ez_household(R, W, model, BETA, 2.0, 2.0)
-    hi, _, _ = solve_ez_household(R, W, model, BETA, 2.0, 8.0)
+    lo, _, _, _ = solve_ez_household(R, W, model, BETA, 2.0, 2.0)
+    hi, _, _, _ = solve_ez_household(R, W, model, BETA, 2.0, 8.0)
     from aiyagari_hark_tpu.ops.interp import interp1d_rowwise
 
     m = jnp.tile(jnp.linspace(4.0, 20.0, 10)[None, :], (3, 1))

@@ -7,6 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from aiyagari_hark_tpu.solver_health import CONVERGED
 from aiyagari_hark_tpu.models.household import (
     aggregate_capital,
     aggregate_labor,
@@ -39,20 +40,21 @@ def prices():
 @pytest.fixture(scope="module")
 def solved(model, prices):
     R, W = prices
-    policy, iters, diff = solve_household(R, W, model, DISC, CRRA)
-    return policy, int(iters), float(diff)
+    policy, iters, diff, status = solve_household(R, W, model, DISC, CRRA)
+    return policy, int(iters), float(diff), int(status)
 
 
 def test_egm_converges(solved):
-    _, iters, diff = solved
+    _, iters, diff, status = solved
     assert diff < 1e-6
     assert iters < 3000
+    assert status == CONVERGED
 
 
 def test_euler_equation_residual(model, prices, solved):
     """Off the borrowing constraint, u'(c(m)) = beta R E[u'(c(R a' + W l'))]."""
     R, W = prices
-    policy, _, _ = solved
+    policy, _, _, _ = solved
     n = model.labor_levels.shape[0]
     m = jnp.linspace(2.0, 30.0, 50)
     max_rel = 0.0
@@ -73,7 +75,7 @@ def test_euler_equation_residual(model, prices, solved):
 
 def test_policy_monotone_and_budget(model, prices, solved):
     R, W = prices
-    policy, _, _ = solved
+    policy, _, _, _ = solved
     m = jnp.linspace(0.5, 40.0, 200)
     for s in (0, 3, 6):
         c = np.asarray(consumption_at(policy, m, s))
@@ -88,7 +90,7 @@ def test_constrained_region_consumes_everything(model, prices, solved):
     """Below the first endogenous knot the agent consumes ~all resources
     (the reference's prepended (1e-7, 1e-7) constraint segment)."""
     R, W = prices
-    policy, _, _ = solved
+    policy, _, _, _ = solved
     m0 = float(policy.m_knots[0, 1])  # first endogenous knot, poorest state
     m = jnp.asarray(0.5 * m0)
     c = float(consumption_at(policy, m, 0))
@@ -97,8 +99,9 @@ def test_constrained_region_consumes_everything(model, prices, solved):
 
 def test_stationary_distribution_invariants(model, prices, solved):
     R, W = prices
-    policy, _, _ = solved
-    dist, iters, diff = stationary_wealth(policy, R, W, model)
+    policy, _, _, _ = solved
+    dist, iters, diff, status = stationary_wealth(policy, R, W, model)
+    assert int(status) == CONVERGED
     d = np.asarray(dist)
     assert abs(d.sum() - 1.0) < 1e-8
     assert (d >= -1e-15).all()
@@ -126,8 +129,8 @@ def test_impatience_supply_rises_with_r(model):
     for r in (0.02, 0.041):
         k_to_l = firm.k_to_l_from_r(r, ALPHA, DELTA)
         W = float(firm.wage_rate(k_to_l, ALPHA))
-        policy, _, _ = solve_household(1.0 + r, W, model, DISC, CRRA)
-        dist, _, _ = stationary_wealth(policy, 1.0 + r, W, model)
+        policy, _, _, _ = solve_household(1.0 + r, W, model, DISC, CRRA)
+        dist, _, _, _ = stationary_wealth(policy, 1.0 + r, W, model)
         supplies.append(float(aggregate_capital(dist, model)))
     assert supplies[1] > supplies[0]
 
@@ -139,16 +142,16 @@ def test_stationary_methods_agree(model, prices, solved):
     mode here) — are the same linear operator, so their fixed points must
     agree to solver tolerance."""
     R, W = prices
-    policy, _, _ = solved
-    ref, _, _ = stationary_wealth(policy, R, W, model, method="scatter")
+    policy, _, _, _ = solved
+    ref, _, _, _ = stationary_wealth(policy, R, W, model, method="scatter")
     for method in ("dense", "pallas"):
-        d, it, diff = stationary_wealth(policy, R, W, model, method=method)
+        d, it, diff, _ = stationary_wealth(policy, R, W, model, method=method)
         np.testing.assert_allclose(np.asarray(d), np.asarray(ref),
                                    atol=1e-9, err_msg=method)
         assert int(it) > 0 and float(diff) <= 1e-11
     # the direct LU solve targets the same fixed point but certifies via a
     # plain-step residual rather than iterating to 1e-11
-    d, it, diff = stationary_wealth(policy, R, W, model, method="solve")
+    d, it, diff, _ = stationary_wealth(policy, R, W, model, method="solve")
     np.testing.assert_allclose(np.asarray(d), np.asarray(ref),
                                atol=1e-8, err_msg="solve")
     assert float(diff) < 1e-9
@@ -165,7 +168,7 @@ def test_dense_operator_is_push_forward(model, prices, solved):
     )
 
     R, W = prices
-    policy, _, _ = solved
+    policy, _, _, _ = solved
     trans = wealth_transition(policy, R, W, model)
     S = dense_wealth_operator(trans, model.dist_grid.shape[0])
     # columns of each S[n] are lotteries: they sum to 1 exactly
@@ -195,7 +198,7 @@ def test_pallas_kernel_under_vmap():
     def solve_at(r):
         k_to_l = firm.k_to_l_from_r(r, ALPHA, DELTA)
         W = firm.wage_rate(k_to_l, ALPHA)
-        pol, _, _ = solve_household(1.0 + r, W, m, DISC, CRRA)
+        pol, _, _, _ = solve_household(1.0 + r, W, m, DISC, CRRA)
         trans = wealth_transition(pol, 1.0 + r, W, m)
         S = dense_wealth_operator(trans, m.dist_grid.shape[0])
         dist, _, _ = stationary_dense_pallas(S, m.transition, d0, 1e-10,
@@ -222,8 +225,8 @@ def test_pallas_lane_grid_dispatch_under_vmap():
     def dist_at(r, method):
         k_to_l = firm.k_to_l_from_r(r, ALPHA, DELTA)
         W = firm.wage_rate(k_to_l, ALPHA)
-        pol, _, _ = solve_household(1.0 + r, W, m, DISC, CRRA)
-        d, _, _ = stationary_wealth(pol, 1.0 + r, W, m, tol=1e-10,
+        pol, _, _, _ = solve_household(1.0 + r, W, m, DISC, CRRA)
+        d, _, _, _ = stationary_wealth(pol, 1.0 + r, W, m, tol=1e-10,
                                     method=method)
         return d
 
@@ -249,8 +252,8 @@ def test_pallas_kernel_compiled_on_tpu(model, prices, solved):
     from aiyagari_hark_tpu.ops.pallas_kernels import stationary_dense_pallas
 
     R, W = prices
-    policy, _, _ = solved
-    ref, _, _ = stationary_wealth(policy, R, W, model, method="scatter")
+    policy, _, _, _ = solved
+    ref, _, _, _ = stationary_wealth(policy, R, W, model, method="scatter")
     trans = wealth_transition(policy, R, W, model)
     S = dense_wealth_operator(trans, model.dist_grid.shape[0])
     d, _, _ = stationary_dense_pallas(S, model.transition,
@@ -270,8 +273,8 @@ def test_pallas_nested_vmap_collapses_to_lane_grid():
 
     def one(r, beta, method):
         W = firm.wage_rate(firm.k_to_l_from_r(r, 0.36, 0.08), 0.36)
-        pol, _, _ = solve_household(1.0 + r, W, m, beta, 2.0)
-        d, _, _ = stationary_wealth(pol, 1.0 + r, W, m, method=method)
+        pol, _, _, _ = solve_household(1.0 + r, W, m, beta, 2.0)
+        d, _, _, _ = stationary_wealth(pol, 1.0 + r, W, m, method=method)
         return d
 
     rs = jnp.asarray([0.02, 0.03])

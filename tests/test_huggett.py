@@ -42,7 +42,7 @@ def test_borrowing_constrained_policy_is_exact(huggett_model):
     so test just inside it; beyond it the household is *optimally* interior
     (c < m - b, a > b), which a separate assertion checks."""
     b = -4.0
-    policy, _, diff = solve_household(1.03, 1.0, huggett_model, BETA, CRRA)
+    policy, _, diff, _ = solve_household(1.03, 1.0, huggett_model, BETA, CRRA)
     assert float(diff) < 1e-6
     for s in range(5):
         m1 = float(policy.m_knots[s, 1])       # state's constraint kink
@@ -57,8 +57,8 @@ def test_borrowing_constrained_policy_is_exact(huggett_model):
 
 
 def test_wealth_distribution_reaches_negative_assets(huggett_model):
-    policy, _, _ = solve_household(1.03, 1.0, huggett_model, BETA, CRRA)
-    dist, _, _ = stationary_wealth(policy, 1.03, 1.0, huggett_model)
+    policy, _, _, _ = solve_household(1.03, 1.0, huggett_model, BETA, CRRA)
+    dist, _, _, _ = stationary_wealth(policy, 1.03, 1.0, huggett_model)
     d = np.asarray(dist)
     grid = np.asarray(huggett_model.dist_grid)
     assert grid[0] == pytest.approx(-4.0)

@@ -57,7 +57,7 @@ def test_consumption_rises_with_age_at_fixed_resources(model):
 def test_long_horizon_converges_to_infinite_horizon(model):
     """With many ages ahead, the age-0 policy is the cycles=0 fixed point —
     backward induction and the while_loop solver must agree."""
-    inf_policy, _, _ = solve_household(R, W, model, BETA, CRRA)
+    inf_policy, _, _, _ = solve_household(R, W, model, BETA, CRRA)
     pol = jax.jit(lambda: solve_lifecycle(R, W, model, BETA, CRRA,
                                           horizon=300))()
     m_test = jnp.tile(jnp.linspace(0.5, 30.0, 12), (5, 1))

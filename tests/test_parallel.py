@@ -131,7 +131,7 @@ def ks_setup():
     econ = EconomyConfig(labor_states=4, act_T=40, t_discard=10, verbose=False)
     cal = build_ks_calibration(agent, econ)
     afunc = AFuncParams(intercept=jnp.zeros(2), slope=jnp.ones(2))
-    policy, _, _ = solve_ks_household(afunc, cal, tol=1e-5)
+    policy, _, _, _ = solve_ks_household(afunc, cal, tol=1e-5)
     key = jax.random.PRNGKey(3)
     mrkv = simulate_markov_history(cal.agg_transition, 0, econ.act_T,
                                    jax.random.PRNGKey(7))

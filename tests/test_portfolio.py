@@ -107,7 +107,7 @@ def test_no_premium_means_zero_share():
 
 def test_stationary_portfolio_distribution_properties(solved):
     model, policy = solved
-    dist, it, diff = jax.jit(lambda: stationary_portfolio_wealth(
+    dist, it, diff, _ = jax.jit(lambda: stationary_portfolio_wealth(
         policy, R_FREE, WAGE, model, tol=1e-9))()
     assert float(jnp.sum(dist)) == pytest.approx(1.0, abs=1e-8)
     assert bool(jnp.all(dist >= -1e-12))
@@ -171,7 +171,7 @@ def test_degenerate_risky_asset_matches_single_asset():
         R_FREE, WAGE, model, BETA, 2.0))()
     assert float(jnp.min(pol.share)) > 0.95
     simple = build_simple_model(labor_states=5, labor_ar=0.6, a_count=32)
-    spol, _, _ = jax.jit(lambda: solve_household(
+    spol, _, _, _ = jax.jit(lambda: solve_household(
         r_risky, WAGE, simple, BETA, 2.0))()
     m = jnp.linspace(1.0, 20.0, 30)
     c_port = consumption_at(consumption_policy(pol),

@@ -1,0 +1,279 @@
+"""Solver-health layer: typed status codes, in-loop NaN tripwires, and the
+sweep quarantine/retry escalation — every path exercised by DETERMINISTIC
+fault injection (``solver_health.inject_fault`` at the loop level, the
+``inject_fault=`` hook of ``run_table2_sweep`` at the sweep level), so the
+tripwires are tested without waiting for natural divergence.
+
+The load-bearing claims:
+  * a NaN iterate exits a fixed point immediately as NONFINITE — it must
+    neither masquerade as convergence (``NaN > tol`` is False) nor burn
+    the iteration budget;
+  * MAX_ITER is distinguishable from CONVERGED;
+  * the distribution loop's stall window reports STALLED;
+  * one injected-NaN sweep cell is quarantined, retried, and recovered
+    while every OTHER cell's Table II values stay bit-identical to an
+    uninjected run;
+  * a diverged facade solve raises ``SolverDivergenceError`` instead of
+    returning silent garbage.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.models.equilibrium import solve_calibration_lean
+from aiyagari_hark_tpu.models.household import (
+    accelerated_distribution_fixed_point,
+    accelerated_policy_fixed_point,
+    build_simple_model,
+    egm_step,
+    initial_policy,
+)
+from aiyagari_hark_tpu.solver_health import (
+    CONVERGED,
+    MAX_ITER,
+    NONFINITE,
+    STALLED,
+    SolverDivergenceError,
+    combine_status,
+    inject_fault,
+    is_failure,
+    status_name,
+)
+
+BETA, CRRA = 0.96, 2.0
+SMALL = dict(labor_states=5, a_count=16, dist_count=64)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return build_simple_model(**SMALL)
+
+
+@pytest.fixture(scope="module")
+def egm(model):
+    return lambda p: egm_step(p, 1.02, 1.0, model, BETA, CRRA)
+
+
+# -- the code algebra ------------------------------------------------------
+
+def test_status_severity_and_combine():
+    assert CONVERGED < STALLED < MAX_ITER < NONFINITE
+    assert int(combine_status(CONVERGED, STALLED)) == STALLED
+    assert int(combine_status(STALLED, MAX_ITER)) == MAX_ITER
+    assert int(combine_status(NONFINITE, CONVERGED)) == NONFINITE
+    # elementwise over per-cell arrays (the sweep's form)
+    a = np.array([CONVERGED, MAX_ITER, STALLED])
+    b = np.array([STALLED, CONVERGED, NONFINITE])
+    np.testing.assert_array_equal(
+        np.asarray(combine_status(a, b)), [STALLED, MAX_ITER, NONFINITE])
+
+
+def test_is_failure_gate():
+    assert not is_failure(CONVERGED) and not is_failure(STALLED)
+    assert is_failure(MAX_ITER) and is_failure(NONFINITE)
+    np.testing.assert_array_equal(
+        is_failure(np.array([CONVERGED, STALLED, MAX_ITER, NONFINITE])),
+        [False, False, True, True])
+
+
+def test_status_names():
+    assert [status_name(c) for c in range(4)] == [
+        "CONVERGED", "STALLED", "MAX_ITER", "NONFINITE"]
+    assert "UNKNOWN" in status_name(17)
+
+
+def test_inject_fault_rejects_unknown_mode():
+    with pytest.raises(ValueError, match="mode"):
+        inject_fault(lambda x: x, mode="bogus")
+
+
+# -- policy loop tripwires -------------------------------------------------
+
+def test_policy_healthy_exit_is_converged(egm, model):
+    pol, it, diff, status = accelerated_policy_fixed_point(
+        egm, initial_policy(model), 1e-6, 3000)
+    assert int(status) == CONVERGED
+    assert float(diff) <= 1e-6 and int(it) < 3000
+
+
+def test_policy_nan_fault_exits_nonfinite_immediately(egm, model):
+    """A NaN at iteration 5 must exit within a step or two of 5 — not
+    report CONVERGED (the NaN > tol masquerade) and not burn 3000 steps."""
+    bad = inject_fault(egm, mode="nan", at_iter=5)
+    _, it, diff, status = accelerated_policy_fixed_point(
+        bad, initial_policy(model), 1e-6, 3000)
+    assert int(status) == NONFINITE
+    assert not np.isfinite(float(diff))
+    assert int(it) <= 7, "tripwire must fire at the poisoned iterate"
+
+
+def test_policy_stall_fault_exits_max_iter_not_converged(egm, model):
+    """MAX_ITER != CONVERGED: an oscillating iterate above tol must burn
+    the (small) budget and say so."""
+    stall = inject_fault(egm, mode="stall", at_iter=0, amplitude=1e-3)
+    _, it, diff, status = accelerated_policy_fixed_point(
+        stall, initial_policy(model), 1e-6, 150)
+    assert int(status) == MAX_ITER
+    assert int(it) == 150
+    assert float(diff) > 1e-6
+
+
+# -- distribution loop tripwires (cheap synthetic contraction) -------------
+
+def _affine_push(target, rate=0.5):
+    """x -> target + rate * (x - target): a contraction with known fixed
+    point — milliseconds per step, so the 512-step stall window is cheap."""
+    return lambda x: target + rate * (x - target)
+
+
+def test_distribution_healthy_exit_is_converged():
+    target = jnp.linspace(0.0, 1.0, 32).reshape(8, 4)
+    d0 = jnp.zeros((8, 4))
+    dist, it, diff, status = accelerated_distribution_fixed_point(
+        _affine_push(target), d0, 1e-10, 5000, accel_every=0)
+    assert int(status) == CONVERGED
+    np.testing.assert_allclose(np.asarray(dist), np.asarray(target),
+                               atol=1e-8)
+
+
+def test_distribution_nan_fault_exits_nonfinite_immediately():
+    target = jnp.ones((8, 4))
+    bad = inject_fault(_affine_push(target), mode="nan", at_iter=3)
+    _, it, _, status = accelerated_distribution_fixed_point(
+        bad, jnp.zeros((8, 4)), 1e-10, 5000, accel_every=0)
+    assert int(status) == NONFINITE
+    assert int(it) <= 5
+
+
+def test_distribution_stall_fault_exits_stalled():
+    """The alternating-offset fault pins the diff near 2*amplitude: the
+    best certified residual stops improving and the 512-step stall window
+    must exit STALLED (not burn max_iter, not claim convergence)."""
+    target = jnp.ones((8, 4))
+    stall = inject_fault(_affine_push(target), mode="stall", at_iter=0,
+                         amplitude=1e-4)
+    _, it, best, status = accelerated_distribution_fixed_point(
+        stall, jnp.zeros((8, 4)), 1e-10, 20000, accel_every=0)
+    assert int(status) == STALLED
+    assert int(it) < 20000
+    assert 1e-10 < float(best)
+
+
+def test_distribution_max_iter_exit():
+    target = jnp.ones((8, 4))
+    _, it, _, status = accelerated_distribution_fixed_point(
+        _affine_push(target, rate=0.999), jnp.zeros((8, 4)), 1e-14, 50,
+        accel_every=0)
+    assert int(status) == MAX_ITER
+    assert int(it) == 50
+
+
+# -- equilibrium bisection tripwires ---------------------------------------
+
+def test_lean_equilibrium_healthy_status(model):
+    lean = solve_calibration_lean(1.0, 0.3, labor_sd=0.2, **SMALL)
+    assert int(lean.status) == CONVERGED
+    assert not is_failure(int(lean.status))
+
+
+def test_lean_equilibrium_nan_fault_trips_nonfinite(model):
+    lean = solve_calibration_lean(1.0, 0.3, labor_sd=0.2, fault_iter=2,
+                                  fault_mode="nan", **SMALL)
+    assert int(lean.status) == NONFINITE
+    # the tripwire exits on the poisoned evaluation, not at max_bisect
+    assert int(lean.bisect_iters) == 3
+
+
+def test_lean_equilibrium_stall_fault_trips_max_iter(model):
+    lean = solve_calibration_lean(1.0, 0.3, labor_sd=0.2, fault_iter=1,
+                                  fault_mode="stall", max_bisect=8, **SMALL)
+    assert int(lean.status) == MAX_ITER
+    assert int(lean.bisect_iters) == 8
+
+
+# -- sweep quarantine/retry (the acceptance criterion) ---------------------
+
+@pytest.mark.slow
+def test_sweep_quarantines_retries_and_leaves_others_bit_identical():
+    """ISSUE acceptance: a sweep with one deterministically fault-injected
+    cell completes, quarantines/retries that cell, reports its status, and
+    leaves all other cells' Table II values bit-identical to an uninjected
+    run."""
+    from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+    from aiyagari_hark_tpu.utils.config import SweepConfig
+
+    sweep = SweepConfig(crra_values=(1.0, 3.0), rho_values=(0.3, 0.6))
+    base = run_table2_sweep(sweep, **SMALL)
+    assert base.status is not None and base.retries is not None
+    assert base.status.dtype == np.int64
+    # satellite ADVICE r5 #2: counters are integers again on the host
+    assert base.bisect_iters.dtype == np.int64
+    assert base.egm_iters.dtype == np.int64
+    assert base.dist_iters.dtype == np.int64
+    assert not base.failed_cells().size
+    assert (base.retries == 0).all()
+
+    cell = 2
+    inj = run_table2_sweep(
+        sweep, inject_fault={"cell": cell, "at_iter": 1, "mode": "nan"},
+        **SMALL)
+    others = [i for i in range(4) if i != cell]
+    # bit-identical, not allclose: the other lanes ran the same lock-step
+    # masked program
+    assert np.array_equal(base.r_star_pct[others], inj.r_star_pct[others])
+    assert np.array_equal(base.capital[others], inj.capital[others])
+    # the injected cell was quarantined, retried, and recovered
+    assert inj.retries[cell] >= 1
+    assert not is_failure(int(inj.status[cell]))
+    assert np.isfinite(inj.r_star_pct[cell])
+    assert abs(inj.r_star_pct[cell] - base.r_star_pct[cell]) < 1e-3
+
+
+@pytest.mark.slow
+def test_sweep_without_quarantine_reports_raw_failure():
+    from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+    from aiyagari_hark_tpu.utils.config import SweepConfig
+
+    sweep = SweepConfig(crra_values=(1.0, 3.0), rho_values=(0.3, 0.6))
+    res = run_table2_sweep(
+        sweep, inject_fault={"cell": 1, "at_iter": 0, "mode": "nan"},
+        quarantine=False, **SMALL)
+    assert int(res.status[1]) == NONFINITE
+    assert int(res.retries[1]) == 0
+    assert 1 in res.failed_cells()
+
+
+# -- facade / KS outer loop ------------------------------------------------
+
+def test_ks_divergence_raises_typed_error():
+    """An aggregate state that never appears in the regression window
+    makes the saving-rule OLS non-finite — the outer loop must raise the
+    typed error with the status trail, not return garbage."""
+    from aiyagari_hark_tpu.models.ks_solver import solve_ks_economy
+    from aiyagari_hark_tpu.utils.config import AgentConfig, EconomyConfig
+
+    agent = AgentConfig(labor_states=5, a_count=16, agent_count=40)
+    econ = EconomyConfig(labor_states=5, act_T=60, t_discard=20,
+                         max_loops=2, verbose=False)
+    # a chain pinned to state 0: state 1's masked OLS sample is empty
+    mrkv = np.zeros(60, dtype=np.int64)
+    with pytest.raises(SolverDivergenceError) as ei:
+        solve_ks_economy(agent, econ, mrkv_hist=mrkv)
+    assert ei.value.status == NONFINITE
+    assert ei.value.trail, "the error must carry the status trail"
+
+
+def test_facade_solve_propagates_divergence_error():
+    from aiyagari_hark_tpu import AiyagariEconomy, AiyagariType
+
+    economy = AiyagariEconomy(LaborStatesNo=5, act_T=60, T_discard=20,
+                              max_loops=2, verbose=False)
+    agent = AiyagariType(LaborStatesNo=5, AgentCount=40, aCount=16)
+    agent.cycles = 0
+    agent.get_economy_data(economy)
+    economy.agents = [agent]
+    economy.MrkvNow_hist = np.zeros(60, dtype=np.int64)
+    with pytest.raises(SolverDivergenceError):
+        economy.solve()

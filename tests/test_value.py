@@ -30,7 +30,7 @@ from aiyagari_hark_tpu.models.value import (
 def stochastic_case():
     model = build_simple_model(labor_states=5, a_count=48)
     R, W, beta, crra = 1.02, 1.1, 0.96, 2.0
-    policy, _, _ = solve_household(R, W, model, beta, crra)
+    policy, _, _, _ = solve_household(R, W, model, beta, crra)
     vf, it, diff = jax.jit(
         lambda: policy_value(policy, R, W, model, beta, crra))()
     assert float(diff) < 1e-9
@@ -46,7 +46,7 @@ def test_log_utility_closed_form():
     """
     beta, R = 0.9, 1.05
     model = build_simple_model(labor_states=1, a_count=64, a_max=100.0)
-    policy, _, _ = solve_household(R, 0.0, model, beta, 1.0)
+    policy, _, _, _ = solve_household(R, 0.0, model, beta, 1.0)
     m_test = jnp.asarray([[2.0, 10.0, 30.0]])
     c = np.asarray(policy.c_knots)[0]
     m = np.asarray(policy.m_knots)[0]
@@ -125,11 +125,11 @@ def test_value_increasing_and_monotone_in_state(stochastic_case):
 @pytest.mark.slow
 def test_aggregate_welfare_and_consumption_equivalent(stochastic_case):
     model, policy, vf, R, W, beta, crra = stochastic_case
-    dist, _, _ = stationary_wealth(policy, R, W, model)
+    dist, _, _, _ = stationary_wealth(policy, R, W, model)
     wel = float(aggregate_welfare(vf, dist, R, W, model, crra))
     assert np.isfinite(wel)
     # a 5% wage rise is a strict welfare improvement
-    policy2, _, _ = solve_household(R, 1.05 * W, model, beta, crra)
+    policy2, _, _, _ = solve_household(R, 1.05 * W, model, beta, crra)
     vf2, _, _ = policy_value(policy2, R, 1.05 * W, model, beta, crra)
     wel2 = float(aggregate_welfare(vf2, dist, R, 1.05 * W, model, crra))
     assert wel2 > wel
@@ -170,9 +170,9 @@ def test_welfare_sweepable_under_jit_and_vmap(stochastic_case):
     model, policy, vf, R, W, beta, crra = stochastic_case
 
     def welfare(w_scale):
-        p, _, _ = solve_household(R, w_scale * W, model, beta, crra)
+        p, _, _, _ = solve_household(R, w_scale * W, model, beta, crra)
         v, _, _ = policy_value(p, R, w_scale * W, model, beta, crra)
-        dist, _, _ = stationary_wealth(p, R, w_scale * W, model)
+        dist, _, _, _ = stationary_wealth(p, R, w_scale * W, model)
         return aggregate_welfare(v, dist, R, w_scale * W, model, crra)
 
     out = jax.jit(jax.vmap(welfare))(jnp.asarray([1.0, 1.05]))
@@ -194,7 +194,7 @@ def test_policy_value_direct_matches_iterative(stochastic_case):
     np.testing.assert_allclose(np.asarray(vf_d.vnvrs_knots),
                                np.asarray(vf.vnvrs_knots),
                                rtol=1e-6, atol=1e-7)
-    dist, _, _ = stationary_wealth(policy, R, W, model)
+    dist, _, _, _ = stationary_wealth(policy, R, W, model)
     w_it = float(aggregate_welfare(vf, dist, R, W, model, crra))
     w_d = float(aggregate_welfare(vf_d, dist, R, W, model, crra))
     np.testing.assert_allclose(w_d, w_it, rtol=1e-7)
@@ -209,7 +209,7 @@ def test_policy_value_direct_log_utility_exact():
 
     beta, R = 0.9, 1.05
     model = build_simple_model(labor_states=1, a_count=64, a_max=100.0)
-    policy, _, _ = solve_household(R, 0.0, model, beta, 1.0)
+    policy, _, _, _ = solve_household(R, 0.0, model, beta, 1.0)
     vf, _, diff = policy_value_direct(policy, R, 0.0, model, beta, 1.0)
     # diff is the LOG-space residual: |Δv| ≤ diff/(1-beta) for log utility
     assert float(diff) < 1e-9
