@@ -72,6 +72,7 @@ def household_capital_supply(r, model: SimpleModel, disc_fac, crra,
                              egm_tol=1e-6, dist_tol=1e-11,
                              init_policy=None, init_dist=None,
                              dist_method: str = "auto",
+                             egm_method: str = "xla",
                              accel_every: int | None = None) -> SupplyEval:
     """A(r): solve the household at prices implied by r, return stationary
     capital plus the objects (policy, distribution, W), iteration counts
@@ -83,6 +84,10 @@ def household_capital_supply(r, model: SimpleModel, disc_fac, crra,
     inner iteration counts severalfold at identical answers (both loops
     converge to r-dependent fixed points regardless of start).
 
+    ``egm_method`` selects the EGM fixed-point engine ("xla" lock-step
+    while_loop, "pallas" per-lane kernel, "auto" by backend — see
+    ``solve_household``); ``dist_method`` the distribution engine.
+
     ``accel_every=0`` disables the Anderson extrapolation in BOTH inner
     loops (plain damped iteration — the sweep retry ladder's safe mode);
     ``None`` keeps each loop's own default cadence."""
@@ -92,7 +97,7 @@ def household_capital_supply(r, model: SimpleModel, disc_fac, crra,
     egm_kw = {} if accel_every is None else {"accel_every": accel_every}
     policy, egm_it, _, egm_status = solve_household(
         R, W, model, disc_fac, crra, tol=egm_tol, init_policy=init_policy,
-        **egm_kw)
+        method=egm_method, **egm_kw)
     dist, dist_it, _, dist_status = stationary_wealth(
         policy, R, W, model, tol=dist_tol, init_dist=init_dist,
         method=dist_method, **egm_kw)
@@ -255,9 +260,11 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
                            egm_tol: float | None = None,
                            dist_tol: float | None = None,
                            dist_method: str = "auto",
+                           egm_method: str = "xla",
                            root_method: str = "bisect",
                            accel_every: int | None = None,
                            bracket_pad: float = 1.0,
+                           bracket_init=None,
                            fault_iter=None,
                            fault_mode: str = "nan") -> LeanEquilibrium:
     """Bracketed root-finding equilibrium that carries the supply evaluation
@@ -299,6 +306,26 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
     NONFINITE tripwire path), mode "stall" freezes the bracket so the
     loop burns its trip cap (the MAX_ITER path).  ``None`` compiles the
     hook out entirely.
+
+    ``bracket_init``: optional ``(lo0, hi0, it0)`` warm-started bracket
+    (traced scalars — the sweep scheduler's per-lane continuation seeds,
+    ``parallel.sweep``).  The triple must be a *dyadic descendant* of the
+    economic bracket: endpoints produced by iterating ``mid = 0.5*(lo+hi)``
+    from ``(r_lo, r_hi)`` and keeping the half predicted to contain the
+    root, with ``it0`` the number of levels descended.  The seed is
+    VERIFIED before it is trusted: the excess is evaluated at both warm
+    endpoints, and only when they actually bracket the root
+    (``excess(lo0) <= 0 < excess(hi0)``, both finite) does the loop start
+    from the warm triple — excess supply is increasing in r, so a verified
+    dyadic sub-bracket certifies every skipped trip's sign and the
+    continuation replays the exact cold midpoint sequence (bit-identical
+    ``r_star``/``status`` up to inner-solver noise at ``|excess| ~``
+    solver tolerance; exactly bit-identical when the seed fails
+    verification, because the loop then falls back to the untouched cold
+    bracket AND the cold inner warm-start carry).  ``bisect_iters``
+    reports actual excess evaluations (2 verification solves + the
+    continuation trips), not the replayed level count — the honest work
+    number the scheduler's savings are measured by.
     """
     r_tol, egm_tol, dist_tol, r_lo, r_hi = _bisection_setup(
         model, disc_fac, depr_fac, r_tol, egm_tol, dist_tol,
@@ -320,6 +347,66 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
         raise ValueError(f"root_method={root_method!r}: "
                          "expected 'illinois' or 'bisect'")
     one = jnp.asarray(1.0, dtype=dtype)
+
+    def eval_supply(r, pol, dist):
+        return household_capital_supply(
+            r, model, disc_fac, crra, cap_share, depr_fac, prod,
+            egm_tol=egm_tol, dist_tol=dist_tol,
+            init_policy=pol, init_dist=dist, dist_method=dist_method,
+            egm_method=egm_method, accel_every=accel_every)
+
+    def excess_at(r, ev):
+        return ev.supply - firm.k_to_l_from_r(r, cap_share, depr_fac,
+                                              prod) * labor
+
+    # Warm-started bracket (see docstring): verify the dyadic seed by
+    # evaluating the excess at both warm endpoints, fall back to the cold
+    # bracket — including the COLD inner-loop inits, so a rejected seed
+    # reproduces the cold trajectory exactly — when the seed does not
+    # bracket the root.  The two verification solves are charged to the
+    # cell's counters; their inner statuses do NOT fold into the final
+    # status (they only pick the starting bracket, exactly as the cold
+    # bracket's implicit endpoint signs are never certified either).
+    it0 = zi
+    f_lo0, f_hi0 = -one, one
+    egm0 = zi
+    dist0 = zi
+    n_verify = 0
+    if bracket_init is not None:
+        lo_w = jnp.asarray(bracket_init[0], dtype=dtype)
+        hi_w = jnp.asarray(bracket_init[1], dtype=dtype)
+        it_w = jnp.asarray(bracket_init[2])
+        # An endpoint still AT the economic bracket needs no verification —
+        # the cold path assumes those signs too (and the hi end is the
+        # expensive near-singular regime: supply explodes toward
+        # (1-beta)/beta, so an evaluation there could cost more than the
+        # whole cold solve).  The unneeded slot re-evaluates at the lo
+        # point instead: the carry is already its solution, so it
+        # converges in a handful of steps and its value is ignored.
+        need_lo = lo_w > r_lo
+        need_hi = hi_w < r_hi
+        ev_lo = eval_supply(lo_w, p0, d0)
+        ex_lo = excess_at(lo_w, ev_lo)
+        pt_hi = jnp.where(need_hi, hi_w, lo_w)
+        ev_hi = eval_supply(pt_hi, ev_lo.policy, ev_lo.distribution)
+        ex_hi = excess_at(pt_hi, ev_hi)
+        ok_w = ((~need_lo | (jnp.isfinite(ex_lo) & (ex_lo <= 0)))
+                & (~need_hi | (jnp.isfinite(ex_hi) & (ex_hi > 0)))
+                & (lo_w >= r_lo) & (hi_w <= r_hi) & (hi_w > lo_w)
+                # a zero-level seed IS the cold bracket: take the exact
+                # cold path (cold inner inits), never a half-warm hybrid
+                & (it_w > 0))
+        r_lo = jnp.where(ok_w, lo_w, r_lo)
+        r_hi = jnp.where(ok_w, hi_w, r_hi)
+        it0 = jnp.where(ok_w, it_w.astype(it0.dtype), it0)
+        f_lo0 = jnp.where(ok_w & need_lo, ex_lo, f_lo0)
+        f_hi0 = jnp.where(ok_w & need_hi, ex_hi, f_hi0)
+        p0 = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(ok_w, a, b), ev_hi.policy, p0)
+        d0 = jnp.where(ok_w, ev_hi.distribution, d0)
+        egm0 = egm0 + ev_lo.egm_iters + ev_hi.egm_iters
+        dist0 = dist0 + ev_lo.dist_iters + ev_hi.dist_iters
+        n_verify = 2
 
     def cond(state):
         lo, hi = state[0], state[1]
@@ -344,13 +431,8 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
             mid = jnp.clip(mid, lo + pad, hi - pad)
         else:
             mid = 0.5 * (lo + hi)
-        ev = household_capital_supply(
-            mid, model, disc_fac, crra, cap_share, depr_fac, prod,
-            egm_tol=egm_tol, dist_tol=dist_tol,
-            init_policy=policy, init_dist=dist, dist_method=dist_method,
-            accel_every=accel_every)
-        demand = firm.k_to_l_from_r(mid, cap_share, depr_fac, prod) * labor
-        ex = ev.supply - demand
+        ev = eval_supply(mid, policy, dist)
+        ex = excess_at(mid, ev)
         freeze = jnp.asarray(False)
         if fault_iter is not None:
             # deterministic fault injection (see docstring): active only
@@ -384,7 +466,7 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
     (lo, hi, _, _, iters, supply, egm_iters, dist_iters, _, _,
      inner_status, ok) = jax.lax.while_loop(
         cond, body,
-        (r_lo, r_hi, -one, one, zi, zero, zi, zi, p0, d0,
+        (r_lo, r_hi, f_lo0, f_hi0, it0, zero, egm0, dist0, p0, d0,
          jnp.int32(CONVERGED), jnp.asarray(True)))
     # worst of: the non-finite tripwire, the bracket exit, and the LAST
     # midpoint's inner fixed-point statuses (earlier midpoints' inner
@@ -396,8 +478,12 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
         jnp.where((hi - lo) > r_tol, jnp.int32(MAX_ITER),
                   jnp.int32(CONVERGED)),
         inner_status)
+    # honest work accounting: evaluations actually performed (continuation
+    # trips + the 2 warm-seed verification solves), not the replayed level
+    # count — identical to the trip count on the cold path
+    evals = iters - it0 + n_verify
     return LeanEquilibrium(r_star=0.5 * (lo + hi), capital=supply,
-                           labor=labor, bisect_iters=iters,
+                           labor=labor, bisect_iters=evals,
                            egm_iters=egm_iters, dist_iters=dist_iters,
                            status=status)
 

@@ -254,10 +254,59 @@ def accelerated_policy_fixed_point(step_fn, p0, tol: float, max_iter: int,
                                                           max_iter)
 
 
+@functools.lru_cache(maxsize=None)
+def _pallas_egm_fixed_point_vmappable(tol: float, max_iter: int,
+                                      accel_every: int):
+    """The Pallas EGM policy fixed point with a custom batching rule —
+    the POLICY-loop twin of ``_pallas_fixed_point_vmappable``.
+
+    A plain ``vmap`` over ``egm_policy_pallas`` would trace every lane
+    into ONE kernel invocation running lock-step; ``custom_vmap``
+    reroutes a batched call to ``egm_policy_pallas_grid`` instead: one
+    program instance per lane, each exiting at its OWN convergence, so a
+    converged calibration cell stops burning MXU cycles instead of
+    running masked EGM steps until the slowest sweep lane's policy
+    converges (ISSUE 2 tentpole).  Nested batch axes collapse into the
+    lane axis exactly like the distribution grid dispatch."""
+    from ..ops.pallas_kernels import egm_policy_pallas, egm_policy_pallas_grid
+
+    def _bcast(axis_size, in_batched, *args):
+        return tuple(a if b else jnp.broadcast_to(a, (axis_size,) + a.shape)
+                     for b, a in zip(in_batched, args))
+
+    @jax.custom_batching.custom_vmap
+    def fp_grid(m0, c0, a_grid, levels, P, scalars):
+        return egm_policy_pallas_grid(m0, c0, a_grid, levels, P, scalars,
+                                      tol, max_iter, accel_every)
+
+    @fp_grid.def_vmap
+    def _grid_batched(axis_size, in_batched, *args):  # noqa: ANN001
+        args = _bcast(axis_size, in_batched, *args)
+        b, c = args[0].shape[0], args[0].shape[1]
+        flat = tuple(a.reshape((b * c,) + a.shape[2:]) for a in args)
+        m, cc, iters, diffs = fp_grid(*flat)
+        return ((m.reshape((b, c) + m.shape[1:]),
+                 cc.reshape((b, c) + cc.shape[1:]),
+                 iters.reshape(b, c), diffs.reshape(b, c)),
+                (True, True, True, True))
+
+    @jax.custom_batching.custom_vmap
+    def fp(m0, c0, a_grid, levels, P, scalars):
+        return egm_policy_pallas(m0, c0, a_grid, levels, P, scalars,
+                                 tol, max_iter, accel_every)
+
+    @fp.def_vmap
+    def _batched(axis_size, in_batched, *args):  # noqa: ANN001
+        args = _bcast(axis_size, in_batched, *args)
+        return fp_grid(*args), (True, True, True, True)
+
+    return fp
+
+
 def solve_household(R, W, model: SimpleModel, disc_fac, crra,
                     tol: float = 1e-6, max_iter: int = 3000,
                     init_policy: HouseholdPolicy | None = None,
-                    accel_every: int = 32):
+                    accel_every: int = 32, method: str = "xla"):
     """Infinite-horizon EGM fixed point via ``lax.while_loop``.
 
     Convergence is sup-norm on the consumption knots — the array analog of
@@ -269,8 +318,42 @@ def solve_household(R, W, model: SimpleModel, disc_fac, crra,
     midpoint's policy — nearby prices → nearby fixed points → far fewer
     backward steps than the identity terminal guess).  Acceleration
     semantics: ``accelerated_policy_fixed_point``.
+
+    ``method``: "xla" (default) runs the fixed point as a ``while_loop``
+    — under ``vmap`` every lane steps until the slowest converges;
+    "pallas" runs it as a VMEM-resident kernel whose ``custom_vmap``
+    batching rule grids one program instance per lane, each exiting at
+    its own convergence (``_pallas_egm_fixed_point_vmappable`` — the
+    sweep's straggler answer extended to the policy loop); "auto" picks
+    "pallas" on a TPU backend whose probe passes, else "xla".  Both
+    engines run the SAME iteration code (``accelerated_policy_fixed_point``
+    + ``egm_step``), so they take the same iteration path (same step
+    count, same status); values agree to float-fusion noise.
     """
     p0 = initial_policy(model) if init_policy is None else init_policy
+    if method == "auto":
+        from ..ops.pallas_kernels import pallas_egm_grid_tpu_available
+        on_tpu = jax.default_backend() in ("tpu", "axon")
+        method = ("pallas" if on_tpu and pallas_egm_grid_tpu_available()
+                  else "xla")
+    if method == "pallas":
+        dt = model.a_grid.dtype
+        scalars = jnp.stack([jnp.asarray(R, dtype=dt),
+                             jnp.asarray(W, dtype=dt),
+                             jnp.asarray(disc_fac, dtype=dt),
+                             jnp.asarray(crra, dtype=dt),
+                             jnp.asarray(model.borrow_limit, dtype=dt)])
+        fp = _pallas_egm_fixed_point_vmappable(float(tol), int(max_iter),
+                                               int(accel_every))
+        m, c, it, diff = fp(p0.m_knots, p0.c_knots, model.a_grid,
+                            model.labor_levels, model.transition, scalars)
+        # status reconstructed outside the kernel boundary: this loop has
+        # no stall exit, so (iters, diff) classify it exactly
+        return (HouseholdPolicy(m_knots=m, c_knots=c), it, diff,
+                classify_fixed_point_exit(diff, tol, it, max_iter))
+    if method != "xla":
+        raise ValueError(f"method must be 'xla', 'pallas' or 'auto', "
+                         f"got {method!r}")
     return accelerated_policy_fixed_point(
         lambda p: egm_step(p, R, W, model, disc_fac, crra),
         p0, tol, max_iter, accel_every)

@@ -3,12 +3,12 @@ and device-sharded agent panels (SURVEY.md §2.4's latent axes made
 first-class)."""
 
 from . import multihost
-from .mesh import make_mesh, pad_to_multiple, sharding
+from .mesh import balanced_lane_order, make_mesh, pad_to_multiple, sharding
 from .panel import initial_panel_sharded, simulate_panel_sharded
 from .sweep import SweepResult, run_table2_sweep
 
 __all__ = [
-    "make_mesh", "pad_to_multiple", "sharding",
+    "balanced_lane_order", "make_mesh", "pad_to_multiple", "sharding",
     "initial_panel_sharded", "simulate_panel_sharded",
     "SweepResult", "run_table2_sweep",
 ]

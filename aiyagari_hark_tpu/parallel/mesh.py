@@ -58,6 +58,39 @@ def sharding(mesh: Mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(*spec))
 
 
+def balanced_lane_order(work, n_shards: int) -> np.ndarray:
+    """Lane permutation that balances per-device TOTAL WORK, not lane count.
+
+    ``NamedSharding`` over a batch axis places CONTIGUOUS equal-size blocks
+    of lanes on devices, so the only lever for load balance is the lane
+    ORDER.  Given predicted per-lane work (len divisible by ``n_shards``),
+    this assigns lanes to shards greedily — heaviest lane first, onto the
+    currently lightest non-full shard (LPT scheduling, the classic 4/3-
+    approximation) — and returns a permutation laying shard 0's lanes
+    first, then shard 1's, etc.  Apply with ``x[perm]`` before
+    ``device_put``; invert with ``np.argsort(perm)`` after the gather so
+    results come back in caller order.
+
+    With ``n_shards=1`` this is the identity-ordering no-op (single
+    device: order cannot change total work)."""
+    work = np.asarray(work, dtype=np.float64)
+    n = work.shape[0]
+    if n % n_shards:
+        raise ValueError(f"{n} lanes not divisible by {n_shards} shards "
+                         "(pad first: pad_to_multiple)")
+    cap = n // n_shards
+    if n_shards == 1:
+        return np.arange(n)
+    bins = [[] for _ in range(n_shards)]
+    totals = np.zeros(n_shards)
+    for lane in np.argsort(-work, kind="stable"):
+        open_bins = [b for b in range(n_shards) if len(bins[b]) < cap]
+        b = min(open_bins, key=lambda i: (totals[i], i))
+        bins[b].append(int(lane))
+        totals[b] += work[lane]
+    return np.concatenate([np.asarray(b, dtype=np.int64) for b in bins])
+
+
 def pad_to_multiple(x, multiple: int, axis: int = 0):
     """Pad ``x`` along ``axis`` (edge-replicating) to a multiple of
     ``multiple``; returns (padded, original_length).  Sharded axes must divide

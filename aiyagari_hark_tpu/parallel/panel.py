@@ -19,6 +19,21 @@ from ..models.ks_model import KSCalibration, KSPolicy
 from ..models.simulate import PanelState, initial_panel, simulate_panel
 
 
+def _shard_map(fn, mesh, in_specs, out_specs):
+    """``shard_map`` across jax versions: the top-level ``jax.shard_map``
+    (with ``check_vma``) landed after 0.4.x; older jaxlibs ship it as
+    ``jax.experimental.shard_map.shard_map`` (with ``check_rep``).  The
+    replication check is disabled in both spellings — the per-period
+    ``pmean`` already replicates the aggregates by construction."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
+
+
 def initial_panel_sharded(cal: KSCalibration, agent_count: int,
                           mrkv_init: int, key: jax.Array, mesh: Mesh,
                           axis: str = "agents") -> PanelState:
@@ -47,8 +62,8 @@ def initial_panel_sharded(cal: KSCalibration, agent_count: int,
     spec_state = PanelState(assets=P(axis), labor_state=P(axis),
                             employed=P(axis), M_now=P(), R_now=P(),
                             W_now=P(), mrkv=P())
-    return jax.shard_map(birth, mesh=mesh, in_specs=P(axis),
-                         out_specs=spec_state, check_vma=False)(keys)
+    return _shard_map(birth, mesh=mesh, in_specs=P(axis),
+                      out_specs=spec_state)(keys)
 
 
 def simulate_panel_sharded(policy: KSPolicy, cal: KSCalibration,
@@ -70,9 +85,8 @@ def simulate_panel_sharded(policy: KSPolicy, cal: KSCalibration,
     spec_state = PanelState(assets=P(axis), labor_state=P(axis),
                             employed=P(axis), M_now=P(), R_now=P(),
                             W_now=P(), mrkv=P())
-    fn = jax.shard_map(
+    fn = _shard_map(
         run, mesh=mesh,
         in_specs=(P(), spec_state, P(axis)),
-        out_specs=(P(), spec_state),
-        check_vma=False)
+        out_specs=(P(), spec_state))
     return fn(mrkv_hist, init, keys)

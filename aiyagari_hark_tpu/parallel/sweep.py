@@ -1,4 +1,4 @@
-"""The Table II calibration sweep as one batched, device-sharded XLA program.
+"""The Table II calibration sweep as batched, device-sharded XLA programs.
 
 The reference runs Aiyagari's Table II (σ ∈ {1,3,5} × ρ ∈ {0,0.3,0.6,0.9})
 **manually, one notebook cell at a time**, editing the parameter dicts between
@@ -8,10 +8,27 @@ tuple batches BOTH of Aiyagari's panels — vmapped through the jitted
 bisection equilibrium (``models.equilibrium``) and sharded over the ``cells``
 mesh axis.  No communication between cells — XLA places one subset of cells
 per device and the only cross-device traffic is the final result gather.
+
+Scheduling (ISSUE 2): one lock-step launch prices every lane at the SLOWEST
+cell (measured total-work skew 2.6 at 12 lanes growing to 5.3 at 96,
+``bench_tpu_last.json:lanes_scaling``) — the load-imbalance pathology
+high-dimensional DSGE solvers schedule around (Scheidegger et al.,
+arXiv:2202.06555).  Per-cell work is *predictable* from (σ, ρ, sd) (the
+asymptotic-linearity structure of the consumption policy, Ma–Stachurski–Toda
+arXiv:2002.09108) or, better, from a prior run's counters, so the
+``schedule="balanced"`` path sorts cells by predicted work into
+work-homogeneous BUCKETS solved as separate launches of one shared
+executable (same shape ⇒ same compiled program, different data), balances
+per-DEVICE total work — not lane count — inside each bucket, optionally
+warm-starts each cell's bisection bracket by verified dyadic descent toward
+a known root, and un-permutes before ``SweepResult`` so the output is
+bit-order-identical to the lock-step path.
 """
 
 from __future__ import annotations
 
+import time
+import warnings
 from dataclasses import dataclass
 from functools import lru_cache
 from typing import Optional
@@ -23,8 +40,14 @@ from jax.sharding import Mesh
 
 from ..models.equilibrium import solve_calibration_lean
 from ..solver_health import CONVERGED, is_failure, status_name
+from ..utils.checkpoint import (
+    CheckpointMismatchError,
+    config_fingerprint,
+    load_sweep_sidecar,
+    save_sweep_sidecar,
+)
 from ..utils.config import SweepConfig
-from .mesh import pad_to_multiple, sharding
+from .mesh import balanced_lane_order, pad_to_multiple, sharding
 
 
 @dataclass
@@ -40,6 +63,10 @@ class SweepResult:
     Under vmap-of-while, every lane runs until the slowest converges, so
     ``iteration_skew()`` (max/min total work) bounds the wasted compute —
     the supporting model for multi-chip scaling claims (VERDICT r1 #9).
+    ``scheduled_iteration_skew()`` is the straggler ratio AFTER bucketed
+    scheduling — the waste the hardware actually sees when the sweep ran
+    ``schedule="balanced"`` (``bucket`` records each cell's launch group,
+    ``predicted_work`` the scheduler's cost model).
 
     Solver health: ``status`` holds each cell's final ``solver_health``
     code and ``retries`` how many quarantine retries it consumed (0 =
@@ -57,13 +84,17 @@ class SweepResult:
     saving_rate_pct: np.ndarray  # [C] δK/Y, percent
     capital: np.ndarray       # [C]
     excess: np.ndarray        # [C] market-clearing residual, O(r_tol) exact
-    bisect_iters: np.ndarray  # [C]
+    bisect_iters: np.ndarray  # [C] excess evaluations actually performed
     egm_iters: np.ndarray     # [C] total EGM steps across all midpoints
     dist_iters: np.ndarray    # [C] total distribution-iteration steps
     wall_seconds: float = float("nan")
     dist_method: str = "auto"   # the distribution method that actually ran
+    egm_method: str = "xla"     # the policy-loop engine that actually ran
     status: Optional[np.ndarray] = None   # [C] solver_health codes (final)
     retries: Optional[np.ndarray] = None  # [C] quarantine attempts used
+    bucket: Optional[np.ndarray] = None   # [C] scheduled launch group
+    #                                       (None = lock-step single batch)
+    predicted_work: Optional[np.ndarray] = None  # [C] scheduler work model
 
     def failed_cells(self) -> np.ndarray:
         """Indices of cells whose final status is a failure (MAX_ITER or
@@ -81,6 +112,21 @@ class SweepResult:
         finish (1.0 = perfectly balanced; the batch runs at the max)."""
         w = self.total_work()
         return float(w.max() / max(w.min(), 1))
+
+    def scheduled_iteration_skew(self) -> float:
+        """The straggler ratio the hardware actually paid: with bucketed
+        scheduling each bucket is its own lock-step launch, so the binding
+        ratio is the WORST within-bucket max/min (equals
+        ``iteration_skew()`` for a lock-step sweep, where the single
+        launch is the single bucket)."""
+        if self.bucket is None:
+            return self.iteration_skew()
+        w = self.total_work()
+        worst = 1.0
+        for b in np.unique(self.bucket[self.bucket >= 0]):
+            wb = w[self.bucket == b]
+            worst = max(worst, float(wb.max() / max(wb.min(), 1)))
+        return worst
 
     def table(self) -> str:
         """Aiyagari Table II layout: rows ρ, columns σ, entries r* (%);
@@ -105,12 +151,25 @@ class SweepResult:
         return "\n".join(lines)
 
 
+def _canonical_dtype(dtype):
+    """Normalize a sweep dtype request to the concrete dtype the program
+    will run in, so ``dtype=None`` and an explicitly-passed default cannot
+    produce two ``_batched_solver`` cache entries — two identical XLA
+    compiles — for the same program (ISSUE 2 satellite)."""
+    from jax import dtypes as jax_dtypes
+
+    return jax_dtypes.canonicalize_dtype(
+        np.float64 if dtype is None else np.dtype(dtype))
+
+
 @lru_cache(maxsize=None)
-def _batched_solver(dtype, kwargs_items=(), fault_mode=None):
+def _batched_solver(dtype, kwargs_items=(), fault_mode=None, warm=False):
     """Jitted vmapped cell solver, memoized so repeated sweeps (benchmarks,
-    resumed runs) hit the jit cache instead of rebuilding the closure.
-    Cached entries (jitted closures) live for the process — call
-    ``_batched_solver.cache_clear()`` to drop them.
+    resumed runs, every bucket of a scheduled sweep) hit the jit cache
+    instead of rebuilding the closure.  Cached entries (jitted closures)
+    live for the process — call ``_batched_solver.cache_clear()`` to drop
+    them.  ``dtype`` must already be canonical (``_canonical_dtype``) so
+    aliases cannot split the cache.
 
     The stationary s.d. is a vmapped axis alongside (σ, ρ), so both
     Table II panels batch into one program.  Uses the lean bisection
@@ -120,10 +179,17 @@ def _batched_solver(dtype, kwargs_items=(), fault_mode=None):
     ``run_table2_sweep``.
 
     ``fault_mode`` (static) compiles in the deterministic fault-injection
-    hook: the returned callable then takes a fourth per-cell array of
+    hook: the returned callable then takes an extra per-cell array of
     bisection trip indices (negative = healthy lane) — see
     ``solve_equilibrium_lean``.  ``None`` (the production default) keeps
-    the three-argument program with the hook compiled out.
+    the hook compiled out.
+
+    ``warm`` (static) compiles in the warm-started bracket continuation:
+    the callable takes three extra per-cell arrays ``(lo0, hi0, it0)`` —
+    verified dyadic bracket seeds (``solve_equilibrium_lean``'s
+    ``bracket_init``).  A scheduled sweep therefore uses at most TWO
+    executables (cold + warm) regardless of bucket count: every bucket is
+    padded to one shared shape, so later launches are pure cache hits.
     """
     model_kwargs = dict(kwargs_items)
 
@@ -142,15 +208,27 @@ def _batched_solver(dtype, kwargs_items=(), fault_mode=None):
                           res.dist_iters.astype(f),
                           res.status.astype(f)])
 
-    if fault_mode is None:
+    def solve_cell(crra, rho, sd, bracket_init=None, fault_it=None):
+        extra = {} if bracket_init is None else {"bracket_init": bracket_init}
+        if fault_mode is not None:
+            extra.update(fault_iter=fault_it, fault_mode=fault_mode)
+        return pack(solve_calibration_lean(crra, rho, labor_sd=sd,
+                                           dtype=dtype, **extra,
+                                           **model_kwargs))
+
+    if fault_mode is None and not warm:
         def solve_one(crra, rho, sd):
-            return pack(solve_calibration_lean(crra, rho, labor_sd=sd,
-                                               dtype=dtype, **model_kwargs))
-    else:
+            return solve_cell(crra, rho, sd)
+    elif fault_mode is None:
+        def solve_one(crra, rho, sd, lo0, hi0, it0):
+            return solve_cell(crra, rho, sd, bracket_init=(lo0, hi0, it0))
+    elif not warm:
         def solve_one(crra, rho, sd, fault_it):
-            return pack(solve_calibration_lean(
-                crra, rho, labor_sd=sd, dtype=dtype, fault_iter=fault_it,
-                fault_mode=fault_mode, **model_kwargs))
+            return solve_cell(crra, rho, sd, fault_it=fault_it)
+    else:
+        def solve_one(crra, rho, sd, lo0, hi0, it0, fault_it):
+            return solve_cell(crra, rho, sd, bracket_init=(lo0, hi0, it0),
+                              fault_it=fault_it)
 
     return jax.jit(jax.vmap(solve_one))
 
@@ -159,20 +237,23 @@ def _batched_solver(dtype, kwargs_items=(), fault_mode=None):
 # rung re-runs a failed cell serially with progressively safer settings —
 # pure bisection (no Illinois secant jumps), an ALTERNATE distribution
 # method (a Mosaic/extrapolation pathology in one method is invisible to
-# another), then plain damped iteration (``accel_every=0`` — the Anderson
-# extrapolation is the main non-finite risk in the inner loops), then a
-# 10x-padded bracket that keeps the bisection away from the singular
-# endpoints where the supply map loses contraction (ISSUE refs:
+# another — and the SAME alternate is kept on later rungs: re-running the
+# failing method with damping would retry the pathology, not escape it),
+# the lock-step XLA policy loop (same reasoning for an EGM-kernel
+# pathology), then plain damped iteration (``accel_every=0`` — the
+# Anderson extrapolation is the main non-finite risk in the inner loops),
+# then a 10x-padded bracket that keeps the bisection away from the
+# singular endpoints where the supply map loses contraction (ISSUE refs:
 # Cao-Luo-Nie 1905.13045, Ma-Stachurski-Toda 1812.01320).
 def _retry_ladder(model_kwargs: dict) -> tuple:
     prior = model_kwargs.get("dist_method", "auto")
     alternate = "dense" if prior in ("auto", "scatter") else "scatter"
     return (
         {"dist_method": alternate, "root_method": "bisect"},
-        {"dist_method": "scatter", "root_method": "bisect",
-         "accel_every": 0},
-        {"dist_method": "scatter", "root_method": "bisect",
-         "accel_every": 0, "bracket_pad": 10.0},
+        {"dist_method": alternate, "root_method": "bisect",
+         "egm_method": "xla", "accel_every": 0},
+        {"dist_method": alternate, "root_method": "bisect",
+         "egm_method": "xla", "accel_every": 0, "bracket_pad": 10.0},
     )
 
 
@@ -200,50 +281,381 @@ def _hashable_kwargs(model_kwargs: dict) -> tuple:
     return tuple(items)
 
 
+# ---------------------------------------------------------------------------
+# Work-balanced scheduling (ISSUE 2 tentpole).
+# ---------------------------------------------------------------------------
+
+def heuristic_cell_work(cells: np.ndarray) -> np.ndarray:
+    """Relative per-cell inner-loop work predicted from (σ, ρ, sd) alone —
+    the scheduler's cold-start cost model.
+
+    Empirics (CPU f64 counter records, this repo): total work is dominated
+    by distribution iterations, whose count is the wealth chain's mixing
+    time; measured WORK falls strongly in ρ (persistent income lets the
+    wealth distribution settle in far fewer push-forwards), strongly in
+    sd, and mildly in σ — equivalently, inverse work RISES approximately
+    affinely in each, which is the form fitted below.  Only the RANKING
+    matters for bucketing, and a prior-run sidecar replaces this model
+    with measured counters cell-for-cell whenever one is available
+    (``run_table2_sweep``)."""
+    cells = np.asarray(cells, dtype=np.float64)
+    sig, rho, sd = cells[:, 0], cells[:, 1], cells[:, 2]
+    # measured work FALLS in rho, sd, and (mildly) sigma, so the fitted
+    # INVERSE work RISES approximately affinely in each — keep the signs
+    # paired with test_heuristic_work_model_ranks when recalibrating
+    inv = 1.0 + 0.81 * rho + 6.6 * (sd - 0.2) + 0.02 * (sig - 1.0)
+    return 1.0 / np.maximum(inv, 0.05)
+
+
+def _work_fingerprint(kwargs_items: tuple, dtype) -> int:
+    """Sidecar validity key: the solver configuration that shaped the
+    counters (method choices, tolerances, grid sizes) plus the dtype.
+    Cell triples are NOT part of the key — rows are matched per cell, so
+    a sidecar from a coarser lattice still warm-starts the cells it has."""
+    return config_fingerprint(str(np.dtype(dtype)), repr(kwargs_items))
+
+
+def _load_sidecar(path, fingerprint):
+    """Best-effort sidecar read: a missing, corrupt, or stale-fingerprint
+    file degrades to the heuristic — never kills a sweep.  (BadZipFile /
+    EOFError are what ``np.load`` raises on a truncated or trashed npz —
+    neither is an OSError.)"""
+    import zipfile
+
+    if path is None:
+        return None
+    try:
+        return load_sweep_sidecar(path, fingerprint)
+    except CheckpointMismatchError as e:
+        warnings.warn(f"sweep sidecar ignored: {e}", stacklevel=3)
+        return None
+    except (OSError, ValueError, EOFError, zipfile.BadZipFile):
+        return None
+
+
+def _predict_work(cells: np.ndarray, side) -> np.ndarray:
+    """Per-cell predicted work: sidecar counters where available (scaled
+    into the heuristic's units via the median ratio over matched cells, so
+    mixed predictions stay comparable), heuristic elsewhere."""
+    pred = heuristic_cell_work(cells)
+    if side is None:
+        return pred
+    measured = np.full(len(cells), np.nan)
+    work = side.total_work()
+    for i, cell in enumerate(cells):
+        j = side.lookup(cell)
+        if j is not None and work[j] > 0:
+            measured[i] = float(work[j])
+    have = np.isfinite(measured)
+    if have.any():
+        scale = float(np.median(measured[have] / pred[have]))
+        pred = pred * max(scale, 1e-12)
+        pred[have] = measured[have]
+    return pred
+
+
+def _host_bracket(model_kwargs: dict, dtype):
+    """The economic bisection bracket in host arithmetic, bit-identical to
+    ``equilibrium._bisection_setup``'s (same Python-float expression, one
+    cast to ``dtype``) — required so dyadic descent replays the device's
+    exact endpoint bits."""
+    ft = np.dtype(dtype).type
+    disc_fac = float(model_kwargs.get("disc_fac", 0.96))
+    depr_fac = float(model_kwargs.get("depr_fac", 0.08))
+    pad = float(model_kwargs.get("bracket_pad", 1.0))
+    return (ft(-depr_fac + 1e-3 * pad),
+            ft(1.0 / disc_fac - 1.0 - 1e-4 * pad))
+
+
+def _host_r_tol(model_kwargs: dict, dtype) -> float:
+    """The effective bracket tolerance (``_bisection_setup`` defaults)."""
+    rt = model_kwargs.get("r_tol")
+    if rt is not None:
+        return float(rt)
+    return 1e-10 if np.dtype(dtype) == np.float64 else 1e-6
+
+
+def dyadic_bracket(r_lo, r_hi, target: float, margin: float,
+                   max_levels: int, dtype):
+    """Descend the bisection's dyadic tree toward ``target``, in the SAME
+    floating-point arithmetic the compiled loop uses (``mid = 0.5*(lo+hi)``
+    in ``dtype``), keeping a safety ball of ``margin`` around the target
+    inside the bracket.  Returns ``(lo, hi, levels)`` — a bracket whose
+    endpoints are bit-exact dyadic descendants of ``(r_lo, r_hi)``, so a
+    continuation from it replays the cold bisection's remaining midpoint
+    sequence exactly (``solve_equilibrium_lean``'s ``bracket_init``
+    contract)."""
+    ft = np.dtype(dtype).type
+    lo, hi, half = ft(r_lo), ft(r_hi), ft(0.5)
+    levels = 0
+    while levels < max_levels:
+        mid = half * (lo + hi)
+        if target + margin < mid:
+            hi = mid
+        elif target - margin > mid:
+            lo = mid
+        else:
+            break
+        levels += 1
+    return lo, hi, levels
+
+
+def _plan_buckets(order: np.ndarray, n_buckets: int):
+    """Split the work-sorted cell order into equal-size contiguous buckets
+    (cheapest first).  0 = auto: ~C/3 buckets capped at 8 — small enough
+    buckets to homogenize work, few enough launches to keep dispatch
+    overhead negligible."""
+    n = len(order)
+    k = n_buckets if n_buckets > 0 else max(1, min(8, n // 3))
+    k = min(k, n)
+    size = -(-n // k)
+    return [order[i * size:(i + 1) * size]
+            for i in range(k) if len(order[i * size:(i + 1) * size])], size
+
+
+def _neighbor_seed(cell, cells, r_solved, solved_ok, width, r_tol,
+                   warm_margin):
+    """Bracket seed for ``cell`` from the nearest already-solved neighbor
+    in normalized (σ, ρ, sd) space: target = neighbor's root, margin = the
+    local r*-variation between the two nearest solved neighbors (how far
+    the root plausibly moved), floored defensively.  None when nothing is
+    solved yet."""
+    idx = np.nonzero(solved_ok)[0]
+    if len(idx) == 0:
+        return None
+    d = (np.abs(cells[idx, 0] - cell[0]) / 4.0
+         + np.abs(cells[idx, 1] - cell[1]) / 0.9
+         + np.abs(cells[idx, 2] - cell[2]) / 0.4)
+    near = idx[np.argsort(d, kind="stable")]
+    target = float(r_solved[near[0]])
+    if warm_margin > 0.0:
+        return target, float(warm_margin)
+    if len(near) > 1:
+        spread = abs(float(r_solved[near[0]]) - float(r_solved[near[1]]))
+        margin = max(spread, 0.03 * width, 64.0 * r_tol)
+    else:
+        margin = max(0.08 * width, 64.0 * r_tol)
+    return target, margin
+
+
+def _solve_scheduled(sweep: SweepConfig, crra, rho, sd, rho_nominal,
+                     fault_iters, fault_mode, mesh, axis, dtype,
+                     kwargs_items, model_kwargs, perturb=0.0):
+    """The work-balanced bucketed solve: returns per-cell packed results
+    ``[C, 7]`` in ORIGINAL cell order, the summed launch wall, the bucket
+    assignment, and the predicted-work vector.
+
+    Order of operations per bucket (cheapest predicted bucket first):
+    warm-bracket seeds from the sidecar (same cell) or the nearest solved
+    neighbor, lane layout balanced per device by predicted work (LPT), one
+    launch of the shared executable, results un-permuted into place and
+    made available as seeds for the next bucket.  Sidecar lookups, the
+    work model, and neighbor distances all use the NOMINAL ρ (a benchmark
+    ``perturb`` nudge must not break same-cell matching)."""
+    n_orig = len(crra)
+    cells = np.stack([crra, rho_nominal, sd], axis=1)
+    fingerprint = _work_fingerprint(kwargs_items, dtype)
+    side = (_load_sidecar(sweep.sidecar_path, fingerprint)
+            if sweep.work_model in ("auto", "sidecar") else None)
+    if sweep.work_model == "sidecar" and side is None:
+        warnings.warn("work_model='sidecar' but no valid sidecar at "
+                      f"{sweep.sidecar_path!r}; using the heuristic",
+                      stacklevel=3)
+    pred = _predict_work(cells, side)
+    order = np.argsort(pred, kind="stable")
+    buckets, size = _plan_buckets(order, sweep.n_buckets)
+
+    n_shards = 1 if mesh is None else mesh.shape[axis]
+    b_pad = size + (-size % n_shards)
+    shard = None if mesh is None else sharding(mesh, axis)
+
+    r_lo, r_hi = _host_bracket(model_kwargs, dtype)
+    width = float(r_hi) - float(r_lo)
+    r_tol = _host_r_tol(model_kwargs, dtype)
+    max_levels = max(0, int(model_kwargs.get("max_bisect", 60)) - 6)
+    # Same-cell sidecar seeds descend DEEP: the prior root is exact to
+    # r_tol for an identical configuration, and the expensive evaluations
+    # are the near-root ones (slow-mixing distribution fixed points cost a
+    # ~constant certification floor per evaluation regardless of warm
+    # carry), so every level skipped near the root saves a floor-cost
+    # solve.  2x r_tol keeps the verified ball strictly containing the
+    # root; the continuation still performs >= 2 certified evaluations.
+    # The |perturb| term covers the benchmark methodology: a perturbed
+    # timed rerun moves the root by ~perturb * dr*/drho (dr*/drho is
+    # O(0.03) on the Table II lattice, so 4|perturb| has ~100x slack) —
+    # without it an f64 rerun's margin (2e-10) sits INSIDE the root
+    # shift, every seed fails verification, and the "warm" sweep pays
+    # cold work plus two verification solves per lane.
+    margin_same = (float(sweep.warm_margin) if sweep.warm_margin > 0.0
+                   else max(2.0 * r_tol, 4.0 * abs(float(perturb)),
+                            16.0 * np.finfo(np.dtype(dtype)).eps * width))
+
+    results = np.full((n_orig, 7), np.nan)
+    solved = np.zeros(n_orig, dtype=bool)
+    bucket_of = np.full(n_orig, -1, dtype=np.int64)
+    wall_total = 0.0
+
+    for bi, bucket in enumerate(buckets):
+        bucket_of[bucket] = bi
+        lanes = np.concatenate(
+            [bucket, np.repeat(bucket[-1], b_pad - len(bucket))]
+        ).astype(np.int64)
+        if n_shards > 1:
+            lanes = lanes[balanced_lane_order(pred[lanes], n_shards)]
+
+        seeds = None
+        if sweep.warm_brackets:
+            status_so_far = np.rint(
+                np.nan_to_num(results[:, 6], nan=3.0)).astype(np.int64)
+            solved_ok = (solved & np.isfinite(results[:, 0])
+                         & ~is_failure(status_so_far))
+            targets = []
+            for li in lanes:
+                seed = None
+                if side is not None:
+                    j = side.lookup(cells[li])
+                    if j is not None and np.isfinite(side.r_star[j]):
+                        seed = (float(side.r_star[j]), margin_same)
+                if seed is None:
+                    seed = _neighbor_seed(cells[li], cells, results[:, 0],
+                                          solved_ok, width, r_tol,
+                                          float(sweep.warm_margin))
+                targets.append(seed)
+            known = [t for t in targets if t is not None]
+            if known:
+                # A lane with no seed of its own (e.g. its sidecar root is
+                # NaN because the cell failed last run, and nothing is
+                # solved yet to neighbor from) must not force the whole
+                # bucket cold: give it a PSEUDO-seed at the median of its
+                # bucket-mates' targets.  In-program verification decides
+                # per lane — a wrong pseudo-bracket (or one that cannot
+                # descend at all, it0 = 0) falls back to the exact cold
+                # trajectory at the cost of two cheap-end evaluations.
+                med = float(np.median([t[0] for t in known]))
+                pseudo = (med, max(0.125 * width, 64.0 * r_tol))
+                per_lane = []
+                for t in targets:
+                    tt = t if t is not None else pseudo
+                    per_lane.append(dyadic_bracket(r_lo, r_hi, tt[0],
+                                                   tt[1], max_levels,
+                                                   dtype))
+                seeds = per_lane
+
+        warm = seeds is not None
+        fn = _batched_solver(dtype, kwargs_items, fault_mode, warm)
+        args = [jnp.asarray(crra[lanes], dtype=dtype),
+                jnp.asarray(rho[lanes], dtype=dtype),
+                jnp.asarray(sd[lanes], dtype=dtype)]
+        if warm:
+            args += [jnp.asarray(np.asarray([s[0] for s in seeds]),
+                                 dtype=dtype),
+                     jnp.asarray(np.asarray([s[1] for s in seeds]),
+                                 dtype=dtype),
+                     jnp.asarray(np.asarray([s[2] for s in seeds],
+                                            dtype=np.int32))]
+        if fault_mode is not None:
+            args.append(jnp.asarray(fault_iters[lanes]))
+        if shard is not None:
+            args = [jax.device_put(a, shard) for a in args]
+
+        t0 = time.perf_counter()
+        packed = np.asarray(fn(*args))            # [B, 7], one transfer
+        wall_total += time.perf_counter() - t0
+
+        # un-permute: padding lanes duplicate a real lane's inputs, so the
+        # duplicate rows carry identical bits and last-write-wins is exact
+        results[lanes] = packed
+        solved[bucket] = True
+    return results, wall_total, bucket_of, pred
+
+
+_COMPILATION_CACHE_ON = False
+
+
+def _ensure_compilation_cache() -> None:
+    """Idempotently enable the persistent XLA compilation cache for sweep
+    programs (``SweepConfig.compilation_cache``).  The kill switch
+    (``AIYAGARI_COMPILATION_CACHE=0``) is parsed in exactly ONE place —
+    ``utils.backend.enable_compilation_cache``, which returns "" without
+    touching jax config when it is set.  Best-effort — an unwritable
+    cache dir must not take down a solve."""
+    global _COMPILATION_CACHE_ON
+    if _COMPILATION_CACHE_ON:
+        return
+    try:
+        from ..utils.backend import enable_compilation_cache
+
+        enable_compilation_cache()
+    except OSError as e:
+        warnings.warn(f"persistent compilation cache unavailable: {e}",
+                      stacklevel=3)
+    _COMPILATION_CACHE_ON = True   # resolved either way: stop re-checking
+
+
 def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
                      mesh: Optional[Mesh] = None, axis: str = "cells",
                      dtype=None, timer=None, perturb: float = 0.0,
                      quarantine: bool = True, max_retries: int = 3,
                      inject_fault: Optional[dict] = None,
                      **model_kwargs) -> SweepResult:
-    """Solve every (σ, ρ, sd) cell as one batched program.
+    """Solve every (σ, ρ, sd) cell as batched program launches.
+
+    Scheduling: ``sweep.schedule`` picks between the single lock-step
+    launch ("locked" — every lane runs until the slowest cell converges)
+    and the work-balanced bucketed path ("balanced" — cells sorted by
+    predicted work into ``sweep.n_buckets`` equal-shape launches of one
+    shared executable, cheapest bucket first, per-device work balanced
+    inside each bucket, optional verified warm-started brackets); "auto"
+    (default) buckets batches of >= 8 cells.  The scheduled path's output
+    is un-permuted before ``SweepResult`` — bit-order-identical to the
+    lock-step path (and, with ``warm_brackets`` off, bit-IDENTICAL: the
+    per-lane computation does not depend on batch size or lane position).
+    With ``sweep.sidecar_path`` set, per-cell counters and roots persist
+    across runs (``utils.checkpoint.SweepSidecar``): the next sweep
+    buckets on measured work and, with ``warm_brackets=True``, descends
+    each cell's bracket toward its known root — skipping the expensive
+    wide-bracket bisection trips while keeping the certified ``r_tol``
+    contract (every seed is verified in-program; a bad seed falls back to
+    the cold bracket, see ``solve_equilibrium_lean``).
 
     Solver health: every cell returns a ``solver_health`` status code.
     With ``quarantine`` on (the default), failed cells (MAX_ITER /
     NONFINITE — a single diverged calibration must not poison the batch)
     are NaN-masked and re-run serially on the host through the bounded
     ``_retry_ladder`` (up to ``max_retries`` rungs: alternate
-    distribution method, damped updates, padded bracket); a recovered
-    cell's values and counters replace the quarantined ones, a cell that
-    exhausts the ladder stays NaN with its failing status recorded.  The
-    retries run AFTER the timed batched solve, so ``wall_seconds`` stays
-    the honest batched-program wall.
+    distribution method — reused on every rung, never the known-failing
+    one — damped updates, padded bracket); a recovered cell's values and
+    counters replace the quarantined ones, a cell that exhausts the
+    ladder stays NaN with its failing status recorded.  The retries run
+    AFTER the timed batched solve, so ``wall_seconds`` stays the honest
+    batched-program wall.
 
     ``inject_fault``: deterministic fault injection for exercising that
     machinery — ``{"cell": i, "at_iter": k, "mode": "nan"|"stall"}``
     poisons cell ``i`` at its k-th bisection trip inside the jitted
     program (``solve_equilibrium_lean``); all other lanes run the same
-    lock-step masked iterations they run uninjected, so their results
-    stay bit-identical.  Retries never re-inject.
+    masked iterations they run uninjected, so their results stay
+    bit-identical.  Retries never re-inject.  Cell indices refer to the
+    ORIGINAL ``sweep.cells()`` order under any schedule.
 
     With ``mesh`` given, cells are sharded over ``axis`` (padded by edge
-    replication to divide the axis size); the batch is one ``jit`` whose
-    per-cell ``while_loop``s run until the *slowest* cell converges —
-    the usual vmap-of-while semantics.  Measured straggler cost: ~2.5x
-    total-work skew within one panel, ~3.5x across both Table II panels
-    (the high-risk sd=0.4 cells mix slowest) — still far cheaper than
-    separate launches.  Without a mesh it is the same program on one
-    device.
+    replication to divide the axis size); under "balanced" each bucket is
+    additionally laid out so per-device TOTAL PREDICTED WORK — not lane
+    count — balances (``mesh.balanced_lane_order``).  Without a mesh it
+    is the same program on one device.
 
     ``wall_seconds`` is an HONEST wall: the clock stops only after every
     output has materialized on the host (``np.asarray``), because through
     the tunneled TPU ``block_until_ready`` alone does not reliably block
-    for XLA executables.  Benchmark callers should also pass a tiny
-    ``perturb`` (added to the ρ inputs, e.g. 1e-6 — it must survive the
-    f32 cast: f32 spacing at ρ=0.3 is ~3e-8) on the timed call so
-    an identical-execution cache anywhere in the stack cannot serve the
-    warm-up run's results — same compiled program, same fixed point to
-    within the perturbation (methodology of ``scripts/pallas_ab.py``).
+    for XLA executables; the scheduled path reports the SUM of its launch
+    walls (host-side planning between launches is excluded — it is
+    microseconds against seconds of solve).  Benchmark callers should
+    also pass a tiny ``perturb`` (added to the ρ inputs, e.g. 1e-6 — it
+    must survive the f32 cast: f32 spacing at ρ=0.3 is ~3e-8) on the
+    timed call so an identical-execution cache anywhere in the stack
+    cannot serve the warm-up run's results — same compiled program, same
+    fixed point to within the perturbation (methodology of
+    ``scripts/pallas_ab.py``).
     """
     cells = np.asarray(sweep.cells(), dtype=np.float64)  # [C, 3] (σ, ρ, sd)
     crra, rho, sd = cells[:, 0], cells[:, 1], cells[:, 2]
@@ -251,6 +663,9 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
     if perturb:
         rho = rho + perturb
     n_orig = crra.shape[0]
+    dtype = _canonical_dtype(dtype)
+    if sweep.compilation_cache:
+        _ensure_compilation_cache()
     fault_mode = None
     fault_iters = None
     if inject_fault is not None:
@@ -258,29 +673,6 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
         fault_iters = np.full(n_orig, -1, dtype=np.int32)
         fault_iters[int(inject_fault["cell"])] = int(
             inject_fault.get("at_iter", 0))
-    if mesh is not None:
-        shard = sharding(mesh, axis)
-        n_shards = mesh.shape[axis]
-        crra, _ = pad_to_multiple(crra, n_shards)
-        rho, _ = pad_to_multiple(rho, n_shards)
-        sd, _ = pad_to_multiple(sd, n_shards)
-        crra = jax.device_put(jnp.asarray(crra, dtype=dtype), shard)
-        rho = jax.device_put(jnp.asarray(rho, dtype=dtype), shard)
-        sd = jax.device_put(jnp.asarray(sd, dtype=dtype), shard)
-        if fault_iters is not None:
-            # edge-replication padding may duplicate the LAST cell; pad
-            # with healthy -1 lanes instead so a fault is injected exactly
-            # once
-            pad = crra.shape[0] - n_orig
-            fault_iters = np.concatenate(
-                [fault_iters, np.full(pad, -1, dtype=np.int32)])
-            fault_iters = jax.device_put(jnp.asarray(fault_iters), shard)
-    else:
-        crra = jnp.asarray(crra, dtype=dtype)
-        rho = jnp.asarray(rho, dtype=dtype)
-        sd = jnp.asarray(sd, dtype=dtype)
-        if fault_iters is not None:
-            fault_iters = jnp.asarray(fault_iters)
 
     if "dist_method" not in model_kwargs:
         # Sweep-level default, distinct from stationary_wealth's "auto".
@@ -302,19 +694,80 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
                 "pallas" if pallas_grid_tpu_available() else "dense")
         else:
             model_kwargs["dist_method"] = "auto"
+    if "egm_method" not in model_kwargs:
+        # Same default logic for the POLICY loop (ISSUE 2 tentpole): the
+        # lane-grid EGM kernel lets a converged cell stop burning MXU
+        # cycles instead of lock-stepping to the slowest lane; probe-gated
+        # with the XLA while_loop as the universal fallback.
+        if jax.default_backend() in ("tpu", "axon"):
+            from ..ops.pallas_kernels import pallas_egm_grid_tpu_available
+            model_kwargs["egm_method"] = (
+                "pallas" if pallas_egm_grid_tpu_available() else "xla")
+        else:
+            model_kwargs["egm_method"] = "xla"
 
-    fn = _batched_solver(dtype, _hashable_kwargs(model_kwargs), fault_mode)
-    import time
-    args = (crra, rho, sd) if fault_iters is None else (crra, rho, sd,
-                                                        fault_iters)
-    t0 = time.perf_counter()
-    packed = np.asarray(fn(*args))                # [C, 7], one transfer
-    wall = time.perf_counter() - t0
-    r, K, L, iters, egm_it, dist_it, status_f = packed.T
+    kwargs_items = _hashable_kwargs(model_kwargs)
+    schedule = sweep.schedule
+    if schedule == "auto":
+        # Balanced by default only where dispatch is cheap: through the
+        # tunneled TPU every launch costs ~0.7 s round trip
+        # (bench ``dispatch_roundtrip_s``), so bucketing a small batch
+        # there trades straggler waste for a larger fixed cost — and the
+        # pallas lane grid already de-stragglers the dominant
+        # distribution loop per lane.  Accelerator callers opt in
+        # explicitly (the bench's warm-scheduled phase does).
+        on_accel = jax.default_backend() in ("tpu", "axon")
+        schedule = "balanced" if (n_orig >= 8 and not on_accel) else "locked"
+    if schedule not in ("balanced", "locked"):
+        raise ValueError(f"schedule must be 'auto', 'balanced' or "
+                         f"'locked', got {sweep.schedule!r}")
+
+    bucket_of = None
+    pred = None
+    if schedule == "balanced":
+        packed, wall, bucket_of, pred = _solve_scheduled(
+            sweep, crra, rho, sd, rho_label, fault_iters, fault_mode,
+            mesh, axis, dtype, kwargs_items, model_kwargs,
+            perturb=perturb)
+        r, K, L, iters, egm_it, dist_it, status_f = packed.T
+        sl = slice(0, n_orig)
+    else:
+        if mesh is not None:
+            shard = sharding(mesh, axis)
+            n_shards = mesh.shape[axis]
+            crra_d, _ = pad_to_multiple(crra, n_shards)
+            rho_d, _ = pad_to_multiple(rho, n_shards)
+            sd_d, _ = pad_to_multiple(sd, n_shards)
+            crra_d = jax.device_put(jnp.asarray(crra_d, dtype=dtype), shard)
+            rho_d = jax.device_put(jnp.asarray(rho_d, dtype=dtype), shard)
+            sd_d = jax.device_put(jnp.asarray(sd_d, dtype=dtype), shard)
+            fault_d = None
+            if fault_iters is not None:
+                # edge-replication padding may duplicate the LAST cell; pad
+                # with healthy -1 lanes instead so a fault is injected
+                # exactly once
+                pad = crra_d.shape[0] - n_orig
+                fault_d = np.concatenate(
+                    [fault_iters, np.full(pad, -1, dtype=np.int32)])
+                fault_d = jax.device_put(jnp.asarray(fault_d), shard)
+        else:
+            crra_d = jnp.asarray(crra, dtype=dtype)
+            rho_d = jnp.asarray(rho, dtype=dtype)
+            sd_d = jnp.asarray(sd, dtype=dtype)
+            fault_d = (None if fault_iters is None
+                       else jnp.asarray(fault_iters))
+
+        fn = _batched_solver(dtype, kwargs_items, fault_mode)
+        args = ((crra_d, rho_d, sd_d) if fault_d is None
+                else (crra_d, rho_d, sd_d, fault_d))
+        t0 = time.perf_counter()
+        packed = np.asarray(fn(*args))                # [C, 7], one transfer
+        wall = time.perf_counter() - t0
+        r, K, L, iters, egm_it, dist_it, status_f = packed.T
+        sl = slice(0, n_orig)
     if timer is not None:
         timer(wall)
 
-    sl = slice(0, n_orig)
     # explicit copies: the device transfer's buffer is read-only and the
     # quarantine path writes recovered cells back in place
     r = np.array(r, dtype=np.float64)[sl]
@@ -332,19 +785,17 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
     retries = np.zeros(n_orig, dtype=np.int64)
 
     # Host-side escalation: quarantine failed cells and walk the bounded
-    # retry ladder serially (never re-injecting a fault).  Runs after the
-    # timed batched solve — wall_seconds stays the batched-program wall.
+    # retry ladder serially (never re-injecting a fault, never reusing a
+    # warm bracket seed).  Runs after the timed batched solve —
+    # wall_seconds stays the batched-program wall.
     failed = is_failure(status)
     if quarantine and failed.any():
-        crra_h = np.asarray(crra, dtype=np.float64)[sl]
-        rho_h = np.asarray(rho, dtype=np.float64)[sl]
-        sd_h = np.asarray(sd, dtype=np.float64)[sl]
         ladder = _retry_ladder(model_kwargs)[:max(0, int(max_retries))]
         for i in np.nonzero(failed)[0]:
             for attempt, overrides in enumerate(ladder, start=1):
                 retries[i] = attempt
                 lean = solve_calibration_lean(
-                    crra_h[i], rho_h[i], labor_sd=sd_h[i], dtype=dtype,
+                    crra[i], rho[i], labor_sd=sd[i], dtype=dtype,
                     **{**model_kwargs, **overrides})
                 cell_status = int(lean.status)
                 if not is_failure(cell_status):
@@ -362,13 +813,26 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
         r[still] = np.nan
         K[still] = np.nan
         if len(still):
-            import warnings
             warnings.warn(
                 "table2 sweep: cells "
                 + ", ".join(f"{int(i)} ({status_name(status[i])})"
                             for i in still)
                 + " failed every quarantine retry; their values are "
                 "NaN-masked in the SweepResult", stacklevel=2)
+
+    if sweep.sidecar_path is not None:
+        # persist this run's counters/roots for the next run's scheduler
+        # (work model + warm brackets); best-effort — an unwritable path
+        # must not take down a finished solve
+        try:
+            save_sweep_sidecar(
+                sweep.sidecar_path, np.stack([crra, rho_label,
+                                              np.asarray(sd)], axis=1),
+                r, iters, egm_it, dist_it, status,
+                _work_fingerprint(kwargs_items, dtype))
+        except OSError as e:
+            warnings.warn(f"could not write sweep sidecar "
+                          f"{sweep.sidecar_path!r}: {e}", stacklevel=2)
 
     # Host-side closed forms (firm.py identities in numpy — numpy, not jnp,
     # so nothing touches the device after the solve): demand from the
@@ -380,11 +844,12 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
     output = prod * K ** alpha * L ** (1.0 - alpha)
     srate = delta * K / output
     return SweepResult(
-        crra=np.asarray(crra)[sl], labor_ar=rho_label[sl],
-        labor_sd=np.asarray(sd)[sl],
+        crra=crra[sl], labor_ar=rho_label[sl], labor_sd=np.asarray(sd)[sl],
         r_star_pct=r * 100.0, saving_rate_pct=srate * 100.0,
         capital=K, excess=K - demand,
         bisect_iters=iters, egm_iters=egm_it, dist_iters=dist_it,
         wall_seconds=wall,
         dist_method=str(model_kwargs["dist_method"]),
-        status=status, retries=retries)
+        egm_method=str(model_kwargs["egm_method"]),
+        status=status, retries=retries, bucket=bucket_of,
+        predicted_work=pred)

@@ -55,9 +55,18 @@ def enable_compilation_cache(path: Optional[str] = None) -> str:
     in compilation.  The cache key covers the HLO and the jaxlib/backend
     version, so code changes recompile automatically.  Default location:
     ``$AIYAGARI_CACHE_DIR`` or ``<repo>/.jax_cache`` (gitignored).
+
+    Every sweep launch enables this by default
+    (``SweepConfig.compilation_cache``); ``AIYAGARI_COMPILATION_CACHE=0``
+    (or ``off``/``false``) is the global kill switch — it returns ""
+    without touching jax config, for debugging cache-related wedges or
+    read-only filesystems.
     """
     import jax
 
+    if os.environ.get("AIYAGARI_COMPILATION_CACHE", "").lower() in (
+            "0", "off", "false"):
+        return ""
     if path is None:
         path = os.environ.get(
             "AIYAGARI_CACHE_DIR",

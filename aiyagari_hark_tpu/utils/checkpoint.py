@@ -102,6 +102,77 @@ def load_pytree(path: str, like, strict: bool = True):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+class SweepSidecar(NamedTuple):
+    """Warm-start sidecar for the Table II sweep scheduler: one prior run's
+    per-cell work counters and roots, keyed by the (σ, ρ, sd) triples and
+    fingerprinted against the solver configuration that produced them.
+
+    Two consumers (``parallel.sweep``): the WORK MODEL reads
+    ``total_work()`` to sort/bucket cells by measured — not guessed — cost,
+    and the WARM-BRACKET seeder reads ``r_star`` to descend each cell's
+    bisection bracket toward its known root before the batch launches.
+    Rows with a failure status carry NaN ``r_star`` (never seed from a
+    quarantined cell) but keep their counters (a failed cell's work is
+    still the best cost estimate available)."""
+
+    cells: np.ndarray         # [C, 3] (σ, ρ, sd), float64
+    r_star: np.ndarray        # [C] net rate at the certified root; NaN=failed
+    bisect_iters: np.ndarray  # [C] int64 excess evaluations
+    egm_iters: np.ndarray     # [C] int64 total EGM backward steps
+    dist_iters: np.ndarray    # [C] int64 total distribution steps
+    status: np.ndarray        # [C] int64 solver_health codes
+    fingerprint: np.ndarray   # scalar int64 — solver-config hash
+
+    def total_work(self) -> np.ndarray:
+        return self.egm_iters + self.dist_iters
+
+    def lookup(self, cell, decimals: int = 9):
+        """Row index of ``cell`` = (σ, ρ, sd) (rounded match), or None."""
+        key = np.round(np.asarray(cell, dtype=np.float64), decimals)
+        hits = np.nonzero(
+            (np.round(self.cells, decimals) == key[None, :]).all(axis=1))[0]
+        return int(hits[0]) if len(hits) else None
+
+
+def save_sweep_sidecar(path: str, cells, r_star, bisect_iters, egm_iters,
+                       dist_iters, status, fingerprint: int) -> None:
+    """Persist a sweep's per-cell record for the next run's scheduler
+    (atomic npz via ``save_pytree``)."""
+    save_pytree(path, SweepSidecar(
+        cells=np.asarray(cells, dtype=np.float64),
+        r_star=np.asarray(r_star, dtype=np.float64),
+        bisect_iters=np.asarray(bisect_iters, dtype=np.int64),
+        egm_iters=np.asarray(egm_iters, dtype=np.int64),
+        dist_iters=np.asarray(dist_iters, dtype=np.int64),
+        status=np.asarray(status, dtype=np.int64),
+        fingerprint=np.asarray(fingerprint, np.int64)))
+
+
+def load_sweep_sidecar(path: str, fingerprint: int) -> SweepSidecar:
+    """Load a scheduler sidecar, refusing one written under a different
+    solver configuration.
+
+    Raises ``CheckpointMismatchError`` on a fingerprint mismatch and lets
+    OSError/ValueError from a missing or corrupt file propagate — the
+    scheduler catches all three and degrades to its (σ, ρ, sd) heuristic:
+    a stale work model must never be silently trusted for warm brackets
+    (the bracket seeds would fail verification and waste two evaluations
+    per lane), and a missing sidecar is the normal first-run state."""
+    n = 1   # template leaf shapes come from the file; any row count loads
+    tmpl = SweepSidecar(
+        cells=np.zeros((n, 3)), r_star=np.zeros(n),
+        bisect_iters=np.zeros(n, np.int64), egm_iters=np.zeros(n, np.int64),
+        dist_iters=np.zeros(n, np.int64), status=np.zeros(n, np.int64),
+        fingerprint=np.zeros((), np.int64))
+    side = load_pytree(path, tmpl)
+    if int(side.fingerprint) != int(fingerprint):
+        raise CheckpointMismatchError(
+            f"sweep sidecar {path} was written under solver-config "
+            f"fingerprint {int(side.fingerprint)}, current is "
+            f"{int(fingerprint)}; refusing a stale work model")
+    return side
+
+
 class KSCheckpoint(NamedTuple):
     """Resumable state of the Krusell-Smith outer loop: the perceived rule,
     how many outer iterations produced it, the RNG seed that generated the

@@ -168,11 +168,58 @@ class SweepConfig:
 
     ``labor_sd`` may be a tuple to add the stationary-s.d. panel axis:
     ``labor_sd=(0.2, 0.4)`` runs BOTH of Aiyagari's Table II panels as
-    one batched program (24 cells)."""
+    one batched program (24 cells).
+
+    Scheduler knobs (ISSUE 2; mechanics in ``parallel.sweep`` and DESIGN
+    §4b):
+
+    * ``schedule`` — "locked": the whole batch as ONE vmapped launch
+      (every lane lock-steps until the slowest cell converges);
+      "balanced": cells sorted by predicted work into ``n_buckets``
+      work-homogeneous buckets solved as separate launches of one shared
+      executable, un-permuted before ``SweepResult`` (bit-order-identical
+      output); "auto" (default): "balanced" for >= 8 cells on
+      non-accelerator backends, else "locked" (bucketing a tiny batch
+      only adds dispatches, and through the tunneled TPU each launch
+      costs ~0.7 s round trip — accelerator callers opt in explicitly).
+    * ``n_buckets`` — bucket count for "balanced"; 0 = auto (~C/3,
+      capped at 8).
+    * ``warm_brackets`` — seed each cell's bisection bracket by dyadic
+      descent toward a known root (sidecar same-cell root, else the
+      nearest already-solved neighbor in (σ, ρ, sd)); every seed is
+      verified in-program before it is trusted.  Off by default: it
+      changes inner-loop trajectories (answers move at inner-solver
+      noise, certified tolerance untouched), so golden-pinned runs keep
+      the cold path unless they opt in.
+    * ``warm_margin`` — half-width (in r units) the descended bracket
+      must keep around the seed root; 0.0 = auto (tight for sidecar
+      same-cell seeds, conservative for neighbor seeds).
+    * ``work_model`` — "sidecar": require prior-run counters
+      (``sidecar_path``); "heuristic": the (σ, ρ, sd) regression;
+      "auto": sidecar when present and fingerprint-valid, else
+      heuristic.
+    * ``sidecar_path`` — npz path for prior-run counters/roots
+      (``utils.checkpoint.SweepSidecar``); written after every scheduled
+      solve, read before.  None disables persistence.
+    * ``compilation_cache`` — enable jax's persistent XLA compilation
+      cache (``utils.backend.enable_compilation_cache``; dir from
+      ``$AIYAGARI_CACHE_DIR``, kill switch ``$AIYAGARI_COMPILATION_CACHE=0``)
+      before compiling sweep programs, so repeated processes skip XLA
+      entirely."""
 
     crra_values: Tuple[float, ...] = (1.0, 3.0, 5.0)
     rho_values: Tuple[float, ...] = (0.0, 0.3, 0.6, 0.9)
     labor_sd: float | Tuple[float, ...] = 0.2
+    schedule: str = "auto"
+    n_buckets: int = 0
+    warm_brackets: bool = False
+    warm_margin: float = 0.0
+    work_model: str = "auto"
+    sidecar_path: str | None = None
+    compilation_cache: bool = True
+
+    def replace(self, **kwargs) -> "SweepConfig":
+        return dataclasses.replace(self, **kwargs)
 
     def sd_values(self) -> Tuple[float, ...]:
         # normalize sequences to tuples (same policy as the sweep's

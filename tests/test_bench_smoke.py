@@ -1,0 +1,121 @@
+"""Fast bench smoke (ISSUE 2 satellite): the compile-cache contract and
+the FLOP accounting the bench record is built from.
+
+The load-bearing test runs a tiny 4-cell sweep twice in-process and
+asserts the second launch performs ZERO XLA compiles (in-memory executable
+cache), then drops the in-memory caches and asserts a third launch is
+served entirely by the PERSISTENT compilation cache (zero cache misses) —
+the contract that stops the benchmark trajectory from charging recompiles
+to the solver."""
+
+import jax
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.parallel.sweep import _batched_solver, run_table2_sweep
+from aiyagari_hark_tpu.utils.backend import enable_compilation_cache
+from aiyagari_hark_tpu.utils.config import SweepConfig
+from aiyagari_hark_tpu.utils.timing import (
+    CompileCounter,
+    flop_report,
+    model_flops,
+    peak_flops_per_chip,
+)
+
+# 4 cells, tiny grids: the smoke must cost seconds, not minutes.
+SMOKE = SweepConfig(crra_values=(1.0, 3.0), rho_values=(0.3, 0.6))
+KW = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-4,
+          max_bisect=16)
+
+
+def test_second_sweep_launch_performs_zero_compiles():
+    cache_dir = enable_compilation_cache()
+    assert cache_dir, "compilation cache must be enabled for this test"
+    # cache programs regardless of their compile time — the smoke's tiny
+    # programs compile in well under the production 1 s threshold
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        with CompileCounter() as c1:
+            first = run_table2_sweep(SMOKE, **KW)
+        with CompileCounter() as c2:
+            second = run_table2_sweep(SMOKE, **KW)
+        # same process, same config: the jitted executable is reused —
+        # not one compile request, cached or otherwise
+        assert c2.compile_events == 0, c2.__dict__
+        assert c2.cache_misses == 0
+        assert np.array_equal(first.r_star_pct, second.r_star_pct)
+
+        # drop the in-memory caches: the PERSISTENT cache must now serve
+        # every compile request (zero XLA compiles, only cache hits)
+        jax.clear_caches()
+        _batched_solver.cache_clear()
+        with CompileCounter() as c3:
+            third = run_table2_sweep(SMOKE, **KW)
+        assert c3.cache_misses == 0, c3.__dict__
+        assert c3.cache_hits > 0
+        assert np.array_equal(first.r_star_pct, third.r_star_pct)
+    finally:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def test_batched_solver_dtype_alias_shares_cache_entry():
+    """dtype=None vs the explicit default dtype must resolve to the SAME
+    jitted closure — two entries meant two identical XLA compiles."""
+    import jax.numpy as jnp
+
+    from aiyagari_hark_tpu.parallel.sweep import _canonical_dtype
+
+    fn_none = _batched_solver(_canonical_dtype(None))
+    fn_expl = _batched_solver(_canonical_dtype(jnp.float64))
+    assert fn_none is fn_expl
+
+
+def test_model_flops_and_flop_report():
+    """The shared FLOP accounting (moved to utils.timing): the dense
+    distribution path dominates scatter by the D^2/D matvec ratio, the
+    report rounds rate + MFU, and degenerate walls yield nulls instead of
+    crashes — the fine-grid fields must never strand the record again."""
+    egm_only = model_flops(10, 0, 32, 7, 500, dense_dist=True)
+    assert egm_only == model_flops(10, 0, 32, 7, 500, dense_dist=False)
+    dense = model_flops(0, 10, 32, 7, 500, dense_dist=True)
+    scatter = model_flops(0, 10, 32, 7, 500, dense_dist=False)
+    assert dense > 50 * scatter
+    rep = flop_report(100, 1000, 2.0, 32, 7, 500, dense_dist=False,
+                      backend="cpu")
+    assert rep["flops_per_sec"] > 0 and rep["mfu_pct"] is None
+    assert flop_report(1, 1, None, 32, 7, 500, False, "cpu") == {
+        "flops_per_sec": None, "mfu_pct": None}
+    assert flop_report(1, 1, 0.0, 32, 7, 500, False, "cpu") == {
+        "flops_per_sec": None, "mfu_pct": None}
+    assert peak_flops_per_chip("cpu") is None
+
+
+def test_bench_emits_scheduler_and_compile_fields():
+    """The bench record contract this PR adds: post-scheduling skew and
+    cold/warm compile attribution must be wired into the record builder
+    (cheap source-level check — a full bench run is minutes)."""
+    import inspect
+
+    import bench
+
+    src = inspect.getsource(bench)
+    for fieldname in ("scheduled_iteration_skew", "compile_cold_s",
+                      "compile_warm_s", "warm_inner_step_reduction_pct",
+                      "fine_grid_cpu_flops_per_sec"):
+        assert fieldname in src, fieldname
+
+
+@pytest.mark.slow
+def test_warm_scheduled_metrics_end_to_end(tmp_path, monkeypatch):
+    """bench._warm_scheduled_metrics against a real (tiny) sweep."""
+    import bench
+
+    from aiyagari_hark_tpu.utils.timing import PhaseTimer
+
+    monkeypatch.setattr(bench, "_repo_dir", lambda: str(tmp_path))
+    # the bench hands the function its default-lattice headline result
+    base = run_table2_sweep(SweepConfig(), **KW)
+    out = bench._warm_scheduled_metrics(PhaseTimer(), dict(KW), base)
+    assert "warm_sweep_wall_s" in out
+    assert out.get("warm_sweep_error") is None, out
+    assert out["warm_vs_base_max_bp"] < 0.5

@@ -285,3 +285,63 @@ def test_pallas_nested_vmap_collapses_to_lane_grid():
         betas)
     assert dp.shape == dd.shape == (2, 2, 60, 5)
     np.testing.assert_allclose(np.asarray(dp), np.asarray(dd), atol=1e-12)
+
+
+def test_pallas_egm_single_lane_matches_xla(model, prices):
+    """The EGM policy fixed point as a Pallas kernel (ISSUE 2 tentpole):
+    interpret mode runs the IDENTICAL iteration code, so the unbatched
+    kernel must take the same iteration path (same step count, same
+    status) and land on the XLA while_loop's fixed point to float-fusion
+    noise (XLA may fuse the step's ops differently inside vs outside the
+    interpreted kernel — bit-equality is not part of the contract)."""
+    R, W = prices
+    px, itx, dx, sx = solve_household(R, W, model, DISC, CRRA, tol=1e-7)
+    pp, itp, dp, sp = solve_household(R, W, model, DISC, CRRA, tol=1e-7,
+                                      method="pallas")
+    np.testing.assert_allclose(np.asarray(px.m_knots),
+                               np.asarray(pp.m_knots), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(px.c_knots),
+                               np.asarray(pp.c_knots), rtol=1e-12)
+    assert int(itx) == int(itp) and int(sx) == int(sp) == CONVERGED
+    with pytest.raises(ValueError, match="method"):
+        solve_household(R, W, model, DISC, CRRA, method="bogus")
+
+
+def test_pallas_egm_grid_dispatch_under_vmap(model, prices):
+    """A vmapped 'pallas' EGM solve must reroute to the lane-GRID kernel
+    (custom_vmap), each lane exiting at its own convergence: per-lane
+    results equal the UNBATCHED solves exactly (the grid runs each lane's
+    program alone), and the lock-step vmap(xla) path to float tolerance
+    (batched matmul contraction rounds differently)."""
+    R, W = prices
+    crras = jnp.asarray([1.0, 2.0, 5.0])
+
+    def solve(crra, method):
+        pol, it, _, status = solve_household(R, W, model, DISC, crra,
+                                             tol=1e-7, method=method)
+        return pol.c_knots, it, status
+
+    c_g, it_g, s_g = jax.vmap(lambda c: solve(c, "pallas"))(crras)
+    c_x, it_x, s_x = jax.vmap(lambda c: solve(c, "xla"))(crras)
+    assert np.asarray(s_g).tolist() == np.asarray(s_x).tolist()
+    # per-lane exit: iteration counts are lane-local, not the batch max
+    assert np.array_equal(np.asarray(it_g), np.asarray(it_x))
+    np.testing.assert_allclose(np.asarray(c_g), np.asarray(c_x), atol=1e-10)
+    for i, crra in enumerate([1.0, 2.0, 5.0]):
+        c1, _, _ = solve(jnp.asarray(crra), "xla")
+        np.testing.assert_allclose(np.asarray(c1), np.asarray(c_g)[i],
+                                   rtol=1e-12)
+
+
+def test_pallas_egm_inside_lean_equilibrium(model):
+    """egm_method threads through the bisection equilibrium: the lean
+    solve with the kernel engine lands on the XLA engine's r* (identical
+    iteration code; trajectories match to solver noise)."""
+    from aiyagari_hark_tpu.models.equilibrium import solve_calibration_lean
+
+    kw = dict(labor_states=4, a_count=12, dist_count=48, r_tol=1e-5,
+              max_bisect=25)
+    lean_x = solve_calibration_lean(2.0, 0.3, egm_method="xla", **kw)
+    lean_p = solve_calibration_lean(2.0, 0.3, egm_method="pallas", **kw)
+    assert abs(float(lean_x.r_star) - float(lean_p.r_star)) < 1e-6
+    assert int(lean_p.status) == CONVERGED
