@@ -177,7 +177,7 @@ def calibrate_spread_to_lorenz(model: SimpleModel, center, crra,
                                spread_lo: float = 0.0,
                                spread_hi: float = 0.03,
                                spread_tol: float = 2e-4,
-                               scf_path=None,
+                               scf_path=None, retry=None,
                                **solver_kwargs) -> LorenzFit:
     """Fit the beta-dist spread to the REAL SCF wealth Lorenz curve —
     the cstwMPC estimation (Carroll et al. 2017) run against the curve
@@ -194,11 +194,24 @@ def calibrate_spread_to_lorenz(model: SimpleModel, center, crra,
     Host-side minimization (the objective is smooth but not monotone, so
     the jit-side ``_bisect`` root-finder does not apply); each evaluation
     is jitted work, and repeated shapes hit the jit cache.
+
+    Resilience (ISSUE 3): each evaluation is a calibration STEP boundary
+    — inside a ``preemption_guard()`` a shutdown request raises the typed
+    ``resilience.Interrupted`` between solves instead of dying inside
+    one, and every equilibrium solve runs under ``retry_transient`` with
+    the deterministic backoff of ``retry`` (default ``RetryPolicy()``).
     """
+    import jax
     import numpy as np
 
+    from ..utils.resilience import (
+        RetryPolicy,
+        raise_if_interrupted,
+        retry_transient,
+    )
     from ..utils.stats import lorenz_distance_vs_scf
 
+    retry_policy = retry if retry is not None else RetryPolicy()
     weights = jnp.ones((n_types,), dtype=model.a_grid.dtype)
     grid = np.asarray(model.dist_grid)
     n_eval = [0]
@@ -207,11 +220,15 @@ def calibrate_spread_to_lorenz(model: SimpleModel, center, crra,
         """(distance, r_star) at a trial spread — ONE definition of the
         objective, shared with the headline golden via
         ``lorenz_distance_vs_scf``."""
+        raise_if_interrupted("Lorenz-spread calibration",
+                             progress={"evaluations": n_eval[0]})
         n_eval[0] += 1
         betas = uniform_beta_types(center, float(spread), n_types)
-        eq = solve_heterogeneous_equilibrium(
-            model, betas, weights, crra, cap_share, depr_fac,
-            **solver_kwargs)
+        eq = retry_transient(
+            lambda: jax.block_until_ready(solve_heterogeneous_equilibrium(
+                model, betas, weights, crra, cap_share, depr_fac,
+                **solver_kwargs)),
+            retry_policy, label=f"calibration solve {n_eval[0]}")
         pop = np.asarray(population_distribution(eq).sum(axis=1))
         return (lorenz_distance_vs_scf(grid, pop, path=scf_path),
                 float(eq.r_star))

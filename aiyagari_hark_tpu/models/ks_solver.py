@@ -214,7 +214,8 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
                      dist_count: int = 500,
                      dist_fan: int | None = None,
                      dist_discard: int | None = None,
-                     dist_pin_slope: bool | None = None) -> KSSolution:
+                     dist_pin_slope: bool | None = None,
+                     retry=None) -> KSSolution:
     """Full reference-parity solve: the Krusell-Smith fixed point over the
     aggregate saving rule.
 
@@ -230,6 +231,19 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
     ``seed``, resume from it instead of the config's initial guesses.
     ``timer``: an optional ``utils.timing.PhaseTimer`` accumulating
     solve/simulate/regress phases.
+
+    Resilience (ISSUE 3, ``utils.resilience``): inside a
+    ``preemption_guard()`` a SIGTERM/SIGINT is honored at the next OUTER
+    iteration boundary — the just-written checkpoint (sidecar-first write
+    order, see below) is the flushed state and the typed
+    ``resilience.Interrupted`` is raised instead of dying mid-write; a
+    rerun with the same ``checkpoint_path`` continues the trajectory.
+    The heavy device calls (household solve, panel/distribution
+    simulation) run under ``retry_transient`` with the deterministic
+    backoff of ``retry`` (default ``RetryPolicy()``): transient
+    device/RPC faults are replayed — pure jitted launches, so a replay
+    computes the same bits — while ``SolverDivergenceError`` is never
+    retried (the solver-health layer owns numeric failure).
 
     ``sim_method``: "panel" (reference parity — ``agent_count`` Monte-Carlo
     agents) or "distribution" (deterministic: push a ``dist_count``-point
@@ -272,9 +286,20 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
         load_ks_checkpoint,
         save_ks_checkpoint,
     )
+    from ..utils.resilience import (
+        RetryPolicy,
+        raise_if_interrupted,
+        retry_transient,
+    )
     from ..utils.timing import PhaseTimer
     if timer is None:
         timer = PhaseTimer()
+    retry_policy = retry if retry is not None else RetryPolicy()
+
+    def _device(label, f):
+        """Transient-retry wrapper for the jitted launches (safe to
+        replay: pure programs of immutable inputs)."""
+        return retry_transient(f, retry_policy, label=label)
     cal = build_ks_calibration(agent, econ, ks_employment=ks_employment,
                                dtype=dtype)
     key = jax.random.PRNGKey(seed)
@@ -540,15 +565,19 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
     for it in range(it_start, econ.max_loops):
         t0 = time.time()
         with timer.phase("solve"):
-            policy, egm_iters, _, egm_status = jax.block_until_ready(
-                solve_hh(afunc, policy_seed))
+            policy, egm_iters, _, egm_status = _device(
+                f"KS household solve (iter {it})",
+                lambda: jax.block_until_ready(
+                    solve_hh(afunc, policy_seed)))
             policy_seed = policy
         k_it = jax.random.fold_in(k_panel, it) if resample_each_iteration \
             else k_panel
         with timer.phase("simulate"):
-            history, final_panel = jax.block_until_ready(
-                run_panel(policy, k_it, sim_init,
-                          jnp.exp(afunc.intercept[0])))
+            history, final_panel = _device(
+                f"KS panel simulation (iter {it})",
+                lambda: jax.block_until_ready(
+                    run_panel(policy, k_it, sim_init,
+                              jnp.exp(afunc.intercept[0]))))
             if carry_init:
                 sim_init = final_panel
         with timer.phase("regress"):
@@ -611,6 +640,13 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
                                last_residual=last_residual[0])
         if converged:
             break
+        # Outer-iteration boundary: the checkpoint (when configured) was
+        # just flushed, so a shutdown request exits HERE with resumable
+        # state instead of dying inside the next iteration's launches.
+        raise_if_interrupted("KS outer loop", checkpoint_path,
+                             progress={"iteration": it + 1,
+                                       "max_loops": econ.max_loops,
+                                       "distance": distance})
 
     history, final_panel = finalize(history, final_panel)
     # worst-of-run health code: the outer loop's own exit combined with

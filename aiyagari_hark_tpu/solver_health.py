@@ -51,8 +51,15 @@ CONVERGED = 0
 STALLED = 1
 MAX_ITER = 2
 NONFINITE = 3
+# Process-level (never emitted by a jitted loop): a run stopped at a safe
+# boundary on a shutdown request (``utils.resilience.Interrupted``).  The
+# result is uncertified, so it sits on the failure side of ``is_failure``;
+# "worse" than NONFINITE only in the trivial sense that no numbers were
+# produced at all.
+INTERRUPTED = 4
 
-STATUS_NAMES = ("CONVERGED", "STALLED", "MAX_ITER", "NONFINITE")
+STATUS_NAMES = ("CONVERGED", "STALLED", "MAX_ITER", "NONFINITE",
+                "INTERRUPTED")
 
 
 def status_name(code) -> str:

@@ -12,10 +12,17 @@ from __future__ import annotations
 
 import os
 import tempfile
+import zipfile
 from typing import NamedTuple
 
 import jax
 import numpy as np
+
+# What np.load/load_pytree raise on a missing, truncated, or trashed npz:
+# BadZipFile/EOFError are what a half-written or zeroed file produces —
+# neither is an OSError (learned the hard way once; encode it ONCE so
+# every best-effort loader degrades on the same set).
+CORRUPT_NPZ_ERRORS = (OSError, ValueError, EOFError, zipfile.BadZipFile)
 
 
 class CheckpointMismatchError(ValueError):
@@ -46,6 +53,88 @@ def save_pytree(path: str, tree) -> None:
         if os.path.exists(tmp):
             os.remove(tmp)
         raise
+
+
+def _atomic_write_text(path: str, text: str, suffix: str) -> None:
+    """tmp + ``os.replace`` in the target's directory — the same
+    crash-consistency discipline as ``save_pytree``: a kill at any point
+    leaves either the old file or the new one, never a truncated hybrid."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=suffix)
+    try:
+        with os.fdopen(fd, "w") as f:   # atomic-ok: the blessed writer
+            f.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """Crash-consistent replacement for ``open(path, "w").write(text)``
+    on artifact paths (sentinels, runtime summaries): see
+    ``atomic_write_json`` for why bare writes are banned
+    (``scripts/check_atomic_writes.py`` enforces it)."""
+    _atomic_write_text(path, text, suffix=".txt.tmp")
+
+
+def atomic_write_json(path: str, obj, indent: int = 2,
+                      sort_keys: bool = False,
+                      trailing_newline: bool = True) -> None:
+    """Crash-consistent JSON artifact write (tmp + ``os.replace``).
+
+    Entry points used to write records with bare ``open(path, "w")`` +
+    ``json.dump`` — a kill mid-write leaves a truncated record, and for
+    ``bench_tpu_last.json`` a poisoned evidence file that a later CPU
+    fallback would embed as "the committed TPU record".  All JSON/txt
+    artifacts go through here (or ``atomic_write_text``); the static lint
+    ``scripts/check_atomic_writes.py`` keeps bare writes from regressing
+    in."""
+    import json
+
+    text = json.dumps(obj, indent=indent, sort_keys=sort_keys)
+    _atomic_write_text(path, text + ("\n" if trailing_newline else ""),
+                       suffix=".json.tmp")
+
+
+def gc_orphaned_tmp(path: str, max_age_s: float = 3600.0) -> list:
+    """Remove stale atomic-writer temp files next to ``path``.
+
+    ``save_pytree``/``atomic_write_*`` clean their tmp on any in-process
+    failure, but a HARD kill (SIGKILL, OOM, power) between the write and
+    the rename strands a ``tmp*.npz.tmp``-style sibling forever.  Sweep
+    resume and ``preemption_guard`` teardown call this on their
+    checkpoint/ledger paths: age-gated (default 1 h — never race a
+    concurrent writer's in-flight tmp) and logged.  Returns the removed
+    paths."""
+    import glob
+    import time
+
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    removed = []
+    now = time.time()
+    # only THIS module's writers' signatures — a shared directory (/tmp!)
+    # holds other applications' mkstemp files, which are not ours to
+    # delete no matter how stale
+    ours = [os.path.join(d, f"tmp*{s}")
+            for s in (".npz.tmp", ".json.tmp", ".txt.tmp")]
+    for tmp in sorted(t for pat in ours for t in glob.glob(pat)):
+        try:
+            if now - os.path.getmtime(tmp) >= max_age_s:
+                os.remove(tmp)
+                removed.append(tmp)
+        except OSError:
+            continue
+    if removed:
+        import warnings
+        warnings.warn(
+            f"removed {len(removed)} orphaned checkpoint tmp file(s) "
+            f"next to {path}: " + ", ".join(os.path.basename(r)
+                                            for r in removed),
+            stacklevel=2)
+    return removed
 
 
 def _canonical_treedef(s: str) -> str:

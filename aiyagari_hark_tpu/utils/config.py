@@ -205,7 +205,14 @@ class SweepConfig:
       cache (``utils.backend.enable_compilation_cache``; dir from
       ``$AIYAGARI_CACHE_DIR``, kill switch ``$AIYAGARI_COMPILATION_CACHE=0``)
       before compiling sweep programs, so repeated processes skip XLA
-      entirely."""
+      entirely.
+    * ``resume_path`` — npz path for the durable resume ledger (ISSUE 3,
+      ``utils.resilience.SweepLedger``): solved buckets and quarantine
+      outcomes are flushed there atomically as the sweep progresses, and
+      a restarted identical run (fingerprint-checked) skips completed
+      work, reassembling a bit-identical ``SweepResult``.  Deleted on
+      successful completion.  None (default) disables persistence; the
+      ``run_table2_sweep(resume_path=)`` argument overrides."""
 
     crra_values: Tuple[float, ...] = (1.0, 3.0, 5.0)
     rho_values: Tuple[float, ...] = (0.0, 0.3, 0.6, 0.9)
@@ -217,6 +224,7 @@ class SweepConfig:
     work_model: str = "auto"
     sidecar_path: str | None = None
     compilation_cache: bool = True
+    resume_path: str | None = None
 
     def replace(self, **kwargs) -> "SweepConfig":
         return dataclasses.replace(self, **kwargs)

@@ -1,0 +1,505 @@
+"""Preemption-tolerant run layer: durable resume, graceful shutdown,
+transient-fault retry (ISSUE 3).
+
+PR 1 made *numerical* failure typed and recoverable (``solver_health``:
+status codes, quarantine, the retry ladder); this module does the same for
+*process and device* failure, which preemptible TPU slices make an expected
+operating condition rather than an accident: a multi-minute Table II sweep
+or KS fixed point must survive a SIGTERM, a transient XLA/RPC hiccup, and a
+kill mid-write (the preemption-tolerance story of high-dimensional DSGE
+solving, Scheidegger et al. arXiv:2202.06555).  Three pillars:
+
+* **Durable sweep resume** (``SweepLedger``/``LedgerState``): the sweep
+  persists a fingerprinted per-bucket ledger — every solved bucket's packed
+  ``SweepResult`` rows plus quarantine/retry state — atomically
+  (``utils.checkpoint.save_pytree``) after each bucket launch and each
+  quarantine rung.  A restarted ``run_table2_sweep(resume_path=...)`` skips
+  completed buckets and already-retried cells and replays only the rest;
+  the assembled ``SweepResult`` is **bit-identical** to an uninterrupted
+  run (same discipline as the scheduler's lock-step parity: the per-cell
+  computation never depends on *when* it ran).  The fingerprint covers
+  everything that shapes the bits — cells, solver kwargs, dtype, schedule,
+  fault injection, and the warm-start sidecar's content — so a stale
+  ledger degrades loudly to a fresh run, never to silent garbage.
+
+* **Graceful shutdown** (``preemption_guard``): a context manager that
+  installs SIGTERM/SIGINT handlers setting a flag
+  (``interrupt_requested``) which long loops poll at safe boundaries —
+  sweep bucket seams, KS outer iterations, calibration evaluations.  The
+  loop then flushes a valid checkpoint/ledger and raises the typed
+  ``Interrupted`` (status ``solver_health.INTERRUPTED``) instead of dying
+  mid-write.  A second signal escalates to ``KeyboardInterrupt`` so a
+  wedged run can still be killed.
+
+* **Transient-fault retry** (``retry_transient``): deterministic
+  exponential backoff around device/compile/RPC calls, gated by
+  ``classify_transient`` — UNAVAILABLE-style runtime errors are retried,
+  while ``SolverDivergenceError``/``NONFINITE`` is **never** retried here
+  (numeric divergence is the PR 1 quarantine ladder's job; retrying it
+  would mask real bugs and double-spend the budget on deterministic
+  failures).  ``TransientInjector`` (raise-at-call-k) makes every retry
+  path exercisable deterministically on CPU.
+
+Everything here is host-side and dependency-free (signal/os/numpy); the
+jitted programs never see it.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+import warnings
+from dataclasses import dataclass, field
+from typing import Callable, NamedTuple, Optional
+
+import numpy as np
+
+from ..solver_health import INTERRUPTED, SolverDivergenceError
+from .checkpoint import (
+    CORRUPT_NPZ_ERRORS,
+    gc_orphaned_tmp,
+    load_pytree,
+    save_pytree,
+)
+
+
+class Interrupted(BaseException):
+    """A long-running solve stopped at a safe boundary on a shutdown
+    request (SIGTERM/SIGINT or ``request_interrupt``), after flushing its
+    checkpoint/ledger.  Typed so drivers can distinguish "preempted,
+    resume me" (exit code ``EX_TEMPFAIL``-style) from a real failure.
+
+    Derives from ``BaseException`` — the same reasoning that puts
+    ``KeyboardInterrupt``/``SystemExit`` there: a shutdown request must
+    sail through the entry points' broad ``except Exception`` fault
+    handlers (the bench's attempt/fallback ladder, phase guards) instead
+    of being "recovered" into a CPU retry while the scheduler is pulling
+    the node.
+
+    Fields:
+
+    * ``status`` — ``solver_health.INTERRUPTED`` (an uncertified exit;
+      ``is_failure`` is True for it).
+    * ``resume_path`` — where the flushed state lives (ledger or KS
+      checkpoint); ``None`` when the caller ran without persistence.
+    * ``signum`` — the signal that requested the shutdown, if any.
+    * ``progress`` — a small dict of where the run stopped (e.g.
+      ``{"completed_buckets": 2, "n_buckets": 4}``).
+    """
+
+    def __init__(self, message: str, resume_path: Optional[str] = None,
+                 signum: Optional[int] = None, progress: Optional[dict] = None):
+        super().__init__(message)
+        self.status = INTERRUPTED
+        self.resume_path = resume_path
+        self.signum = signum
+        self.progress = dict(progress) if progress else {}
+
+
+# ---------------------------------------------------------------------------
+# Graceful shutdown: the preemption flag and its signal plumbing.
+# ---------------------------------------------------------------------------
+
+# Module-level so loops can poll without threading a token through every
+# call signature.  Set by the guard's signal handler or request_interrupt;
+# cleared when the outermost guard exits (or via clear_interrupt).
+_INTERRUPT = {"flag": False, "signum": None}
+_GUARD_DEPTH = 0
+
+
+def interrupt_requested() -> bool:
+    """True once a shutdown has been requested; long loops poll this at
+    safe boundaries (bucket seams, outer iterations) and exit via
+    ``Interrupted`` after flushing state."""
+    return _INTERRUPT["flag"]
+
+
+def request_interrupt(signum: Optional[int] = None) -> None:
+    """Set the shutdown flag programmatically — the deterministic test
+    injection for the polling paths (the production setter is the signal
+    handler ``preemption_guard`` installs)."""
+    _INTERRUPT["flag"] = True
+    _INTERRUPT["signum"] = signum
+
+
+def clear_interrupt() -> None:
+    """Reset the shutdown flag (tests; also the outermost guard's exit)."""
+    _INTERRUPT["flag"] = False
+    _INTERRUPT["signum"] = None
+
+
+def raise_if_interrupted(what: str, resume_path: Optional[str] = None,
+                         progress: Optional[dict] = None) -> None:
+    """The poll used at loop boundaries: raise the typed ``Interrupted``
+    when a shutdown was requested.  Callers flush their checkpoint/ledger
+    BEFORE polling, so the exception always leaves valid state behind."""
+    if _INTERRUPT["flag"]:
+        sig = _INTERRUPT["signum"]
+        name = ("" if sig is None
+                else f" ({signal.Signals(sig).name})")
+        raise Interrupted(
+            f"{what} interrupted at a safe boundary{name}"
+            + (f"; resume from {resume_path}" if resume_path else ""),
+            resume_path=resume_path, signum=sig, progress=progress)
+
+
+class preemption_guard:
+    """Context manager installing SIGTERM/SIGINT handlers that request a
+    graceful shutdown instead of killing the process mid-write.
+
+    The first signal sets the flag (``interrupt_requested``) — polled at
+    loop boundaries, which flush and raise ``Interrupted``.  A second
+    signal raises ``KeyboardInterrupt`` immediately: graceful shutdown
+    must never make a wedged run unkillable.  Handlers are restored on
+    exit; when the outermost guard exits the flag is cleared, so one
+    preempted run cannot poison the next solve in the same process.
+
+    ``gc_paths``: checkpoint/ledger paths whose directories are swept for
+    orphaned ``tmp*.npz.tmp``-style atomic-writer temp files on teardown
+    (``checkpoint.gc_orphaned_tmp`` — a hard kill between a writer's
+    write and rename strands one).
+
+    Guards nest (the inner install is a no-op); outside the main thread
+    — where CPython forbids ``signal.signal`` — the guard degrades to
+    flag-only mode (``request_interrupt`` still works)."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT),
+                 gc_paths=(), max_tmp_age_s: float = 3600.0):
+        self._signals = tuple(signals)
+        self._gc_paths = tuple(gc_paths)
+        self._max_tmp_age_s = max_tmp_age_s
+        self._previous: dict = {}
+
+    def _handler(self, signum, frame):
+        if _INTERRUPT["flag"]:
+            # second request: the polite exit is not happening — escalate
+            raise KeyboardInterrupt(
+                f"second {signal.Signals(signum).name} during graceful "
+                f"shutdown")
+        request_interrupt(signum)
+
+    def __enter__(self):
+        global _GUARD_DEPTH
+        for s in self._signals:
+            try:
+                self._previous[s] = signal.signal(s, self._handler)
+            except ValueError:
+                # not the main thread: flag-only mode
+                break
+        _GUARD_DEPTH += 1
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        global _GUARD_DEPTH
+        for s, prev in self._previous.items():
+            try:
+                signal.signal(s, prev)
+            except ValueError:
+                pass
+        self._previous.clear()
+        _GUARD_DEPTH = max(0, _GUARD_DEPTH - 1)
+        if _GUARD_DEPTH == 0:
+            clear_interrupt()
+        for p in self._gc_paths:
+            gc_orphaned_tmp(p, max_age_s=self._max_tmp_age_s)
+        return False
+
+
+def fire_preemption(mode: str = "signal") -> None:
+    """Deterministic preemption injection for tests and drills:
+    ``"signal"`` delivers a real SIGTERM to this process (requires an
+    active ``preemption_guard``, exactly like production), ``"flag"``
+    sets the flag directly (no guard needed)."""
+    if mode == "signal":
+        os.kill(os.getpid(), signal.SIGTERM)
+        # CPython runs the handler at the next bytecode boundary in the
+        # main thread; a no-op call guarantees we cross one before the
+        # caller's poll.
+        time.sleep(0)
+    elif mode == "flag":
+        request_interrupt()
+    else:
+        raise ValueError(f"fire_preemption mode must be 'signal' or "
+                         f"'flag', got {mode!r}")
+
+
+# ---------------------------------------------------------------------------
+# Transient-fault retry with deterministic backoff.
+# ---------------------------------------------------------------------------
+
+# gRPC-style status codes that mark a runtime error transient — matched
+# CASE-SENSITIVELY (the RPC stack shouts them; deterministic Python
+# messages that merely contain words like "aborted" must not match).
+TRANSIENT_CODE_PATTERNS = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "RESOURCE_EXHAUSTED",
+    "ABORTED",
+    "CANCELLED",
+)
+# Lowercase failure signatures the tunneled-TPU rounds actually logged.
+# Deliberately a short, auditable list — an unknown error is NOT retried.
+TRANSIENT_MESSAGE_PATTERNS = (
+    "socket closed",
+    "connection reset",
+    "failed to connect",
+    "broken pipe",
+    "rst_stream",
+    "preempted",
+    "transient",
+)
+# RESOURCE_EXHAUSTED carve-out: on a single-tenant accelerator the common
+# RESOURCE_EXHAUSTED is device OOM ("Attempting to allocate ...") — a
+# DETERMINISTIC property of the program, not a hiccup; replaying it just
+# re-pays the launch max_attempts times and buries the real diagnosis.
+_DETERMINISTIC_EXHAUSTION = ("allocat", "out of memory", "oom", "hbm")
+
+
+class InjectedTransientError(RuntimeError):
+    """The deterministic stand-in for a device/RPC fault
+    (``TransientInjector``); its message matches the transient classifier
+    by construction."""
+
+
+def classify_transient(exc: BaseException) -> bool:
+    """True when ``exc`` is worth retrying: a transient device, RPC, or
+    compile-service failure.
+
+    The hard rule: ``SolverDivergenceError`` (and thus ``NONFINITE``) is
+    NEVER transient — numeric divergence is deterministic, owned by the
+    solver-health quarantine ladder, and retrying it at this layer would
+    mask real bugs.  ``Interrupted`` is a requested shutdown, not a fault.
+    Everything else is matched conservatively by type
+    (``ConnectionError``), by SHOUTED gRPC status code
+    (``TRANSIENT_CODE_PATTERNS``, case-sensitive so prose containing
+    "aborted" cannot match), or by logged failure signature
+    (``TRANSIENT_MESSAGE_PATTERNS``) — except a RESOURCE_EXHAUSTED that
+    reads as device OOM, which is deterministic and not retried."""
+    if isinstance(exc, (SolverDivergenceError, Interrupted)):
+        return False
+    if not isinstance(exc, Exception):        # KeyboardInterrupt/SystemExit
+        return False
+    if isinstance(exc, (InjectedTransientError, ConnectionError)):
+        return True
+    if isinstance(exc, (ValueError, TypeError, KeyError, AttributeError)):
+        return False                          # programming errors: never
+    raw = str(exc)
+    msg = raw.lower()
+    if "RESOURCE_EXHAUSTED" in raw and any(
+            p in msg for p in _DETERMINISTIC_EXHAUSTION):
+        return False                          # device OOM: deterministic
+    return (any(p in raw for p in TRANSIENT_CODE_PATTERNS)
+            or any(p in msg for p in TRANSIENT_MESSAGE_PATTERNS))
+
+
+@dataclass
+class RetryPolicy:
+    """Deterministic exponential-backoff schedule: attempt ``i`` (0-based)
+    that fails transiently sleeps ``min(base_delay * multiplier**i,
+    max_delay)`` before attempt ``i+1``; at most ``max_attempts`` total
+    attempts.  No jitter — reproducibility beats thundering-herd
+    avoidance for a single-tenant solver, and tests can assert the exact
+    schedule.  ``sleep`` is injectable so tests capture delays instead of
+    paying them."""
+
+    max_attempts: int = 3
+    base_delay: float = 0.5
+    multiplier: float = 2.0
+    max_delay: float = 30.0
+    sleep: Callable[[float], None] = field(default=time.sleep, repr=False)
+
+    def delay(self, attempt: int) -> float:
+        return float(min(self.base_delay * self.multiplier ** attempt,
+                         self.max_delay))
+
+
+class TransientInjector:
+    """Raise-at-call-k fault injection for the retry layer: the k-th
+    guarded call (0-based, counted across every ``retry_transient``
+    invocation sharing this injector, retries included) raises
+    ``InjectedTransientError``, ``times`` times in a row.
+
+    ``times=1`` exercises the retry-then-succeed path;
+    ``times >= max_attempts`` exhausts the policy so the fault escapes —
+    the resume path's test harness.  Purely a test/diagnostic hook, like
+    ``solver_health.inject_fault`` for the numeric layer."""
+
+    def __init__(self, at_call: int = 0, times: int = 1,
+                 message: str = "UNAVAILABLE: injected transient fault"):
+        self.at_call = int(at_call)
+        self.remaining = int(times)
+        self.message = message
+        self.calls = 0
+
+    @classmethod
+    def from_spec(cls, spec) -> "TransientInjector":
+        """Build from the entry points' dict form
+        (``inject_transient={"at_call": k, "times": n}``); an existing
+        injector passes through (so one counter spans warm-up + timed
+        runs when a caller wants that)."""
+        if isinstance(spec, cls):
+            return spec
+        return cls(**dict(spec))
+
+    def before_call(self) -> None:
+        k = self.calls
+        self.calls += 1
+        if self.remaining > 0 and k >= self.at_call:
+            self.remaining -= 1
+            raise InjectedTransientError(f"{self.message} (call {k})")
+
+
+def retry_transient(fn: Callable[[], object],
+                    policy: Optional[RetryPolicy] = None,
+                    classify: Callable[[BaseException], bool] = None,
+                    inject: Optional[TransientInjector] = None,
+                    label: str = "device call"):
+    """Call ``fn()`` with transient-fault retry under ``policy``.
+
+    A failure classified transient (``classify_transient`` by default) is
+    retried after the policy's deterministic backoff, with a warning per
+    retry; a non-transient failure — including ``SolverDivergenceError``,
+    per the never-retry-NONFINITE rule — re-raises immediately.  The last
+    transient failure re-raises once ``max_attempts`` is exhausted.
+
+    Retrying is safe exactly because the guarded calls are pure device
+    launches (jitted XLA programs of immutable inputs): a replay computes
+    the same bits, so retry composes with the sweep's bit-identity
+    contract."""
+    policy = policy or RetryPolicy()
+    classify = classify or classify_transient
+    attempts = max(1, int(policy.max_attempts))
+    for attempt in range(attempts):
+        try:
+            if inject is not None:
+                inject.before_call()
+            return fn()
+        except BaseException as e:   # noqa: BLE001 — classifier decides
+            if not classify(e) or attempt == attempts - 1:
+                raise
+            d = policy.delay(attempt)
+            warnings.warn(
+                f"transient fault in {label} (attempt {attempt + 1}/"
+                f"{attempts}): {type(e).__name__}: {str(e)[:200]} — "
+                f"retrying in {d:g}s", stacklevel=2)
+            policy.sleep(d)
+    raise AssertionError("unreachable")       # loop always returns/raises
+
+
+# ---------------------------------------------------------------------------
+# Durable sweep resume: the per-bucket ledger.
+# ---------------------------------------------------------------------------
+
+class SweepLedger(NamedTuple):
+    """On-disk form of a sweep-in-progress (one atomic npz via
+    ``save_pytree``): per-cell packed solver outputs in ORIGINAL cell
+    order plus the solved/retried bookkeeping the resume needs.
+
+    ``packed`` rows are the batched solver's exact device outputs
+    ``[r, K, L, bisect, egm, dist, status]`` (float64 round-trips npz
+    bit-exactly), so a resumed assembly is bit-identical to an
+    uninterrupted one.  ``fingerprint`` covers everything that shapes
+    those bits — cells (perturb included), solver kwargs, dtype, schedule
+    knobs, fault injection, and the warm-start sidecar's content — a
+    mismatch degrades loudly to a fresh run."""
+
+    packed: np.ndarray       # [C, 7] float64; NaN rows = not yet solved
+    solved: np.ndarray       # [C] bool — batched result present
+    bucket: np.ndarray       # [C] int64 launch group (-1 = unassigned)
+    pred: np.ndarray         # [C] float64 scheduler work model
+    retries: np.ndarray      # [C] int64 quarantine rungs consumed
+    retried: np.ndarray      # [C] bool — quarantine outcome is final
+    fingerprint: np.ndarray  # scalar int64
+
+
+def _ledger_template(n: int) -> SweepLedger:
+    return SweepLedger(
+        packed=np.full((n, 7), np.nan),
+        solved=np.zeros(n, dtype=bool),
+        bucket=np.full(n, -1, dtype=np.int64),
+        pred=np.full(n, np.nan),
+        retries=np.zeros(n, dtype=np.int64),
+        retried=np.zeros(n, dtype=bool),
+        fingerprint=np.zeros((), np.int64))
+
+
+class LedgerState:
+    """Host-side mutable wrapper around ``SweepLedger``: the sweep records
+    progress here and ``flush()``es after every bucket launch and every
+    quarantine rung — each flush one atomic replace, so a kill at ANY
+    point leaves either the previous or the new valid ledger, never a
+    torn one.  ``complete()`` removes the file: a finished run must not
+    satisfy the next run's launches silently."""
+
+    def __init__(self, path: str, fingerprint: int, n_cells: int):
+        self.path = path
+        self.fingerprint = int(fingerprint)
+        t = _ledger_template(n_cells)
+        self.packed = t.packed
+        self.solved = t.solved
+        self.bucket = t.bucket
+        self.pred = t.pred
+        self.retries = t.retries
+        self.retried = t.retried
+        self.resumed = False      # a prior run's progress was restored
+
+    @classmethod
+    def resume(cls, path: str, fingerprint: int,
+               n_cells: int) -> "LedgerState":
+        """Fresh state, or the prior run's — when ``path`` holds a ledger
+        for the SAME run (fingerprint match).  A missing file is the
+        normal first-run state; a corrupt/mismatched one warns and starts
+        fresh (it will be overwritten at the first flush) — resume must
+        degrade to recompute, never to wrong bits."""
+        self = cls(path, fingerprint, n_cells)
+        gc_orphaned_tmp(path)     # a prior hard kill may have stranded tmps
+        if not os.path.exists(path):
+            return self
+        try:
+            led = load_pytree(path, _ledger_template(n_cells))
+        except CORRUPT_NPZ_ERRORS as e:
+            warnings.warn(f"sweep resume ledger {path} unreadable ({e}); "
+                          f"starting fresh", stacklevel=2)
+            return self
+        if int(led.fingerprint) != int(fingerprint):
+            warnings.warn(
+                f"sweep resume ledger {path} was written by a different "
+                f"run (fingerprint {int(led.fingerprint)} vs "
+                f"{int(fingerprint)}); starting fresh", stacklevel=2)
+            return self
+        self.packed = np.array(led.packed)
+        self.solved = np.array(led.solved)
+        self.bucket = np.array(led.bucket)
+        self.pred = np.array(led.pred)
+        self.retries = np.array(led.retries)
+        self.retried = np.array(led.retried)
+        self.resumed = bool(self.solved.any() or self.retried.any())
+        return self
+
+    def record_bucket(self, cells: np.ndarray, rows: np.ndarray,
+                      bucket_id: int) -> None:
+        """A bucket launch finished: store its cells' packed rows."""
+        self.packed[cells] = rows
+        self.solved[cells] = True
+        self.bucket[cells] = bucket_id
+
+    def record_retry(self, cell: int, row: np.ndarray,
+                     attempts: int) -> None:
+        """A quarantined cell's ladder walk finished (recovered or
+        exhausted): its outcome is final for this run."""
+        self.packed[cell] = row
+        self.retries[cell] = attempts
+        self.retried[cell] = True
+
+    def flush(self) -> None:
+        save_pytree(self.path, SweepLedger(
+            packed=self.packed, solved=self.solved, bucket=self.bucket,
+            pred=self.pred, retries=self.retries, retried=self.retried,
+            fingerprint=np.asarray(self.fingerprint, np.int64)))
+
+    def complete(self) -> None:
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass

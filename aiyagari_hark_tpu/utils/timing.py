@@ -53,12 +53,17 @@ class PhaseTimer:
 def write_records_jsonl(path: str, records: Iterable) -> None:
     """Persist iteration records (e.g. ``KSIterationRecord`` dataclasses or
     dicts) as JSON lines — the structured replacement for the reference's
-    ``verbose`` prints (``Aiyagari_Support.py:1954-1962``)."""
-    with open(path, "w") as f:
-        for rec in records:
-            if dataclasses.is_dataclass(rec) and not isinstance(rec, type):
-                rec = dataclasses.asdict(rec)
-            f.write(json.dumps(rec) + "\n")
+    ``verbose`` prints (``Aiyagari_Support.py:1954-1962``).  Written
+    crash-consistently (tmp + rename, ``checkpoint.atomic_write_text``):
+    a kill mid-write must not leave a half-record line."""
+    from .checkpoint import atomic_write_text
+
+    lines = []
+    for rec in records:
+        if dataclasses.is_dataclass(rec) and not isinstance(rec, type):
+            rec = dataclasses.asdict(rec)
+        lines.append(json.dumps(rec) + "\n")
+    atomic_write_text(path, "".join(lines))
 
 
 def read_records_jsonl(path: str):

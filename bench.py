@@ -169,11 +169,14 @@ def _persist_tpu_evidence(record: dict) -> None:
     — in this run or a future capture — can then only cost freshness,
     never the record.  Best-effort: a read-only checkout or dirty index
     must not take down the bench."""
+    from aiyagari_hark_tpu.utils.checkpoint import atomic_write_json
+
     path = os.path.join(_repo_dir(), "bench_tpu_last.json")
     try:
-        with open(path, "w") as f:
-            json.dump(record, f, indent=1, sort_keys=True)
-            f.write("\n")
+        # atomic (tmp + rename, ISSUE 3 satellite): a kill mid-write must
+        # not leave a truncated evidence file for a later CPU fallback to
+        # embed as "the committed TPU record"
+        atomic_write_json(path, record, indent=1, sort_keys=True)
         print(f"[bench] persisted TPU evidence -> {path}", file=sys.stderr)
     except OSError as e:
         print(f"[bench] could not write {path}: {e}", file=sys.stderr)
@@ -337,12 +340,15 @@ class _HazardSentinel:
         return os.path.exists(self.path())
 
     def write(self) -> None:
+        from aiyagari_hark_tpu.utils.checkpoint import atomic_write_text
+
         try:
-            with open(self.path(), "w") as f:
-                f.write(f"{self.what} in flight; presence at bench start "
-                        f"skips/demotes the phase.\nRetry with "
-                        f"{self.force_env}=1 (clears this file on success) "
-                        "or delete this file.\n")
+            atomic_write_text(
+                self.path(),
+                f"{self.what} in flight; presence at bench start "
+                f"skips/demotes the phase.\nRetry with "
+                f"{self.force_env}=1 (clears this file on success) "
+                "or delete this file.\n")
         except OSError as e:
             print(f"[bench] could not write {self.filename}: {e}",
                   file=sys.stderr)
@@ -536,8 +542,14 @@ def _warm_scheduled_metrics(timer, sweep_kwargs: dict, base_res) -> dict:
             res = run_table2_sweep(cfg, perturb=PERTURB, **sweep_kwargs)
         base_steps = float(base_res.total_work().sum())
         warm_steps = float(res.total_work().sum())
-        max_bp = max(abs(float(a) - float(b)) for a, b in
-                     zip(res.r_star_pct, base_res.r_star_pct)) * 100.0
+        # NaN-safe: a quarantine-exhausted cell is NaN-masked in BOTH runs
+        # (the SweepResult contract) — compare the finite cells and record
+        # null (valid JSON, unlike NaN) if nothing is comparable
+        import numpy as _np
+        diffs = _np.abs(_np.asarray(res.r_star_pct)
+                        - _np.asarray(base_res.r_star_pct)) * 100.0
+        finite = diffs[_np.isfinite(diffs)]
+        max_bp = float(finite.max()) if finite.size else None
         out.update({
             "warm_sweep_wall_s": round(res.wall_seconds, 4),
             "warm_sweep_inner_steps": int(warm_steps),
@@ -545,7 +557,8 @@ def _warm_scheduled_metrics(timer, sweep_kwargs: dict, base_res) -> dict:
                 100.0 * (1.0 - warm_steps / max(base_steps, 1.0)), 1),
             "warm_scheduled_iteration_skew": round(
                 res.scheduled_iteration_skew(), 3),
-            "warm_vs_base_max_bp": round(max_bp, 4),
+            "warm_vs_base_max_bp": (None if max_bp is None
+                                    else round(max_bp, 4)),
         })
         print(f"[bench] warm scheduled sweep: wall={res.wall_seconds:.3f}s "
               f"inner steps {int(base_steps)} -> {int(warm_steps)} "
@@ -871,7 +884,37 @@ def _pallas_dense_ab(timer, sweep_kwargs: dict, pallas_r_star) -> dict:
             "dense_sweep_wall_s": round(res.wall_seconds, 4)}
 
 
-def main():
+def main(argv=None):
+    """CLI wrapper: the preemption-tolerant run layer (ISSUE 3) around the
+    measurement body.  ``--resume PATH`` gives the headline sweep a
+    durable ledger — a preempted bench restarted with the same flag skips
+    the solved buckets; SIGTERM/SIGINT are honored at safe boundaries
+    (bucket seams) with exit code 75 (EX_TEMPFAIL: retry me), the
+    convention preemptible-slice supervisors restart on."""
+    import argparse
+
+    from aiyagari_hark_tpu.utils.resilience import (
+        Interrupted,
+        preemption_guard,
+    )
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--resume", default=None, metavar="PATH",
+                    help="durable resume ledger for the headline sweep "
+                         "(utils.resilience): a preempted run restarted "
+                         "with the same path skips completed buckets, "
+                         "bit-identically")
+    args = ap.parse_args(argv)
+    gc_paths = () if args.resume is None else (args.resume,)
+    try:
+        with preemption_guard(gc_paths=gc_paths):
+            _run_bench(resume_path=args.resume)
+    except Interrupted as e:
+        print(f"[bench] preempted at a safe boundary: {e}", file=sys.stderr)
+        sys.exit(75)
+
+
+def _run_bench(resume_path=None):
     from aiyagari_hark_tpu.utils.backend import enable_compilation_cache
     from aiyagari_hark_tpu.utils.timing import PhaseTimer, device_trace
 
@@ -931,9 +974,16 @@ def main():
             timer.counts.pop("compile", None)
             cold_counter = CompileCounter()
             with cold_counter, timer.phase("compile"):
+                # no resume ledger here: the warm-up is a throwaway
+                # compile pass, and its perturb=0 inputs fingerprint
+                # differently from the timed sweep's — sharing one path
+                # would clobber (then delete) the measured sweep's saved
+                # buckets on a restart, and resuming the warm-up itself
+                # would skip the launches that exist to compile/warm
                 run_table2_sweep(sweep, **kwargs)   # compile + warm-up
             with timer.phase("sweep"), device_trace(trace_dir):
-                res = run_table2_sweep(sweep, perturb=PERTURB, **kwargs)
+                res = run_table2_sweep(sweep, perturb=PERTURB,
+                                       resume_path=resume_path, **kwargs)
             used_kwargs = kwargs
             break
         except Exception as e:   # noqa: BLE001 — device faults surface as

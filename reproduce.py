@@ -29,7 +29,6 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 import time
@@ -254,6 +253,15 @@ def main(argv=None):
                          "because the committed results.json carries the "
                          "side-by-side fields; use this flag for iteration "
                          "runs that don't regenerate the artifact")
+    ap.add_argument("--resume", default=None, metavar="PATH",
+                    help="KS checkpoint path (utils.checkpoint) for the "
+                         "main solve: written every outer iteration, "
+                         "resumed from when the file exists — a "
+                         "preempted run restarted with the same path "
+                         "continues its trajectory instead of starting "
+                         "over (utils.resilience; SIGTERM/SIGINT exit "
+                         "gracefully at the next iteration boundary with "
+                         "code 75)")
     ap.add_argument("--extras", action="store_true",
                     help="also run the beyond-parity reporting (GE impulse "
                          "response figure, the histogram engine's "
@@ -270,6 +278,22 @@ def main(argv=None):
     if args.scf_csv and not os.path.exists(args.scf_csv):
         ap.error(f"--scf-csv {args.scf_csv!r} does not exist")
 
+    from aiyagari_hark_tpu.utils.resilience import (
+        Interrupted,
+        preemption_guard,
+    )
+    try:
+        with preemption_guard(
+                gc_paths=(args.resume,) if args.resume else ()):
+            return _run_pipeline(args)
+    except Interrupted as e:
+        print(f"[reproduce] preempted at a safe boundary: {e}"
+              + (f"; rerun with --resume {e.resume_path} to continue"
+                 if e.resume_path else ""), file=sys.stderr)
+        sys.exit(75)           # EX_TEMPFAIL: supervisors restart on this
+
+
+def _run_pipeline(args):
     start_time = time.time()
 
     from aiyagari_hark_tpu.utils.backend import (enable_compilation_cache,
@@ -318,7 +342,8 @@ def main(argv=None):
           f"Aiyagari (1994) model...")
     t0 = time.time()
     with timer.phase("solve"):
-        sol = economy.solve(dtype=info.dtype, sim_method=args.sim_method)
+        sol = economy.solve(dtype=info.dtype, sim_method=args.sim_method,
+                            checkpoint_path=args.resume)
     solve_minutes = (time.time() - t0) / 60.0
     print(f"Solving the Aiyagari model took {solve_minutes:.3f} minutes "
           f"(reference: 27.12 minutes). converged={sol.converged}")
@@ -455,13 +480,20 @@ def main(argv=None):
             solved=hist_solved)
 
     # -- runtime + structured results (cell 30 / runtime.txt:1-2)
+    from aiyagari_hark_tpu.utils.checkpoint import (
+        atomic_write_json,
+        atomic_write_text,
+    )
     os.makedirs(args.output_dir, exist_ok=True)
     total_time = time.time() - start_time
-    with open(os.path.join(args.output_dir, "runtime.txt"), "w") as f:
-        f.write(f"Total runtime: {total_time} seconds\n")
-        f.write(f"Python version: {sys.version}\n")
-        f.write(f"Backend: {info.name} ({'f64' if info.x64 else 'f32'})\n")
-        f.write(f"Phase breakdown:\n{timer.summary()}\n")
+    # atomic artifact writes (ISSUE 3 satellite): a kill mid-write must
+    # leave the previous runtime.txt/results.json, never a truncated one
+    atomic_write_text(
+        os.path.join(args.output_dir, "runtime.txt"),
+        f"Total runtime: {total_time} seconds\n"
+        f"Python version: {sys.version}\n"
+        f"Backend: {info.name} ({'f64' if info.x64 else 'f32'})\n"
+        f"Phase breakdown:\n{timer.summary()}\n")
     results = {
         "backend": info.name,
         "x64": info.x64,
@@ -490,8 +522,8 @@ def main(argv=None):
                               "lorenz_vs_scf": 0.9714,
                               "solve_minutes": 27.12},
     }
-    with open(os.path.join(args.output_dir, "results.json"), "w") as f:
-        json.dump(results, f, indent=2)
+    atomic_write_json(os.path.join(args.output_dir, "results.json"),
+                      results, indent=2, trailing_newline=False)
     print(f"Total runtime: {total_time:.2f} seconds "
           f"(phase breakdown in runtime.txt)")
     return results

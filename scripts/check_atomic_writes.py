@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Static lint: artifact writes must be crash-consistent (ISSUE 3).
+
+A bare ``open(path, "w")`` + ``json.dump``/``write`` truncates the target
+before writing, so a kill mid-write leaves a corrupt artifact — the failure
+mode that can poison ``bench_tpu_last.json`` (a later CPU fallback embeds it
+as evidence) or strand a half-written ``results.json``.  The blessed
+writers — ``utils.checkpoint.save_pytree`` / ``atomic_write_json`` /
+``atomic_write_text`` — all go tmp + ``os.replace``.
+
+This lint greps the package and the entry points (``bench.py``,
+``reproduce.py``) for write-mode ``open(...)`` calls (and direct
+``np.savez`` to a path) outside ``utils/checkpoint.py``; a hit is a
+finding unless the line carries an explicit ``# atomic-ok`` waiver (for
+the rare write that is genuinely append-only or otherwise crash-safe).
+Run standalone (exits 1 on findings) or via tier-1
+(``tests/test_checkpoint_tools.py``), so non-crash-consistent writes
+cannot regress in.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Scope: the installable package plus the two entry points.  scripts/ and
+# tests/ are out of scope — they write developer-local files whose loss is
+# a re-run, not a poisoned committed artifact.
+SCAN_ROOTS = ("aiyagari_hark_tpu",)
+SCAN_FILES = ("bench.py", "reproduce.py")
+
+# The atomic writers themselves (tmp + os.replace) live here.
+BLESSED = {os.path.join("aiyagari_hark_tpu", "utils", "checkpoint.py")}
+
+WAIVER = "# atomic-ok"
+
+# open(..., "w") / open(..., mode="w") in any spelling that truncates:
+# w, wt, wb, w+ ... — reads ("r") and appends ("a") are out of scope.
+# The path expression may contain arbitrary nesting (os.path.join(...),
+# self.path(), f-strings), so the lazy skip must admit parens — anchoring
+# on the mode LITERAL keeps it precise: a quote, 'w', optional b/t/+,
+# closing quote cannot appear inside a normal path literal ("w.txt"
+# fails the closing-quote-after-mode-chars requirement).
+_OPEN_W = re.compile(
+    r"""\bopen\s*\(               # open(
+        [^#]*?                    # path expression (parens allowed)
+        (?:mode\s*=\s*)?          # optional mode=
+        (?P<q>['"])w[bt+]*(?P=q)  # a truncating mode literal
+    """, re.VERBOSE)
+# np.savez/savez_compressed called on a PATH (a string/variable, not the
+# blessed writers' file-descriptor handle f).
+_SAVEZ = re.compile(r"\bnp\.savez(?:_compressed)?\s*\(\s*(?!f\b)")
+
+
+def scan_file(path: str, rel: str) -> list:
+    findings = []
+    if rel.replace(os.sep, "/") in {b.replace(os.sep, "/")
+                                    for b in BLESSED}:
+        return findings
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, start=1):
+            if WAIVER in line:
+                continue
+            if _OPEN_W.search(line):
+                findings.append(
+                    (rel, lineno,
+                     "bare write-mode open() — use "
+                     "utils.checkpoint.atomic_write_json/_text "
+                     "(or save_pytree), or waive with '# atomic-ok'"))
+            elif _SAVEZ.search(line):
+                findings.append(
+                    (rel, lineno,
+                     "np.savez to a path — use "
+                     "utils.checkpoint.save_pytree (atomic), or waive "
+                     "with '# atomic-ok'"))
+    return findings
+
+
+def scan(repo: str = REPO) -> list:
+    """All findings as (relpath, lineno, message) triples."""
+    findings = []
+    targets = []
+    for root in SCAN_ROOTS:
+        for dirpath, _, names in os.walk(os.path.join(repo, root)):
+            if "__pycache__" in dirpath:
+                continue
+            targets += [os.path.join(dirpath, n) for n in sorted(names)
+                        if n.endswith(".py")]
+    targets += [os.path.join(repo, f) for f in SCAN_FILES]
+    for path in targets:
+        if os.path.exists(path):
+            findings += scan_file(path, os.path.relpath(path, repo))
+    return findings
+
+
+def main() -> int:
+    findings = scan()
+    for rel, lineno, msg in findings:
+        print(f"{rel}:{lineno}: {msg}")
+    if findings:
+        print(f"{len(findings)} non-crash-consistent artifact write(s); "
+              f"see scripts/check_atomic_writes.py docstring")
+        return 1
+    print("atomic-write lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,117 @@
+"""KS outer-loop resilience (ISSUE 3): graceful preemption at iteration
+boundaries and torn-write recovery of the checkpoint/sidecar pair.
+
+``ks_solver`` documents the sidecar-before-checkpoint write order and the
+iteration-tag mismatch degradation; until this module no test actually
+killed a run between the two writes (ISSUE 3 satellite).  The configs are
+tiny (3 labor states, 10-point grids, short horizons) — the code paths are
+the production ones.
+"""
+
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.models.ks_solver import solve_ks_economy
+from aiyagari_hark_tpu.utils.checkpoint import load_ks_checkpoint
+from aiyagari_hark_tpu.utils.config import AgentConfig, EconomyConfig
+from aiyagari_hark_tpu.utils.resilience import (
+    Interrupted,
+    clear_interrupt,
+    request_interrupt,
+)
+
+AGENT = AgentConfig(labor_states=3, a_count=10, agent_count=40)
+ECON = EconomyConfig(labor_states=3, act_T=150, t_discard=30,
+                     verbose=False, tolerance=0.02, max_loops=3)
+KWARGS = dict(seed=0, sim_method="distribution", dist_count=32)
+
+
+def _bump_sidecar_tag(sidecar: str, delta: int = 7) -> None:
+    """Rewrite the sidecar's iteration tag in place — the on-disk state a
+    kill BETWEEN the sidecar write and the checkpoint write leaves behind
+    (the sidecar is written first, so its tag runs ahead)."""
+    with np.load(sidecar) as data:
+        arrays = {k: data[k] for k in data.files}
+    # leaf_000000 is the tag: the sidecar tree is (tag, state...) and
+    # save_pytree flattens depth-first
+    arrays["leaf_000000"] = arrays["leaf_000000"] + delta
+    np.savez(sidecar, **arrays)
+
+
+def test_ks_torn_checkpoint_pair_resumes_loudly(tmp_path):
+    """A torn (old checkpoint, newer sidecar) pair must resume with the
+    documented LOUD approximate degradation — fresh initial distribution,
+    tag-mismatch warning — and still complete; and a checkpoint missing
+    its sidecar entirely must warn the same way."""
+    ck = str(tmp_path / "ks.npz")
+    sidecar = ck + ".dist.npz"
+    part = solve_ks_economy(AGENT, ECON.replace(max_loops=2), **KWARGS,
+                            checkpoint_path=ck)
+    assert len(part.records) == 2
+    tag0 = int(load_ks_checkpoint(ck).iteration)
+
+    _bump_sidecar_tag(sidecar)
+    with pytest.warns(UserWarning,
+                      match="interrupted between the two writes"):
+        torn = solve_ks_economy(AGENT, ECON, **KWARGS, checkpoint_path=ck)
+    # the resume really continued from the checkpoint's iteration count
+    assert all(r.iteration >= tag0 for r in torn.records)
+    assert np.isfinite(np.asarray(torn.afunc.intercept)).all()
+
+    # checkpoint copied without its sidecar: same loud degradation
+    import os
+
+    os.remove(sidecar)
+    with pytest.warns(UserWarning, match="resuming from a fresh initial "
+                                         "distribution"):
+        solo = solve_ks_economy(AGENT, ECON, **KWARGS, checkpoint_path=ck)
+    assert np.isfinite(np.asarray(solo.afunc.intercept)).all()
+
+
+def test_matched_sidecar_resumes_exactly(tmp_path):
+    """The healthy pair (tags match) must resume WITHOUT the approximate-
+    resume warning: the carried distribution is restored, so the continued
+    trajectory equals the uninterrupted one (the contract the torn pair
+    degrades from)."""
+    import warnings
+
+    ck = str(tmp_path / "ks.npz")
+    full = solve_ks_economy(AGENT, ECON, **KWARGS)
+    solve_ks_economy(AGENT, ECON.replace(max_loops=2), **KWARGS,
+                     checkpoint_path=ck)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        resumed = solve_ks_economy(AGENT, ECON, **KWARGS,
+                                   checkpoint_path=ck)
+    assert not [w for w in caught
+                if "approximate" in str(w.message)], (
+        "healthy checkpoint/sidecar pair must resume exactly, not "
+        "degrade to the approximate path")
+    np.testing.assert_allclose(np.asarray(resumed.afunc.intercept),
+                               np.asarray(full.afunc.intercept),
+                               atol=1e-10)
+
+
+def test_ks_preemption_flushes_checkpoint_and_resumes(tmp_path):
+    """A shutdown requested mid-run is honored at the next outer-iteration
+    boundary: the checkpoint for the completed iteration is on disk, the
+    typed Interrupted carries the resume path, and a rerun continues the
+    trajectory to the uninterrupted result."""
+    ck = str(tmp_path / "ks.npz")
+    full = solve_ks_economy(AGENT, ECON, **KWARGS)
+    try:
+        request_interrupt()
+        with pytest.raises(Interrupted) as ei:
+            solve_ks_economy(AGENT, ECON, **KWARGS, checkpoint_path=ck)
+    finally:
+        clear_interrupt()
+    assert ei.value.resume_path == ck
+    assert ei.value.progress["iteration"] == 1   # stopped after iter 1
+    assert int(load_ks_checkpoint(ck).iteration) == 1
+
+    resumed = solve_ks_economy(AGENT, ECON, **KWARGS, checkpoint_path=ck)
+    assert [r.iteration for r in resumed.records] == list(
+        range(1, 1 + len(resumed.records)))
+    np.testing.assert_allclose(np.asarray(resumed.afunc.intercept),
+                               np.asarray(full.afunc.intercept),
+                               atol=1e-10)

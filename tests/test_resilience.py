@@ -1,0 +1,347 @@
+"""Preemption-tolerant run layer (ISSUE 3): durable sweep resume,
+graceful-shutdown signal handling, transient-fault retry with backoff.
+
+The load-bearing assertion is the KILL-AND-RESUME acceptance test: a
+12-cell CPU sweep interrupted after bucket k — by an injected SIGTERM and
+by an injected transient fault, separately — resumes via ``resume_path``
+and produces a ``SweepResult`` bit-identical to the uninterrupted run,
+including statuses, iteration counters, and a quarantined cell.  The
+companion contract: a transient fault at call k is retried on the
+deterministic backoff schedule, while a solver-health ``NONFINITE`` is
+NEVER retried by this layer (that is the PR 1 quarantine ladder's job).
+"""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+from aiyagari_hark_tpu.solver_health import (
+    INTERRUPTED,
+    SolverDivergenceError,
+    is_failure,
+)
+from aiyagari_hark_tpu.utils.config import SweepConfig
+from aiyagari_hark_tpu.utils.resilience import (
+    InjectedTransientError,
+    Interrupted,
+    RetryPolicy,
+    TransientInjector,
+    classify_transient,
+    clear_interrupt,
+    interrupt_requested,
+    preemption_guard,
+    raise_if_interrupted,
+    request_interrupt,
+    retry_transient,
+)
+
+# Reduced-size solver config shared with tests/test_sweep_scheduler.py —
+# same lru/jit cache keys, so this module rides the same warm compiles.
+KW = dict(a_count=12, dist_count=48, labor_states=4, r_tol=1e-5,
+          max_bisect=30)
+# Quarantined cell: stall-injected so it exits MAX_ITER, is quarantined,
+# and walks one ladder rung — the resume must replay its retry outcome.
+FAULT = {"cell": 2, "at_iter": 2, "mode": "stall"}
+TWELVE = SweepConfig(schedule="balanced", n_buckets=3)
+SMALL = SweepConfig(crra_values=(1.0, 5.0), rho_values=(0.0, 0.9),
+                    schedule="balanced", n_buckets=2)
+
+
+def spy_policy(**kw):
+    """A RetryPolicy whose sleeps are captured, not paid."""
+    slept = []
+    kw.setdefault("base_delay", 0.25)
+    policy = RetryPolicy(sleep=slept.append, **kw)
+    return policy, slept
+
+
+def assert_sweep_identical(a, b):
+    """Bit-identity over every per-cell field of two SweepResults —
+    values, NaN masks, statuses, iteration counters, retry counts, and
+    the scheduler's bucket/work-model bookkeeping."""
+    for f in ("r_star_pct", "saving_rate_pct", "capital", "excess",
+              "predicted_work"):
+        assert np.array_equal(np.asarray(getattr(a, f)),
+                              np.asarray(getattr(b, f)),
+                              equal_nan=True), f
+    for f in ("bisect_iters", "egm_iters", "dist_iters", "status",
+              "retries", "bucket"):
+        assert np.array_equal(np.asarray(getattr(a, f)),
+                              np.asarray(getattr(b, f))), f
+
+
+# -- retry_transient: policy, classifier, injection -------------------------
+
+
+def test_retry_policy_deterministic_backoff_schedule():
+    p = RetryPolicy(max_attempts=5, base_delay=0.5, multiplier=2.0,
+                    max_delay=3.0)
+    assert [p.delay(i) for i in range(5)] == [0.5, 1.0, 2.0, 3.0, 3.0]
+
+
+def test_retry_transient_retries_injected_fault_per_schedule():
+    policy, slept = spy_policy(max_attempts=3)
+    inject = TransientInjector(at_call=0, times=2)
+    calls = []
+    out = retry_transient(lambda: calls.append(1) or "ok", policy,
+                          inject=inject, label="unit")
+    assert out == "ok"
+    assert len(calls) == 1                 # two injected raises, then work
+    assert slept == [policy.delay(0), policy.delay(1)]
+
+
+def test_retry_transient_exhaustion_reraises():
+    policy, slept = spy_policy(max_attempts=2)
+    inject = TransientInjector(at_call=0, times=5)
+    with pytest.raises(InjectedTransientError):
+        retry_transient(lambda: "never", policy, inject=inject)
+    assert slept == [policy.delay(0)]      # one backoff between 2 attempts
+
+
+def test_retry_transient_never_retries_nonfinite():
+    """The hard rule: numeric divergence is the quarantine ladder's job —
+    the transient layer must re-raise SolverDivergenceError immediately,
+    with zero sleeps."""
+    policy, slept = spy_policy(max_attempts=5)
+
+    def diverge():
+        raise SolverDivergenceError("NONFINITE in the inner loop",
+                                    status=3)
+
+    with pytest.raises(SolverDivergenceError):
+        retry_transient(diverge, policy)
+    assert slept == []
+
+
+def test_retry_transient_non_transient_raises_immediately():
+    policy, slept = spy_policy(max_attempts=5)
+    with pytest.raises(ValueError):
+        retry_transient(lambda: (_ for _ in ()).throw(
+            ValueError("bad argument")), policy)
+    assert slept == []
+
+
+def test_classify_transient_rules():
+    assert classify_transient(InjectedTransientError("x"))
+    assert classify_transient(ConnectionError("peer reset"))
+    assert classify_transient(RuntimeError("UNAVAILABLE: socket closed"))
+    assert classify_transient(RuntimeError("DEADLINE_EXCEEDED: 60s"))
+    assert classify_transient(RuntimeError("RESOURCE_EXHAUSTED: quota"))
+    assert not classify_transient(SolverDivergenceError("nan", status=3))
+    assert not classify_transient(ValueError("UNAVAILABLE"))  # type wins
+    assert not classify_transient(RuntimeError("assertion failed"))
+    assert not classify_transient(KeyboardInterrupt())
+    assert not classify_transient(Interrupted("shutdown"))
+    # gRPC codes are matched SHOUTED — prose must not trip the retry
+    assert not classify_transient(RuntimeError("operation aborted by user"))
+    # device OOM is RESOURCE_EXHAUSTED but deterministic: never replayed
+    assert not classify_transient(RuntimeError(
+        "RESOURCE_EXHAUSTED: Attempting to allocate 12.5G in HBM"))
+
+
+# -- preemption_guard: signals, escalation, teardown ------------------------
+
+
+def test_preemption_guard_turns_sigterm_into_typed_interrupt():
+    with preemption_guard():
+        assert not interrupt_requested()
+        os.kill(os.getpid(), signal.SIGTERM)   # a real signal, as in prod
+        assert interrupt_requested()
+        with pytest.raises(Interrupted) as ei:
+            raise_if_interrupted("unit loop", resume_path="/tmp/x.npz",
+                                 progress={"step": 3})
+        assert ei.value.signum == signal.SIGTERM
+        assert ei.value.status == INTERRUPTED
+        assert is_failure(ei.value.status)     # uncertified exit
+        assert ei.value.resume_path == "/tmp/x.npz"
+        assert ei.value.progress == {"step": 3}
+    # guard exit clears the flag and restores the default disposition
+    assert not interrupt_requested()
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+
+
+def test_preemption_guard_second_signal_escalates():
+    with preemption_guard():
+        os.kill(os.getpid(), signal.SIGTERM)
+        with pytest.raises(KeyboardInterrupt, match="second SIGTERM"):
+            os.kill(os.getpid(), signal.SIGTERM)
+            # the handler fires at the next bytecode boundary; touching
+            # the flag guarantees we cross one
+            interrupt_requested()
+
+
+def test_preemption_guard_teardown_gcs_orphaned_tmp(tmp_path):
+    """A hard kill between an atomic writer's write and rename strands a
+    tmp sibling; guard teardown sweeps it (age-gated, logged)."""
+    target = str(tmp_path / "ledger.npz")
+    stale = str(tmp_path / "tmpabc123.npz.tmp")
+    with open(stale, "w") as f:
+        f.write("stranded")
+    with pytest.warns(UserWarning, match="orphaned checkpoint tmp"):
+        with preemption_guard(gc_paths=(target,), max_tmp_age_s=0.0):
+            pass
+    assert not os.path.exists(stale)
+
+
+def test_calibration_polls_at_evaluation_boundaries():
+    """calibrate_spread_to_lorenz honors a shutdown request at its next
+    evaluation boundary — before launching another multi-second GE solve."""
+    from aiyagari_hark_tpu.models.calibrate import calibrate_spread_to_lorenz
+    from aiyagari_hark_tpu.models.household import build_simple_model
+
+    model = build_simple_model(labor_states=3, a_count=8, dist_count=16)
+    try:
+        request_interrupt()
+        with pytest.raises(Interrupted) as ei:
+            calibrate_spread_to_lorenz(model, 0.95, 2.0, 0.36, 0.08,
+                                       n_types=2)
+    finally:
+        clear_interrupt()
+    assert ei.value.progress == {"evaluations": 0}   # nothing was solved
+
+
+def test_nested_guard_and_flag_injection():
+    with preemption_guard():
+        with preemption_guard():
+            request_interrupt()
+            assert interrupt_requested()
+        # inner exit must NOT clear the flag (outer guard still winding
+        # down), only the outermost does
+        assert interrupt_requested()
+    assert not interrupt_requested()
+
+
+# -- the kill-and-resume acceptance (12-cell CPU sweep) ---------------------
+
+
+@pytest.fixture(scope="module")
+def uninterrupted():
+    """The reference run: full 12-cell lattice, bucketed schedule, one
+    stall-injected cell that the quarantine ladder retries."""
+    res = run_table2_sweep(TWELVE, inject_fault=FAULT, max_retries=1, **KW)
+    assert int(res.retries[FAULT["cell"]]) >= 1     # quarantine really ran
+    return res
+
+
+def test_sigterm_after_bucket_k_resumes_bit_identical(tmp_path,
+                                                      uninterrupted):
+    """Injected SIGTERM after bucket 0: the sweep flushes its ledger and
+    raises the typed Interrupted; a rerun with the same resume_path skips
+    the solved bucket and reassembles bit-identically."""
+    ledger = str(tmp_path / "sweep_ledger.npz")
+    with preemption_guard():
+        with pytest.raises(Interrupted) as ei:
+            run_table2_sweep(
+                TWELVE, inject_fault=FAULT, max_retries=1,
+                resume_path=ledger,
+                inject_preempt={"after_bucket": 0, "mode": "signal"}, **KW)
+    assert ei.value.signum == signal.SIGTERM
+    assert ei.value.resume_path == ledger
+    assert ei.value.progress["completed_buckets"] == 1
+    assert os.path.exists(ledger)          # valid state flushed pre-raise
+    with np.load(ledger) as raw:           # some, not all, cells solved
+        n_leaves = len([k for k in raw.files if k.startswith("leaf_")])
+    assert n_leaves == 7                   # the SweepLedger layout
+
+    resumed = run_table2_sweep(TWELVE, inject_fault=FAULT, max_retries=1,
+                               resume_path=ledger, **KW)
+    assert not os.path.exists(ledger)      # completed runs clean up
+    assert_sweep_identical(resumed, uninterrupted)
+
+
+def test_transient_fault_mid_sweep_resumes_bit_identical(tmp_path,
+                                                         uninterrupted):
+    """A transient fault at call k=1 (the second bucket launch) that
+    exhausts the retry budget escapes; the ledger holds bucket 0 and the
+    rerun resumes bit-identically.  The backoff between the two attempts
+    follows the policy's deterministic schedule."""
+    ledger = str(tmp_path / "sweep_ledger.npz")
+    policy, slept = spy_policy(max_attempts=2)
+    with pytest.raises(InjectedTransientError):
+        run_table2_sweep(
+            TWELVE, inject_fault=FAULT, max_retries=1, resume_path=ledger,
+            retry=policy, inject_transient={"at_call": 1, "times": 2},
+            **KW)
+    assert slept == [policy.delay(0)]      # retried once, per schedule
+    assert os.path.exists(ledger)
+
+    resumed = run_table2_sweep(TWELVE, inject_fault=FAULT, max_retries=1,
+                               resume_path=ledger, **KW)
+    assert not os.path.exists(ledger)
+    assert_sweep_identical(resumed, uninterrupted)
+
+
+def test_transient_fault_retried_in_place_same_bits():
+    """A transient fault that does NOT exhaust the budget is absorbed: the
+    launch replays (pure program, same bits) and the sweep completes in
+    one call, identical to a fault-free run."""
+    clean = run_table2_sweep(SMALL, **KW)
+    policy, slept = spy_policy(max_attempts=3)
+    with pytest.warns(UserWarning, match="transient fault in sweep"):
+        faulted = run_table2_sweep(
+            SMALL, retry=policy,
+            inject_transient={"at_call": 0, "times": 1}, **KW)
+    assert slept == [policy.delay(0)]
+    assert_sweep_identical(faulted, clean)
+
+
+def test_nonfinite_goes_to_quarantine_not_transient_retry():
+    """An injected NONFINITE is handled by the solver-health quarantine
+    ladder; the transient-retry layer must consume ZERO attempts on it."""
+    policy, slept = spy_policy(max_attempts=5)
+    res = run_table2_sweep(SMALL, inject_fault={"cell": 1, "at_iter": 1,
+                                                "mode": "nan"},
+                           max_retries=1, retry=policy, **KW)
+    assert slept == []                     # no transient retries fired
+    assert int(res.retries[1]) >= 1        # the quarantine ladder did run
+    # retries never re-inject, so the ladder recovers the cell cleanly
+    assert not is_failure(int(res.status[1]))
+    assert np.isfinite(res.r_star_pct[1])
+
+
+def test_stale_ledger_warns_and_recomputes(tmp_path):
+    """A ledger written under a different configuration must degrade
+    loudly to a fresh run — never silently satisfy the launches."""
+    ledger = str(tmp_path / "sweep_ledger.npz")
+    with preemption_guard():
+        with pytest.raises(Interrupted):
+            run_table2_sweep(
+                SMALL, resume_path=ledger,
+                inject_preempt={"after_bucket": 0, "mode": "flag"}, **KW)
+    assert os.path.exists(ledger)
+    other = dict(KW)
+    other["r_tol"] = 2e-5                  # different solver config
+    with pytest.warns(UserWarning, match="different run"):
+        res = run_table2_sweep(SMALL, resume_path=ledger, **other)
+    assert np.isfinite(res.r_star_pct).all()
+    assert not os.path.exists(ledger)
+
+
+def test_locked_schedule_resumes_through_quarantine(tmp_path):
+    """The lock-step path is one "bucket" to the ledger: a preemption
+    between the launch and the quarantine rungs resumes without
+    relaunching the batch, bit-identically."""
+    cfg = SMALL.replace(schedule="locked")
+    clean = run_table2_sweep(cfg, inject_fault=FAULT, max_retries=1, **KW)
+    ledger = str(tmp_path / "locked_ledger.npz")
+    try:
+        request_interrupt()                # flag set before the call:
+        with pytest.raises(Interrupted):   # honored right after the launch
+            run_table2_sweep(cfg, inject_fault=FAULT, max_retries=1,
+                             resume_path=ledger, **KW)
+    finally:
+        clear_interrupt()
+    assert os.path.exists(ledger)
+    resumed = run_table2_sweep(cfg, inject_fault=FAULT, max_retries=1,
+                               resume_path=ledger, **KW)
+    for f in ("r_star_pct", "capital"):
+        assert np.array_equal(np.asarray(getattr(resumed, f)),
+                              np.asarray(getattr(clean, f)),
+                              equal_nan=True), f
+    for f in ("bisect_iters", "egm_iters", "dist_iters", "status",
+              "retries"):
+        assert np.array_equal(np.asarray(getattr(resumed, f)),
+                              np.asarray(getattr(clean, f))), f
