@@ -110,6 +110,14 @@ from .models.value import (  # noqa: F401
     value_at,
 )
 from .parallel.sweep import SweepResult, run_table2_sweep  # noqa: F401
+from .serve import (  # noqa: F401
+    EquilibriumQuery,
+    EquilibriumService,
+    EquilibriumSolveFailed,
+    ServedResult,
+    SolutionStore,
+    make_query,
+)
 from .solver_health import (  # noqa: F401
     CONVERGED,
     MAX_ITER,

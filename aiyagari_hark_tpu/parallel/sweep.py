@@ -43,9 +43,13 @@ from ..solver_health import CONVERGED, is_failure, status_name
 from ..utils.checkpoint import (
     CORRUPT_NPZ_ERRORS,
     CheckpointMismatchError,
-    config_fingerprint,
     load_sweep_sidecar,
     save_sweep_sidecar,
+)
+from ..utils.fingerprint import (
+    hashable_kwargs,
+    ledger_fingerprint,
+    work_fingerprint,
 )
 from ..utils.config import SweepConfig
 from ..utils.resilience import (
@@ -266,28 +270,10 @@ def _retry_ladder(model_kwargs: dict) -> tuple:
     )
 
 
-def _hashable_kwargs(model_kwargs: dict) -> tuple:
-    """Normalize sweep kwargs into an ``lru_cache``-safe key: sequences
-    become tuples, and anything still unhashable gets a clear error instead
-    of ``lru_cache``'s bare TypeError."""
-    items = []
-    for k, v in sorted(model_kwargs.items()):
-        if isinstance(v, (list, np.ndarray)):
-            arr = np.asarray(v)
-            if arr.ndim > 1:
-                raise TypeError(
-                    f"sweep kwarg {k!r} has shape {arr.shape}; only scalars "
-                    "and 1-D sequences can be forwarded to the cell solver")
-            v = tuple(arr.tolist())
-        try:
-            hash(v)
-        except TypeError:
-            raise TypeError(
-                f"sweep kwarg {k!r}={v!r} is not hashable; pass scalars or "
-                "tuples (grids are rebuilt per cell from scalar settings)"
-            ) from None
-        items.append((k, v))
-    return tuple(items)
+# Canonical kwargs normalization — lives in ``utils.fingerprint`` now (the
+# serving store hashes the same spelling); the private name stays for
+# existing callers (models.fiscal, tests).
+_hashable_kwargs = hashable_kwargs
 
 
 # ---------------------------------------------------------------------------
@@ -316,12 +302,12 @@ def heuristic_cell_work(cells: np.ndarray) -> np.ndarray:
     return 1.0 / np.maximum(inv, 0.05)
 
 
-def _work_fingerprint(kwargs_items: tuple, dtype) -> int:
-    """Sidecar validity key: the solver configuration that shaped the
-    counters (method choices, tolerances, grid sizes) plus the dtype.
-    Cell triples are NOT part of the key — rows are matched per cell, so
-    a sidecar from a coarser lattice still warm-starts the cells it has."""
-    return config_fingerprint(str(np.dtype(dtype)), repr(kwargs_items))
+# Sidecar validity key: the solver configuration that shaped the counters
+# (method choices, tolerances, grid sizes) plus the dtype.  Cell triples
+# are NOT part of the key — rows are matched per cell, so a sidecar from a
+# coarser lattice still warm-starts the cells it has.  Shared with the
+# serving store's donor groups via ``utils.fingerprint.work_fingerprint``.
+_work_fingerprint = work_fingerprint
 
 
 def _load_sidecar(path, fingerprint):
@@ -420,6 +406,33 @@ def _plan_buckets(order: np.ndarray, n_buckets: int):
             for i in range(k) if len(order[i * size:(i + 1) * size])], size
 
 
+# Donor-ranking normalization of the (σ, ρ, sd) axes — the Table II
+# lattice spans (≈4, 0.9, 0.4).  ONE rule shared by the sweep's in-batch
+# neighbor seeding and the serving store's donor nomination
+# (``serve.store.SolutionStore.nominate``), so batch and serving warm
+# starts rank donors — and size their verified margins — identically and
+# cannot drift apart (the ISSUE 4 fingerprint-consolidation rationale,
+# applied to the seeding rule).
+NEIGHBOR_CELL_SCALE = (4.0, 0.9, 0.4)
+
+
+def neighbor_distance(cell, cells) -> np.ndarray:
+    """Normalized L1 distance from ``cell`` to each row of ``cells``."""
+    cell = np.asarray(cell, dtype=np.float64)
+    cells = np.asarray(cells, dtype=np.float64)
+    return sum(np.abs(cells[..., i] - cell[i]) / NEIGHBOR_CELL_SCALE[i]
+               for i in range(3))
+
+
+def donor_margin(spread, width: float, r_tol: float) -> float:
+    """Safety-ball half-width around a donated root: the r*-spread of the
+    two nearest donors (how far the root plausibly moved) floored
+    defensively; ``spread=None`` is the single-donor conservative case."""
+    if spread is None:
+        return float(max(0.08 * width, 64.0 * r_tol))
+    return float(max(spread, 0.03 * width, 64.0 * r_tol))
+
+
 def _neighbor_seed(cell, cells, r_solved, solved_ok, width, r_tol,
                    warm_margin):
     """Bracket seed for ``cell`` from the nearest already-solved neighbor
@@ -430,19 +443,14 @@ def _neighbor_seed(cell, cells, r_solved, solved_ok, width, r_tol,
     idx = np.nonzero(solved_ok)[0]
     if len(idx) == 0:
         return None
-    d = (np.abs(cells[idx, 0] - cell[0]) / 4.0
-         + np.abs(cells[idx, 1] - cell[1]) / 0.9
-         + np.abs(cells[idx, 2] - cell[2]) / 0.4)
+    d = neighbor_distance(cell, cells[idx])
     near = idx[np.argsort(d, kind="stable")]
     target = float(r_solved[near[0]])
     if warm_margin > 0.0:
         return target, float(warm_margin)
-    if len(near) > 1:
-        spread = abs(float(r_solved[near[0]]) - float(r_solved[near[1]]))
-        margin = max(spread, 0.03 * width, 64.0 * r_tol)
-    else:
-        margin = max(0.08 * width, 64.0 * r_tol)
-    return target, margin
+    spread = (abs(float(r_solved[near[0]]) - float(r_solved[near[1]]))
+              if len(near) > 1 else None)
+    return target, donor_margin(spread, width, r_tol)
 
 
 def _resilience_seam(ledger, record, progress, inject_preempt=None,
@@ -837,13 +845,10 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
         resume_path = sweep.resume_path
     ledger = None
     if resume_path is not None:
-        ledger_fp = config_fingerprint(
-            crra, rho, sd, repr(kwargs_items), str(np.dtype(dtype)),
-            schedule, int(sweep.n_buckets), bool(sweep.warm_brackets),
-            float(sweep.warm_margin), str(fault_mode),
-            "none" if fault_iters is None else fault_iters,
-            int(max_retries), bool(quarantine),
-            *(tuple(side) if side is not None else ("no-sidecar",)))
+        ledger_fp = ledger_fingerprint(
+            crra, rho, sd, kwargs_items, dtype, schedule,
+            sweep.n_buckets, sweep.warm_brackets, sweep.warm_margin,
+            fault_mode, fault_iters, max_retries, quarantine, side)
         ledger = LedgerState.resume(resume_path, ledger_fp, n_orig)
 
     bucket_of = None

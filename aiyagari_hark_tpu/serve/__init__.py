@@ -1,0 +1,23 @@
+"""Equilibrium serving subsystem (DESIGN §8): micro-batched query engine
+over a content-addressed solution store with nearest-neighbor warm starts.
+
+The batch sweep (``parallel.sweep``) answers "solve this lattice once,
+fast"; this package answers "serve equilibrium queries interactively" —
+exact hits from the store in microseconds, near hits warm-started from
+the nearest cached neighbor through the verified ``dyadic_bracket``
+mechanism, cold misses micro-batched onto a fixed ladder of executable
+shapes shared with the sweep's compiled cell solver.
+"""
+
+from .batcher import MicroBatcher, ServeQueueFull, default_ladder  # noqa: F401
+from .metrics import ServeMetrics  # noqa: F401
+from .service import (  # noqa: F401
+    EquilibriumQuery,
+    EquilibriumService,
+    EquilibriumSolveFailed,
+    ServedResult,
+    ServeError,
+    ServiceClosed,
+    make_query,
+)
+from .store import Donation, SolutionStore, StoredSolution, make_solution  # noqa: F401

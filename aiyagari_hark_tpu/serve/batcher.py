@@ -1,0 +1,182 @@
+"""Micro-batching for equilibrium queries: bounded queue, shape ladder.
+
+The device economics (DESIGN §8): one vmapped launch amortizes dispatch
+overhead across lanes, but XLA compiles one executable **per input
+shape** — so admitting arbitrary batch sizes would compile an executable
+per arrival pattern.  The batcher therefore pads every flush up to a small
+**ladder** of fixed shapes (default: powers of two up to ``max_batch``),
+so a warmed service owns exactly ``len(ladder)`` executables per solver
+group and every later launch is a pure executable-cache hit.  Padded lanes
+duplicate a real lane's inputs (identical bits, masked out at scatter) —
+the sweep's padding rule.
+
+Flush policy: a group flushes when it holds ``max_batch`` requests
+(occupancy-bound) or when its oldest request has waited ``max_wait_s``
+(latency-bound).  The clock is injectable, so the deadline machinery is
+property-testable with a deterministic fake clock; the bounded queue
+(``max_queue`` across groups) sheds load by blocking or raising
+``ServeQueueFull``.
+
+This module is deliberately generic: items are opaque (the service's
+pending-request records), groups are opaque hashable keys (the service
+uses (dtype, kwargs) — only same-configuration queries can share an
+executable).  No jax imports."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Hashable, List, Optional, Tuple
+
+
+class ServeQueueFull(RuntimeError):
+    """The bounded request queue is at capacity and the caller asked not
+    to block (or timed out blocking)."""
+
+
+def default_ladder(max_batch: int) -> Tuple[int, ...]:
+    """Powers of two up to and including ``max_batch``: the shape set a
+    warmed service compiles, e.g. ``max_batch=8 -> (1, 2, 4, 8)``,
+    ``max_batch=12 -> (1, 2, 4, 8, 12)``."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    ladder = []
+    s = 1
+    while s < max_batch:
+        ladder.append(s)
+        s *= 2
+    ladder.append(max_batch)
+    return tuple(ladder)
+
+
+class MicroBatcher:
+    """Collects requests per group behind a bounded queue and releases
+    them as ladder-shaped batches on size or deadline."""
+
+    def __init__(self, max_batch: int = 8, max_wait_s: float = 0.002,
+                 max_queue: int = 1024,
+                 ladder: Optional[Tuple[int, ...]] = None,
+                 clock=time.monotonic):
+        self.ladder = (default_ladder(max_batch) if ladder is None
+                       else tuple(sorted(set(int(s) for s in ladder))))
+        if not self.ladder or self.ladder[0] < 1:
+            raise ValueError(f"invalid ladder {self.ladder}")
+        self.max_batch = self.ladder[-1]
+        if max_batch > self.max_batch:
+            raise ValueError(
+                f"max_batch={max_batch} exceeds the ladder's largest "
+                f"shape {self.max_batch}; every flush must pad to a "
+                "ladder shape")
+        self.max_wait_s = float(max_wait_s)
+        self.max_queue = int(max_queue)
+        self.clock = clock
+        self._cond = threading.Condition()
+        self._groups: dict = {}     # group -> list of (item, t_enqueued)
+        self._depth = 0
+
+    def pad_to(self, n: int) -> int:
+        """Smallest ladder shape >= n (the launch shape for n real lanes)."""
+        for s in self.ladder:
+            if s >= n:
+                return s
+        return self.max_batch
+
+    def depth(self) -> int:
+        with self._cond:
+            return self._depth
+
+    def offer(self, group: Hashable, item, block: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Enqueue one request.  At capacity: block (optionally up to
+        ``timeout`` seconds of real time) or raise ``ServeQueueFull``."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._cond:
+            while self._depth >= self.max_queue:
+                if not block:
+                    raise ServeQueueFull(
+                        f"serving queue at capacity ({self.max_queue})")
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise ServeQueueFull(
+                        f"serving queue still at capacity "
+                        f"({self.max_queue}) after {timeout:g}s")
+                self._cond.wait(remaining)
+            self._groups.setdefault(group, []).append((item, self.clock()))
+            self._depth += 1
+            self._cond.notify_all()
+
+    def _pop_from(self, group: Hashable, n: int) -> list:
+        entries = self._groups[group]
+        taken = [item for item, _ in entries[:n]]
+        rest = entries[n:]
+        if rest:
+            self._groups[group] = rest
+        else:
+            del self._groups[group]
+        self._depth -= len(taken)
+        self._cond.notify_all()
+        return taken
+
+    def pop_ready(self, now: Optional[float] = None) -> List[tuple]:
+        """Batches due at ``now`` (default: the injected clock), as
+        ``(group, [items...])`` — full groups first (oldest requests),
+        then deadline-expired groups.  Non-blocking."""
+        if now is None:
+            now = self.clock()
+        out = []
+        with self._cond:
+            for group in list(self._groups):
+                while len(self._groups.get(group, ())) >= self.max_batch:
+                    out.append((group, self._pop_from(group,
+                                                      self.max_batch)))
+                entries = self._groups.get(group)
+                if entries and now - entries[0][1] >= self.max_wait_s:
+                    out.append((group, self._pop_from(group,
+                                                      self.max_batch)))
+        return out
+
+    def pop_all(self) -> List[tuple]:
+        """Everything still queued, chunked at ``max_batch`` — the drain
+        path (service shutdown)."""
+        out = []
+        with self._cond:
+            for group in list(self._groups):
+                while group in self._groups:
+                    out.append((group, self._pop_from(group,
+                                                      self.max_batch)))
+        return out
+
+    def next_deadline(self) -> Optional[float]:
+        """Earliest instant (in clock units) a queued group becomes due,
+        or None when the queue is empty."""
+        with self._cond:
+            oldest = [entries[0][1] for entries in self._groups.values()
+                      if entries]
+        if not oldest:
+            return None
+        return min(oldest) + self.max_wait_s
+
+    def wait_ready(self, timeout: Optional[float] = None) -> List[tuple]:
+        """Block (on real time) until at least one batch is due, then
+        return the due batches; ``[]`` on timeout.  The worker thread's
+        wait primitive — uses the injected clock only for deadlines, real
+        time for the condition wait."""
+        end = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                ready = self.pop_ready()
+                if ready:
+                    return ready
+                nd = self.next_deadline()
+                wait = None
+                if nd is not None:
+                    wait = max(0.0, nd - self.clock())
+                if end is not None:
+                    remaining = end - time.monotonic()
+                    if remaining <= 0:
+                        return []
+                    wait = remaining if wait is None else min(wait,
+                                                              remaining)
+                self._cond.wait(wait)

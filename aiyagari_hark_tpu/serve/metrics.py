@@ -1,0 +1,132 @@
+"""Serving observability: counters, latency histograms, compile counts.
+
+The serving analogue of the bench record fields: every number the
+``--serve-smoke`` bench mode emits (``serve_hit_rate``, ``serve_p50_ms``,
+``serve_batch_occupancy``, ``serve_compiles``, ...) is accumulated here,
+thread-safely, by the ``EquilibriumService`` hot path.  Kept deliberately
+dependency-free (no jax import at module scope): recording a hit must cost
+microseconds — the exact-hit latency budget is < 1 ms end to end.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..utils.timing import CompileCounter
+
+# Served-request paths, in cache-goodness order.
+PATHS = ("hit", "near", "cold")
+
+
+class LatencyHistogram:
+    """Bounded latency sample set with exact percentiles.
+
+    Samples beyond ``cap`` are dropped by decimation (every other kept),
+    so long soaks stay O(cap) memory while early AND late samples keep
+    representation; ``count`` always reflects every observation."""
+
+    def __init__(self, cap: int = 8192):
+        self.cap = int(cap)
+        self.samples: list = []
+        self.count = 0
+        self._stride = 1
+        self._seen = 0
+
+    def add(self, seconds: float) -> None:
+        self.count += 1
+        self._seen += 1
+        if self._seen % self._stride:
+            return
+        self.samples.append(float(seconds))
+        if len(self.samples) >= self.cap:
+            self.samples = self.samples[::2]
+            self._stride *= 2
+
+    def percentile(self, q: float):
+        """q in [0, 100]; None when no samples were recorded."""
+        if not self.samples:
+            return None
+        s = sorted(self.samples)
+        idx = min(len(s) - 1, max(0, round(q / 100.0 * (len(s) - 1))))
+        return s[idx]
+
+
+class ServeMetrics:
+    """Thread-safe accumulator for one ``EquilibriumService``'s lifetime.
+
+    * per-path request counts and latencies (submit -> future resolved);
+    * batch shape accounting: real lanes vs padded ladder shape
+      (``serve_batch_occupancy`` is mean real/shape over launches);
+    * queue depth peak;
+    * XLA compile activity via ``utils.timing.CompileCounter`` — the
+      service holds ``compile`` entered around every device launch, so
+      ``serve_compiles`` counts backend compile requests attributable to
+      serving (an in-memory executable reuse fires nothing: the
+      zero-compiles-after-warmup contract's number).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.served = {p: 0 for p in PATHS}
+        self.failures = 0
+        self.batches = 0
+        self.lanes_real = 0
+        self.lanes_padded = 0
+        self.queue_depth_peak = 0
+        self.latency = {p: LatencyHistogram() for p in PATHS}
+        self.latency_all = LatencyHistogram()
+        self.compile = CompileCounter()
+
+    def record_served(self, path: str, latency_s: float) -> None:
+        with self._lock:
+            self.served[path] += 1
+            self.latency[path].add(latency_s)
+            self.latency_all.add(latency_s)
+
+    def record_failure(self, latency_s: float) -> None:
+        with self._lock:
+            self.failures += 1
+            self.latency_all.add(latency_s)
+
+    def record_batch(self, n_real: int, shape: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.lanes_real += int(n_real)
+            self.lanes_padded += int(shape)
+
+    def note_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            if depth > self.queue_depth_peak:
+                self.queue_depth_peak = depth
+
+    @staticmethod
+    def _ms(value):
+        return None if value is None else round(value * 1e3, 4)
+
+    def snapshot(self) -> dict:
+        """The serving record fields, bench-JSON ready (``serve_*``)."""
+        with self._lock:
+            n = sum(self.served.values()) + self.failures
+            total = max(n, 1)
+            occ = (self.lanes_real / self.lanes_padded
+                   if self.lanes_padded else None)
+            return {
+                "serve_requests": n,
+                "serve_hit_rate": round(self.served["hit"] / total, 4),
+                "serve_near_rate": round(self.served["near"] / total, 4),
+                "serve_cold_rate": round(self.served["cold"] / total, 4),
+                "serve_failures": self.failures,
+                "serve_batches": self.batches,
+                "serve_batch_occupancy": (None if occ is None
+                                          else round(occ, 4)),
+                "serve_queue_depth_peak": self.queue_depth_peak,
+                "serve_p50_ms": self._ms(self.latency_all.percentile(50)),
+                "serve_p95_ms": self._ms(self.latency_all.percentile(95)),
+                "serve_hit_p50_ms": self._ms(
+                    self.latency["hit"].percentile(50)),
+                "serve_hit_p95_ms": self._ms(
+                    self.latency["hit"].percentile(95)),
+                "serve_compiles": self.compile.compile_events,
+                "serve_compile_cache_misses": self.compile.cache_misses,
+                "serve_compile_s": round(self.compile.compile_seconds, 3),
+            }

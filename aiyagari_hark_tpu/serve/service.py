@@ -1,0 +1,482 @@
+"""EquilibriumService: the in-process inference engine for equilibrium
+queries — the serving analogue of the batch sweep (DESIGN §8).
+
+Request path per query (``submit`` / ``query``):
+
+1. **exact hit** — the calibration's ``solution_fingerprint`` is in the
+   ``SolutionStore``: the future resolves immediately from the cached
+   device row.  No device launch, no jax call — microseconds.
+2. **near hit** — the store nominates the nearest solved neighbor in the
+   same solver group; the service descends the economic bracket toward
+   the donor's root (``dyadic_bracket``) and the lane launches with that
+   verified-on-device seed (``solve_equilibrium_lean(bracket_init=)``) —
+   a wrong donor costs two cheap-end evaluations and falls back to the
+   exact cold trajectory in-program.
+3. **cold miss** — the lane launches with the pseudo-cold seed
+   ``(r_lo, r_hi, 0)``, which the in-program verifier rejects by
+   construction (``it0 = 0``), replaying the exact cold midpoint
+   sequence.
+
+Misses are micro-batched (``MicroBatcher``): flush on ``max_batch`` or
+the ``max_wait_s`` deadline, padded to a fixed shape ladder so a warmed
+service owns ONE executable per ladder shape per solver group — the
+sweep's shared-executable discipline (``parallel.sweep._batched_solver``
+IS the executable: serving and the batch sweep share the compile cache).
+
+Correctness contract (property-tested in ``tests/test_serve.py``): lane
+results are bit-identical across batch packing, padding, and batchmates —
+a served result equals a batch-of-1 launch of the same executable with
+the same seed, bit for bit (and equals the un-vmapped eager
+``solve_equilibrium_lean`` on every field except ``capital``, whose
+cross-lane reduction order differs at ~1e-11 — see DESIGN §8).  A failed
+(NONFINITE/MAX_ITER) cell raises a typed ``EquilibriumSolveFailed`` on
+its own future and is never cached; its batchmates' bits are untouched
+(PR 1's quarantine isolation, per launch).
+
+Resilience: every launch runs under ``retry_transient`` (transient
+device/RPC faults retried on the deterministic backoff schedule; numeric
+failure never retried here), and the worker polls
+``resilience.interrupt_requested`` at batch seams — inside a
+``preemption_guard`` a SIGTERM drains by *failing* pending futures with
+the typed ``Interrupted`` instead of leaving callers hung.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..solver_health import SolverDivergenceError, is_failure, status_name
+from ..utils.fingerprint import (
+    hashable_kwargs,
+    solution_fingerprint,
+    work_fingerprint,
+)
+from ..utils.resilience import (
+    Interrupted,
+    RetryPolicy,
+    interrupt_requested,
+    retry_transient,
+)
+from .batcher import MicroBatcher, ServeQueueFull  # noqa: F401  (re-export)
+from .metrics import ServeMetrics
+from .store import SolutionStore, make_solution
+
+
+class ServeError(RuntimeError):
+    """Base of the serving layer's typed errors."""
+
+
+class ServiceClosed(ServeError):
+    """submit() after close(): the service no longer accepts queries."""
+
+
+class EquilibriumSolveFailed(SolverDivergenceError):
+    """One query's solve exited with a failure status (NONFINITE /
+    MAX_ITER).  Raised on that query's future only — batchmates are
+    unaffected.  Subclasses ``SolverDivergenceError`` so the resilience
+    layer's never-retry-numeric-failure rule applies to it by type."""
+
+    def __init__(self, cell, status: int, key: int):
+        super().__init__(
+            f"equilibrium query (σ={cell[0]:g}, ρ={cell[1]:g}, "
+            f"sd={cell[2]:g}) failed with status "
+            f"{status_name(status)}", status=status)
+        self.cell = tuple(cell)
+        self.key = int(key)
+
+
+class EquilibriumQuery(NamedTuple):
+    """One canonicalized equilibrium request.
+
+    Build with ``make_query`` (which canonicalizes dtype and kwargs);
+    equality of two queries' ``key()`` is exactly "every input that can
+    move a bit of the answer matches".  ``fault_iter`` is the
+    deterministic fault-injection hook (tests only; requires the service
+    to be constructed with ``inject_fault_mode``): faulted queries bypass
+    the cache on both read and write."""
+
+    crra: float
+    labor_ar: float
+    labor_sd: float
+    dtype: np.dtype
+    kwargs: tuple
+    fault_iter: Optional[int] = None
+
+    def cell(self) -> Tuple[float, float, float]:
+        return (self.crra, self.labor_ar, self.labor_sd)
+
+    def key(self) -> int:
+        return solution_fingerprint(self.crra, self.labor_ar,
+                                    self.labor_sd, self.kwargs, self.dtype)
+
+    def group(self) -> int:
+        return work_fingerprint(self.kwargs, self.dtype)
+
+
+def make_query(crra: float, labor_ar: float, labor_sd: float = 0.2,
+               dtype=None, fault_iter: Optional[int] = None,
+               **model_kwargs) -> EquilibriumQuery:
+    """Canonicalize one request: dtype to the concrete compute dtype
+    (``dtype=None`` and the explicit default address the same solution),
+    kwargs to the sorted hashable items every fingerprint hashes."""
+    from ..parallel.sweep import _canonical_dtype
+
+    return EquilibriumQuery(
+        crra=float(crra), labor_ar=float(labor_ar),
+        labor_sd=float(labor_sd), dtype=_canonical_dtype(dtype),
+        kwargs=hashable_kwargs(model_kwargs),
+        fault_iter=None if fault_iter is None else int(fault_iter))
+
+
+class ServedResult(NamedTuple):
+    """One resolved query.  Scalars are host Python numbers (float64
+    holds every compute dtype exactly; counters exact — values ≪ 2^24).
+
+    ``bracket_init`` is the exact ``(lo, hi, levels)`` seed the lane
+    launched with (``None`` for a cache hit) — passing it to a direct
+    ``solve_equilibrium_lean(bracket_init=)`` call reproduces the served
+    bits; ``path`` records which serving path produced the numbers."""
+
+    r_star: float
+    capital: float
+    labor: float
+    bisect_iters: int
+    egm_iters: int
+    dist_iters: int
+    status: int
+    path: str                       # "hit" | "near" | "cold"
+    bracket_init: Optional[tuple]   # (lo, hi, levels) launched with
+    key: int                        # solution_fingerprint
+
+
+def _result_from_row(row: np.ndarray, path: str, bracket_init,
+                     key: int) -> ServedResult:
+    return ServedResult(
+        r_star=float(row[0]), capital=float(row[1]), labor=float(row[2]),
+        bisect_iters=int(np.rint(row[3])), egm_iters=int(np.rint(row[4])),
+        dist_iters=int(np.rint(row[5])), status=int(np.rint(row[6])),
+        path=path, bracket_init=bracket_init, key=int(key))
+
+
+class _Pending(NamedTuple):
+    query: EquilibriumQuery
+    future: Future
+    t_submit: float
+
+
+class EquilibriumService:
+    """Micro-batched equilibrium query engine over a content-addressed
+    solution store (module docstring for the architecture).
+
+    ``start_worker=True`` (default) runs a daemon worker thread draining
+    the batcher — production mode; ``submit`` returns immediately and
+    futures resolve asynchronously.  ``start_worker=False`` is the
+    deterministic test mode: nothing launches until ``pump()`` (due
+    batches at the injected clock) or ``flush()`` (everything, now).
+
+    ``inject_fault_mode`` ("nan"/"stall") compiles the deterministic
+    fault-injection hook into the service's executables (tests only);
+    per-query ``fault_iter`` then selects the poisoned lanes, exactly as
+    ``run_table2_sweep(inject_fault=)`` does for the batch path."""
+
+    def __init__(self, store: Optional[SolutionStore] = None,
+                 capacity: int = 256, disk_path: Optional[str] = None,
+                 donor_cutoff: float = float("inf"),
+                 max_batch: int = 8, max_wait_s: float = 0.002,
+                 max_queue: int = 1024,
+                 ladder: Optional[Tuple[int, ...]] = None,
+                 retry: Optional[RetryPolicy] = None,
+                 inject_fault_mode: Optional[str] = None,
+                 clock=time.monotonic, start_worker: bool = True,
+                 metrics: Optional[ServeMetrics] = None):
+        self.store = (store if store is not None
+                      else SolutionStore(capacity=capacity,
+                                         disk_path=disk_path,
+                                         donor_cutoff=donor_cutoff))
+        self.metrics = metrics if metrics is not None else ServeMetrics()
+        self.batcher = MicroBatcher(max_batch=max_batch,
+                                    max_wait_s=max_wait_s,
+                                    max_queue=max_queue, ladder=ladder,
+                                    clock=clock)
+        self._retry = retry if retry is not None else RetryPolicy()
+        self._fault_mode = inject_fault_mode
+        self._clock = clock
+        self._closed = False
+        self._drain_on_close = True
+        self._launch_lock = threading.Lock()
+        # serializes submit's closed-check+enqueue against close's
+        # closed-set+drain, so a request can never slip into the batcher
+        # after the final drain (its future would hang forever)
+        self._gate = threading.Lock()
+        self._worker: Optional[threading.Thread] = None
+        if start_worker:
+            self._worker = threading.Thread(target=self._worker_loop,
+                                            name="equilibrium-serve",
+                                            daemon=True)
+            self._worker.start()
+
+    # -- client surface -----------------------------------------------------
+
+    def submit(self, q: EquilibriumQuery) -> Future:
+        """Enqueue one query; returns a future resolving to a
+        ``ServedResult`` (or raising ``EquilibriumSolveFailed`` /
+        ``Interrupted``).  Exact cache hits resolve before returning."""
+        if self._closed:
+            raise ServiceClosed("EquilibriumService is closed")
+        if q.fault_iter is not None and self._fault_mode is None:
+            raise ValueError(
+                "query carries fault_iter but the service was built "
+                "without inject_fault_mode")
+        t0 = self._clock()
+        fut: Future = Future()
+        if q.fault_iter is None:
+            sol = self.store.get(q.key())
+            if sol is not None:
+                res = _result_from_row(np.asarray(sol.packed), "hit",
+                                       None, q.key())
+                self.metrics.record_served("hit", self._clock() - t0)
+                fut.set_result(res)
+                return fut
+        # Enqueue under the gate: without it a close() between the
+        # closed-check above and the offer could run its final drain
+        # first, stranding this future.  The worker drains the batcher
+        # without taking the gate, so a blocking offer (full queue)
+        # cannot deadlock close().
+        with self._gate:
+            if self._closed:
+                raise ServiceClosed("EquilibriumService is closed")
+            self.batcher.offer((q.dtype, q.kwargs), _Pending(q, fut, t0),
+                               block=self._worker is not None)
+        self.metrics.note_queue_depth(self.batcher.depth())
+        return fut
+
+    def query(self, crra: float, labor_ar: float, labor_sd: float = 0.2,
+              dtype=None, timeout: Optional[float] = None,
+              **model_kwargs) -> ServedResult:
+        """Synchronous convenience: build the query, submit, wait.  In
+        manual (no-worker) mode pending batches are flushed immediately —
+        a lone synchronous caller must not wait out ``max_wait_s``."""
+        fut = self.submit(make_query(crra, labor_ar, labor_sd=labor_sd,
+                                     dtype=dtype, **model_kwargs))
+        if self._worker is None and not fut.done():
+            self.flush()
+        return fut.result(timeout)
+
+    # -- launch machinery ---------------------------------------------------
+
+    def _plan_seed(self, q: EquilibriumQuery, host) -> Tuple[tuple, str]:
+        """The lane's bracket seed and serving path: donor descent when
+        the store nominates one, the pseudo-cold seed otherwise."""
+        from ..parallel.sweep import dyadic_bracket
+
+        r_lo, r_hi, r_tol, max_levels = host
+        nom = self.store.nominate(q.cell(), q.group(),
+                                  float(r_hi) - float(r_lo), r_tol)
+        if nom is not None:
+            lo, hi, lev = dyadic_bracket(r_lo, r_hi, nom.target,
+                                         nom.margin, max_levels, q.dtype)
+            if lev > 0:
+                return (lo, hi, lev), "near"
+        return (r_lo, r_hi, 0), "cold"
+
+    def _launch(self, group, pendings) -> None:
+        """Solve one flushed batch: plan seeds, pad to the ladder shape,
+        launch the shared executable, scatter rows to futures.  Any
+        launch-level failure fails this batch's futures (typed), never
+        the service; ``Interrupted`` re-raises after failing them so the
+        worker can drain."""
+        import jax.numpy as jnp
+
+        from ..parallel.sweep import (
+            _batched_solver,
+            _host_bracket,
+            _host_r_tol,
+        )
+
+        dtype, kwargs_items = group
+        model_kwargs = dict(kwargs_items)
+        r_lo, r_hi = _host_bracket(model_kwargs, dtype)
+        r_tol = _host_r_tol(model_kwargs, dtype)
+        max_levels = max(0, int(model_kwargs.get("max_bisect", 60)) - 6)
+        host = (r_lo, r_hi, r_tol, max_levels)
+
+        plans = [self._plan_seed(p.query, host) for p in pendings]
+        n = len(pendings)
+        shape = self.batcher.pad_to(n)
+        lanes = list(range(n)) + [n - 1] * (shape - n)
+        cells = [pendings[i].query.cell() for i in lanes]
+        seeds = [plans[i][0] for i in lanes]
+        args = [jnp.asarray(np.asarray([c[0] for c in cells]), dtype=dtype),
+                jnp.asarray(np.asarray([c[1] for c in cells]), dtype=dtype),
+                jnp.asarray(np.asarray([c[2] for c in cells]), dtype=dtype),
+                jnp.asarray(np.asarray([s[0] for s in seeds]), dtype=dtype),
+                jnp.asarray(np.asarray([s[1] for s in seeds]), dtype=dtype),
+                jnp.asarray(np.asarray([s[2] for s in seeds],
+                                       dtype=np.int32))]
+        if self._fault_mode is not None:
+            fault = [(-1 if pendings[i].query.fault_iter is None
+                      else pendings[i].query.fault_iter) for i in lanes]
+            args.append(jnp.asarray(np.asarray(fault, dtype=np.int32)))
+        fn = _batched_solver(dtype, kwargs_items, self._fault_mode,
+                             warm=True)
+
+        try:
+            with self._launch_lock, self.metrics.compile:
+                packed = retry_transient(
+                    lambda: np.asarray(fn(*args)), self._retry,
+                    label=f"serve batch [{shape}]")
+        except BaseException as e:
+            for p in pendings:
+                if not p.future.done():
+                    p.future.set_exception(e)
+                self.metrics.record_failure(self._clock() - p.t_submit)
+            if isinstance(e, Interrupted):
+                raise
+            return
+
+        self.metrics.record_batch(n, shape)
+        now = self._clock()
+        for i, p in enumerate(pendings):
+            row = np.asarray(packed[i], dtype=np.float64)
+            status = int(np.rint(row[6]))
+            seed, path = plans[i]
+            if is_failure(status):
+                p.future.set_exception(EquilibriumSolveFailed(
+                    p.query.cell(), status, p.query.key()))
+                self.metrics.record_failure(now - p.t_submit)
+                continue
+            res = _result_from_row(row, path, seed, p.query.key())
+            if p.query.fault_iter is None:
+                self.store.put(make_solution(p.query.cell(), row,
+                                             p.query.group(),
+                                             p.query.key()))
+            p.future.set_result(res)
+            self.metrics.record_served(path, now - p.t_submit)
+
+    # -- pumping / lifecycle ------------------------------------------------
+
+    def pump(self, now: Optional[float] = None) -> int:
+        """Manual mode: launch the batches due at ``now`` (injected-clock
+        units).  Returns the number of batches launched.  Polls the
+        preemption flag first — at a requested shutdown, pending futures
+        fail with the typed ``Interrupted`` and it re-raises (the sweep's
+        seam protocol: callers see the typed exit, waiters are never left
+        hung)."""
+        return self._run_batches(self.batcher.pop_ready(now))
+
+    def flush(self) -> int:
+        """Launch everything queued regardless of deadlines."""
+        return self._run_batches(self.batcher.pop_all())
+
+    def _run_batches(self, batches) -> int:
+        """Launch a popped batch list under the seam protocol.  On a
+        shutdown request — the flag set before any launch, or an
+        ``Interrupted`` escaping a launch — EVERY popped-but-unlaunched
+        batch's futures AND everything still queued fail with the typed
+        exception before it re-raises: a batch popped out of the batcher
+        must never be silently abandoned (its waiters would hang)."""
+        remaining = list(batches)
+        count = 0
+        try:
+            if interrupt_requested():
+                raise Interrupted(
+                    "equilibrium service interrupted; pending queries "
+                    "failed at the batch seam")
+            while remaining:
+                group, pendings = remaining.pop(0)
+                self._launch(group, pendings)
+                count += 1
+        except Interrupted as e:
+            # _launch already failed its own batch's futures before
+            # re-raising; fail the popped-but-unlaunched ones, then the
+            # still-queued ones, and stop accepting queries
+            for _, pendings in remaining:
+                self._fail_futures(pendings, e)
+            self._fail_pending(e)
+            self._closed = True
+            raise
+        return count
+
+    def _fail_futures(self, pendings, exc: BaseException) -> None:
+        for p in pendings:
+            if not p.future.done():
+                p.future.set_exception(exc)
+            self.metrics.record_failure(self._clock() - p.t_submit)
+
+    def _fail_pending(self, exc: BaseException) -> None:
+        for _, pendings in self.batcher.pop_all():
+            self._fail_futures(pendings, exc)
+
+    def _worker_loop(self) -> None:
+        while True:
+            try:
+                self._run_batches(self.batcher.wait_ready(timeout=0.05))
+                if self._closed:
+                    if self._drain_on_close:
+                        self._run_batches(self.batcher.pop_all())
+                    else:
+                        self._fail_pending(
+                            ServiceClosed("service closed without drain"))
+                    return
+            except Interrupted:
+                # _run_batches already failed every pending future and
+                # closed the service at the seam
+                return
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Stop accepting queries; by default drain what is queued (every
+        pending future resolves), else fail pending with
+        ``ServiceClosed``.  Idempotent.  A ``submit`` racing ``close`` is
+        serialized by the gate: it either enqueues before the final drain
+        (and resolves) or observes the closed flag and raises."""
+        with self._gate:
+            self._drain_on_close = drain
+            self._closed = True
+        if self._worker is not None:
+            with self.batcher._cond:
+                self.batcher._cond.notify_all()
+            self._worker.join(timeout)
+            self._worker = None
+        elif drain and not interrupt_requested():
+            self.flush()
+        # belt-and-braces: nothing can be queued past the gate-serialized
+        # close, but a stray entry must fail typed, never hang
+        self._fail_pending(ServiceClosed("service closed"))
+
+    def __enter__(self) -> "EquilibriumService":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close(drain=exc_type is None)
+
+    # -- verification helper ------------------------------------------------
+
+    def reference_solve(self, q: EquilibriumQuery,
+                        bracket_init: Optional[tuple] = None):
+        """A direct single-cell solve through the SAME executable family
+        serving uses (batch-of-1 launch, no store, no batching): the
+        bit-identity contract's reference.  ``bracket_init=None`` solves
+        cold (the un-seeded executable — exactly
+        ``solve_equilibrium_lean`` with no ``bracket_init``); passing a
+        served result's ``bracket_init`` reproduces its bits."""
+        import jax.numpy as jnp
+
+        from ..parallel.sweep import _batched_solver
+
+        warm = bracket_init is not None
+        fn = _batched_solver(q.dtype, q.kwargs, None, warm)
+        args = [jnp.asarray([q.crra], dtype=q.dtype),
+                jnp.asarray([q.labor_ar], dtype=q.dtype),
+                jnp.asarray([q.labor_sd], dtype=q.dtype)]
+        if warm:
+            args += [jnp.asarray([bracket_init[0]], dtype=q.dtype),
+                     jnp.asarray([bracket_init[1]], dtype=q.dtype),
+                     jnp.asarray([bracket_init[2]], dtype=np.int32)]
+        row = np.asarray(fn(*args), dtype=np.float64)[0]
+        return _result_from_row(row, "reference", bracket_init, q.key())

@@ -1,0 +1,255 @@
+"""Content-addressed equilibrium solution store: LRU memory + disk tier.
+
+The serving cache (DESIGN §8).  A solution is addressed by its
+``utils.fingerprint.solution_fingerprint`` — the solver configuration
+(kwargs + dtype) plus the calibration cell — so two queries collide iff
+every input that can move a bit of the answer matches.  Entries within one
+*solver group* (``work_fingerprint``: same kwargs + dtype, any cell) also
+serve as **warm-start donors**: ``nominate`` picks the nearest solved
+neighbor in normalized (σ, ρ, sd) space and proposes a (target, margin)
+pair for the service's dyadic bracket descent — the same donor rule the
+sweep scheduler applies across buckets (``parallel.sweep._neighbor_seed``),
+pointed at the store instead of the in-flight batch.
+
+Tiers:
+
+* **memory** — a bounded LRU of full entries (the hot set; an exact hit
+  is a dict lookup, no device, no disk).
+* **disk** (optional) — one tiny npz per entry under ``disk_path``,
+  written with ``utils.checkpoint.save_pytree`` (tmp + ``os.replace``;
+  the atomic-write lint covers this package).  Evicted memory entries
+  stay on disk; a process restart reloads the index and serves stored
+  calibrations without re-solving.
+
+Failed solutions (``solver_health.is_failure``) are never stored — a
+quarantine-grade status must not become a cache hit, and a NaN root must
+never be nominated as a donor (the sidecar's NaN-row rule)."""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+import warnings
+from collections import OrderedDict
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from ..solver_health import is_failure
+from ..utils.checkpoint import CORRUPT_NPZ_ERRORS, load_pytree, save_pytree
+
+
+class StoredSolution(NamedTuple):
+    """One cached equilibrium, npz-able as a pytree (disk tier).
+
+    ``packed`` is the batched solver's device row
+    ``[r_star, K, L, bisect, egm, dist, status]`` in float64 — float64
+    round-trips npz bit-exactly and holds every narrower compute dtype
+    exactly, so a reload serves the original bits."""
+
+    cell: np.ndarray    # [3] (σ, ρ, sd) float64
+    packed: np.ndarray  # [7] float64
+    group: np.ndarray   # scalar int64 — work_fingerprint (solver config)
+    key: np.ndarray     # scalar int64 — solution_fingerprint (full address)
+
+
+def _template() -> StoredSolution:
+    return StoredSolution(cell=np.zeros(3), packed=np.zeros(7),
+                          group=np.zeros((), np.int64),
+                          key=np.zeros((), np.int64))
+
+
+def make_solution(cell, packed, group: int, key: int) -> StoredSolution:
+    return StoredSolution(
+        cell=np.asarray(cell, dtype=np.float64),
+        packed=np.asarray(packed, dtype=np.float64),
+        group=np.asarray(group, np.int64),
+        key=np.asarray(key, np.int64))
+
+
+class Donation(NamedTuple):
+    """A nominated warm-start seed: descend toward ``target`` keeping a
+    ``margin`` safety ball (the ``dyadic_bracket`` inputs)."""
+
+    target: float
+    margin: float
+    donor_key: int
+
+
+class _Meta(NamedTuple):
+    """Host-side index row kept for every known entry (memory or disk):
+    what donor nomination needs without touching the entry itself."""
+
+    cell: tuple
+    group: int
+    r_star: float
+    on_disk: bool
+
+
+class SolutionStore:
+    """Bounded LRU of ``StoredSolution`` with an optional disk tier.
+
+    Thread-safe (one lock; every operation is O(small)).  ``capacity``
+    bounds the in-memory entries only; with a disk tier, evicted entries
+    remain addressable (a ``get`` promotes them back), and the index of
+    disk entries — a few dozen bytes each — is kept in memory for donor
+    nomination."""
+
+    def __init__(self, capacity: int = 256,
+                 disk_path: Optional[str] = None,
+                 donor_cutoff: float = float("inf")):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.disk_path = disk_path
+        # normalized-distance radius beyond which nominate() declines: a
+        # donor across the whole lattice proposes a junk target (safe —
+        # in-program verification falls back to cold — but an honest
+        # "cold" classification beats a doomed descent).  inf = always
+        # nominate, the sweep scheduler's behavior.
+        self.donor_cutoff = float(donor_cutoff)
+        self._lock = threading.RLock()
+        self._mem: OrderedDict = OrderedDict()   # key -> StoredSolution
+        self._meta: dict = {}                    # key -> _Meta
+        if disk_path is not None:
+            os.makedirs(disk_path, exist_ok=True)
+            self._load_disk_index()
+
+    # -- tiers --------------------------------------------------------------
+
+    def _file(self, key: int) -> str:
+        # keys are signed int64; hex-encode the two's-complement bits so
+        # the filename is stable and glob-able
+        return os.path.join(self.disk_path,
+                            f"sol_{int(key) & 0xFFFFFFFFFFFFFFFF:016x}.npz")
+
+    def _load_disk_index(self) -> None:
+        """Rebuild the index from the disk tier (process restart).  A
+        corrupt entry file is skipped with a warning — the store must
+        degrade to re-solving, never refuse to start."""
+        for path in sorted(glob.glob(os.path.join(self.disk_path,
+                                                  "sol_*.npz"))):
+            try:
+                sol = load_pytree(path, _template())
+            except CORRUPT_NPZ_ERRORS as e:
+                warnings.warn(f"solution store: skipping unreadable entry "
+                              f"{path} ({e})", stacklevel=2)
+                continue
+            self._meta[int(sol.key)] = _Meta(
+                cell=tuple(np.asarray(sol.cell, dtype=np.float64)),
+                group=int(sol.group),
+                r_star=float(sol.packed[0]), on_disk=True)
+
+    # -- core ops -----------------------------------------------------------
+
+    def get(self, key: int) -> Optional[StoredSolution]:
+        """Exact lookup; promotes to most-recently-used.  A disk-resident
+        entry is loaded and promoted into memory (evicting LRU)."""
+        key = int(key)
+        with self._lock:
+            sol = self._mem.get(key)
+            if sol is not None:
+                self._mem.move_to_end(key)
+                return sol
+            meta = self._meta.get(key)
+            if meta is None or not meta.on_disk:
+                return None
+            try:
+                sol = load_pytree(self._file(key), _template())
+            except CORRUPT_NPZ_ERRORS as e:
+                warnings.warn(f"solution store: entry {key} unreadable on "
+                              f"disk ({e}); dropping it", stacklevel=2)
+                del self._meta[key]
+                return None
+            self._insert(key, sol)
+            return sol
+
+    def put(self, sol: StoredSolution) -> None:
+        """Insert (or refresh) one solution.  Failed statuses are refused
+        loudly — caching an uncertified result is a caller bug."""
+        status = int(np.rint(sol.packed[6]))
+        if is_failure(status):
+            raise ValueError(
+                f"refusing to store a failed solution (status={status}); "
+                "failures raise on their future, they are never cached")
+        key = int(sol.key)
+        with self._lock:
+            on_disk = False
+            if self.disk_path is not None:
+                try:
+                    save_pytree(self._file(key), sol)
+                    on_disk = True
+                except OSError as e:
+                    warnings.warn(f"solution store: could not persist entry "
+                                  f"{key}: {e}", stacklevel=2)
+            prior = self._meta.get(key)
+            if prior is not None and prior.on_disk:
+                on_disk = True
+            self._meta[key] = _Meta(
+                cell=tuple(np.asarray(sol.cell, dtype=np.float64)),
+                group=int(sol.group),
+                r_star=float(sol.packed[0]), on_disk=on_disk)
+            self._insert(key, sol)
+
+    def _insert(self, key: int, sol: StoredSolution) -> None:
+        self._mem[key] = sol
+        self._mem.move_to_end(key)
+        while len(self._mem) > self.capacity:
+            old_key, _ = self._mem.popitem(last=False)
+            meta = self._meta.get(old_key)
+            if meta is not None and not meta.on_disk:
+                # memory-only tier: eviction forgets the entry entirely
+                # (bounded memory is the contract); with a disk tier the
+                # index row stays so the entry remains addressable
+                del self._meta[old_key]
+
+    # -- donor nomination ---------------------------------------------------
+
+    def nominate(self, cell, group: int, width: float,
+                 r_tol: float) -> Optional[Donation]:
+        """Warm-start donor for ``cell`` within solver group ``group``:
+        target = nearest stored root in normalized (σ, ρ, sd) space,
+        margin = the r*-spread between the two nearest donors (how far the
+        root plausibly moved), floored defensively — LITERALLY the sweep
+        scheduler's neighbor rule (``parallel.sweep.neighbor_distance`` /
+        ``donor_margin``, one shared implementation) pointed at the store.
+        ``width`` is the economic bracket width and ``r_tol`` the
+        bisection tolerance of the *querying* configuration.  None when
+        the group holds no donors (or none inside ``donor_cutoff``)."""
+        from ..parallel.sweep import donor_margin, neighbor_distance
+
+        with self._lock:
+            rows = [(k, m) for k, m in self._meta.items()
+                    if m.group == int(group) and np.isfinite(m.r_star)]
+        if not rows:
+            return None
+        d = neighbor_distance(cell, np.asarray([m.cell for _, m in rows]))
+        order = np.argsort(d, kind="stable")
+        if float(d[order[0]]) > self.donor_cutoff:
+            return None
+        k0, m0 = rows[int(order[0])]
+        target = float(m0.r_star)
+        spread = (abs(target - float(rows[int(order[1])][1].r_star))
+                  if len(rows) > 1 else None)
+        return Donation(target=target,
+                        margin=donor_margin(spread, width, r_tol),
+                        donor_key=int(k0))
+
+    # -- introspection ------------------------------------------------------
+
+    def __len__(self) -> int:
+        """In-memory (LRU-bounded) entry count."""
+        with self._lock:
+            return len(self._mem)
+
+    def known(self) -> int:
+        """Addressable entries across both tiers."""
+        with self._lock:
+            return len(self._meta)
+
+    def mem_keys(self) -> list:
+        """Memory-tier keys in LRU order (oldest first) — test hook for
+        the eviction-order contract."""
+        with self._lock:
+            return list(self._mem.keys())

@@ -1,0 +1,119 @@
+"""One fingerprint vocabulary for every cache key in the framework.
+
+Four subsystems key durable state by "a hash of the configuration that
+produced it": the sweep scheduler's warm-start sidecar
+(``checkpoint.SweepSidecar``), the preemption ledger
+(``resilience.SweepLedger``), the KS checkpoint stale-resume guard, and —
+new with the serving subsystem — the content-addressed
+``serve.SolutionStore``.  They used to each assemble their key inline from
+the shared ``config_fingerprint`` primitive, which is exactly how cache
+keys drift: two call sites disagree about whether dtype is hashed as
+``str(np.dtype(d))`` or ``repr(d)`` and a sidecar written by one subsystem
+silently never matches in another.  This module owns the primitive AND the
+per-subsystem key builders, so the encoding decisions live (and are
+tested) in one place.
+
+Layering: pure host-side (hashlib/json/numpy), imported by
+``utils.checkpoint``, ``utils.resilience``, ``parallel.sweep`` and
+``serve`` — it must not import any of them back.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+
+import numpy as np
+
+
+def config_fingerprint(*objs) -> int:
+    """Deterministic int64 fingerprint of configs/arrays, used to detect
+    state written under a different setup (stale-resume guard, cache
+    keys).  Dataclasses hash their sorted field dict, arrays their
+    dtype/shape/bytes, everything else its ``repr``."""
+    parts = []
+    for o in objs:
+        if o is None:
+            parts.append("none")
+        elif dataclasses.is_dataclass(o) and not isinstance(o, type):
+            parts.append(json.dumps(dataclasses.asdict(o), sort_keys=True,
+                                    default=repr))
+        elif isinstance(o, np.ndarray) or hasattr(o, "__array__"):
+            a = np.asarray(o)
+            parts.append(f"{a.dtype}{a.shape}"
+                         + hashlib.md5(a.tobytes()).hexdigest())
+        else:
+            parts.append(repr(o))
+    digest = hashlib.md5("|".join(parts).encode()).digest()
+    return int.from_bytes(digest[:8], "little", signed=True)
+
+
+def hashable_kwargs(model_kwargs: dict) -> tuple:
+    """Normalize solver kwargs into a canonical, hashable, SORTED items
+    tuple — the one spelling every fingerprint below hashes, and the
+    ``lru_cache`` key of the batched solver.  Sequences become tuples;
+    anything still unhashable gets a clear error instead of ``lru_cache``'s
+    bare TypeError.  Sorting makes the fingerprints insensitive to the
+    caller's keyword order."""
+    items = []
+    for k, v in sorted(model_kwargs.items()):
+        if isinstance(v, (list, np.ndarray)):
+            arr = np.asarray(v)
+            if arr.ndim > 1:
+                raise TypeError(
+                    f"sweep kwarg {k!r} has shape {arr.shape}; only scalars "
+                    "and 1-D sequences can be forwarded to the cell solver")
+            v = tuple(arr.tolist())
+        try:
+            hash(v)
+        except TypeError:
+            raise TypeError(
+                f"sweep kwarg {k!r}={v!r} is not hashable; pass scalars or "
+                "tuples (grids are rebuilt per cell from scalar settings)"
+            ) from None
+        items.append((k, v))
+    return tuple(items)
+
+
+def work_fingerprint(kwargs_items: tuple, dtype) -> int:
+    """Solver-configuration key: the method choices, tolerances, and grid
+    sizes that shape a cell's counters and root, plus the dtype.  Cell
+    triples are NOT part of the key — rows/entries are matched per cell.
+
+    Shared verbatim by the sweep sidecar (``checkpoint.SweepSidecar``) and
+    the serving store's donor groups (``serve.SolutionStore``): a sidecar
+    and a store entry written under the same solver configuration MUST
+    carry the same group key, or warm starts silently stop flowing between
+    the batch and serving paths."""
+    return config_fingerprint(str(np.dtype(dtype)), repr(kwargs_items))
+
+
+def solution_fingerprint(crra, labor_ar, labor_sd, kwargs_items: tuple,
+                         dtype) -> int:
+    """Content address of ONE equilibrium solution: the solver group
+    (``work_fingerprint`` inputs) plus the calibration cell.  The serving
+    store's exact-hit key — two queries collide iff every input that can
+    move a bit of the answer matches."""
+    return config_fingerprint(
+        str(np.dtype(dtype)), repr(kwargs_items),
+        float(crra), float(labor_ar), float(labor_sd))
+
+
+def ledger_fingerprint(crra, rho, sd, kwargs_items: tuple, dtype,
+                       schedule: str, n_buckets: int, warm_brackets: bool,
+                       warm_margin: float, fault_mode, fault_iters,
+                       max_retries: int, quarantine: bool,
+                       sidecar) -> int:
+    """Validity key of the sweep resume ledger (``resilience.SweepLedger``):
+    everything that shapes the result bits — cells (perturb included),
+    solver kwargs, dtype, schedule knobs, fault injection, and the
+    warm-start sidecar's CONTENT (seeds read it live, so a sidecar swapped
+    between interrupt and resume would silently change trajectories)."""
+    return config_fingerprint(
+        crra, rho, sd, repr(kwargs_items), str(np.dtype(dtype)),
+        schedule, int(n_buckets), bool(warm_brackets),
+        float(warm_margin), str(fault_mode),
+        "none" if fault_iters is None else fault_iters,
+        int(max_retries), bool(quarantine),
+        *(tuple(sidecar) if sidecar is not None else ("no-sidecar",)))

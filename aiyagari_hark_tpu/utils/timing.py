@@ -14,7 +14,7 @@ import dataclasses
 import json
 import time
 from collections import defaultdict
-from typing import Dict, Iterable
+from typing import Dict, Iterable, NamedTuple
 
 
 class PhaseTimer:
@@ -95,46 +95,76 @@ def model_flops(egm_iters: float, dist_iters: float, a_count: int,
     return egm + dist_iters * per_dist
 
 
-def peak_flops_per_chip(backend: str) -> float | None:
-    """Nominal peak FLOP/s of one chip for the MFU denominator.
+class PeakFlops(NamedTuple):
+    """The MFU denominator and its provenance: ``assumed=True`` means the
+    chip kind was not recognized and ``value`` is a class GUESS — record
+    it as ``peak_flops_assumed``, never pass it off as measured."""
+
+    value: float | None
+    assumed: bool
+
+
+_ASSUMED_PEAK_WARNED: set = set()
+
+
+def peak_flops_per_chip(backend: str) -> PeakFlops:
+    """Nominal peak FLOP/s of one chip for the MFU denominator, with an
+    ``assumed`` flag for unrecognized accelerators.
 
     TPU v5-lite (v5e): 197e12 bf16 MXU peak — the honest ceiling even
     though this framework runs f32 matmuls at ``precision=HIGHEST`` (which
     costs multiple bf16 passes), because MFU is about how much of the
     silicon the problem could engage.  CPU gets no MFU (no meaningful
-    single-number peak for this host).
+    single-number peak for this host).  An UNKNOWN TPU kind used to get
+    197e12 silently — an MFU built on a guessed denominator read exactly
+    like a measured one; now the guess warns once per kind and callers
+    must surface ``assumed`` in their records (``peak_flops_assumed``,
+    bench/serve — ISSUE 4 satellite).
     """
     if backend not in ("tpu", "axon"):
-        return None
+        return PeakFlops(None, False)
     try:
         import jax
         kind = jax.devices()[0].device_kind.lower()
     except Exception:   # noqa: BLE001 — device query is best-effort
         kind = ""
     if "v5 lite" in kind or "v5e" in kind or "v5lite" in kind:
-        return 197e12
+        return PeakFlops(197e12, False)
     if "v4" in kind:
-        return 275e12
+        return PeakFlops(275e12, False)
     if "v5p" in kind or "v5" in kind:
-        return 459e12
-    return 197e12   # unknown TPU: assume the v5e class this repo targets
+        return PeakFlops(459e12, False)
+    # unknown TPU: assume the v5e class this repo targets, loudly
+    if kind not in _ASSUMED_PEAK_WARNED:
+        _ASSUMED_PEAK_WARNED.add(kind)
+        import warnings
+
+        warnings.warn(
+            f"unrecognized TPU device kind {kind!r}: assuming the v5e "
+            "peak (197e12 FLOP/s) for MFU — treat mfu_pct as approximate "
+            "(peak_flops_assumed=True in records)", stacklevel=2)
+    return PeakFlops(197e12, True)
 
 
 def flop_report(egm_iters: float, dist_iters: float, wall_s: float,
                 a_count: int, n_states: int, d_count: int,
                 dense_dist: bool, backend: str) -> dict:
     """Achieved FLOP rate + MFU for one measured phase, as record fields:
-    ``{"flops_per_sec": ..., "mfu_pct": ...}`` (mfu None off-accelerator).
-    Never raises on a degenerate wall — a broken phase records nulls, not
-    a crashed bench."""
+    ``{"flops_per_sec": ..., "mfu_pct": ..., "peak_flops_assumed": ...}``
+    (mfu None off-accelerator; ``peak_flops_assumed`` True when the MFU
+    denominator is the unknown-chip class guess).  Never raises on a
+    degenerate wall — a broken phase records nulls, not a crashed
+    bench."""
     if wall_s is None or not wall_s > 0:
-        return {"flops_per_sec": None, "mfu_pct": None}
+        return {"flops_per_sec": None, "mfu_pct": None,
+                "peak_flops_assumed": False}
     flops = model_flops(egm_iters, dist_iters, a_count, n_states, d_count,
                         dense_dist)
     peak = peak_flops_per_chip(backend)
     return {"flops_per_sec": round(flops / wall_s),
-            "mfu_pct": (None if peak is None
-                        else round(100.0 * flops / wall_s / peak, 4))}
+            "mfu_pct": (None if peak.value is None
+                        else round(100.0 * flops / wall_s / peak.value, 4)),
+            "peak_flops_assumed": peak.assumed}
 
 
 # -- XLA compile counting (jax.monitoring) ----------------------------------

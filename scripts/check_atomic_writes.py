@@ -28,7 +28,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # Scope: the installable package plus the two entry points.  scripts/ and
 # tests/ are out of scope — they write developer-local files whose loss is
-# a re-run, not a poisoned committed artifact.
+# a re-run, not a poisoned committed artifact.  The package walk is
+# recursive, so every subpackage — including ``serve/``, whose on-disk
+# solution-store tier MUST go through the blessed atomic writers (a torn
+# store entry would be served as a cached equilibrium) — is in scope
+# automatically; ``tests/test_checkpoint_tools.py`` pins that coverage.
 SCAN_ROOTS = ("aiyagari_hark_tpu",)
 SCAN_FILES = ("bench.py", "reproduce.py")
 
@@ -79,9 +83,10 @@ def scan_file(path: str, rel: str) -> list:
     return findings
 
 
-def scan(repo: str = REPO) -> list:
-    """All findings as (relpath, lineno, message) triples."""
-    findings = []
+def scan_targets(repo: str = REPO) -> list:
+    """Every file the lint covers, as absolute paths — exposed so the
+    lint's own test can assert coverage (e.g. that ``serve/`` is in
+    scope) instead of trusting the walk silently."""
     targets = []
     for root in SCAN_ROOTS:
         for dirpath, _, names in os.walk(os.path.join(repo, root)):
@@ -90,7 +95,13 @@ def scan(repo: str = REPO) -> list:
             targets += [os.path.join(dirpath, n) for n in sorted(names)
                         if n.endswith(".py")]
     targets += [os.path.join(repo, f) for f in SCAN_FILES]
-    for path in targets:
+    return targets
+
+
+def scan(repo: str = REPO) -> list:
+    """All findings as (relpath, lineno, message) triples."""
+    findings = []
+    for path in scan_targets(repo):
         if os.path.exists(path):
             findings += scan_file(path, os.path.relpath(path, repo))
     return findings

@@ -139,10 +139,7 @@ def test_gc_ignores_non_writer_files(tmp_path):
 # -- the static atomic-writes lint (tier-1 hook) ----------------------------
 
 
-def test_check_atomic_writes_lint_is_clean():
-    """The package and entry points contain no bare write-mode open() /
-    np.savez on artifact paths outside the blessed atomic writers — run
-    here so a regression fails tier-1, not a code review."""
+def _load_lint():
     import importlib.util
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -151,9 +148,53 @@ def test_check_atomic_writes_lint_is_clean():
         os.path.join(repo, "scripts", "check_atomic_writes.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
+    return mod, repo
+
+
+def test_check_atomic_writes_lint_is_clean():
+    """The package and entry points contain no bare write-mode open() /
+    np.savez on artifact paths outside the blessed atomic writers — run
+    here so a regression fails tier-1, not a code review."""
+    mod, repo = _load_lint()
     findings = mod.scan(repo)
     assert findings == [], "\n".join(
         f"{rel}:{line}: {msg}" for rel, line, msg in findings)
+
+
+def test_check_atomic_writes_covers_serve_package():
+    """ISSUE 4 satellite: the serving subsystem's on-disk store tier must
+    be inside the lint's scope — pin the walk's coverage instead of
+    trusting it silently."""
+    mod, repo = _load_lint()
+    rels = {os.path.relpath(t, repo).replace(os.sep, "/")
+            for t in mod.scan_targets(repo)}
+    for required in ("aiyagari_hark_tpu/serve/store.py",
+                     "aiyagari_hark_tpu/serve/service.py",
+                     "aiyagari_hark_tpu/serve/batcher.py",
+                     "aiyagari_hark_tpu/serve/metrics.py",
+                     "aiyagari_hark_tpu/utils/checkpoint.py",
+                     "bench.py"):
+        assert required in rels, required
+
+
+def test_check_atomic_writes_scan_fires_on_bare_write_in_serve(tmp_path):
+    """End-to-end through the directory walk: a deliberately bare
+    ``open(..., "w")`` dropped into a fake repo's ``serve/`` package is a
+    finding (and a waived line is not)."""
+    mod, _ = _load_lint()
+    pkg = tmp_path / "aiyagari_hark_tpu" / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "bad_store.py").write_text(
+        'def persist(path, text):\n'
+        '    with open(path, "w") as f:\n'
+        '        f.write(text)\n'
+        'def waived(path, text):\n'
+        '    with open(path, "w") as f:  # atomic-ok\n'
+        '        f.write(text)\n')
+    findings = mod.scan(str(tmp_path))
+    assert [(rel.replace(os.sep, "/"), line)
+            for rel, line, _ in findings] == [
+        ("aiyagari_hark_tpu/serve/bad_store.py", 2)]
 
 
 def test_check_atomic_writes_lint_catches_bare_write(tmp_path):

@@ -1,0 +1,113 @@
+"""utils.fingerprint (ISSUE 4 satellite): the consolidated cache-key
+vocabulary — roundtrip determinism, sensitivity, and the no-drift
+contract between the subsystems that share keys."""
+
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.utils.fingerprint import (
+    config_fingerprint,
+    hashable_kwargs,
+    ledger_fingerprint,
+    solution_fingerprint,
+    work_fingerprint,
+)
+
+KW = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-4,
+          max_bisect=16)
+
+
+def test_config_fingerprint_deterministic_and_sensitive():
+    a = np.arange(6, dtype=np.float64)
+    assert config_fingerprint(a, "x", 3) == config_fingerprint(a, "x", 3)
+    assert config_fingerprint(a, "x", 3) != config_fingerprint(a, "x", 4)
+    assert config_fingerprint(a) != config_fingerprint(a.astype(np.float32))
+    assert config_fingerprint(None) != config_fingerprint("none-ish")
+
+
+def test_hashable_kwargs_canonical_order_and_sequences():
+    items = hashable_kwargs(dict(b=2, a=1))
+    assert items == (("a", 1), ("b", 2))
+    assert hashable_kwargs(dict(a=1, b=2)) == items
+    seq = hashable_kwargs(dict(g=[1.0, 2.0]))
+    assert seq == (("g", (1.0, 2.0)),)
+    with pytest.raises(TypeError):
+        hashable_kwargs(dict(bad={"not": "hashable"}))
+    with pytest.raises(TypeError):
+        hashable_kwargs(dict(bad=np.zeros((2, 2))))
+
+
+def test_work_fingerprint_roundtrip_and_dtype_alias():
+    items = hashable_kwargs(KW)
+    fp = work_fingerprint(items, np.float64)
+    assert work_fingerprint(items, None) == fp        # np.dtype(None)=f64
+    assert work_fingerprint(items, "float64") == fp
+    assert work_fingerprint(items, np.float32) != fp
+    other = hashable_kwargs({**KW, "a_count": 11})
+    assert work_fingerprint(other, np.float64) != fp
+
+
+def test_work_fingerprint_matches_sweep_sidecar_key():
+    """The no-drift contract: the sweep's sidecar key and the serving
+    store's group key are the SAME function — a sidecar written by the
+    batch path must address the same solver group serving reads."""
+    from aiyagari_hark_tpu.parallel import sweep
+
+    assert sweep._work_fingerprint is work_fingerprint
+    assert sweep._hashable_kwargs is hashable_kwargs
+    from aiyagari_hark_tpu.utils import checkpoint
+
+    assert checkpoint.config_fingerprint is config_fingerprint
+
+
+def test_solution_fingerprint_covers_cell_and_config():
+    items = hashable_kwargs(KW)
+    fp = solution_fingerprint(3.0, 0.6, 0.2, items, np.float64)
+    assert solution_fingerprint(3.0, 0.6, 0.2, items, np.float64) == fp
+    assert solution_fingerprint(3.0, 0.6, 0.2, items, None) == fp
+    distinct = {
+        solution_fingerprint(3.1, 0.6, 0.2, items, np.float64),
+        solution_fingerprint(3.0, 0.7, 0.2, items, np.float64),
+        solution_fingerprint(3.0, 0.6, 0.3, items, np.float64),
+        solution_fingerprint(3.0, 0.6, 0.2, items, np.float32),
+        solution_fingerprint(3.0, 0.6, 0.2,
+                             hashable_kwargs({**KW, "r_tol": 2e-4}),
+                             np.float64),
+    }
+    assert fp not in distinct and len(distinct) == 5
+
+
+def test_ledger_fingerprint_sensitivity():
+    crra = np.asarray([1.0, 3.0])
+    rho = np.asarray([0.3, 0.6])
+    sd = np.asarray([0.2, 0.2])
+    items = hashable_kwargs(KW)
+
+    def fp(**over):
+        kw = dict(crra=crra, rho=rho, sd=sd, kwargs_items=items,
+                  dtype=np.float64, schedule="balanced", n_buckets=0,
+                  warm_brackets=False, warm_margin=0.0, fault_mode=None,
+                  fault_iters=None, max_retries=3, quarantine=True,
+                  sidecar=None)
+        kw.update(over)
+        return ledger_fingerprint(**kw)
+
+    base = fp()
+    assert fp() == base
+    assert fp(schedule="locked") != base
+    assert fp(warm_brackets=True) != base
+    assert fp(rho=rho + 1e-6) != base                  # perturb included
+    assert fp(fault_iters=np.asarray([0, -1])) != base
+    # the sidecar's CONTENT is part of the key (a swapped sidecar between
+    # interrupt and resume must invalidate the ledger)
+    from aiyagari_hark_tpu.utils.checkpoint import SweepSidecar
+
+    side = SweepSidecar(
+        cells=np.asarray([[1.0, 0.3, 0.2]]), r_star=np.asarray([0.04]),
+        bisect_iters=np.asarray([11]), egm_iters=np.asarray([500]),
+        dist_iters=np.asarray([4000]), status=np.asarray([0]),
+        fingerprint=np.asarray(1, np.int64))
+    with_side = fp(sidecar=side)
+    assert with_side != base
+    side2 = side._replace(r_star=np.asarray([0.05]))
+    assert fp(sidecar=side2) != with_side

@@ -1,0 +1,417 @@
+"""Equilibrium serving subsystem (ISSUE 4): the bit-identity property
+test, failure isolation, deterministic batching, drain semantics, and the
+threaded soak.
+
+The load-bearing contract mirrors PR 2's scheduler parity: a served
+result is bit-identical to a direct single-cell launch of the same
+executable family with the same bracket seed, regardless of batch
+packing, padding, or which other requests shared the launch — and a
+failed (NONFINITE) cell raises a typed error on its own future without
+poisoning batchmates."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.serve import (
+    EquilibriumService,
+    EquilibriumSolveFailed,
+    MicroBatcher,
+    ServeQueueFull,
+    ServiceClosed,
+    default_ladder,
+    make_query,
+)
+from aiyagari_hark_tpu.solver_health import NONFINITE, is_failure
+from aiyagari_hark_tpu.utils.resilience import (
+    Interrupted,
+    clear_interrupt,
+    request_interrupt,
+)
+
+# The same tiny-cell configuration as tests/test_bench_smoke.py, so the
+# suite shares compiled executables instead of paying fresh XLA compiles
+# per file.
+KW = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-4,
+          max_bisect=16)
+
+
+class FakeClock:
+    """Deterministic injected clock for the deadline machinery."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def manual_service(**over):
+    kw = dict(start_worker=False, max_batch=4, max_wait_s=60.0,
+              ladder=(1, 2, 4))
+    kw.update(over)
+    return EquilibriumService(**kw)
+
+
+def assert_rows_equal(a, b):
+    """Full bit equality of two served/reference results' value fields."""
+    assert (a.r_star, a.capital, a.labor) == (b.r_star, b.capital, b.labor)
+    assert (a.bisect_iters, a.egm_iters, a.dist_iters) == (
+        b.bisect_iters, b.egm_iters, b.dist_iters)
+    assert a.status == b.status
+
+
+# ---------------------------------------------------------------------------
+# Bit-identity property test (the acceptance contract).
+# ---------------------------------------------------------------------------
+
+def test_mixed_batch_bit_identity():
+    """One launch holding a near-hit warm lane, a cold lane, and padding,
+    plus an exact hit served at submit: every request's result equals the
+    direct single-cell solve with the same seed, bit for bit."""
+    svc = manual_service(donor_cutoff=0.5)
+    qa = make_query(3.0, 0.6, **KW)
+    ra = svc.query(3.0, 0.6, **KW)           # seeds the store (cold)
+    assert ra.path == "cold"
+
+    # exact hit: resolves at submit, no launch, bits are the stored ones
+    fhit = svc.submit(make_query(3.0, 0.6, **KW))
+    assert fhit.done()
+    assert_rows_equal(fhit.result(), ra)
+    assert fhit.result().path == "hit"
+
+    # mixed flush: two near neighbors + one far cold, 3 real lanes padded
+    # to ladder shape 4
+    fb = svc.submit(make_query(3.0, 0.65, **KW))    # near (donor: qa)
+    fc = svc.submit(make_query(1.0, 0.0, **KW))     # far -> cold
+    fd = svc.submit(make_query(3.0, 0.55, **KW))    # near
+    assert svc.flush() == 1                         # ONE shared launch
+    rb, rc, rd = fb.result(0), fc.result(0), fd.result(0)
+    assert rb.path == "near" and rd.path == "near"
+    assert rc.path == "cold"
+    assert rb.bracket_init[2] > 0 and rc.bracket_init[2] == 0
+
+    # the contract: same executable family, batch of 1, same seed ->
+    # identical bits for every field, for every lane of the mixed batch
+    for res, q in ((ra, qa),
+                   (rb, make_query(3.0, 0.65, **KW)),
+                   (rc, make_query(1.0, 0.0, **KW)),
+                   (rd, make_query(3.0, 0.55, **KW))):
+        ref = svc.reference_solve(q, bracket_init=res.bracket_init)
+        assert_rows_equal(res, ref)
+
+    # a pseudo-cold lane replays the exact cold trajectory: equilibrium
+    # values match the bare cold program bit-for-bit; only the work
+    # counters carry the two verification solves
+    cold_ref = svc.reference_solve(make_query(1.0, 0.0, **KW))
+    assert (rc.r_star, rc.capital, rc.labor, rc.status) == (
+        cold_ref.r_star, cold_ref.capital, cold_ref.labor, cold_ref.status)
+    assert rc.bisect_iters == cold_ref.bisect_iters + 2
+    svc.close()
+
+
+def test_served_bits_vs_eager_direct_call():
+    """Against the un-vmapped eager ``solve_equilibrium_lean``: the root,
+    labor, counters, and status are bit-identical; ``capital`` — the one
+    cross-lane reduction — agrees to summation-order noise (DESIGN §8)."""
+    from aiyagari_hark_tpu.models.equilibrium import solve_calibration_lean
+
+    svc = manual_service()
+    res = svc.query(3.0, 0.6, **KW)
+    d = solve_calibration_lean(3.0, 0.6, labor_sd=0.2,
+                               bracket_init=res.bracket_init, **KW)
+    assert res.r_star == float(d.r_star)
+    assert res.labor == float(d.labor)
+    assert res.bisect_iters == int(d.bisect_iters)
+    assert res.egm_iters == int(d.egm_iters)
+    assert res.dist_iters == int(d.dist_iters)
+    assert res.status == int(d.status)
+    assert abs(res.capital - float(d.capital)) <= 1e-9 * abs(res.capital)
+    svc.close()
+
+
+def test_nonfinite_cell_fails_its_future_not_the_batch():
+    """Deterministic fault injection: the poisoned lane's future raises
+    the typed ``EquilibriumSolveFailed``; batchmates' bits equal the
+    fault-free direct solves; the failure is never cached."""
+    svc = manual_service(inject_fault_mode="nan")
+    qa = make_query(3.0, 0.6, **KW)
+    qf = make_query(1.0, 0.3, fault_iter=0, **KW)
+    qc = make_query(5.0, 0.9, **KW)
+    fa, ff, fc = svc.submit(qa), svc.submit(qf), svc.submit(qc)
+    assert svc.flush() == 1                         # one shared launch
+    with pytest.raises(EquilibriumSolveFailed) as exc:
+        ff.result(0)
+    assert exc.value.status == NONFINITE
+    assert is_failure(exc.value.status)
+    # the failed calibration never became a cache entry (and a healthy
+    # same-cell query later would still solve, not hit garbage)
+    assert svc.store.get(make_query(1.0, 0.3, **KW).key()) is None
+    # batchmates: bit-identical to the fault-free reference solves
+    for fut, q in ((fa, qa), (fc, qc)):
+        res = fut.result(0)
+        ref = svc.reference_solve(
+            make_query(q.crra, q.labor_ar, **KW),
+            bracket_init=res.bracket_init)
+        assert_rows_equal(res, ref)
+    assert svc.metrics.failures == 1
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Batching mechanics with a deterministic clock.
+# ---------------------------------------------------------------------------
+
+def test_default_ladder_shapes():
+    assert default_ladder(8) == (1, 2, 4, 8)
+    assert default_ladder(12) == (1, 2, 4, 8, 12)
+    assert default_ladder(1) == (1,)
+    b = MicroBatcher(max_batch=8)
+    assert [b.pad_to(n) for n in (1, 2, 3, 5, 8)] == [1, 2, 4, 8, 8]
+
+
+def test_batcher_deadline_and_size_flush():
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=4, max_wait_s=0.010, clock=clk)
+    b.offer("g", "r0")
+    assert b.pop_ready() == []                  # deadline not reached
+    assert b.next_deadline() == pytest.approx(0.010)
+    clk.advance(0.005)
+    assert b.pop_ready() == []
+    clk.advance(0.006)                          # past the deadline
+    ready = b.pop_ready()
+    assert ready == [("g", ["r0"])]
+    # size-triggered: max_batch arrivals flush immediately, no deadline
+    for i in range(4):
+        b.offer("g", f"s{i}")
+    assert b.pop_ready() == [("g", ["s0", "s1", "s2", "s3"])]
+    assert b.depth() == 0
+
+
+def test_batcher_bounded_queue():
+    b = MicroBatcher(max_batch=4, max_queue=2, clock=FakeClock())
+    b.offer("g", 1)
+    b.offer("g", 2)
+    with pytest.raises(ServeQueueFull):
+        b.offer("g", 3, block=False)
+    with pytest.raises(ServeQueueFull):
+        b.offer("g", 3, timeout=0.01)
+    assert b.depth() == 2
+
+
+def test_service_deadline_with_injected_clock():
+    clk = FakeClock()
+    svc = manual_service(max_wait_s=0.010, clock=clk)
+    fut = svc.submit(make_query(3.0, 0.6, **KW))
+    assert svc.pump() == 0 and not fut.done()   # before the deadline
+    clk.advance(0.011)
+    assert svc.pump() == 1
+    assert fut.result(0).path == "cold"
+    svc.close()
+
+
+def test_batch_occupancy_and_queue_metrics():
+    svc = manual_service()
+    for rho in (0.0, 0.3, 0.6):
+        svc.submit(make_query(1.0, rho, **KW))
+    svc.flush()                                  # 3 real lanes -> shape 4
+    snap = svc.metrics.snapshot()
+    assert snap["serve_batches"] == 1
+    assert snap["serve_batch_occupancy"] == pytest.approx(0.75)
+    assert snap["serve_queue_depth_peak"] == 3
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Cache-hit contract (ISSUE 4 satellite: tier-1 smoke).
+# ---------------------------------------------------------------------------
+
+def test_second_identical_query_is_hit_with_zero_compiles():
+    from aiyagari_hark_tpu.utils.timing import CompileCounter
+
+    svc = manual_service()
+    first = svc.query(3.0, 0.6, **KW)
+    assert first.path == "cold"
+    with CompileCounter() as c:
+        fut = svc.submit(make_query(3.0, 0.6, **KW))
+        assert fut.done()                        # resolved at submit
+        second = fut.result()
+    assert c.compile_events == 0 and c.cache_misses == 0
+    assert second.path == "hit"
+    assert_rows_equal(first, second)
+    snap = svc.metrics.snapshot()
+    assert snap["serve_hit_rate"] == pytest.approx(0.5)
+    assert snap["serve_hit_p50_ms"] is not None
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Drain / shutdown / preemption semantics.
+# ---------------------------------------------------------------------------
+
+def test_close_drains_pending_futures():
+    svc = manual_service()
+    futs = [svc.submit(make_query(1.0, rho, **KW)) for rho in (0.0, 0.3)]
+    svc.close(drain=True)
+    for f in futs:
+        assert not is_failure(f.result(0).status)
+    with pytest.raises(ServiceClosed):
+        svc.submit(make_query(1.0, 0.6, **KW))
+
+
+def test_close_without_drain_fails_pending():
+    svc = manual_service()
+    fut = svc.submit(make_query(1.0, 0.45, **KW))
+    svc.close(drain=False)
+    with pytest.raises(ServiceClosed):
+        fut.result(0)
+
+
+def test_preemption_fails_pending_with_typed_interrupted():
+    svc = manual_service()
+    fut = svc.submit(make_query(1.0, 0.55, **KW))
+    try:
+        request_interrupt()
+        with pytest.raises(Interrupted):
+            svc.pump()
+        with pytest.raises(Interrupted):
+            fut.result(0)
+    finally:
+        clear_interrupt()
+    # the service closed at the seam: no more submits
+    with pytest.raises(ServiceClosed):
+        svc.submit(make_query(1.0, 0.6, **KW))
+
+
+def test_worker_preemption_fails_popped_and_queued_futures():
+    """WORKER-mode preemption (the path a live service actually runs): a
+    shutdown request observed at the worker's batch seam must fail every
+    pending future — popped or still queued — with the typed
+    ``Interrupted``, never leave a waiter hung through the preemption."""
+    svc = EquilibriumService(max_batch=4, max_wait_s=60.0, ladder=(1, 2, 4))
+    try:
+        futs = [svc.submit(make_query(1.0, rho, **KW))
+                for rho in (0.05, 0.15)]        # queued behind max_wait
+        request_interrupt()
+        for f in futs:
+            with pytest.raises(Interrupted):
+                f.result(10)                    # must FAIL, not hang
+        with pytest.raises(ServiceClosed):
+            svc.submit(make_query(1.0, 0.25, **KW))
+    finally:
+        clear_interrupt()
+        svc.close()
+
+
+def test_sweep_and_store_share_one_donor_rule():
+    """The donor-ranking metric and margin rule are one implementation
+    (``parallel.sweep.neighbor_distance``/``donor_margin``) — a drifted
+    copy in the store would silently break batch/serving warm-start
+    parity."""
+    from aiyagari_hark_tpu.parallel.sweep import (
+        donor_margin,
+        neighbor_distance,
+    )
+    from aiyagari_hark_tpu.serve import SolutionStore, make_solution
+
+    store = SolutionStore(capacity=8)
+    cells = [(3.0, 0.60, 0.2), (3.0, 0.90, 0.2), (1.0, 0.65, 0.2)]
+    roots = [0.035, 0.030, 0.040]
+    for k, (cell, r) in enumerate(zip(cells, roots), start=1):
+        row = np.asarray([r, 5.0, 0.9, 11.0, 500.0, 4000.0, 0.0])
+        store.put(make_solution(cell, row, 7, k))
+    query_cell, width, r_tol = (3.0, 0.65, 0.2), 0.12, 1e-4
+    nom = store.nominate(query_cell, 7, width, r_tol)
+    d = neighbor_distance(query_cell, np.asarray(cells))
+    order = np.argsort(d, kind="stable")
+    assert nom.donor_key == int(order[0]) + 1
+    spread = abs(roots[int(order[0])] - roots[int(order[1])])
+    assert nom.margin == donor_margin(spread, width, r_tol)
+
+
+def test_fault_query_requires_fault_service():
+    svc = manual_service()
+    with pytest.raises(ValueError):
+        svc.submit(make_query(1.0, 0.3, fault_iter=0, **KW))
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Threaded soak (slow): hundreds of concurrent submits, shuffled arrivals.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_threaded_soak_shuffled_arrivals():
+    """4 threads x 60 shuffled submits over a small lattice through a
+    live worker thread — twice.  Wave 1 is an all-miss storm (every
+    submit lands before the first solve resolves): every future resolves,
+    every served result is bit-identical to the direct single-cell solve
+    with its recorded seed, and warm answers sit within the bracket
+    certificate of cold.  Wave 2 replays the same shuffled queries
+    against the now-warm store: pure exact hits, bit-equal to wave 1."""
+    rng = np.random.default_rng(1234)
+    lattice = [(c, r) for c in (1.0, 3.0) for r in (0.0, 0.3, 0.6, 0.9)]
+    queries = [lattice[i] for i in rng.integers(0, len(lattice), 240)]
+    svc = EquilibriumService(max_batch=8, max_wait_s=0.002, max_queue=512)
+
+    def storm():
+        futs = [None] * len(queries)
+
+        def submitter(tid):
+            for i in range(tid, len(queries), 4):
+                c, r = queries[i]
+                futs[i] = svc.submit(make_query(c, r, **KW))
+
+        threads = [threading.Thread(target=submitter, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return [f.result(120) for f in futs]
+
+    wave1 = storm()
+
+    # verify each distinct (key, seed) once against the direct reference,
+    # and each distinct key once against the bare cold program
+    r_tol = KW["r_tol"]
+    seen = {}
+    cold = {}
+    for (c, r), res in zip(queries, wave1):
+        assert not is_failure(res.status)
+        q = make_query(c, r, **KW)
+        sig = (res.key, res.bracket_init)
+        if sig not in seen:
+            seen[sig] = (svc.reference_solve(q, res.bracket_init)
+                         if res.bracket_init is not None else None)
+        ref = seen[sig]
+        if ref is not None:
+            assert_rows_equal(res, ref)
+        if res.key not in cold:
+            cold[res.key] = svc.reference_solve(q)
+        assert abs(res.r_star - cold[res.key].r_star) <= 4.0 * r_tol
+
+    # wave 2: same shuffled arrivals, warm store -> pure exact hits.  The
+    # cached entry is the last wave-1 launch that wrote the key (duplicate
+    # queries in different batches may differ at inner-solver noise), so
+    # assert membership in wave 1's result set for the key.
+    by_key = {}
+    for res in wave1:
+        by_key.setdefault(res.key, []).append(
+            (res.r_star, res.capital, res.labor, res.bisect_iters,
+             res.egm_iters, res.dist_iters, res.status))
+    wave2 = storm()
+    svc.close()
+    for res in wave2:
+        assert res.path == "hit"
+        row = (res.r_star, res.capital, res.labor, res.bisect_iters,
+               res.egm_iters, res.dist_iters, res.status)
+        assert row in by_key[res.key]
+    snap = svc.metrics.snapshot()
+    assert snap["serve_requests"] == 2 * len(queries)
+    assert snap["serve_failures"] == 0
+    assert snap["serve_hit_rate"] >= 0.49      # wave 2 is all hits

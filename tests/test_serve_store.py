@@ -1,0 +1,194 @@
+"""SolutionStore semantics (ISSUE 4 satellite): LRU eviction order,
+content-address inequality, donor nomination, and the disk tier's
+reload-without-resolve contract."""
+
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.serve import (
+    EquilibriumService,
+    SolutionStore,
+    make_query,
+    make_solution,
+)
+from aiyagari_hark_tpu.solver_health import CONVERGED, NONFINITE
+
+KW = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-4,
+          max_bisect=16)
+GROUP = 7
+
+
+def entry(key, cell=(3.0, 0.6, 0.2), r_star=0.035, group=GROUP,
+          status=CONVERGED):
+    packed = np.asarray([r_star, 5.0, 0.9, 11.0, 500.0, 4000.0,
+                         float(status)])
+    return make_solution(cell, packed, group, key)
+
+
+# ---------------------------------------------------------------------------
+# LRU semantics.
+# ---------------------------------------------------------------------------
+
+def test_lru_eviction_order():
+    store = SolutionStore(capacity=2)
+    store.put(entry(1))
+    store.put(entry(2))
+    assert store.mem_keys() == [1, 2]
+    assert store.get(1) is not None           # promote 1 -> MRU
+    assert store.mem_keys() == [2, 1]
+    store.put(entry(3))                       # evicts 2 (the LRU), not 1
+    assert store.mem_keys() == [1, 3]
+    assert store.get(2) is None               # memory-only: forgotten
+    assert store.get(1) is not None
+    assert store.known() == 2
+
+
+def test_put_refresh_moves_to_mru():
+    store = SolutionStore(capacity=2)
+    store.put(entry(1))
+    store.put(entry(2))
+    store.put(entry(1, r_star=0.04))          # refresh promotes
+    store.put(entry(3))
+    assert store.mem_keys() == [1, 3]
+    assert float(store.get(1).packed[0]) == 0.04
+
+
+def test_put_refuses_failed_status():
+    store = SolutionStore(capacity=4)
+    with pytest.raises(ValueError):
+        store.put(entry(9, status=NONFINITE))
+
+
+# ---------------------------------------------------------------------------
+# Content addressing: any differing input -> a different key.
+# ---------------------------------------------------------------------------
+
+def test_solution_key_differs_when_any_input_differs():
+    base = make_query(3.0, 0.6, **KW)
+    variants = [
+        make_query(3.0001, 0.6, **KW),                    # cell: crra
+        make_query(3.0, 0.61, **KW),                      # cell: rho
+        make_query(3.0, 0.6, labor_sd=0.25, **KW),        # cell: sd
+        make_query(3.0, 0.6, dtype=np.float32, **KW),     # dtype
+        make_query(3.0, 0.6, **{**KW, "a_count": 11}),    # grid size
+        make_query(3.0, 0.6, **{**KW, "r_tol": 2e-4}),    # tolerance
+        make_query(3.0, 0.6, **{**KW, "max_bisect": 17}),
+        make_query(3.0, 0.6, **KW, dist_method="dense"),  # extra kwarg
+    ]
+    keys = {q.key() for q in variants}
+    assert base.key() not in keys
+    assert len(keys) == len(variants)         # all pairwise distinct
+
+
+def test_solution_key_canonicalization():
+    """Keyword order and the dtype=None alias must NOT split the address
+    (the dtype aliasing bug class of ISSUE 2, at the cache-key layer)."""
+    a = make_query(3.0, 0.6, a_count=10, r_tol=1e-4)
+    b = make_query(3.0, 0.6, r_tol=1e-4, a_count=10)
+    assert a.key() == b.key() and a.group() == b.group()
+    import jax.numpy as jnp
+
+    c = make_query(3.0, 0.6, dtype=jnp.float64, a_count=10, r_tol=1e-4)
+    assert a.key() == c.key()                  # None == explicit default
+
+
+# ---------------------------------------------------------------------------
+# Donor nomination.
+# ---------------------------------------------------------------------------
+
+def test_nominate_picks_true_nearest_neighbor():
+    store = SolutionStore(capacity=8)
+    store.put(entry(1, cell=(3.0, 0.60, 0.2), r_star=0.035))
+    store.put(entry(2, cell=(3.0, 0.90, 0.2), r_star=0.030))
+    store.put(entry(3, cell=(1.0, 0.65, 0.2), r_star=0.040))
+    width, r_tol = 0.12, 1e-4
+    nom = store.nominate((3.0, 0.65, 0.2), GROUP, width, r_tol)
+    # normalized distances: 1 -> 0.056, 2 -> 0.278, 3 -> 0.5: key 1 wins
+    assert nom.donor_key == 1
+    assert nom.target == 0.035
+    # margin covers the spread to the SECOND-nearest donor (key 2)
+    assert nom.margin >= abs(0.035 - 0.030)
+
+
+def test_nominate_scopes_to_group_and_cutoff():
+    store = SolutionStore(capacity=8, donor_cutoff=0.5)
+    store.put(entry(1, cell=(3.0, 0.6, 0.2), group=GROUP))
+    assert store.nominate((3.0, 0.65, 0.2), GROUP + 1, 0.12, 1e-4) is None
+    # inside the cutoff: nominated; across the lattice: declined
+    assert store.nominate((3.0, 0.65, 0.2), GROUP, 0.12, 1e-4) is not None
+    assert store.nominate((1.0, 0.0, 0.2), GROUP, 0.12, 1e-4) is None
+
+
+def test_nominate_single_donor_margin_floor():
+    store = SolutionStore(capacity=8)
+    store.put(entry(1, cell=(3.0, 0.6, 0.2), r_star=0.035))
+    width, r_tol = 0.12, 1e-4
+    nom = store.nominate((3.0, 0.65, 0.2), GROUP, width, r_tol)
+    assert nom.margin == pytest.approx(max(0.08 * width, 64.0 * r_tol))
+
+
+# ---------------------------------------------------------------------------
+# Disk tier: restart reuses entries, corrupt files degrade.
+# ---------------------------------------------------------------------------
+
+def test_disk_tier_survives_restart(tmp_path):
+    d = str(tmp_path / "solstore")
+    store = SolutionStore(capacity=4, disk_path=d)
+    store.put(entry(11, cell=(1.0, 0.3, 0.2), r_star=0.041))
+    store.put(entry(12, cell=(3.0, 0.6, 0.2), r_star=0.035))
+
+    reborn = SolutionStore(capacity=4, disk_path=d)
+    assert reborn.known() == 2
+    assert len(reborn) == 0                   # index only; memory cold
+    sol = reborn.get(11)
+    assert sol is not None
+    assert np.array_equal(np.asarray(sol.packed),
+                          np.asarray(store.get(11).packed))
+    assert len(reborn) == 1                   # promoted on get
+    # donors survive the restart too
+    assert reborn.nominate((1.0, 0.35, 0.2), GROUP, 0.12,
+                           1e-4).donor_key == 11
+
+
+def test_disk_tier_eviction_keeps_entry_addressable(tmp_path):
+    store = SolutionStore(capacity=1, disk_path=str(tmp_path / "s"))
+    store.put(entry(1, cell=(1.0, 0.3, 0.2)))
+    store.put(entry(2, cell=(3.0, 0.6, 0.2)))   # evicts 1 from memory
+    assert store.mem_keys() == [2]
+    assert store.known() == 2
+    assert store.get(1) is not None             # reloaded from disk
+
+
+def test_corrupt_disk_entry_skipped(tmp_path):
+    d = tmp_path / "s"
+    store = SolutionStore(capacity=4, disk_path=str(d))
+    store.put(entry(1, cell=(1.0, 0.3, 0.2)))
+    (d / "sol_00000000deadbeef.npz").write_bytes(b"not an npz")
+    with pytest.warns(UserWarning, match="unreadable"):
+        reborn = SolutionStore(capacity=4, disk_path=str(d))
+    assert reborn.known() == 1
+
+
+def test_service_disk_reload_serves_without_resolving(tmp_path):
+    """The end-to-end restart contract: a second service process over the
+    same disk path serves the stored calibration as an exact hit — zero
+    cold solves, zero XLA compiles."""
+    from aiyagari_hark_tpu.utils.timing import CompileCounter
+
+    d = str(tmp_path / "serve_store")
+    svc = EquilibriumService(start_worker=False, max_batch=4,
+                             disk_path=d, ladder=(1, 2, 4))
+    first = svc.query(3.0, 0.6, **KW)
+    assert first.path == "cold"
+    svc.close()
+
+    svc2 = EquilibriumService(start_worker=False, max_batch=4,
+                              disk_path=d, ladder=(1, 2, 4))
+    with CompileCounter() as c:
+        again = svc2.query(3.0, 0.6, **KW)
+    assert again.path == "hit"
+    assert c.compile_events == 0 and c.cache_misses == 0
+    assert (again.r_star, again.capital, again.labor) == (
+        first.r_star, first.capital, first.labor)
+    assert svc2.metrics.snapshot()["serve_cold_rate"] == 0.0
+    svc2.close()
