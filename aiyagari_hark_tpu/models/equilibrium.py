@@ -28,12 +28,15 @@ from ..solver_health import (
     NONFINITE,
     combine_status,
 )
+from ..utils.config import resolve_precision
 from .household import (
+    R_DESCENT_WIDTH_SCALE,
     HouseholdPolicy,
     SimpleModel,
     aggregate_capital,
     aggregate_labor,
     build_simple_model,
+    descent_dtype,
     initial_distribution,
     initial_policy,
     solve_household,
@@ -55,7 +58,13 @@ class EquilibriumResult(NamedTuple):
 
 
 class SupplyEval(NamedTuple):
-    """One household-side evaluation A(r) with its work counters."""
+    """One household-side evaluation A(r) with its work counters.
+
+    ``descent_steps``/``polish_steps`` split the inner-loop work by
+    precision-ladder phase (DESIGN §5; all-polish under the "reference"
+    policy), and ``escalations`` counts inner loops whose descent phase
+    fell back to a pure-reference solve
+    (``solver_health.PRECISION_ESCALATED``)."""
 
     supply: jnp.ndarray
     policy: HouseholdPolicy
@@ -65,6 +74,9 @@ class SupplyEval(NamedTuple):
     egm_iters: jnp.ndarray       # EGM backward steps taken to the fixed point
     dist_iters: jnp.ndarray      # distribution-iteration steps taken
     status: jnp.ndarray = CONVERGED  # worst of the two inner loops' codes
+    descent_steps: jnp.ndarray = 0   # cheap-phase steps (both loops)
+    polish_steps: jnp.ndarray = 0    # reference-phase steps (both loops)
+    escalations: jnp.ndarray = 0     # inner loops escalated to reference
 
 
 def household_capital_supply(r, model: SimpleModel, disc_fac, crra,
@@ -73,7 +85,8 @@ def household_capital_supply(r, model: SimpleModel, disc_fac, crra,
                              init_policy=None, init_dist=None,
                              dist_method: str = "auto",
                              egm_method: str = "xla",
-                             accel_every: int | None = None) -> SupplyEval:
+                             accel_every: int | None = None,
+                             precision: str = "reference") -> SupplyEval:
     """A(r): solve the household at prices implied by r, return stationary
     capital plus the objects (policy, distribution, W), iteration counts
     (the work model behind the grid-points/sec benchmark metric), and the
@@ -90,20 +103,33 @@ def household_capital_supply(r, model: SimpleModel, disc_fac, crra,
 
     ``accel_every=0`` disables the Anderson extrapolation in BOTH inner
     loops (plain damped iteration — the sweep retry ladder's safe mode);
-    ``None`` keeps each loop's own default cadence."""
+    ``None`` keeps each loop's own default cadence.
+
+    ``precision`` threads the mixed-precision ladder policy (DESIGN §5)
+    into BOTH inner fixed points; the per-phase step split rides the
+    returned counters."""
     k_to_l = firm.k_to_l_from_r(r, cap_share, depr_fac, prod)
     W = firm.wage_rate(k_to_l, cap_share, prod)
     R = 1.0 + r
     egm_kw = {} if accel_every is None else {"accel_every": accel_every}
-    policy, egm_it, _, egm_status = solve_household(
+    policy, egm_it, _, egm_status, egm_ph = solve_household(
         R, W, model, disc_fac, crra, tol=egm_tol, init_policy=init_policy,
-        method=egm_method, **egm_kw)
-    dist, dist_it, _, dist_status = stationary_wealth(
+        method=egm_method, precision=precision, return_phases=True,
+        **egm_kw)
+    dist, dist_it, _, dist_status, dist_ph = stationary_wealth(
         policy, R, W, model, tol=dist_tol, init_dist=init_dist,
-        method=dist_method, **egm_kw)
+        method=dist_method, precision=precision, return_phases=True,
+        **egm_kw)
+    it_dtype = jnp.asarray(egm_it).dtype
     return SupplyEval(aggregate_capital(dist, model), policy, dist, W,
                       k_to_l, egm_it, dist_it,
-                      combine_status(egm_status, dist_status))
+                      combine_status(egm_status, dist_status),
+                      descent_steps=(egm_ph.descent_steps.astype(it_dtype)
+                                     + dist_ph.descent_steps.astype(it_dtype)),
+                      polish_steps=(egm_ph.polish_steps.astype(it_dtype)
+                                    + dist_ph.polish_steps.astype(it_dtype)),
+                      escalations=(egm_ph.escalated.astype(it_dtype)
+                                   + dist_ph.escalated.astype(it_dtype)))
 
 
 def _bisection_setup(model: SimpleModel, disc_fac, depr_fac,
@@ -119,7 +145,8 @@ def _bisection_setup(model: SimpleModel, disc_fac, depr_fac,
     failed cell with a larger pad, trading a few basis points of bracket
     reach for distance from the singular endpoints."""
     dtype = model.a_grid.dtype
-    f64 = dtype == jnp.float64
+    f64 = dtype == jnp.float64   # dtype-ok: dispatch on the model dtype,
+    #                              not a hard-coded compute dtype
     if r_tol is None:
         r_tol = 1e-10 if f64 else 1e-6
     if egm_tol is None:
@@ -196,7 +223,8 @@ def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
                                 r_tol: float | None = None,
                                 max_bisect: int = 60,
                                 egm_tol: float | None = None,
-                                dist_tol: float | None = None) -> EquilibriumResult:
+                                dist_tol: float | None = None,
+                                precision: str = "reference") -> EquilibriumResult:
     """Bisect r until the capital market clears.
 
     Fully jit-able/vmappable: a fixed-trip ``while_loop`` whose body solves
@@ -212,7 +240,8 @@ def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
     def excess_supply(r):
         supply = household_capital_supply(
             r, model, disc_fac, crra, cap_share, depr_fac, prod,
-            egm_tol=egm_tol, dist_tol=dist_tol).supply
+            egm_tol=egm_tol, dist_tol=dist_tol,
+            precision=precision).supply
         demand = firm.k_to_l_from_r(r, cap_share, depr_fac, prod) * labor
         return supply - demand
 
@@ -221,7 +250,7 @@ def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
 
     ev = household_capital_supply(
         r_star, model, disc_fac, crra, cap_share, depr_fac, prod,
-        egm_tol=egm_tol, dist_tol=dist_tol)
+        egm_tol=egm_tol, dist_tol=dist_tol, precision=precision)
     supply, wage, k_to_l = ev.supply, ev.wage, ev.k_to_l
     demand = k_to_l * labor
     output = prod * supply ** cap_share * labor ** (1.0 - cap_share)
@@ -252,6 +281,12 @@ class LeanEquilibrium(NamedTuple):
     status: jnp.ndarray = CONVERGED  # solver_health code for the cell:
     # worst of (bracket exit, last midpoint's inner fixed points, the
     # non-finite tripwire); `parallel.sweep` quarantines on is_failure()
+    descent_steps: jnp.ndarray = 0   # cheap-phase inner steps, all midpoints
+    polish_steps: jnp.ndarray = 0    # reference-phase inner steps (== the
+    #                                  total under precision="reference")
+    escalations: jnp.ndarray = 0     # inner fixed points whose descent fell
+    #                                  back to a pure-reference solve
+    #                                  (solver_health.PRECISION_ESCALATED)
 
 
 def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
@@ -265,6 +300,7 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
                            accel_every: int | None = None,
                            bracket_pad: float = 1.0,
                            bracket_init=None,
+                           precision: str = "reference",
                            fault_iter=None,
                            fault_mode: str = "nan") -> LeanEquilibrium:
     """Bracketed root-finding equilibrium that carries the supply evaluation
@@ -298,6 +334,16 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
     bracket and exits NONFINITE immediately).  ``accel_every=0`` /
     ``bracket_pad`` are the sweep retry ladder's knobs (see
     ``household_capital_supply`` / ``_bisection_setup``).
+
+    ``precision`` (DESIGN §5): the mixed-precision ladder policy threaded
+    into every inner fixed point of every midpoint evaluation —
+    "reference" (default, bit-identical single-phase), "mixed" (cheap
+    descent + reference polish, final tolerance contract unchanged),
+    "fast" (descent only, tolerance relaxed).  ``descent_steps``/
+    ``polish_steps``/``escalations`` on the result split the inner work
+    by phase; a descent-phase NONFINITE/STALLED is absorbed INSIDE the
+    ladder (pure-reference fallback, counted in ``escalations``), so
+    quarantine only sees failures the reference path would also produce.
 
     ``fault_iter``/``fault_mode`` are the deterministic fault-injection
     hook (``solver_health``): at bisection trip ``fault_iter`` (may be
@@ -348,12 +394,23 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
                          "expected 'illinois' or 'bisect'")
     one = jnp.asarray(1.0, dtype=dtype)
 
-    def eval_supply(r, pol, dist):
-        return household_capital_supply(
-            r, model, disc_fac, crra, cap_share, depr_fac, prod,
-            egm_tol=egm_tol, dist_tol=dist_tol,
-            init_policy=pol, init_dist=dist, dist_method=dist_method,
-            egm_method=egm_method, accel_every=accel_every)
+    spec = resolve_precision(precision)
+
+    def make_eval(prec):
+        def eval_at(r, pol, dist):
+            return household_capital_supply(
+                r, model, disc_fac, crra, cap_share, depr_fac, prod,
+                egm_tol=egm_tol, dist_tol=dist_tol,
+                init_policy=pol, init_dist=dist, dist_method=dist_method,
+                egm_method=egm_method, accel_every=accel_every,
+                precision=prec)
+        return eval_at
+
+    # The final-grade evaluation (used by the polish trips and the warm-seed
+    # verification): the caller's own policy.  Under "mixed" each of its
+    # inner fixed points runs the per-loop ladder — warm-started descent in
+    # the cheap dtype, reference polish to the full inner tolerances.
+    eval_supply = make_eval(precision)
 
     def excess_at(r, ev):
         return ev.supply - firm.k_to_l_from_r(r, cap_share, depr_fac,
@@ -371,6 +428,9 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
     f_lo0, f_hi0 = -one, one
     egm0 = zi
     dist0 = zi
+    desc0 = zi
+    pol0 = zi
+    esc0 = zi
     n_verify = 0
     if bracket_init is not None:
         lo_w = jnp.asarray(bracket_init[0], dtype=dtype)
@@ -406,68 +466,143 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
         d0 = jnp.where(ok_w, ev_hi.distribution, d0)
         egm0 = egm0 + ev_lo.egm_iters + ev_hi.egm_iters
         dist0 = dist0 + ev_lo.dist_iters + ev_hi.dist_iters
+        desc0 = desc0 + ev_lo.descent_steps + ev_hi.descent_steps
+        pol0 = pol0 + ev_lo.polish_steps + ev_hi.polish_steps
+        esc0 = esc0 + ev_lo.escalations + ev_hi.escalations
         n_verify = 2
 
-    def cond(state):
-        lo, hi = state[0], state[1]
-        it = state[4]
-        ok = state[11]
-        return ((hi - lo) > r_tol) & (it < max_bisect) & ok
+    def make_cond(width_tol):
+        def cond(state):
+            lo, hi = state[0], state[1]
+            it = state[4]
+            ok = state[11]
+            return ((hi - lo) > width_tol) & (it < max_bisect) & ok
+        return cond
 
-    def body(state):
-        (lo, hi, f_lo, f_hi, it, _, egm_acc, dist_acc, policy, dist,
-         _, _) = state
-        if use_illinois:
-            # Illinois (modified regula falsi): secant point from the
-            # stored endpoint values, clipped to the bracket interior.
-            # Endpoint values start as sign-correct placeholders (±1) —
-            # evaluating at the raw bracket ends would cost two solves at
-            # the pathological extremes (supply near r_hi mixes slowest);
-            # the placeholders only misplace the first point or two (the
-            # first step IS the midpoint), and the halving rule below
-            # guarantees bracket progress regardless.
-            mid = hi - f_hi * (hi - lo) / (f_hi - f_lo)
-            pad = 0.01 * (hi - lo)
-            mid = jnp.clip(mid, lo + pad, hi - pad)
-        else:
-            mid = 0.5 * (lo + hi)
-        ev = eval_supply(mid, policy, dist)
-        ex = excess_at(mid, ev)
-        freeze = jnp.asarray(False)
-        if fault_iter is not None:
-            # deterministic fault injection (see docstring): active only
-            # when the traced fault_iter is non-negative
-            hit = (jnp.asarray(fault_iter) >= 0) & (it
-                                                    >= jnp.asarray(fault_iter))
-            if fault_mode == "nan":
-                ex = jnp.where(hit, jnp.nan, ex)
-            elif fault_mode == "stall":
-                freeze = hit
+    def make_body(ev_fn):
+        def body(state):
+            (lo, hi, f_lo, f_hi, it, _, egm_acc, dist_acc, policy, dist,
+             _, _, desc_acc, pol_acc, esc_acc) = state
+            if use_illinois:
+                # Illinois (modified regula falsi): secant point from the
+                # stored endpoint values, clipped to the bracket interior.
+                # Endpoint values start as sign-correct placeholders (±1) —
+                # evaluating at the raw bracket ends would cost two solves
+                # at the pathological extremes (supply near r_hi mixes
+                # slowest); the placeholders only misplace the first point
+                # or two (the first step IS the midpoint), and the halving
+                # rule below guarantees bracket progress regardless.
+                mid = hi - f_hi * (hi - lo) / (f_hi - f_lo)
+                pad = 0.01 * (hi - lo)
+                mid = jnp.clip(mid, lo + pad, hi - pad)
             else:
-                raise ValueError(f"fault_mode={fault_mode!r}: expected "
-                                 "'nan' or 'stall'")
-        ok = jnp.isfinite(ex)
-        up = ex > 0   # excess supply increasing in r: root is below mid
-        # a non-finite excess (or an injected stall) must not move the
-        # bracket: NaN > 0 is False, which would silently collapse the
-        # upper end — freeze it and let the tripwire exit the loop
-        move = ok & ~freeze
-        new_lo = jnp.where(move & ~up, mid, lo)
-        new_hi = jnp.where(move & up, mid, hi)
-        # replace the moved endpoint's value with the real one; HALVE the
-        # retained endpoint's value (the Illinois anti-stagnation rule —
-        # pulls the next secant point toward the stale side)
-        new_f_lo = jnp.where(up, 0.5 * f_lo, ex)
-        new_f_hi = jnp.where(up, ex, 0.5 * f_hi)
-        return (new_lo, new_hi, new_f_lo, new_f_hi, it + 1, ev.supply,
-                egm_acc + ev.egm_iters, dist_acc + ev.dist_iters,
-                ev.policy, ev.distribution, ev.status, ok)
+                mid = 0.5 * (lo + hi)
+            ev = ev_fn(mid, policy, dist)
+            ex = excess_at(mid, ev)
+            freeze = jnp.asarray(False)
+            if fault_iter is not None:
+                # deterministic fault injection (see docstring): active
+                # only when the traced fault_iter is non-negative.  The
+                # trip counter runs ACROSS the ladder's descent and polish
+                # loops, so an injection at trip k fires in whichever
+                # phase reaches k — a poisoned reference excess is a real
+                # failure and must surface as NONFINITE, never be healed
+                # by the bisection-level escalation.
+                hit = (jnp.asarray(fault_iter) >= 0) & (
+                    it >= jnp.asarray(fault_iter))
+                if fault_mode == "nan":
+                    ex = jnp.where(hit, jnp.nan, ex)
+                elif fault_mode == "stall":
+                    freeze = hit
+                else:
+                    raise ValueError(f"fault_mode={fault_mode!r}: expected "
+                                     "'nan' or 'stall'")
+            ok = jnp.isfinite(ex)
+            up = ex > 0   # excess supply increasing in r: root below mid
+            # a non-finite excess (or an injected stall) must not move the
+            # bracket: NaN > 0 is False, which would silently collapse the
+            # upper end — freeze it and let the tripwire exit the loop
+            move = ok & ~freeze
+            new_lo = jnp.where(move & ~up, mid, lo)
+            new_hi = jnp.where(move & up, mid, hi)
+            # replace the moved endpoint's value with the real one; HALVE
+            # the retained endpoint's value (the Illinois anti-stagnation
+            # rule — pulls the next secant point toward the stale side)
+            new_f_lo = jnp.where(up, 0.5 * f_lo, ex)
+            new_f_hi = jnp.where(up, ex, 0.5 * f_hi)
+            return (new_lo, new_hi, new_f_lo, new_f_hi, it + 1, ev.supply,
+                    egm_acc + ev.egm_iters, dist_acc + ev.dist_iters,
+                    ev.policy, ev.distribution, ev.status, ok,
+                    desc_acc + ev.descent_steps, pol_acc + ev.polish_steps,
+                    esc_acc + ev.escalations)
+        return body
+
+    init = (r_lo, r_hi, f_lo0, f_hi0, it0, zero, egm0, dist0, p0, d0,
+            jnp.int32(CONVERGED), jnp.asarray(True), desc0, pol0, esc0)
+    esc_trips = zi   # descent trips re-granted to an escalated polish
+    if not spec.two_phase:
+        final = jax.lax.while_loop(make_cond(r_tol), make_body(eval_supply),
+                                   init)
+        width_tol = r_tol
+    else:
+        # Bisection-level ladder (DESIGN §5): while the bracket is WIDE,
+        # the midpoint evaluations only steer it — their fine-scale error
+        # is erased by later trips — so they run descent-only ("fast"
+        # inner solves: cheap dtype, tolerances floored at what it can
+        # certify).  The switch width is set so the cheap phase's root-
+        # placement noise (measured f32-vs-f64 drift: ~1e-6 in r units,
+        # 0.097 bp over all 12 Table II cells) is orders of magnitude
+        # smaller than the remaining bracket.
+        cheap_eps = float(jnp.finfo(descent_dtype(dtype)).eps)
+        r_switch = max(float(r_tol), R_DESCENT_WIDTH_SCALE * cheap_eps)
+        state_a = jax.lax.while_loop(make_cond(r_switch),
+                                     make_body(make_eval("fast")), init)
+        if not spec.polish:
+            final = state_a
+            width_tol = r_switch   # "fast": contract relaxed, honestly
+        else:
+            (lo_a, hi_a, _, _, it_a, sup_a, egm_a, dist_a, pol_a, d_a,
+             _, ok_a, desc_a, polish_a, esc_a) = state_a
+            # Bisection-level escalation: a NONFINITE excess in the cheap
+            # descent must not steer (or seed) the polish — restart it
+            # from the untouched bracket and cold inner inits, exactly a
+            # reference-grade solve (PRECISION_ESCALATED; quarantine only
+            # ever sees failures the reference path would also produce).
+            esc_b = ~ok_a
+            # Re-bracket with a half-width safety margin on each side:
+            # the cheap phase places the root to ~1e-6 while the margin is
+            # ~0.5 * r_switch, so the widened bracket contains the true
+            # root with two orders of magnitude to spare (the same
+            # unverified-sign assumption the economic bracket itself
+            # rests on), at the cost of a single extra trip.
+            w_a = hi_a - lo_a
+            lo_b = jnp.maximum(r_lo, lo_a - 0.5 * w_a)
+            hi_b = jnp.minimum(r_hi, hi_a + 0.5 * w_a)
+            lo_b = jnp.where(esc_b, r_lo, lo_b)
+            hi_b = jnp.where(esc_b, r_hi, hi_b)
+            pol_b = jax.tree_util.tree_map(
+                lambda cold, warm: jnp.where(esc_b, cold, warm), p0, pol_a)
+            d_b = jnp.where(esc_b, d0, d_a)
+            # An escalated lane's restart is a FULL reference-grade solve:
+            # reset its trip counter to the pre-loop value so the polish
+            # gets the whole max_bisect budget (the descent trips it
+            # burned must not make the fallback MAX_ITER where a plain
+            # reference solve would converge — quarantine may only see
+            # failures the reference path would also produce).  The burnt
+            # trips are added back into the honest eval count below.
+            it_b0 = jnp.where(esc_b, it0, it_a)
+            esc_trips = jnp.where(esc_b, it_a - it0,
+                                  jnp.zeros_like(it_a))
+            init_b = (lo_b, hi_b, -one, one, it_b0, sup_a, egm_a, dist_a,
+                      pol_b, d_b, jnp.int32(CONVERGED), jnp.asarray(True),
+                      desc_a, polish_a,
+                      esc_a + esc_b.astype(esc_a.dtype))
+            final = jax.lax.while_loop(make_cond(r_tol),
+                                       make_body(eval_supply), init_b)
+            width_tol = r_tol
 
     (lo, hi, _, _, iters, supply, egm_iters, dist_iters, _, _,
-     inner_status, ok) = jax.lax.while_loop(
-        cond, body,
-        (r_lo, r_hi, f_lo0, f_hi0, it0, zero, egm0, dist0, p0, d0,
-         jnp.int32(CONVERGED), jnp.asarray(True)))
+     inner_status, ok, descent_steps, polish_steps, escalations) = final
     # worst of: the non-finite tripwire, the bracket exit, and the LAST
     # midpoint's inner fixed-point statuses (earlier midpoints' inner
     # exits don't certify anything about the returned objects; a
@@ -475,17 +610,19 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
     # `ok` on that very evaluation)
     status = combine_status(
         jnp.where(~ok, jnp.int32(NONFINITE), jnp.int32(CONVERGED)),
-        jnp.where((hi - lo) > r_tol, jnp.int32(MAX_ITER),
+        jnp.where((hi - lo) > width_tol, jnp.int32(MAX_ITER),
                   jnp.int32(CONVERGED)),
         inner_status)
     # honest work accounting: evaluations actually performed (continuation
     # trips + the 2 warm-seed verification solves), not the replayed level
     # count — identical to the trip count on the cold path
-    evals = iters - it0 + n_verify
+    evals = iters - it0 + n_verify + esc_trips
     return LeanEquilibrium(r_star=0.5 * (lo + hi), capital=supply,
                            labor=labor, bisect_iters=evals,
                            egm_iters=egm_iters, dist_iters=dist_iters,
-                           status=status)
+                           status=status, descent_steps=descent_steps,
+                           polish_steps=polish_steps,
+                           escalations=escalations)
 
 
 def _solve_cell(solver, crra, labor_ar, labor_sd=0.2, labor_states=7,

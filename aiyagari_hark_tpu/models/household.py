@@ -39,9 +39,12 @@ from ..ops.markov import (
 from ..ops.utility import inverse_marginal_utility, marginal_utility
 from ..solver_health import (
     NONFINITE,
+    STALLED,
     call_step,
     classify_fixed_point_exit,
+    inject_fault,
 )
+from ..utils.config import resolve_precision
 
 # The reference's borrowing-constraint knot value (Aiyagari_Support.py:1503).
 CONSTRAINT_EPS = 1e-7
@@ -125,19 +128,26 @@ def initial_policy(model: SimpleModel) -> HouseholdPolicy:
 
 
 def egm_step(policy: HouseholdPolicy, R, W, model: SimpleModel,
-             disc_fac, crra) -> HouseholdPolicy:
+             disc_fac, crra,
+             matmul_precision=jax.lax.Precision.HIGHEST) -> HouseholdPolicy:
     """One EGM backward step on the [A, N] block.  The expectation over next
     states is a single [A,N']x[N',N] matmul (MXU-friendly), replacing the
-    reference's per-state Python loop (``Aiyagari_Support.py:1479-1485``)."""
+    reference's per-state Python loop (``Aiyagari_Support.py:1479-1485``).
+
+    ``matmul_precision``: HIGHEST by default — the TPU bf16 matmul default
+    loses ~3 decimal digits, which the EGM fixed point bakes into the
+    policy (r* moves >1bp) when EVERY step runs that way.  The mixed-
+    precision ladder's descent phase (DESIGN §5) passes DEFAULT instead:
+    bf16 matmul inputs, accumulation pinned to the iterate dtype via
+    ``preferred_element_type``, with the polish phase erasing the drift."""
     a = model.a_grid                                  # [A]
     m_next = R * a[:, None] + W * model.labor_levels[None, :]   # [A, N']
     # c_next(m) per next-state: rowwise interp with per-state knots.
     c_next = interp1d_rowwise(m_next.T, policy.m_knots, policy.c_knots).T
     vp_next = marginal_utility(c_next, crra)          # [A, N']
-    # precision=HIGHEST: the TPU bf16 matmul default loses ~3 decimal digits,
-    # which the EGM fixed point then bakes into the policy (r* moves >1bp).
     end_of_prd_vp = disc_fac * R * jnp.matmul(
-        vp_next, model.transition.T, precision=jax.lax.Precision.HIGHEST)
+        vp_next, model.transition.T, precision=matmul_precision,
+        preferred_element_type=vp_next.dtype)
     c_now = inverse_marginal_utility(end_of_prd_vp, crra)
     m_now = a[:, None] + c_now
     # borrowing-constraint knot: at m = b + eps the agent consumes eps and
@@ -254,6 +264,182 @@ def accelerated_policy_fixed_point(step_fn, p0, tol: float, max_iter: int,
                                                           max_iter)
 
 
+# ---------------------------------------------------------------------------
+# Mixed-precision fixed-point ladder (DESIGN §5).
+# ---------------------------------------------------------------------------
+
+# Descent-phase matmul contraction: DEFAULT lets the TPU MXU take bf16
+# inputs (one pass instead of HIGHEST's six); accumulation stays in the
+# iterate dtype via ``preferred_element_type`` at every call site.  On CPU
+# the cheapness comes from the f32 iterate instead (twice the SIMD lanes).
+DESCENT_MATMUL_PRECISION = jax.lax.Precision.DEFAULT
+
+# Coarse-tolerance scales (units of the descent dtype's eps): how deep the
+# cheap phase can CERTIFY a sup-norm diff before rounding noise floors it.
+# Policy knots span the asset grid (values up to ~a_max = 50, f32 spacing
+# ~4e-6 there), so the policy loop needs a wide margin; histogram masses
+# are <= 1 with an observed f32 update floor of 1e-8..3e-8, so one eps is
+# already conservative.
+POLICY_DESCENT_TOL_SCALE = 256.0
+DIST_DESCENT_TOL_SCALE = 1.0
+
+# Bisection-level switch width (units of the cheap dtype's eps; see
+# ``equilibrium.solve_equilibrium_lean``): the bracket width below which
+# midpoint evaluations switch from descent-only inner solves to the full
+# ladder.  256 eps_f32 ~ 3e-5 in r units — ~30x the measured f32
+# root-placement noise (~1e-6; the 0.097 bp f32-vs-f64 drift across all
+# 12 Table II cells, BENCH r5), and the re-bracketing margin in
+# ``solve_equilibrium_lean`` widens the polish bracket by half its width
+# on each side on top of that.  Measured on the 12-cell CPU sweep:
+# polish_frac ~0.2 at zero r* drift vs the reference policy.
+R_DESCENT_WIDTH_SCALE = 256.0
+
+
+def descent_dtype(dtype):
+    """The cheap dtype of the ladder's descent phase: f64 models descend
+    in f32; f32 (and narrower) models keep their dtype — their descent
+    cheapness is the DEFAULT-precision matmul path, not a narrower
+    iterate (bf16 iterates cannot certify any useful tolerance)."""
+    return jnp.float32 if jnp.dtype(dtype) == jnp.dtype("float64") else dtype
+
+
+def descent_tolerance(tol, cheap_dtype, scale: float) -> float:
+    """The descent phase's coarse certification target: the requested tol,
+    floored at what the cheap dtype can certify (``scale`` eps)."""
+    return max(float(tol), scale * float(jnp.finfo(cheap_dtype).eps))
+
+
+def cast_floating(tree, dtype):
+    """Cast every floating-point array leaf of a pytree (model, policy,
+    transition) to ``dtype``; integer/bool leaves pass through.  The ONE
+    down/up-cast used by every ladder entry point, so descent programs
+    cannot half-cast a model."""
+    def cast(leaf):
+        arr = jnp.asarray(leaf)
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            return arr.astype(dtype)
+        return leaf
+    return jax.tree.map(cast, tree)
+
+
+class PrecisionPhases(NamedTuple):
+    """Per-phase step counters of one mixed-precision ladder solve.
+
+    ``descent_steps``/``polish_steps`` are the iterations each phase took
+    (reference-policy solves report all steps as polish — every step ran
+    at reference precision).  ``escalated`` is True when the descent
+    phase exited NONFINITE or STALLED and the polish restarted from the
+    caller's initial iterate — a pure-reference solve
+    (``solver_health.PRECISION_ESCALATED``)."""
+
+    descent_steps: jnp.ndarray
+    polish_steps: jnp.ndarray
+    escalated: jnp.ndarray
+
+
+def reference_phases(it) -> PrecisionPhases:
+    """The phase accounting of a single-phase reference solve."""
+    it = jnp.asarray(it)
+    return PrecisionPhases(descent_steps=jnp.zeros_like(it),
+                           polish_steps=it,
+                           escalated=jnp.asarray(False))
+
+
+def _with_phases(out, want_phases: bool, phases=None):
+    """Append the trailing ``PrecisionPhases`` element iff the caller asked
+    for it — the ONE place the optional-arity return is assembled, so the
+    operator-precedence trap of inlining ``out + (...) if want else out``
+    cannot recur at each solver exit.  ``phases=None`` means the solve was
+    single-phase (``reference_phases`` of its iteration count)."""
+    if not want_phases:
+        return out
+    return out + ((reference_phases(out[1]) if phases is None else phases),)
+
+
+def _polish_cadence(accel_every: int) -> int:
+    """Anderson cadence of the polish phase: tighter than the descent's.
+    The polish starts NEAR the fixed point, where the dominant-rate
+    estimate is accurate and extrapolation is safest (the distribution
+    iterator's own lam_max reasoning), so extrapolating more often there
+    cuts the reference-precision step count — the ladder's whole point —
+    without touching the certification semantics (convergence is still a
+    plain-step diff below tol)."""
+    return max(8, int(accel_every) // 4) if accel_every > 0 else 0
+
+
+def ladder_policy_fixed_point(step_cheap, step_ref, p0, tol: float,
+                              descent_tol: float, max_iter: int,
+                              accel_every: int = 32, polish: bool = True,
+                              cheap_dtype=None):
+    """Two-phase EGM fixed point: cheap-dtype descent to ``descent_tol``,
+    reference-precision polish to ``tol`` — one jitted program, two
+    ``while_loop``s (DESIGN §5).
+
+    ``step_cheap`` must be the EGM step over CHEAP-dtype operands (the
+    caller casts the model once with ``cast_floating``); ``step_ref`` the
+    reference step.  Escalation: a NONFINITE descent (poisoned iterate)
+    or a STALLED one (the coarse tolerance sat below the cheap dtype's
+    rounding floor — its best iterate is uncertified noise) restarts the
+    polish from ``p0`` with the full budget: a pure-reference solve, so
+    quarantine only ever sees failures the reference path would also
+    have produced.  A MAX_ITER descent is NOT escalated — its iterate is
+    finite and certified to wherever it got, the polish continues from
+    it.  ``polish=False`` is the "fast" policy: descent only, tolerance
+    contract relaxed to the cheap floor (the caller documents this).
+
+    Returns ``(policy, total_iters, diff, status, PrecisionPhases)`` —
+    ``status``/``diff`` are the final phase's, so the caller's tolerance
+    contract and solver_health semantics are unchanged under ``polish``.
+    """
+    ref_dt = p0.c_knots.dtype
+    dt = ref_dt if cheap_dtype is None else cheap_dtype
+    p0_cheap = cast_floating(p0, dt)
+    pol_d, it_d, diff_d, status_d = accelerated_policy_fixed_point(
+        step_cheap, p0_cheap, descent_tol, max_iter, accel_every)
+    pol_up = cast_floating(pol_d, ref_dt)
+    if not polish:
+        phases = PrecisionPhases(descent_steps=it_d,
+                                 polish_steps=jnp.zeros_like(it_d),
+                                 escalated=jnp.asarray(False))
+        return pol_up, it_d, diff_d.astype(ref_dt), status_d, phases
+    escalated = (status_d == NONFINITE) | (status_d == STALLED)
+    start = jax.tree.map(lambda cold, warm: jnp.where(escalated, cold, warm),
+                         p0, pol_up)
+    pol, it_p, diff, status = accelerated_policy_fixed_point(
+        step_ref, start, tol, max_iter, _polish_cadence(accel_every))
+    phases = PrecisionPhases(descent_steps=it_d, polish_steps=it_p,
+                             escalated=escalated)
+    return pol, it_d + it_p, diff, status, phases
+
+
+def ladder_distribution_fixed_point(push_cheap, push_ref, dist0, tol: float,
+                                    descent_tol: float, max_iter: int,
+                                    accel_every: int = 64,
+                                    polish: bool = True, cheap_dtype=None):
+    """Two-phase stationary-distribution fixed point — the distribution
+    twin of ``ladder_policy_fixed_point`` (same escalation contract).
+    The cast-up iterate is exactly renormalized before the polish (the
+    cheap phase conserved mass only to its own rounding)."""
+    ref_dt = dist0.dtype
+    dt = ref_dt if cheap_dtype is None else cheap_dtype
+    d_cheap, it_d, diff_d, status_d = accelerated_distribution_fixed_point(
+        push_cheap, dist0.astype(dt), descent_tol, max_iter, accel_every)
+    d_up = d_cheap.astype(ref_dt)
+    d_up = d_up / jnp.sum(d_up)
+    if not polish:
+        phases = PrecisionPhases(descent_steps=it_d,
+                                 polish_steps=jnp.zeros_like(it_d),
+                                 escalated=jnp.asarray(False))
+        return d_up, it_d, diff_d.astype(ref_dt), status_d, phases
+    escalated = (status_d == NONFINITE) | (status_d == STALLED)
+    start = jnp.where(escalated, dist0, d_up)
+    dist, it_p, diff, status = accelerated_distribution_fixed_point(
+        push_ref, start, tol, max_iter, _polish_cadence(accel_every))
+    phases = PrecisionPhases(descent_steps=it_d, polish_steps=it_p,
+                             escalated=escalated)
+    return dist, it_d + it_p, diff, status, phases
+
+
 @functools.lru_cache(maxsize=None)
 def _pallas_egm_fixed_point_vmappable(tol: float, max_iter: int,
                                       accel_every: int):
@@ -306,13 +492,19 @@ def _pallas_egm_fixed_point_vmappable(tol: float, max_iter: int,
 def solve_household(R, W, model: SimpleModel, disc_fac, crra,
                     tol: float = 1e-6, max_iter: int = 3000,
                     init_policy: HouseholdPolicy | None = None,
-                    accel_every: int = 32, method: str = "xla"):
+                    accel_every: int = 32, method: str = "xla",
+                    precision: str = "reference",
+                    return_phases: bool = False,
+                    descent_fault_iter: int | None = None,
+                    descent_fault_mode: str = "nan"):
     """Infinite-horizon EGM fixed point via ``lax.while_loop``.
 
     Convergence is sup-norm on the consumption knots — the array analog of
     HARK's ConsumerSolution distance the reference's agent loop uses
     (SURVEY.md §3.1).  Returns (policy, n_iter, final_diff, status) with
-    ``status`` a ``solver_health`` code.
+    ``status`` a ``solver_health`` code; with ``return_phases=True`` a
+    trailing ``PrecisionPhases`` rides along (descent/polish step split +
+    the escalation flag — all zeros-descent under "reference").
 
     ``init_policy`` warm-starts the iteration (e.g. the previous bisection
     midpoint's policy — nearby prices → nearby fixed points → far fewer
@@ -329,34 +521,77 @@ def solve_household(R, W, model: SimpleModel, disc_fac, crra,
     engines run the SAME iteration code (``accelerated_policy_fixed_point``
     + ``egm_step``), so they take the same iteration path (same step
     count, same status); values agree to float-fusion noise.
+
+    ``precision`` (DESIGN §5, ``utils.config.PRECISION_POLICIES``):
+    "reference" (default) is today's single-phase solve, bit-identical;
+    "mixed" runs the two-phase ladder (cheap-dtype descent to a coarse
+    tolerance, reference polish to ``tol`` — contract unchanged); "fast"
+    is descent-only (tolerance relaxed to the cheap floor).  The VMEM
+    kernel runs a single-precision program, so non-reference policies
+    demote ``method`` to "xla".  ``descent_fault_iter`` (tests) wraps the
+    DESCENT step with ``solver_health.inject_fault`` from that iteration
+    — the deterministic trigger for the escalation path.
     """
+    spec = resolve_precision(precision)
     p0 = initial_policy(model) if init_policy is None else init_policy
-    if method == "auto":
-        from ..ops.pallas_kernels import pallas_egm_grid_tpu_available
-        on_tpu = jax.default_backend() in ("tpu", "axon")
-        method = ("pallas" if on_tpu and pallas_egm_grid_tpu_available()
-                  else "xla")
-    if method == "pallas":
-        dt = model.a_grid.dtype
-        scalars = jnp.stack([jnp.asarray(R, dtype=dt),
-                             jnp.asarray(W, dtype=dt),
-                             jnp.asarray(disc_fac, dtype=dt),
-                             jnp.asarray(crra, dtype=dt),
-                             jnp.asarray(model.borrow_limit, dtype=dt)])
-        fp = _pallas_egm_fixed_point_vmappable(float(tol), int(max_iter),
-                                               int(accel_every))
-        m, c, it, diff = fp(p0.m_knots, p0.c_knots, model.a_grid,
-                            model.labor_levels, model.transition, scalars)
-        # status reconstructed outside the kernel boundary: this loop has
-        # no stall exit, so (iters, diff) classify it exactly
-        return (HouseholdPolicy(m_knots=m, c_knots=c), it, diff,
-                classify_fixed_point_exit(diff, tol, it, max_iter))
-    if method != "xla":
+    if not spec.two_phase:
+        if method == "auto":
+            from ..ops.pallas_kernels import pallas_egm_grid_tpu_available
+            on_tpu = jax.default_backend() in ("tpu", "axon")
+            method = ("pallas" if on_tpu and pallas_egm_grid_tpu_available()
+                      else "xla")
+        if method == "pallas":
+            dt = model.a_grid.dtype
+            scalars = jnp.stack([jnp.asarray(R, dtype=dt),
+                                 jnp.asarray(W, dtype=dt),
+                                 jnp.asarray(disc_fac, dtype=dt),
+                                 jnp.asarray(crra, dtype=dt),
+                                 jnp.asarray(model.borrow_limit, dtype=dt)])
+            fp = _pallas_egm_fixed_point_vmappable(float(tol), int(max_iter),
+                                                   int(accel_every))
+            m, c, it, diff = fp(p0.m_knots, p0.c_knots, model.a_grid,
+                                model.labor_levels, model.transition,
+                                scalars)
+            # status reconstructed outside the kernel boundary: this loop
+            # has no stall exit, so (iters, diff) classify it exactly
+            out = (HouseholdPolicy(m_knots=m, c_knots=c), it, diff,
+                   classify_fixed_point_exit(diff, tol, it, max_iter))
+            return _with_phases(out, return_phases)
+        if method != "xla":
+            raise ValueError(f"method must be 'xla', 'pallas' or 'auto', "
+                             f"got {method!r}")
+        out = accelerated_policy_fixed_point(
+            lambda p: egm_step(p, R, W, model, disc_fac, crra),
+            p0, tol, max_iter, accel_every)
+        return _with_phases(out, return_phases)
+
+    # -- mixed / fast: the two-phase ladder (DESIGN §5) --------------------
+    if method not in ("xla", "auto", "pallas"):
         raise ValueError(f"method must be 'xla', 'pallas' or 'auto', "
                          f"got {method!r}")
-    return accelerated_policy_fixed_point(
+    cheap = descent_dtype(model.a_grid.dtype)
+    model_c = cast_floating(model, cheap)
+    Rc = jnp.asarray(R).astype(cheap)
+    Wc = jnp.asarray(W).astype(cheap)
+    bc = jnp.asarray(disc_fac).astype(cheap)
+    cc = jnp.asarray(crra).astype(cheap)
+
+    def step_cheap(p):
+        return egm_step(p, Rc, Wc, model_c, bc, cc,
+                        matmul_precision=DESCENT_MATMUL_PRECISION)
+
+    if descent_fault_iter is not None:
+        step_cheap = inject_fault(step_cheap, descent_fault_mode,
+                                  at_iter=descent_fault_iter,
+                                  amplitude=10.0 * descent_tolerance(
+                                      tol, cheap, POLICY_DESCENT_TOL_SCALE))
+    pol, it, diff, status, phases = ladder_policy_fixed_point(
+        step_cheap,
         lambda p: egm_step(p, R, W, model, disc_fac, crra),
-        p0, tol, max_iter, accel_every)
+        p0, tol,
+        descent_tolerance(tol, cheap, POLICY_DESCENT_TOL_SCALE),
+        max_iter, accel_every, polish=spec.polish, cheap_dtype=cheap)
+    return _with_phases((pol, it, diff, status), return_phases, phases)
 
 
 def consumption_at(policy: HouseholdPolicy, m, state_idx=None):
@@ -409,16 +644,22 @@ def dense_wealth_operator(trans: WealthTransition,
     return S
 
 
-def _push_forward_dense(dist, S, transition_matrix):
+def _push_forward_dense(dist, S, transition_matrix,
+                        matmul_precision=jax.lax.Precision.HIGHEST):
     """One distribution step as dense matmuls: per-state lottery matvec,
-    then the labor-state mixing matmul."""
-    moved = jnp.einsum("ndk,kn->dn", S, dist,
-                       precision=jax.lax.Precision.HIGHEST)
-    return jnp.matmul(moved, transition_matrix,
-                      precision=jax.lax.Precision.HIGHEST)
+    then the labor-state mixing matmul.  HIGHEST by default (thousands of
+    push-forward steps compound the TPU bf16 matmul default into visible
+    mass error); the ladder's descent phase passes DEFAULT — bf16 MXU
+    inputs, accumulation pinned to the iterate dtype (DESIGN §5): this is
+    the matmul the MXU-eligibility claim is about."""
+    moved = jnp.einsum("ndk,kn->dn", S, dist, precision=matmul_precision,
+                       preferred_element_type=dist.dtype)
+    return jnp.matmul(moved, transition_matrix, precision=matmul_precision,
+                      preferred_element_type=dist.dtype)
 
 
-def _push_forward(dist, trans: WealthTransition, transition_matrix):
+def _push_forward(dist, trans: WealthTransition, transition_matrix,
+                  matmul_precision=jax.lax.Precision.HIGHEST):
     """One distribution-iteration step: scatter mass along the asset lottery,
     then mix labor states with a [D,N]x[N,N] matmul."""
     d_size = dist.shape[0]
@@ -431,10 +672,9 @@ def _push_forward(dist, trans: WealthTransition, transition_matrix):
 
     moved = jax.vmap(scatter_one_state, in_axes=1, out_axes=1)(
         dist, trans.idx, trans.weight)
-    # precision=HIGHEST: thousands of push-forward steps compound the TPU
-    # bf16 matmul default into visible mass-distribution error.
-    return jnp.matmul(moved, transition_matrix,
-                      precision=jax.lax.Precision.HIGHEST)
+    # precision semantics: _push_forward_dense
+    return jnp.matmul(moved, transition_matrix, precision=matmul_precision,
+                      preferred_element_type=dist.dtype)
 
 
 @functools.lru_cache(maxsize=None)
@@ -500,7 +740,10 @@ def _pallas_fixed_point_vmappable(tol: float, max_iter: int,
 def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
                       tol: float = 1e-11, max_iter: int = 20000,
                       init_dist=None, accel_every: int = 64,
-                      method: str = "auto"):
+                      method: str = "auto", precision: str = "reference",
+                      return_phases: bool = False,
+                      descent_fault_iter: int | None = None,
+                      descent_fault_mode: str = "nan"):
     """Stationary joint distribution over (wealth, labor state), [D, N].
 
     Returns (dist, n_iter, final_diff, status) — ``status`` a
@@ -534,11 +777,31 @@ def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
     the fixed point with one dense LU solve + refinement (uniform cost per
     cell — the skew-free choice under a vmapped sweep, see
     ``_stationary_solve``); "auto" picks by backend and size.
+
+    ``precision`` (DESIGN §5): "reference" (default) is the single-phase
+    solve, bit-identical to pre-ladder behavior; "mixed" runs the
+    cheap-dtype descent + reference polish ladder (tolerance contract
+    unchanged); "fast" is descent-only.  Under a non-reference policy the
+    VMEM kernel demotes to "dense" (the kernel runs a single-precision
+    program) and "auto" prefers "dense" on accelerators — the descent
+    phase's DEFAULT-precision matmuls are what makes the dense operator
+    MXU-eligible; "solve" ignores the ladder (LU + certified refinement
+    is already a direct-then-polish scheme).  ``return_phases`` appends a
+    ``PrecisionPhases``; ``descent_fault_iter`` (tests) poisons the
+    descent phase via ``solver_health.inject_fault``.
     """
+    spec = resolve_precision(precision)
     trans = wealth_transition(policy, R, W, model)
     dist0 = initial_distribution(model) if init_dist is None else init_dist
     d_size = model.dist_grid.shape[0]
     n = model.labor_levels.shape[0]
+    if spec.two_phase and method in ("auto", "pallas"):
+        # the ladder's method table: the kernel runs ONE precision, so the
+        # descent/polish split needs the XLA paths; on accelerators the
+        # dense operator is the MXU path, everywhere else scatter wins
+        on_tpu = jax.default_backend() in ("tpu", "axon")
+        op_bytes = n * d_size * d_size * dist0.dtype.itemsize
+        method = "dense" if (on_tpu and op_bytes <= 2 ** 31) else "scatter"
     if method == "auto":
         # TPU backends ("axon" is the tunneled TPU platform here) prefer the
         # VMEM-resident Pallas kernel, probed once per process because Mosaic
@@ -570,11 +833,13 @@ def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
         # The kernel's stats contract stays (iters, diff); the status is
         # fully reconstructible outside: a finite diff > tol before
         # max_iter can only be the stall window.
-        return dist, it, diff, classify_fixed_point_exit(diff, tol, it,
-                                                         max_iter)
+        out = (dist, it, diff, classify_fixed_point_exit(diff, tol, it,
+                                                         max_iter))
+        return _with_phases(out, return_phases)
     if method == "solve":
         S = dense_wealth_operator(trans, d_size)
-        return _stationary_solve(S, model.transition, dist0, tol)
+        out = _stationary_solve(S, model.transition, dist0, tol)
+        return _with_phases(out, return_phases)
     if method == "dense":
         S = dense_wealth_operator(trans, d_size)
         push = lambda d: _push_forward_dense(d, S, model.transition)  # noqa: E731
@@ -583,8 +848,32 @@ def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
     else:
         raise ValueError(f"method must be 'auto', 'scatter', 'dense', "
                          f"'pallas' or 'solve', got {method!r}")
-    return accelerated_distribution_fixed_point(
-        push, dist0, tol, max_iter, accel_every)
+    if not spec.two_phase:
+        out = accelerated_distribution_fixed_point(
+            push, dist0, tol, max_iter, accel_every)
+        return _with_phases(out, return_phases)
+
+    # -- mixed / fast: the two-phase ladder (DESIGN §5) --------------------
+    cheap = descent_dtype(dist0.dtype)
+    P_c = model.transition.astype(cheap)
+    if method == "dense":
+        S_c = S.astype(cheap)
+        push_cheap = lambda d: _push_forward_dense(  # noqa: E731
+            d, S_c, P_c, matmul_precision=DESCENT_MATMUL_PRECISION)
+    else:
+        trans_c = cast_floating(trans, cheap)
+        push_cheap = lambda d: _push_forward(  # noqa: E731
+            d, trans_c, P_c, matmul_precision=DESCENT_MATMUL_PRECISION)
+    if descent_fault_iter is not None:
+        push_cheap = inject_fault(
+            push_cheap, descent_fault_mode, at_iter=descent_fault_iter,
+            amplitude=10.0 * descent_tolerance(tol, cheap,
+                                               DIST_DESCENT_TOL_SCALE))
+    dist, it, diff, status, phases = ladder_distribution_fixed_point(
+        push_cheap, push, dist0, tol,
+        descent_tolerance(tol, cheap, DIST_DESCENT_TOL_SCALE),
+        max_iter, accel_every, polish=spec.polish, cheap_dtype=cheap)
+    return _with_phases((dist, it, diff, status), return_phases, phases)
 
 
 def _stationary_solve(S, transition, dist0, tol, refine: int = 2,
@@ -619,7 +908,8 @@ def _stationary_solve(S, transition, dist0, tol, refine: int = 2,
     lu, piv = jax.scipy.linalg.lu_factor(B)
     x = jax.scipy.linalg.lu_solve((lu, piv), rhs)
     for _ in range(refine):
-        resid = rhs - jnp.matmul(B, x, precision=jax.lax.Precision.HIGHEST)
+        resid = rhs - jnp.matmul(B, x, precision=jax.lax.Precision.HIGHEST,
+                                 preferred_element_type=x.dtype)
         x = x + jax.scipy.linalg.lu_solve((lu, piv), resid)
     x = jnp.clip(x, 0.0, None)
     dist = (x / jnp.sum(x)).reshape(d, n)

@@ -57,14 +57,18 @@ class HuggettEquilibrium(NamedTuple):
 def net_bond_demand(r, model: SimpleModel, disc_fac, crra,
                     egm_tol=1e-6, dist_tol=1e-11,
                     init_policy_=None, init_dist=None,
-                    dist_method: str = "auto"):
+                    dist_method: str = "auto",
+                    precision: str = "reference"):
     """E[a] at rate ``r``: aggregate net bond position of the household
-    sector (positive = net savers).  Endowment economy: R = 1 + r, W = 1."""
+    sector (positive = net savers).  Endowment economy: R = 1 + r, W = 1.
+    ``precision`` threads the mixed-precision ladder (DESIGN §5) into
+    both inner fixed points."""
     policy, _, _, _ = solve_household(1.0 + r, 1.0, model, disc_fac, crra,
-                                   tol=egm_tol, init_policy=init_policy_)
+                                   tol=egm_tol, init_policy=init_policy_,
+                                   precision=precision)
     dist, _, _, _ = stationary_wealth(policy, 1.0 + r, 1.0, model,
                                    tol=dist_tol, init_dist=init_dist,
-                                   method=dist_method)
+                                   method=dist_method, precision=precision)
     return aggregate_capital(dist, model), policy, dist
 
 
@@ -74,7 +78,8 @@ def solve_huggett_equilibrium(model: SimpleModel, disc_fac, crra,
                               egm_tol: float | None = None,
                               dist_tol: float | None = None,
                               r_lo: float = -0.10,
-                              dist_method: str = "auto"
+                              dist_method: str = "auto",
+                              precision: str = "reference"
                               ) -> HuggettEquilibrium:
     """Bisect the bond rate until the credit market clears (E[a] = 0).
 
@@ -114,12 +119,14 @@ def solve_huggett_equilibrium(model: SimpleModel, disc_fac, crra,
                          lo - (2.0 ** k) * 0.1)
         ex, _, _ = net_bond_demand(lo, model, disc_fac, crra,
                                    egm_tol=egm_tol, dist_tol=dist_tol,
-                                   dist_method=dist_method)
+                                   dist_method=dist_method,
+                                   precision=precision)
         return lo, ex, k + 1
 
     ex_lo0, _, _ = net_bond_demand(lo0, model, disc_fac, crra,
                                    egm_tol=egm_tol, dist_tol=dist_tol,
-                                   dist_method=dist_method)
+                                   dist_method=dist_method,
+                                   precision=precision)
     lo0, ex_lo, _ = jax.lax.while_loop(widen_cond, widen_body,
                                        (lo0, ex_lo0, zi))
     bracketed = ex_lo <= 0
@@ -133,7 +140,8 @@ def solve_huggett_equilibrium(model: SimpleModel, disc_fac, crra,
         mid = 0.5 * (lo + hi)
         ex, policy, dist = net_bond_demand(
             mid, model, disc_fac, crra, egm_tol=egm_tol, dist_tol=dist_tol,
-            init_policy_=policy, init_dist=dist, dist_method=dist_method)
+            init_policy_=policy, init_dist=dist, dist_method=dist_method,
+            precision=precision)
         lo = jnp.where(ex > 0, lo, mid)
         hi = jnp.where(ex > 0, mid, hi)
         return lo, hi, it + 1, policy, dist
@@ -143,7 +151,8 @@ def solve_huggett_equilibrium(model: SimpleModel, disc_fac, crra,
     r_star = 0.5 * (lo + hi)
     ex, policy, dist = net_bond_demand(
         r_star, model, disc_fac, crra, egm_tol=egm_tol, dist_tol=dist_tol,
-        init_policy_=policy, init_dist=dist, dist_method=dist_method)
+        init_policy_=policy, init_dist=dist, dist_method=dist_method,
+        precision=precision)
     borrowers = jnp.sum(jnp.where(model.dist_grid[:, None] < 0, dist, 0.0))
     return HuggettEquilibrium(r_star=r_star, net_demand=ex, policy=policy,
                               distribution=dist, borrower_share=borrowers,

@@ -176,11 +176,13 @@ def initial_ks_policy(cal: KSCalibration) -> KSPolicy:
 
 
 def egm_step_ks(policy: KSPolicy, pre: PrecomputedArrays,
-                cal: KSCalibration) -> KSPolicy:
+                cal: KSCalibration,
+                matmul_precision=jax.lax.Precision.HIGHEST) -> KSPolicy:
     """One EGM backward step over the ``[A, Mc, S]`` block
     (``solve_Aiyagari``, ``Aiyagari_Support.py:1423-1520``, as pure array
     math: the 28-interpolator Python loop becomes a vmapped two-level interp,
-    the probability-weighted sum becomes one matmul)."""
+    the probability-weighted sum becomes one matmul).  ``matmul_precision``
+    follows ``household.egm_step``'s ladder semantics (DESIGN §5)."""
     # c'(m', M') for every next state: vmap over (Mc, S') columns; each
     # column interpolates the A-vector of m' queries at scalar M'.
     def eval_col(m_col, M_scalar, s_idx):
@@ -200,7 +202,8 @@ def egm_step_ks(policy: KSPolicy, pre: PrecomputedArrays,
     # EndOfPrdvP[a, mc, s] = beta * sum_{s'} P[s, s'] weighted[a, mc, s']
     end_vp = cal.disc_fac * jnp.einsum("ams,ks->amk", weighted,
                                        cal.ind_transition,
-                                       precision=jax.lax.Precision.HIGHEST)
+                                       precision=matmul_precision,
+                                       preferred_element_type=weighted.dtype)
     c_now = inverse_marginal_utility(end_vp, cal.crra)    # [A, Mc, S]
     m_now = cal.a_grid[:, None, None] + c_now
     eps = jnp.full((1,) + c_now.shape[1:], CONSTRAINT_EPS, dtype=c_now.dtype)
@@ -213,7 +216,8 @@ def egm_step_ks(policy: KSPolicy, pre: PrecomputedArrays,
 def solve_ks_household(afunc: AFuncParams, cal: KSCalibration,
                        tol: float = 1e-6, max_iter: int = 2000,
                        init_policy: KSPolicy | None = None,
-                       accel_every: int = 32):
+                       accel_every: int = 32,
+                       precision: str = "reference"):
     """Infinite-horizon fixed point of the 4N-state EGM step under the given
     perceived aggregate law.  Sup-norm convergence on consumption knots (the
     array analog of HARK's solution distance).  Returns
@@ -227,10 +231,37 @@ def solve_ks_household(afunc: AFuncParams, cal: KSCalibration,
     shared safeguarded machinery of
     ``household.accelerated_policy_fixed_point`` (KSPolicy carries the
     same ``m_knots``/``c_knots`` interface).  0 disables.
-    """
-    from .household import accelerated_policy_fixed_point
 
+    ``precision`` (DESIGN §5): "reference" (default) is the single-phase
+    solve, bit-identical to pre-ladder behavior; "mixed"/"fast" run the
+    cheap-dtype descent (+ reference polish) ladder exactly as the
+    compact Aiyagari policy loop does (``household.solve_household``).
+    """
+    from ..utils.config import resolve_precision
+    from .household import (
+        POLICY_DESCENT_TOL_SCALE,
+        accelerated_policy_fixed_point,
+        cast_floating,
+        descent_dtype,
+        descent_tolerance,
+        ladder_policy_fixed_point,
+        DESCENT_MATMUL_PRECISION,
+    )
+
+    spec = resolve_precision(precision)
     pre = precompute(afunc, cal)
     p0 = initial_ks_policy(cal) if init_policy is None else init_policy
-    return accelerated_policy_fixed_point(
-        lambda p: egm_step_ks(p, pre, cal), p0, tol, max_iter, accel_every)
+    if not spec.two_phase:
+        return accelerated_policy_fixed_point(
+            lambda p: egm_step_ks(p, pre, cal), p0, tol, max_iter,
+            accel_every)
+    cheap = descent_dtype(cal.a_grid.dtype)
+    cal_c = cast_floating(cal, cheap)
+    pre_c = cast_floating(pre, cheap)
+    pol, it, diff, status, _ = ladder_policy_fixed_point(
+        lambda p: egm_step_ks(p, pre_c, cal_c,
+                              matmul_precision=DESCENT_MATMUL_PRECISION),
+        lambda p: egm_step_ks(p, pre, cal),
+        p0, tol, descent_tolerance(tol, cheap, POLICY_DESCENT_TOL_SCALE),
+        max_iter, accel_every, polish=spec.polish, cheap_dtype=cheap)
+    return pol, it, diff, status

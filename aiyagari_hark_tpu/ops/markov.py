@@ -90,26 +90,55 @@ def normalized_labor_states(tauchen_grid: jnp.ndarray) -> jnp.ndarray:
     return levels / jnp.mean(levels)
 
 
-def stationary_distribution(transition: jnp.ndarray, iters: int = 2000) -> jnp.ndarray:
+def stationary_distribution(transition: jnp.ndarray, iters: int = 2000,
+                            precision: str = "reference") -> jnp.ndarray:
     """Stationary row vector of a row-stochastic matrix by power iteration.
 
     Power iteration (rather than an eigensolver) keeps this jit-able and
     backend-agnostic; ``iters`` matmuls of an [n,n] matrix are negligible.
+
+    ``precision`` (DESIGN §5): "reference" AND "mixed" run every squaring
+    at HIGHEST — TPU f32 matmuls default to bf16 inputs and repeated
+    squaring amplifies that rounding into percent-level stationary-mass
+    errors, and for a persistent chain no affordable fixed polish can
+    contract that error back out (a power-step polish contracts at the
+    subdominant eigenvalue rate, which is exactly what is close to 1
+    here).  This fixed point is a handful of [n,n] (n<=28) matmuls, so a
+    cheap descent phase has nothing to save: "mixed" deliberately equals
+    "reference", keeping its certified-accuracy contract.  Only "fast"
+    (tolerance contract relaxed by definition) runs the squarings at
+    DEFAULT precision with a short HIGHEST power-step polish against the
+    original matrix — approximate, for exploratory work.
     """
+    from ..utils.config import resolve_precision
+
+    spec = resolve_precision(precision)
+    cheap = spec.two_phase and not spec.polish   # "fast" only; see above
     n = transition.shape[0]
     pi = jnp.full((n,), 1.0 / n, dtype=transition.dtype)
     # Squaring the matrix log2(iters) times converges geometrically faster
     # than repeated vector products and is still a handful of tiny matmuls.
     mat = transition
     steps = max(1, math.ceil(math.log2(iters)))
-    # precision=HIGHEST: TPU f32 matmuls default to bf16 inputs; repeated
-    # squaring amplifies that rounding into percent-level stationary-mass
-    # errors, so force the full-precision path (these are [n,n], n<=28).
+    sq_precision = (jax.lax.Precision.DEFAULT if cheap
+                    else jax.lax.Precision.HIGHEST)
     for _ in range(steps):
-        mat = jnp.matmul(mat, mat, precision=jax.lax.Precision.HIGHEST)
+        mat = jnp.matmul(mat, mat, precision=sq_precision,
+                         preferred_element_type=mat.dtype)
         mat = mat / jnp.sum(mat, axis=1, keepdims=True)
-    pi = jnp.matmul(pi, mat, precision=jax.lax.Precision.HIGHEST)
-    return pi / jnp.sum(pi)
+    pi = jnp.matmul(pi, mat, precision=sq_precision,
+                    preferred_element_type=pi.dtype)
+    pi = pi / jnp.sum(pi)
+    if cheap:
+        # best-effort polish: HIGHEST power steps against the exact
+        # one-step matrix (contracts at the subdominant rate — enough for
+        # well-mixing chains, approximate for persistent ones)
+        for _ in range(8):
+            pi = jnp.matmul(pi, transition,
+                            precision=jax.lax.Precision.HIGHEST,
+                            preferred_element_type=pi.dtype)
+            pi = pi / jnp.sum(pi)
+    return pi
 
 
 def aggregate_markov_matrix(dur_mean_b: float, dur_mean_g: float,

@@ -49,10 +49,12 @@ def _fixed_point_kernel(S_ref, P_ref, d0_ref, out_ref, stats_ref, *,
         # ("#tpu.dot_dimension_numbers ... expected integer value" on a
         # v5-lite), and N is a small static constant anyway
         cols = [jnp.matmul(S[i], dist[:, i:i + 1],
-                           precision=jax.lax.Precision.HIGHEST)
+                           precision=jax.lax.Precision.HIGHEST,
+                           preferred_element_type=dist.dtype)
                 for i in range(n_states)]
         moved = jnp.concatenate(cols, axis=1)
-        return jnp.matmul(moved, P, precision=jax.lax.Precision.HIGHEST)
+        return jnp.matmul(moved, P, precision=jax.lax.Precision.HIGHEST,
+                          preferred_element_type=dist.dtype)
 
     # status is dropped at the kernel boundary: the (iters, diff) stats
     # pair reconstructs it exactly (see ``stationary_wealth``)
@@ -110,10 +112,12 @@ def _fixed_point_kernel_lane(S_ref, P_ref, d0_ref, out_ref, stats_ref, *,
 
     def push(dist):
         cols = [jnp.matmul(S[i], dist[:, i:i + 1],
-                           precision=jax.lax.Precision.HIGHEST)
+                           precision=jax.lax.Precision.HIGHEST,
+                           preferred_element_type=dist.dtype)
                 for i in range(n_states)]
         moved = jnp.concatenate(cols, axis=1)
-        return jnp.matmul(moved, P, precision=jax.lax.Precision.HIGHEST)
+        return jnp.matmul(moved, P, precision=jax.lax.Precision.HIGHEST,
+                          preferred_element_type=dist.dtype)
 
     dist, it, diff, _ = accelerated_distribution_fixed_point(
         push, d0, tol, max_iter, accel_every)

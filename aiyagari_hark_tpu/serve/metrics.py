@@ -76,12 +76,25 @@ class ServeMetrics:
         self.latency = {p: LatencyHistogram() for p in PATHS}
         self.latency_all = LatencyHistogram()
         self.compile = CompileCounter()
+        # precision-ladder phase accounting (DESIGN §5): per-phase inner
+        # steps of every solved (non-hit) query, and how many inner fixed
+        # points escalated descent -> reference
+        self.descent_steps = 0
+        self.polish_steps = 0
+        self.precision_escalations = 0
 
     def record_served(self, path: str, latency_s: float) -> None:
         with self._lock:
             self.served[path] += 1
             self.latency[path].add(latency_s)
             self.latency_all.add(latency_s)
+
+    def record_phases(self, descent: int, polish: int,
+                      escalations: int) -> None:
+        with self._lock:
+            self.descent_steps += int(descent)
+            self.polish_steps += int(polish)
+            self.precision_escalations += int(escalations)
 
     def record_failure(self, latency_s: float) -> None:
         with self._lock:
@@ -129,4 +142,12 @@ class ServeMetrics:
                 "serve_compiles": self.compile.compile_events,
                 "serve_compile_cache_misses": self.compile.cache_misses,
                 "serve_compile_s": round(self.compile.compile_seconds, 3),
+                "serve_descent_steps": self.descent_steps,
+                "serve_polish_steps": self.polish_steps,
+                "serve_polish_frac": (
+                    None if self.descent_steps + self.polish_steps == 0
+                    else round(self.polish_steps
+                               / (self.descent_steps + self.polish_steps),
+                               4)),
+                "serve_precision_escalations": self.precision_escalations,
             }

@@ -152,6 +152,11 @@ class ServedResult(NamedTuple):
     path: str                       # "hit" | "near" | "cold"
     bracket_init: Optional[tuple]   # (lo, hi, levels) launched with
     key: int                        # solution_fingerprint
+    descent_steps: int = 0          # precision-ladder cheap-phase steps
+    polish_steps: int = 0           # reference-phase steps (== the total
+    #                                 under precision="reference")
+    precision_escalations: int = 0  # ladder descent→reference fallbacks
+    #                                 (solver_health.PRECISION_ESCALATED)
 
 
 def _result_from_row(row: np.ndarray, path: str, bracket_init,
@@ -160,7 +165,10 @@ def _result_from_row(row: np.ndarray, path: str, bracket_init,
         r_star=float(row[0]), capital=float(row[1]), labor=float(row[2]),
         bisect_iters=int(np.rint(row[3])), egm_iters=int(np.rint(row[4])),
         dist_iters=int(np.rint(row[5])), status=int(np.rint(row[6])),
-        path=path, bracket_init=bracket_init, key=int(key))
+        path=path, bracket_init=bracket_init, key=int(key),
+        descent_steps=int(np.rint(row[7])),
+        polish_steps=int(np.rint(row[8])),
+        precision_escalations=int(np.rint(row[9])))
 
 
 class _Pending(NamedTuple):
@@ -357,6 +365,8 @@ class EquilibriumService:
                                              p.query.key()))
             p.future.set_result(res)
             self.metrics.record_served(path, now - p.t_submit)
+            self.metrics.record_phases(res.descent_steps, res.polish_steps,
+                                       res.precision_escalations)
 
     # -- pumping / lifecycle ------------------------------------------------
 

@@ -38,24 +38,27 @@ import numpy as np
 
 from ..solver_health import is_failure
 from ..utils.checkpoint import CORRUPT_NPZ_ERRORS, load_pytree, save_pytree
+from ..utils.config import PACKED_ROW_WIDTH
 
 
 class StoredSolution(NamedTuple):
     """One cached equilibrium, npz-able as a pytree (disk tier).
 
-    ``packed`` is the batched solver's device row
-    ``[r_star, K, L, bisect, egm, dist, status]`` in float64 — float64
-    round-trips npz bit-exactly and holds every narrower compute dtype
-    exactly, so a reload serves the original bits."""
+    ``packed`` is the batched solver's device row in the
+    ``config.PACKED_ROW_FIELDS`` layout, in float64 — float64 round-trips
+    npz bit-exactly and holds every narrower compute dtype exactly, so a
+    reload serves the original bits.  A pre-widening disk entry fails the
+    template load and degrades like any corrupt entry."""
 
     cell: np.ndarray    # [3] (σ, ρ, sd) float64
-    packed: np.ndarray  # [7] float64
+    packed: np.ndarray  # [PACKED_ROW_WIDTH] float64
     group: np.ndarray   # scalar int64 — work_fingerprint (solver config)
     key: np.ndarray     # scalar int64 — solution_fingerprint (full address)
 
 
 def _template() -> StoredSolution:
-    return StoredSolution(cell=np.zeros(3), packed=np.zeros(7),
+    return StoredSolution(cell=np.zeros(3),
+                          packed=np.zeros(PACKED_ROW_WIDTH),
                           group=np.zeros((), np.int64),
                           key=np.zeros((), np.int64))
 
@@ -136,6 +139,12 @@ class SolutionStore:
                 warnings.warn(f"solution store: skipping unreadable entry "
                               f"{path} ({e})", stacklevel=2)
                 continue
+            if sol.packed.shape != (PACKED_ROW_WIDTH,):
+                # pre-widening row layout: unreadable by this version
+                warnings.warn(f"solution store: skipping entry {path} with "
+                              f"stale row layout {sol.packed.shape}",
+                              stacklevel=2)
+                continue
             self._meta[int(sol.key)] = _Meta(
                 cell=tuple(np.asarray(sol.cell, dtype=np.float64)),
                 group=int(sol.group),
@@ -160,6 +169,12 @@ class SolutionStore:
             except CORRUPT_NPZ_ERRORS as e:
                 warnings.warn(f"solution store: entry {key} unreadable on "
                               f"disk ({e}); dropping it", stacklevel=2)
+                del self._meta[key]
+                return None
+            if sol.packed.shape != (PACKED_ROW_WIDTH,):
+                warnings.warn(f"solution store: entry {key} has a stale "
+                              f"row layout {sol.packed.shape}; dropping it",
+                              stacklevel=2)
                 del self._meta[key]
                 return None
             self._insert(key, sol)

@@ -61,6 +61,16 @@ INTERRUPTED = 4
 STATUS_NAMES = ("CONVERGED", "STALLED", "MAX_ITER", "NONFINITE",
                 "INTERRUPTED")
 
+# NOTE marker, not a status code (it never enters ``combine_status``): a
+# mixed-precision ladder's DESCENT phase exited NONFINITE or STALLED and
+# the fixed point fell back to a pure-reference solve before quarantine
+# could see a failure (DESIGN §5).  The final status is the reference
+# polish's honest exit; this note records that the cheap phase was
+# abandoned.  Surfaces as ``SweepResult.precision_escalations`` /
+# ``ServeMetrics`` counters and in status-trail dicts under the
+# ``"note"`` key.
+PRECISION_ESCALATED = "PRECISION_ESCALATED"
+
 
 def status_name(code) -> str:
     """Host-side pretty name for one integer status code."""

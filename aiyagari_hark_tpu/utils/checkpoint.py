@@ -202,18 +202,35 @@ class SweepSidecar(NamedTuple):
     bisection bracket toward its known root before the batch launches.
     Rows with a failure status carry NaN ``r_star`` (never seed from a
     quarantined cell) but keep their counters (a failed cell's work is
-    still the best cost estimate available)."""
+    still the best cost estimate available).
+
+    ``descent_steps``/``polish_steps`` split the counters by
+    precision-ladder phase (DESIGN §5; all zeros for a "reference"-policy
+    run), so ``total_work()`` can weight a cheap descent step by its
+    measured relative cost (``config.DESCENT_STEP_COST``) — without the
+    weighting a mixed-policy sidecar would overstate the cost of cells
+    whose work is mostly cheap steps and the scheduler's buckets would
+    drift off balance.  Adding the columns is a sidecar format change:
+    an old-format file fails the pytree template load and the scheduler
+    degrades to its heuristic, exactly like any corrupt sidecar."""
 
     cells: np.ndarray         # [C, 3] (σ, ρ, sd), float64
     r_star: np.ndarray        # [C] net rate at the certified root; NaN=failed
     bisect_iters: np.ndarray  # [C] int64 excess evaluations
     egm_iters: np.ndarray     # [C] int64 total EGM backward steps
     dist_iters: np.ndarray    # [C] int64 total distribution steps
+    descent_steps: np.ndarray  # [C] int64 cheap-phase inner steps
+    polish_steps: np.ndarray   # [C] int64 reference-phase inner steps
     status: np.ndarray        # [C] int64 solver_health codes
     fingerprint: np.ndarray   # scalar int64 — solver-config hash
 
     def total_work(self) -> np.ndarray:
-        return self.egm_iters + self.dist_iters
+        """Reference-precision-equivalent per-cell work: every step, with
+        descent-phase steps weighted by their measured relative cost."""
+        from .config import DESCENT_STEP_COST
+
+        total = (self.egm_iters + self.dist_iters).astype(np.float64)
+        return total - (1.0 - DESCENT_STEP_COST) * self.descent_steps
 
     def lookup(self, cell, decimals: int = 9):
         """Row index of ``cell`` = (σ, ρ, sd) (rounded match), or None."""
@@ -224,15 +241,25 @@ class SweepSidecar(NamedTuple):
 
 
 def save_sweep_sidecar(path: str, cells, r_star, bisect_iters, egm_iters,
-                       dist_iters, status, fingerprint: int) -> None:
+                       dist_iters, status, fingerprint: int,
+                       descent_steps=None, polish_steps=None) -> None:
     """Persist a sweep's per-cell record for the next run's scheduler
-    (atomic npz via ``save_pytree``)."""
+    (atomic npz via ``save_pytree``).  ``descent_steps``/``polish_steps``
+    default to the all-reference split (zero descent)."""
+    n = len(np.asarray(r_star))
+    if descent_steps is None:
+        descent_steps = np.zeros(n, dtype=np.int64)
+    if polish_steps is None:
+        polish_steps = (np.asarray(egm_iters, dtype=np.int64)
+                        + np.asarray(dist_iters, dtype=np.int64))
     save_pytree(path, SweepSidecar(
         cells=np.asarray(cells, dtype=np.float64),
         r_star=np.asarray(r_star, dtype=np.float64),
         bisect_iters=np.asarray(bisect_iters, dtype=np.int64),
         egm_iters=np.asarray(egm_iters, dtype=np.int64),
         dist_iters=np.asarray(dist_iters, dtype=np.int64),
+        descent_steps=np.asarray(descent_steps, dtype=np.int64),
+        polish_steps=np.asarray(polish_steps, dtype=np.int64),
         status=np.asarray(status, dtype=np.int64),
         fingerprint=np.asarray(fingerprint, np.int64)))
 
@@ -251,7 +278,9 @@ def load_sweep_sidecar(path: str, fingerprint: int) -> SweepSidecar:
     tmpl = SweepSidecar(
         cells=np.zeros((n, 3)), r_star=np.zeros(n),
         bisect_iters=np.zeros(n, np.int64), egm_iters=np.zeros(n, np.int64),
-        dist_iters=np.zeros(n, np.int64), status=np.zeros(n, np.int64),
+        dist_iters=np.zeros(n, np.int64),
+        descent_steps=np.zeros(n, np.int64),
+        polish_steps=np.zeros(n, np.int64), status=np.zeros(n, np.int64),
         fingerprint=np.zeros((), np.int64))
     side = load_pytree(path, tmpl)
     if int(side.fingerprint) != int(fingerprint):

@@ -67,6 +67,90 @@ _ECONOMY_KEY_MAP = {
 }
 
 
+# -- precision policy (DESIGN §5) -------------------------------------------
+#
+# The hot fixed points (EGM expectation, distribution push-forward,
+# stationary power iteration) historically forced ``precision=HIGHEST``
+# end-to-end because fixed-point error compounds.  Measured (BENCH r5):
+# that buys 0.097 bp of f32-vs-f64 drift at 0.059% TPU MFU — reference
+# precision paid for thousands of descent iterations whose error the last
+# few iterations erase.  The precision POLICY makes that trade explicit:
+#
+# * ``"reference"`` (default) — today's behavior, bit-identical: every
+#   fixed point runs in the model dtype with HIGHEST-precision matmuls.
+# * ``"mixed"`` — two-phase ladder inside one jitted program: a DESCENT
+#   phase in a cheap dtype (f32 iterates for f64 models; bf16 matmul
+#   inputs with f32 accumulation via ``preferred_element_type`` +
+#   ``precision=DEFAULT`` on TPU) iterated to a coarse tolerance, then a
+#   POLISH phase that casts the iterate up and continues in the reference
+#   dtype with HIGHEST matmuls to the ORIGINAL tolerance.  The final
+#   tolerance contract and solver_health semantics are unchanged; a
+#   NONFINITE/STALLED descent falls back to a pure-reference solve
+#   (escalation — ``solver_health.PRECISION_ESCALATED``).
+# * ``"fast"`` — descent only: the cheap phase runs to the caller's
+#   tolerance floored at what the cheap dtype can certify, and NO polish
+#   runs.  This RELAXES the tolerance contract to the cheap-dtype floor —
+#   approximate answers for exploratory sweeps, never for goldens.
+
+PRECISION_POLICIES = ("reference", "mixed", "fast")
+
+# Measured relative cost of one descent-phase step vs one reference step
+# (CPU f32-vs-f64 vectorization roughly halves per-step cost; the TPU
+# bf16 MXU path is cheaper still, so 0.5 is the conservative weight).
+# Used wherever phase counters are collapsed into one work number: the
+# scheduler's sidecar work model (``checkpoint.SweepSidecar.total_work``)
+# and the reference-equivalent-step acceptance in ``tests/test_precision``.
+DESCENT_STEP_COST = 0.5
+
+
+@dataclass(frozen=True)
+class PrecisionSpec:
+    """Resolved ladder knobs for one precision policy (DESIGN §5)."""
+
+    policy: str
+    two_phase: bool          # a cheap-dtype descent phase runs
+    polish: bool             # the reference-precision polish phase runs
+    descent_step_cost: float  # per-step cost of a descent step, relative
+    #                           to a reference-precision step
+
+
+_PRECISION_SPECS = {
+    "reference": PrecisionSpec("reference", two_phase=False, polish=True,
+                               descent_step_cost=1.0),
+    "mixed": PrecisionSpec("mixed", two_phase=True, polish=True,
+                           descent_step_cost=DESCENT_STEP_COST),
+    "fast": PrecisionSpec("fast", two_phase=True, polish=False,
+                          descent_step_cost=DESCENT_STEP_COST),
+}
+
+
+def resolve_precision(policy) -> PrecisionSpec:
+    """Validate a precision policy name (or pass a spec through)."""
+    if isinstance(policy, PrecisionSpec):
+        return policy
+    try:
+        return _PRECISION_SPECS[policy]
+    except (KeyError, TypeError):
+        raise ValueError(
+            f"precision policy must be one of {PRECISION_POLICIES}, "
+            f"got {policy!r}") from None
+
+
+# Packed device-row layout of the batched cell solver: ONE stacked float
+# row per cell means ONE device->host transfer per launch (the round-5
+# packing rationale, ``parallel.sweep._batched_solver``).  The layout is
+# shared by the sweep, the resume ledger (``resilience.SweepLedger``),
+# and the serving store (``serve.SolutionStore``) — widening it is a
+# format change for all three, so the tuple lives HERE and the ledger
+# fingerprint hashes it (an old-width ledger refuses to resume instead of
+# crashing a restarted sweep).
+PACKED_ROW_FIELDS = ("r_star", "capital", "labor", "bisect_iters",
+                     "egm_iters", "dist_iters", "status",
+                     "descent_steps", "polish_steps",
+                     "precision_escalations")
+PACKED_ROW_WIDTH = len(PACKED_ROW_FIELDS)
+
+
 @dataclass(frozen=True)
 class AgentConfig:
     """Household-side parameters.  Defaults mirror ``init_Aiyagari_agents``

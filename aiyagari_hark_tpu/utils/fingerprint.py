@@ -26,6 +26,8 @@ import json
 
 import numpy as np
 
+from .config import PACKED_ROW_FIELDS, resolve_precision
+
 
 def config_fingerprint(*objs) -> int:
     """Deterministic int64 fingerprint of configs/arrays, used to detect
@@ -55,9 +57,26 @@ def hashable_kwargs(model_kwargs: dict) -> tuple:
     ``lru_cache`` key of the batched solver.  Sequences become tuples;
     anything still unhashable gets a clear error instead of ``lru_cache``'s
     bare TypeError.  Sorting makes the fingerprints insensitive to the
-    caller's keyword order."""
+    caller's keyword order.
+
+    Precision-policy normalization (DESIGN §5): an EXPLICIT
+    ``precision="reference"`` is dropped — it is the default, and the two
+    spellings produce bit-identical programs, so they must share one
+    executable cache entry and one fingerprint (sidecar work predictions,
+    sweep ledgers, and ``SolutionStore`` entries must never split — or
+    mix — on a no-op spelling).  Non-default policies stay in the items
+    and therefore key every cache downstream (the cross-policy inequality
+    pinned by ``tests/test_fingerprint.py``); an unknown policy fails
+    here, before it can silently alias a real one."""
     items = []
     for k, v in sorted(model_kwargs.items()):
+        if k == "precision":
+            # ONE validation surface: resolve_precision is the authority
+            # (an unknown policy raises here, before it can alias a real
+            # one in any cache key); hash the canonical policy name
+            v = resolve_precision(v).policy
+            if v == "reference":
+                continue
         if isinstance(v, (list, np.ndarray)):
             arr = np.asarray(v)
             if arr.ndim > 1:
@@ -109,8 +128,12 @@ def ledger_fingerprint(crra, rho, sd, kwargs_items: tuple, dtype,
     everything that shapes the result bits — cells (perturb included),
     solver kwargs, dtype, schedule knobs, fault injection, and the
     warm-start sidecar's CONTENT (seeds read it live, so a sidecar swapped
-    between interrupt and resume would silently change trajectories)."""
+    between interrupt and resume would silently change trajectories) — and
+    the packed-row LAYOUT (``config.PACKED_ROW_FIELDS``): a ledger written
+    under an older row width must refuse to resume instead of feeding
+    wrong-shaped rows into a restarted sweep."""
     return config_fingerprint(
+        repr(PACKED_ROW_FIELDS),
         crra, rho, sd, repr(kwargs_items), str(np.dtype(dtype)),
         schedule, int(n_buckets), bool(warm_brackets),
         float(warm_margin), str(fault_mode),

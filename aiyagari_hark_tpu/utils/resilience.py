@@ -56,6 +56,7 @@ from typing import Callable, NamedTuple, Optional
 import numpy as np
 
 from ..solver_health import INTERRUPTED, SolverDivergenceError
+from .config import PACKED_ROW_WIDTH
 from .checkpoint import (
     CORRUPT_NPZ_ERRORS,
     gc_orphaned_tmp,
@@ -396,15 +397,17 @@ class SweepLedger(NamedTuple):
     ``save_pytree``): per-cell packed solver outputs in ORIGINAL cell
     order plus the solved/retried bookkeeping the resume needs.
 
-    ``packed`` rows are the batched solver's exact device outputs
-    ``[r, K, L, bisect, egm, dist, status]`` (float64 round-trips npz
+    ``packed`` rows are the batched solver's exact device outputs in the
+    ``config.PACKED_ROW_FIELDS`` layout (float64 round-trips npz
     bit-exactly), so a resumed assembly is bit-identical to an
     uninterrupted one.  ``fingerprint`` covers everything that shapes
     those bits — cells (perturb included), solver kwargs, dtype, schedule
-    knobs, fault injection, and the warm-start sidecar's content — a
+    knobs, fault injection, the warm-start sidecar's content, AND the
+    row layout itself (a pre-widening ledger must refuse to resume) — a
     mismatch degrades loudly to a fresh run."""
 
-    packed: np.ndarray       # [C, 7] float64; NaN rows = not yet solved
+    packed: np.ndarray       # [C, PACKED_ROW_WIDTH] float64; NaN rows =
+    #                          not yet solved
     solved: np.ndarray       # [C] bool — batched result present
     bucket: np.ndarray       # [C] int64 launch group (-1 = unassigned)
     pred: np.ndarray         # [C] float64 scheduler work model
@@ -415,7 +418,7 @@ class SweepLedger(NamedTuple):
 
 def _ledger_template(n: int) -> SweepLedger:
     return SweepLedger(
-        packed=np.full((n, 7), np.nan),
+        packed=np.full((n, PACKED_ROW_WIDTH), np.nan),
         solved=np.zeros(n, dtype=bool),
         bucket=np.full(n, -1, dtype=np.int64),
         pred=np.full(n, np.nan),

@@ -416,7 +416,13 @@ def _fine_grid_metrics(backend: str, timer) -> dict:
             # sentinel stays: a clean failure this run may hang the next)
             print(f"[bench] fine-grid cell ({method}) failed: "
                   f"{type(e).__name__}: {str(e)[:300]}", file=sys.stderr)
-            out.update({"fine_grid_wall_s": None, "fine_grid_method": method,
+            # a failed attempt nulls the WALL and records which method
+            # failed — it must NOT claim fine_grid_method (the r05 record
+            # carried method="dense" with every derived field null, which
+            # read as "dense ran"); a later method's success overwrites
+            # the nulls, and record_null_violations pins the invariant
+            out.update({"fine_grid_wall_s": None,
+                        "fine_grid_failed_method": method,
                         "fine_grid_flops_per_sec": None,
                         "fine_grid_mfu_pct": None})
             if method == "dense":
@@ -575,6 +581,97 @@ def _warm_scheduled_metrics(timer, sweep_kwargs: dict, base_res) -> dict:
               f"{str(e)[:300]}", file=sys.stderr)
         out["warm_sweep_error"] = f"{type(e).__name__}: {str(e)[:160]}"
     return out
+
+
+def _precision_ladder_metrics(timer, sweep_kwargs: dict, base_res) -> dict:
+    """The ISSUE 5 tentpole measured end-to-end: the 12-cell sweep under
+    ``precision="mixed"`` (cheap-dtype descent, reference polish — DESIGN
+    §5) against the reference-policy headline.  Emits the ``precision_*``
+    record fields: the per-phase step split, the polish fraction (the
+    share of steps still paying reference precision), the r* agreement
+    with the reference sweep in basis points, and the wall-clock speedup.
+    Runs on every backend — the acceptance numbers are CPU numbers too;
+    on the TPU this is the phase where the dense distribution matmuls
+    become MXU-eligible for the descent iterations."""
+    import numpy as np
+
+    from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+    from aiyagari_hark_tpu.utils.config import SweepConfig
+
+    out: dict = {}
+    kwargs = dict(sweep_kwargs)
+    kwargs["precision"] = "mixed"
+    try:
+        with timer.phase("precision_compile"):
+            run_table2_sweep(SweepConfig(), **kwargs)   # compile + warm-up
+        with timer.phase("precision_mixed"):
+            res = run_table2_sweep(SweepConfig(), perturb=PERTURB, **kwargs)
+        descent = int(res.descent_steps.sum())
+        polish = int(res.polish_steps.sum())
+        diffs = np.abs(np.asarray(res.r_star_pct)
+                       - np.asarray(base_res.r_star_pct)) * 100.0
+        finite = diffs[np.isfinite(diffs)]
+        max_bp = float(finite.max()) if finite.size else None
+        out.update({
+            "precision_policy": "mixed",
+            "precision_descent_steps": descent,
+            "precision_polish_steps": polish,
+            "precision_polish_frac": round(res.polish_frac(), 4),
+            "precision_escalations": int(res.precision_escalations.sum()),
+            "precision_mixed_wall_s": round(res.wall_seconds, 4),
+            "mixed_r_star_vs_ref_max_bp": (None if max_bp is None
+                                           else round(max_bp, 4)),
+            "mixed_speedup": round(
+                base_res.wall_seconds / max(res.wall_seconds, 1e-9), 3),
+        })
+        bp_txt = ("n/a (no finite cells)" if max_bp is None
+                  else f"{max_bp:.4f} bp")
+        print(f"[bench] mixed-precision sweep: wall={res.wall_seconds:.3f}s "
+              f"({out['mixed_speedup']}x ref) descent={descent} "
+              f"polish={polish} (frac {out['precision_polish_frac']}), "
+              f"max |Δr*|={bp_txt}, "
+              f"{out['precision_escalations']} escalations",
+              file=sys.stderr)
+    except Exception as e:   # noqa: BLE001 — the precision phase must not
+        # cost the record its headline fields
+        print(f"[bench] mixed-precision sweep failed: {type(e).__name__}: "
+              f"{str(e)[:300]}", file=sys.stderr)
+        out["precision_error"] = f"{type(e).__name__}: {str(e)[:160]}"
+    return out
+
+
+# Wall-present-but-derived-null pairs the bench must never emit (ISSUE 5
+# satellite: BENCH_r05's TPU record carried fine_grid_method="dense" with
+# every derived field null).  Each entry: (wall field, derived field,
+# accel_only) — accel_only fields (MFU needs a chip peak) may be null on
+# CPU records but never on tpu/axon ones.
+_NULL_SENTINEL_PAIRS = (
+    ("fine_grid_wall_s", "fine_grid_flops_per_sec", False),
+    ("fine_grid_wall_s", "fine_grid_mfu_pct", True),
+    ("fine_grid_lanes4_wall_s", "fine_grid_lanes4_cells_per_sec", False),
+    ("fine_grid_lanes4_wall_s", "fine_grid_lanes4_mfu_pct", True),
+    ("fine_grid_cpu_wall_s", "fine_grid_cpu_flops_per_sec", False),
+)
+
+
+def record_null_violations(record: dict) -> list:
+    """Fields whose wall time is present but whose derived rate/MFU field
+    is null — the class of stranding the fine-grid phase shipped twice
+    (VERDICT r5, BENCH_r05 ``last_tpu``).  A failed phase must null the
+    WALL too (the honest "did not run"), never a derived field alone.
+    Returns ``(wall_field, derived_field)`` pairs; pinned by
+    ``tests/test_bench_smoke.py`` against both synthetic records and the
+    record this bench emits."""
+    on_accel = record.get("backend") in ("tpu", "axon")
+    bad = []
+    for wall_field, derived, accel_only in _NULL_SENTINEL_PAIRS:
+        if accel_only and not on_accel:
+            continue
+        if wall_field not in record:
+            continue
+        if record[wall_field] is not None and record.get(derived) is None:
+            bad.append((wall_field, derived))
+    return bad
 
 
 def _compile_cold_warm(timer, sweep_kwargs: dict) -> dict:
@@ -828,14 +925,24 @@ def _lanes_scaling(timer, sweep_kwargs: dict) -> list:
     """The scaling thesis, measured: the Table II sweep at 12/24/48/96
     lanes (finer sd panels), cells/sec and MFU per lane count (VERDICT r3
     weak-item 3 — DESIGN §4 claims "scaling comes from MORE LANES" and the
-    largest previously measured batch was 24)."""
+    largest previously measured batch was 24).
+
+    Scheduled (ISSUE 5 satellite): the ladder used to launch every lane
+    count as ONE lock-step batch — measured skew grew 2.563 → 5.275 from
+    12 to 96 lanes and cells/sec REGRESSED past 24 lanes (BENCH_r05
+    ``lanes_scaling``), so the thesis was being measured through exactly
+    the straggler pathology the PR-2 scheduler exists to remove.  The
+    ladder now routes through ``SweepConfig(schedule="balanced")`` like
+    the main sweep and records ``iteration_skew_scheduled`` (the
+    within-bucket ratio the hardware actually pays) alongside the raw
+    lock-step-equivalent number."""
     from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
     from aiyagari_hark_tpu.utils.config import SweepConfig
 
     peak = _peak_flops_per_chip("tpu")
     entries = []
     for lanes, sds in LANES_SD_PANELS.items():
-        cfg = SweepConfig(labor_sd=sds)
+        cfg = SweepConfig(labor_sd=sds, schedule="balanced")
         try:
             with timer.phase(f"lanes{lanes}_compile"):
                 run_table2_sweep(cfg, **sweep_kwargs)    # compile + warm-up
@@ -857,11 +964,18 @@ def _lanes_scaling(timer, sweep_kwargs: dict) -> list:
                         round(100.0 * flops / res.wall_seconds
                               / peak.value, 4)),
             "iteration_skew": round(res.iteration_skew(), 3),
+            # the within-bucket ratio the scheduled launches actually pay
+            "iteration_skew_scheduled": round(
+                res.scheduled_iteration_skew(), 3),
+            "n_buckets": (0 if res.bucket is None
+                          else int(res.bucket.max()) + 1),
         }
         entries.append(entry)
         print(f"[bench] lanes={lanes:3d}: wall={entry['wall_s']:.3f}s "
               f"-> {entry['cells_per_sec']:.2f} cells/s "
-              f"skew={entry['iteration_skew']:.2f}", file=sys.stderr)
+              f"skew={entry['iteration_skew']:.2f} "
+              f"(scheduled {entry['iteration_skew_scheduled']:.2f} over "
+              f"{entry['n_buckets']} buckets)", file=sys.stderr)
     return entries
 
 
@@ -1222,6 +1336,13 @@ def _run_bench(resume_path=None):
     if on_accel:
         _persist_tpu_evidence(record)
 
+    # The ISSUE 5 tentpole end-to-end: the mixed-precision ladder sweep vs
+    # the reference headline (every backend — polish_frac and the bp
+    # agreement are CPU acceptance numbers too).
+    record.update(_precision_ladder_metrics(timer, used_kwargs, res))
+    if on_accel:
+        _persist_tpu_evidence(record)
+
     # Compiled-Mosaic correctness + A/B margin (accelerator, pallas path).
     if on_accel and dist_method == "pallas":
         try:
@@ -1275,6 +1396,15 @@ def _run_bench(resume_path=None):
                                        else round(max_bp, 3))
     if on_accel:
         _persist_tpu_evidence(record)     # the complete record
+
+    # last line of defense against the stranded-null class (ISSUE 5
+    # satellite): a derived field that is null while its wall is present
+    # is a record bug — flag it loudly in the artifact and on stderr
+    nulls = record_null_violations(record)
+    if nulls:
+        record["record_null_violations"] = [list(p) for p in nulls]
+        print(f"[bench] WARNING: stranded-null record fields: {nulls}",
+              file=sys.stderr)
 
     print(f"[bench] phase breakdown:\n{timer.summary()}", file=sys.stderr)
     print(f"[bench] Table II r* (%):\n{res.table()}", file=sys.stderr)

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Static lint: hot-loop dtype/precision discipline (ISSUE 5).
+
+The mixed-precision ladder (DESIGN §5) only works if every matmul in the
+hot loops states its accumulation dtype explicitly and no hot-loop module
+hard-codes a compute dtype.  Two violation classes, scoped to the modules
+whose inner loops the ladder runs (``HOT_MODULES``):
+
+1. **Bare matmul** — a ``jnp.matmul``/``jnp.dot``/``jnp.einsum``/
+   ``jnp.tensordot`` call without ``preferred_element_type=``, or the
+   infix ``@`` operator (which cannot carry one at all).  On TPU a matmul
+   without a pinned accumulation dtype silently accumulates at whatever
+   the precision mode implies — exactly the drift the descent phase's
+   ``precision=DEFAULT`` + ``preferred_element_type`` pairing exists to
+   control (and the Pallas guide's standing MXU rule).
+2. **Hard-coded ``jnp.float64``** — a compute dtype literal in a hot
+   module pins work to the reference dtype regardless of the model dtype
+   or the ladder policy.  Dtypes must flow from the model/config.
+
+A hit is a finding unless its line carries an explicit ``# dtype-ok``
+waiver (for dtype *dispatch* like ``dtype == jnp.float64``, which tests a
+dtype rather than imposing one).  Run standalone (exits 1 on findings) or
+via tier-1 (``tests/test_dtype_discipline.py``), next to
+``check_atomic_writes.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The hot-loop modules: the two fixed-point implementations, the kernels,
+# and the bisection equilibrium that threads them.
+HOT_MODULES = (
+    os.path.join("aiyagari_hark_tpu", "models", "household.py"),
+    os.path.join("aiyagari_hark_tpu", "models", "equilibrium.py"),
+    os.path.join("aiyagari_hark_tpu", "ops", "markov.py"),
+    os.path.join("aiyagari_hark_tpu", "ops", "pallas_kernels.py"),
+)
+
+WAIVER = "# dtype-ok"
+
+_MATMUL_CALL = re.compile(r"\bjnp\.(matmul|dot|einsum|tensordot)\s*\(")
+# infix matrix multiply: ' @ ' between expressions.  Decorators are
+# line-initial '@name' with no preceding expression, so requiring a
+# non-space character before ' @ ' on the same line excludes them.
+_INFIX_AT = re.compile(r"\S\s+@\s+\S")
+_F64_LITERAL = re.compile(r"\bjnp\.float64\b")
+
+
+_TRIPLE_STRING = re.compile(r"('''|\"\"\")(.*?)(\1)", re.DOTALL)
+
+
+def _blank_strings(src: str) -> str:
+    """Triple-quoted strings (docstrings) blanked out, newlines kept, so
+    the line-based scans cannot trip on prose examples like ``S @ d``."""
+    def blank(m):
+        return m.group(1) + re.sub(r"[^\n]", " ", m.group(2)) + m.group(3)
+    return _TRIPLE_STRING.sub(blank, src)
+
+
+def _call_span(src: str, open_paren: int) -> str:
+    """The argument text of a call whose '(' sits at ``open_paren``
+    (balanced-paren scan, so multi-line calls are covered)."""
+    depth = 0
+    for i in range(open_paren, len(src)):
+        if src[i] == "(":
+            depth += 1
+        elif src[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return src[open_paren:i + 1]
+    return src[open_paren:]
+
+
+def scan_source(src: str, rel: str) -> list:
+    """All findings in one module's source, as (rel, lineno, message)."""
+    findings = []
+    src = _blank_strings(src)
+    lines = src.splitlines()
+
+    for m in _MATMUL_CALL.finditer(src):
+        lineno = src.count("\n", 0, m.start()) + 1
+        if WAIVER in lines[lineno - 1]:
+            continue
+        call = _call_span(src, m.end() - 1)
+        if "preferred_element_type" not in call:
+            findings.append(
+                (rel, lineno,
+                 f"jnp.{m.group(1)} without preferred_element_type= — pin "
+                 "the accumulation dtype (descent ladder contract, DESIGN "
+                 "§5), or waive with '# dtype-ok'"))
+
+    for lineno, line in enumerate(lines, start=1):
+        if WAIVER in line:
+            continue
+        code = line.split("#", 1)[0]
+        if _INFIX_AT.search(code):
+            findings.append(
+                (rel, lineno,
+                 "infix '@' matmul cannot carry preferred_element_type — "
+                 "use jnp.matmul(..., preferred_element_type=...), or "
+                 "waive with '# dtype-ok'"))
+        if _F64_LITERAL.search(code):
+            findings.append(
+                (rel, lineno,
+                 "hard-coded jnp.float64 in a hot-loop module — dtypes "
+                 "flow from the model/config (precision policy, DESIGN "
+                 "§5), or waive with '# dtype-ok'"))
+    return findings
+
+
+def scan_targets(repo: str = REPO) -> list:
+    """The files the lint covers, absolute paths — exposed so the lint's
+    own test can assert coverage instead of trusting the list silently."""
+    return [os.path.join(repo, rel) for rel in HOT_MODULES]
+
+
+def scan(repo: str = REPO) -> list:
+    findings = []
+    for path in scan_targets(repo):
+        if os.path.exists(path):
+            with open(path) as fh:
+                findings += scan_source(fh.read(),
+                                        os.path.relpath(path, repo))
+    return findings
+
+
+def main() -> int:
+    findings = scan()
+    for rel, lineno, msg in findings:
+        print(f"{rel}:{lineno}: {msg}")
+    if findings:
+        print(f"{len(findings)} dtype-discipline violation(s); see "
+              f"scripts/check_dtype_discipline.py docstring")
+        return 1
+    print("dtype-discipline lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

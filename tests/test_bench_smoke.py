@@ -129,6 +129,77 @@ def test_bench_emits_scheduler_and_compile_fields():
         assert fieldname in src, fieldname
 
 
+def test_bench_emits_precision_ladder_fields():
+    """ISSUE 5 record contract: the mixed-precision phase's fields must be
+    wired into the record builder, and the lanes ladder must run scheduled
+    and record the post-scheduling skew."""
+    import inspect
+
+    import bench
+
+    src = inspect.getsource(bench._precision_ladder_metrics)
+    for fieldname in ("precision_descent_steps", "precision_polish_steps",
+                      "precision_polish_frac", "mixed_r_star_vs_ref_max_bp",
+                      "mixed_speedup", "precision_escalations"):
+        assert fieldname in src, fieldname
+    assert "_precision_ladder_metrics(timer" in inspect.getsource(
+        bench._run_bench)
+    lanes_src = inspect.getsource(bench._lanes_scaling)
+    assert 'schedule="balanced"' in lanes_src
+    assert "iteration_skew_scheduled" in lanes_src
+
+
+def test_record_null_sentinel_flags_stranded_fields():
+    """ISSUE 5 satellite: a wall time present with its derived rate/MFU
+    field null is the r05 stranding class — the checker must flag it, and
+    must NOT flag the legitimate nulls (wall null too, or MFU on a
+    backend with no chip peak)."""
+    from bench import record_null_violations
+
+    # the r05 last_tpu shape: dense failed, wall null → no violation
+    assert record_null_violations(
+        {"backend": "tpu", "fine_grid_wall_s": None,
+         "fine_grid_flops_per_sec": None, "fine_grid_mfu_pct": None}) == []
+    # CPU record: mfu legitimately null (no peak), flops present → clean
+    assert record_null_violations(
+        {"backend": "cpu", "fine_grid_wall_s": 1.3,
+         "fine_grid_flops_per_sec": 5, "fine_grid_mfu_pct": None}) == []
+    # the bug class: wall present, derived null
+    bad = record_null_violations(
+        {"backend": "tpu", "fine_grid_wall_s": 1.3,
+         "fine_grid_flops_per_sec": None, "fine_grid_mfu_pct": 0.1})
+    assert ("fine_grid_wall_s", "fine_grid_flops_per_sec") in bad
+    bad_mfu = record_null_violations(
+        {"backend": "axon", "fine_grid_lanes4_wall_s": 2.0,
+         "fine_grid_lanes4_cells_per_sec": 2.0,
+         "fine_grid_lanes4_mfu_pct": None})
+    assert ("fine_grid_lanes4_wall_s", "fine_grid_lanes4_mfu_pct") in bad_mfu
+    # the checker is wired into the record builder, and a failed fine-grid
+    # attempt no longer claims fine_grid_method
+    import inspect
+
+    import bench
+
+    assert "record_null_violations(record)" in inspect.getsource(
+        bench._run_bench)
+    assert "fine_grid_failed_method" in inspect.getsource(
+        bench._fine_grid_metrics)
+
+
+def test_serve_metrics_emit_precision_fields():
+    from aiyagari_hark_tpu.serve import ServeMetrics
+
+    m = ServeMetrics()
+    snap = m.snapshot()
+    assert snap["serve_polish_frac"] is None      # no solves yet
+    m.record_phases(300, 100, 1)
+    snap = m.snapshot()
+    assert snap["serve_descent_steps"] == 300
+    assert snap["serve_polish_steps"] == 100
+    assert snap["serve_polish_frac"] == 0.25
+    assert snap["serve_precision_escalations"] == 1
+
+
 def test_bench_serve_smoke_fields_wired():
     """--serve-smoke record contract (ISSUE 4 satellite): the serving
     fields must be produced by the metrics snapshot and the smoke body."""

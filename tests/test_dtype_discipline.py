@@ -1,0 +1,84 @@
+"""Dtype-discipline lint (ISSUE 5 satellite): the hot-loop modules must
+pin every matmul's accumulation dtype and never hard-code a compute
+dtype — enforced in tier-1 next to the atomic-write lint, with one
+fixture per violation class so the regexes cannot silently rot."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+from check_dtype_discipline import (  # noqa: E402
+    HOT_MODULES,
+    scan,
+    scan_source,
+    scan_targets,
+)
+
+
+def test_repo_hot_modules_are_clean():
+    assert scan() == []
+
+
+def test_scan_covers_the_ladder_modules():
+    """The lint must actually look at the four hot modules — a dropped
+    entry would silently stop enforcing the ladder contract there."""
+    targets = {os.path.basename(t) for t in scan_targets()}
+    assert {"household.py", "equilibrium.py", "markov.py",
+            "pallas_kernels.py"} <= targets
+    for rel in HOT_MODULES:
+        assert os.path.exists(os.path.join(
+            os.path.dirname(__file__), "..", rel)), rel
+
+
+# -- fixture per violation class --------------------------------------------
+
+def _messages(src):
+    return [msg for _, _, msg in scan_source(src, "fixture.py")]
+
+
+def test_flags_matmul_without_preferred_element_type():
+    bad = "x = jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)\n"
+    msgs = _messages(bad)
+    assert len(msgs) == 1 and "preferred_element_type" in msgs[0]
+
+
+def test_flags_multiline_einsum_without_preferred_element_type():
+    bad = ("y = jnp.einsum('ij,jk->ik', a,\n"
+           "               b,\n"
+           "               precision=prec)\n")
+    msgs = _messages(bad)
+    assert len(msgs) == 1 and "einsum" in msgs[0]
+
+
+def test_accepts_matmul_with_preferred_element_type():
+    good = ("x = jnp.matmul(a, b, precision=prec,\n"
+            "               preferred_element_type=a.dtype)\n"
+            "y = jnp.dot(a, b, preferred_element_type=jnp.float32)\n")
+    assert _messages(good) == []
+
+
+def test_flags_infix_matmul_operator():
+    msgs = _messages("moved = S[i] @ dist[:, i]\n")
+    assert len(msgs) == 1 and "'@'" in msgs[0]
+
+
+def test_decorators_and_docstrings_are_not_infix_matmul():
+    good = ('@jax.custom_batching.custom_vmap\n'
+            'def f(x):\n'
+            '    """prose example: moved = S @ d per state."""\n'
+            '    return x\n')
+    assert _messages(good) == []
+
+
+def test_flags_hardcoded_float64_literal():
+    msgs = _messages("z = jnp.zeros((3,), dtype=jnp.float64)\n")
+    assert len(msgs) == 1 and "float64" in msgs[0]
+
+
+def test_waiver_comment_suppresses_each_class():
+    waived = (
+        "x = jnp.matmul(a, b)  # dtype-ok: fixture\n"
+        "y = a @ b  # dtype-ok: fixture\n"
+        "f64 = dtype == jnp.float64  # dtype-ok: dispatch\n")
+    assert _messages(waived) == []

@@ -77,6 +77,55 @@ def test_solution_fingerprint_covers_cell_and_config():
     assert fp not in distinct and len(distinct) == 5
 
 
+def test_precision_policy_in_group_keys():
+    """ISSUE 5 satellite: the precision policy is part of every cache key
+    (cross-policy inequality) while the EXPLICIT default spelling hashes
+    identically to the implicit one (no-drift pin) — sidecar predictions,
+    ledgers, and store entries can neither mix policies nor split on a
+    no-op spelling."""
+    items = hashable_kwargs(KW)
+    # no-drift: explicit "reference" == absent, at every key level
+    assert hashable_kwargs({**KW, "precision": "reference"}) == items
+    assert (work_fingerprint(
+        hashable_kwargs({**KW, "precision": "reference"}), np.float64)
+        == work_fingerprint(items, np.float64))
+    # cross-policy inequality
+    mixed = hashable_kwargs({**KW, "precision": "mixed"})
+    fast = hashable_kwargs({**KW, "precision": "fast"})
+    assert mixed != items and fast != items and mixed != fast
+    keys = {work_fingerprint(it, np.float64) for it in (items, mixed, fast)}
+    assert len(keys) == 3
+    sols = {solution_fingerprint(3.0, 0.6, 0.2, it, np.float64)
+            for it in (items, mixed, fast)}
+    assert len(sols) == 3
+    # an unknown policy fails loudly before it can alias a real one
+    with pytest.raises(ValueError):
+        hashable_kwargs({**KW, "precision": "bf16"})
+
+
+def test_ledger_fingerprint_covers_row_layout():
+    """A resume ledger written under a different packed-row layout must
+    never fingerprint-match (the pre-widening ledger would feed
+    wrong-shaped rows into a restarted sweep)."""
+    from aiyagari_hark_tpu.utils import fingerprint as fp
+    from aiyagari_hark_tpu.utils.config import PACKED_ROW_FIELDS
+
+    crra = np.asarray([1.0])
+    rho = np.asarray([0.3])
+    sd = np.asarray([0.2])
+    args = dict(crra=crra, rho=rho, sd=sd,
+                kwargs_items=hashable_kwargs(KW), dtype=np.float64,
+                schedule="locked", n_buckets=0, warm_brackets=False,
+                warm_margin=0.0, fault_mode=None, fault_iters=None,
+                max_retries=3, quarantine=True, sidecar=None)
+    base = ledger_fingerprint(**args)
+    try:
+        fp.PACKED_ROW_FIELDS = PACKED_ROW_FIELDS[:7]   # the pre-PR layout
+        assert ledger_fingerprint(**args) != base
+    finally:
+        fp.PACKED_ROW_FIELDS = PACKED_ROW_FIELDS
+
+
 def test_ledger_fingerprint_sensitivity():
     crra = np.asarray([1.0, 3.0])
     rho = np.asarray([0.3, 0.6])
@@ -105,7 +154,8 @@ def test_ledger_fingerprint_sensitivity():
     side = SweepSidecar(
         cells=np.asarray([[1.0, 0.3, 0.2]]), r_star=np.asarray([0.04]),
         bisect_iters=np.asarray([11]), egm_iters=np.asarray([500]),
-        dist_iters=np.asarray([4000]), status=np.asarray([0]),
+        dist_iters=np.asarray([4000]), descent_steps=np.asarray([0]),
+        polish_steps=np.asarray([4500]), status=np.asarray([0]),
         fingerprint=np.asarray(1, np.int64))
     with_side = fp(sidecar=side)
     assert with_side != base

@@ -1,0 +1,265 @@
+"""Mixed-precision fixed-point ladder (ISSUE 5, DESIGN §5).
+
+The contract under test:
+
+* ``precision="reference"`` (the default) is BIT-identical to an
+  unspecified precision — the explicit spelling shares the executable
+  cache entry, the fingerprints, and the bits (the pre-PR goldens in
+  ``test_table2``/``test_wealth_goldens`` pin the default path's values
+  untouched).
+* ``precision="mixed"`` keeps the acceptance numbers on the 12-cell CPU
+  sweep: r* within 0.25 bp of the reference policy, polish_frac <= 0.25,
+  and fewer reference-precision-equivalent steps
+  (``polish + DESCENT_STEP_COST * descent``) than the reference sweep's
+  total.
+* parity holds beyond the Aiyagari sweep: one Huggett bond-economy solve
+  and one 4N-state KS household solve agree across policies.
+* a NaN injected into the DESCENT phase escalates to a pure-reference
+  solve inside the ladder (``PRECISION_ESCALATED``) — the caller sees a
+  healthy status and reference-grade values, quarantine sees nothing.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.models.equilibrium import solve_calibration_lean
+from aiyagari_hark_tpu.models.household import (
+    PrecisionPhases,
+    build_simple_model,
+    solve_household,
+    stationary_wealth,
+)
+from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+from aiyagari_hark_tpu.solver_health import (
+    CONVERGED,
+    PRECISION_ESCALATED,
+    STALLED,
+    is_failure,
+)
+from aiyagari_hark_tpu.utils.config import (
+    DESCENT_STEP_COST,
+    PACKED_ROW_FIELDS,
+    SweepConfig,
+    resolve_precision,
+)
+
+# The tier-1 sweep workload: the full 12-cell Table II lattice at smoke
+# grid sizes (the ladder claims are about phases and tolerances, not
+# grid resolution; full-size parity is the bench's precision_* phase).
+KW = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-5,
+          max_bisect=24)
+TINY = dict(labor_states=3, a_count=10, dist_count=32)
+
+
+def test_resolve_precision_policies():
+    assert resolve_precision("reference").two_phase is False
+    assert resolve_precision("mixed").polish is True
+    assert resolve_precision("fast").polish is False
+    assert 0.0 < resolve_precision("mixed").descent_step_cost <= 1.0
+    with pytest.raises(ValueError):
+        resolve_precision("bf16")
+
+
+def test_packed_row_layout_pin():
+    """The device-row layout shared by sweep/ledger/store — widening it
+    again must be a deliberate, fingerprint-bumping change."""
+    assert PACKED_ROW_FIELDS == (
+        "r_star", "capital", "labor", "bisect_iters", "egm_iters",
+        "dist_iters", "status", "descent_steps", "polish_steps",
+        "precision_escalations")
+
+
+# ---------------------------------------------------------------------------
+# The 12-cell acceptance block.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def sweeps():
+    ref = run_table2_sweep(SweepConfig(), **KW)
+    mixed = run_table2_sweep(SweepConfig(), precision="mixed", **KW)
+    return ref, mixed
+
+
+def test_reference_default_and_explicit_are_bit_identical(sweeps):
+    ref, _ = sweeps
+    expl = run_table2_sweep(SweepConfig(), precision="reference", **KW)
+    for field in ("r_star_pct", "saving_rate_pct", "capital", "excess",
+                  "bisect_iters", "egm_iters", "dist_iters", "status",
+                  "descent_steps", "polish_steps",
+                  "precision_escalations"):
+        assert np.array_equal(getattr(ref, field), getattr(expl, field)), field
+    # reference phase accounting: zero descent, every step is polish
+    assert (ref.descent_steps == 0).all()
+    assert np.array_equal(ref.polish_steps, ref.total_work())
+    assert ref.polish_frac() == 1.0
+
+
+def test_mixed_12_cell_acceptance(sweeps):
+    ref, mixed = sweeps
+    assert not is_failure(mixed.status).any()
+    # escalation is allowed (a slow-mixing cell's descent can stall at the
+    # cheap-dtype floor and fall back — that is the contract working), but
+    # it must stay the exception and never surface as a failure
+    assert int(mixed.precision_escalations.sum()) <= 2
+    # r* agreement: <= 0.25 bp per cell (r_star_pct is in percent; 1 bp =
+    # 0.01 percentage points)
+    max_bp = float(np.abs(mixed.r_star_pct - ref.r_star_pct).max()) * 100.0
+    assert max_bp <= 0.25, max_bp
+    # polish fraction: at most a quarter of the steps still pay reference
+    # precision
+    assert mixed.polish_frac() <= 0.25, mixed.polish_frac()
+    # reference-equivalent work strictly below the reference sweep's
+    ref_equiv = (float(mixed.polish_steps.sum())
+                 + DESCENT_STEP_COST * float(mixed.descent_steps.sum()))
+    assert ref_equiv < float(ref.total_work().sum())
+    # phase counters are an exact partition of the total work
+    assert np.array_equal(mixed.descent_steps + mixed.polish_steps,
+                          mixed.total_work())
+
+
+def test_fast_policy_is_cheap_and_approximate(sweeps):
+    ref, _ = sweeps
+    fast = run_table2_sweep(SweepConfig(), precision="fast", **KW)
+    assert (fast.polish_steps == 0).all()
+    assert float(fast.total_work().sum()) < 0.8 * float(
+        ref.total_work().sum())
+    # descent-only answers: within the relaxed (cheap-floor) tolerance —
+    # a few bp, not reference-grade, but nowhere near garbage
+    max_bp = float(np.abs(fast.r_star_pct - ref.r_star_pct).max()) * 100.0
+    assert max_bp < 5.0, max_bp
+
+
+# ---------------------------------------------------------------------------
+# Parity beyond the sweep: Huggett and Krusell-Smith.
+# ---------------------------------------------------------------------------
+
+def test_huggett_mixed_matches_reference():
+    from aiyagari_hark_tpu.models.huggett import solve_huggett_equilibrium
+
+    model = build_simple_model(borrow_limit=-1.0, **TINY)
+    ref = solve_huggett_equilibrium(model, 0.96, 2.0, r_tol=1e-6)
+    mix = solve_huggett_equilibrium(model, 0.96, 2.0, r_tol=1e-6,
+                                    precision="mixed")
+    assert bool(ref.bracketed) and bool(mix.bracketed)
+    assert abs(float(ref.r_star) - float(mix.r_star)) * 1e4 <= 0.25  # bp
+
+
+def test_ks_household_mixed_matches_reference():
+    from aiyagari_hark_tpu.models.ks_model import (
+        AFuncParams,
+        build_ks_calibration,
+        solve_ks_household,
+    )
+    from aiyagari_hark_tpu.utils.config import AgentConfig, EconomyConfig
+
+    agent = AgentConfig(labor_states=3, a_count=12,
+                        mgrid_base=(0.7, 0.9, 1.0, 1.1, 1.3))
+    econ = EconomyConfig(labor_states=3)
+    cal = build_ks_calibration(agent, econ)
+    afunc = AFuncParams(intercept=jnp.zeros(2), slope=jnp.ones(2))
+    pol_ref, _, _, st_ref = solve_ks_household(afunc, cal, tol=1e-6)
+    pol_mix, _, _, st_mix = solve_ks_household(afunc, cal, tol=1e-6,
+                                               precision="mixed")
+    assert int(st_ref) == CONVERGED and int(st_mix) == CONVERGED
+    # both converged to the same fixed point to ladder-noise: the polish
+    # certifies the same sup-norm tolerance the reference run does
+    diff = float(jnp.max(jnp.abs(pol_ref.c_knots - pol_mix.c_knots)))
+    assert diff <= 50 * 1e-6, diff
+
+
+# ---------------------------------------------------------------------------
+# Escalation: descent-phase faults are absorbed inside the ladder.
+# ---------------------------------------------------------------------------
+
+def test_policy_descent_nan_escalates_to_reference(sweeps=None):
+    model = build_simple_model(**TINY)
+    ref_pol, _, _, ref_status = solve_household(1.02, 1.0, model, 0.96, 2.0,
+                                                tol=1e-6)
+    pol, _, _, status, phases = solve_household(
+        1.02, 1.0, model, 0.96, 2.0, tol=1e-6, precision="mixed",
+        return_phases=True, descent_fault_iter=0)
+    assert isinstance(phases, PrecisionPhases)
+    assert bool(phases.escalated), PRECISION_ESCALATED
+    # the fallback IS a reference-grade solve: healthy status, and the
+    # answer matches the reference fixed point to its tolerance
+    assert int(status) == CONVERGED == int(ref_status)
+    assert not is_failure(int(status))
+    # both certify the same sup-norm update tolerance; the fixed-point
+    # error bound is tol/(1-beta) ~ 2.5e-5, and the escalated polish runs
+    # a tighter Anderson cadence than the plain reference loop
+    assert float(jnp.max(jnp.abs(pol.c_knots - ref_pol.c_knots))) <= 5e-5
+
+
+def test_distribution_descent_stall_escalates_to_reference():
+    model = build_simple_model(**TINY)
+    pol, _, _, _ = solve_household(1.02, 1.0, model, 0.96, 2.0, tol=1e-6)
+    d_ref, _, _, st_ref = stationary_wealth(pol, 1.02, 1.0, model, tol=1e-11)
+    # a stall pinned into the DESCENT phase (alternating offset above the
+    # coarse tolerance) must trip the stall window there and fall back
+    d_mix, _, _, st_mix, phases = stationary_wealth(
+        pol, 1.02, 1.0, model, tol=1e-11, precision="mixed",
+        return_phases=True, descent_fault_iter=0,
+        descent_fault_mode="stall")
+    assert bool(phases.escalated)
+    assert int(st_mix) == int(st_ref) == CONVERGED
+    assert float(jnp.max(jnp.abs(d_ref - d_mix))) <= 1e-9
+    # uninjected control: no escalation, same answer
+    d_ok, _, _, st_ok, ph_ok = stationary_wealth(
+        pol, 1.02, 1.0, model, tol=1e-11, precision="mixed",
+        return_phases=True)
+    assert not bool(ph_ok.escalated) and int(st_ok) == CONVERGED
+    assert float(jnp.max(jnp.abs(d_ref - d_ok))) <= 1e-9
+
+
+def test_sweep_quarantine_never_sees_descent_faults():
+    """End-to-end: a mixed-policy sweep whose every descent phase is
+    healthy reports zero retries — and the bisection-level NaN injection
+    (which poisons the REFERENCE excess too) still reaches quarantine,
+    exactly as under the default policy."""
+    smoke = SweepConfig(crra_values=(1.0, 3.0), rho_values=(0.3, 0.6))
+    res = run_table2_sweep(smoke, precision="mixed", **KW)
+    assert (res.retries == 0).all()
+    assert not is_failure(res.status).any()
+    # sweep-level fault injection under mixed: the poisoned cell fails
+    # loudly (NaN-masked after the retry ladder, which retries at full
+    # reference precision), its neighbors stay healthy
+    bad = run_table2_sweep(smoke, precision="mixed", quarantine=True,
+                           max_retries=0,
+                           inject_fault={"cell": 1, "at_iter": 0,
+                                         "mode": "nan"}, **KW)
+    assert is_failure(bad.status[1])
+    assert np.isnan(bad.r_star_pct[1])
+    healthy = [0, 2, 3]
+    assert np.allclose(bad.r_star_pct[healthy], res.r_star_pct[healthy],
+                       rtol=0, atol=1e-10)
+
+
+# ---------------------------------------------------------------------------
+# Stationary power iteration (ops.markov) ladder.
+# ---------------------------------------------------------------------------
+
+def test_markov_stationary_distribution_ladder_parity():
+    from aiyagari_hark_tpu.ops.markov import (
+        stationary_distribution,
+        tauchen_labor_process,
+    )
+
+    P = tauchen_labor_process(5, 0.6, 0.2).transition
+    ref = stationary_distribution(P)
+    mix = stationary_distribution(P, precision="mixed")
+    fast = stationary_distribution(P, precision="fast")
+    # "mixed" deliberately equals "reference" here: this fixed point is a
+    # handful of tiny matmuls, and no affordable polish can repair cheap
+    # squaring error on a persistent chain — so mixed keeps the certified
+    # contract instead of pretending to descend (see the docstring)
+    assert np.array_equal(np.asarray(ref), np.asarray(mix))
+    # descent-only ("fast") is approximate but normalized
+    assert float(jnp.abs(jnp.sum(fast) - 1.0)) <= 1e-6
+    assert float(jnp.max(jnp.abs(ref - fast))) <= 1e-4
+
+
+def test_solver_health_exposes_the_escalation_note():
+    assert PRECISION_ESCALATED == "PRECISION_ESCALATED"
+    assert STALLED < 2  # the note is NOT a status code; severity untouched

@@ -21,7 +21,7 @@ GROUP = 7
 def entry(key, cell=(3.0, 0.6, 0.2), r_star=0.035, group=GROUP,
           status=CONVERGED):
     packed = np.asarray([r_star, 5.0, 0.9, 11.0, 500.0, 4000.0,
-                         float(status)])
+                         float(status), 0.0, 4500.0, 0.0])
     return make_solution(cell, packed, group, key)
 
 
