@@ -5,6 +5,8 @@ Layers (mirroring SURVEY.md §1, rebuilt TPU-first):
   * ``ops``      — numerics core (grids, Tauchen, CRRA, batched interp, OLS)
   * ``models``   — EGM household solver, simulators, equilibrium loops
   * ``parallel`` — device meshes, calibration sweeps, sharded agent panels
+  * ``serve``    — micro-batched equilibrium query engine + solution store
+  * ``verify``   — a posteriori certification, checksum chain, SDC defense
   * ``utils``    — typed configs, checkpointing, logging, statistics
   * ``facade``   — notebook-compatible AiyagariType / AiyagariEconomy classes
 """

@@ -503,7 +503,12 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
             if os.path.exists(sidecar):
                 tag = None
                 try:
-                    tag, state = load_pytree(
+                    # KS distribution sidecars are guarded by the
+                    # checkpoint-tag match below (a torn pair is detected
+                    # and degrades to an approximate resume); they are
+                    # re-derivable simulation state, not part of the
+                    # checksummed solution chain (DESIGN §9)
+                    tag, state = load_pytree(  # integrity-ok
                         sidecar, (np.zeros((), np.int64), sim_init))
                 except ValueError as e:
                     # structural mismatch (e.g. a sidecar written by an

@@ -39,7 +39,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from ..models.equilibrium import solve_calibration_lean
-from ..solver_health import CONVERGED, is_failure, status_name
+from ..solver_health import CONVERGED, NONFINITE, is_failure, status_name
 from ..utils.checkpoint import (
     CORRUPT_NPZ_ERRORS,
     CheckpointMismatchError,
@@ -47,8 +47,10 @@ from ..utils.checkpoint import (
     save_sweep_sidecar,
 )
 from ..utils.fingerprint import (
+    IntegrityError,
     hashable_kwargs,
     ledger_fingerprint,
+    solution_fingerprint,
     work_fingerprint,
 )
 from ..utils.config import PACKED_ROW_WIDTH, SweepConfig
@@ -121,6 +123,15 @@ class SweepResult:
     polish_steps: Optional[np.ndarray] = None    # [C] reference-phase steps
     precision_escalations: Optional[np.ndarray] = None  # [C] ladder
     #                                       descent→reference fallbacks
+    # -- integrity layer (ISSUE 6, DESIGN §9) ------------------------------
+    sdc_suspected: Optional[np.ndarray] = None  # [C] bool — the SDC spot
+    #   recheck saw a bitwise mismatch for this cell (recorded BEFORE the
+    #   quarantine ladder re-solved it; None = recheck not run)
+    cert_level: Optional[np.ndarray] = None  # [C] verify certificate level
+    #   (CERTIFIED/MARGINAL/FAILED; None = certification not run)
+    recheck_wall_seconds: float = 0.0   # SDC recheck launches (outside
+    #                                     wall_seconds — defense overhead)
+    certify_wall_seconds: float = 0.0   # certification launches (ditto)
 
     def polish_frac(self) -> float:
         """Share of inner-loop steps that ran at reference precision —
@@ -359,6 +370,13 @@ def _load_sidecar(path, fingerprint):
     except CheckpointMismatchError as e:
         warnings.warn(f"sweep sidecar ignored: {e}", stacklevel=3)
         return None
+    except IntegrityError as e:
+        # silent corruption (DESIGN §9): the file parsed and carried the
+        # right fingerprint, but its content no longer hashes to its
+        # solve-time checksum — degrade to the heuristic, loudly
+        warnings.warn(f"sweep sidecar failed integrity verification: {e}",
+                      stacklevel=3)
+        return None
     except CORRUPT_NPZ_ERRORS:
         return None
 
@@ -590,6 +608,12 @@ def _solve_scheduled(sweep: SweepConfig, crra, rho, sd, rho_nominal,
     results = np.full((n_orig, PACKED_ROW_WIDTH), np.nan)
     solved = np.zeros(n_orig, dtype=bool)
     bucket_of = np.full(n_orig, -1, dtype=np.int64)
+    # per-cell launch provenance for the SDC recheck (DESIGN §9): the
+    # exact bracket seed each cell launched with (None = cold), and which
+    # cells were restored from a resume ledger (their seeds are unknown,
+    # so a warm-bracket recheck cannot replay them)
+    seeds_used: list = [None] * n_orig
+    restored = np.zeros(n_orig, dtype=bool)
     wall_total = 0.0
 
     for bi, bucket in enumerate(buckets):
@@ -600,6 +624,7 @@ def _solve_scheduled(sweep: SweepConfig, crra, rho, sd, rho_nominal,
             # then see what an uninterrupted run would have seen
             results[bucket] = ledger.packed[bucket]
             solved[bucket] = True
+            restored[bucket] = True
             continue
         lanes = np.concatenate(
             [bucket, np.repeat(bucket[-1], b_pad - len(bucket))]
@@ -670,13 +695,94 @@ def _solve_scheduled(sweep: SweepConfig, crra, rho, sd, rho_nominal,
         # duplicate rows carry identical bits and last-write-wins is exact
         results[lanes] = packed
         solved[bucket] = True
+        if warm:
+            for pos, li in enumerate(lanes):
+                seeds_used[li] = seeds[pos]
         _resilience_seam(
             ledger,
             lambda led: led.record_bucket(bucket, results[bucket], bi),
             progress={"completed_buckets": bi + 1,
                       "n_buckets": len(buckets)},
             inject_preempt=inject_preempt, bucket_id=bi)
-    return results, wall_total, bucket_of, pred
+    return results, wall_total, bucket_of, pred, seeds_used, restored
+
+
+# ---------------------------------------------------------------------------
+# SDC spot-checks (ISSUE 6, DESIGN §9): deterministic bitwise re-solve of a
+# fingerprint-sampled cell subset in permuted lane positions.
+# ---------------------------------------------------------------------------
+
+def sdc_sample(cells: np.ndarray, kwargs_items: tuple, dtype,
+               fraction: float) -> np.ndarray:
+    """The fingerprint-sampled recheck subset: rank cells by their
+    ``solution_fingerprint`` (a content hash — uniform-ish over cells,
+    deterministic per configuration, uncorrelated with lattice position)
+    and take the ``ceil(fraction * C)`` smallest.  The same configuration
+    always rechecks the same cells — reproducible defense, diffable
+    across runs — while different configurations sample different
+    subsets, so a fleet sweeping many configs covers the lattice."""
+    c = len(cells)
+    k = int(np.ceil(float(fraction) * c))
+    if k <= 0:
+        return np.asarray([], dtype=np.int64)
+    ranks = np.asarray(
+        [solution_fingerprint(cell[0], cell[1], cell[2], kwargs_items,
+                              dtype) for cell in np.asarray(cells)],
+        dtype=np.int64)
+    return np.sort(np.argsort(ranks, kind="stable")[:min(k, c)])
+
+
+def _sdc_recheck(rows, crra, rho, sd, sample, seeds_used, fault_iters,
+                 fault_mode, dtype, kwargs_items, device_call):
+    """Re-solve the sampled cells through the SAME executable family and
+    compare packed rows BITWISE against the batched results.
+
+    Every launch prepends a duplicate of its first sampled cell, so every
+    real cell solves at a different lane index than lane 0 — combined
+    with the different batch shape/composition, the recheck exercises the
+    packing-independence contract end to end (a per-lane computation must
+    not depend on lane position or batchmates), which is what makes a
+    bitwise mismatch a corruption signal rather than noise.  Cells that
+    launched with a warm bracket seed replay their EXACT recorded seed
+    (a different seed would legitimately change counters).  Returns
+    (mismatched original-cell indices, summed recheck wall).
+
+    Cost note: the sample-sized launch is its own XLA input shape, so
+    the FIRST recheck at a given ``recheck_fraction`` pays one compile
+    (amortized by the persistent compilation cache and by any warm-up
+    run at the same fraction — the bench's integrity smoke warms it);
+    steady-state rechecks are pure executable-cache hits."""
+    wall = 0.0
+    suspect: list = []
+    groups: dict = {}
+    for i in sample:
+        groups.setdefault(seeds_used[int(i)] is not None,
+                          []).append(int(i))
+    for warm, idx in sorted(groups.items()):
+        lanes = [idx[0]] + idx
+        args = [jnp.asarray(crra[lanes], dtype=dtype),
+                jnp.asarray(rho[lanes], dtype=dtype),
+                jnp.asarray(sd[lanes], dtype=dtype)]
+        if warm:
+            seeds = [seeds_used[i] for i in lanes]
+            args += [jnp.asarray(np.asarray([s[0] for s in seeds]),
+                                 dtype=dtype),
+                     jnp.asarray(np.asarray([s[1] for s in seeds]),
+                                 dtype=dtype),
+                     jnp.asarray(np.asarray([s[2] for s in seeds],
+                                            dtype=np.int32))]
+        if fault_mode is not None:
+            args.append(jnp.asarray(fault_iters[lanes]))
+        fn = _batched_solver(dtype, kwargs_items, fault_mode, warm)
+        packed, launch_wall = _timed_launch(
+            device_call, f"sdc recheck [{len(lanes)}]", fn, args)
+        wall += launch_wall
+        re_rows = np.asarray(packed, dtype=np.float64)[1:]
+        for pos, i in enumerate(idx):
+            if (np.asarray(rows[i], dtype=np.float64).tobytes()
+                    != re_rows[pos].tobytes()):
+                suspect.append(i)
+    return suspect, wall
 
 
 _COMPILATION_CACHE_ON = False
@@ -711,6 +817,8 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
                      retry: Optional[RetryPolicy] = None,
                      inject_transient: Optional[dict] = None,
                      inject_preempt: Optional[dict] = None,
+                     inject_sdc: Optional[dict] = None,
+                     cert_thresholds=None,
                      **model_kwargs) -> SweepResult:
     """Solve every (σ, ρ, sd) cell as batched program launches.
 
@@ -772,6 +880,22 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
     ``inject_transient={"at_call": k, "times": n}`` and
     ``inject_preempt={"after_bucket": b, "mode": "signal"|"flag"}`` are
     the deterministic fault hooks exercising those paths in CPU tests.
+
+    Integrity (ISSUE 6, DESIGN §9): ``sweep.recheck_fraction`` re-solves
+    a fingerprint-sampled cell subset in permuted lane positions after
+    the batched solve and compares packed rows bitwise (``sdc_sample`` /
+    ``_sdc_recheck``); a mismatch records ``SweepResult.sdc_suspected``
+    and the cell routes through the quarantine ladder for a trusted
+    re-solve.  ``sweep.certify`` runs a posteriori certification
+    (``verify.certify_equilibrium`` recompute path) on every final cell,
+    recording ``SweepResult.cert_level``; ``cert_thresholds`` overrides
+    the configuration-scaled defaults.  Both run AFTER the timed batched
+    solve — their cost is reported separately
+    (``recheck_wall_seconds``/``certify_wall_seconds``), never inside
+    ``wall_seconds``.  ``inject_sdc={"cell": i, "bit": b}`` (bit flip)
+    or ``{"cell": i, "field": f, "amplitude": a}`` (perturbation)
+    deterministically corrupts one cell's packed row post-solve,
+    pre-recheck — the silent-data-corruption drill.
 
     With ``mesh`` given, cells are sharded over ``axis`` (padded by edge
     replication to divide the axis size); under "balanced" each bucket is
@@ -897,22 +1021,21 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
 
     bucket_of = None
     pred = None
+    seeds_used: list = [None] * n_orig
+    restored_mask = np.zeros(n_orig, dtype=bool)
     if schedule == "balanced":
-        packed, wall, bucket_of, pred = _solve_scheduled(
+        (packed, wall, bucket_of, pred, seeds_used,
+         restored_mask) = _solve_scheduled(
             sweep, crra, rho, sd, rho_label, fault_iters, fault_mode,
             mesh, axis, dtype, kwargs_items, model_kwargs,
             perturb=perturb, side=side, ledger=ledger,
             device_call=device_call, inject_preempt=inject_preempt)
-        (r, K, L, iters, egm_it, dist_it, status_f, desc_f, pol_f,
-         esc_f) = packed.T
         sl = slice(0, n_orig)
     elif ledger is not None and ledger.solved.all():
         # locked path, fully solved by the interrupted run: restore the
         # batched phase from the ledger (quarantine may still be pending)
         packed = ledger.packed
         wall = 0.0
-        (r, K, L, iters, egm_it, dist_it, status_f, desc_f, pol_f,
-         esc_f) = packed.T
         sl = slice(0, n_orig)
     else:
         if mesh is not None:
@@ -952,29 +1075,83 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
                                           np.asarray(packed)[:n_orig], 0),
             progress={"completed_buckets": 1, "n_buckets": 1},
             inject_preempt=inject_preempt, bucket_id=0)
-        (r, K, L, iters, egm_it, dist_it, status_f, desc_f, pol_f,
-         esc_f) = packed.T
         sl = slice(0, n_orig)
     if timer is not None:
         timer(wall)
 
-    # explicit copies: the device transfer's buffer is read-only and the
-    # quarantine path writes recovered cells back in place
-    r = np.array(r, dtype=np.float64)[sl]
-    K = np.array(K, dtype=np.float64)[sl]
-    L = np.array(L, dtype=np.float64)[sl]
+    # ONE host copy of the packed rows (the device transfer's buffer is
+    # read-only; the injection/quarantine paths write rows in place)
+    rows = np.array(np.asarray(packed), dtype=np.float64)[sl]
+
+    # -- SDC injection + spot recheck (DESIGN §9) ---------------------------
+    # Injection corrupts the host copy AFTER the solve (and after the
+    # ledger recorded the true bits) — the silent-data-corruption model:
+    # finite numbers, healthy status, wrong bits.
+    if inject_sdc is not None:
+        ci = int(inject_sdc["cell"])
+        if "bit" in inject_sdc:
+            from ..verify.inject import flip_row_bit
+
+            rows[ci] = flip_row_bit(rows[ci],
+                                    field=int(inject_sdc.get("field", 0)),
+                                    bit=int(inject_sdc["bit"]))
+        else:
+            rows[ci, int(inject_sdc.get("field", 0))] += float(
+                inject_sdc.get("amplitude", 1e-6))
+    sdc_suspected = None
+    recheck_wall = 0.0
+    if sweep.recheck_fraction > 0.0:
+        sample = sdc_sample(np.stack([crra, rho_label, sd], axis=1),
+                            kwargs_items, dtype, sweep.recheck_fraction)
+        # Two classes of ledger-restored cell cannot be bitwise-rechecked
+        # against a fresh batched launch, and are skipped LOUDLY, never
+        # silently: warm-bracket cells whose launch seeds were not
+        # recorded, and quarantine-RETRIED cells — their restored row is
+        # the serial quarantine outcome, which the batched executable can
+        # never reproduce (a mismatch there would be a false alarm, not
+        # corruption).
+        skipped = []
+        if sweep.warm_brackets and restored_mask.any():
+            skipped += [int(i) for i in sample if restored_mask[i]
+                        and seeds_used[int(i)] is None]
+        if ledger is not None and ledger.retried.any():
+            skipped += [int(i) for i in sample
+                        if ledger.retried[i] and int(i) not in skipped]
+        if skipped:
+            warnings.warn(
+                f"sdc recheck: skipping ledger-restored cell(s) "
+                f"{sorted(skipped)} (warm seeds unknown, or the row is a "
+                f"serial quarantine outcome)", stacklevel=2)
+            sample = np.asarray([i for i in sample
+                                 if int(i) not in set(skipped)],
+                                dtype=np.int64)
+        suspects, recheck_wall = _sdc_recheck(
+            rows, crra, rho, sd, sample, seeds_used, fault_iters,
+            fault_mode, dtype, kwargs_items, device_call)
+        sdc_suspected = np.zeros(n_orig, dtype=bool)
+        sdc_suspected[suspects] = True
+        if suspects:
+            warnings.warn(
+                "sdc recheck: bitwise mismatch for cell(s) "
+                + ", ".join(str(i) for i in suspects)
+                + " — silent data corruption suspected; routing through "
+                "the quarantine ladder", stacklevel=2)
+
+    r = rows[:, 0].copy()
+    K = rows[:, 1].copy()
+    L = rows[:, 2].copy()
     # The counters and status rode the device transfer in the float dtype
     # (exact — values ≪ 2^24, which f32 represents without rounding); cast
     # back to integers HERE so downstream consumers (total_work sums,
     # jsonified bench records, status comparisons) never see counters
     # silently become floats (ADVICE r5 #2).
-    iters = np.asarray(np.rint(iters), dtype=np.int64)[sl]
-    egm_it = np.asarray(np.rint(egm_it), dtype=np.int64)[sl]
-    dist_it = np.asarray(np.rint(dist_it), dtype=np.int64)[sl]
-    status = np.asarray(np.rint(status_f), dtype=np.int64)[sl]
-    desc_it = np.asarray(np.rint(desc_f), dtype=np.int64)[sl]
-    pol_it = np.asarray(np.rint(pol_f), dtype=np.int64)[sl]
-    escal = np.asarray(np.rint(esc_f), dtype=np.int64)[sl]
+    iters = np.asarray(np.rint(rows[:, 3]), dtype=np.int64)
+    egm_it = np.asarray(np.rint(rows[:, 4]), dtype=np.int64)
+    dist_it = np.asarray(np.rint(rows[:, 5]), dtype=np.int64)
+    status = np.asarray(np.rint(rows[:, 6]), dtype=np.int64)
+    desc_it = np.asarray(np.rint(rows[:, 7]), dtype=np.int64)
+    pol_it = np.asarray(np.rint(rows[:, 8]), dtype=np.int64)
+    escal = np.asarray(np.rint(rows[:, 9]), dtype=np.int64)
     retries = np.zeros(n_orig, dtype=np.int64)
 
     # Host-side escalation: quarantine failed cells and walk the bounded
@@ -1000,6 +1177,14 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
             escal[i] = int(np.rint(row[9]))
             retries[i] = int(ledger.retries[i])
             restored_retry[i] = True
+    demoted = np.zeros(n_orig, dtype=bool)
+    if sdc_suspected is not None:
+        # a suspected cell's batched numbers are untrusted no matter how
+        # healthy its status looks: demote it to NONFINITE (corrupt bits
+        # ARE garbage) so the quarantine ladder re-solves it; whatever
+        # the ladder cannot recover is purged wholesale after it runs
+        demoted = sdc_suspected & ~restored_retry
+        status[demoted] = NONFINITE
     failed = is_failure(status) & ~restored_retry
     if quarantine and (failed.any() or restored_retry.any()):
         ladder = _retry_ladder(model_kwargs)[:max(0, int(max_retries))]
@@ -1048,6 +1233,19 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
                 + " failed every quarantine retry; their values are "
                 "NaN-masked in the SweepResult", stacklevel=2)
 
+    # KNOWN-corrupt cells no retry recovered (or that had no ladder to
+    # run) must not leak ANY field into the result or the sidecar work
+    # model: an honest MAX_ITER best-iterate keeps its labor/counters,
+    # corrupt bits keep nothing — the sidecar's warm-seed rule trusts
+    # any finite r_star and its bucket planner trusts the counters.
+    purge = demoted & is_failure(status)
+    if purge.any():
+        r[purge] = np.nan
+        K[purge] = np.nan
+        L[purge] = np.nan
+        for arr in (iters, egm_it, dist_it, desc_it, pol_it, escal):
+            arr[purge] = 0
+
     if sweep.sidecar_path is not None:
         # persist this run's counters/roots for the next run's scheduler
         # (work model + warm brackets); best-effort — an unwritable path
@@ -1062,6 +1260,32 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
         except OSError as e:
             warnings.warn(f"could not write sweep sidecar "
                           f"{sweep.sidecar_path!r}: {e}", stacklevel=2)
+
+    # -- a posteriori certification (DESIGN §9) -----------------------------
+    # Runs on the FINAL values (quarantine outcomes included), outside
+    # the timed wall: one vmapped recompute-certifier launch over the
+    # healthy cells; failed cells certify FAILED trivially.  Runs BEFORE
+    # ledger.complete() and through device_call (transient retry), so a
+    # certification-time fault cannot cost a completed sweep its resume
+    # state — a restarted run restores every cell and re-certifies.
+    cert_level = None
+    certify_wall = 0.0
+    if sweep.certify:
+        from ..verify.certificate import certify_packed_rows
+
+        t0 = time.perf_counter()
+        final_rows = np.stack(
+            [r, K, L, iters.astype(np.float64), egm_it.astype(np.float64),
+             dist_it.astype(np.float64), status.astype(np.float64),
+             desc_it.astype(np.float64), pol_it.astype(np.float64),
+             escal.astype(np.float64)], axis=1)
+        certs = device_call(
+            "a posteriori certification",
+            lambda: certify_packed_rows(
+                final_rows, np.stack([crra, rho, np.asarray(sd)], axis=1),
+                dtype, kwargs_items, thresholds=cert_thresholds))
+        cert_level = np.asarray([c.level for c in certs], dtype=np.int64)
+        certify_wall = time.perf_counter() - t0
 
     if ledger is not None:
         # the run completed: a finished ledger must not satisfy the next
@@ -1087,4 +1311,6 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
         egm_method=str(model_kwargs["egm_method"]),
         status=status, retries=retries, bucket=bucket_of,
         predicted_work=pred, descent_steps=desc_it, polish_steps=pol_it,
-        precision_escalations=escal)
+        precision_escalations=escal, sdc_suspected=sdc_suspected,
+        cert_level=cert_level, recheck_wall_seconds=recheck_wall,
+        certify_wall_seconds=certify_wall)

@@ -12,6 +12,8 @@ shapes shared with the sweep's compiled cell solver.
 from .batcher import MicroBatcher, ServeQueueFull, default_ladder  # noqa: F401
 from .metrics import ServeMetrics  # noqa: F401
 from .service import (  # noqa: F401
+    CertificationFailed,
+    DeadlineExceeded,
     EquilibriumQuery,
     EquilibriumService,
     EquilibriumSolveFailed,
@@ -20,4 +22,10 @@ from .service import (  # noqa: F401
     ServiceClosed,
     make_query,
 )
-from .store import Donation, SolutionStore, StoredSolution, make_solution  # noqa: F401
+from .store import (  # noqa: F401
+    UNCERTIFIED,
+    Donation,
+    SolutionStore,
+    StoredSolution,
+    make_solution,
+)

@@ -11,6 +11,7 @@ microseconds — the exact-hit latency budget is < 1 ms end to end.
 from __future__ import annotations
 
 import threading
+import weakref
 
 from ..utils.timing import CompileCounter
 
@@ -82,6 +83,63 @@ class ServeMetrics:
         self.descent_steps = 0
         self.polish_steps = 0
         self.precision_escalations = 0
+        # integrity layer (ISSUE 6, DESIGN §9): deadline expirations at
+        # batch seams, per-level certificate verdicts of certified
+        # queries, and the store's corrupt-eviction counter (provided by
+        # the SolutionStore so the metrics module stays dependency-free)
+        self.deadline_expirations = 0
+        self.certificates = {"certified": 0, "marginal": 0, "failed": 0}
+        # provider id -> [WeakMethod, last-seen eviction count]: weak so
+        # a long-lived shared metrics object cannot pin dead services'
+        # stores (each bound provider strongly references its store's
+        # whole memory tier); last-seen so a garbage-collected store's
+        # final observed count stays in the sum (folded into the retired
+        # total when its id is reused by a new store)
+        self._store_counts: dict = {}
+        self._retired_evictions = 0
+
+    def attach_store(self, counts_provider) -> None:
+        """Register a ``SolutionStore.integrity_counts`` provider whose
+        counters ``snapshot`` merges (``store_corrupt_evictions``).
+        Providers ACCUMULATE: a ``ServeMetrics`` shared by several
+        services reports the SUM over their stores (a re-registered
+        provider — e.g. two services over one store — counts once);
+        holds only a weak reference."""
+        with self._lock:
+            key = id(counts_provider.__self__)
+            entry = self._store_counts.get(key)
+            if entry is not None:
+                if entry[0]() is not None:
+                    return      # same live store, already tracked
+                # CPython id reuse: a NEW store was allocated at a
+                # garbage-collected store's address — retire the dead
+                # provider's final observed count (it must stay in the
+                # sum) and track the new store from zero
+                self._retired_evictions += entry[1]
+            self._store_counts[key] = [weakref.WeakMethod(
+                counts_provider), 0]
+
+    def _store_evictions(self) -> int:
+        total = self._retired_evictions
+        for entry in self._store_counts.values():
+            provider = entry[0]()
+            if provider is not None:
+                entry[1] = provider()["store_corrupt_evictions"]
+            total += entry[1]
+        return total
+
+    def record_expired(self, latency_s: float) -> None:
+        """One query failed with ``DeadlineExceeded`` at a batch seam."""
+        with self._lock:
+            self.deadline_expirations += 1
+            self.latency_all.add(latency_s)
+
+    def record_certificate(self, level: int) -> None:
+        """One cold-miss solution was certified (``certify_before_cache``)."""
+        name = ("certified", "marginal", "failed")[max(0, min(2,
+                                                              int(level)))]
+        with self._lock:
+            self.certificates[name] += 1
 
     def record_served(self, path: str, latency_s: float) -> None:
         with self._lock:
@@ -150,4 +208,9 @@ class ServeMetrics:
                                / (self.descent_steps + self.polish_steps),
                                4)),
                 "serve_precision_escalations": self.precision_escalations,
+                "serve_deadline_expirations": self.deadline_expirations,
+                "serve_certified": self.certificates["certified"],
+                "serve_marginal_certificates": self.certificates["marginal"],
+                "serve_failed_certificates": self.certificates["failed"],
+                "store_corrupt_evictions": self._store_evictions(),
             }

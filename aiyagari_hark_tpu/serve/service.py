@@ -50,7 +50,12 @@ from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
 
-from ..solver_health import SolverDivergenceError, is_failure, status_name
+from ..solver_health import (
+    DEADLINE_EXCEEDED,
+    SolverDivergenceError,
+    is_failure,
+    status_name,
+)
 from ..utils.fingerprint import (
     hashable_kwargs,
     solution_fingerprint,
@@ -64,7 +69,7 @@ from ..utils.resilience import (
 )
 from .batcher import MicroBatcher, ServeQueueFull  # noqa: F401  (re-export)
 from .metrics import ServeMetrics
-from .store import SolutionStore, make_solution
+from .store import UNCERTIFIED, SolutionStore, make_solution
 
 
 class ServeError(RuntimeError):
@@ -88,6 +93,41 @@ class EquilibriumSolveFailed(SolverDivergenceError):
             f"{status_name(status)}", status=status)
         self.cell = tuple(cell)
         self.key = int(key)
+
+
+class DeadlineExceeded(ServeError):
+    """A query's deadline expired before its batch launched: the pending
+    future fails typed at the next batch seam instead of waiting
+    indefinitely (ISSUE 6 SLO satellite).  ``status`` is the
+    process-level ``solver_health.DEADLINE_EXCEEDED`` code; counted in
+    ``ServeMetrics`` as ``serve_deadline_expirations``."""
+
+    def __init__(self, cell, key: int, waited_s: float):
+        super().__init__(
+            f"equilibrium query (σ={cell[0]:g}, ρ={cell[1]:g}, "
+            f"sd={cell[2]:g}) missed its deadline after waiting "
+            f"{waited_s:.3f}s")
+        self.status = DEADLINE_EXCEEDED
+        self.cell = tuple(cell)
+        self.key = int(key)
+        self.waited_s = float(waited_s)
+
+
+class CertificationFailed(ServeError):
+    """A cold-miss solution FAILED a posteriori certification under
+    ``certify_before_cache`` (DESIGN §9): the future fails typed with the
+    full ``verify.Certificate`` attached, and the solution is NEVER
+    written to the store — an uncertifiable answer must not become a
+    cache hit."""
+
+    def __init__(self, cell, key: int, certificate):
+        super().__init__(
+            f"equilibrium query (σ={cell[0]:g}, ρ={cell[1]:g}, "
+            f"sd={cell[2]:g}) failed certification: "
+            f"{certificate.summary()}")
+        self.cell = tuple(cell)
+        self.key = int(key)
+        self.certificate = certificate
 
 
 class EquilibriumQuery(NamedTuple):
@@ -157,10 +197,13 @@ class ServedResult(NamedTuple):
     #                                 under precision="reference")
     precision_escalations: int = 0  # ladder descent→reference fallbacks
     #                                 (solver_health.PRECISION_ESCALATED)
+    cert_level: Optional[int] = None  # verify certificate verdict
+    #   (CERTIFIED/MARGINAL; None = this solution was never certified —
+    #   FAILED certificates raise CertificationFailed instead)
 
 
 def _result_from_row(row: np.ndarray, path: str, bracket_init,
-                     key: int) -> ServedResult:
+                     key: int, cert_level=None) -> ServedResult:
     return ServedResult(
         r_star=float(row[0]), capital=float(row[1]), labor=float(row[2]),
         bisect_iters=int(np.rint(row[3])), egm_iters=int(np.rint(row[4])),
@@ -168,13 +211,15 @@ def _result_from_row(row: np.ndarray, path: str, bracket_init,
         path=path, bracket_init=bracket_init, key=int(key),
         descent_steps=int(np.rint(row[7])),
         polish_steps=int(np.rint(row[8])),
-        precision_escalations=int(np.rint(row[9])))
+        precision_escalations=int(np.rint(row[9])),
+        cert_level=cert_level)
 
 
 class _Pending(NamedTuple):
     query: EquilibriumQuery
     future: Future
     t_submit: float
+    deadline: Optional[float] = None   # absolute clock-units expiry
 
 
 class EquilibriumService:
@@ -190,7 +235,19 @@ class EquilibriumService:
     ``inject_fault_mode`` ("nan"/"stall") compiles the deterministic
     fault-injection hook into the service's executables (tests only);
     per-query ``fault_iter`` then selects the poisoned lanes, exactly as
-    ``run_table2_sweep(inject_fault=)`` does for the batch path."""
+    ``run_table2_sweep(inject_fault=)`` does for the batch path.
+
+    Integrity (ISSUE 6, DESIGN §9): ``certify_before_cache=True`` runs a
+    posteriori certification (``verify.certify_equilibrium`` recompute
+    path, thresholds from ``cert_thresholds`` or the configuration-scaled
+    defaults) on every solved cold miss BEFORE the store sees it — a
+    FAILED certificate raises ``CertificationFailed`` on that future and
+    the solution is never cached; CERTIFIED/MARGINAL verdicts ride
+    ``ServedResult.cert_level`` and the store entry.
+    ``inject_corrupt_lane={"at_launch": k, "lane": j, "field": f,
+    "amplitude": a}`` deterministically corrupts one solved lane of the
+    k-th launch post-solve, pre-certification (tests only) — the serve
+    path's silent-data-corruption drill."""
 
     def __init__(self, store: Optional[SolutionStore] = None,
                  capacity: int = 256, disk_path: Optional[str] = None,
@@ -201,12 +258,21 @@ class EquilibriumService:
                  retry: Optional[RetryPolicy] = None,
                  inject_fault_mode: Optional[str] = None,
                  clock=time.monotonic, start_worker: bool = True,
-                 metrics: Optional[ServeMetrics] = None):
+                 metrics: Optional[ServeMetrics] = None,
+                 certify_before_cache: bool = False,
+                 cert_thresholds=None,
+                 inject_corrupt_lane: Optional[dict] = None):
         self.store = (store if store is not None
                       else SolutionStore(capacity=capacity,
                                          disk_path=disk_path,
                                          donor_cutoff=donor_cutoff))
         self.metrics = metrics if metrics is not None else ServeMetrics()
+        self.metrics.attach_store(self.store.integrity_counts)
+        self._certify = bool(certify_before_cache)
+        self._cert_thresholds = cert_thresholds
+        self._corrupt_lane = (dict(inject_corrupt_lane)
+                              if inject_corrupt_lane is not None else None)
+        self._launch_count = 0
         self.batcher = MicroBatcher(max_batch=max_batch,
                                     max_wait_s=max_wait_s,
                                     max_queue=max_queue, ladder=ladder,
@@ -230,10 +296,18 @@ class EquilibriumService:
 
     # -- client surface -----------------------------------------------------
 
-    def submit(self, q: EquilibriumQuery) -> Future:
+    def submit(self, q: EquilibriumQuery,
+               deadline: Optional[float] = None) -> Future:
         """Enqueue one query; returns a future resolving to a
         ``ServedResult`` (or raising ``EquilibriumSolveFailed`` /
-        ``Interrupted``).  Exact cache hits resolve before returning."""
+        ``DeadlineExceeded`` / ``Interrupted``).  Exact cache hits
+        resolve before returning.
+
+        ``deadline`` (seconds from now, clock units): a pending query
+        whose deadline expires before its batch launches fails with the
+        typed ``DeadlineExceeded`` at the next batch seam instead of
+        waiting indefinitely — the SLO primitive.  A query that already
+        resolved (exact hit) never expires."""
         if self._closed:
             raise ServiceClosed("EquilibriumService is closed")
         if q.fault_iter is not None and self._fault_mode is None:
@@ -245,11 +319,14 @@ class EquilibriumService:
         if q.fault_iter is None:
             sol = self.store.get(q.key())
             if sol is not None:
-                res = _result_from_row(np.asarray(sol.packed), "hit",
-                                       None, q.key())
+                lvl = int(sol.cert_level)
+                res = _result_from_row(
+                    np.asarray(sol.packed), "hit", None, q.key(),
+                    cert_level=None if lvl == UNCERTIFIED else lvl)
                 self.metrics.record_served("hit", self._clock() - t0)
                 fut.set_result(res)
                 return fut
+        expiry = None if deadline is None else t0 + float(deadline)
         # Enqueue under the gate: without it a close() between the
         # closed-check above and the offer could run its final drain
         # first, stranding this future.  The worker drains the batcher
@@ -258,19 +335,22 @@ class EquilibriumService:
         with self._gate:
             if self._closed:
                 raise ServiceClosed("EquilibriumService is closed")
-            self.batcher.offer((q.dtype, q.kwargs), _Pending(q, fut, t0),
+            self.batcher.offer((q.dtype, q.kwargs),
+                               _Pending(q, fut, t0, expiry),
                                block=self._worker is not None)
         self.metrics.note_queue_depth(self.batcher.depth())
         return fut
 
     def query(self, crra: float, labor_ar: float, labor_sd: float = 0.2,
               dtype=None, timeout: Optional[float] = None,
+              deadline: Optional[float] = None,
               **model_kwargs) -> ServedResult:
         """Synchronous convenience: build the query, submit, wait.  In
         manual (no-worker) mode pending batches are flushed immediately —
         a lone synchronous caller must not wait out ``max_wait_s``."""
         fut = self.submit(make_query(crra, labor_ar, labor_sd=labor_sd,
-                                     dtype=dtype, **model_kwargs))
+                                     dtype=dtype, **model_kwargs),
+                          deadline=deadline)
         if self._worker is None and not fut.done():
             self.flush()
         return fut.result(timeout)
@@ -292,9 +372,28 @@ class EquilibriumService:
                 return (lo, hi, lev), "near"
         return (r_lo, r_hi, 0), "cold"
 
+    def _expire_due(self, pendings) -> list:
+        """The batch-seam deadline gate (ISSUE 6 SLO satellite): fail
+        every pending whose deadline has passed with the typed
+        ``DeadlineExceeded`` and return the still-live remainder.  Runs
+        BEFORE the launch, so an expired query never pays for (or waits
+        on) a solve its caller has already abandoned."""
+        now = self._clock()
+        live = []
+        for p in pendings:
+            if p.deadline is not None and now >= p.deadline:
+                if not p.future.done():
+                    p.future.set_exception(DeadlineExceeded(
+                        p.query.cell(), p.query.key(), now - p.t_submit))
+                self.metrics.record_expired(now - p.t_submit)
+            else:
+                live.append(p)
+        return live
+
     def _launch(self, group, pendings) -> None:
-        """Solve one flushed batch: plan seeds, pad to the ladder shape,
-        launch the shared executable, scatter rows to futures.  Any
+        """Solve one flushed batch: expire overdue deadlines, plan seeds,
+        pad to the ladder shape, launch the shared executable, certify
+        (``certify_before_cache``), scatter rows to futures.  Any
         launch-level failure fails this batch's futures (typed), never
         the service; ``Interrupted`` re-raises after failing them so the
         worker can drain."""
@@ -306,6 +405,9 @@ class EquilibriumService:
             _host_r_tol,
         )
 
+        pendings = self._expire_due(pendings)
+        if not pendings:
+            return
         dtype, kwargs_items = group
         model_kwargs = dict(kwargs_items)
         r_lo, r_hi = _host_bracket(model_kwargs, dtype)
@@ -348,9 +450,65 @@ class EquilibriumService:
             return
 
         self.metrics.record_batch(n, shape)
+        rows = np.array(np.asarray(packed), dtype=np.float64)
+        launch_id = self._launch_count
+        self._launch_count += 1
+        if (self._corrupt_lane is not None
+                and int(self._corrupt_lane.get("at_launch", 0))
+                == launch_id):
+            # deterministic post-solve lane corruption (tests): the bits
+            # are wrong from here on — certification (or the store's
+            # checksum chain) must stop them, not serve them
+            lane = int(self._corrupt_lane.get("lane", 0))
+            rows[lane, int(self._corrupt_lane.get("field", 0))] += float(
+                self._corrupt_lane.get("amplitude", 1e-3))
+
+        # certify_before_cache (DESIGN §9): one vmapped certification
+        # launch over this batch's healthy, cacheable lanes — the store
+        # never persists (and the futures never see) an uncertified
+        # FAILED solution
+        certs = [None] * len(pendings)
+        if self._certify:
+            from ..verify.certificate import certify_packed_rows
+
+            idx = [i for i, p in enumerate(pendings)
+                   if p.query.fault_iter is None
+                   and not is_failure(int(np.rint(rows[i][6])))]
+            if idx:
+                # padded to the ladder shape (last lane duplicated) like
+                # the solve launch, so a warmed service owns ONE
+                # certifier executable per ladder shape — unpadded, every
+                # distinct healthy-lane count would compile its own
+                pad = self.batcher.pad_to(len(idx))
+                pidx = idx + [idx[-1]] * (pad - len(idx))
+                cells = np.asarray([pendings[i].query.cell()
+                                    for i in pidx])
+                try:
+                    with self._launch_lock, self.metrics.compile:
+                        graded = retry_transient(
+                            lambda: certify_packed_rows(
+                                rows[pidx], cells, dtype, kwargs_items,
+                                thresholds=self._cert_thresholds),
+                            self._retry, label=f"serve certify [{pad}]")
+                except BaseException as e:
+                    # certification is a device launch too: a failure
+                    # there fails THIS batch's futures typed — it must
+                    # never escape _launch and kill the worker with the
+                    # futures stranded unresolved
+                    for p in pendings:
+                        if not p.future.done():
+                            p.future.set_exception(e)
+                        self.metrics.record_failure(
+                            self._clock() - p.t_submit)
+                    if isinstance(e, Interrupted):
+                        raise
+                    return
+                for i, cert in zip(idx, graded[:len(idx)]):
+                    certs[i] = cert
+
         now = self._clock()
         for i, p in enumerate(pendings):
-            row = np.asarray(packed[i], dtype=np.float64)
+            row = rows[i]
             status = int(np.rint(row[6]))
             seed, path = plans[i]
             if is_failure(status):
@@ -358,11 +516,21 @@ class EquilibriumService:
                     p.query.cell(), status, p.query.key()))
                 self.metrics.record_failure(now - p.t_submit)
                 continue
-            res = _result_from_row(row, path, seed, p.query.key())
+            cert = certs[i]
+            if cert is not None:
+                self.metrics.record_certificate(cert.level)
+                if cert.failed:
+                    p.future.set_exception(CertificationFailed(
+                        p.query.cell(), p.query.key(), cert))
+                    self.metrics.record_failure(now - p.t_submit)
+                    continue
+            lvl = None if cert is None else cert.level
+            res = _result_from_row(row, path, seed, p.query.key(),
+                                   cert_level=lvl)
             if p.query.fault_iter is None:
-                self.store.put(make_solution(p.query.cell(), row,
-                                             p.query.group(),
-                                             p.query.key()))
+                self.store.put(make_solution(
+                    p.query.cell(), row, p.query.group(), p.query.key(),
+                    cert_level=UNCERTIFIED if lvl is None else lvl))
             p.future.set_result(res)
             self.metrics.record_served(path, now - p.t_submit)
             self.metrics.record_phases(res.descent_steps, res.polish_steps,

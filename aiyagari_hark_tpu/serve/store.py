@@ -23,7 +23,19 @@ Tiers:
 
 Failed solutions (``solver_health.is_failure``) are never stored — a
 quarantine-grade status must not become a cache hit, and a NaN root must
-never be nominated as a donor (the sidecar's NaN-row rule)."""
+never be nominated as a donor (the sidecar's NaN-row rule).
+
+Integrity (ISSUE 6, DESIGN §9): every entry carries a solve-time
+``packed_row_checksum`` verified on EVERY read — memory-tier hits
+included (hashing 80 bytes costs ~a microsecond against the sub-ms hit
+budget) — and a ``cert_level`` (``verify`` certificate verdict;
+``UNCERTIFIED`` when certification was off).  An entry failing
+verification is EVICTED: dropped from both tiers, its disk file deleted
+(a corrupt file left in place would re-degrade every restart), the
+eviction counted (``integrity_counts`` → ``ServeMetrics``
+``store_corrupt_evictions``) and logged once with the entry key.  The
+store never serves bytes it cannot verify — a miss and a re-solve is the
+degrade."""
 
 from __future__ import annotations
 
@@ -39,6 +51,12 @@ import numpy as np
 from ..solver_health import is_failure
 from ..utils.checkpoint import CORRUPT_NPZ_ERRORS, load_pytree, save_pytree
 from ..utils.config import PACKED_ROW_WIDTH
+from ..utils.fingerprint import packed_row_checksum
+
+# verify.certificate.UNCERTIFIED, inlined to keep this module's imports
+# host-cheap (the certificate module is imported lazily by the service);
+# the equality is pinned by tests/test_verify.py.
+UNCERTIFIED = -1
 
 
 class StoredSolution(NamedTuple):
@@ -48,27 +66,40 @@ class StoredSolution(NamedTuple):
     ``config.PACKED_ROW_FIELDS`` layout, in float64 — float64 round-trips
     npz bit-exactly and holds every narrower compute dtype exactly, so a
     reload serves the original bits.  A pre-widening disk entry fails the
-    template load and degrades like any corrupt entry."""
+    template load and degrades like any corrupt entry.
+
+    ``checksum`` is the solve-time ``packed_row_checksum`` of ``packed``
+    (verified at every boundary, DESIGN §9); ``cert_level`` the
+    ``verify`` certificate verdict for this solution (``UNCERTIFIED``
+    when the service ran without ``certify_before_cache``)."""
 
     cell: np.ndarray    # [3] (σ, ρ, sd) float64
     packed: np.ndarray  # [PACKED_ROW_WIDTH] float64
     group: np.ndarray   # scalar int64 — work_fingerprint (solver config)
     key: np.ndarray     # scalar int64 — solution_fingerprint (full address)
+    checksum: np.ndarray    # scalar int64 — solve-time row checksum
+    cert_level: np.ndarray  # scalar int64 — verify certificate level
 
 
 def _template() -> StoredSolution:
     return StoredSolution(cell=np.zeros(3),
                           packed=np.zeros(PACKED_ROW_WIDTH),
                           group=np.zeros((), np.int64),
-                          key=np.zeros((), np.int64))
+                          key=np.zeros((), np.int64),
+                          checksum=np.zeros((), np.int64),
+                          cert_level=np.zeros((), np.int64))
 
 
-def make_solution(cell, packed, group: int, key: int) -> StoredSolution:
+def make_solution(cell, packed, group: int, key: int,
+                  cert_level: int = UNCERTIFIED) -> StoredSolution:
+    packed = np.asarray(packed, dtype=np.float64)
     return StoredSolution(
         cell=np.asarray(cell, dtype=np.float64),
-        packed=np.asarray(packed, dtype=np.float64),
+        packed=packed,
         group=np.asarray(group, np.int64),
-        key=np.asarray(key, np.int64))
+        key=np.asarray(key, np.int64),
+        checksum=np.asarray(packed_row_checksum(packed), np.int64),
+        cert_level=np.asarray(int(cert_level), np.int64))
 
 
 class Donation(NamedTuple):
@@ -115,6 +146,7 @@ class SolutionStore:
         self._lock = threading.RLock()
         self._mem: OrderedDict = OrderedDict()   # key -> StoredSolution
         self._meta: dict = {}                    # key -> _Meta
+        self._corrupt_evictions = 0
         if disk_path is not None:
             os.makedirs(disk_path, exist_ok=True)
             self._load_disk_index()
@@ -127,23 +159,53 @@ class SolutionStore:
         return os.path.join(self.disk_path,
                             f"sol_{int(key) & 0xFFFFFFFFFFFFFFFF:016x}.npz")
 
+    def _evict_corrupt(self, path: str, reason: str, key=None) -> None:
+        """One shared corrupt-entry eviction (DESIGN §9; lock held): log
+        ONCE with the entry key, forget it in both tiers, count it, and
+        DELETE the disk file — a corrupt file left behind would re-warn
+        and re-degrade on every restart, and must never be servable."""
+        self._corrupt_evictions += 1
+        if key is not None:
+            self._mem.pop(int(key), None)
+            self._meta.pop(int(key), None)
+        warnings.warn(
+            "solution store: evicting corrupt entry "
+            + (f"{int(key)} " if key is not None else "")
+            + f"({os.path.basename(path)}): {reason}; the entry is "
+            "deleted and the query will re-solve", stacklevel=3)
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+    def _verified(self, sol: StoredSolution) -> bool:
+        """Content-checksum verification of one entry's packed row
+        against its solve-time checksum (# integrity-ok: this IS the
+        verification site)."""
+        return packed_row_checksum(sol.packed) == int(sol.checksum)
+
     def _load_disk_index(self) -> None:
         """Rebuild the index from the disk tier (process restart).  A
-        corrupt entry file is skipped with a warning — the store must
-        degrade to re-solving, never refuse to start."""
+        corrupt entry file is EVICTED — logged with its key, counted,
+        deleted — and the store degrades to re-solving: it must never
+        refuse to start, and never serve (or keep) bytes it cannot
+        verify."""
         for path in sorted(glob.glob(os.path.join(self.disk_path,
                                                   "sol_*.npz"))):
             try:
                 sol = load_pytree(path, _template())
             except CORRUPT_NPZ_ERRORS as e:
-                warnings.warn(f"solution store: skipping unreadable entry "
-                              f"{path} ({e})", stacklevel=2)
+                self._evict_corrupt(path, f"unreadable ({e})")
                 continue
             if sol.packed.shape != (PACKED_ROW_WIDTH,):
                 # pre-widening row layout: unreadable by this version
-                warnings.warn(f"solution store: skipping entry {path} with "
-                              f"stale row layout {sol.packed.shape}",
-                              stacklevel=2)
+                self._evict_corrupt(path,
+                                    f"stale row layout {sol.packed.shape}",
+                                    key=sol.key)
+                continue
+            if not self._verified(sol):
+                self._evict_corrupt(path, "checksum mismatch",
+                                    key=sol.key)
                 continue
             self._meta[int(sol.key)] = _Meta(
                 cell=tuple(np.asarray(sol.cell, dtype=np.float64)),
@@ -154,28 +216,56 @@ class SolutionStore:
 
     def get(self, key: int) -> Optional[StoredSolution]:
         """Exact lookup; promotes to most-recently-used.  A disk-resident
-        entry is loaded and promoted into memory (evicting LRU)."""
+        entry is loaded and promoted into memory (evicting LRU).  EVERY
+        return path re-verifies the entry's content checksum — a
+        memory-tier bit flip is as silent as a disk one — and a failed
+        verification evicts the entry (both tiers + disk file) and
+        reports a miss, so the caller re-solves instead of serving
+        corruption."""
         key = int(key)
         with self._lock:
             sol = self._mem.get(key)
             if sol is not None:
-                self._mem.move_to_end(key)
-                return sol
+                if not self._verified(sol):
+                    # in-RAM corruption: drop ONLY the memory copy — the
+                    # disk entry is a separate byte store written
+                    # atomically with its own verification on load, very
+                    # plausibly still healthy; destroying it would turn
+                    # one transient memory flip into a permanent cache
+                    # loss.  Fall through to the disk path below, which
+                    # re-verifies (and evicts the file iff IT is bad).
+                    self._corrupt_evictions += 1
+                    del self._mem[key]
+                    meta = self._meta.get(key)
+                    on_disk = meta is not None and meta.on_disk
+                    warnings.warn(
+                        f"solution store: entry {key} failed checksum "
+                        "verification in the memory tier; dropping the "
+                        "in-memory copy"
+                        + (" and retrying the disk tier" if on_disk
+                           else ""), stacklevel=2)
+                    if not on_disk:
+                        self._meta.pop(key, None)
+                        return None
+                else:
+                    self._mem.move_to_end(key)
+                    return sol
             meta = self._meta.get(key)
             if meta is None or not meta.on_disk:
                 return None
+            path = self._file(key)
             try:
-                sol = load_pytree(self._file(key), _template())
+                sol = load_pytree(path, _template())
             except CORRUPT_NPZ_ERRORS as e:
-                warnings.warn(f"solution store: entry {key} unreadable on "
-                              f"disk ({e}); dropping it", stacklevel=2)
-                del self._meta[key]
+                self._evict_corrupt(path, f"unreadable ({e})", key=key)
                 return None
             if sol.packed.shape != (PACKED_ROW_WIDTH,):
-                warnings.warn(f"solution store: entry {key} has a stale "
-                              f"row layout {sol.packed.shape}; dropping it",
-                              stacklevel=2)
-                del self._meta[key]
+                self._evict_corrupt(path,
+                                    f"stale row layout {sol.packed.shape}",
+                                    key=key)
+                return None
+            if not self._verified(sol):
+                self._evict_corrupt(path, "checksum mismatch", key=key)
                 return None
             self._insert(key, sol)
             return sol
@@ -268,3 +358,10 @@ class SolutionStore:
         the eviction-order contract."""
         with self._lock:
             return list(self._mem.keys())
+
+    def integrity_counts(self) -> dict:
+        """Integrity counters for ``ServeMetrics`` (DESIGN §9):
+        ``store_corrupt_evictions`` is the number of entries that failed
+        checksum/format verification and were evicted (+ file deleted)."""
+        with self._lock:
+            return {"store_corrupt_evictions": self._corrupt_evictions}

@@ -57,9 +57,14 @@ NONFINITE = 3
 # "worse" than NONFINITE only in the trivial sense that no numbers were
 # produced at all.
 INTERRUPTED = 4
+# Process-level: a serving query's deadline expired before its batch
+# launched (``serve.DeadlineExceeded`` — ISSUE 6 SLO satellite).  Like
+# INTERRUPTED, no numbers were produced: uncertified by construction,
+# failure side of ``is_failure``.
+DEADLINE_EXCEEDED = 5
 
 STATUS_NAMES = ("CONVERGED", "STALLED", "MAX_ITER", "NONFINITE",
-                "INTERRUPTED")
+                "INTERRUPTED", "DEADLINE_EXCEEDED")
 
 # NOTE marker, not a status code (it never enters ``combine_status``): a
 # mixed-precision ladder's DESCENT phase exited NONFINITE or STALLED and

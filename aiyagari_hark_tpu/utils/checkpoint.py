@@ -212,7 +212,17 @@ class SweepSidecar(NamedTuple):
     whose work is mostly cheap steps and the scheduler's buckets would
     drift off balance.  Adding the columns is a sidecar format change:
     an old-format file fails the pytree template load and the scheduler
-    degrades to its heuristic, exactly like any corrupt sidecar."""
+    degrades to its heuristic, exactly like any corrupt sidecar.
+
+    ``checksum`` (DESIGN §9) is the ``fingerprint.content_checksum`` of
+    every content array above, computed at save time: warm-bracket seeds
+    READ ``r_star`` live, so a bit-flipped row that still parses would
+    silently move a descended bracket (the seed verification would catch
+    the junk target at the cost of two wasted solves per lane — but a
+    corrupted COUNTER row would skew the bucket plan with no verification
+    downstream at all).  ``load_sweep_sidecar`` verifies it and raises
+    the typed ``IntegrityError``; the scheduler degrades to its
+    heuristic, same as any corrupt sidecar."""
 
     cells: np.ndarray         # [C, 3] (σ, ρ, sd), float64
     r_star: np.ndarray        # [C] net rate at the certified root; NaN=failed
@@ -223,6 +233,20 @@ class SweepSidecar(NamedTuple):
     polish_steps: np.ndarray   # [C] int64 reference-phase inner steps
     status: np.ndarray        # [C] int64 solver_health codes
     fingerprint: np.ndarray   # scalar int64 — solver-config hash
+    # scalar int64 content checksum (DESIGN §9); the default (0 = unset)
+    # keeps hand-built sidecars (tests, tooling) constructible — the
+    # blessed writer always stamps the real checksum
+    checksum: np.ndarray = np.zeros((), np.int64)
+
+    def content_checksum(self) -> int:
+        """The checksum the stored content SHOULD carry — one canonical
+        hashing order, shared by the writer and the verifier."""
+        from .fingerprint import content_checksum
+
+        return content_checksum(self.cells, self.r_star, self.bisect_iters,
+                                self.egm_iters, self.dist_iters,
+                                self.descent_steps, self.polish_steps,
+                                self.status)
 
     def total_work(self) -> np.ndarray:
         """Reference-precision-equivalent per-cell work: every step, with
@@ -252,7 +276,7 @@ def save_sweep_sidecar(path: str, cells, r_star, bisect_iters, egm_iters,
     if polish_steps is None:
         polish_steps = (np.asarray(egm_iters, dtype=np.int64)
                         + np.asarray(dist_iters, dtype=np.int64))
-    save_pytree(path, SweepSidecar(
+    side = SweepSidecar(
         cells=np.asarray(cells, dtype=np.float64),
         r_star=np.asarray(r_star, dtype=np.float64),
         bisect_iters=np.asarray(bisect_iters, dtype=np.int64),
@@ -261,19 +285,27 @@ def save_sweep_sidecar(path: str, cells, r_star, bisect_iters, egm_iters,
         descent_steps=np.asarray(descent_steps, dtype=np.int64),
         polish_steps=np.asarray(polish_steps, dtype=np.int64),
         status=np.asarray(status, dtype=np.int64),
-        fingerprint=np.asarray(fingerprint, np.int64)))
+        fingerprint=np.asarray(fingerprint, np.int64),
+        checksum=np.zeros((), np.int64))
+    save_pytree(path, side._replace(
+        checksum=np.asarray(side.content_checksum(), np.int64)))
 
 
 def load_sweep_sidecar(path: str, fingerprint: int) -> SweepSidecar:
     """Load a scheduler sidecar, refusing one written under a different
-    solver configuration.
+    solver configuration or with corrupted content.
 
-    Raises ``CheckpointMismatchError`` on a fingerprint mismatch and lets
-    OSError/ValueError from a missing or corrupt file propagate — the
-    scheduler catches all three and degrades to its (σ, ρ, sd) heuristic:
-    a stale work model must never be silently trusted for warm brackets
-    (the bracket seeds would fail verification and waste two evaluations
-    per lane), and a missing sidecar is the normal first-run state."""
+    Raises ``CheckpointMismatchError`` on a fingerprint mismatch, the
+    typed ``fingerprint.IntegrityError`` on a content-checksum mismatch
+    (the stored counters/roots are not the bytes that were solved), and
+    lets OSError/ValueError from a missing or corrupt file propagate —
+    the scheduler catches all of these and degrades to its (σ, ρ, sd)
+    heuristic: a stale or corrupted work model must never be silently
+    trusted for warm brackets (the bracket seeds would fail verification
+    and waste two evaluations per lane), and a missing sidecar is the
+    normal first-run state."""
+    from .fingerprint import IntegrityError
+
     n = 1   # template leaf shapes come from the file; any row count loads
     tmpl = SweepSidecar(
         cells=np.zeros((n, 3)), r_star=np.zeros(n),
@@ -281,13 +313,20 @@ def load_sweep_sidecar(path: str, fingerprint: int) -> SweepSidecar:
         dist_iters=np.zeros(n, np.int64),
         descent_steps=np.zeros(n, np.int64),
         polish_steps=np.zeros(n, np.int64), status=np.zeros(n, np.int64),
-        fingerprint=np.zeros((), np.int64))
+        fingerprint=np.zeros((), np.int64), checksum=np.zeros((), np.int64))
     side = load_pytree(path, tmpl)
     if int(side.fingerprint) != int(fingerprint):
         raise CheckpointMismatchError(
             f"sweep sidecar {path} was written under solver-config "
             f"fingerprint {int(side.fingerprint)}, current is "
             f"{int(fingerprint)}; refusing a stale work model")
+    want = side.content_checksum()
+    if int(side.checksum) != int(want):
+        raise IntegrityError(
+            f"sweep sidecar {path} failed content-checksum verification "
+            f"(stored {int(side.checksum)}, content hashes to {want}) — "
+            "silent corruption; refusing the work model",
+            boundary="sidecar")
     return side
 
 

@@ -296,7 +296,23 @@ class SweepConfig:
       a restarted identical run (fingerprint-checked) skips completed
       work, reassembling a bit-identical ``SweepResult``.  Deleted on
       successful completion.  None (default) disables persistence; the
-      ``run_table2_sweep(resume_path=)`` argument overrides."""
+      ``run_table2_sweep(resume_path=)`` argument overrides.
+
+    Integrity knobs (ISSUE 6, DESIGN §9):
+
+    * ``recheck_fraction`` — SDC spot-check rate: deterministically
+      re-solve a fingerprint-sampled ``ceil(fraction * C)`` subset of
+      cells in a PERMUTED lane position after the batched solve and
+      compare the packed rows bitwise (the packing-independence
+      contract makes any mismatch a silent-data-corruption signal, not
+      noise).  A mismatching cell is recorded ``sdc_suspected`` and
+      routed through the quarantine retry ladder for a trusted re-solve.
+      0.0 (default) disables; the recheck runs outside the timed wall.
+    * ``certify`` — a posteriori certification of every cell after the
+      solve (``verify.certify_equilibrium`` recompute path): Euler /
+      stationarity / market-clearing / shape residuals against
+      ``verify.CertThresholds`` for this configuration, recorded
+      per-cell in ``SweepResult.cert_level``."""
 
     crra_values: Tuple[float, ...] = (1.0, 3.0, 5.0)
     rho_values: Tuple[float, ...] = (0.0, 0.3, 0.6, 0.9)
@@ -309,6 +325,8 @@ class SweepConfig:
     sidecar_path: str | None = None
     compilation_cache: bool = True
     resume_path: str | None = None
+    recheck_fraction: float = 0.0
+    certify: bool = False
 
     def replace(self, **kwargs) -> "SweepConfig":
         return dataclasses.replace(self, **kwargs)

@@ -29,6 +29,76 @@ import numpy as np
 from .config import PACKED_ROW_FIELDS, resolve_precision
 
 
+class IntegrityError(RuntimeError):
+    """A content checksum failed verification at an artifact boundary —
+    the stored bytes are not the bytes that were solved (bit flip, torn
+    write that still parses, stale partial overwrite).
+
+    Deliberately NOT a ``ValueError``/``OSError`` subclass: the broad
+    best-effort loaders (``checkpoint.CORRUPT_NPZ_ERRORS``) must not
+    swallow it by accident — every boundary that can see one decides its
+    own degrade explicitly (recompute for store/serve, quarantine for
+    resume, heuristic for the sidecar) and logs what it evicted.
+
+    ``boundary`` names the verification site ("ledger", "sidecar",
+    "store-mem", "store-disk", "serve"); ``key`` the entry/cell involved
+    when there is one."""
+
+    def __init__(self, message: str, boundary: str | None = None,
+                 key=None):
+        super().__init__(message)
+        self.boundary = boundary
+        self.key = None if key is None else int(key)
+
+
+def content_checksum(*arrays) -> int:
+    """Deterministic int64 checksum over the CANONICAL bytes of one or
+    more numeric arrays: every array is materialized as little-endian
+    float64 (which holds every narrower compute dtype exactly and
+    round-trips npz bit-exactly — the packed-row persistence rationale),
+    C-contiguous, shape included.  The one spelling every integrity
+    boundary hashes (ledger rows, sidecar content, store entries), so a
+    checksum computed at solve time verifies at every later load no
+    matter which subsystem did the storing."""
+    h = hashlib.md5()
+    for a in arrays:
+        a = np.ascontiguousarray(np.asarray(a, dtype="<f8"))
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return int.from_bytes(h.digest()[:8], "little", signed=True)
+
+
+def packed_row_checksum(row) -> int:
+    """Content checksum of ONE packed device row
+    (``config.PACKED_ROW_FIELDS`` layout) — computed at solve time,
+    verified at every boundary the row later crosses (ledger
+    flush/restore, store tiers, serve responses)."""
+    return content_checksum(row)
+
+
+def packed_row_checksums(rows) -> np.ndarray:
+    """Per-row checksums of a ``[C, W]`` packed block, int64.  NaN rows
+    (quarantined / not-yet-solved) checksum deterministically too — IEEE
+    NaN payloads produced by the same program are the same bits."""
+    rows = np.asarray(rows, dtype=np.float64)
+    return np.asarray([packed_row_checksum(r) for r in rows],
+                      dtype=np.int64)
+
+
+def verify_packed_row(row, expected: int, boundary: str,
+                      key=None) -> None:
+    """Raise a typed ``IntegrityError`` unless ``row``'s content checksum
+    matches ``expected`` (an int64 recorded at solve time)."""
+    got = packed_row_checksum(row)
+    if int(got) != int(expected):
+        where = "" if key is None else f" (entry {int(key)})"
+        raise IntegrityError(
+            f"packed-row checksum mismatch at the {boundary} "
+            f"boundary{where}: stored bytes hash to {got}, solve-time "
+            f"checksum was {int(expected)} — silent corruption",
+            boundary=boundary, key=key)
+
+
 def config_fingerprint(*objs) -> int:
     """Deterministic int64 fingerprint of configs/arrays, used to detect
     state written under a different setup (stale-resume guard, cache

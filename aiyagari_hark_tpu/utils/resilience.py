@@ -63,6 +63,7 @@ from .checkpoint import (
     load_pytree,
     save_pytree,
 )
+from .fingerprint import packed_row_checksum, packed_row_checksums
 
 
 class Interrupted(BaseException):
@@ -404,7 +405,16 @@ class SweepLedger(NamedTuple):
     those bits — cells (perturb included), solver kwargs, dtype, schedule
     knobs, fault injection, the warm-start sidecar's content, AND the
     row layout itself (a pre-widening ledger must refuse to resume) — a
-    mismatch degrades loudly to a fresh run."""
+    mismatch degrades loudly to a fresh run.
+
+    ``checksums`` (DESIGN §9) are per-row ``packed_row_checksum`` values
+    recorded at SOLVE time, before the first flush — the fingerprint
+    certifies *which run* wrote the ledger, the checksums certify that
+    each row's BYTES are still the bytes that run solved.  A resumed load
+    verifies every solved/retried row; a mismatched row (bit flip, torn
+    npz that still parses) is quarantined — its solved/retried flags are
+    cleared so the sweep recomputes it — instead of reassembling silent
+    garbage into a "bit-identical" result."""
 
     packed: np.ndarray       # [C, PACKED_ROW_WIDTH] float64; NaN rows =
     #                          not yet solved
@@ -413,6 +423,7 @@ class SweepLedger(NamedTuple):
     pred: np.ndarray         # [C] float64 scheduler work model
     retries: np.ndarray      # [C] int64 quarantine rungs consumed
     retried: np.ndarray      # [C] bool — quarantine outcome is final
+    checksums: np.ndarray    # [C] int64 solve-time row checksums (0=unset)
     fingerprint: np.ndarray  # scalar int64
 
 
@@ -424,6 +435,7 @@ def _ledger_template(n: int) -> SweepLedger:
         pred=np.full(n, np.nan),
         retries=np.zeros(n, dtype=np.int64),
         retried=np.zeros(n, dtype=bool),
+        checksums=np.zeros(n, dtype=np.int64),
         fingerprint=np.zeros((), np.int64))
 
 
@@ -445,7 +457,10 @@ class LedgerState:
         self.pred = t.pred
         self.retries = t.retries
         self.retried = t.retried
+        self.checksums = t.checksums
         self.resumed = False      # a prior run's progress was restored
+        self.corrupt_cells = []   # cells quarantined by resume-time
+        #                           checksum verification (recomputed)
 
     @classmethod
     def resume(cls, path: str, fingerprint: int,
@@ -477,15 +492,47 @@ class LedgerState:
         self.pred = np.array(led.pred)
         self.retries = np.array(led.retries)
         self.retried = np.array(led.retried)
+        self.checksums = np.array(led.checksums)
+        self._verify_rows()
         self.resumed = bool(self.solved.any() or self.retried.any())
         return self
 
+    def _verify_rows(self) -> None:
+        """Resume-time integrity verification (DESIGN §9): every row the
+        ledger claims solved/retried must still hash to its solve-time
+        checksum.  A mismatching row — silent corruption that parsed
+        fine — is QUARANTINED: its flags are cleared so the restarted
+        sweep recomputes it (and its bucket), and the event is warned
+        loudly with the cell indices.  Other cells' restored bits are
+        untouched — corruption must never poison its neighbors."""
+        claimed = self.solved | self.retried
+        bad = [int(i) for i in np.nonzero(claimed)[0]
+               if packed_row_checksum(self.packed[i])
+               != int(self.checksums[i])]
+        if not bad:
+            return
+        for i in bad:
+            self.packed[i] = np.nan
+            self.solved[i] = False
+            self.retried[i] = False
+            self.retries[i] = 0
+            self.bucket[i] = -1
+            self.checksums[i] = 0
+        self.corrupt_cells = bad
+        warnings.warn(
+            f"sweep resume ledger {self.path}: row checksum verification "
+            f"failed for cell(s) {bad} — silent corruption; those cells "
+            "are quarantined and will be recomputed", stacklevel=3)
+
     def record_bucket(self, cells: np.ndarray, rows: np.ndarray,
                       bucket_id: int) -> None:
-        """A bucket launch finished: store its cells' packed rows."""
+        """A bucket launch finished: store its cells' packed rows, with
+        content checksums taken NOW — at solve time, before any flush —
+        so every later boundary can verify the bytes."""
         self.packed[cells] = rows
         self.solved[cells] = True
         self.bucket[cells] = bucket_id
+        self.checksums[cells] = packed_row_checksums(rows)
 
     def record_retry(self, cell: int, row: np.ndarray,
                      attempts: int) -> None:
@@ -494,11 +541,13 @@ class LedgerState:
         self.packed[cell] = row
         self.retries[cell] = attempts
         self.retried[cell] = True
+        self.checksums[cell] = packed_row_checksum(row)
 
     def flush(self) -> None:
         save_pytree(self.path, SweepLedger(
             packed=self.packed, solved=self.solved, bucket=self.bucket,
             pred=self.pred, retries=self.retries, retried=self.retried,
+            checksums=self.checksums,
             fingerprint=np.asarray(self.fingerprint, np.int64)))
 
     def complete(self) -> None:

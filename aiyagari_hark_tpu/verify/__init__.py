@@ -1,0 +1,58 @@
+"""Solution-integrity subsystem (DESIGN §9): a posteriori certification,
+the checksummed artifact chain, and silent-corruption defense.
+
+Three pillars:
+
+* **Certification** (``certificate``): ``certify_equilibrium`` re-checks
+  a solved equilibrium through independent straightforward evaluations —
+  Euler residuals at off-grid midpoints, stationarity and mass of the
+  wealth distribution, full-path market clearing, shape and Lorenz
+  invariants — returning a severity-ordered ``Certificate``
+  (CERTIFIED < MARGINAL < FAILED).
+* **Checksummed artifact chain** (``utils.fingerprint``
+  ``packed_row_checksum``/``content_checksum``/``IntegrityError``):
+  content checksums computed at solve time and verified at every
+  boundary a solution later crosses — resume-ledger restore, scheduler
+  sidecar load, ``SolutionStore`` memory/disk tiers, serve responses —
+  so corruption surfaces as a typed error that degrades (recompute /
+  evict / quarantine) instead of propagating.
+* **SDC spot-checks + injection** (``parallel.sweep``
+  ``SweepConfig(recheck_fraction=)``; ``inject``): deterministic
+  re-solves of a fingerprint-sampled cell subset in permuted lane
+  positions, compared bitwise (the packing-independence contract), plus
+  the deterministic corruption injectors that exercise every detection
+  path in tier-1.
+"""
+
+from ..utils.fingerprint import (  # noqa: F401
+    IntegrityError,
+    content_checksum,
+    packed_row_checksum,
+    packed_row_checksums,
+    verify_packed_row,
+)
+from .certificate import (  # noqa: F401
+    CERT_CHECKS,
+    CERT_LEVEL_NAMES,
+    CERTIFIED,
+    FAILED,
+    MARGINAL,
+    UNCERTIFIED,
+    Certificate,
+    CertThresholds,
+    CheckResult,
+    cert_level_name,
+    certify_equilibrium,
+    certify_packed_rows,
+    euler_residual_midpoints,
+    lorenz_residual,
+    shape_residual,
+    stationarity_residuals,
+)
+from .inject import (  # noqa: F401
+    corrupt_ledger_row,
+    corrupt_store_entry,
+    flip_row_bit,
+    perturb_row,
+    perturbed_policy,
+)

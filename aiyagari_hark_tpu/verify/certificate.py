@@ -1,0 +1,603 @@
+"""A posteriori equilibrium certification (DESIGN §9).
+
+The solvers certify their own exits (``solver_health``: tolerance met,
+budget exhausted, non-finite), but a *silent* failure — a bit-flipped
+packed row, a device computing subtly wrong lanes, a torn store entry that
+still parses — produces finite, plausible numbers that no exit code can
+flag.  The theory supplies cheap independent oracles: an Aiyagari
+equilibrium is fully characterized by Euler-equation optimality of the
+policy and stationarity/market-clearing of the distribution (Ma–
+Stachurski–Toda arXiv:1812.01320; Cao–Luo–Nie arXiv:1905.13045), so every
+solution can be certified AFTER the fact by a code path that did not
+produce it.
+
+``certify_equilibrium`` recomputes, via independent straightforward
+evaluations (never the EGM inverse update, never the lean in-loop carry):
+
+* **euler** — the relative Euler-equation residual of the consumption
+  policy at OFF-GRID midpoints of the endogenous knots (EGM satisfies the
+  Euler equation at the knots by construction, so the knots alone cannot
+  catch a policy that is wrong between them), masked to the
+  constraint-slack region where the equation holds with equality;
+* **stationarity / mass** — ``‖Γ′μ − μ‖∞`` under a fresh push-forward of
+  the transition implied by the policy, and ``|Σμ − 1|`` mass
+  conservation;
+* **market_clearing** — ``|K_supply(r*) − K_demand(r*)| / K`` with the
+  supply re-evaluated through the FULL (not lean) path
+  (policy solve at r*, stationary distribution, aggregation);
+* **capital** — the solution's reported capital against the re-evaluated
+  supply (the lean solver reports supply at the last bisection midpoint,
+  within one bracket width of A(r*) — corruption of the capital field
+  shows up here);
+* **shape / lorenz** — structural invariants: strictly increasing
+  endogenous knots, positive nondecreasing consumption, nonnegative
+  masses with a monotone cumulative-wealth (Lorenz) curve;
+* **recompute** — the certifier's own inner solves' ``solver_health``
+  exits (a certificate built on a diverged recomputation certifies
+  nothing).
+
+Each check yields a residual compared against a typed threshold ladder
+(``CertThresholds`` — defaults scale with the solver tolerances the same
+way ``equilibrium._bisection_setup`` scales them with dtype), producing a
+severity-ordered verdict per check and overall:
+
+    CERTIFIED (0) < MARGINAL (1) < FAILED (2)
+
+combined by ``max`` exactly like ``solver_health.combine_status``.  The
+verdicts thread into ``SweepResult.cert_level``, ``StoredSolution`` /
+``ServedResult`` (``serve``), and the ``--integrity-smoke`` bench record.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from functools import lru_cache
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from ..solver_health import is_failure
+from ..utils.fingerprint import hashable_kwargs
+
+# Severity-ordered certificate levels; combine with max().
+CERTIFIED = 0
+MARGINAL = 1
+FAILED = 2
+# Store sentinel: no certificate was ever computed for this entry.
+UNCERTIFIED = -1
+
+CERT_LEVEL_NAMES = ("CERTIFIED", "MARGINAL", "FAILED")
+
+# Fixed residual-vector layout shared by the jitted recompute certifier,
+# the eager object certifier, the bench record (integrity_max_<check>),
+# and the tests.  Order is load-bearing — never reorder, only append.
+CERT_CHECKS = ("euler", "stationarity", "mass", "market_clearing",
+               "capital", "shape", "lorenz", "recompute")
+
+
+def cert_level_name(level: int) -> str:
+    level = int(level)
+    if level == UNCERTIFIED:
+        return "UNCERTIFIED"
+    if 0 <= level < len(CERT_LEVEL_NAMES):
+        return CERT_LEVEL_NAMES[level]
+    return f"UNKNOWN({level})"
+
+
+class CheckResult(NamedTuple):
+    """One certification check's outcome."""
+
+    name: str
+    residual: float
+    threshold: float      # CERTIFIED bound; MARGINAL up to marginal_factor x
+    level: int
+
+    def __repr__(self) -> str:
+        return (f"CheckResult({self.name}: {cert_level_name(self.level)}, "
+                f"residual={self.residual:.3e} vs tol={self.threshold:.3e})")
+
+
+class Certificate(NamedTuple):
+    """Severity-ordered a posteriori certificate of one equilibrium."""
+
+    level: int                 # worst check level (max)
+    checks: tuple              # of CheckResult, CERT_CHECKS order
+
+    @property
+    def certified(self) -> bool:
+        return self.level == CERTIFIED
+
+    @property
+    def failed(self) -> bool:
+        return self.level >= FAILED
+
+    def residuals(self) -> dict:
+        return {c.name: c.residual for c in self.checks}
+
+    def worst(self) -> CheckResult:
+        return max(self.checks, key=lambda c: (c.level,
+                                               c.residual / max(c.threshold,
+                                                                1e-300)))
+
+    def summary(self) -> str:
+        w = self.worst()
+        return (f"{cert_level_name(self.level)}"
+                + ("" if self.level == CERTIFIED
+                   else f" (worst: {w.name} residual {w.residual:.3e} "
+                        f"vs tol {w.threshold:.3e})"))
+
+
+@dataclass(frozen=True)
+class CertThresholds:
+    """CERTIFIED bounds per check; a residual within ``marginal_factor``
+    of its bound certifies MARGINAL, beyond that FAILED.
+
+    Defaults are calibrated for the float64 default solver tolerances
+    (r_tol=1e-10, egm_tol=1e-6, dist_tol=1e-11) with ~an order of
+    magnitude of headroom over the measured committed-golden residuals;
+    ``for_solver`` rescales the tolerance-coupled bounds for other
+    configurations (a bisection root is only located to its bracket
+    width, so the market-clearing bound MUST widen with r_tol — the
+    certificate certifies the solution against *its own* contract, not
+    a tighter one it never promised).
+
+    * ``euler`` is dominated by piecewise-linear interpolation curvature
+      error between knots, O(h²) in the local grid spacing — solver- and
+      r_tol-independent.
+    * ``stationarity`` floors at the distribution fixed point's own exit
+      (≤ a small multiple of dist_tol; the stall window can leave it a
+      few x above).
+    * ``mass`` is accumulation noise: D·eps-scale.
+    * ``market_clearing``/``capital`` scale with r_tol times the excess
+      map's relative slope (O(10–100) on the Table II lattice), floored
+      at the inner-solver noise the supply evaluation itself carries.
+    * ``shape``/``lorenz`` are structural: any true violation fails, the
+      tiny nonzero bounds only absorb cumsum rounding.
+    * ``recompute`` maps the certifier's own inner ``solver_health``
+      exits: CONVERGED certifies, STALLED is marginal, failures fail.
+    """
+
+    euler: float = 0.08
+    stationarity: float = 1e-8
+    mass: float = 5e-10
+    market_clearing: float = 1e-2
+    capital: float = 1e-2
+    shape: float = 0.0
+    lorenz: float = 1e-12
+    recompute: float = 0.5
+    marginal_factor: float = 8.0
+
+    @classmethod
+    def for_solver(cls, dtype=None, r_tol: Optional[float] = None,
+                   egm_tol: Optional[float] = None,
+                   dist_tol: Optional[float] = None,
+                   precision: str = "reference",
+                   **overrides) -> "CertThresholds":
+        """Thresholds matched to a solver configuration's own tolerance
+        contract — the same dtype-aware defaults as
+        ``equilibrium._bisection_setup``.
+
+        ``precision``: a non-reference ladder policy (DESIGN §5) legally
+        wobbles the root by its cheap-phase noise (the descent's f32
+        excess evaluations steer the early bracket; measured ~4e-6 in r
+        on the committed-golden config, ~1.4e-2 in relative excess), so
+        the market-clearing/capital bounds widen 4x — certifying a mixed
+        solution against reference-noise bounds would reject its own
+        documented contract, not corruption."""
+        f64 = np.dtype(dtype if dtype is not None else np.float64) \
+            == np.float64
+        if r_tol is None:
+            r_tol = 1e-10 if f64 else 1e-6
+        if dist_tol is None:
+            dist_tol = 1e-11 if f64 else 1e-8
+        if egm_tol is None:
+            egm_tol = 1e-6 if f64 else 1e-5
+        eps = float(np.finfo(np.float64 if f64 else np.float32).eps)
+        # Two noise sources bound how well an honest root can clear the
+        # market: (1) the bracket — r* is located to r_tol and the excess
+        # map's measured relative slope reaches ~600 on the Table II
+        # lattice (σ=1 cells); (2) the inner solves — the EGM fixed point
+        # converges to egm_tol per-step, i.e. ~egm_tol/(1-β) true policy
+        # error, which the slow-mixing stationary distribution amplifies
+        # by ~1/(1-λ_mix) into the aggregate (measured: up to ~1.7e-3
+        # relative at egm_tol=1e-6 on the committed-golden config).  The
+        # bound takes the worse of the two with ~5x headroom; corruption
+        # below it is the checksum chain's and the bitwise SDC recheck's
+        # job — the certificate is the last line for SEMANTIC error.
+        market = max(1e4 * float(egm_tol), 1500.0 * float(r_tol))
+        from ..utils.config import resolve_precision
+
+        if resolve_precision(precision).two_phase:
+            market *= 4.0
+        return cls(
+            euler=max(0.08, 20.0 * float(egm_tol)),
+            stationarity=max(300.0 * float(dist_tol), 200.0 * eps),
+            mass=max(5e-10 if f64 else 5e-5, 2e5 * eps),
+            market_clearing=market,
+            capital=market,
+        ).replace(**overrides)
+
+    def replace(self, **kw) -> "CertThresholds":
+        return replace(self, **kw)
+
+    def bound(self, name: str) -> float:
+        return float(getattr(self, name))
+
+    def grade(self, name: str, residual: float) -> CheckResult:
+        """One residual -> one severity-graded CheckResult.  A non-finite
+        residual (the recomputation itself produced garbage) fails.
+
+        The ``recompute`` check carries a raw ``solver_health`` status
+        code, not a continuous residual, so it gets its OWN band —
+        CONVERGED certifies, STALLED is marginal, MAX_ITER/NONFINITE
+        fail — instead of the shared ``marginal_factor``, which would
+        grade a diverged recomputation (status 2-3) MARGINAL and let it
+        through the certify-before-cache gate."""
+        tol = self.bound(name)
+        r = float(residual)
+        if name == "recompute":
+            marginal_bound = 1.5      # STALLED (1) and nothing above
+        else:
+            marginal_bound = self.marginal_factor * tol
+        if not np.isfinite(r):
+            level = FAILED
+        elif r <= tol:
+            level = CERTIFIED
+        elif r <= marginal_bound:
+            level = MARGINAL
+        else:
+            level = FAILED
+        return CheckResult(name=name, residual=r, threshold=tol, level=level)
+
+    def certificate(self, residuals) -> Certificate:
+        """Grade a CERT_CHECKS-ordered residual vector."""
+        checks = tuple(self.grade(name, r)
+                       for name, r in zip(CERT_CHECKS, residuals))
+        return Certificate(level=max(c.level for c in checks),
+                           checks=checks)
+
+
+# ---------------------------------------------------------------------------
+# The independent residual evaluations (jit/vmap-safe; jax imported lazily
+# so importing the certificate vocabulary costs nothing).
+# ---------------------------------------------------------------------------
+
+def euler_residual_midpoints(policy, R, W, model, disc_fac, crra):
+    """Max relative Euler-equation residual of ``policy`` at the OFF-GRID
+    midpoints of its endogenous knots, over the constraint-slack region.
+
+    Straightforward forward evaluation — interpolate consumption at the
+    midpoint, push savings through the budget, take the expectation of
+    marginal utility with a plain einsum, invert the FOC — never the EGM
+    update, so a policy that merely *looks* like an EGM output cannot
+    satisfy it by construction."""
+    import jax.numpy as jnp
+
+    from ..models.household import consumption_at
+    from ..ops.utility import inverse_marginal_utility, marginal_utility
+
+    m_k, c_k = policy.m_knots, policy.c_knots            # [N, K]
+    n = m_k.shape[0]
+    # midpoints of the ENDOGENOUS segments (skip the prepended
+    # borrowing-constraint segment [0, 1], where c = m - b exactly)
+    m_mid = 0.5 * (m_k[:, 1:-1] + m_k[:, 2:])            # [N, J]
+    c_mid = consumption_at(policy, m_mid)                # [N, J]
+    a_end = m_mid - c_mid                                # savings
+    m_next = (R * a_end[:, :, None]
+              + W * model.labor_levels[None, None, :])   # [N, J, N']
+    mq = jnp.moveaxis(m_next, 2, 0).reshape(n, -1)       # [N', N*J]
+    vp = marginal_utility(consumption_at(policy, mq), crra)
+    vp = vp.reshape(n, n, m_mid.shape[1])                # [N'(k), N, J]
+    evp = jnp.einsum("nk,knj->nj", model.transition, vp)
+    c_star = inverse_marginal_utility(disc_fac * R * evp, crra)
+    # equality only where the constraint is slack at the midpoint AND at
+    # the Euler-implied optimum (binding points satisfy an inequality)
+    floor = model.a_grid[0]
+    slack = (a_end > floor) & ((m_mid - c_star) > floor)
+    tiny = jnp.asarray(np.finfo(np.float64).tiny, dtype=c_mid.dtype)
+    rel = jnp.abs(c_mid - c_star) / jnp.maximum(c_mid, tiny)
+    return jnp.max(jnp.where(slack, rel, 0.0))
+
+
+def stationarity_residuals(policy, dist, R, W, model):
+    """(‖Γ′μ − μ‖∞, |Σμ − 1|): one fresh scatter push-forward of the
+    transition implied by ``policy`` applied to ``dist`` — independent of
+    whichever distribution engine (dense/pallas/LU) produced ``dist``."""
+    import jax.numpy as jnp
+
+    from ..models.household import _push_forward, wealth_transition
+
+    trans = wealth_transition(policy, R, W, model)
+    pushed = _push_forward(dist, trans, model.transition)
+    return (jnp.max(jnp.abs(pushed - dist)),
+            jnp.abs(jnp.sum(dist) - 1.0))
+
+
+def shape_residual(policy):
+    """Structural violation magnitude of a consumption policy: endogenous
+    knots must strictly increase, consumption must be positive and
+    nondecreasing in resources.  0.0 for a healthy policy."""
+    import jax.numpy as jnp
+
+    zero = jnp.zeros((), dtype=policy.c_knots.dtype)
+    dm = jnp.diff(policy.m_knots, axis=1)
+    dc = jnp.diff(policy.c_knots, axis=1)
+    return (jnp.maximum(jnp.max(-dm), zero)
+            + jnp.maximum(jnp.max(-dc), zero)
+            + jnp.maximum(jnp.max(-policy.c_knots), zero))
+
+
+def lorenz_residual(dist, model):
+    """Lorenz-curve monotonicity of the stationary wealth histogram:
+    nonnegative masses and a nondecreasing cumulative-wealth curve over
+    the nonnegative-wealth support, as a relative violation magnitude."""
+    import jax.numpy as jnp
+
+    m = jnp.sum(dist, axis=1) if dist.ndim == 2 else dist
+    zero = jnp.zeros((), dtype=m.dtype)
+    neg_mass = jnp.maximum(jnp.max(-m), zero)
+    w = jnp.clip(m, 0.0, None) * model.dist_grid
+    cw = jnp.cumsum(w)
+    # only the nonnegative-wealth region is Lorenz-monotone by theory (a
+    # negative borrowing limit legitimately decrements the running sum)
+    ok_region = model.dist_grid[1:] >= 0
+    dec = jnp.maximum(jnp.max(jnp.where(ok_region, -jnp.diff(cw), 0.0)),
+                      zero)
+    tiny = jnp.asarray(np.finfo(np.float64).tiny, dtype=m.dtype)
+    return neg_mass + dec / jnp.maximum(cw[-1], tiny)
+
+
+# Kwarg vocabulary split (mirrors ``equilibrium._solve_cell``): what the
+# certifier NEEDS (model structure, prices, inner tolerances) vs the
+# production solver's METHOD knobs (dist_method, egm_method, root_method,
+# accel_every, bracket_pad, max_bisect, precision, warm seeds, fault
+# hooks), which the certifier deliberately ignores — independence means
+# certifying with its own straightforward evaluation paths no matter how
+# the solution was produced.
+_MODEL_KEYS = ("labor_states", "labor_bound", "a_min", "a_max", "a_count",
+               "a_nest_fac", "dist_count", "borrow_limit")
+_PRICE_DEFAULTS = {"disc_fac": 0.96, "cap_share": 0.36, "depr_fac": 0.08,
+                   "prod": 1.0}
+
+
+def _split_kwargs(model_kwargs: dict):
+    build = {k: model_kwargs[k] for k in _MODEL_KEYS if k in model_kwargs}
+    price = {k: float(model_kwargs.get(k, v))
+             for k, v in _PRICE_DEFAULTS.items()}
+    f64 = True
+    dt = model_kwargs.get("__dtype__")
+    if dt is not None:
+        f64 = np.dtype(dt) == np.float64
+    egm_tol = model_kwargs.get("egm_tol") or (1e-6 if f64 else 1e-5)
+    dist_tol = model_kwargs.get("dist_tol") or (1e-11 if f64 else 1e-8)
+    return build, price, float(egm_tol), float(dist_tol)
+
+
+def _cert_dist_method(build: dict) -> str:
+    """The certifier's distribution engine: the DIRECT linear solve
+    (``household._stationary_solve`` — non-iterative, uniform cost) when
+    the bordered matrix is small enough to factor comfortably, the
+    scatter power iteration beyond that."""
+    d = int(build.get("dist_count", 500))
+    n = int(build.get("labor_states", 7))
+    return "solve" if d * n <= 4096 else "scatter"
+
+
+def _recompute_residuals(crra, rho, sd, r_star, capital, dtype,
+                         model_kwargs: dict):
+    """The re-solve certification body (jit/vmap-safe): rebuild the model,
+    re-evaluate the FULL supply path at ``r_star`` (EGM policy solve +
+    direct stationary solve — NOT the lean in-loop carry), and return the
+    CERT_CHECKS residual vector."""
+    import jax.numpy as jnp
+
+    from ..models import firm
+    from ..models.household import (
+        aggregate_capital,
+        aggregate_labor,
+        build_simple_model,
+        solve_household,
+        stationary_wealth,
+    )
+    from ..solver_health import combine_status
+
+    build, price, egm_tol, dist_tol = _split_kwargs(
+        {**model_kwargs, "__dtype__": dtype})
+    model = build_simple_model(labor_ar=rho, labor_sd=sd, dtype=dtype,
+                               **build)
+    k_to_l = firm.k_to_l_from_r(r_star, price["cap_share"],
+                                price["depr_fac"], price["prod"])
+    W = firm.wage_rate(k_to_l, price["cap_share"], price["prod"])
+    R = 1.0 + r_star
+    policy, _, _, egm_status = solve_household(
+        R, W, model, price["disc_fac"], crra, tol=egm_tol, method="xla",
+        precision="reference")
+    dist, _, _, dist_status = stationary_wealth(
+        policy, R, W, model, tol=dist_tol,
+        method=_cert_dist_method(build), precision="reference")
+
+    supply = aggregate_capital(dist, model)
+    labor = aggregate_labor(model)
+    demand = k_to_l * labor
+    tiny = jnp.asarray(np.finfo(np.float64).tiny, dtype=supply.dtype)
+    denom = jnp.maximum(jnp.abs(supply), tiny)
+    station, mass = stationarity_residuals(policy, dist, R, W, model)
+    resids = jnp.stack([
+        euler_residual_midpoints(policy, R, W, model, price["disc_fac"],
+                                 crra),
+        station,
+        mass,
+        jnp.abs(supply - demand) / denom,
+        jnp.abs(capital - supply) / denom,
+        shape_residual(policy),
+        lorenz_residual(dist, model),
+        combine_status(egm_status, dist_status).astype(supply.dtype),
+    ])
+    return resids.astype(jnp.float64) if resids.dtype != jnp.float64 \
+        else resids
+
+
+@lru_cache(maxsize=None)
+def _recompute_certifier(dtype, kwargs_items=()):
+    """Jitted vmapped re-solve certifier, memoized per solver group like
+    ``parallel.sweep._batched_solver`` (same cache discipline: ``dtype``
+    must be canonical).  Maps ``(crra, rho, sd, r_star, capital) ->
+    [len(CERT_CHECKS)]`` float64 residual rows."""
+    import jax
+
+    model_kwargs = dict(kwargs_items)
+
+    def one(crra, rho, sd, r_star, capital):
+        return _recompute_residuals(crra, rho, sd, r_star, capital,
+                                    dtype, model_kwargs)
+
+    return jax.jit(jax.vmap(one))
+
+
+def _thresholds_from_kwargs(thresholds, dtype, model_kwargs: dict):
+    if thresholds is not None:
+        return thresholds
+    return CertThresholds.for_solver(
+        dtype=dtype, r_tol=model_kwargs.get("r_tol"),
+        egm_tol=model_kwargs.get("egm_tol"),
+        dist_tol=model_kwargs.get("dist_tol"),
+        precision=model_kwargs.get("precision", "reference"))
+
+
+def certify_packed_rows(rows, cells, dtype, kwargs_items,
+                        thresholds: Optional[CertThresholds] = None):
+    """Certify a block of packed device rows (``PACKED_ROW_FIELDS``
+    layout) for the given (σ, ρ, sd) cells — the sweep/store/serve form.
+    One vmapped launch for the whole block.  Returns a list of
+    ``Certificate``; a row whose solver status is already a failure
+    certifies FAILED trivially (it is loudly NaN-masked upstream — the
+    certificate records the verdict without wasting a recomputation)."""
+    rows = np.asarray(rows, dtype=np.float64)
+    cells = np.asarray(cells, dtype=np.float64)
+    model_kwargs = dict(kwargs_items)
+    thr = _thresholds_from_kwargs(thresholds, dtype, model_kwargs)
+    healthy = ~np.asarray([is_failure(int(np.rint(r[6]))) for r in rows])
+    out: list = [None] * len(rows)
+    if healthy.any():
+        import jax.numpy as jnp
+
+        idx = np.nonzero(healthy)[0]
+        fn = _recompute_certifier(dtype, kwargs_items)
+        resids = np.asarray(fn(
+            jnp.asarray(cells[idx, 0], dtype=dtype),
+            jnp.asarray(cells[idx, 1], dtype=dtype),
+            jnp.asarray(cells[idx, 2], dtype=dtype),
+            jnp.asarray(rows[idx, 0], dtype=dtype),
+            jnp.asarray(rows[idx, 1], dtype=dtype)), dtype=np.float64)
+        for j, i in enumerate(idx):
+            out[int(i)] = thr.certificate(resids[j])
+    for i in np.nonzero(~healthy)[0]:
+        status = int(np.rint(rows[i][6]))
+        # the full CERT_CHECKS-ordered vector (every consumer zips
+        # against it): the unevaluated checks carry NaN residuals —
+        # "could not certify" grades FAILED, never CERTIFIED-by-default
+        resids = np.full(len(CERT_CHECKS), np.nan)
+        resids[CERT_CHECKS.index("recompute")] = float(status)
+        out[int(i)] = thr.certificate(resids)
+    return out
+
+
+def certify_equilibrium(result, crra=None, labor_ar=None, labor_sd=0.2,
+                        thresholds: Optional[CertThresholds] = None,
+                        dtype=None, **model_kwargs) -> Certificate:
+    """A posteriori certificate of one solved equilibrium (module
+    docstring for the check battery).
+
+    ``result`` may be:
+
+    * a full ``models.equilibrium.EquilibriumResult`` — its OWN policy
+      and distribution are certified directly (the strongest form: the
+      served artifacts themselves are checked, so a perturbed policy or
+      distribution cannot hide behind a clean recomputation);
+    * a ``LeanEquilibrium`` / ``serve.ServedResult`` / packed-row-like
+      object with ``r_star`` and ``capital`` — scalars only, so the
+      policy and distribution are re-derived at ``r_star`` through the
+      full supply path and the residuals certify the (r*, K) pair;
+    * a bare float ``r_star``.
+
+    ``crra``/``labor_ar``/``labor_sd`` locate the calibration cell;
+    ``model_kwargs`` is the same vocabulary as
+    ``equilibrium.solve_calibration`` (grid sizes, tolerances, prices) —
+    method knobs are deliberately ignored (independence).  ``thresholds``
+    defaults to ``CertThresholds.for_solver`` of this configuration.
+    """
+    from ..parallel.sweep import _canonical_dtype
+
+    if crra is None or labor_ar is None:
+        raise TypeError("certify_equilibrium needs the calibration cell: "
+                        "pass crra= and labor_ar= (and labor_sd=)")
+    dtype = _canonical_dtype(dtype)
+    thr = _thresholds_from_kwargs(thresholds, dtype, model_kwargs)
+    policy = getattr(result, "policy", None)
+    distribution = getattr(result, "distribution", None)
+    r_star = result if np.isscalar(result) else result.r_star
+    capital = (None if np.isscalar(result)
+               else getattr(result, "capital", None))
+
+    if policy is not None and distribution is not None:
+        resids = _object_residuals(
+            float(np.asarray(r_star)), policy, distribution,
+            float(crra), float(labor_ar), float(labor_sd), dtype,
+            model_kwargs)
+        return thr.certificate(resids)
+
+    import jax.numpy as jnp
+
+    kwargs_items = hashable_kwargs(model_kwargs)
+    fn = _recompute_certifier(dtype, kwargs_items)
+    cap = r_star if capital is None else capital
+    resids = np.array(fn(
+        jnp.asarray([crra], dtype=dtype),
+        jnp.asarray([labor_ar], dtype=dtype),
+        jnp.asarray([labor_sd], dtype=dtype),
+        jnp.asarray([np.asarray(r_star)], dtype=dtype),
+        jnp.asarray([np.asarray(cap)], dtype=dtype)),
+        dtype=np.float64)[0]
+    if capital is None:
+        # a bare r* has no capital claim to check: mirror the supply
+        resids[CERT_CHECKS.index("capital")] = 0.0
+    return thr.certificate(resids)
+
+
+def _object_residuals(r_star, policy, distribution, crra, labor_ar,
+                      labor_sd, dtype, model_kwargs: dict) -> np.ndarray:
+    """Certify PROVIDED solution objects (policy + distribution) against
+    the model directly — eager evaluation, no inner solves, so the
+    ``recompute`` check is trivially clean."""
+    import jax.numpy as jnp
+
+    from ..models import firm
+    from ..models.household import (
+        aggregate_capital,
+        aggregate_labor,
+        build_simple_model,
+    )
+
+    build, price, _, _ = _split_kwargs({**model_kwargs, "__dtype__": dtype})
+    model = build_simple_model(labor_ar=labor_ar, labor_sd=labor_sd,
+                               dtype=dtype, **build)
+    k_to_l = firm.k_to_l_from_r(r_star, price["cap_share"],
+                                price["depr_fac"], price["prod"])
+    W = firm.wage_rate(k_to_l, price["cap_share"], price["prod"])
+    R = 1.0 + r_star
+    supply = aggregate_capital(distribution, model)
+    demand = k_to_l * aggregate_labor(model)
+    denom = max(abs(float(supply)), np.finfo(np.float64).tiny)
+    station, mass = stationarity_residuals(policy, distribution, R, W,
+                                           model)
+    return np.asarray([
+        float(euler_residual_midpoints(policy, R, W, model,
+                                       price["disc_fac"], crra)),
+        float(station),
+        float(mass),
+        abs(float(supply) - float(demand)) / denom,
+        0.0,   # supply IS aggregate_capital(distribution): no second claim
+        float(shape_residual(policy)),
+        float(lorenz_residual(distribution, model)),
+        0.0,
+    ], dtype=np.float64)

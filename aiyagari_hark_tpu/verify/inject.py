@@ -1,0 +1,142 @@
+"""Deterministic corruption injection (DESIGN §9) — the integrity layer's
+analogue of ``solver_health.inject_fault`` (numeric faults) and
+``resilience.TransientInjector`` (process faults): every silent-corruption
+detection path must be exercisable on CPU in tier-1, not waited for.
+
+Nothing in production calls these.  Each injector corrupts exactly one
+artifact deterministically, so a test (or ``bench.py --integrity-smoke``)
+can assert injected == detected counts:
+
+* ``flip_row_bit`` / ``perturb_row`` — in-memory packed-row corruption
+  (the SDC model: a device or DMA flips a mantissa bit post-solve);
+* ``corrupt_ledger_row`` — rewrite one solved row's bytes inside a saved
+  resume ledger WITHOUT updating its solve-time checksum (a bit flip
+  between record and flush, or rot at rest) — resume must quarantine it;
+* ``corrupt_store_entry`` — truncate / zero / perturb one disk-tier
+  solution-store npz: truncation exercises the unreadable-file path,
+  perturbation the parses-fine-wrong-bytes checksum path;
+* ``perturbed_policy`` — an off-by-one grid shift or small lane noise on
+  a consumption policy: finite, monotone, plausible — exactly what only
+  a posteriori certification can catch.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def flip_row_bit(row, field: int = 0, bit: int = 20) -> np.ndarray:
+    """One packed row with mantissa ``bit`` of ``row[field]`` flipped
+    (float64 bit-cast) — the canonical single-event-upset model."""
+    row = np.array(row, dtype=np.float64)
+    bits = row.view(np.uint64)
+    bits[field] ^= np.uint64(1) << np.uint64(bit)
+    return row
+
+
+def perturb_row(row, field: int = 0, amplitude: float = 1e-6) -> np.ndarray:
+    """One packed row with ``amplitude`` added to ``row[field]`` — the
+    subtly-wrong-lane model (finite, plausible, off)."""
+    row = np.array(row, dtype=np.float64)
+    row[field] += amplitude
+    return row
+
+
+def _rewrite_npz_leaf(path: str, leaf_index: int, mutate) -> None:
+    """Rewrite one ``save_pytree`` leaf in place, preserving every other
+    leaf and the treedef BYTE-FOR-BYTE — the file still parses and still
+    claims its solve-time checksums, which is precisely the corruption
+    the checksum boundary exists to catch."""
+    with np.load(path) as data:   # integrity-ok: the corruption injector
+        arrays = {k: np.array(data[k]) for k in data.files}
+    key = f"leaf_{leaf_index:06d}"
+    if key not in arrays:
+        raise KeyError(f"{path} has no leaf {leaf_index}")
+    arrays[key] = mutate(arrays[key])
+    with open(path, "wb") as f:   # atomic-ok: deliberate corruption injector
+        np.savez(f, **arrays)
+
+
+def corrupt_ledger_row(path: str, cell: int, field: int = 0,
+                       bit: int = 20) -> None:
+    """Flip one bit of solved cell ``cell``'s packed row inside a saved
+    sweep resume ledger, leaving its recorded checksum untouched.
+    ``LedgerState.resume`` must detect the mismatch and quarantine the
+    cell (recompute), never reassemble the corrupt bits."""
+    from ..utils.resilience import SweepLedger
+
+    def mutate(packed):
+        packed = np.array(packed)
+        packed[cell] = flip_row_bit(packed[cell], field=field, bit=bit)
+        return packed
+
+    _rewrite_npz_leaf(path, SweepLedger._fields.index("packed"), mutate)
+
+
+def corrupt_store_entry(disk_path: str, key: int = None,
+                        mode: str = "perturb",
+                        amplitude: float = 1e-3) -> str:
+    """Corrupt one disk-tier ``SolutionStore`` entry; returns the path.
+
+    ``mode="truncate"`` halves the file (unreadable npz — the
+    ``CORRUPT_NPZ_ERRORS`` path), ``"zero"`` zeroes it, ``"perturb"``
+    adds ``amplitude`` to the stored row's r* while keeping the file
+    well-formed and its checksum field untouched (the silent-corruption
+    path only checksum verification can catch).  ``key=None`` corrupts
+    the lexicographically first entry."""
+    from ..serve.store import StoredSolution
+
+    if key is None:
+        names = sorted(n for n in os.listdir(disk_path)
+                       if n.startswith("sol_") and n.endswith(".npz"))
+        if not names:
+            raise FileNotFoundError(f"no store entries under {disk_path}")
+        path = os.path.join(disk_path, names[0])
+    else:
+        path = os.path.join(
+            disk_path, f"sol_{int(key) & 0xFFFFFFFFFFFFFFFF:016x}.npz")
+    if mode == "truncate":
+        raw = open(path, "rb").read()
+        with open(path, "wb") as f:   # atomic-ok: corruption injector
+            f.write(raw[:max(1, len(raw) // 2)])
+    elif mode == "zero":
+        size = os.path.getsize(path)
+        with open(path, "wb") as f:   # atomic-ok: corruption injector
+            f.write(b"\x00" * size)
+    elif mode == "perturb":
+        _rewrite_npz_leaf(
+            path, StoredSolution._fields.index("packed"),
+            lambda row: perturb_row(row, field=0, amplitude=amplitude))
+    else:
+        raise ValueError(f"corrupt_store_entry mode must be 'truncate', "
+                         f"'zero' or 'perturb', got {mode!r}")
+    return path
+
+
+def perturbed_policy(policy, mode: str = "noise",
+                     amplitude: float = 1e-6, seed: int = 0):
+    """A deliberately wrong consumption policy that every structural
+    check passes — the certification oracle's job:
+
+    * ``mode="shift"``: off-by-one grid shift — each endogenous knot
+      takes its RIGHT neighbor's consumption (over-consuming by one grid
+      step; still monotone, still positive);
+    * ``mode="noise"``: deterministic ``amplitude`` lane noise on the
+      consumption knots (small enough to keep monotonicity, large enough
+      that the stationarity oracle sees a different lottery).
+    """
+    import jax.numpy as jnp
+
+    c = np.asarray(policy.c_knots, dtype=np.float64)
+    if mode == "shift":
+        shifted = np.concatenate([c[:, :1], c[:, 2:], c[:, -1:]], axis=1)
+    elif mode == "noise":
+        rng = np.random.default_rng(seed)
+        shifted = c + amplitude * rng.standard_normal(c.shape)
+    else:
+        raise ValueError(f"perturbed_policy mode must be 'shift' or "
+                         f"'noise', got {mode!r}")
+    return policy._replace(
+        c_knots=jnp.asarray(shifted, dtype=policy.c_knots.dtype))

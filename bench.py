@@ -1106,6 +1106,256 @@ def _serve_smoke() -> dict:
     return record
 
 
+# Integrity smoke (ISSUE 6): certification/recheck economics measured at
+# the committed-golden 12-cell configuration (tests/data/
+# table2_golden_test.json — real f64 physics, so the certificate
+# thresholds are exercised at their production scale), corruption drills
+# at smoke-test grid sizes (detection is scale-independent).
+INTEGRITY_SMOKE_KWARGS = dict(a_count=24, dist_count=150)
+INTEGRITY_DRILL_KWARGS = dict(a_count=10, dist_count=32, labor_states=3,
+                              r_tol=1e-5, max_bisect=24)
+INTEGRITY_RECHECK_FRACTION = 0.25
+
+
+def _integrity_smoke() -> dict:
+    """The ``--integrity-smoke`` acceptance run (DESIGN §9): certify the
+    12-cell golden sweep under reference AND mixed precision (every cell
+    must come back CERTIFIED at default thresholds), measure the
+    certification + recheck overheads against the sweep wall, and run
+    every deterministic corruption drill — ledger bit flip, disk-store
+    truncation/perturbation, post-solve lane perturbation (sweep SDC and
+    serve path), shifted policy — asserting injected == detected."""
+    import numpy as np
+
+    import jax
+
+    # The integrity acceptance is a CPU float64 statement (the golden
+    # cells and the certificate thresholds are f64 physics); the smoke
+    # runs standalone before any backend initializes, so pinning the
+    # platform here is safe — same pattern as the bench's f64 oracle.
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from aiyagari_hark_tpu.parallel.sweep import (
+        _canonical_dtype,
+        _hashable_kwargs,
+        run_table2_sweep,
+    )
+    from aiyagari_hark_tpu.utils.config import SweepConfig
+    from aiyagari_hark_tpu.verify import CERT_CHECKS, certify_packed_rows
+
+    backend = jax.default_backend()
+    kw = dict(INTEGRITY_SMOKE_KWARGS)
+
+    # phase 1: warm-up — compiles the sweep, certifier AND recheck
+    # executables (the recheck's sample-sized launch is its own XLA
+    # shape) so the timed overheads measure steady-state defense cost,
+    # not compiles
+    t0 = time.perf_counter()
+    run_table2_sweep(
+        SweepConfig(certify=True,
+                    recheck_fraction=INTEGRITY_RECHECK_FRACTION), **kw)
+    print(f"[bench] integrity smoke: warm-up in "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    # phase 2: timed reference run — certification on, SDC recheck on
+    res = run_table2_sweep(
+        SweepConfig(certify=True,
+                    recheck_fraction=INTEGRITY_RECHECK_FRACTION),
+        perturb=PERTURB, **kw)
+    cert_overhead = res.certify_wall_seconds / max(res.wall_seconds, 1e-9)
+    recheck_overhead = (res.recheck_wall_seconds
+                        / max(res.wall_seconds, 1e-9))
+
+    # per-check max residuals: re-grade the final rows through the (warm)
+    # certifier.  The certifier reads columns 0 (r*), 1 (capital) and 6
+    # (status) of a packed row; the labor column is not used, so a
+    # placeholder is exact here.
+    rows = np.stack(
+        [res.r_star_pct / 100.0, res.capital, np.ones(len(res.capital)),
+         res.bisect_iters, res.egm_iters, res.dist_iters, res.status,
+         res.descent_steps, res.polish_steps,
+         res.precision_escalations], axis=1).astype(np.float64)
+    cells = np.stack([res.crra, res.labor_ar, res.labor_sd], axis=1)
+    mk = dict(kw)
+    mk.setdefault("dist_method", "auto")
+    mk.setdefault("egm_method", "xla")
+    certs = certify_packed_rows(rows, cells, _canonical_dtype(None),
+                                _hashable_kwargs(mk))
+    resid = np.asarray([[c.residual for c in cert.checks]
+                        for cert in certs])
+
+    # phase 3: mixed-precision certification (precision-aware thresholds)
+    resm = run_table2_sweep(SweepConfig(certify=True), perturb=PERTURB,
+                            precision="mixed", **kw)
+
+    # phase 4: corruption drills — every injection must be detected by
+    # the layer that first loads or certifies it
+    injected, detected, detail = _integrity_drills()
+
+    record = {
+        "metric": "integrity_smoke",
+        "backend": backend,
+        "integrity_cells": len(cells),
+        "integrity_cert_levels": [int(v) for v in res.cert_level],
+        "integrity_mixed_cert_levels": [int(v) for v in resm.cert_level],
+        "integrity_all_certified": bool((res.cert_level == 0).all()),
+        "integrity_mixed_all_certified": bool(
+            (resm.cert_level == 0).all()),
+        # NaN residuals (an unevaluated check on a failed-status cell)
+        # must not poison the JSON record: report None there — the
+        # all_certified flag above is already false in that case
+        **{f"integrity_max_{name}": (
+            round(float(resid[:, j].max()), 10)
+            if np.isfinite(resid[:, j].max()) else None)
+           for j, name in enumerate(CERT_CHECKS)},
+        "integrity_sweep_wall_s": round(res.wall_seconds, 3),
+        "integrity_certify_wall_s": round(res.certify_wall_seconds, 3),
+        "integrity_recheck_wall_s": round(res.recheck_wall_seconds, 3),
+        # acceptance: certification + checksum verification < 10% of the
+        # sweep wall at recheck_fraction=0 (the recheck is priced
+        # separately — it deliberately re-solves cells)
+        "integrity_cert_overhead_frac": round(cert_overhead, 4),
+        "integrity_overhead_under_10pct": bool(cert_overhead < 0.10),
+        "integrity_recheck_fraction": INTEGRITY_RECHECK_FRACTION,
+        "integrity_recheck_overhead_frac": round(recheck_overhead, 4),
+        "integrity_recheck_suspects": int(res.sdc_suspected.sum()),
+        # acceptance: injected == detected, per drill and in total
+        "integrity_injected": injected,
+        "integrity_detected": detected,
+        "integrity_injection_detail": detail,
+    }
+    print(f"[bench] integrity smoke: cert levels {record['integrity_cert_levels']} "
+          f"(mixed {record['integrity_mixed_cert_levels']}), cert overhead "
+          f"{100 * cert_overhead:.1f}%, recheck overhead "
+          f"{100 * recheck_overhead:.1f}%, injected {injected} == "
+          f"detected {detected}", file=sys.stderr)
+    if injected != detected:
+        print("[bench] integrity smoke: INJECTED != DETECTED — a "
+              "corruption slipped through a detection layer",
+              file=sys.stderr)
+    return record
+
+
+def _integrity_drills():
+    """The deterministic corruption drill battery (tiny grids): returns
+    (injected, detected, per-drill detail).  Each drill corrupts exactly
+    one artifact and checks the responsible layer caught it."""
+    import warnings as _warnings
+
+    import numpy as np
+
+    from aiyagari_hark_tpu.models.equilibrium import solve_calibration
+    from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+    from aiyagari_hark_tpu.serve import (
+        CertificationFailed,
+        EquilibriumService,
+        make_query,
+    )
+    from aiyagari_hark_tpu.utils.config import SweepConfig
+    from aiyagari_hark_tpu.verify import (
+        certify_equilibrium,
+        corrupt_ledger_row,
+        corrupt_store_entry,
+        perturbed_policy,
+    )
+
+    kw = dict(INTEGRITY_DRILL_KWARGS)
+    cfg = SweepConfig(crra_values=(1.0, 3.0), rho_values=(0.3, 0.6))
+    detail = {}
+
+    # drill 1: post-solve lane bit flip in the sweep -> bitwise recheck
+    res = run_table2_sweep(cfg.replace(recheck_fraction=1.0),
+                           inject_sdc={"cell": 1, "bit": 24}, **kw)
+    detail["sweep_lane_bitflip"] = int(res.sdc_suspected.sum())
+
+    # drill 2: ledger row bit flip between flush and resume -> resume
+    # checksum verification quarantines + recomputes
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        ledger = os.path.join(td, "ledger.npz")
+        from aiyagari_hark_tpu.utils.resilience import (
+            Interrupted,
+            clear_interrupt,
+        )
+
+        try:
+            run_table2_sweep(cfg, resume_path=ledger,
+                             inject_preempt={"after_bucket": 0,
+                                             "mode": "flag"}, **kw)
+            raise AssertionError("preemption injection did not fire")
+        except Interrupted:
+            # the injected flag must not bleed into the next drill (or
+            # into the bench's own preemption guard)
+            clear_interrupt()
+        corrupt_ledger_row(ledger, cell=1, bit=21)
+        with _warnings.catch_warnings(record=True) as w:
+            _warnings.simplefilter("always")
+            resumed = run_table2_sweep(cfg, resume_path=ledger, **kw)
+        caught = any("checksum verification failed" in str(x.message)
+                     for x in w)
+        clean = run_table2_sweep(cfg, **kw)
+        bit_identical = bool(np.array_equal(clean.r_star_pct,
+                                            resumed.r_star_pct))
+        detail["ledger_row_bitflip"] = int(caught and bit_identical)
+
+    # drills 3+4: disk-store perturbation (parses fine, wrong bytes) and
+    # truncation (unreadable) -> checksum/format eviction + deletion
+    with tempfile.TemporaryDirectory() as td:
+        svc = EquilibriumService(start_worker=False, max_batch=4,
+                                 ladder=(1, 2, 4), disk_path=td)
+        svc.query(3.0, 0.6, **kw)
+        svc.close()
+        path = corrupt_store_entry(td, mode="perturb", amplitude=1e-3)
+        with _warnings.catch_warnings(record=True):
+            _warnings.simplefilter("always")
+            svc2 = EquilibriumService(start_worker=False, max_batch=4,
+                                      ladder=(1, 2, 4), disk_path=td)
+        evictions = svc2.store.integrity_counts()[
+            "store_corrupt_evictions"]
+        detail["store_perturbation"] = int(evictions == 1
+                                           and not os.path.exists(path))
+        svc2.query(3.0, 0.6, **kw)     # re-solve repopulates
+        svc2.close()
+        corrupt_store_entry(td, mode="truncate")
+        with _warnings.catch_warnings(record=True):
+            _warnings.simplefilter("always")
+            svc3 = EquilibriumService(start_worker=False, max_batch=4,
+                                      ladder=(1, 2, 4), disk_path=td)
+        detail["store_truncation"] = int(
+            svc3.store.integrity_counts()["store_corrupt_evictions"] == 1)
+        svc3.close()
+
+    # drill 5: off-by-one grid shift on a policy -> certification FAILED
+    full = solve_calibration(3.0, 0.6, **kw)
+    bad = full._replace(policy=perturbed_policy(full.policy, mode="shift"))
+    detail["shifted_policy"] = int(
+        certify_equilibrium(bad, crra=3.0, labor_ar=0.6, **kw).failed)
+
+    # drill 6: serve-path lane perturbation -> certify_before_cache FAILS
+    # the future and never caches
+    svc = EquilibriumService(start_worker=False, max_batch=4,
+                             ladder=(1, 2, 4), certify_before_cache=True,
+                             inject_corrupt_lane={"at_launch": 0,
+                                                  "lane": 0,
+                                                  "amplitude": 3e-3})
+    fut = svc.submit(make_query(3.0, 0.6, **kw))
+    svc.flush()
+    try:
+        fut.result(0)
+        served_failed = False
+    except CertificationFailed:
+        served_failed = True
+    detail["serve_lane_perturbation"] = int(served_failed
+                                            and svc.store.known() == 0)
+    svc.close()
+
+    injected = len(detail)
+    detected = int(sum(detail.values()))
+    return injected, detected, detail
+
+
 def main(argv=None):
     """CLI wrapper: the preemption-tolerant run layer (ISSUE 3) around the
     measurement body.  ``--resume PATH`` gives the headline sweep a
@@ -1114,7 +1364,9 @@ def main(argv=None):
     (bucket seams) with exit code 75 (EX_TEMPFAIL: retry me), the
     convention preemptible-slice supervisors restart on.  ``--serve-smoke``
     runs the (fast) serving acceptance instead of the full bench and
-    emits the ``serve_*`` record (ISSUE 4)."""
+    emits the ``serve_*`` record (ISSUE 4); ``--integrity-smoke`` runs
+    the solution-integrity acceptance (certification, recheck, corruption
+    drills) and emits the ``integrity_*`` record (ISSUE 6)."""
     import argparse
 
     from aiyagari_hark_tpu.utils.resilience import (
@@ -1132,16 +1384,22 @@ def main(argv=None):
                     help="run the equilibrium-serving smoke (12-cell "
                          "hit/near/cold replay) and emit the serve_* "
                          "record instead of the full bench")
+    ap.add_argument("--integrity-smoke", action="store_true",
+                    help="run the solution-integrity smoke (12-cell "
+                         "golden certification, SDC recheck, corruption "
+                         "drills) and emit the integrity_* record "
+                         "instead of the full bench")
     args = ap.parse_args(argv)
-    if args.serve_smoke:
+    if args.serve_smoke or args.integrity_smoke:
         from aiyagari_hark_tpu.utils.backend import (
             enable_compilation_cache,
         )
 
         enable_compilation_cache()
+        smoke = _integrity_smoke if args.integrity_smoke else _serve_smoke
         try:
             with preemption_guard():
-                print(json.dumps(_serve_smoke()))
+                print(json.dumps(smoke()))
         except Interrupted as e:
             print(f"[bench] preempted at a safe boundary: {e}",
                   file=sys.stderr)

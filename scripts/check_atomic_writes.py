@@ -29,9 +29,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # Scope: the installable package plus the two entry points.  scripts/ and
 # tests/ are out of scope — they write developer-local files whose loss is
 # a re-run, not a poisoned committed artifact.  The package walk is
-# recursive, so every subpackage — including ``serve/``, whose on-disk
+# recursive, so every subpackage — ``serve/``, whose on-disk
 # solution-store tier MUST go through the blessed atomic writers (a torn
-# store entry would be served as a cached equilibrium) — is in scope
+# store entry would be served as a cached equilibrium), and ``verify/``
+# (ISSUE 6), whose corruption INJECTORS deliberately write raw bytes and
+# therefore carry explicit ``# atomic-ok`` waivers — is in scope
 # automatically; ``tests/test_checkpoint_tools.py`` pins that coverage.
 SCAN_ROOTS = ("aiyagari_hark_tpu",)
 SCAN_FILES = ("bench.py", "reproduce.py")

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Static lint: artifact LOADS must verify content checksums (ISSUE 6).
+
+The integrity layer (DESIGN §9) computes a content checksum at solve time
+and verifies it at every boundary a solution later crosses — resume-ledger
+restore, scheduler-sidecar load, solution-store tiers.  That chain is only
+as strong as its weakest load site: ONE raw ``load_pytree``/``np.load``
+that skips verification re-opens the silent-corruption hole the layer
+closed (exactly how the store's disk tier degraded silently before this
+PR).  This lint keeps the chain closed structurally:
+
+every call to a RAW npz loader (``load_pytree`` / ``np.load``) in the
+package or entry points, outside the blessed loader module
+(``utils/checkpoint.py``, which hosts the verified wrappers), must either
+
+* sit in a function that also calls a checksum-verification primitive
+  (``verify_packed_row`` / ``packed_row_checksum`` / ``content_checksum``
+  or a ``_verified``/``_verify_rows`` helper built on them), or
+* carry an explicit ``# integrity-ok`` waiver comment stating why
+  verification does not apply (e.g. the corruption INJECTOR itself, or a
+  legacy artifact class with its own fingerprint guard).
+
+Run standalone (exits 1 on findings) or via tier-1
+(``tests/test_integrity_lint.py``), so unverified loads cannot regress in.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Same scope policy as scripts/check_atomic_writes.py: the installable
+# package plus the entry points; scripts/ and tests/ are out of scope.
+SCAN_ROOTS = ("aiyagari_hark_tpu",)
+SCAN_FILES = ("bench.py", "reproduce.py")
+
+# The verified wrappers (load_sweep_sidecar etc.) and the raw-loader
+# implementation itself live here.
+BLESSED = {os.path.join("aiyagari_hark_tpu", "utils", "checkpoint.py")}
+
+WAIVER = "# integrity-ok"
+
+# Raw loaders whose call sites need verification evidence.
+RAW_LOADERS = {"load_pytree"}
+RAW_LOADER_ATTRS = {("np", "load"), ("numpy", "load")}
+
+# Names whose call inside the same function counts as verification
+# evidence: the checksum primitives (utils.fingerprint) and the local
+# helpers built directly on them.
+VERIFY_NAMES = {"verify_packed_row", "packed_row_checksum",
+                "packed_row_checksums", "content_checksum",
+                "_verified", "_verify_rows"}
+
+
+def _call_name(node: ast.Call):
+    """Terminal name of a call target: ``f(...)`` -> "f",
+    ``a.b.f(...)`` -> "f"; plus the (base, attr) pair for np.load."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id, None
+    if isinstance(fn, ast.Attribute):
+        base = fn.value.id if isinstance(fn.value, ast.Name) else None
+        return fn.attr, (base, fn.attr)
+    return None, None
+
+
+def _is_raw_load(node: ast.Call) -> bool:
+    name, pair = _call_name(node)
+    if name in RAW_LOADERS:
+        return True
+    return pair in RAW_LOADER_ATTRS
+
+
+def _function_ranges(tree: ast.AST):
+    """(start, end, node) for every function, innermost resolvable by
+    smallest span."""
+    spans = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            spans.append((node.lineno, node.end_lineno, node))
+    return spans
+
+
+def _enclosing(spans, lineno):
+    best = None
+    for start, end, node in spans:
+        if start <= lineno <= end:
+            if best is None or (end - start) < (best[1] - best[0]):
+                best = (start, end, node)
+    return best[2] if best is not None else None
+
+
+def _has_verify_call(scope: ast.AST) -> bool:
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call):
+            name, _ = _call_name(node)
+            if name in VERIFY_NAMES:
+                return True
+    return False
+
+
+def scan_source(src: str, rel: str) -> list:
+    """Findings for one file's source text (exposed for fixture tests)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [(rel, e.lineno or 0, f"unparseable: {e.msg}")]
+    lines = src.splitlines()
+    spans = _function_ranges(tree)
+    findings = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_raw_load(node)):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if WAIVER in line:
+            continue
+        scope = _enclosing(spans, node.lineno)
+        if scope is not None and _has_verify_call(scope):
+            continue
+        where = scope.name if scope is not None else "<module>"
+        findings.append(
+            (rel, node.lineno,
+             f"raw artifact load in {where}() without checksum "
+             "verification — call a utils.fingerprint verification "
+             "primitive in this function, use a verified loader "
+             "(load_sweep_sidecar / the store), or waive with "
+             "'# integrity-ok'"))
+    return findings
+
+
+def scan_file(path: str, rel: str) -> list:
+    if rel.replace(os.sep, "/") in {b.replace(os.sep, "/")
+                                    for b in BLESSED}:
+        return []
+    with open(path) as fh:
+        return scan_source(fh.read(), rel)
+
+
+def scan_targets(repo: str = REPO) -> list:
+    """Every file the lint covers (absolute paths) — exposed so the
+    lint's own test can pin coverage (verify/, serve/, resilience)."""
+    targets = []
+    for root in SCAN_ROOTS:
+        for dirpath, _, names in os.walk(os.path.join(repo, root)):
+            if "__pycache__" in dirpath:
+                continue
+            targets += [os.path.join(dirpath, n) for n in sorted(names)
+                        if n.endswith(".py")]
+    targets += [os.path.join(repo, f) for f in SCAN_FILES]
+    return targets
+
+
+def scan(repo: str = REPO) -> list:
+    findings = []
+    for path in scan_targets(repo):
+        if os.path.exists(path):
+            findings += scan_file(path, os.path.relpath(path, repo))
+    return findings
+
+
+def main() -> int:
+    findings = scan()
+    for rel, lineno, msg in findings:
+        print(f"{rel}:{lineno}: {msg}")
+    if findings:
+        print(f"{len(findings)} unverified artifact load(s); see "
+              f"scripts/check_integrity_boundaries.py docstring")
+        return 1
+    print("integrity-boundary lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,117 @@
+"""check_integrity_boundaries lint (ISSUE 6 satellite): every raw
+ledger/sidecar/store load site must call checksum verification (or carry
+an explicit ``# integrity-ok`` waiver) — run in tier-1 so an unverified
+load cannot regress in, with fixture tests proving the lint actually
+fires on the pattern it guards."""
+
+import importlib.util
+import os
+
+
+def _load_lint():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "check_integrity_boundaries",
+        os.path.join(repo, "scripts", "check_integrity_boundaries.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod, repo
+
+
+def test_integrity_lint_is_clean():
+    """The package and entry points contain no unverified raw artifact
+    loads — failing here, not in code review."""
+    mod, repo = _load_lint()
+    findings = mod.scan(repo)
+    assert findings == [], "\n".join(
+        f"{rel}:{line}: {msg}" for rel, line, msg in findings)
+
+
+def test_integrity_lint_covers_every_boundary_module():
+    """Pin the walk's coverage of the checksummed chain's load sites —
+    the resume ledger, the scheduler sidecar, the solution store, the
+    verify package itself — instead of trusting it silently."""
+    mod, repo = _load_lint()
+    rels = {os.path.relpath(t, repo).replace(os.sep, "/")
+            for t in mod.scan_targets(repo)}
+    for required in ("aiyagari_hark_tpu/utils/resilience.py",
+                     "aiyagari_hark_tpu/serve/store.py",
+                     "aiyagari_hark_tpu/verify/inject.py",
+                     "aiyagari_hark_tpu/verify/certificate.py",
+                     "aiyagari_hark_tpu/models/ks_solver.py",
+                     "bench.py"):
+        assert required in rels, required
+
+
+def test_lint_fires_on_unverified_load():
+    mod, _ = _load_lint()
+    findings = mod.scan_source(
+        "def restore(path, tmpl):\n"
+        "    led = load_pytree(path, tmpl)\n"
+        "    return led\n", "fake.py")
+    assert [(rel, line) for rel, line, _ in findings] == [("fake.py", 2)]
+    # np.load spelling too, including at module level
+    findings = mod.scan_source(
+        "import numpy as np\n"
+        "data = np.load('x.npz')\n", "fake2.py")
+    assert [line for _, line, _ in findings] == [2]
+
+
+def test_lint_accepts_verified_and_waived_loads():
+    mod, _ = _load_lint()
+    src_verified = (
+        "def restore(path, tmpl):\n"
+        "    led = load_pytree(path, tmpl)\n"
+        "    verify_packed_row(led.packed, led.checksum, 'ledger')\n"
+        "    return led\n")
+    assert mod.scan_source(src_verified, "ok.py") == []
+    src_helper = (
+        "class Store:\n"
+        "    def get(self, key):\n"
+        "        sol = load_pytree(self._file(key), _template())\n"
+        "        if not self._verified(sol):\n"
+        "            return None\n"
+        "        return sol\n")
+    assert mod.scan_source(src_helper, "ok2.py") == []
+    src_waived = (
+        "def migrate(path):\n"
+        "    old = load_pytree(path, tmpl)  # integrity-ok\n"
+        "    return old\n")
+    assert mod.scan_source(src_waived, "ok3.py") == []
+
+
+def test_lint_end_to_end_on_fake_repo(tmp_path):
+    """Through the directory walk: an unverified load dropped into a
+    fake repo's serve/ package is a finding; the verified one is not."""
+    mod, _ = _load_lint()
+    pkg = tmp_path / "aiyagari_hark_tpu" / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "bad_loader.py").write_text(
+        "def load(path, tmpl):\n"
+        "    return load_pytree(path, tmpl)\n")
+    (pkg / "good_loader.py").write_text(
+        "def load(path, tmpl):\n"
+        "    sol = load_pytree(path, tmpl)\n"
+        "    verify_packed_row(sol.packed, sol.checksum, 'store')\n"
+        "    return sol\n")
+    findings = mod.scan(str(tmp_path))
+    assert [(rel.replace(os.sep, "/"), line)
+            for rel, line, _ in findings] == [
+        ("aiyagari_hark_tpu/serve/bad_loader.py", 2)]
+
+
+def test_atomic_writes_lint_covers_verify_package():
+    """ISSUE 6 satellite: the verify/ package's writers are inside the
+    atomic-write lint's scope (its injectors carry explicit waivers)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "check_atomic_writes",
+        os.path.join(repo, "scripts", "check_atomic_writes.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rels = {os.path.relpath(t, repo).replace(os.sep, "/")
+            for t in mod.scan_targets(repo)}
+    assert "aiyagari_hark_tpu/verify/inject.py" in rels
+    assert "aiyagari_hark_tpu/verify/certificate.py" in rels
+    # and the injectors' deliberate raw writes are waived, not findings
+    assert mod.scan(repo) == []

@@ -244,7 +244,8 @@ def test_sigterm_after_bucket_k_resumes_bit_identical(tmp_path,
     assert os.path.exists(ledger)          # valid state flushed pre-raise
     with np.load(ledger) as raw:           # some, not all, cells solved
         n_leaves = len([k for k in raw.files if k.startswith("leaf_")])
-    assert n_leaves == 7                   # the SweepLedger layout
+    assert n_leaves == 8                   # the SweepLedger layout
+    #                                        (+checksums, ISSUE 6)
 
     resumed = run_table2_sweep(TWELVE, inject_fault=FAULT, max_retries=1,
                                resume_path=ledger, **KW)

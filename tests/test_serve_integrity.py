@@ -1,0 +1,277 @@
+"""Serving-path integrity (ISSUE 6, DESIGN §9): deadlines at batch
+seams, checksummed store tiers with evict-and-delete, and the
+certify-before-cache gate (no FAILED-certificate solution is ever
+written to the SolutionStore)."""
+
+import glob
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.serve import (
+    CertificationFailed,
+    DeadlineExceeded,
+    EquilibriumService,
+    SolutionStore,
+    make_query,
+    make_solution,
+)
+from aiyagari_hark_tpu.solver_health import DEADLINE_EXCEEDED, is_failure
+from aiyagari_hark_tpu.verify import CERTIFIED, corrupt_store_entry
+from aiyagari_hark_tpu.verify.inject import flip_row_bit
+
+KW = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-5,
+          max_bisect=24)
+
+
+def _manual_service(**kwargs):
+    return EquilibriumService(start_worker=False, max_batch=4,
+                              ladder=(1, 2, 4), **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Deadlines (the SLO satellite).
+# ---------------------------------------------------------------------------
+
+def test_expired_query_fails_typed_at_batch_seam():
+    t = [0.0]
+    svc = _manual_service(clock=lambda: t[0])
+    expired = svc.submit(make_query(3.0, 0.6, **KW), deadline=0.5)
+    live = svc.submit(make_query(1.0, 0.3, **KW), deadline=100.0)
+    nodeadline = svc.submit(make_query(5.0, 0.9, **KW))
+    t[0] = 1.0
+    svc.flush()
+    with pytest.raises(DeadlineExceeded) as ei:
+        expired.result(0)
+    assert ei.value.status == DEADLINE_EXCEEDED
+    assert is_failure(ei.value.status)          # uncertified by definition
+    assert ei.value.waited_s == pytest.approx(1.0)
+    # batchmates are untouched: the live and no-deadline queries solved
+    assert live.result(0).r_star != 0.0
+    assert nodeadline.result(0).r_star != 0.0
+    snap = svc.metrics.snapshot()
+    assert snap["serve_deadline_expirations"] == 1
+    assert snap["serve_failures"] == 0          # expiry is not a solve failure
+    svc.close()
+
+
+def test_expired_query_never_launches_or_caches():
+    t = [0.0]
+    svc = _manual_service(clock=lambda: t[0])
+    fut = svc.submit(make_query(3.0, 0.6, **KW), deadline=0.5)
+    t[0] = 1.0
+    svc.flush()
+    with pytest.raises(DeadlineExceeded):
+        fut.result(0)
+    assert svc.store.known() == 0               # nothing was solved
+    assert svc.metrics.snapshot()["serve_batches"] == 0
+    svc.close()
+
+
+def test_deadline_resolves_hit_before_expiry_check():
+    """An exact hit resolves at submit — a deadline can never expire it."""
+    t = [0.0]
+    svc = _manual_service(clock=lambda: t[0])
+    svc.query(3.0, 0.6, **KW)
+    fut = svc.submit(make_query(3.0, 0.6, **KW), deadline=0.0)
+    assert fut.done() and fut.result(0).path == "hit"
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Store checksum chain: evict, delete, count, re-solve.
+# ---------------------------------------------------------------------------
+
+def test_perturbed_disk_entry_evicted_deleted_counted(tmp_path):
+    d = str(tmp_path / "store")
+    svc = _manual_service(disk_path=d)
+    first = svc.query(3.0, 0.6, **KW)
+    svc.close()
+
+    path = corrupt_store_entry(d, mode="perturb", amplitude=1e-3)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        svc2 = _manual_service(disk_path=d)
+    msgs = [str(x.message) for x in w]
+    assert any("evicting corrupt entry" in m for m in msgs)
+    assert not os.path.exists(path)             # deleted: cannot re-degrade
+    assert svc2.store.integrity_counts()["store_corrupt_evictions"] == 1
+    # a THIRD process sees a clean (empty) store: no repeat warnings
+    again = svc2.query(3.0, 0.6, **KW)
+    assert again.path == "cold"                 # re-solved, never served
+    assert again.r_star == first.r_star         # ...and correct
+    assert svc2.metrics.snapshot()["store_corrupt_evictions"] == 1
+    svc2.close()
+    with warnings.catch_warnings(record=True) as w2:
+        warnings.simplefilter("always")
+        svc3 = _manual_service(disk_path=d)
+    assert not any("evicting" in str(x.message) for x in w2)
+    svc3.close()
+
+
+@pytest.mark.parametrize("mode", ["truncate", "zero"])
+def test_unreadable_disk_entry_evicted_at_index_load(tmp_path, mode):
+    d = str(tmp_path / "store")
+    svc = _manual_service(disk_path=d)
+    svc.query(3.0, 0.6, **KW)
+    svc.close()
+    corrupt_store_entry(d, mode=mode)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        store = SolutionStore(capacity=4, disk_path=d)
+    assert any("evicting corrupt entry" in str(x.message) for x in w)
+    assert store.known() == 0
+    assert store.integrity_counts()["store_corrupt_evictions"] == 1
+    assert glob.glob(os.path.join(d, "sol_*.npz")) == []
+
+
+def test_memory_tier_corruption_evicted_on_get():
+    """A bit flip in the MEMORY tier is as silent as a disk one: get()
+    re-verifies and reports a miss instead of serving it."""
+    store = SolutionStore(capacity=4)
+    row = np.asarray([0.035, 5.0, 0.9, 11, 500, 4000, 0, 0, 4500, 0],
+                     dtype=np.float64)
+    store.put(make_solution((3.0, 0.6, 0.2), row, group=7, key=1))
+    assert store.get(1) is not None
+    # corrupt the cached object's bytes in place (the SDC model)
+    sol = store.get(1)
+    sol.packed[:] = flip_row_bit(sol.packed, field=0, bit=18)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert store.get(1) is None
+    assert any("memory tier" in str(x.message) for x in w)
+    assert store.integrity_counts()["store_corrupt_evictions"] == 1
+
+
+def test_memory_tier_corruption_recovers_from_healthy_disk_copy(tmp_path):
+    """An in-RAM flip must NOT destroy the (independently verified) disk
+    copy: the get falls through, re-verifies the file, and serves it —
+    one transient memory corruption is not a permanent cache loss."""
+    store = SolutionStore(capacity=4, disk_path=str(tmp_path / "s"))
+    row = np.asarray([0.035, 5.0, 0.9, 11, 500, 4000, 0, 0, 4500, 0],
+                     dtype=np.float64)
+    pristine = row.copy()   # make_solution aliases the caller's array —
+    #                         the in-place flip below reaches `row` too
+    store.put(make_solution((3.0, 0.6, 0.2), row, group=7, key=1))
+    sol = store.get(1)
+    sol.packed[:] = flip_row_bit(sol.packed, field=0, bit=18)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        recovered = store.get(1)
+    assert any("retrying the disk tier" in str(x.message) for x in w)
+    assert recovered is not None
+    assert np.array_equal(np.asarray(recovered.packed), pristine)
+    assert store.integrity_counts()["store_corrupt_evictions"] == 1
+    # and the disk file survived
+    assert store.get(1) is not None
+
+
+def test_corrupted_entry_on_get_path_deleted(tmp_path):
+    """Disk corruption AFTER the index was built (rot between index load
+    and get): the get path verifies, evicts, deletes."""
+    d = str(tmp_path / "store")
+    svc = _manual_service(disk_path=d)
+    svc.query(3.0, 0.6, **KW)
+    svc.close()
+    svc2 = _manual_service(disk_path=d)        # index load verifies: clean
+    path = corrupt_store_entry(d, mode="perturb", amplitude=1e-3)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        r = svc2.query(3.0, 0.6, **KW)
+    assert any("evicting corrupt entry" in str(x.message) for x in w)
+    assert r.path == "cold"                     # re-solved, never served
+    assert svc2.store.integrity_counts()["store_corrupt_evictions"] == 1
+    # the re-solve re-cached a CLEAN entry at the same address: a third
+    # process loads it without any eviction warning
+    with warnings.catch_warnings(record=True) as w2:
+        warnings.simplefilter("always")
+        svc3 = _manual_service(disk_path=d)
+    assert not any("evicting" in str(x.message) for x in w2)
+    assert svc3.query(3.0, 0.6, **KW).path == "hit"
+    svc3.close()
+    svc2.close()
+
+
+# ---------------------------------------------------------------------------
+# certify_before_cache (the acceptance property).
+# ---------------------------------------------------------------------------
+
+def test_certified_cold_miss_cached_with_level(tmp_path):
+    svc = _manual_service(certify_before_cache=True,
+                          disk_path=str(tmp_path / "s"))
+    r = svc.query(3.0, 0.6, **KW)
+    assert r.path == "cold" and r.cert_level == CERTIFIED
+    hit = svc.query(3.0, 0.6, **KW)
+    assert hit.path == "hit" and hit.cert_level == CERTIFIED
+    snap = svc.metrics.snapshot()
+    assert snap["serve_certified"] == 1
+    assert snap["serve_failed_certificates"] == 0
+    svc.close()
+    # the certificate level survives the disk tier and a restart
+    svc2 = _manual_service(disk_path=str(tmp_path / "s"))
+    assert svc2.query(3.0, 0.6, **KW).cert_level == CERTIFIED
+    svc2.close()
+
+
+def test_failed_certificate_never_written_to_store():
+    """ISSUE 6 acceptance: with certify_before_cache on, an injected
+    post-solve lane perturbation FAILS certification, the future raises
+    typed, and the store never sees the solution; batchmates and the
+    next clean solve are unaffected."""
+    svc = _manual_service(
+        certify_before_cache=True,
+        inject_corrupt_lane={"at_launch": 0, "lane": 0, "field": 0,
+                             "amplitude": 3e-3})
+    corrupt = svc.submit(make_query(3.0, 0.6, **KW))
+    mate = svc.submit(make_query(1.0, 0.3, **KW))
+    svc.flush()
+    with pytest.raises(CertificationFailed) as ei:
+        corrupt.result(0)
+    assert ei.value.certificate.failed
+    assert ei.value.cell == (3.0, 0.6, 0.2)
+    # the batchmate solved, certified, and cached normally
+    assert mate.result(0).cert_level == CERTIFIED
+    assert svc.store.known() == 1               # ONLY the clean batchmate
+    assert svc.store.get(ei.value.key) is None  # the corrupt one: never
+    snap = svc.metrics.snapshot()
+    assert snap["serve_failed_certificates"] == 1
+    assert snap["serve_certified"] == 1
+    # launch 1 (no injection): the same query now solves (near-hit warm
+    # start from the cached batchmate is fine), certifies, caches
+    clean = svc.query(3.0, 0.6, **KW)
+    assert clean.path in ("cold", "near") and clean.cert_level == CERTIFIED
+    assert svc.store.known() == 2
+    svc.close()
+
+
+def test_shared_metrics_sums_eviction_counts_across_stores(tmp_path):
+    """One ServeMetrics shared by several services reports the SUM of
+    their stores' corruption evictions — a later attach must not drop an
+    earlier store's counter."""
+    from aiyagari_hark_tpu.serve import ServeMetrics
+
+    metrics = ServeMetrics()
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    for d in (a, b):
+        svc = _manual_service(disk_path=d)
+        svc.query(3.0, 0.6, **KW)
+        svc.close()
+        corrupt_store_entry(d, mode="perturb")
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        svc_a = _manual_service(disk_path=a, metrics=metrics)
+        svc_b = _manual_service(disk_path=b, metrics=metrics)
+    assert metrics.snapshot()["store_corrupt_evictions"] == 2
+    svc_a.close()
+    svc_b.close()
+
+
+def test_uncertified_service_leaves_level_unset():
+    svc = _manual_service()
+    r = svc.query(3.0, 0.6, **KW)
+    assert r.cert_level is None
+    hit = svc.query(3.0, 0.6, **KW)
+    assert hit.path == "hit" and hit.cert_level is None
+    svc.close()

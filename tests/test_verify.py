@@ -1,0 +1,405 @@
+"""Solution-integrity layer (ISSUE 6, DESIGN §9): a posteriori
+certification properties, the checksummed artifact chain, and the
+SDC spot-recheck — every detection path driven by its deterministic
+corruption injector.
+
+The load-bearing acceptance tests:
+
+* every cell of the 12-cell Table II sweep certifies CERTIFIED at
+  default thresholds, under the reference AND mixed precision policies,
+  with verdicts stable across ``schedule=``;
+* a deliberately perturbed policy (one-gridpoint shift, 1e-6 lane
+  noise) certifies FAILED;
+* every injected corruption — ledger row bit flip, sidecar content
+  flip, post-solve lane flip — is detected by the layer that first
+  loads or certifies it and degrades (recompute/quarantine/heuristic)
+  without poisoning other cells (injected == detected).
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.models.equilibrium import (
+    solve_calibration,
+    solve_calibration_lean,
+)
+from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep, sdc_sample
+from aiyagari_hark_tpu.solver_health import NONFINITE
+from aiyagari_hark_tpu.utils.config import SweepConfig
+from aiyagari_hark_tpu.utils.checkpoint import (
+    load_sweep_sidecar,
+    save_sweep_sidecar,
+)
+from aiyagari_hark_tpu.utils.fingerprint import (
+    IntegrityError,
+    content_checksum,
+    packed_row_checksum,
+    packed_row_checksums,
+    verify_packed_row,
+)
+from aiyagari_hark_tpu.utils.resilience import Interrupted, clear_interrupt
+from aiyagari_hark_tpu.verify import (
+    CERT_CHECKS,
+    CERTIFIED,
+    FAILED,
+    CertThresholds,
+    certify_equilibrium,
+    corrupt_ledger_row,
+    flip_row_bit,
+    perturbed_policy,
+)
+
+# Reduced-size config (test_sweep_scheduler's scale): full production
+# code paths, ~1s/cell on CPU.
+KW = dict(a_count=12, dist_count=48, labor_states=4, r_tol=1e-5,
+          max_bisect=30)
+SMALL = SweepConfig(crra_values=(1.0, 3.0), rho_values=(0.3, 0.6))
+TWELVE = SweepConfig()
+
+
+# ---------------------------------------------------------------------------
+# Checksum primitives.
+# ---------------------------------------------------------------------------
+
+def test_checksum_primitives_deterministic_and_sensitive():
+    row = np.asarray([0.035, 5.0, 0.9, 11, 500, 4000, 0, 0, 4500, 0],
+                     dtype=np.float64)
+    c = packed_row_checksum(row)
+    assert c == packed_row_checksum(row.copy())          # deterministic
+    assert c != packed_row_checksum(flip_row_bit(row))   # 1-bit sensitive
+    assert c != packed_row_checksum(row.astype(np.float32))  # via cast drift
+    # shape rides the hash: a flattened 2-row block != its concatenation
+    assert (content_checksum(np.zeros((2, 3)))
+            != content_checksum(np.zeros(6)))
+    # per-row vector agrees with the scalar primitive, NaN rows included
+    rows = np.stack([row, np.full(10, np.nan)])
+    per = packed_row_checksums(rows)
+    assert per[0] == c
+    assert per[1] == packed_row_checksum(rows[1])
+    verify_packed_row(row, c, "test")                    # clean: no raise
+    with pytest.raises(IntegrityError) as ei:
+        verify_packed_row(flip_row_bit(row), c, "test", key=7)
+    assert ei.value.boundary == "test" and ei.value.key == 7
+
+
+def test_uncertified_sentinel_pinned():
+    """serve.store inlines verify.UNCERTIFIED to stay import-cheap — the
+    two spellings must never drift."""
+    from aiyagari_hark_tpu.serve.store import UNCERTIFIED as store_u
+    from aiyagari_hark_tpu.verify import UNCERTIFIED as verify_u
+
+    assert store_u == verify_u
+
+
+# ---------------------------------------------------------------------------
+# Certification properties.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def solved_cell():
+    return solve_calibration(3.0, 0.6, **KW)
+
+
+def test_full_result_certifies_certified(solved_cell):
+    cert = certify_equilibrium(solved_cell, crra=3.0, labor_ar=0.6, **KW)
+    assert cert.level == CERTIFIED and cert.certified
+    assert tuple(c.name for c in cert.checks) == CERT_CHECKS
+    assert all(np.isfinite(c.residual) for c in cert.checks)
+
+
+def test_lean_and_bare_rstar_certify(solved_cell):
+    lean = solve_calibration_lean(3.0, 0.6, **KW)
+    cert = certify_equilibrium(lean, crra=3.0, labor_ar=0.6, **KW)
+    assert cert.certified
+    # a bare float r*: the capital claim is mirrored (nothing to check)
+    bare = certify_equilibrium(float(lean.r_star), crra=3.0,
+                               labor_ar=0.6, **KW)
+    assert bare.certified
+    assert bare.residuals()["capital"] == 0.0
+
+
+@pytest.mark.parametrize("mode,amplitude", [("shift", 0.0),
+                                            ("noise", 1e-6)])
+def test_perturbed_policy_certifies_failed(solved_cell, mode, amplitude):
+    """ISSUE 6 acceptance: a finite, monotone-looking, plausible policy —
+    one-gridpoint shift or 1e-6 lane noise — must FAIL certification
+    (only the independent oracles can catch it; no status code fires)."""
+    bad = solved_cell._replace(
+        policy=perturbed_policy(solved_cell.policy, mode=mode,
+                                amplitude=amplitude))
+    cert = certify_equilibrium(bad, crra=3.0, labor_ar=0.6, **KW)
+    assert cert.failed, cert.summary()
+
+
+def test_perturbed_rstar_certifies_failed(solved_cell):
+    """A corrupted interest rate (the serve-path lane-perturbation
+    amplitude) fails the full-path market-clearing re-evaluation."""
+    cert = certify_equilibrium(float(solved_cell.r_star) + 3e-3,
+                               crra=3.0, labor_ar=0.6, **KW)
+    assert cert.failed
+    assert cert.worst().name in ("market_clearing", "capital")
+
+
+def test_failed_status_row_certifies_failed_without_recompute():
+    from aiyagari_hark_tpu.parallel.sweep import (
+        _canonical_dtype,
+        _hashable_kwargs,
+    )
+    from aiyagari_hark_tpu.verify import certify_packed_rows
+
+    row = np.asarray([np.nan, np.nan, 1.0, 5, 100, 100, NONFINITE,
+                      0, 200, 0], dtype=np.float64)
+    certs = certify_packed_rows(
+        [row], [(3.0, 0.6, 0.2)], _canonical_dtype(None),
+        _hashable_kwargs(dict(KW)))
+    assert len(certs) == 1 and certs[0].failed
+    # the checks tuple keeps the full CERT_CHECKS-ordered layout (every
+    # consumer zips against it): unevaluated checks carry NaN residuals
+    # and grade FAILED, the recompute check carries the status code
+    assert tuple(c.name for c in certs[0].checks) == CERT_CHECKS
+    by_name = {c.name: c for c in certs[0].checks}
+    assert by_name["recompute"].residual == float(NONFINITE)
+    assert np.isnan(by_name["euler"].residual)
+    assert by_name["euler"].level == FAILED
+
+
+def test_thresholds_scale_with_solver_config():
+    loose = CertThresholds.for_solver(r_tol=1e-4)
+    tight = CertThresholds.for_solver(r_tol=1e-10)
+    assert loose.market_clearing > tight.market_clearing
+    mixed = CertThresholds.for_solver(r_tol=1e-10, precision="mixed")
+    assert mixed.market_clearing > tight.market_clearing
+    # overrides thread through
+    assert CertThresholds.for_solver(euler=0.5).euler == 0.5
+    # grading: MARGINAL sits between tol and marginal_factor * tol
+    thr = CertThresholds()
+    assert thr.grade("euler", thr.euler * 0.5).level == CERTIFIED
+    assert thr.grade("euler", thr.euler * 2.0).level == 1
+    assert thr.grade("euler", thr.euler * 100.0).level == FAILED
+    assert thr.grade("euler", float("nan")).level == FAILED
+    # the recompute check has its own band: CONVERGED certifies, STALLED
+    # is marginal, MAX_ITER/NONFINITE FAIL (a diverged recomputation must
+    # never pass the certify-before-cache gate as MARGINAL)
+    from aiyagari_hark_tpu.solver_health import (
+        CONVERGED,
+        MAX_ITER,
+        STALLED,
+    )
+
+    assert thr.grade("recompute", float(CONVERGED)).level == CERTIFIED
+    assert thr.grade("recompute", float(STALLED)).level == 1
+    assert thr.grade("recompute", float(MAX_ITER)).level == FAILED
+    assert thr.grade("recompute", float(NONFINITE)).level == FAILED
+
+
+def test_sweep_certifies_all_cells_and_verdicts_stable():
+    """12-cell acceptance at tier-1 scale: every cell CERTIFIED under
+    default thresholds, and the verdict vector is identical across
+    ``schedule=`` (bit-identical inputs) and ``precision=`` policies."""
+    ref = run_table2_sweep(TWELVE.replace(certify=True), **KW)
+    assert ref.cert_level is not None
+    assert (ref.cert_level == CERTIFIED).all(), ref.cert_level
+    assert ref.certify_wall_seconds > 0.0
+
+    bal = run_table2_sweep(
+        TWELVE.replace(certify=True, schedule="balanced"), **KW)
+    assert np.array_equal(bal.cert_level, ref.cert_level)
+
+    mixed = run_table2_sweep(TWELVE.replace(certify=True),
+                             precision="mixed", **KW)
+    assert (mixed.cert_level == CERTIFIED).all(), mixed.cert_level
+
+
+# ---------------------------------------------------------------------------
+# SDC spot-recheck.
+# ---------------------------------------------------------------------------
+
+def test_sdc_sample_deterministic_and_fraction_scaled():
+    cells = np.asarray(TWELVE.cells())
+    from aiyagari_hark_tpu.parallel.sweep import (
+        _canonical_dtype,
+        _hashable_kwargs,
+    )
+
+    dtype = _canonical_dtype(None)
+    items = _hashable_kwargs(dict(KW))
+    s1 = sdc_sample(cells, items, dtype, 0.25)
+    assert len(s1) == 3            # ceil(0.25 * 12)
+    assert np.array_equal(s1, sdc_sample(cells, items, dtype, 0.25))
+    assert len(sdc_sample(cells, items, dtype, 1.0)) == 12
+    assert len(sdc_sample(cells, items, dtype, 0.0)) == 0
+    # a different solver configuration samples a different subset
+    other = _hashable_kwargs({**KW, "a_count": 13})
+    assert not np.array_equal(s1, sdc_sample(cells, other, dtype, 0.25))
+
+
+def test_recheck_clean_run_no_suspects():
+    res = run_table2_sweep(SMALL.replace(recheck_fraction=1.0), **KW)
+    assert res.sdc_suspected is not None
+    assert not res.sdc_suspected.any()
+    assert res.recheck_wall_seconds > 0.0
+    clean = run_table2_sweep(SMALL, **KW)
+    np.testing.assert_array_equal(clean.r_star_pct, res.r_star_pct)
+
+
+def test_injected_lane_corruption_detected_and_quarantined():
+    """Acceptance: a post-solve bit flip on one lane is caught by the
+    bitwise recheck, the cell is routed through the quarantine ladder
+    (trusted re-solve), and every OTHER cell's bits are untouched."""
+    clean = run_table2_sweep(SMALL, **KW)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        bad = run_table2_sweep(SMALL.replace(recheck_fraction=1.0),
+                               inject_sdc={"cell": 1, "bit": 30}, **KW)
+    assert any("silent data corruption" in str(x.message) for x in w)
+    assert bad.sdc_suspected.sum() == 1 and bad.sdc_suspected[1]
+    assert bad.retries[1] >= 1             # quarantine re-solved it
+    assert np.isfinite(bad.r_star_pct[1])  # ...successfully
+    others = [0, 2, 3]
+    np.testing.assert_array_equal(clean.r_star_pct[others],
+                                  bad.r_star_pct[others])
+    np.testing.assert_array_equal(clean.status[others],
+                                  bad.status[others])
+
+
+def test_injected_corruption_without_recheck_goes_undetected():
+    """recheck_fraction=0 disables the defense: the corruption sails
+    through (the honest negative control for injected == detected)."""
+    res = run_table2_sweep(SMALL, inject_sdc={"cell": 1, "bit": 30}, **KW)
+    assert res.sdc_suspected is None
+
+
+def test_suspected_cell_nan_masked_when_quarantine_off():
+    """With quarantine=False no retry ladder runs: a suspected cell's
+    KNOWN-corrupt values must still be NaN-masked (status NONFINITE),
+    never kept as plausible finite numbers — the sidecar's NaN=failed
+    warm-seed rule depends on it."""
+    res = run_table2_sweep(SMALL.replace(recheck_fraction=1.0),
+                           inject_sdc={"cell": 1, "bit": 30},
+                           quarantine=False, **KW)
+    assert res.sdc_suspected[1]
+    assert res.status[1] == NONFINITE
+    assert np.isnan(res.r_star_pct[1]) and np.isnan(res.capital[1])
+    assert np.isfinite(res.r_star_pct[[0, 2, 3]]).all()
+
+
+def test_recheck_skips_resumed_quarantine_outcomes(tmp_path):
+    """A resumed ledger row holding a serial quarantine OUTCOME can never
+    bitwise-match a fresh batched launch — the recheck must skip it
+    loudly instead of reporting a false corruption alarm."""
+    from aiyagari_hark_tpu.utils.resilience import SweepLedger
+    from aiyagari_hark_tpu.verify.inject import _rewrite_npz_leaf
+
+    ledger = str(tmp_path / "ledger.npz")
+    try:
+        with pytest.raises(Interrupted):
+            run_table2_sweep(SMALL, resume_path=ledger,
+                            inject_preempt={"after_bucket": 0,
+                                            "mode": "flag"}, **KW)
+    finally:
+        clear_interrupt()
+    # mark cell 2's row as a quarantine outcome (retried) in place — the
+    # packed bytes (and so their checksum) are untouched, but the resume
+    # must now treat the row as a serial-solve result the batched
+    # executable cannot reproduce, and exclude it from the sample
+    def mark(arr, value):
+        arr = np.array(arr)
+        arr[2] = value
+        return arr
+
+    _rewrite_npz_leaf(ledger, SweepLedger._fields.index("retried"),
+                      lambda a: mark(a, True))
+    _rewrite_npz_leaf(ledger, SweepLedger._fields.index("retries"),
+                      lambda a: mark(a, 1))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        resumed = run_table2_sweep(SMALL.replace(recheck_fraction=1.0),
+                                   resume_path=ledger, **KW)
+    msgs = [str(x.message) for x in w]
+    assert not resumed.sdc_suspected.any(), msgs
+    assert any("skipping ledger-restored cell(s) [2]" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# Checksummed artifact chain: sidecar + ledger.
+# ---------------------------------------------------------------------------
+
+def test_sidecar_checksum_roundtrip_and_corruption(tmp_path):
+    from aiyagari_hark_tpu.verify.inject import _rewrite_npz_leaf
+    from aiyagari_hark_tpu.utils.checkpoint import SweepSidecar
+
+    path = str(tmp_path / "side.npz")
+    save_sweep_sidecar(path, [[3.0, 0.6, 0.2]], [0.035], [11], [500],
+                       [4000], [0], fingerprint=99)
+    side = load_sweep_sidecar(path, 99)     # clean: verifies
+    assert int(side.checksum) == side.content_checksum()
+
+    # corrupt ONE root value in place, leaving the stored checksum —
+    # the silent-corruption shape the checksum boundary exists to catch
+    _rewrite_npz_leaf(path, SweepSidecar._fields.index("r_star"),
+                      lambda r: r + 1e-9)
+    with pytest.raises(IntegrityError):
+        load_sweep_sidecar(path, 99)
+
+
+def test_corrupt_sidecar_degrades_sweep_to_heuristic(tmp_path):
+    """End to end: a sweep pointed at a corrupted sidecar warns and runs
+    (heuristic work model) instead of trusting or crashing."""
+    from aiyagari_hark_tpu.verify.inject import _rewrite_npz_leaf
+    from aiyagari_hark_tpu.utils.checkpoint import SweepSidecar
+
+    side = str(tmp_path / "side.npz")
+    cfg = SMALL.replace(schedule="balanced", sidecar_path=side)
+    first = run_table2_sweep(cfg, **KW)     # writes the sidecar
+    _rewrite_npz_leaf(side, SweepSidecar._fields.index("dist_iters"),
+                      lambda it: it + 1)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        again = run_table2_sweep(cfg, **KW)
+    assert any("integrity" in str(x.message).lower() for x in w)
+    np.testing.assert_array_equal(first.r_star_pct, again.r_star_pct)
+
+
+def test_ledger_row_corruption_quarantined_on_resume(tmp_path):
+    """Acceptance: flip one bit in a solved ledger row between interrupt
+    and resume — the resume verifies checksums, quarantines exactly that
+    cell (recompute), and the reassembled result is bit-identical to an
+    uninterrupted run."""
+    ledger = str(tmp_path / "ledger.npz")
+    clean = run_table2_sweep(SMALL, **KW)
+    try:
+        with pytest.raises(Interrupted):
+            run_table2_sweep(SMALL, resume_path=ledger,
+                             inject_preempt={"after_bucket": 0,
+                                             "mode": "flag"}, **KW)
+    finally:
+        clear_interrupt()
+    assert os.path.exists(ledger)
+    corrupt_ledger_row(ledger, cell=1, bit=21)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        resumed = run_table2_sweep(SMALL, resume_path=ledger, **KW)
+    assert any("checksum verification failed" in str(x.message)
+               for x in w)
+    np.testing.assert_array_equal(clean.r_star_pct, resumed.r_star_pct)
+    np.testing.assert_array_equal(clean.status, resumed.status)
+    assert not os.path.exists(ledger)       # completed: deleted
+
+
+def test_ledger_uncorrupted_resume_still_bit_identical(tmp_path):
+    """Negative control: the checksum chain must not break the existing
+    resume bit-identity contract."""
+    ledger = str(tmp_path / "ledger.npz")
+    clean = run_table2_sweep(SMALL, **KW)
+    try:
+        with pytest.raises(Interrupted):
+            run_table2_sweep(SMALL, resume_path=ledger,
+                             inject_preempt={"after_bucket": 0,
+                                             "mode": "flag"}, **KW)
+    finally:
+        clear_interrupt()
+    resumed = run_table2_sweep(SMALL, resume_path=ledger, **KW)
+    np.testing.assert_array_equal(clean.r_star_pct, resumed.r_star_pct)
