@@ -7,6 +7,7 @@ Layers (mirroring SURVEY.md §1, rebuilt TPU-first):
   * ``parallel`` — device meshes, calibration sweeps, sharded agent panels
   * ``serve``    — micro-batched equilibrium query engine + solution store
   * ``verify``   — a posteriori certification, checksum chain, SDC defense
+  * ``obs``      — run-scoped tracing spans, metrics registry, event journal
   * ``utils``    — typed configs, checkpointing, logging, statistics
   * ``facade``   — notebook-compatible AiyagariType / AiyagariEconomy classes
 """

@@ -86,7 +86,10 @@ def household_capital_supply(r, model: SimpleModel, disc_fac, crra,
                              dist_method: str = "auto",
                              egm_method: str = "xla",
                              accel_every: int | None = None,
-                             precision: str = "reference") -> SupplyEval:
+                             precision: str = "reference",
+                             descent_fault_iter: int | None = None,
+                             descent_fault_mode: str = "nan",
+                             ) -> SupplyEval:
     """A(r): solve the household at prices implied by r, return stationary
     capital plus the objects (policy, distribution, W), iteration counts
     (the work model behind the grid-points/sec benchmark metric), and the
@@ -107,11 +110,21 @@ def household_capital_supply(r, model: SimpleModel, disc_fac, crra,
 
     ``precision`` threads the mixed-precision ladder policy (DESIGN §5)
     into BOTH inner fixed points; the per-phase step split rides the
-    returned counters."""
+    returned counters.  ``descent_fault_iter`` (tests; ISSUE 7 event
+    drills) poisons both inner DESCENT phases at that iteration so the
+    ladder's escalation path is deterministically injectable from the
+    sweep level — compiled out when None, like the bisection's
+    ``fault_iter``; ``descent_fault_mode`` picks the poison ("nan" |
+    "stall" — a stall escalates WITHOUT contaminating the descent-only
+    bracket trips' finite excess, so the cell stays healthy end to
+    end)."""
     k_to_l = firm.k_to_l_from_r(r, cap_share, depr_fac, prod)
     W = firm.wage_rate(k_to_l, cap_share, prod)
     R = 1.0 + r
     egm_kw = {} if accel_every is None else {"accel_every": accel_every}
+    if descent_fault_iter is not None:
+        egm_kw["descent_fault_iter"] = int(descent_fault_iter)
+        egm_kw["descent_fault_mode"] = str(descent_fault_mode)
     policy, egm_it, _, egm_status, egm_ph = solve_household(
         R, W, model, disc_fac, crra, tol=egm_tol, init_policy=init_policy,
         method=egm_method, precision=precision, return_phases=True,
@@ -302,7 +315,10 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
                            bracket_init=None,
                            precision: str = "reference",
                            fault_iter=None,
-                           fault_mode: str = "nan") -> LeanEquilibrium:
+                           fault_mode: str = "nan",
+                           descent_fault_iter: int | None = None,
+                           descent_fault_mode: str = "nan",
+                           ) -> LeanEquilibrium:
     """Bracketed root-finding equilibrium that carries the supply evaluation
     through the loop state instead of re-solving the household at ``r_star``
     afterwards.
@@ -403,7 +419,9 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
                 egm_tol=egm_tol, dist_tol=dist_tol,
                 init_policy=pol, init_dist=dist, dist_method=dist_method,
                 egm_method=egm_method, accel_every=accel_every,
-                precision=prec)
+                precision=prec,
+                descent_fault_iter=descent_fault_iter,
+                descent_fault_mode=descent_fault_mode)
         return eval_at
 
     # The final-grade evaluation (used by the polish trips and the warm-seed

@@ -589,6 +589,10 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
             new_afunc, rsq = jax.block_until_ready(update(history, afunc))
         if not (bool(jnp.all(jnp.isfinite(new_afunc.intercept)))
                 and bool(jnp.all(jnp.isfinite(new_afunc.slope)))):
+            from ..obs.runtime import emit_event
+
+            emit_event("SOLVER_DIVERGED", where="ks_outer", iteration=it,
+                       status="NONFINITE")
             raise SolverDivergenceError(
                 f"KS outer iteration {it}: saving-rule regression produced "
                 f"non-finite parameters (intercept={new_afunc.intercept}, "

@@ -1,0 +1,51 @@
+"""Unified observability layer (ISSUE 7, DESIGN §10): run-scoped tracing
+spans, a typed metrics registry, and a structured event journal, all
+correlated by one ``run_id``.
+
+Three pillars, one switch:
+
+* ``trace`` — nestable host-side spans with Chrome-trace/Perfetto
+  export and an opt-in ``utils.timing.device_trace`` bridge;
+* ``metrics`` — typed counters/gauges/histograms with Prometheus-text
+  and round-tripping JSON snapshots, into which the existing
+  ``ServeMetrics``/``CompileCounter``/sweep counters mirror;
+* ``journal`` — append-only JSONL of typed lifecycle events
+  (QUARANTINE, RETRY_TRANSIENT, CERT_FAILED, ...) emitted at every seam
+  the previous PRs built, enforced by ``scripts/check_obs_events.py``.
+
+Off by default, near-zero disabled overhead (``NULL_OBS``; the no-op
+span is one cached null context manager).  Enable via ``ObsConfig`` on
+``SweepConfig(obs=...)`` / ``EquilibriumService(obs=...)`` /
+``bench.py --obs-smoke``.  Everything here is stdlib-only at import —
+recording a serve hit must stay microseconds.
+"""
+
+from .journal import EVENT_TYPES, EventJournal, read_journal  # noqa: F401
+from .metrics import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    reset_default_registry,
+)
+from .runtime import (  # noqa: F401
+    NULL_INSTRUMENT,
+    NULL_OBS,
+    Obs,
+    ObsConfig,
+    active_obs,
+    active_span,
+    build_obs,
+    emit_event,
+    resolve_obs,
+)
+from .trace import (  # noqa: F401
+    NULL_SPAN,
+    NULL_SPAN_CM,
+    Span,
+    Tracer,
+    new_run_id,
+    trace_nesting_ok,
+)

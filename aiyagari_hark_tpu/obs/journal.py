@@ -1,0 +1,124 @@
+"""Structured event journal: typed lifecycle events as append-only JSONL
+(ISSUE 7).
+
+Every seam the previous PRs built — quarantine (PR 1), bucket launches
+and warm starts (PR 2), preemption/retry/resume (PR 3), serving paths
+and deadlines (PR 4/6), precision escalation (PR 5), certification and
+corruption eviction (PR 6) — used to announce itself through
+``warnings.warn`` / ``logging`` prose: human-greppable, machine-opaque.
+The journal gives each of those seams ONE typed, machine-readable line:
+
+    {"ts": ..., "run_id": "run-...", "event": "QUARANTINE",
+     "cell": 7, "crra": 5.0, ...}
+
+* **Typed**: ``event`` must be a member of ``EVENT_TYPES`` — an unknown
+  type raises at the emit site, so event names cannot drift per caller
+  (the contract ``scripts/check_obs_events.py`` lints and
+  ``tests/test_obs.py`` exercises drill-by-drill).
+* **Append-only, crash-consistent**: lines go through
+  ``utils.checkpoint.append_jsonl`` — one ``os.write`` of one complete
+  newline-terminated line per event to an ``O_APPEND`` descriptor.  A
+  SIGKILL can tear at most the final line, which ``read_journal`` (and
+  ``utils.timing.read_records_jsonl``) detect and skip; it can never
+  interleave or truncate earlier events.
+* **Run-scoped**: every line carries the ``run_id`` shared with the
+  trace and the metrics snapshot, so one grep correlates a quarantined
+  cell with its bucket span and its retry counter.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+# The typed lifecycle vocabulary (DESIGN §10).  Grouped by the subsystem
+# that owns the seam; adding a member is an API change — document it in
+# DESIGN §10 and cover it in tests/test_obs.py.
+EVENT_TYPES = (
+    # run lifecycle (obs runtime)
+    "RUN_START", "RUN_END",
+    # sweep scheduler (parallel.sweep)
+    "BUCKET_LAUNCH", "QUARANTINE", "SDC_SUSPECTED",
+    # resilience layer (utils.resilience)
+    "RETRY_TRANSIENT", "INTERRUPTED", "RESUME_RESTORE",
+    # precision ladder (DESIGN §5)
+    "PRECISION_ESCALATED",
+    # integrity / certification (verify, utils.fingerprint)
+    "CERT_FAILED", "INTEGRITY_FAILED",
+    # serving (serve.service / serve.store)
+    "STORE_EVICT_CORRUPT", "DEADLINE_EXCEEDED",
+    # typed solver divergence escaping to a caller (models, facade)
+    "SOLVER_DIVERGED",
+)
+
+
+def _jsonable(v):
+    from .trace import _jsonable as coerce
+
+    return coerce(v)
+
+
+class EventJournal:
+    """Append-only JSONL journal for one run.
+
+    ``emit`` is thread-safe and durable per event (no buffering: a
+    lifecycle event is rare and must survive the preemption it often
+    describes).  The file may hold several runs' events (appends never
+    truncate) — readers filter by ``run_id``."""
+
+    def __init__(self, path: str, run_id: str, clock=time.time):
+        self.path = str(path)
+        self.run_id = str(run_id)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.emitted = 0
+
+    def emit(self, etype: str, **attrs) -> dict:
+        """Append one typed event; returns the record written.  Raises
+        ``ValueError`` on an event type outside ``EVENT_TYPES`` — the
+        journal's vocabulary is closed by design."""
+        if etype not in EVENT_TYPES:
+            raise ValueError(
+                f"unknown journal event type {etype!r}; add it to "
+                f"obs.journal.EVENT_TYPES if it is a new lifecycle seam "
+                f"(known: {', '.join(EVENT_TYPES)})")
+        rec = {"ts": round(float(self._clock()), 6),
+               "run_id": self.run_id, "event": etype}
+        for k, v in attrs.items():
+            rec[str(k)] = _jsonable(v)
+        from ..utils.checkpoint import append_jsonl
+
+        with self._lock:
+            append_jsonl(self.path, [json.dumps(rec)])
+            self.emitted += 1
+        return rec
+
+
+def read_journal(path: str, run_id: Optional[str] = None,
+                 event: Optional[str] = None) -> list:
+    """Read a journal back as a list of dicts, optionally filtered by
+    ``run_id`` and/or ``event`` type.
+
+    A line that does not parse is SKIPPED, not fatal
+    (``utils.checkpoint.read_jsonl_tolerant`` — the shared reader half
+    of ``append_jsonl``'s crash contract): a journal must stay readable
+    after the very preemption it recorded.  Skips are warned with a
+    count, never silent.  A missing file reads as an empty journal."""
+    import warnings
+
+    from ..utils.checkpoint import read_jsonl_tolerant
+
+    try:
+        records, bad = read_jsonl_tolerant(path)
+    except OSError:
+        return []
+    if bad:
+        warnings.warn(
+            f"event journal {path}: skipped {bad} unparseable line(s) "
+            "(torn tail from a hard kill, or external corruption)",
+            stacklevel=2)
+    return [rec for rec in records
+            if (run_id is None or rec.get("run_id") == run_id)
+            and (event is None or rec.get("event") == event)]

@@ -1,0 +1,288 @@
+"""The run-scoped observability bundle: config, lifecycle, active scope
+(ISSUE 7).
+
+``ObsConfig`` is the one switch callers thread (``SweepConfig.obs``,
+``EquilibriumService(obs=...)``, bench flags); ``Obs`` bundles the three
+pillars — tracer, metrics registry, event journal — under one
+``run_id`` so every artifact of a run correlates.  Disabled is the
+default and near-free:
+
+* ``NULL_OBS`` is a process singleton whose ``span()`` returns THE
+  cached null context manager (``trace.NULL_SPAN_CM`` — no allocation,
+  no clock read), whose ``event()`` is a constant no-op, and whose
+  instrument accessors return a shared no-op instrument.
+* ``emit_event`` — the module-level hook deep seams use
+  (``utils.resilience`` retries, ``SolutionStore`` evictions,
+  ``utils.fingerprint`` integrity raises) — costs ONE empty-list truth
+  test when no run is active.
+
+An enabled ``Obs`` additionally registers itself as the ACTIVE scope
+(``activate()``) for the duration of a run, so instrumented layers too
+deep to thread a handle through (signal handlers, checksum primitives,
+the store called from a service that predates the run) still land their
+events in the right journal.  The active scope is a PER-THREAD stack:
+nested runs (a sweep inside a bench phase) journal to the innermost,
+and concurrent runs on different threads never blend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+from typing import List, Optional
+
+from .journal import EventJournal
+from .metrics import MetricsRegistry
+from .trace import NULL_SPAN_CM, Tracer, new_run_id
+
+
+@dataclasses.dataclass(frozen=True)
+class ObsConfig:
+    """Observability knobs for one run (hashable, rides frozen configs).
+
+    * ``enabled`` — master switch; False (default) resolves to
+      ``NULL_OBS`` and changes ZERO solver bits (pinned by
+      ``tests/test_obs.py``).
+    * ``trace`` / ``metrics`` — record spans / counters (both on when
+      enabled; the journal is governed by ``journal_path`` alone).
+    * ``trace_path`` — write the Chrome-trace JSON here on close
+      (load it in chrome://tracing or https://ui.perfetto.dev).
+    * ``journal_path`` — append typed lifecycle events to this JSONL.
+    * ``run_id`` — correlation id; auto-generated when None.
+    * ``device_trace_dir`` — opt-in bridge to ``utils.timing
+      .device_trace``: spans created with ``device_profile=True``
+      capture an XLA profiler dump under this directory."""
+
+    enabled: bool = False
+    trace: bool = True
+    metrics: bool = True
+    trace_path: Optional[str] = None
+    journal_path: Optional[str] = None
+    run_id: Optional[str] = None
+    device_trace_dir: Optional[str] = None
+
+    def replace(self, **kwargs) -> "ObsConfig":
+        return dataclasses.replace(self, **kwargs)
+
+
+class _NullInstrument:
+    """Accepts every instrument mutation, records nothing."""
+
+    __slots__ = ()
+    kind = "null"
+    value = 0.0
+    count = 0
+    sum = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        pass
+
+    def set(self, value: float) -> None:
+        pass
+
+    def observe(self, value: float) -> None:
+        pass
+
+
+NULL_INSTRUMENT = _NullInstrument()
+_NULL_ACTIVATE_CM = contextlib.nullcontext(None)
+
+
+class Obs:
+    """One run's observability bundle (build via ``build_obs``)."""
+
+    enabled = True
+
+    def __init__(self, run_id: Optional[str] = None,
+                 tracer: Optional[Tracer] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 journal: Optional[EventJournal] = None,
+                 trace_path: Optional[str] = None):
+        self.run_id = run_id if run_id is not None else new_run_id()
+        self.tracer = tracer
+        self.registry = registry
+        self.journal = journal
+        self.trace_path = trace_path
+        self._closed = False
+
+    # -- spans --------------------------------------------------------------
+
+    def span(self, name: str, **attrs):
+        if self.tracer is None:
+            return NULL_SPAN_CM
+        return self.tracer.span(name, **attrs)
+
+    def record_span(self, name: str, duration_s: float, **attrs) -> None:
+        if self.tracer is not None:
+            self.tracer.record(name, duration_s, **attrs)
+
+    # -- events -------------------------------------------------------------
+
+    def event(self, etype: str, **attrs) -> None:
+        if self.journal is not None:
+            self.journal.emit(etype, **attrs)
+
+    # -- metrics ------------------------------------------------------------
+
+    def counter(self, name: str, help: str = ""):
+        if self.registry is None:
+            return NULL_INSTRUMENT
+        return self.registry.counter(name, help)
+
+    def gauge(self, name: str, help: str = ""):
+        if self.registry is None:
+            return NULL_INSTRUMENT
+        return self.registry.gauge(name, help)
+
+    def histogram(self, name: str, help: str = "", **kw):
+        if self.registry is None:
+            return NULL_INSTRUMENT
+        return self.registry.histogram(name, help, **kw)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def activate(self):
+        """Context manager making this the ACTIVE scope for module-level
+        ``emit_event``/``active_obs`` callers (deep seams without a
+        threaded handle)."""
+        return _activation(self)
+
+    def close(self) -> None:
+        """Flush run-end artifacts: the Chrome trace (atomic write) and
+        the RUN_END journal event.  Idempotent — a run interrupted
+        between seams may close through more than one ``finally``."""
+        if self._closed:
+            return
+        self._closed = True
+        self.event("RUN_END")
+        if self.tracer is not None and self.trace_path is not None:
+            self.tracer.save_chrome_trace(self.trace_path)
+
+
+class _NullObs(Obs):
+    """The disabled bundle: one process-wide instance, every operation a
+    constant-time no-op (the ISSUE 7 near-zero-disabled-overhead
+    contract)."""
+
+    enabled = False
+
+    def __init__(self):
+        super().__init__(run_id="run-disabled")
+
+    def span(self, name: str, **attrs):
+        return NULL_SPAN_CM
+
+    def record_span(self, name: str, duration_s: float, **attrs) -> None:
+        pass
+
+    def event(self, etype: str, **attrs) -> None:
+        pass
+
+    def counter(self, name: str, help: str = ""):
+        return NULL_INSTRUMENT
+
+    gauge = counter
+    histogram = counter
+
+    def activate(self):
+        return _NULL_ACTIVATE_CM
+
+    def close(self) -> None:
+        pass
+
+
+NULL_OBS = _NullObs()
+
+# The active-scope stack: appended under ``activate()``, innermost
+# last.  PER-THREAD (``threading.local``) — two runs on two threads (a
+# sweep while a service warms, two concurrent sweeps) each see only
+# their own scope, so a deep seam can never journal thread A's event
+# under thread B's run_id.  A worker thread servicing a run it did not
+# start (the serve batch worker) re-activates the owning bundle around
+# its launches.
+_ACTIVE = threading.local()
+
+
+def _active_stack() -> List[Obs]:
+    stack = getattr(_ACTIVE, "stack", None)
+    if stack is None:
+        stack = _ACTIVE.stack = []
+    return stack
+
+
+@contextlib.contextmanager
+def _activation(obs: Obs):
+    stack = _active_stack()
+    stack.append(obs)
+    try:
+        yield obs
+    finally:
+        try:
+            stack.remove(obs)
+        except ValueError:
+            pass
+
+
+def active_obs() -> Obs:
+    """This thread's innermost active bundle, or ``NULL_OBS``."""
+    stack = getattr(_ACTIVE, "stack", None)
+    return stack[-1] if stack else NULL_OBS
+
+
+def emit_event(etype: str, **attrs) -> None:
+    """Journal one event into the active scope — the hook for seams too
+    deep to thread an ``Obs`` handle (retry backoffs, checksum
+    failures, signal-flag polls).  One attribute read plus a truth-test
+    when no run is active."""
+    stack = getattr(_ACTIVE, "stack", None)
+    if not stack:
+        return
+    stack[-1].event(etype, **attrs)
+
+
+def active_span(name: str, **attrs):
+    """A span on the active scope (cached null CM when none)."""
+    stack = getattr(_ACTIVE, "stack", None)
+    if not stack:
+        return NULL_SPAN_CM
+    return stack[-1].span(name, **attrs)
+
+
+def build_obs(config: Optional[ObsConfig]) -> Obs:
+    """Materialize a bundle from a config: ``None`` or
+    ``enabled=False`` give ``NULL_OBS``."""
+    if config is None or not config.enabled:
+        return NULL_OBS
+    run_id = config.run_id if config.run_id is not None else new_run_id()
+    tracer = (Tracer(run_id=run_id,
+                     device_trace_dir=config.device_trace_dir)
+              if config.trace else None)
+    registry = MetricsRegistry() if config.metrics else None
+    journal = (EventJournal(config.journal_path, run_id)
+               if config.journal_path is not None else None)
+    obs = Obs(run_id=run_id, tracer=tracer, registry=registry,
+              journal=journal, trace_path=config.trace_path)
+    obs.event("RUN_START")
+    return obs
+
+
+def resolve_obs(obj) -> tuple:
+    """Normalize a caller-facing ``obs`` argument to ``(Obs, owned)``:
+
+    * ``None`` → ``(NULL_OBS, False)``;
+    * an ``ObsConfig`` → a freshly built bundle, OWNED by the callee
+      (who must ``close()`` it when the run ends);
+    * an ``Obs`` → passed through un-owned (the caller's run spans
+      several subsystems — e.g. the bench tracing sweep AND serve under
+      one run_id — and closes it itself)."""
+    if obj is None:
+        return NULL_OBS, False
+    if isinstance(obj, ObsConfig):
+        obs = build_obs(obj)
+        return obs, obs is not NULL_OBS
+    if isinstance(obj, Obs):
+        return obj, False
+    raise TypeError(
+        f"obs must be None, an ObsConfig, or an Obs bundle; got "
+        f"{type(obj).__name__}")

@@ -39,6 +39,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from ..models.equilibrium import solve_calibration_lean
+from ..obs.runtime import NULL_OBS, resolve_obs
 from ..solver_health import CONVERGED, NONFINITE, is_failure, status_name
 from ..utils.checkpoint import (
     CORRUPT_NPZ_ERRORS,
@@ -368,14 +369,14 @@ def _load_sidecar(path, fingerprint):
     try:
         return load_sweep_sidecar(path, fingerprint)
     except CheckpointMismatchError as e:
-        warnings.warn(f"sweep sidecar ignored: {e}", stacklevel=3)
+        warnings.warn(f"sweep sidecar ignored: {e}", stacklevel=4)
         return None
     except IntegrityError as e:
         # silent corruption (DESIGN §9): the file parsed and carried the
         # right fingerprint, but its content no longer hashes to its
         # solve-time checksum — degrade to the heuristic, loudly
         warnings.warn(f"sweep sidecar failed integrity verification: {e}",
-                      stacklevel=3)
+                      stacklevel=4)
         return None
     except CORRUPT_NPZ_ERRORS:
         return None
@@ -549,7 +550,7 @@ def _solve_scheduled(sweep: SweepConfig, crra, rho, sd, rho_nominal,
                      fault_iters, fault_mode, mesh, axis, dtype,
                      kwargs_items, model_kwargs, perturb=0.0,
                      side=None, ledger=None, device_call=None,
-                     inject_preempt=None):
+                     inject_preempt=None, obs=NULL_OBS):
     """The work-balanced bucketed solve: returns per-cell packed results
     ``[C, PACKED_ROW_WIDTH]`` in ORIGINAL cell order, the summed launch
     wall, the bucket assignment, and the predicted-work vector.
@@ -687,14 +688,29 @@ def _solve_scheduled(sweep: SweepConfig, crra, rho, sd, rho_nominal,
         if shard is not None:
             args = [jax.device_put(a, shard) for a in args]
 
-        packed, launch_wall = _timed_launch(     # [B, W], one transfer
-            device_call, f"sweep bucket {bi}", fn, args)
+        with obs.span("sweep/bucket", bucket=int(bi),
+                      cells=len(bucket), lanes=len(lanes), warm=warm,
+                      device_profile=True) as bsp:
+            packed, launch_wall = _timed_launch(     # [B, W], one transfer
+                device_call, f"sweep bucket {bi}", fn, args)
         wall_total += launch_wall
 
         # un-permute: padding lanes duplicate a real lane's inputs, so the
         # duplicate rows carry identical bits and last-write-wins is exact
         results[lanes] = packed
         solved[bucket] = True
+        # phase spans from RETURNED counters — no tracing inside jit
+        # (DESIGN §10): descent/polish step totals subdivide the bucket
+        # span proportionally as synthetic children
+        bsp.annotate(wall_s=launch_wall)
+        bsp.subdivide({"descent": float(results[bucket, 7].sum()),
+                       "polish": float(results[bucket, 8].sum())},
+                      prefix="sweep/phase/")
+        obs.event("BUCKET_LAUNCH", bucket=int(bi),
+                  cells=[int(c) for c in bucket], warm=warm,
+                  wall_s=launch_wall)
+        obs.histogram("aiyagari_sweep_bucket_wall_seconds",
+                      "per-bucket launch wall").observe(launch_wall)
         if warm:
             for pos, li in enumerate(lanes):
                 seeds_used[li] = seeds[pos]
@@ -804,7 +820,7 @@ def _ensure_compilation_cache() -> None:
         enable_compilation_cache()
     except OSError as e:
         warnings.warn(f"persistent compilation cache unavailable: {e}",
-                      stacklevel=3)
+                      stacklevel=4)
     _COMPILATION_CACHE_ON = True   # resolved either way: stop re-checking
 
 
@@ -818,8 +834,44 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
                      inject_transient: Optional[dict] = None,
                      inject_preempt: Optional[dict] = None,
                      inject_sdc: Optional[dict] = None,
-                     cert_thresholds=None,
+                     cert_thresholds=None, obs=None,
                      **model_kwargs) -> SweepResult:
+    # The observability shell around the solve (ISSUE 7, DESIGN §10):
+    # resolve the obs bundle (argument beats SweepConfig.obs; None is the
+    # near-free NULL_OBS), make it the ACTIVE scope so deep seams
+    # (retry_transient, ledger restore, checksum verification) journal
+    # into this run, and wrap everything in the root "sweep/run" span.
+    # A bundle built HERE from an ObsConfig is owned here — closed (trace
+    # flushed, RUN_END journaled) even when the run exits via the typed
+    # Interrupted; a caller-provided Obs spans multiple subsystems and
+    # stays open.  The full sweep contract is documented on
+    # ``_run_table2_sweep_impl`` (re-exported onto this wrapper below).
+    # NOTE: this wrapper adds one stack frame between the user and the
+    # impl — every stacklevel-tuned warnings.warn inside counts it.
+    obs, owned = resolve_obs(obs if obs is not None else sweep.obs)
+    try:
+        with obs.activate(), obs.span(
+                "sweep/run", schedule=sweep.schedule,
+                cells=len(sweep.cells())) as sp:
+            res = _run_table2_sweep_impl(
+                sweep, mesh, axis, dtype, timer, perturb, quarantine,
+                max_retries, inject_fault, resume_path, retry,
+                inject_transient, inject_preempt, inject_sdc,
+                cert_thresholds, obs, **model_kwargs)
+            sp.annotate(wall_s=res.wall_seconds,
+                        skew=res.scheduled_iteration_skew(),
+                        failed_cells=len(res.failed_cells()))
+            return res
+    finally:
+        if owned:
+            obs.close()
+
+
+def _run_table2_sweep_impl(sweep, mesh, axis, dtype, timer, perturb,
+                           quarantine, max_retries, inject_fault,
+                           resume_path, retry, inject_transient,
+                           inject_preempt, inject_sdc, cert_thresholds,
+                           obs, **model_kwargs) -> SweepResult:
     """Solve every (σ, ρ, sd) cell as batched program launches.
 
     Scheduling: ``sweep.schedule`` picks between the single lock-step
@@ -915,6 +967,19 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
     cannot serve the warm-up run's results — same compiled program, same
     fixed point to within the perturbation (methodology of
     ``scripts/pallas_ab.py``).
+
+    Observability (ISSUE 7, DESIGN §10): with ``obs`` (argument or
+    ``SweepConfig.obs`` — an ``obs.ObsConfig`` or a shared ``obs.Obs``
+    bundle) the sweep records a ``sweep/run`` span containing per-bucket
+    launch spans (subdivided into descent/polish phase children from the
+    returned counters — nothing traces inside jit), quarantine-rung and
+    recheck/certify spans, journals typed lifecycle events
+    (BUCKET_LAUNCH, QUARANTINE, SDC_SUSPECTED, PRECISION_ESCALATED,
+    CERT_FAILED, RETRY_TRANSIENT, INTERRUPTED, RESUME_RESTORE) under one
+    ``run_id``, and mirrors the sweep counters into the metrics
+    registry.  Disabled (default) is near-free and changes zero solver
+    bits — ``wall_seconds`` semantics are untouched either way (spans
+    bracket the same clock reads the honest wall already makes).
     """
     cells = np.asarray(sweep.cells(), dtype=np.float64)  # [C, 3] (σ, ρ, sd)
     crra, rho, sd = cells[:, 0], cells[:, 1], cells[:, 2]
@@ -1000,7 +1065,7 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
         if sweep.work_model == "sidecar" and side is None:
             warnings.warn("work_model='sidecar' but no valid sidecar at "
                           f"{sweep.sidecar_path!r}; using the heuristic",
-                          stacklevel=2)
+                          stacklevel=3)
     retry_policy = retry if retry is not None else RetryPolicy()
     injector = (TransientInjector.from_spec(inject_transient)
                 if inject_transient is not None else None)
@@ -1029,7 +1094,8 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
             sweep, crra, rho, sd, rho_label, fault_iters, fault_mode,
             mesh, axis, dtype, kwargs_items, model_kwargs,
             perturb=perturb, side=side, ledger=ledger,
-            device_call=device_call, inject_preempt=inject_preempt)
+            device_call=device_call, inject_preempt=inject_preempt,
+            obs=obs)
         sl = slice(0, n_orig)
     elif ledger is not None and ledger.solved.all():
         # locked path, fully solved by the interrupted run: restore the
@@ -1066,8 +1132,19 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
         fn = _batched_solver(dtype, kwargs_items, fault_mode)
         args = ((crra_d, rho_d, sd_d) if fault_d is None
                 else (crra_d, rho_d, sd_d, fault_d))
-        packed, wall = _timed_launch(           # [C, W], one transfer
-            device_call, "sweep launch", fn, args)
+        with obs.span("sweep/bucket", bucket=0, cells=n_orig,
+                      warm=False, device_profile=True) as bsp:
+            packed, wall = _timed_launch(       # [C, W], one transfer
+                device_call, "sweep launch", fn, args)
+        bsp.annotate(wall_s=wall)
+        bsp.subdivide(
+            {"descent": float(np.asarray(packed)[:n_orig, 7].sum()),
+             "polish": float(np.asarray(packed)[:n_orig, 8].sum())},
+            prefix="sweep/phase/")
+        obs.event("BUCKET_LAUNCH", bucket=0,
+                  cells=list(range(n_orig)), warm=False, wall_s=wall)
+        obs.histogram("aiyagari_sweep_bucket_wall_seconds",
+                      "per-bucket launch wall").observe(wall)
         # the single lock-step launch is bucket 0 of 1 to the seam protocol
         _resilience_seam(
             ledger,
@@ -1121,21 +1198,29 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
             warnings.warn(
                 f"sdc recheck: skipping ledger-restored cell(s) "
                 f"{sorted(skipped)} (warm seeds unknown, or the row is a "
-                f"serial quarantine outcome)", stacklevel=2)
+                f"serial quarantine outcome)", stacklevel=3)
             sample = np.asarray([i for i in sample
                                  if int(i) not in set(skipped)],
                                 dtype=np.int64)
-        suspects, recheck_wall = _sdc_recheck(
-            rows, crra, rho, sd, sample, seeds_used, fault_iters,
-            fault_mode, dtype, kwargs_items, device_call)
+        with obs.span("sweep/sdc_recheck", sampled=len(sample)) as rsp:
+            suspects, recheck_wall = _sdc_recheck(
+                rows, crra, rho, sd, sample, seeds_used, fault_iters,
+                fault_mode, dtype, kwargs_items, device_call)
+        rsp.annotate(wall_s=recheck_wall, suspects=len(suspects))
         sdc_suspected = np.zeros(n_orig, dtype=bool)
         sdc_suspected[suspects] = True
+        for i in suspects:
+            obs.event("SDC_SUSPECTED", cell=int(i),
+                      crra=float(crra[i]), rho=float(rho_label[i]),
+                      sd=float(sd[i]))
+        obs.counter("aiyagari_sweep_sdc_suspected_total",
+                    "bitwise recheck mismatches").inc(len(suspects))
         if suspects:
             warnings.warn(
                 "sdc recheck: bitwise mismatch for cell(s) "
                 + ", ".join(str(i) for i in suspects)
                 + " — silent data corruption suspected; routing through "
-                "the quarantine ladder", stacklevel=2)
+                "the quarantine ladder", stacklevel=3)
 
     r = rows[:, 0].copy()
     K = rows[:, 1].copy()
@@ -1189,13 +1274,18 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
     if quarantine and (failed.any() or restored_retry.any()):
         ladder = _retry_ladder(model_kwargs)[:max(0, int(max_retries))]
         for i in np.nonzero(failed)[0]:
+            status_before = int(status[i])
             for attempt, overrides in enumerate(ladder, start=1):
                 retries[i] = attempt
-                lean = device_call(
-                    f"quarantine retry cell {int(i)}",
-                    lambda: jax.block_until_ready(solve_calibration_lean(
-                        crra[i], rho[i], labor_sd=sd[i], dtype=dtype,
-                        **{**model_kwargs, **overrides})))
+                with obs.span("sweep/quarantine", cell=int(i),
+                              rung=attempt):
+                    lean = device_call(
+                        f"quarantine retry cell {int(i)}",
+                        lambda: jax.block_until_ready(
+                            solve_calibration_lean(
+                                crra[i], rho[i], labor_sd=sd[i],
+                                dtype=dtype,
+                                **{**model_kwargs, **overrides})))
                 cell_status = int(lean.status)
                 if not is_failure(cell_status):
                     r[i] = float(lean.r_star)
@@ -1209,6 +1299,14 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
                     escal[i] = int(lean.escalations)
                     status[i] = cell_status
                     break
+            obs.event("QUARANTINE", cell=int(i), crra=float(crra[i]),
+                      rho=float(rho_label[i]), sd=float(sd[i]),
+                      status_before=status_name(status_before),
+                      status_after=status_name(int(status[i])),
+                      recovered=not bool(is_failure(int(status[i]))),
+                      retries=int(retries[i]))
+            obs.counter("aiyagari_sweep_quarantined_cells_total",
+                        "cells routed through the retry ladder").inc()
             # quarantine seam: the outcome (recovered or exhausted) is
             # final for this run — same commit-then-poll protocol as the
             # launch seams
@@ -1231,7 +1329,7 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
                 + ", ".join(f"{int(i)} ({status_name(status[i])})"
                             for i in still)
                 + " failed every quarantine retry; their values are "
-                "NaN-masked in the SweepResult", stacklevel=2)
+                "NaN-masked in the SweepResult", stacklevel=3)
 
     # KNOWN-corrupt cells no retry recovered (or that had no ladder to
     # run) must not leak ANY field into the result or the sidecar work
@@ -1246,6 +1344,15 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
         for arr in (iters, egm_it, dist_it, desc_it, pol_it, escal):
             arr[purge] = 0
 
+    # Precision-ladder escalations (DESIGN §5) as journal events: the
+    # counter rode the packed row out of the jitted program; the journal
+    # line is where "which cell abandoned its cheap descent" becomes
+    # greppable next to the bucket that ran it.
+    for i in np.nonzero(escal > 0)[0]:
+        obs.event("PRECISION_ESCALATED", cell=int(i),
+                  crra=float(crra[i]), rho=float(rho_label[i]),
+                  sd=float(sd[i]), escalations=int(escal[i]))
+
     if sweep.sidecar_path is not None:
         # persist this run's counters/roots for the next run's scheduler
         # (work model + warm brackets); best-effort — an unwritable path
@@ -1259,7 +1366,7 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
                 descent_steps=desc_it, polish_steps=pol_it)
         except OSError as e:
             warnings.warn(f"could not write sweep sidecar "
-                          f"{sweep.sidecar_path!r}: {e}", stacklevel=2)
+                          f"{sweep.sidecar_path!r}: {e}", stacklevel=3)
 
     # -- a posteriori certification (DESIGN §9) -----------------------------
     # Runs on the FINAL values (quarantine outcomes included), outside
@@ -1279,13 +1386,24 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
              dist_it.astype(np.float64), status.astype(np.float64),
              desc_it.astype(np.float64), pol_it.astype(np.float64),
              escal.astype(np.float64)], axis=1)
-        certs = device_call(
-            "a posteriori certification",
-            lambda: certify_packed_rows(
-                final_rows, np.stack([crra, rho, np.asarray(sd)], axis=1),
-                dtype, kwargs_items, thresholds=cert_thresholds))
+        with obs.span("sweep/certify", cells=n_orig) as csp:
+            certs = device_call(
+                "a posteriori certification",
+                lambda: certify_packed_rows(
+                    final_rows,
+                    np.stack([crra, rho, np.asarray(sd)], axis=1),
+                    dtype, kwargs_items, thresholds=cert_thresholds))
         cert_level = np.asarray([c.level for c in certs], dtype=np.int64)
         certify_wall = time.perf_counter() - t0
+        csp.annotate(wall_s=certify_wall,
+                     failed=int((cert_level == 2).sum()))
+        for i in np.nonzero(cert_level == 2)[0]:
+            obs.event("CERT_FAILED", cell=int(i), crra=float(crra[i]),
+                      rho=float(rho_label[i]), sd=float(sd[i]),
+                      summary=certs[int(i)].summary())
+        obs.counter("aiyagari_sweep_cert_failed_total",
+                    "cells whose certificate graded FAILED").inc(
+            int((cert_level == 2).sum()))
 
     if ledger is not None:
         # the run completed: a finished ledger must not satisfy the next
@@ -1295,6 +1413,23 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
     # Host-side closed forms (firm.py identities in numpy — numpy, not jnp,
     # so nothing touches the device after the solve): demand from the
     # inverted marginal product of capital, Y from Cobb-Douglas, s = delta*K/Y.
+    # Mirror the run's counters into the metrics registry (ISSUE 7): the
+    # SweepResult dataclass keeps its API; the registry is where the
+    # same numbers become scrapeable/snapshot-able alongside serve's.
+    obs.counter("aiyagari_sweep_cells_total",
+                "cells solved by sweeps this run").inc(n_orig)
+    obs.counter("aiyagari_sweep_inner_steps_total",
+                "EGM + distribution inner steps").inc(
+        float((egm_it + dist_it).sum()))
+    obs.counter("aiyagari_sweep_quarantine_retries_total",
+                "quarantine ladder rungs consumed").inc(
+        int(retries.sum()))
+    obs.counter("aiyagari_sweep_precision_escalations_total",
+                "ladder descent->reference fallbacks").inc(
+        int(escal.sum()))
+    obs.gauge("aiyagari_sweep_wall_seconds",
+              "last sweep's honest batched wall").set(wall)
+
     alpha = model_kwargs.get("cap_share", 0.36)
     delta = model_kwargs.get("depr_fac", 0.08)
     prod = model_kwargs.get("prod", 1.0)
@@ -1314,3 +1449,8 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
         precision_escalations=escal, sdc_suspected=sdc_suspected,
         cert_level=cert_level, recheck_wall_seconds=recheck_wall,
         certify_wall_seconds=certify_wall)
+
+
+# The public wrapper carries the impl's full contract docstring (the
+# wrapper body is only the observability shell).
+run_table2_sweep.__doc__ = _run_table2_sweep_impl.__doc__

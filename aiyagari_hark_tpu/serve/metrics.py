@@ -174,6 +174,22 @@ class ServeMetrics:
     def _ms(value):
         return None if value is None else round(value * 1e3, 4)
 
+    def publish(self, registry) -> None:
+        """Mirror the snapshot into an ``obs.MetricsRegistry`` (ISSUE 7)
+        without changing this class's public API: every numeric
+        ``serve_*`` field becomes an ``aiyagari_``-prefixed gauge
+        (gauges, not counters — a snapshot is a level, and rates/
+        percentiles go down).  The ``EquilibriumService`` publishes on
+        ``close()`` when observability is enabled; callers wanting a
+        live scrape call this before ``registry.prometheus_text()``."""
+        if registry is None:
+            return
+        for name, value in self.snapshot().items():
+            if isinstance(value, bool) or not isinstance(value,
+                                                         (int, float)):
+                continue
+            registry.gauge(f"aiyagari_{name}").set(float(value))
+
     def snapshot(self) -> dict:
         """The serving record fields, bench-JSON ready (``serve_*``)."""
         with self._lock:

@@ -50,6 +50,7 @@ from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
 
+from ..obs.runtime import resolve_obs
 from ..solver_health import (
     DEADLINE_EXCEEDED,
     SolverDivergenceError,
@@ -261,11 +262,20 @@ class EquilibriumService:
                  metrics: Optional[ServeMetrics] = None,
                  certify_before_cache: bool = False,
                  cert_thresholds=None,
-                 inject_corrupt_lane: Optional[dict] = None):
+                 inject_corrupt_lane: Optional[dict] = None,
+                 obs=None):
+        # Observability (ISSUE 7, DESIGN §10): an ObsConfig builds a
+        # bundle owned (and closed) by this service; a shared Obs
+        # correlates serving with a caller's wider run.  The store
+        # adopts the same scope so eviction events land in one journal.
+        # NOTE: resolve BEFORE the store so a store built here sees it.
+        self._obs, self._obs_owned = resolve_obs(obs)
         self.store = (store if store is not None
                       else SolutionStore(capacity=capacity,
                                          disk_path=disk_path,
-                                         donor_cutoff=donor_cutoff))
+                                         donor_cutoff=donor_cutoff,
+                                         obs=self._obs))
+        self.store.attach_obs(self._obs)
         self.metrics = metrics if metrics is not None else ServeMetrics()
         self.metrics.attach_store(self.store.integrity_counts)
         self._certify = bool(certify_before_cache)
@@ -323,7 +333,10 @@ class EquilibriumService:
                 res = _result_from_row(
                     np.asarray(sol.packed), "hit", None, q.key(),
                     cert_level=None if lvl == UNCERTIFIED else lvl)
-                self.metrics.record_served("hit", self._clock() - t0)
+                latency = self._clock() - t0
+                self.metrics.record_served("hit", latency)
+                self._obs.record_span("serve/query", latency,
+                                      path="hit", cell=q.cell())
                 fut.set_result(res)
                 return fut
         expiry = None if deadline is None else t0 + float(deadline)
@@ -386,11 +399,28 @@ class EquilibriumService:
                     p.future.set_exception(DeadlineExceeded(
                         p.query.cell(), p.query.key(), now - p.t_submit))
                 self.metrics.record_expired(now - p.t_submit)
+                self._obs.event("DEADLINE_EXCEEDED",
+                                cell=p.query.cell(),
+                                key=p.query.key(),
+                                waited_s=now - p.t_submit)
+                self._obs.counter(
+                    "aiyagari_serve_deadline_expirations_total",
+                    "queries expired at a batch seam").inc()
             else:
                 live.append(p)
         return live
 
     def _launch(self, group, pendings) -> None:
+        # the batch worker is a different thread from whichever run
+        # built the obs bundle, and the active-scope stack is
+        # per-thread: re-activate this service's bundle here so deep
+        # seams without a threaded handle (``retry_transient`` backoffs)
+        # journal into THIS service's run, not the worker thread's
+        # (empty) scope
+        with self._obs.activate():
+            self._launch_impl(group, pendings)
+
+    def _launch_impl(self, group, pendings) -> None:
         """Solve one flushed batch: expire overdue deadlines, plan seeds,
         pad to the ladder shape, launch the shared executable, certify
         (``certify_before_cache``), scatter rows to futures.  Any
@@ -436,10 +466,20 @@ class EquilibriumService:
                              warm=True)
 
         try:
-            with self._launch_lock, self.metrics.compile:
+            with self._launch_lock, self.metrics.compile, \
+                    self._obs.span("serve/batch_flush", lanes=n,
+                                   shape=shape,
+                                   device_profile=True) as bsp:
                 packed = retry_transient(
                     lambda: np.asarray(fn(*args)), self._retry,
                     label=f"serve batch [{shape}]")
+                # phase split from the returned counters (no tracing
+                # inside jit): real lanes only — padding duplicates
+                # would double-count
+                bsp.subdivide(
+                    {"descent": float(packed[:n, 7].sum()),
+                     "polish": float(packed[:n, 8].sum())},
+                    prefix="serve/phase/")
         except BaseException as e:
             for p in pendings:
                 if not p.future.done():
@@ -515,6 +555,10 @@ class EquilibriumService:
                 p.future.set_exception(EquilibriumSolveFailed(
                     p.query.cell(), status, p.query.key()))
                 self.metrics.record_failure(now - p.t_submit)
+                self._obs.event("SOLVER_DIVERGED",
+                                cell=p.query.cell(),
+                                status=status_name(status),
+                                where="serve")
                 continue
             cert = certs[i]
             if cert is not None:
@@ -523,6 +567,11 @@ class EquilibriumService:
                     p.future.set_exception(CertificationFailed(
                         p.query.cell(), p.query.key(), cert))
                     self.metrics.record_failure(now - p.t_submit)
+                    self._obs.event("CERT_FAILED",
+                                    cell=p.query.cell(),
+                                    key=p.query.key(),
+                                    summary=cert.summary(),
+                                    where="serve")
                     continue
             lvl = None if cert is None else cert.level
             res = _result_from_row(row, path, seed, p.query.key(),
@@ -533,6 +582,8 @@ class EquilibriumService:
                     cert_level=UNCERTIFIED if lvl is None else lvl))
             p.future.set_result(res)
             self.metrics.record_served(path, now - p.t_submit)
+            self._obs.record_span("serve/query", now - p.t_submit,
+                                  path=path, cell=p.query.cell())
             self.metrics.record_phases(res.descent_steps, res.polish_steps,
                                        res.precision_escalations)
 
@@ -562,6 +613,8 @@ class EquilibriumService:
         count = 0
         try:
             if interrupt_requested():
+                self._obs.event("INTERRUPTED", what="equilibrium service",
+                                pending_batches=len(remaining))
                 raise Interrupted(
                     "equilibrium service interrupted; pending queries "
                     "failed at the batch seam")
@@ -626,6 +679,14 @@ class EquilibriumService:
         # belt-and-braces: nothing can be queued past the gate-serialized
         # close, but a stray entry must fail typed, never hang
         self._fail_pending(ServiceClosed("service closed"))
+        # observability run-end (ISSUE 7): mirror the metrics snapshot
+        # into the registry, then flush trace/journal iff this service
+        # owns the bundle (an ObsConfig was passed; a shared Obs belongs
+        # to the caller's wider run)
+        if self._obs.enabled:
+            self.metrics.publish(self._obs.registry)
+        if self._obs_owned:
+            self._obs.close()
 
     def __enter__(self) -> "EquilibriumService":
         return self

@@ -48,6 +48,7 @@ from typing import NamedTuple, Optional
 
 import numpy as np
 
+from ..obs.runtime import NULL_OBS, active_obs
 from ..solver_health import is_failure
 from ..utils.checkpoint import CORRUPT_NPZ_ERRORS, load_pytree, save_pytree
 from ..utils.config import PACKED_ROW_WIDTH
@@ -132,7 +133,7 @@ class SolutionStore:
 
     def __init__(self, capacity: int = 256,
                  disk_path: Optional[str] = None,
-                 donor_cutoff: float = float("inf")):
+                 donor_cutoff: float = float("inf"), obs=None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
@@ -147,6 +148,16 @@ class SolutionStore:
         self._mem: OrderedDict = OrderedDict()   # key -> StoredSolution
         self._meta: dict = {}                    # key -> _Meta
         self._corrupt_evictions = 0
+        # Eviction "log once" state is PER STORE INSTANCE (ISSUE 7
+        # satellite): the old pattern leaned on the warnings module's
+        # per-process dedup registry, so a second store over the same
+        # corrupt path — a restarted service in one process — degraded
+        # SILENTLY.  The machine-readable trail (journal event + counter
+        # + ``integrity_counts``) fires on EVERY eviction regardless.
+        self._evict_warned: set = set()
+        # the obs bundle must be adopted BEFORE the disk index loads:
+        # restart-time evictions are exactly the ones worth journaling
+        self._obs = obs if obs is not None else NULL_OBS
         if disk_path is not None:
             os.makedirs(disk_path, exist_ok=True)
             self._load_disk_index()
@@ -159,20 +170,57 @@ class SolutionStore:
         return os.path.join(self.disk_path,
                             f"sol_{int(key) & 0xFFFFFFFFFFFFFFFF:016x}.npz")
 
-    def _evict_corrupt(self, path: str, reason: str, key=None) -> None:
-        """One shared corrupt-entry eviction (DESIGN §9; lock held): log
-        ONCE with the entry key, forget it in both tiers, count it, and
-        DELETE the disk file — a corrupt file left behind would re-warn
-        and re-degrade on every restart, and must never be servable."""
+    def attach_obs(self, obs) -> None:
+        """Adopt a service's observability bundle (ISSUE 7) so eviction
+        events/counters land in ITS journal/registry.  First caller
+        wins — a store shared by two services keeps one run's scope —
+        and the active-scope fallback still covers a bare store used
+        inside someone else's run."""
+        if self._obs is NULL_OBS and obs is not None:
+            self._obs = obs
+
+    def _obs_scope(self):
+        return self._obs if self._obs is not NULL_OBS else active_obs()
+
+    def _record_eviction(self, reason: str, tier: str, path: str,
+                         key=None, message=None,
+                         stacklevel: int = 4) -> None:
+        """The machine-readable eviction trail (ISSUE 7 satellite; lock
+        held): journal event + registry counter on EVERY eviction, a
+        human warning once per (tier, key) per store instance.
+        ``stacklevel`` counts frames from the warn to the store's
+        caller: 4 via ``_evict_corrupt``, 3 for direct callers."""
         self._corrupt_evictions += 1
+        obs = self._obs_scope()
+        obs.event("STORE_EVICT_CORRUPT", tier=tier, reason=reason,
+                  key=None if key is None else int(key),
+                  file=os.path.basename(path) if path else None)
+        obs.counter("aiyagari_store_corrupt_evictions_total",
+                    "store entries evicted on failed verification").inc()
+        token = (tier, os.path.basename(path) if key is None
+                 else int(key))
+        if token in self._evict_warned:
+            return
+        self._evict_warned.add(token)
+        if message is None:
+            message = (
+                "solution store: evicting corrupt entry "
+                + (f"{int(key)} " if key is not None else "")
+                + f"({os.path.basename(path) if path else tier}): "
+                f"{reason}; the entry is deleted and the query will "
+                "re-solve")
+        warnings.warn(message, stacklevel=stacklevel)
+
+    def _evict_corrupt(self, path: str, reason: str, key=None) -> None:
+        """One shared corrupt-entry eviction (DESIGN §9; lock held):
+        journal + count + log (``_record_eviction``), forget the entry
+        in both tiers, and DELETE the disk file — a corrupt file left
+        behind would re-degrade on every restart, and must never be
+        servable."""
         if key is not None:
             self._mem.pop(int(key), None)
             self._meta.pop(int(key), None)
-        warnings.warn(
-            "solution store: evicting corrupt entry "
-            + (f"{int(key)} " if key is not None else "")
-            + f"({os.path.basename(path)}): {reason}; the entry is "
-            "deleted and the query will re-solve", stacklevel=3)
+        self._record_eviction(reason, "disk", path, key=key)
         try:
             os.remove(path)
         except OSError:
@@ -234,16 +282,18 @@ class SolutionStore:
                     # one transient memory flip into a permanent cache
                     # loss.  Fall through to the disk path below, which
                     # re-verifies (and evicts the file iff IT is bad).
-                    self._corrupt_evictions += 1
                     del self._mem[key]
                     meta = self._meta.get(key)
                     on_disk = meta is not None and meta.on_disk
-                    warnings.warn(
-                        f"solution store: entry {key} failed checksum "
-                        "verification in the memory tier; dropping the "
-                        "in-memory copy"
-                        + (" and retrying the disk tier" if on_disk
-                           else ""), stacklevel=2)
+                    self._record_eviction(
+                        "checksum mismatch", "memory", "", key=key,
+                        message=(
+                            f"solution store: entry {key} failed "
+                            "checksum verification in the memory tier; "
+                            "dropping the in-memory copy"
+                            + (" and retrying the disk tier" if on_disk
+                               else "")),
+                        stacklevel=3)
                     if not on_disk:
                         self._meta.pop(key, None)
                         return None

@@ -99,6 +99,65 @@ def atomic_write_json(path: str, obj, indent: int = 2,
                        suffix=".json.tmp")
 
 
+def append_jsonl(path: str, lines) -> None:
+    """Append-safe JSONL writer — the APPEND member of the atomic-writer
+    family (the ``atomic_write_*`` functions replace whole files; a
+    journal/bench record stream must instead grow without rewriting its
+    history on every event).
+
+    Each complete newline-terminated line is written with ONE
+    ``os.write`` to an ``O_APPEND`` descriptor: a kill between lines
+    loses nothing, a kill mid-write can tear at most the FINAL line —
+    which readers (``obs.journal.read_journal``,
+    ``utils.timing.read_records_jsonl``) detect as unparseable and
+    skip — and concurrent appenders (two processes journaling to one
+    file) never interleave bytes within a line.  Bare append-mode
+    ``open`` is banned by ``scripts/check_atomic_writes.py`` for the
+    same reason bare ``"w"`` is: a buffered handle flushes a long line
+    in chunks, and a SIGTERM between chunks tears mid-record."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        for line in lines:
+            if not line.endswith("\n"):
+                line += "\n"
+            os.write(fd, line.encode("utf-8"))
+    finally:
+        os.close(fd)
+
+
+def read_jsonl_tolerant(path: str) -> tuple:
+    """Read a JSONL stream back as ``(records, skipped)``, skipping
+    unparseable lines instead of raising — the reader half of
+    ``append_jsonl``'s crash contract, shared by
+    ``obs.journal.read_journal`` and ``utils.timing
+    .read_records_jsonl`` so the tear semantics live in ONE place.  The
+    writer's one crash artifact is a torn FINAL line (kill
+    mid-``os.write``); a file whose history must survive the preemption
+    it recorded cannot afford a fatal parse.  ``skipped`` > 0 is the
+    caller's cue to warn — a torn line anywhere but the tail means
+    external corruption and must not pass silently.
+
+    Read as binary, decoded per line: the writer always emits UTF-8
+    regardless of locale, and a line torn INSIDE a multibyte character
+    must count as one more skipped line, not raise ``UnicodeDecodeError``
+    before the parse attempt is even reached."""
+    import json
+
+    records, skipped = [], 0
+    with open(path, "rb") as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                records.append(json.loads(raw.decode("utf-8")))
+            except (ValueError, UnicodeDecodeError):
+                skipped += 1
+    return records, skipped
+
+
 def gc_orphaned_tmp(path: str, max_age_s: float = 3600.0) -> list:
     """Remove stale atomic-writer temp files next to ``path``.
 
@@ -322,6 +381,9 @@ def load_sweep_sidecar(path: str, fingerprint: int) -> SweepSidecar:
             f"{int(fingerprint)}; refusing a stale work model")
     want = side.content_checksum()
     if int(side.checksum) != int(want):
+        from ..obs.runtime import emit_event
+
+        emit_event("INTEGRITY_FAILED", boundary="sidecar", path=path)
         raise IntegrityError(
             f"sweep sidecar {path} failed content-checksum verification "
             f"(stored {int(side.checksum)}, content hashes to {want}) — "

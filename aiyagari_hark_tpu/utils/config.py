@@ -13,7 +13,9 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass
-from typing import Tuple
+from typing import Optional, Tuple
+
+from ..obs.runtime import ObsConfig
 
 # The reference's MgridBase (Aiyagari_Support.py:755-756): multiples of the
 # steady-state aggregate market resources at which the aggregate state is
@@ -312,7 +314,19 @@ class SweepConfig:
       solve (``verify.certify_equilibrium`` recompute path): Euler /
       stationarity / market-clearing / shape residuals against
       ``verify.CertThresholds`` for this configuration, recorded
-      per-cell in ``SweepResult.cert_level``."""
+      per-cell in ``SweepResult.cert_level``.
+
+    Observability knob (ISSUE 7, DESIGN §10):
+
+    * ``obs`` — an ``obs.ObsConfig``: run-scoped tracing spans
+      (per-bucket launches, quarantine rungs, recheck/certify),
+      metrics-registry mirrors of the sweep counters, and typed journal
+      events (BUCKET_LAUNCH, QUARANTINE, SDC_SUSPECTED, ...) correlated
+      by one ``run_id``.  None (default) disables with near-zero
+      overhead and changes ZERO solver bits; the
+      ``run_table2_sweep(obs=)`` argument overrides (pass a shared
+      ``obs.Obs`` bundle to correlate several subsystems under one
+      run)."""
 
     crra_values: Tuple[float, ...] = (1.0, 3.0, 5.0)
     rho_values: Tuple[float, ...] = (0.0, 0.3, 0.6, 0.9)
@@ -327,6 +341,7 @@ class SweepConfig:
     resume_path: str | None = None
     recheck_fraction: float = 0.0
     certify: bool = False
+    obs: Optional[ObsConfig] = None
 
     def replace(self, **kwargs) -> "SweepConfig":
         return dataclasses.replace(self, **kwargs)

@@ -91,6 +91,10 @@ def verify_packed_row(row, expected: int, boundary: str,
     matches ``expected`` (an int64 recorded at solve time)."""
     got = packed_row_checksum(row)
     if int(got) != int(expected):
+        from ..obs.runtime import emit_event
+
+        emit_event("INTEGRITY_FAILED", boundary=boundary,
+                   key=None if key is None else int(key))
         where = "" if key is None else f" (entry {int(key)})"
         raise IntegrityError(
             f"packed-row checksum mismatch at the {boundary} "

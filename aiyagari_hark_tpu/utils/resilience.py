@@ -55,6 +55,7 @@ from typing import Callable, NamedTuple, Optional
 
 import numpy as np
 
+from ..obs.runtime import emit_event
 from ..solver_health import INTERRUPTED, SolverDivergenceError
 from .config import PACKED_ROW_WIDTH
 from .checkpoint import (
@@ -140,6 +141,8 @@ def raise_if_interrupted(what: str, resume_path: Optional[str] = None,
         sig = _INTERRUPT["signum"]
         name = ("" if sig is None
                 else f" ({signal.Signals(sig).name})")
+        emit_event("INTERRUPTED", what=what, signum=sig,
+                   resume_path=resume_path, progress=progress or {})
         raise Interrupted(
             f"{what} interrupted at a safe boundary{name}"
             + (f"; resume from {resume_path}" if resume_path else ""),
@@ -381,6 +384,10 @@ def retry_transient(fn: Callable[[], object],
             if not classify(e) or attempt == attempts - 1:
                 raise
             d = policy.delay(attempt)
+            emit_event("RETRY_TRANSIENT", label=label,
+                       attempt=attempt + 1, max_attempts=attempts,
+                       delay_s=d,
+                       error=f"{type(e).__name__}: {str(e)[:160]}")
             warnings.warn(
                 f"transient fault in {label} (attempt {attempt + 1}/"
                 f"{attempts}): {type(e).__name__}: {str(e)[:200]} — "
@@ -495,6 +502,11 @@ class LedgerState:
         self.checksums = np.array(led.checksums)
         self._verify_rows()
         self.resumed = bool(self.solved.any() or self.retried.any())
+        if self.resumed:
+            emit_event("RESUME_RESTORE", path=path,
+                       cells_restored=int(self.solved.sum()),
+                       cells_retried=int(self.retried.sum()),
+                       corrupt_cells=list(self.corrupt_cells))
         return self
 
     def _verify_rows(self) -> None:
@@ -519,6 +531,8 @@ class LedgerState:
             self.bucket[i] = -1
             self.checksums[i] = 0
         self.corrupt_cells = bad
+        emit_event("INTEGRITY_FAILED", boundary="ledger",
+                   path=self.path, cells=bad)
         warnings.warn(
             f"sweep resume ledger {self.path}: row checksum verification "
             f"failed for cell(s) {bad} — silent corruption; those cells "

@@ -50,25 +50,51 @@ class PhaseTimer:
         return "\n".join(lines + [f"{'total':>12s}: {total:8.3f}s"])
 
 
-def write_records_jsonl(path: str, records: Iterable) -> None:
+def write_records_jsonl(path: str, records: Iterable,
+                        append: bool = False) -> None:
     """Persist iteration records (e.g. ``KSIterationRecord`` dataclasses or
     dicts) as JSON lines — the structured replacement for the reference's
-    ``verbose`` prints (``Aiyagari_Support.py:1954-1962``).  Written
-    crash-consistently (tmp + rename, ``checkpoint.atomic_write_text``):
-    a kill mid-write must not leave a half-record line."""
-    from .checkpoint import atomic_write_text
+    ``verbose`` prints (``Aiyagari_Support.py:1954-1962``).  Routed
+    through the ``utils.checkpoint`` writer family in BOTH modes
+    (ISSUE 7 satellite; ``scripts/check_atomic_writes.py`` bans bare
+    write- AND append-mode handles on artifact paths):
+
+    * ``append=False`` (default) — whole-file replace via
+      ``atomic_write_text`` (tmp + ``os.replace``): a kill mid-write
+      leaves the previous file, never a truncated hybrid.
+    * ``append=True`` — ``checkpoint.append_jsonl``: one ``os.write``
+      per complete line to an ``O_APPEND`` descriptor, so a growing
+      bench/iteration stream survives SIGTERM with at most a torn FINAL
+      line — which ``read_records_jsonl`` detects and skips."""
+    from .checkpoint import append_jsonl, atomic_write_text
 
     lines = []
     for rec in records:
         if dataclasses.is_dataclass(rec) and not isinstance(rec, type):
             rec = dataclasses.asdict(rec)
         lines.append(json.dumps(rec) + "\n")
-    atomic_write_text(path, "".join(lines))
+    if append:
+        append_jsonl(path, lines)
+    else:
+        atomic_write_text(path, "".join(lines))
 
 
 def read_records_jsonl(path: str):
-    with open(path) as f:
-        return [json.loads(line) for line in f if line.strip()]
+    """Read a records JSONL back, SKIPPING unparseable lines
+    (``checkpoint.read_jsonl_tolerant`` — the shared reader half of
+    ``append_jsonl``'s crash contract): a bench resuming after the
+    preemption it recorded must still read its own history.  Skips are
+    warned with a count, never silent."""
+    from .checkpoint import read_jsonl_tolerant
+
+    out, bad = read_jsonl_tolerant(path)
+    if bad:
+        import warnings
+
+        warnings.warn(
+            f"records jsonl {path}: skipped {bad} unparseable line(s) "
+            "(torn tail from a hard kill mid-append?)", stacklevel=2)
+    return out
 
 
 def model_flops(egm_iters: float, dist_iters: float, a_count: int,
@@ -231,6 +257,25 @@ class CompileCounter:
 
     def __exit__(self, *exc) -> None:
         _ACTIVE_COMPILE_COUNTERS.remove(self)
+
+    def publish(self, registry, prefix: str = "aiyagari_xla_") -> None:
+        """Mirror the totals into an ``obs.MetricsRegistry`` (ISSUE 7)
+        without changing this class's public API.  Counters in
+        Prometheus terms — but a CompileCounter is a window total that
+        can be re-published, so they land as gauges (levels), matching
+        ``ServeMetrics.publish``'s convention."""
+        if registry is None:
+            return
+        registry.gauge(prefix + "compile_events",
+                       "backend compile requests").set(self.compile_events)
+        registry.gauge(prefix + "compile_seconds",
+                       "backend compile wall").set(self.compile_seconds)
+        registry.gauge(prefix + "cache_misses",
+                       "programs compiled from scratch").set(
+            self.cache_misses)
+        registry.gauge(prefix + "cache_hits",
+                       "compilations served from the persistent "
+                       "cache").set(self.cache_hits)
 
 
 @contextlib.contextmanager

@@ -472,6 +472,8 @@ def certify_packed_rows(rows, cells, dtype, kwargs_items,
     ``Certificate``; a row whose solver status is already a failure
     certifies FAILED trivially (it is loudly NaN-masked upstream — the
     certificate records the verdict without wasting a recomputation)."""
+    from ..obs.runtime import active_span
+
     rows = np.asarray(rows, dtype=np.float64)
     cells = np.asarray(cells, dtype=np.float64)
     model_kwargs = dict(kwargs_items)
@@ -483,12 +485,17 @@ def certify_packed_rows(rows, cells, dtype, kwargs_items,
 
         idx = np.nonzero(healthy)[0]
         fn = _recompute_certifier(dtype, kwargs_items)
-        resids = np.asarray(fn(
-            jnp.asarray(cells[idx, 0], dtype=dtype),
-            jnp.asarray(cells[idx, 1], dtype=dtype),
-            jnp.asarray(cells[idx, 2], dtype=dtype),
-            jnp.asarray(rows[idx, 0], dtype=dtype),
-            jnp.asarray(rows[idx, 1], dtype=dtype)), dtype=np.float64)
+        # certification span on the ACTIVE obs scope (ISSUE 7): the
+        # sweep/serve callers own the cell-attributed verdict events;
+        # this span times the one vmapped recompute launch itself
+        with active_span("verify/certify_rows", rows=int(len(idx))):
+            resids = np.asarray(fn(
+                jnp.asarray(cells[idx, 0], dtype=dtype),
+                jnp.asarray(cells[idx, 1], dtype=dtype),
+                jnp.asarray(cells[idx, 2], dtype=dtype),
+                jnp.asarray(rows[idx, 0], dtype=dtype),
+                jnp.asarray(rows[idx, 1], dtype=dtype)),
+                dtype=np.float64)
         for j, i in enumerate(idx):
             out[int(i)] = thr.certificate(resids[j])
     for i in np.nonzero(~healthy)[0]:
@@ -526,6 +533,7 @@ def certify_equilibrium(result, crra=None, labor_ar=None, labor_sd=0.2,
     method knobs are deliberately ignored (independence).  ``thresholds``
     defaults to ``CertThresholds.for_solver`` of this configuration.
     """
+    from ..obs.runtime import active_span, emit_event
     from ..parallel.sweep import _canonical_dtype
 
     if crra is None or labor_ar is None:
@@ -539,29 +547,42 @@ def certify_equilibrium(result, crra=None, labor_ar=None, labor_sd=0.2,
     capital = (None if np.isscalar(result)
                else getattr(result, "capital", None))
 
+    def _graded(cert: Certificate) -> Certificate:
+        # verdict event on the active obs scope (ISSUE 7): the
+        # standalone certification API journals its own failures —
+        # sweep/serve batch paths attribute theirs at the call site
+        if cert.failed:
+            emit_event("CERT_FAILED",
+                       cell=(float(crra), float(labor_ar),
+                             float(labor_sd)),
+                       summary=cert.summary(), where="certify")
+        return cert
+
     if policy is not None and distribution is not None:
-        resids = _object_residuals(
-            float(np.asarray(r_star)), policy, distribution,
-            float(crra), float(labor_ar), float(labor_sd), dtype,
-            model_kwargs)
-        return thr.certificate(resids)
+        with active_span("verify/certify", form="objects"):
+            resids = _object_residuals(
+                float(np.asarray(r_star)), policy, distribution,
+                float(crra), float(labor_ar), float(labor_sd), dtype,
+                model_kwargs)
+        return _graded(thr.certificate(resids))
 
     import jax.numpy as jnp
 
     kwargs_items = hashable_kwargs(model_kwargs)
     fn = _recompute_certifier(dtype, kwargs_items)
     cap = r_star if capital is None else capital
-    resids = np.array(fn(
-        jnp.asarray([crra], dtype=dtype),
-        jnp.asarray([labor_ar], dtype=dtype),
-        jnp.asarray([labor_sd], dtype=dtype),
-        jnp.asarray([np.asarray(r_star)], dtype=dtype),
-        jnp.asarray([np.asarray(cap)], dtype=dtype)),
-        dtype=np.float64)[0]
+    with active_span("verify/certify", form="recompute"):
+        resids = np.array(fn(
+            jnp.asarray([crra], dtype=dtype),
+            jnp.asarray([labor_ar], dtype=dtype),
+            jnp.asarray([labor_sd], dtype=dtype),
+            jnp.asarray([np.asarray(r_star)], dtype=dtype),
+            jnp.asarray([np.asarray(cap)], dtype=dtype)),
+            dtype=np.float64)[0]
     if capital is None:
         # a bare r* has no capital claim to check: mirror the supply
         resids[CERT_CHECKS.index("capital")] = 0.0
-    return thr.certificate(resids)
+    return _graded(thr.certificate(resids))
 
 
 def _object_residuals(r_star, policy, distribution, crra, labor_ar,

@@ -1356,6 +1356,315 @@ def _integrity_drills():
     return injected, detected, detail
 
 
+# Observability smoke (ISSUE 7): trace/metrics/journal economics measured
+# on the committed-golden 12-cell configuration (obs-disabled results must
+# stay bit-identical to tests/data/table2_golden_test.json), event-drill
+# battery at smoke-test grid sizes (the contract is scale-independent).
+OBS_SMOKE_KWARGS = dict(a_count=24, dist_count=150)
+OBS_DRILL_KWARGS = dict(a_count=10, dist_count=32, labor_states=3,
+                        r_tol=1e-5, max_bisect=24)
+OBS_OVERHEAD_BUDGET = 0.02
+
+
+def _obs_smoke() -> dict:
+    """The ``--obs-smoke`` acceptance run (DESIGN §10): run the 12-cell
+    golden CPU sweep with tracing + metrics + journal on, assert the
+    Chrome trace loads (valid JSON, >0 complete events, sane span
+    nesting), the metrics snapshot round-trips and renders as Prometheus
+    text, measure ``obs_overhead_frac`` (enabled vs disabled wall,
+    acceptance < 2%), pin obs-disabled results bit-identical to the
+    committed goldens AND obs-enabled results bit-identical to disabled,
+    and re-run every injection drill with the journal enabled asserting
+    injected == recorded typed events."""
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    # CPU float64, like the integrity smoke: the golden cells are f64
+    # physics and the smoke runs standalone before any backend initializes.
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import jax.numpy as jnp
+
+    from aiyagari_hark_tpu.obs import (
+        MetricsRegistry,
+        ObsConfig,
+        build_obs,
+        read_journal,
+        trace_nesting_ok,
+    )
+    from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+    from aiyagari_hark_tpu.utils.config import SweepConfig
+    from aiyagari_hark_tpu.utils.timing import CompileCounter
+
+    backend = jax.default_backend()
+    kw = dict(OBS_SMOKE_KWARGS)
+    golden_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "tests", "data", "table2_golden_test.json")
+    with open(golden_path) as f:
+        golden = json.load(f)
+    assert golden["config"] == kw, "golden drifted from OBS_SMOKE_KWARGS"
+
+    # phase 1: warm-up (compiles the sweep executable; obs never changes
+    # the compiled program, so one warm-up serves both timed modes)
+    t0 = time.perf_counter()
+    run_table2_sweep(SweepConfig(), dtype=jnp.float64, **kw)
+    print(f"[bench] obs smoke: warm-up in {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+
+    with tempfile.TemporaryDirectory() as td:
+        # phases 2+3: timed obs-DISABLED vs obs-ENABLED runs, INTERLEAVED
+        # (off, on, off, on) so slow machine-wide drift — thermal
+        # throttling, a co-tenant waking up — lands on both modes instead
+        # of penalizing whichever ran later; best-of per mode then
+        # rejects the per-run spikes.  The last enabled run uses a bundle
+        # built here (shared, not owned) so the registry/journal/trace
+        # stay inspectable after the run closes.
+        trace_path = os.path.join(td, "trace.json")
+        journal_path = os.path.join(td, "events.jsonl")
+        obs = build_obs(ObsConfig(enabled=True, trace_path=trace_path,
+                                  journal_path=journal_path))
+        walls_off, walls_on, res_off, res_on = [], [], None, None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            res_off = run_table2_sweep(SweepConfig(), dtype=jnp.float64,
+                                       **kw)
+            walls_off.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            with CompileCounter() as cc:
+                res_on = run_table2_sweep(SweepConfig(),
+                                          dtype=jnp.float64, obs=obs,
+                                          **kw)
+            walls_on.append(time.perf_counter() - t0)
+        cc.publish(obs.registry)        # the CompileCounter mirror
+        obs.close()                     # flushes the Chrome trace
+
+        overhead = min(walls_on) / max(min(walls_off), 1e-9) - 1.0
+
+        # acceptance: bit-identity — obs-enabled vs disabled, and
+        # disabled vs the committed golden
+        on_off_identical = bool(
+            np.array_equal(res_on.r_star_pct, res_off.r_star_pct)
+            and np.array_equal(res_on.saving_rate_pct,
+                               res_off.saving_rate_pct)
+            and np.array_equal(res_on.status, res_off.status))
+        golden_r = np.asarray(golden["r_star_pct"], dtype=np.float64)
+        golden_identical = bool(
+            np.array_equal(np.asarray(res_off.r_star_pct), golden_r))
+        golden_max_diff = float(
+            np.max(np.abs(np.asarray(res_off.r_star_pct) - golden_r)))
+
+        # acceptance: the Chrome trace loads and nests sanely
+        with open(trace_path) as f:
+            trace = json.load(f)
+        events = trace["traceEvents"]
+        complete = [e for e in events if e.get("ph") == "X"]
+        nesting_ok = trace_nesting_ok(trace)
+        span_names = sorted({e["name"] for e in complete})
+
+        # acceptance: the metrics snapshot round-trips (and renders)
+        snap = obs.registry.snapshot()
+        roundtrip_ok = MetricsRegistry.restore(snap).snapshot() == snap
+        prom_text = obs.registry.prometheus_text()
+
+        journal = read_journal(journal_path, run_id=obs.run_id)
+
+    # phase 4: the event-contract drill battery, journal enabled
+    injected, detected, detail = _obs_drills()
+
+    record = {
+        "metric": "obs_smoke",
+        "backend": backend,
+        "obs_run_id": obs.run_id,
+        "obs_smoke_cells": len(golden_r),
+        # trace acceptance
+        "obs_trace_events": len(complete),
+        "obs_trace_loads": bool(len(complete) > 0),
+        "obs_trace_nesting_ok": bool(nesting_ok),
+        "obs_trace_span_names": span_names,
+        # metrics acceptance
+        "obs_metrics_count": len(snap),
+        "obs_snapshot_roundtrip": bool(roundtrip_ok),
+        "obs_prometheus_bytes": len(prom_text.encode()),
+        # journal
+        "obs_journal_events": len(journal),
+        # overhead acceptance: enabled-vs-disabled < 2%
+        "obs_wall_off_s": round(min(walls_off), 4),
+        "obs_wall_on_s": round(min(walls_on), 4),
+        "obs_overhead_frac": round(max(0.0, overhead), 4),
+        "obs_overhead_under_2pct": bool(overhead
+                                        < OBS_OVERHEAD_BUDGET),
+        # bit-identity acceptance
+        "obs_on_vs_off_bit_identical": on_off_identical,
+        "obs_golden_bit_identical": golden_identical,
+        "obs_golden_max_abs_diff": golden_max_diff,
+        # event-contract acceptance: injected == recorded, per drill
+        "obs_injected": injected,
+        "obs_detected": detected,
+        "obs_injection_detail": detail,
+    }
+    print(f"[bench] obs smoke: {len(complete)} trace events "
+          f"(nesting {'ok' if nesting_ok else 'BROKEN'}), "
+          f"{len(snap)} metrics (roundtrip "
+          f"{'ok' if roundtrip_ok else 'BROKEN'}), "
+          f"{len(journal)} journal events, overhead "
+          f"{100 * max(0.0, overhead):.2f}%, injected {injected} == "
+          f"detected {detected}", file=sys.stderr)
+    if injected != detected:
+        print("[bench] obs smoke: INJECTED != DETECTED — a lifecycle "
+              "seam failed to journal its event", file=sys.stderr)
+    return record
+
+
+def _obs_drills():
+    """The event-contract drill battery (tiny grids): every deterministic
+    injection the previous PRs built, re-run with the journal enabled;
+    each drill counts 1 iff exactly the matching typed event(s) landed.
+    Returns (injected, detected, per-drill detail)."""
+    import tempfile
+    import warnings as _warnings
+
+    from aiyagari_hark_tpu.obs import ObsConfig, build_obs, read_journal
+    from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+    from aiyagari_hark_tpu.serve import (
+        CertificationFailed,
+        EquilibriumService,
+        make_query,
+    )
+    from aiyagari_hark_tpu.utils.config import SweepConfig
+    from aiyagari_hark_tpu.utils.resilience import (
+        Interrupted,
+        RetryPolicy,
+        clear_interrupt,
+    )
+    from aiyagari_hark_tpu.verify import (
+        corrupt_ledger_row,
+        corrupt_store_entry,
+    )
+
+    kw = dict(OBS_DRILL_KWARGS)
+    cfg = SweepConfig(crra_values=(1.0, 3.0), rho_values=(0.3, 0.6))
+    detail = {}
+
+    def events(path, etype, run_id=None):
+        return read_journal(path, event=etype, run_id=run_id)
+
+    with tempfile.TemporaryDirectory() as td:
+        def jp(name):
+            return os.path.join(td, name + ".jsonl")
+
+        # drill 1: quarantined fault -> exactly one QUARANTINE
+        run_table2_sweep(cfg, obs=ObsConfig(enabled=True,
+                                            journal_path=jp("q")),
+                         inject_fault={"cell": 1, "at_iter": 1,
+                                       "mode": "nan"},
+                         max_retries=2, **kw)
+        q = events(jp("q"), "QUARANTINE")
+        detail["quarantine_fault"] = int(len(q) == 1
+                                         and q[0]["cell"] == 1)
+
+        # drill 2: SDC lane bit flip -> exactly one SDC_SUSPECTED
+        run_table2_sweep(cfg.replace(recheck_fraction=1.0),
+                         obs=ObsConfig(enabled=True,
+                                       journal_path=jp("sdc")),
+                         inject_sdc={"cell": 1, "bit": 24},
+                         quarantine=False, **kw)
+        s = events(jp("sdc"), "SDC_SUSPECTED")
+        detail["sdc_bit_flip"] = int(len(s) == 1 and s[0]["cell"] == 1)
+
+        # drill 3: transient device fault -> exactly one RETRY_TRANSIENT
+        run_table2_sweep(cfg, obs=ObsConfig(enabled=True,
+                                            journal_path=jp("t")),
+                         inject_transient={"at_call": 0, "times": 1},
+                         retry=RetryPolicy(sleep=lambda s: None), **kw)
+        detail["transient_fault"] = int(
+            len(events(jp("t"), "RETRY_TRANSIENT")) == 1)
+
+        # drills 4-6: preemption -> INTERRUPTED; corrupted ledger row ->
+        # INTEGRITY_FAILED on the resume that also journals RESUME_RESTORE
+        ledger = os.path.join(td, "ledger.npz")
+        try:
+            run_table2_sweep(cfg, resume_path=ledger,
+                             obs=ObsConfig(enabled=True,
+                                           journal_path=jp("pre")),
+                             inject_preempt={"after_bucket": 0,
+                                             "mode": "flag"}, **kw)
+            raise AssertionError("preemption injection did not fire")
+        except Interrupted:
+            clear_interrupt()
+        detail["preemption"] = int(
+            len(events(jp("pre"), "INTERRUPTED")) == 1)
+        corrupt_ledger_row(ledger, cell=1, bit=21)
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")
+            run_table2_sweep(cfg, resume_path=ledger,
+                             obs=ObsConfig(enabled=True,
+                                           journal_path=jp("res")),
+                             **kw)
+        integ = events(jp("res"), "INTEGRITY_FAILED")
+        detail["ledger_corruption"] = int(len(integ) == 1
+                                          and integ[0]["cells"] == [1])
+        detail["resume_restore"] = int(
+            len(events(jp("res"), "RESUME_RESTORE")) == 1)
+
+        # drill 7: expired deadline -> exactly one DEADLINE_EXCEEDED
+        t = [0.0]
+        svc = EquilibriumService(start_worker=False, max_batch=4,
+                                 ladder=(1, 2, 4), clock=lambda: t[0],
+                                 obs=ObsConfig(enabled=True,
+                                               journal_path=jp("dl")))
+        fut = svc.submit(make_query(3.0, 0.6, **kw), deadline=0.5)
+        t[0] = 1.0
+        svc.flush()
+        assert fut.exception(0) is not None
+        svc.close()
+        detail["serve_deadline"] = int(
+            len(events(jp("dl"), "DEADLINE_EXCEEDED")) == 1)
+
+        # drill 8: corrupt disk-store entry -> one STORE_EVICT_CORRUPT
+        store_dir = os.path.join(td, "store")
+        svc = EquilibriumService(start_worker=False, max_batch=4,
+                                 ladder=(1, 2, 4), disk_path=store_dir)
+        svc.query(3.0, 0.6, **kw)
+        svc.close()
+        corrupt_store_entry(store_dir, mode="perturb", amplitude=1e-3)
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")
+            svc = EquilibriumService(
+                start_worker=False, max_batch=4, ladder=(1, 2, 4),
+                disk_path=store_dir,
+                obs=ObsConfig(enabled=True, journal_path=jp("ev")))
+            svc.close()
+        detail["store_eviction"] = int(
+            len(events(jp("ev"), "STORE_EVICT_CORRUPT")) == 1)
+
+        # drill 9: serve-path lane corruption under certify_before_cache
+        # -> exactly one CERT_FAILED
+        svc = EquilibriumService(
+            start_worker=False, max_batch=4, ladder=(1, 2, 4),
+            certify_before_cache=True,
+            inject_corrupt_lane={"at_launch": 0, "lane": 0,
+                                 "amplitude": 3e-3},
+            obs=ObsConfig(enabled=True, journal_path=jp("cf")))
+        fut = svc.submit(make_query(3.0, 0.6, **kw))
+        svc.flush()
+        try:
+            fut.result(0)
+            cert_failed = False
+        except CertificationFailed:
+            cert_failed = True
+        svc.close()
+        detail["serve_cert_failure"] = int(
+            cert_failed and len(events(jp("cf"), "CERT_FAILED")) == 1)
+
+    injected = len(detail)
+    detected = int(sum(detail.values()))
+    return injected, detected, detail
+
+
 def main(argv=None):
     """CLI wrapper: the preemption-tolerant run layer (ISSUE 3) around the
     measurement body.  ``--resume PATH`` gives the headline sweep a
@@ -1366,7 +1675,10 @@ def main(argv=None):
     runs the (fast) serving acceptance instead of the full bench and
     emits the ``serve_*`` record (ISSUE 4); ``--integrity-smoke`` runs
     the solution-integrity acceptance (certification, recheck, corruption
-    drills) and emits the ``integrity_*`` record (ISSUE 6)."""
+    drills) and emits the ``integrity_*`` record (ISSUE 6);
+    ``--obs-smoke`` runs the observability acceptance (Chrome trace,
+    metrics snapshot, event-journal drills, disabled-overhead bound) and
+    emits the ``obs_*`` record (ISSUE 7)."""
     import argparse
 
     from aiyagari_hark_tpu.utils.resilience import (
@@ -1389,14 +1701,23 @@ def main(argv=None):
                          "golden certification, SDC recheck, corruption "
                          "drills) and emit the integrity_* record "
                          "instead of the full bench")
+    ap.add_argument("--obs-smoke", action="store_true",
+                    help="run the observability smoke (12-cell golden "
+                         "sweep traced+journaled: Chrome-trace/Perfetto "
+                         "export, metrics snapshot round-trip, "
+                         "injection-drill event contract, <2%% disabled "
+                         "overhead) and emit the obs_* record instead "
+                         "of the full bench")
     args = ap.parse_args(argv)
-    if args.serve_smoke or args.integrity_smoke:
+    if args.serve_smoke or args.integrity_smoke or args.obs_smoke:
         from aiyagari_hark_tpu.utils.backend import (
             enable_compilation_cache,
         )
 
         enable_compilation_cache()
-        smoke = _integrity_smoke if args.integrity_smoke else _serve_smoke
+        smoke = (_obs_smoke if args.obs_smoke
+                 else _integrity_smoke if args.integrity_smoke
+                 else _serve_smoke)
         try:
             with preemption_guard():
                 print(json.dumps(smoke()))

@@ -43,18 +43,23 @@ BLESSED = {os.path.join("aiyagari_hark_tpu", "utils", "checkpoint.py")}
 
 WAIVER = "# atomic-ok"
 
-# open(..., "w") / open(..., mode="w") in any spelling that truncates:
-# w, wt, wb, w+ ... — reads ("r") and appends ("a") are out of scope.
+# open(..., "w"/"a") / open(..., mode=...) in any spelling that truncates
+# OR appends: w, wt, wb, w+, a, ab, a+ ... — reads ("r") stay out of
+# scope.  Appends joined the ban with ISSUE 7: a buffered append handle
+# flushes a long record in chunks, so a SIGTERM between chunks tears
+# mid-line — the blessed ``utils.checkpoint.append_jsonl`` (one
+# ``os.write`` per complete line on an O_APPEND descriptor) is the
+# crash-safe spelling.
 # The path expression may contain arbitrary nesting (os.path.join(...),
 # self.path(), f-strings), so the lazy skip must admit parens — anchoring
-# on the mode LITERAL keeps it precise: a quote, 'w', optional b/t/+,
+# on the mode LITERAL keeps it precise: a quote, 'w'/'a', optional b/t/+,
 # closing quote cannot appear inside a normal path literal ("w.txt"
 # fails the closing-quote-after-mode-chars requirement).
 _OPEN_W = re.compile(
-    r"""\bopen\s*\(               # open(
-        [^#]*?                    # path expression (parens allowed)
-        (?:mode\s*=\s*)?          # optional mode=
-        (?P<q>['"])w[bt+]*(?P=q)  # a truncating mode literal
+    r"""\bopen\s*\(                  # open(
+        [^#]*?                       # path expression (parens allowed)
+        (?:mode\s*=\s*)?             # optional mode=
+        (?P<q>['"])[wa][bt+]*(?P=q)  # a truncating/appending mode literal
     """, re.VERBOSE)
 # np.savez/savez_compressed called on a PATH (a string/variable, not the
 # blessed writers' file-descriptor handle f).
@@ -73,9 +78,10 @@ def scan_file(path: str, rel: str) -> list:
             if _OPEN_W.search(line):
                 findings.append(
                     (rel, lineno,
-                     "bare write-mode open() — use "
-                     "utils.checkpoint.atomic_write_json/_text "
-                     "(or save_pytree), or waive with '# atomic-ok'"))
+                     "bare write/append-mode open() — use "
+                     "utils.checkpoint.atomic_write_json/_text, "
+                     "save_pytree, or append_jsonl, or waive with "
+                     "'# atomic-ok'"))
             elif _SAVEZ.search(line):
                 findings.append(
                     (rel, lineno,

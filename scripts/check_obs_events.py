@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Static lint: typed failures must leave a journal trail (ISSUE 7).
+
+The observability layer's event journal is only trustworthy if every
+lifecycle seam actually emits: a typed error raised without a journal
+event is a failure the machine-readable trail never saw — exactly the
+"read three artifacts and grep logs" hole the layer closed.  This lint
+keeps the event contract closed structurally:
+
+* every CONSTRUCTION of a typed framework error (``TYPED_ERRORS``:
+  ``SolverDivergenceError``/``EquilibriumSolveFailed``,
+  ``IntegrityError``, ``Interrupted``, ``CertificationFailed``,
+  ``DeadlineExceeded``) in the package or entry points — whether raised
+  directly or handed to ``Future.set_exception`` — must sit in a
+  function that also emits a journal event (a call named ``emit``,
+  ``emit_event``, or ``event``), or carry an explicit ``# obs-ok``
+  waiver comment stating why no event applies (e.g. the error CLASS
+  definitions themselves, a re-wrap of an already-journaled failure);
+* every quarantine/retry/evict seam function (``SEAM_DEFS``: the
+  store's ``_evict_corrupt`` eviction path, the resilience layer's
+  ``retry_transient``) must contain an emit call — these seams recover
+  instead of raising, so the error-construction rule cannot see them.
+
+Exception-class DEFINITIONS are exempt automatically (a ``class
+DeadlineExceeded`` body constructs nothing).  Run standalone (exits 1
+on findings) or via tier-1 (``tests/test_obs_lint.py``), so a seam
+added without its event cannot regress in.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Same scope policy as the sibling lints: the installable package plus
+# the entry points; scripts/ and tests/ are out of scope.
+SCAN_ROOTS = ("aiyagari_hark_tpu",)
+SCAN_FILES = ("bench.py", "reproduce.py")
+
+WAIVER = "# obs-ok"
+
+# Typed framework errors whose construction marks a lifecycle seam.
+TYPED_ERRORS = {
+    "SolverDivergenceError",
+    "EquilibriumSolveFailed",
+    "IntegrityError",
+    "Interrupted",
+    "CertificationFailed",
+    "DeadlineExceeded",
+}
+
+# Calls that count as journal-emission evidence in the enclosing
+# function: the module-level hook (``obs.runtime.emit_event``), a
+# bundle/journal method (``obs.event`` / ``journal.emit``), and the
+# store's emission wrapper (``_record_eviction`` — itself in SEAM_DEFS,
+# so its own emit cannot silently disappear).
+EMIT_NAMES = {"emit", "emit_event", "event", "_record_eviction"}
+
+# Recovering seams (no error escapes, so the construction rule cannot
+# see them) that must emit anyway: quarantine/retry/evict sites.  The
+# sweep's quarantine ladder is inline in ``_run_table2_sweep_impl`` —
+# listed here so stripping its QUARANTINE event is a lint failure too.
+SEAM_DEFS = {"_evict_corrupt", "_record_eviction", "retry_transient",
+             "_run_table2_sweep_impl"}
+
+
+def _call_name(node: ast.Call):
+    """Terminal name of a call target: ``f(...)`` -> "f",
+    ``a.b.f(...)`` -> "f"."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _function_ranges(tree: ast.AST):
+    spans = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            spans.append((node.lineno, node.end_lineno, node))
+    return spans
+
+
+def _enclosing(spans, lineno):
+    best = None
+    for start, end, node in spans:
+        if start <= lineno <= end:
+            if best is None or (end - start) < (best[1] - best[0]):
+                best = (start, end, node)
+    return best[2] if best is not None else None
+
+
+def _class_def_lines(tree: ast.AST) -> set:
+    """Line ranges of class bodies that DEFINE a typed error (or a
+    subclass thereof, by base name) — their ``super().__init__`` bodies
+    are the error's own plumbing, not an emission seam."""
+    lines = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        names = {node.name} | {
+            b.id for b in node.bases if isinstance(b, ast.Name)} | {
+            b.attr for b in node.bases if isinstance(b, ast.Attribute)}
+        if names & TYPED_ERRORS:
+            lines.update(range(node.lineno, (node.end_lineno or
+                                             node.lineno) + 1))
+    return lines
+
+
+def _has_emit_call(scope: ast.AST) -> bool:
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call) and _call_name(node) in EMIT_NAMES:
+            return True
+    return False
+
+
+def scan_source(src: str, rel: str) -> list:
+    """Findings for one file's source text (exposed for fixture tests)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [(rel, e.lineno or 0, f"unparseable: {e.msg}")]
+    lines = src.splitlines()
+    spans = _function_ranges(tree)
+    exempt = _class_def_lines(tree)
+    findings = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and _call_name(node) in TYPED_ERRORS):
+            continue
+        if node.lineno in exempt:
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if WAIVER in line:
+            continue
+        scope = _enclosing(spans, node.lineno)
+        if scope is not None and _has_emit_call(scope):
+            continue
+        where = scope.name if scope is not None else "<module>"
+        findings.append(
+            (rel, node.lineno,
+             f"typed error {_call_name(node)} constructed in {where}() "
+             "without a journal event — emit an obs event "
+             "(obs.event / emit_event) in this function, or waive with "
+             "'# obs-ok'"))
+    # recovering seams: the named functions must emit
+    for start, _end, fnode in spans:
+        if fnode.name not in SEAM_DEFS:
+            continue
+        def_line = lines[start - 1] if start <= len(lines) else ""
+        if WAIVER in def_line:
+            continue
+        if not _has_emit_call(fnode):
+            findings.append(
+                (rel, start,
+                 f"seam function {fnode.name}() (quarantine/retry/evict "
+                 "site) emits no journal event — add an obs event, or "
+                 "waive the def line with '# obs-ok'"))
+    return findings
+
+
+def scan_file(path: str, rel: str) -> list:
+    with open(path) as fh:
+        return scan_source(fh.read(), rel)
+
+
+def scan_targets(repo: str = REPO) -> list:
+    """Every file the lint covers (absolute paths) — exposed so the
+    lint's own test can pin coverage of the instrumented seams."""
+    targets = []
+    for root in SCAN_ROOTS:
+        for dirpath, _, names in os.walk(os.path.join(repo, root)):
+            if "__pycache__" in dirpath:
+                continue
+            targets += [os.path.join(dirpath, n) for n in sorted(names)
+                        if n.endswith(".py")]
+    targets += [os.path.join(repo, f) for f in SCAN_FILES]
+    return targets
+
+
+def scan(repo: str = REPO) -> list:
+    findings = []
+    for path in scan_targets(repo):
+        if os.path.exists(path):
+            findings += scan_file(path, os.path.relpath(path, repo))
+    return findings
+
+
+def main() -> int:
+    findings = scan()
+    for rel, lineno, msg in findings:
+        print(f"{rel}:{lineno}: {msg}")
+    if findings:
+        print(f"{len(findings)} unjournaled lifecycle seam(s); see "
+              f"scripts/check_obs_events.py docstring")
+        return 1
+    print("obs-event lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

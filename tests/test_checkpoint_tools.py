@@ -223,3 +223,57 @@ def test_check_atomic_writes_lint_catches_bare_write(tmp_path):
         'with open("w.txt") as f:\n    pass\n')
     findings = mod.scan_file(str(bad), "writer.py")
     assert [line for _, line, _ in findings] == [1, 3, 7, 9, 11]
+
+
+def test_check_atomic_writes_lint_catches_bare_append(tmp_path):
+    """ISSUE 7 satellite: append-mode handles joined the ban — a
+    buffered append flushes long records in chunks, so a SIGTERM
+    between chunks tears mid-line.  ``checkpoint.append_jsonl`` is the
+    blessed spelling; the JSONL record writers
+    (``utils.timing.write_records_jsonl``) route through it."""
+    mod, _ = _load_lint()
+    bad = tmp_path / "appender.py"
+    bad.write_text(
+        'with open(path, "a") as f:\n    f.write(line)\n'
+        'with open(p2, mode="ab") as f:\n    f.write(b"x")\n'
+        'with open(p3, "a") as f:  # atomic-ok\n    pass\n'
+        # read-mode and a-leading filenames must NOT fire
+        'with open("a.txt") as f:\n    pass\n')
+    findings = mod.scan_file(str(bad), "appender.py")
+    assert [line for _, line, _ in findings] == [1, 3]
+
+
+def test_check_atomic_writes_covers_timing_jsonl():
+    """ISSUE 7 satellite: the bench/iteration JSONL writer module is in
+    the lint's scope — pin it instead of trusting the walk."""
+    mod, repo = _load_lint()
+    rels = {os.path.relpath(t, repo).replace(os.sep, "/")
+            for t in mod.scan_targets(repo)}
+    assert "aiyagari_hark_tpu/utils/timing.py" in rels
+    assert "aiyagari_hark_tpu/obs/journal.py" in rels
+
+
+def test_append_jsonl_appends_whole_lines(tmp_path):
+    """The append-safe writer: grows the file without rewriting history,
+    newline-terminates every record, and a torn tail (simulated partial
+    final line) is skipped — not fatal — by the readers."""
+    import warnings
+
+    from aiyagari_hark_tpu.utils.checkpoint import append_jsonl
+    from aiyagari_hark_tpu.utils.timing import (
+        read_records_jsonl,
+        write_records_jsonl,
+    )
+
+    p = str(tmp_path / "records.jsonl")
+    write_records_jsonl(p, [{"i": 0}])
+    write_records_jsonl(p, [{"i": 1}, {"i": 2}], append=True)
+    append_jsonl(p, ['{"i": 3}'])
+    assert read_records_jsonl(p) == [{"i": i} for i in range(4)]
+    # torn tail: a hard kill mid-os.write leaves a partial last line
+    with open(p, "ab") as f:  # atomic-ok: test simulates the torn tail
+        f.write(b'{"i": 4, "part')
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert read_records_jsonl(p) == [{"i": i} for i in range(4)]
+    assert any("unparseable" in str(x.message) for x in w)

@@ -1,0 +1,601 @@
+"""Unified observability layer (ISSUE 7, DESIGN §10): trace spans,
+metrics registry, event journal — and THE event contract.
+
+Two halves:
+
+* unit tests of the three pillars — span nesting/export/subdivision,
+  registry typing/round-trip/Prometheus text, journal append/read/torn
+  tail — plus the no-op contract (ONE cached null context manager, zero
+  allocations on the disabled path);
+* the event CONTRACT, table-driven: every deterministic injection drill
+  the previous PRs built (quarantine fault, SDC bit flip, transient
+  fault, preemption, ledger corruption, deadline expiry, store
+  eviction, serve-path certification failure, precision escalation)
+  re-run with the journal enabled must yield EXACTLY the matching typed
+  event(s), with the right ``run_id``/cell attributes — and obs
+  disabled must change ZERO solver bits.
+
+Solver configs deliberately mirror ``tests/test_resilience.py`` (sweep
+drills) and ``tests/test_serve*.py`` (serve drills) so this module
+rides their warm jit caches instead of compiling its own programs.
+"""
+
+import json
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.obs import (
+    EVENT_TYPES,
+    EventJournal,
+    MetricsRegistry,
+    NULL_OBS,
+    NULL_SPAN_CM,
+    ObsConfig,
+    Tracer,
+    build_obs,
+    default_registry,
+    emit_event,
+    new_run_id,
+    read_journal,
+    reset_default_registry,
+    resolve_obs,
+    trace_nesting_ok,
+)
+from aiyagari_hark_tpu.utils.config import SweepConfig
+from aiyagari_hark_tpu.utils.resilience import RetryPolicy
+
+# Sweep drill config: SAME cache keys as tests/test_resilience.py.
+KW = dict(a_count=12, dist_count=48, labor_states=4, r_tol=1e-5,
+          max_bisect=30)
+SMALL = SweepConfig(crra_values=(1.0, 5.0), rho_values=(0.0, 0.9),
+                    schedule="balanced", n_buckets=2)
+# Lockstep shape for the resume/corruption drill — mirrors
+# tests/test_verify.py's SMALL so after_bucket=0 leaves every row solved
+# (the corrupted row must be one the ledger claims solved).
+LOCKSTEP = SweepConfig(crra_values=(1.0, 3.0), rho_values=(0.3, 0.6))
+# Serve drill config: SAME cache keys as tests/test_serve.py /
+# tests/test_serve_integrity.py.
+SERVE_KW = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-4,
+                max_bisect=16)
+CERT_KW = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-5,
+               max_bisect=24)
+
+
+# ---------------------------------------------------------------------------
+# Pillar 1: tracer.
+# ---------------------------------------------------------------------------
+
+def test_new_run_id_sortable_and_unique():
+    a, b = new_run_id(), new_run_id()
+    assert a != b
+    assert a.startswith("run-")
+    # filesystem- and grep-safe: no separators beyond '-'
+    assert all(c.isalnum() or c == "-" for c in a)
+
+
+def test_tracer_nested_spans_export_chrome_trace():
+    tr = Tracer(run_id="run-test")
+    with tr.span("outer", cells=4) as sp:
+        sp.annotate(extra="x")
+        with tr.span("inner"):
+            pass
+    trace = tr.chrome_trace()
+    events = trace["traceEvents"]
+    assert len(events) == 2
+    assert {e["name"] for e in events} == {"outer", "inner"}
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in events)
+    # the correlation contract: run_id on every event AND in metadata
+    assert all(e["args"]["run_id"] == "run-test" for e in events)
+    assert trace["metadata"]["run_id"] == "run-test"
+    outer = next(e for e in events if e["name"] == "outer")
+    assert outer["args"]["cells"] == 4 and outer["args"]["extra"] == "x"
+    assert trace_nesting_ok(trace)
+
+
+def test_span_subdivide_materializes_synthetic_children():
+    """Phase spans from returned counters (the jit-boundary answer):
+    subdivide partitions the parent wall proportionally, children are
+    marked synthetic and stay inside the parent."""
+    tr = Tracer()
+    with tr.span("bucket") as sp:
+        pass
+    sp.subdivide({"descent": 3.0, "polish": 1.0, "zero": 0.0},
+                 prefix="phase/")
+    events = tr.chrome_trace()["traceEvents"]
+    names = [e["name"] for e in events]
+    assert "phase/descent" in names and "phase/polish" in names
+    assert "phase/zero" not in names            # zero-weight parts dropped
+    parent = next(e for e in events if e["name"] == "bucket")
+    kids = [e for e in events if e["name"].startswith("phase/")]
+    assert all(e["args"]["synthetic"] for e in kids)
+    for e in kids:
+        assert e["ts"] >= parent["ts"] - 1e-6
+        assert (e["ts"] + e["dur"]
+                <= parent["ts"] + parent["dur"] + 1e-6)
+    d = next(e for e in kids if e["name"] == "phase/descent")
+    p = next(e for e in kids if e["name"] == "phase/polish")
+    assert d["dur"] == pytest.approx(3.0 * p["dur"], rel=0.05, abs=1e-3)
+    assert trace_nesting_ok(tr.chrome_trace())
+
+
+def test_tracer_is_thread_safe_with_per_thread_rows():
+    tr = Tracer()
+    # barrier keeps all four threads alive at once: thread idents are
+    # recycled after join, and concurrent threads are the case the
+    # per-thread tid rows exist for
+    gate = threading.Barrier(4)
+
+    def work():
+        with tr.span("t"):
+            with tr.span("u"):
+                gate.wait(timeout=10)
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    with tr.span("main"):
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    trace = tr.chrome_trace()
+    assert len(trace["traceEvents"]) == 9
+    assert len({e["tid"] for e in trace["traceEvents"]}) == 5
+    assert trace_nesting_ok(trace)
+
+
+def test_trace_nesting_ok_rejects_partial_overlap():
+    bad = {"traceEvents": [
+        {"name": "a", "ph": "X", "ts": 0.0, "dur": 10.0, "tid": 0},
+        {"name": "b", "ph": "X", "ts": 5.0, "dur": 10.0, "tid": 0}]}
+    assert not trace_nesting_ok(bad)
+    neg = {"traceEvents": [
+        {"name": "a", "ph": "X", "ts": 0.0, "dur": -1.0, "tid": 0}]}
+    assert not trace_nesting_ok(neg)
+
+
+def test_save_chrome_trace_is_atomic_and_loadable(tmp_path):
+    tr = Tracer()
+    with tr.span("a"):
+        pass
+    path = str(tmp_path / "trace.json")
+    tr.save_chrome_trace(path)
+    with open(path) as f:
+        trace = json.load(f)
+    assert len(trace["traceEvents"]) == 1
+    assert not list(tmp_path.glob("*.tmp"))     # atomic writer cleaned up
+
+
+# ---------------------------------------------------------------------------
+# Pillar 2: metrics registry.
+# ---------------------------------------------------------------------------
+
+def test_registry_instruments_record_and_type_check():
+    reg = MetricsRegistry()
+    c = reg.counter("aiyagari_test_total", "help text")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1.0)                             # counters never decrease
+    g = reg.gauge("aiyagari_test_depth")
+    g.set(7.0)
+    g.inc(-2.0)                                 # gauges may
+    assert g.value == 5.0
+    h = reg.histogram("aiyagari_test_seconds", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.count == 3 and h.sum == pytest.approx(5.55)
+    assert h.cumulative_counts() == [1, 2, 3]
+    # get-or-create: same name+kind returns the same instrument
+    assert reg.counter("aiyagari_test_total") is c
+    # same name, different kind: typed error, no silent shadowing
+    with pytest.raises(ValueError):
+        reg.gauge("aiyagari_test_total")
+    # non-Prometheus names are rejected at creation
+    with pytest.raises(ValueError):
+        reg.counter("bad name!")
+
+
+def test_registry_snapshot_roundtrip_and_prometheus_text():
+    reg = MetricsRegistry()
+    reg.counter("aiyagari_events_total", "events").inc(4)
+    reg.gauge("aiyagari_wall_seconds").set(1.25)
+    h = reg.histogram("aiyagari_lat_seconds", buckets=(0.001, 0.1))
+    h.observe(0.0005)
+    h.observe(0.05)
+    snap = reg.snapshot()
+    assert MetricsRegistry.restore(snap).snapshot() == snap
+    text = reg.prometheus_text()
+    assert "# TYPE aiyagari_events_total counter" in text
+    assert "aiyagari_events_total 4" in text
+    assert 'aiyagari_lat_seconds_bucket{le="+Inf"} 2' in text
+    assert "aiyagari_lat_seconds_count 2" in text
+    assert "# HELP aiyagari_events_total events" in text
+
+
+def test_default_registry_is_process_global_and_resettable():
+    reset_default_registry()
+    try:
+        a = default_registry()
+        assert default_registry() is a
+        a.counter("aiyagari_ambient_total").inc()
+        reset_default_registry()
+        assert default_registry() is not a
+    finally:
+        reset_default_registry()
+
+
+def test_compile_counter_publishes_into_registry():
+    from aiyagari_hark_tpu.utils.timing import CompileCounter
+
+    c = CompileCounter()
+    c.compile_events, c.compile_seconds = 3, 1.5
+    c.cache_hits, c.cache_misses = 2, 1
+    reg = MetricsRegistry()
+    c.publish(reg)
+    snap = reg.snapshot()
+    assert snap["aiyagari_xla_compile_events"]["value"] == 3
+    assert snap["aiyagari_xla_cache_misses"]["value"] == 1
+    c.publish(None)                             # tolerated no-op
+
+
+# ---------------------------------------------------------------------------
+# Pillar 3: event journal.
+# ---------------------------------------------------------------------------
+
+def test_journal_emits_typed_lines_and_reader_filters(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    j = EventJournal(path, "run-a", clock=lambda: 12.0)
+    j.emit("QUARANTINE", cell=3, crra=5.0)
+    j.emit("BUCKET_LAUNCH", bucket=0)
+    EventJournal(path, "run-b").emit("QUARANTINE", cell=1)
+    assert j.emitted == 2
+    recs = read_journal(path)
+    assert len(recs) == 3                       # appends never truncate
+    mine = read_journal(path, run_id="run-a")
+    assert [r["event"] for r in mine] == ["QUARANTINE", "BUCKET_LAUNCH"]
+    assert mine[0] == {"ts": 12.0, "run_id": "run-a",
+                       "event": "QUARANTINE", "cell": 3, "crra": 5.0}
+    q = read_journal(path, event="QUARANTINE")
+    assert {r["run_id"] for r in q} == {"run-a", "run-b"}
+
+
+def test_journal_rejects_unknown_event_type(tmp_path):
+    j = EventJournal(str(tmp_path / "e.jsonl"), "run-x")
+    with pytest.raises(ValueError, match="unknown journal event type"):
+        j.emit("TOTALLY_NEW_THING")
+    assert "QUARANTINE" in EVENT_TYPES          # vocabulary is exported
+
+
+def test_journal_torn_tail_skipped_with_warning(tmp_path):
+    path = str(tmp_path / "e.jsonl")
+    j = EventJournal(path, "run-a")
+    j.emit("RUN_START")
+    with open(path, "ab") as f:  # atomic-ok: test simulates the torn tail
+        f.write(b'{"ts": 1, "run_id": "run-a", "event": "RUN_')
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        recs = read_journal(path)
+    assert [r["event"] for r in recs] == ["RUN_START"]
+    assert any("unparseable" in str(x.message) for x in w)
+    assert read_journal(str(tmp_path / "missing.jsonl")) == []
+
+
+# ---------------------------------------------------------------------------
+# The runtime bundle: resolve/activate/no-op contracts.
+# ---------------------------------------------------------------------------
+
+def test_disabled_path_is_one_cached_null_context_manager():
+    """THE no-op contract (ISSUE 7 tentpole): disabled spans are ONE
+    process-wide nullcontext — no allocation, no clock read."""
+    assert NULL_OBS.span("sweep/bucket", bucket=1) is NULL_SPAN_CM
+    assert NULL_OBS.span("anything") is NULL_SPAN_CM
+    with NULL_OBS.span("x") as sp:
+        sp.annotate(a=1)                        # all mutators no-op
+        sp.subdivide({"descent": 3})
+    NULL_OBS.event("QUARANTINE", cell=1)        # journals nothing
+    NULL_OBS.counter("aiyagari_x_total").inc()  # records nothing
+    assert NULL_OBS.counter("aiyagari_x_total").value == 0.0
+    NULL_OBS.close()                            # idempotent no-op
+
+
+def test_build_and_resolve_obs_contract(tmp_path):
+    assert build_obs(None) is NULL_OBS
+    assert build_obs(ObsConfig(enabled=False)) is NULL_OBS
+    assert resolve_obs(None) == (NULL_OBS, False)
+    cfg = ObsConfig(enabled=True,
+                    journal_path=str(tmp_path / "j.jsonl"))
+    obs, owned = resolve_obs(cfg)
+    assert obs is not NULL_OBS and owned        # built here -> owned
+    passed, owned2 = resolve_obs(obs)
+    assert passed is obs and not owned2         # shared bundle -> not owned
+    with pytest.raises(TypeError):
+        resolve_obs("yes please")
+    obs.close()
+
+
+def test_run_lifecycle_events_and_idempotent_close(tmp_path):
+    jp = str(tmp_path / "j.jsonl")
+    tp = str(tmp_path / "t.json")
+    obs = build_obs(ObsConfig(enabled=True, run_id="run-lc",
+                              journal_path=jp, trace_path=tp))
+    with obs.span("work"):
+        pass
+    obs.close()
+    obs.close()                                 # second close: no-op
+    events = [r["event"] for r in read_journal(jp, run_id="run-lc")]
+    assert events == ["RUN_START", "RUN_END"]
+    with open(tp) as f:
+        trace = json.load(f)
+    assert trace["metadata"]["run_id"] == "run-lc"
+    assert len(trace["traceEvents"]) == 1
+
+
+def test_emit_event_without_active_scope_is_a_noop(tmp_path):
+    emit_event("QUARANTINE", cell=0)            # no scope: silently dropped
+    jp = str(tmp_path / "j.jsonl")
+    obs = build_obs(ObsConfig(enabled=True, journal_path=jp))
+    with obs.activate():
+        emit_event("QUARANTINE", cell=7)
+    emit_event("QUARANTINE", cell=8)            # deactivated again
+    cells = [r["cell"] for r in read_journal(jp, event="QUARANTINE")]
+    assert cells == [7]
+    obs.close()
+
+
+# ---------------------------------------------------------------------------
+# The event contract, table-driven: injected drill -> typed event(s).
+# ---------------------------------------------------------------------------
+
+def _sweep_journal(tmp_path, name, cfg=None, **kwargs):
+    """Run a SMALL sweep with the journal on; return (result, records,
+    run_id) with records filtered to this run."""
+    from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+
+    jp = str(tmp_path / f"{name}.jsonl")
+    res = run_table2_sweep(
+        SMALL if cfg is None else cfg,
+        obs=ObsConfig(enabled=True, journal_path=jp),
+        **{**KW, **kwargs})
+    recs = read_journal(jp)
+    run_ids = {r["run_id"] for r in recs}
+    assert len(run_ids) == 1                    # one run, one id
+    return res, recs, run_ids.pop()
+
+
+# One row per injection drill: (name, config, sweep kwargs, expected
+# event type, expected per-event attrs).  Each drill must yield EXACTLY
+# one matching event (the injected == recorded acceptance).
+SWEEP_DRILLS = [
+    ("quarantine_fault", SMALL,
+     dict(inject_fault={"cell": 1, "at_iter": 1, "mode": "nan"},
+          max_retries=2),
+     "QUARANTINE", {"cell": 1, "recovered": True}),
+    ("sdc_bit_flip", SMALL.replace(recheck_fraction=1.0),
+     dict(inject_sdc={"cell": 1, "bit": 30}, quarantine=False),
+     "SDC_SUSPECTED", {"cell": 1}),
+    ("transient_fault", SMALL,
+     dict(inject_transient={"at_call": 0, "times": 1},
+          retry=RetryPolicy(sleep=lambda s: None)),
+     "RETRY_TRANSIENT", {"attempt": 1}),
+]
+
+
+@pytest.mark.parametrize("name,cfg,kwargs,etype,attrs", SWEEP_DRILLS,
+                         ids=[d[0] for d in SWEEP_DRILLS])
+def test_injected_drill_yields_exactly_one_typed_event(
+        tmp_path, name, cfg, kwargs, etype, attrs):
+    res, recs, run_id = _sweep_journal(tmp_path, name, cfg=cfg, **kwargs)
+    matches = [r for r in recs if r["event"] == etype]
+    assert len(matches) == 1, (etype, recs)
+    for k, v in attrs.items():
+        assert matches[0][k] == v, (k, matches[0])
+    assert matches[0]["run_id"] == run_id
+    # the run's framing events always bracket the drill
+    events = [r["event"] for r in recs]
+    assert events[0] == "RUN_START" and events[-1] == "RUN_END"
+    assert events.count("BUCKET_LAUNCH") == 2   # n_buckets launches
+
+
+def test_clean_sweep_journals_only_lifecycle_events(tmp_path):
+    res, recs, _ = _sweep_journal(tmp_path, "clean")
+    assert not np.isnan(res.r_star_pct).any()
+    kinds = {r["event"] for r in recs}
+    assert kinds == {"RUN_START", "BUCKET_LAUNCH", "RUN_END"}
+    # bucket launches carry cell lists covering every cell exactly once
+    cells = sorted(c for r in recs if r["event"] == "BUCKET_LAUNCH"
+                   for c in r["cells"])
+    assert cells == list(range(4))
+
+
+def test_precision_escalation_journaled_per_cell(tmp_path):
+    """A stalled descent phase under the mixed ladder escalates
+    in-program (DESIGN §5) while the cell stays healthy — the journal
+    names each escalated cell.  (Mode "nan" would poison the lean
+    bisection's descent-only bracket trips too, routing through
+    quarantine instead — a different drill.)"""
+    res, recs, _ = _sweep_journal(tmp_path, "escalate",
+                                  precision="mixed",
+                                  descent_fault_iter=0,
+                                  descent_fault_mode="stall")
+    esc = [r for r in recs if r["event"] == "PRECISION_ESCALATED"]
+    expected = {int(i) for i in
+                np.nonzero(res.precision_escalations > 0)[0]}
+    assert expected                              # the drill fired
+    assert {r["cell"] for r in esc} == expected
+    assert len(esc) == len(expected)             # exactly one per cell
+
+
+def test_interrupt_resume_and_ledger_corruption_events(tmp_path):
+    """The resilience seams end-to-end: injected preemption journals
+    INTERRUPTED; the resumed run journals RESUME_RESTORE; a ledger row
+    corrupted between the two journals INTEGRITY_FAILED — each exactly
+    once, under that run's id."""
+    from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+    from aiyagari_hark_tpu.utils.resilience import (
+        Interrupted,
+        clear_interrupt,
+    )
+    from aiyagari_hark_tpu.verify import corrupt_ledger_row
+
+    ledger = str(tmp_path / "ledger.npz")
+    jp = str(tmp_path / "events.jsonl")
+    try:
+        with pytest.raises(Interrupted):
+            run_table2_sweep(
+                LOCKSTEP, resume_path=ledger,
+                obs=ObsConfig(enabled=True, journal_path=jp),
+                inject_preempt={"after_bucket": 0, "mode": "flag"},
+                **KW)
+    finally:
+        clear_interrupt()
+    first = read_journal(jp)
+    ints = [r for r in first if r["event"] == "INTERRUPTED"]
+    assert len(ints) == 1 and ints[0]["resume_path"] == ledger
+    # even the interrupted run closes its journal (owned bundle)
+    assert first[-1]["event"] == "RUN_END"
+
+    corrupt_ledger_row(ledger, cell=1, bit=21)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        resumed = run_table2_sweep(
+            LOCKSTEP, resume_path=ledger,
+            obs=ObsConfig(enabled=True, journal_path=jp), **KW)
+    run1 = ints[0]["run_id"]
+    second = [r for r in read_journal(jp) if r["run_id"] != run1]
+    integ = [r for r in second if r["event"] == "INTEGRITY_FAILED"]
+    assert len(integ) == 1
+    assert integ[0]["boundary"] == "ledger" and integ[0]["cells"] == [1]
+    restores = [r for r in second if r["event"] == "RESUME_RESTORE"]
+    assert len(restores) == 1
+    assert restores[0]["cells_restored"] >= 1
+    assert restores[0]["corrupt_cells"] == [1]
+    # and the recomputed result is still clean
+    clean = run_table2_sweep(LOCKSTEP, **KW)
+    assert np.array_equal(clean.r_star_pct, resumed.r_star_pct)
+
+
+def test_serve_deadline_and_metrics_mirror(tmp_path):
+    """Serve seams: an expired deadline journals DEADLINE_EXCEEDED and
+    counts in the registry; close() mirrors the ServeMetrics snapshot
+    into the same registry (one scrapeable view, ISSUE 7 tentpole)."""
+    from aiyagari_hark_tpu.serve import EquilibriumService, make_query
+
+    jp = str(tmp_path / "serve.jsonl")
+    obs = build_obs(ObsConfig(enabled=True, journal_path=jp))
+    t = [0.0]
+    svc = EquilibriumService(start_worker=False, max_batch=4,
+                             ladder=(1, 2, 4), clock=lambda: t[0],
+                             obs=obs)
+    expired = svc.submit(make_query(3.0, 0.6, **SERVE_KW), deadline=0.5)
+    t[0] = 1.0
+    svc.flush()
+    assert expired.done() and expired.exception(0) is not None
+    svc.close()
+    dead = read_journal(jp, event="DEADLINE_EXCEEDED",
+                        run_id=obs.run_id)
+    assert len(dead) == 1
+    assert dead[0]["waited_s"] == pytest.approx(1.0)
+    snap = obs.registry.snapshot()
+    assert snap["aiyagari_serve_deadline_expirations_total"][
+        "value"] == 1
+    # ServeMetrics mirrored on close without changing its own API
+    assert snap["aiyagari_serve_deadline_expirations"]["value"] == 1
+    obs.close()
+
+
+def test_store_corrupt_eviction_journaled(tmp_path):
+    """A corrupt disk entry discovered at restart journals exactly one
+    STORE_EVICT_CORRUPT (and the service-owned journal sees it even
+    though the store found it during init)."""
+    from aiyagari_hark_tpu.serve import EquilibriumService
+    from aiyagari_hark_tpu.verify import corrupt_store_entry
+
+    d = str(tmp_path / "store")
+    svc = EquilibriumService(start_worker=False, max_batch=4,
+                             ladder=(1, 2, 4), disk_path=d)
+    svc.query(3.0, 0.6, **SERVE_KW)
+    svc.close()
+    corrupt_store_entry(d, mode="perturb", amplitude=1e-3)
+    jp = str(tmp_path / "store.jsonl")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        svc2 = EquilibriumService(
+            start_worker=False, max_batch=4, ladder=(1, 2, 4),
+            disk_path=d, obs=ObsConfig(enabled=True, journal_path=jp))
+        svc2.close()
+    evs = read_journal(jp, event="STORE_EVICT_CORRUPT")
+    assert len(evs) == 1
+    assert evs[0]["tier"] == "disk"
+    assert evs[0]["reason"] == "checksum mismatch"
+
+
+def test_serve_certification_failure_journaled(tmp_path):
+    """certify_before_cache + injected lane corruption: the failed
+    future journals CERT_FAILED with the serve attribution."""
+    from aiyagari_hark_tpu.serve import (
+        CertificationFailed,
+        EquilibriumService,
+        make_query,
+    )
+
+    jp = str(tmp_path / "cert.jsonl")
+    svc = EquilibriumService(
+        start_worker=False, max_batch=4, ladder=(1, 2, 4),
+        certify_before_cache=True,
+        inject_corrupt_lane={"at_launch": 0, "lane": 0,
+                             "amplitude": 3e-3},
+        obs=ObsConfig(enabled=True, journal_path=jp))
+    fut = svc.submit(make_query(3.0, 0.6, **CERT_KW))
+    svc.flush()
+    with pytest.raises(CertificationFailed):
+        fut.result(0)
+    svc.close()
+    evs = read_journal(jp, event="CERT_FAILED")
+    assert len(evs) == 1 and evs[0]["where"] == "serve"
+    assert evs[0]["cell"][:2] == [3.0, 0.6]
+
+
+# ---------------------------------------------------------------------------
+# No-op mode: disabled obs changes ZERO solver bits.
+# ---------------------------------------------------------------------------
+
+def _assert_sweep_identical(a, b):
+    for f in ("r_star_pct", "saving_rate_pct", "capital", "excess"):
+        assert np.array_equal(np.asarray(getattr(a, f)),
+                              np.asarray(getattr(b, f)),
+                              equal_nan=True), f
+    for f in ("bisect_iters", "egm_iters", "dist_iters", "status",
+              "retries", "bucket", "descent_steps", "polish_steps",
+              "precision_escalations"):
+        assert np.array_equal(np.asarray(getattr(a, f)),
+                              np.asarray(getattr(b, f))), f
+
+
+def test_obs_enabled_vs_disabled_is_bit_identical(tmp_path):
+    """The acceptance pin: tracing + journaling a sweep changes no
+    solver bits — obs=None, ObsConfig(enabled=False) (on the config)
+    and a fully enabled bundle all produce the same SweepResult."""
+    from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+
+    base = run_table2_sweep(SMALL, **KW)
+    off = run_table2_sweep(SMALL.replace(obs=ObsConfig(enabled=False)),
+                           **KW)
+    on = run_table2_sweep(
+        SMALL, obs=ObsConfig(enabled=True,
+                             journal_path=str(tmp_path / "j.jsonl"),
+                             trace_path=str(tmp_path / "t.json")),
+        **KW)
+    _assert_sweep_identical(base, off)
+    _assert_sweep_identical(base, on)
+    # and the enabled run's trace actually materialized, nested sanely
+    with open(tmp_path / "t.json") as f:
+        trace = json.load(f)
+    assert trace_nesting_ok(trace)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "sweep/run" in names and "sweep/bucket" in names
+    # counter-derived synthetic children (reference precision: every
+    # inner step is polish, so only the polish child materializes)
+    assert "sweep/phase/polish" in names

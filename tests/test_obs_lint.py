@@ -1,0 +1,111 @@
+"""check_obs_events lint (ISSUE 7 satellite): every typed framework
+error construction and every quarantine/retry/evict seam must leave a
+journal trail (or carry an explicit ``# obs-ok`` waiver) — run in
+tier-1 so a seam added without its event cannot regress in, with
+fixture tests proving the lint actually fires on the patterns it
+guards."""
+
+import importlib.util
+import os
+
+
+def _load_lint():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "check_obs_events",
+        os.path.join(repo, "scripts", "check_obs_events.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod, repo
+
+
+def test_obs_event_lint_is_clean():
+    """The package and entry points contain no unjournaled typed-error
+    sites or silent seams — failing here, not in code review."""
+    mod, repo = _load_lint()
+    findings = mod.scan(repo)
+    assert findings == [], "\n".join(
+        f"{rel}:{line}: {msg}" for rel, line, msg in findings)
+
+
+def test_obs_event_lint_covers_instrumented_seams():
+    """Pin the walk's coverage of the modules that own lifecycle seams,
+    instead of trusting it silently."""
+    mod, repo = _load_lint()
+    rels = {os.path.relpath(t, repo).replace(os.sep, "/")
+            for t in mod.scan_targets(repo)}
+    for required in ("aiyagari_hark_tpu/utils/resilience.py",
+                     "aiyagari_hark_tpu/utils/fingerprint.py",
+                     "aiyagari_hark_tpu/serve/service.py",
+                     "aiyagari_hark_tpu/serve/store.py",
+                     "aiyagari_hark_tpu/parallel/sweep.py",
+                     "aiyagari_hark_tpu/models/ks_solver.py",
+                     "aiyagari_hark_tpu/facade.py",
+                     "bench.py"):
+        assert required in rels, required
+
+
+def test_lint_fires_on_unjournaled_typed_raise():
+    mod, _ = _load_lint()
+    findings = mod.scan_source(
+        "def solve(x):\n"
+        "    if x < 0:\n"
+        "        raise SolverDivergenceError('diverged', status=3)\n"
+        "    return x\n", "fake.py")
+    assert [(rel, line) for rel, line, _ in findings] == [("fake.py", 3)]
+
+
+def test_lint_fires_on_set_exception_construction():
+    """Typed errors handed to Future.set_exception (never ``raise``d)
+    are seams too — the serve path's DeadlineExceeded pattern."""
+    mod, _ = _load_lint()
+    findings = mod.scan_source(
+        "def expire(p):\n"
+        "    p.future.set_exception(DeadlineExceeded(p.cell, 0, 1.0))\n",
+        "fake.py")
+    assert [line for _, line, _ in findings] == [2]
+
+
+def test_lint_accepts_emitting_and_waived_sites():
+    mod, _ = _load_lint()
+    # emission evidence in the enclosing function
+    assert mod.scan_source(
+        "def expire(p, obs):\n"
+        "    obs.event('DEADLINE_EXCEEDED', cell=p.cell)\n"
+        "    p.future.set_exception(DeadlineExceeded(p.cell, 0, 1.0))\n",
+        "fake.py") == []
+    # module-level hook spelling
+    assert mod.scan_source(
+        "def verify(row):\n"
+        "    emit_event('INTEGRITY_FAILED', boundary='x')\n"
+        "    raise IntegrityError('bad bytes')\n", "fake.py") == []
+    # explicit waiver
+    assert mod.scan_source(
+        "def rewrap(e):\n"
+        "    raise IntegrityError(str(e))  # obs-ok: re-wrap, journaled"
+        " upstream\n", "fake.py") == []
+
+
+def test_lint_exempts_error_class_definitions():
+    """``class DeadlineExceeded(...)`` bodies construct nothing — the
+    definition (incl. subclasses of typed errors) is not a seam."""
+    mod, _ = _load_lint()
+    assert mod.scan_source(
+        "class DeadlineExceeded(ServeError):\n"
+        "    def __init__(self, cell):\n"
+        "        super().__init__(f'{cell} missed its deadline')\n",
+        "fake.py") == []
+
+
+def test_lint_fires_on_silent_seam_function():
+    """A SEAM_DEFS function (quarantine/retry/evict site) without any
+    emit call is a finding; with one, it is clean."""
+    mod, _ = _load_lint()
+    findings = mod.scan_source(
+        "def retry_transient(fn, policy):\n"
+        "    return fn()\n", "fake.py")
+    assert [line for _, line, _ in findings] == [1]
+    assert mod.scan_source(
+        "def retry_transient(fn, policy):\n"
+        "    emit_event('RETRY_TRANSIENT', label='x')\n"
+        "    return fn()\n", "fake.py") == []
