@@ -49,6 +49,13 @@ EVENT_TYPES = (
     "CERT_FAILED", "INTEGRITY_FAILED",
     # serving (serve.service / serve.store)
     "STORE_EVICT_CORRUPT", "DEADLINE_EXCEEDED",
+    # serving overload layer (ISSUE 8, serve.service / serve.overload):
+    # fail-fast admission rejection, priority displacement of a queued
+    # pending, a degraded nearest-neighbor answer, breaker transitions
+    # (OPEN covers reopen-after-failed-probe), the half-open probe
+    # admission, and each fast-fail on an already-open breaker
+    "OVERLOADED", "LOAD_SHED", "DEGRADED_ANSWER",
+    "CIRCUIT_OPEN", "CIRCUIT_PROBE", "CIRCUIT_CLOSE", "CIRCUIT_REJECT",
     # typed solver divergence escaping to a caller (models, facade)
     "SOLVER_DIVERGED",
 )

@@ -10,13 +10,31 @@ shapes shared with the sweep's compiled cell solver.
 """
 
 from .batcher import MicroBatcher, ServeQueueFull, default_ladder  # noqa: F401
+from .loadgen import (  # noqa: F401
+    Arrival,
+    LoadReport,
+    LoadSpec,
+    ManualClock,
+    generate_arrivals,
+    run_load,
+)
 from .metrics import ServeMetrics  # noqa: F401
+from .overload import (  # noqa: F401
+    AdmissionPolicy,
+    CircuitBreaker,
+    Priority,
+    predicted_work,
+    priority_name,
+)
 from .service import (  # noqa: F401
     CertificationFailed,
+    CircuitOpen,
     DeadlineExceeded,
     EquilibriumQuery,
     EquilibriumService,
     EquilibriumSolveFailed,
+    LoadShed,
+    Overloaded,
     ServedResult,
     ServeError,
     ServiceClosed,

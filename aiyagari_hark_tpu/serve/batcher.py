@@ -31,7 +31,21 @@ from typing import Hashable, List, Optional, Tuple
 
 class ServeQueueFull(RuntimeError):
     """The bounded request queue is at capacity and the caller asked not
-    to block (or timed out blocking)."""
+    to block (or timed out blocking).
+
+    Carries the queue state at rejection time so callers can implement
+    retry-after (ISSUE 8 satellite): ``depth`` (queued requests),
+    ``max_queue`` (the bound), and ``oldest_wait_s`` (how long the
+    oldest queued request has waited, in injected-clock units — a proxy
+    for drain speed; None on an empty queue)."""
+
+    def __init__(self, message: str, depth: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 oldest_wait_s: Optional[float] = None):
+        super().__init__(message)
+        self.depth = depth
+        self.max_queue = max_queue
+        self.oldest_wait_s = oldest_wait_s
 
 
 def default_ladder(max_batch: int) -> Tuple[int, ...]:
@@ -56,7 +70,7 @@ class MicroBatcher:
     def __init__(self, max_batch: int = 8, max_wait_s: float = 0.002,
                  max_queue: int = 1024,
                  ladder: Optional[Tuple[int, ...]] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, priority_of=None):
         self.ladder = (default_ladder(max_batch) if ladder is None
                        else tuple(sorted(set(int(s) for s in ladder))))
         if not self.ladder or self.ladder[0] < 1:
@@ -70,6 +84,9 @@ class MicroBatcher:
         self.max_wait_s = float(max_wait_s)
         self.max_queue = int(max_queue)
         self.clock = clock
+        # item -> priority class (int; LOWER = more important) for
+        # ``shed_lowest``; None disables shedding (items stay opaque)
+        self._priority_of = priority_of
         self._cond = threading.Condition()
         self._groups: dict = {}     # group -> list of (item, t_enqueued)
         self._depth = 0
@@ -85,27 +102,107 @@ class MicroBatcher:
         with self._cond:
             return self._depth
 
+    def _oldest_wait(self, now: float) -> Optional[float]:
+        """Wait of the oldest queued request in clock units (lock held)."""
+        oldest = [entries[0][1] for entries in self._groups.values()
+                  if entries]
+        if not oldest:
+            return None
+        return now - min(oldest)
+
+    def _full_error(self, message: str) -> ServeQueueFull:
+        """A payload-carrying ``ServeQueueFull`` (lock held)."""
+        now = self.clock()
+        return ServeQueueFull(message, depth=self._depth,
+                              max_queue=self.max_queue,
+                              oldest_wait_s=self._oldest_wait(now))
+
     def offer(self, group: Hashable, item, block: bool = True,
               timeout: Optional[float] = None) -> None:
         """Enqueue one request.  At capacity: block (optionally up to
-        ``timeout`` seconds of real time) or raise ``ServeQueueFull``."""
-        deadline = (None if timeout is None
-                    else time.monotonic() + timeout)
+        ``timeout``) or raise ``ServeQueueFull`` (carrying depth /
+        max_queue / oldest-wait so callers can retry-after).
+
+        The block timeout is measured on the INJECTED clock (ISSUE 8
+        satellite) so backpressure is property-testable with a fake
+        clock — advance the clock past the timeout and ``kick()`` to
+        wake the blocked caller deterministically.  An equal real-time
+        backstop still bounds the wait when the injected clock is the
+        real one (they coincide) or has stalled (a fake clock nobody
+        advances must not block a caller forever)."""
+        t0 = self.clock()
+        real_deadline = (None if timeout is None
+                         else time.monotonic() + timeout)
         with self._cond:
             while self._depth >= self.max_queue:
                 if not block:
-                    raise ServeQueueFull(
+                    raise self._full_error(
                         f"serving queue at capacity ({self.max_queue})")
-                remaining = (None if deadline is None
-                             else deadline - time.monotonic())
-                if remaining is not None and remaining <= 0:
-                    raise ServeQueueFull(
-                        f"serving queue still at capacity "
-                        f"({self.max_queue}) after {timeout:g}s")
-                self._cond.wait(remaining)
+                if timeout is not None:
+                    clock_left = timeout - (self.clock() - t0)
+                    real_left = real_deadline - time.monotonic()
+                    if clock_left <= 0 or real_left <= 0:
+                        raise self._full_error(
+                            f"serving queue still at capacity "
+                            f"({self.max_queue}) after {timeout:g}s")
+                    self._cond.wait(min(clock_left, real_left))
+                else:
+                    self._cond.wait(None)
             self._groups.setdefault(group, []).append((item, self.clock()))
             self._depth += 1
             self._cond.notify_all()
+
+    def kick(self) -> None:
+        """Wake every blocked ``offer``/``wait_ready`` so it re-reads the
+        injected clock — pair with fake-clock advances in tests and the
+        load harness."""
+        with self._cond:
+            self._cond.notify_all()
+
+    def shed_lowest(self, max_class: Optional[int] = None):
+        """Remove and return the single most-sheddable queued request as
+        ``(group, item)``: the one in the numerically-HIGHEST (least
+        important) priority class, youngest within the class — shedding
+        the youngest wastes the least accumulated waiting (ISSUE 8 shed
+        ordering).  Only items whose class is STRICTLY greater than
+        ``max_class`` (the displacing arrival's class) are eligible.
+        None when nothing is sheddable or no ``priority_of`` was given."""
+        if self._priority_of is None:
+            return None
+        with self._cond:
+            best = None          # ((class, t_enqueued), group, index)
+            for group, entries in self._groups.items():
+                for idx, (item, t) in enumerate(entries):
+                    c = int(self._priority_of(item))
+                    if max_class is not None and c <= int(max_class):
+                        continue
+                    key = (c, t)
+                    if best is None or key > best[0]:
+                        best = (key, group, idx)
+            if best is None:
+                return None
+            _, group, idx = best
+            item, _t = self._groups[group].pop(idx)
+            if not self._groups[group]:
+                del self._groups[group]
+            self._depth -= 1
+            self._cond.notify_all()
+            return group, item
+
+    def ready(self, now: Optional[float] = None) -> bool:
+        """True iff ``pop_ready(now)`` would release at least one batch
+        (a full group, or an oldest request past ``max_wait_s``) —
+        non-destructive, for harnesses scheduling around the batcher."""
+        if now is None:
+            now = self.clock()
+        with self._cond:
+            for entries in self._groups.values():
+                if len(entries) >= self.max_batch:
+                    return True
+                # same boundary arithmetic as pop_ready/next_deadline
+                if entries and now >= entries[0][1] + self.max_wait_s:
+                    return True
+        return False
 
     def _pop_from(self, group: Hashable, n: int) -> list:
         entries = self._groups[group]
@@ -132,7 +229,12 @@ class MicroBatcher:
                     out.append((group, self._pop_from(group,
                                                       self.max_batch)))
                 entries = self._groups.get(group)
-                if entries and now - entries[0][1] >= self.max_wait_s:
+                # due test in the SAME arithmetic next_deadline()
+                # reports (oldest + max_wait_s): ``now - oldest >=
+                # max_wait_s`` can round the other way at the boundary,
+                # leaving a caller who advanced exactly to the reported
+                # deadline spinning on a never-due batch
+                if entries and now >= entries[0][1] + self.max_wait_s:
                     out.append((group, self._pop_from(group,
                                                       self.max_batch)))
         return out

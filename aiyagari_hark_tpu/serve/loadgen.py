@@ -1,0 +1,281 @@
+"""Deterministic open-loop load harness for the serving engine (ISSUE 8,
+DESIGN §11).
+
+Nothing in the repo could *generate* overload before this module: the
+serve tests exercise saturation with hand-placed submits, and the bench
+smoke replays fixed cell lists.  The harness closes that gap with a
+seeded, replayable traffic model driven ENTIRELY by the injectable
+clock:
+
+* **open-loop arrivals** — inter-arrival times drawn from a seeded
+  exponential stream at ``rate`` arrivals per clock second; an open
+  loop keeps submitting on schedule regardless of how far the service
+  has fallen behind (the regime where admission control earns its keep
+  — a closed loop self-throttles and can never overload anything).
+* **Zipf-mixed cells** — query popularity over the lattice follows a
+  Zipf(``zipf_s``) rank distribution (the ROADMAP's
+  millions-of-users traffic model): a few hot calibrations dominate
+  (exact hits must stay µs), with a long cold tail.
+* **mixed classes** — priorities, per-query deadlines, and
+  ``degraded_ok`` consent drawn from seeded mixes, so every typed
+  overload outcome is reachable in one run.
+* **modeled service time** — the service runs in manual (no-worker)
+  mode on a ``ManualClock``; each launched batch occupies the modeled
+  server for ``batch_service_s`` clock units, so "capacity" is exactly
+  ``max_batch / batch_service_s`` cold queries per clock second and a
+  ``rate`` above it genuinely overloads the queue.  All admission
+  decisions read the same clock (pin ``AdmissionPolicy.est_batch_s``
+  for bit-reproducible decisions), which makes an entire overload run
+  REPLAYABLE: same spec + same seed ⇒ the same per-arrival outcome
+  sequence, fingerprinted in ``LoadReport.digest``.
+
+The report records what the acceptance criteria need: every arrival's
+typed outcome (zero unresolved futures is an invariant, checked), p50/
+p99 clock latency per serving path, shed/reject/degrade counts, queue-
+depth percentiles, and the breaker transition timeline.
+
+No jax imports at module scope; solves happen inside the service.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from typing import List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from .service import EquilibriumService, ServeError, make_query
+
+
+class ManualClock:
+    """The harness's injectable clock: a plain float the event loop
+    advances.  Also handy as the deterministic fake clock in tests."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += float(dt)
+
+
+class Arrival(NamedTuple):
+    """One scheduled query of the open-loop trace."""
+
+    t: float
+    cell: Tuple[float, float, float]
+    priority: int
+    deadline: Optional[float]
+    degraded_ok: bool
+
+
+class LoadSpec(NamedTuple):
+    """One replayable load scenario (everything the digest covers).
+
+    ``cells`` is the query lattice in Zipf *rank order* (index 0 is the
+    hottest); ``model_kwargs`` the solver configuration every query
+    shares; ``warm_frac`` pre-solves the hottest fraction of the
+    lattice into the store before the clock starts, so exact hits and
+    degraded-answer donors exist.  Capacity is
+    ``max_batch / batch_service_s`` cold queries per clock second —
+    pick ``rate`` relative to it."""
+
+    cells: Tuple[Tuple[float, float, float], ...]
+    model_kwargs: dict
+    n_queries: int = 200
+    seed: int = 0
+    rate: float = 400.0
+    zipf_s: float = 1.1
+    priority_mix: Tuple[float, float, float] = (0.6, 0.3, 0.1)
+    deadline_frac: float = 0.0
+    deadline_s: float = 0.05
+    degraded_frac: float = 0.0
+    batch_service_s: float = 0.01
+    warm_frac: float = 0.0
+
+
+class LoadReport(NamedTuple):
+    """One load run's record (see ``run_load``)."""
+
+    arrivals: int
+    outcomes: List[str]         # per arrival, in submission order
+    counts: dict                # outcome -> count
+    digest: str                 # fingerprint of the outcome sequence
+    unresolved: int             # futures left unresolved (MUST be 0)
+    p50_ms: dict                # clock-unit latency p50 per path
+    p99_ms: dict                # clock-unit latency p99 per path
+    queue_depth_p50: Optional[float]
+    queue_depth_p99: Optional[float]
+    queue_depth_peak: int
+    breaker_transitions: List[tuple]
+    hit_wall_ms: List[float]    # REAL-time exact-hit submit latencies
+    snapshot: dict              # full ServeMetrics snapshot
+
+
+def generate_arrivals(spec: LoadSpec) -> List[Arrival]:
+    """The seeded open-loop trace: deterministic for a given spec (one
+    ``default_rng(seed)`` stream drawn in a fixed order)."""
+    if not spec.cells:
+        raise ValueError("LoadSpec.cells must be non-empty")
+    rng = np.random.default_rng(spec.seed)
+    n_cells = len(spec.cells)
+    ranks = np.arange(1, n_cells + 1, dtype=np.float64)
+    p = ranks ** -float(spec.zipf_s)
+    p /= p.sum()
+    mix = np.asarray(spec.priority_mix, dtype=np.float64)
+    mix = mix / mix.sum()
+    out = []
+    t = 0.0
+    for _ in range(int(spec.n_queries)):
+        t += float(rng.exponential(1.0 / spec.rate))
+        cell = spec.cells[int(rng.choice(n_cells, p=p))]
+        priority = int(rng.choice(len(mix), p=mix))
+        deadline = (float(spec.deadline_s)
+                    if rng.random() < spec.deadline_frac else None)
+        degraded_ok = bool(rng.random() < spec.degraded_frac)
+        out.append(Arrival(t=t, cell=tuple(float(c) for c in cell),
+                           priority=priority, deadline=deadline,
+                           degraded_ok=degraded_ok))
+    return out
+
+
+def _drain(svc: EquilibriumService, clk: ManualClock, busy_until: float,
+           until: Optional[float], service_s: float) -> float:
+    """Advance the modeled server up to ``until`` (None = run the queue
+    dry): whenever the server is free and a batch is due, jump the
+    clock there, pump, and occupy the server for ``launches x
+    service_s``.  Returns the new busy-until instant."""
+    for _ in range(1_000_000):
+        if svc.batcher.depth() == 0:
+            break
+        t_free = max(clk.t, busy_until)
+        if svc.batcher.ready(t_free):
+            start = t_free
+        else:
+            nd = svc.batcher.next_deadline()
+            if nd is None:
+                break
+            start = max(t_free, nd)
+        if until is not None and start > until:
+            break
+        clk.t = start
+        launched = svc.pump()
+        if launched == 0:
+            # modeling mismatch guard: nudge past the next deadline
+            nd = svc.batcher.next_deadline()
+            if nd is None or (until is not None and nd > until):
+                break
+            clk.t = max(clk.t, nd)
+            continue
+        busy_until = clk.t + launched * service_s
+    else:
+        raise RuntimeError("load harness failed to drain the queue")
+    if until is not None and clk.t < until:
+        clk.t = until
+    return busy_until
+
+
+def run_load(spec: LoadSpec, admission=None, obs=None,
+             max_batch: int = 4, ladder: Optional[tuple] = (1, 2, 4),
+             max_queue: int = 256, max_wait_s: float = 0.005,
+             measure_hit_wall: bool = False) -> LoadReport:
+    """Replay one load scenario against a fresh manual-mode service and
+    classify every arrival into a typed outcome.
+
+    Outcome vocabulary (the digest input): ``served:<path>`` (hit /
+    near / cold / the tagged ``degraded_neighbor``), ``reject:<Error>``
+    (raised at submit: ``Overloaded`` / ``CircuitOpen`` /
+    ``DeadlineExceeded``), ``fail:<Error>`` (the future failed:
+    ``LoadShed`` / ``DeadlineExceeded`` at a seam /
+    ``EquilibriumSolveFailed`` / ...), ``unresolved`` (a future left
+    hanging — the invariant the soak pins to zero).
+
+    Same spec (+ policy with a pinned ``est_batch_s``) ⇒ bit-identical
+    ``digest``: every scheduling, admission, shedding, and breaker
+    decision reads only the manual clock and seeded streams."""
+    clk = ManualClock()
+    svc = EquilibriumService(start_worker=False, clock=clk,
+                             admission=admission, obs=obs,
+                             max_batch=max_batch, ladder=ladder,
+                             max_queue=max_queue, max_wait_s=max_wait_s)
+    try:
+        n_warm = int(round(spec.warm_frac * len(spec.cells)))
+        for cell in spec.cells[:n_warm]:
+            svc.query(cell[0], cell[1], labor_sd=cell[2],
+                      **spec.model_kwargs)
+        arrivals = generate_arrivals(spec)
+        busy_until = clk.t
+        slots: list = [None] * len(arrivals)
+        hit_wall_ms: List[float] = []
+        for i, a in enumerate(arrivals):
+            busy_until = _drain(svc, clk, busy_until, a.t,
+                                spec.batch_service_s)
+            q = make_query(a.cell[0], a.cell[1], labor_sd=a.cell[2],
+                           priority=a.priority,
+                           degraded_ok=a.degraded_ok,
+                           **spec.model_kwargs)
+            try:
+                w0 = time.perf_counter() if measure_hit_wall else 0.0
+                fut = svc.submit(q, deadline=a.deadline)
+                if measure_hit_wall and fut.done():
+                    wall = time.perf_counter() - w0
+                    if (fut.exception() is None
+                            and fut.result().path == "hit"):
+                        hit_wall_ms.append(wall * 1e3)
+                slots[i] = fut
+            except ServeError as e:
+                slots[i] = e
+        _drain(svc, clk, busy_until, None, spec.batch_service_s)
+    finally:
+        svc.close()
+
+    outcomes = []
+    unresolved = 0
+    for slot in slots:
+        if isinstance(slot, ServeError):
+            outcomes.append(f"reject:{type(slot).__name__}")
+        elif not slot.done():
+            unresolved += 1
+            outcomes.append("unresolved")
+        elif slot.exception() is not None:
+            outcomes.append(f"fail:{type(slot.exception()).__name__}")
+        else:
+            res = slot.result()
+            outcomes.append("served:" + (res.quality
+                                         if res.quality != "exact"
+                                         else res.path))
+    counts: dict = {}
+    for o in outcomes:
+        counts[o] = counts.get(o, 0) + 1
+    # digest over the scenario AND the per-arrival outcome sequence —
+    # the replay-bit-reproducibility fingerprint (no wall times inside)
+    trace = [[round(a.t, 9), list(a.cell), a.priority,
+              a.deadline, a.degraded_ok] for a in arrivals]
+    digest = hashlib.blake2b(
+        json.dumps([trace, outcomes], sort_keys=True).encode(),
+        digest_size=16).hexdigest()
+
+    m = svc.metrics
+
+    def _pct(hist, q):
+        v = hist.percentile(q)
+        return None if v is None else round(v * 1e3, 4)
+
+    p50 = {p: _pct(m.latency[p], 50) for p in m.latency}
+    p99 = {p: _pct(m.latency[p], 99) for p in m.latency}
+    p50["all"] = _pct(m.latency_all, 50)
+    p99["all"] = _pct(m.latency_all, 99)
+    depth_p50 = m.depth_hist.percentile(50)
+    depth_p99 = m.depth_hist.percentile(99)
+    return LoadReport(
+        arrivals=len(arrivals), outcomes=outcomes, counts=counts,
+        digest=digest, unresolved=unresolved, p50_ms=p50, p99_ms=p99,
+        queue_depth_p50=depth_p50, queue_depth_p99=depth_p99,
+        queue_depth_peak=m.queue_depth_peak,
+        breaker_transitions=(svc.breaker.transitions()
+                             if svc.breaker is not None else []),
+        hit_wall_ms=hit_wall_ms, snapshot=m.snapshot())

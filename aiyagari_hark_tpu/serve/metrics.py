@@ -15,8 +15,10 @@ import weakref
 
 from ..utils.timing import CompileCounter
 
-# Served-request paths, in cache-goodness order.
-PATHS = ("hit", "near", "cold")
+# Served-request paths, in cache-goodness order.  "degraded" is the
+# overload brown-out path (ISSUE 8): a nearest-neighbor answer served
+# from the store under pressure, tagged ``quality="degraded_neighbor"``.
+PATHS = ("hit", "near", "cold", "degraded")
 
 
 class LatencyHistogram:
@@ -89,6 +91,20 @@ class ServeMetrics:
         # the SolutionStore so the metrics module stays dependency-free)
         self.deadline_expirations = 0
         self.certificates = {"certified": 0, "marginal": 0, "failed": 0}
+        # overload layer (ISSUE 8, DESIGN §11): fail-fast admission
+        # rejections, displaced (shed) pendings, breaker activity, and
+        # submit-time deadline rejections (counted APART from the seam
+        # expirations above — a rejected query never held a queue slot).
+        # ``depth_hist`` samples the queue depth at submit AND at pop
+        # (pre-pop depth), closing the drain-heavy understatement the
+        # submit-only peak had.
+        self.overloaded = 0
+        self.load_sheds = 0
+        self.circuit_rejects = 0
+        self.deadline_rejects = 0
+        self.breaker = {"opened": 0, "reopened": 0, "closed": 0,
+                        "probe": 0}
+        self.depth_hist = LatencyHistogram()
         # provider id -> [WeakMethod, last-seen eviction count]: weak so
         # a long-lived shared metrics object cannot pin dead services'
         # stores (each bound provider strongly references its store's
@@ -134,6 +150,35 @@ class ServeMetrics:
             self.deadline_expirations += 1
             self.latency_all.add(latency_s)
 
+    def record_deadline_reject(self) -> None:
+        """One query was rejected at SUBMIT because its deadline had
+        already effectively passed, or (deadline-aware admission) could
+        not be met given the queue — no slot was ever held."""
+        with self._lock:
+            self.deadline_rejects += 1
+
+    def record_overloaded(self) -> None:
+        """One arrival was rejected fail-fast by admission control."""
+        with self._lock:
+            self.overloaded += 1
+
+    def record_shed(self, waited_s: float) -> None:
+        """One queued pending was displaced by a higher-priority arrival
+        (typed ``LoadShed`` on its future)."""
+        with self._lock:
+            self.load_sheds += 1
+            self.latency_all.add(waited_s)
+
+    def record_circuit_reject(self) -> None:
+        """One arrival fast-failed on an open regional breaker."""
+        with self._lock:
+            self.circuit_rejects += 1
+
+    def record_breaker(self, transition: str) -> None:
+        """One breaker transition: opened/reopened/closed/probe."""
+        with self._lock:
+            self.breaker[transition] += 1
+
     def record_certificate(self, level: int) -> None:
         """One cold-miss solution was certified (``certify_before_cache``)."""
         name = ("certified", "marginal", "failed")[max(0, min(2,
@@ -166,9 +211,14 @@ class ServeMetrics:
             self.lanes_padded += int(shape)
 
     def note_queue_depth(self, depth: int) -> None:
+        """One queue-depth sample — taken at submit AND at every pop
+        (the pre-pop depth), so drain-heavy loads no longer understate
+        the peak (ISSUE 8 satellite); every sample also feeds the depth
+        histogram (``serve_queue_depth_p50``/``p99``)."""
         with self._lock:
             if depth > self.queue_depth_peak:
                 self.queue_depth_peak = depth
+            self.depth_hist.add(float(depth))
 
     @staticmethod
     def _ms(value):
@@ -225,6 +275,18 @@ class ServeMetrics:
                                4)),
                 "serve_precision_escalations": self.precision_escalations,
                 "serve_deadline_expirations": self.deadline_expirations,
+                "serve_degraded_rate": round(
+                    self.served["degraded"] / total, 4),
+                "serve_overloaded": self.overloaded,
+                "serve_load_sheds": self.load_sheds,
+                "serve_circuit_rejects": self.circuit_rejects,
+                "serve_deadline_rejects_submit": self.deadline_rejects,
+                "serve_breaker_opens": self.breaker["opened"],
+                "serve_breaker_reopens": self.breaker["reopened"],
+                "serve_breaker_closes": self.breaker["closed"],
+                "serve_breaker_probes": self.breaker["probe"],
+                "serve_queue_depth_p50": self.depth_hist.percentile(50),
+                "serve_queue_depth_p99": self.depth_hist.percentile(99),
                 "serve_certified": self.certificates["certified"],
                 "serve_marginal_certificates": self.certificates["marginal"],
                 "serve_failed_certificates": self.certificates["failed"],

@@ -52,7 +52,10 @@ import numpy as np
 
 from ..obs.runtime import resolve_obs
 from ..solver_health import (
+    CIRCUIT_OPEN,
     DEADLINE_EXCEEDED,
+    LOAD_SHED,
+    OVERLOADED,
     SolverDivergenceError,
     is_failure,
     status_name,
@@ -70,7 +73,13 @@ from ..utils.resilience import (
 )
 from .batcher import MicroBatcher, ServeQueueFull  # noqa: F401  (re-export)
 from .metrics import ServeMetrics
+from .overload import CircuitBreaker, Priority, predicted_work
 from .store import UNCERTIFIED, SolutionStore, make_solution
+
+# Queue-depth histogram buckets for the obs registry (ISSUE 8 satellite):
+# powers of two spanning "empty" to the default max_queue.
+_DEPTH_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                  256.0, 512.0, 1024.0)
 
 
 class ServeError(RuntimeError):
@@ -114,6 +123,80 @@ class DeadlineExceeded(ServeError):
         self.waited_s = float(waited_s)
 
 
+class Overloaded(ServeError):
+    """Admission control rejected this query FAIL-FAST at submit
+    (ISSUE 8, DESIGN §11): the weighted queue occupancy exceeded the
+    ``AdmissionPolicy`` budget for its priority class
+    (``reason="class_budget"``), the query's deadline could not be met
+    given the estimated wait (``reason="deadline_unmeetable"``), or the
+    bounded queue itself was full (``reason="queue_full"``).
+
+    Carries the retry-after payload: ``depth`` (queued requests),
+    ``max_queue``, and ``est_wait_s`` (queued batches ahead x recent
+    batch latency — also aliased ``retry_after_s``).  ``status`` is the
+    process-level ``solver_health.OVERLOADED`` code."""
+
+    def __init__(self, cell, key: int, depth: int, max_queue: int,
+                 est_wait_s: float, reason: str, priority: int = 0):
+        super().__init__(
+            f"equilibrium query (σ={cell[0]:g}, ρ={cell[1]:g}, "
+            f"sd={cell[2]:g}) rejected: service overloaded ({reason}; "
+            f"depth {depth}/{max_queue}, estimated wait "
+            f"{est_wait_s:.3f}s)")
+        self.status = OVERLOADED
+        self.cell = tuple(cell)
+        self.key = int(key)
+        self.depth = int(depth)
+        self.max_queue = int(max_queue)
+        self.est_wait_s = float(est_wait_s)
+        self.retry_after_s = float(est_wait_s)
+        self.reason = str(reason)
+        self.priority = int(priority)
+
+
+class LoadShed(ServeError):
+    """A queued pending was displaced by a higher-priority arrival under
+    pressure (ISSUE 8): its future fails with this typed error instead
+    of silently losing its slot.  ``priority`` is the shed query's own
+    class, ``waited_s`` how long it sat queued, ``displaced_by`` the
+    displacing query's solution fingerprint.  ``status`` is the
+    process-level ``solver_health.LOAD_SHED`` code."""
+
+    def __init__(self, cell, key: int, priority: int, waited_s: float,
+                 displaced_by: Optional[int] = None):
+        super().__init__(
+            f"equilibrium query (σ={cell[0]:g}, ρ={cell[1]:g}, "
+            f"sd={cell[2]:g}) shed from the queue after {waited_s:.3f}s "
+            f"by a higher-priority arrival")
+        self.status = LOAD_SHED
+        self.cell = tuple(cell)
+        self.key = int(key)
+        self.priority = int(priority)
+        self.waited_s = float(waited_s)
+        self.displaced_by = displaced_by
+
+
+class CircuitOpen(ServeError):
+    """This query's (σ, ρ, sd) region has an OPEN circuit breaker after
+    repeated solve/certification failures (ISSUE 8): fast-failed at
+    submit without occupying a queue slot or burning a solve.
+    ``region`` is the quantized breaker key, ``retry_after_s`` the clock
+    time until the region's next half-open probe window.  ``status`` is
+    the process-level ``solver_health.CIRCUIT_OPEN`` code."""
+
+    def __init__(self, cell, key: int, region: tuple,
+                 retry_after_s: float):
+        super().__init__(
+            f"equilibrium query (σ={cell[0]:g}, ρ={cell[1]:g}, "
+            f"sd={cell[2]:g}) fast-failed: circuit open for region "
+            f"{region} (probe in {retry_after_s:.3f}s)")
+        self.status = CIRCUIT_OPEN
+        self.cell = tuple(cell)
+        self.key = int(key)
+        self.region = tuple(region)
+        self.retry_after_s = float(retry_after_s)
+
+
 class CertificationFailed(ServeError):
     """A cold-miss solution FAILED a posteriori certification under
     ``certify_before_cache`` (DESIGN §9): the future fails typed with the
@@ -147,6 +230,13 @@ class EquilibriumQuery(NamedTuple):
     dtype: np.dtype
     kwargs: tuple
     fault_iter: Optional[int] = None
+    # overload layer (ISSUE 8): the priority class (serve.Priority —
+    # INTERACTIVE=0 > BATCH=1 > SPECULATIVE=2) admission budgets and
+    # shedding key on, and the opt-in degraded-answer consent.  Neither
+    # enters key()/group(): the same calibration at any priority
+    # addresses the same cached solution.
+    priority: int = Priority.INTERACTIVE
+    degraded_ok: bool = False
 
     def cell(self) -> Tuple[float, float, float]:
         return (self.crra, self.labor_ar, self.labor_sd)
@@ -161,17 +251,27 @@ class EquilibriumQuery(NamedTuple):
 
 def make_query(crra: float, labor_ar: float, labor_sd: float = 0.2,
                dtype=None, fault_iter: Optional[int] = None,
+               priority: int = Priority.INTERACTIVE,
+               degraded_ok: bool = False,
                **model_kwargs) -> EquilibriumQuery:
     """Canonicalize one request: dtype to the concrete compute dtype
     (``dtype=None`` and the explicit default address the same solution),
-    kwargs to the sorted hashable items every fingerprint hashes."""
+    kwargs to the sorted hashable items every fingerprint hashes.
+    ``priority``/``degraded_ok`` are the overload-layer knobs (ISSUE 8);
+    they shape admission, never the answer's bits."""
     from ..parallel.sweep import _canonical_dtype
 
+    priority = int(priority)
+    if not 0 <= priority <= Priority.SPECULATIVE:
+        raise ValueError(
+            f"priority must be one of serve.Priority "
+            f"(0..{Priority.SPECULATIVE}), got {priority}")
     return EquilibriumQuery(
         crra=float(crra), labor_ar=float(labor_ar),
         labor_sd=float(labor_sd), dtype=_canonical_dtype(dtype),
         kwargs=hashable_kwargs(model_kwargs),
-        fault_iter=None if fault_iter is None else int(fault_iter))
+        fault_iter=None if fault_iter is None else int(fault_iter),
+        priority=priority, degraded_ok=bool(degraded_ok))
 
 
 class ServedResult(NamedTuple):
@@ -201,6 +301,14 @@ class ServedResult(NamedTuple):
     cert_level: Optional[int] = None  # verify certificate verdict
     #   (CERTIFIED/MARGINAL; None = this solution was never certified —
     #   FAILED certificates raise CertificationFailed instead)
+    quality: str = "exact"          # "exact" | "degraded_neighbor"
+    #   (ISSUE 8): a degraded answer is ALWAYS tagged — the numbers are
+    #   a nearby calibration's, served under pressure, never cached as
+    #   this query's exact solution
+    degraded_distance: Optional[float] = None  # normalized (σ,ρ,sd)
+    #   distance to the donor (degraded answers only)
+    donor_key: Optional[int] = None  # the donor's solution fingerprint
+    #   (degraded answers only)
 
 
 def _result_from_row(row: np.ndarray, path: str, bracket_init,
@@ -221,6 +329,9 @@ class _Pending(NamedTuple):
     future: Future
     t_submit: float
     deadline: Optional[float] = None   # absolute clock-units expiry
+    weight: float = 0.0                # predicted-work occupancy units
+    region: Optional[tuple] = None     # breaker region (admission on)
+    probe: bool = False                # this pending IS a half-open probe
 
 
 class EquilibriumService:
@@ -263,7 +374,7 @@ class EquilibriumService:
                  certify_before_cache: bool = False,
                  cert_thresholds=None,
                  inject_corrupt_lane: Optional[dict] = None,
-                 obs=None):
+                 obs=None, admission=None):
         # Observability (ISSUE 7, DESIGN §10): an ObsConfig builds a
         # bundle owned (and closed) by this service; a shared Obs
         # correlates serving with a caller's wider run.  The store
@@ -286,7 +397,21 @@ class EquilibriumService:
         self.batcher = MicroBatcher(max_batch=max_batch,
                                     max_wait_s=max_wait_s,
                                     max_queue=max_queue, ladder=ladder,
-                                    clock=clock)
+                                    clock=clock,
+                                    priority_of=lambda p: p.query.priority)
+        # Overload layer (ISSUE 8, DESIGN §11): an AdmissionPolicy turns
+        # saturation into typed, observable behavior — weighted
+        # per-class occupancy with fail-fast Overloaded rejection,
+        # priority shedding, degraded neighbor answers past the pressure
+        # threshold, and per-region circuit breakers.  None (default)
+        # disables the whole layer: behavior and served bits are
+        # identical to the pre-overload engine.
+        self._admission = admission
+        self.breaker = (CircuitBreaker.from_policy(admission)
+                        if admission is not None else None)
+        self._occ_lock = threading.Lock()
+        self._occupancy: dict = {}       # priority class -> queued work
+        self._batch_ewma_s: Optional[float] = None   # recent batch wall
         self._retry = retry if retry is not None else RetryPolicy()
         self._fault_mode = inject_fault_mode
         self._clock = clock
@@ -310,14 +435,24 @@ class EquilibriumService:
                deadline: Optional[float] = None) -> Future:
         """Enqueue one query; returns a future resolving to a
         ``ServedResult`` (or raising ``EquilibriumSolveFailed`` /
-        ``DeadlineExceeded`` / ``Interrupted``).  Exact cache hits
-        resolve before returning.
+        ``DeadlineExceeded`` / ``LoadShed`` / ``Interrupted``).  Exact
+        cache hits resolve before returning — and BYPASS the overload
+        layer entirely: a hit is a dict lookup, it must stay
+        microseconds even at 100% cold-miss saturation.
 
-        ``deadline`` (seconds from now, clock units): a pending query
-        whose deadline expires before its batch launches fails with the
-        typed ``DeadlineExceeded`` at the next batch seam instead of
-        waiting indefinitely — the SLO primitive.  A query that already
-        resolved (exact hit) never expires."""
+        ``deadline`` (seconds from now, clock units): an
+        already-expired deadline (<= 0) rejects IMMEDIATELY with the
+        typed ``DeadlineExceeded`` (ISSUE 8 satellite — it never wastes
+        a queue slot; counted apart from seam expirations); a pending
+        whose deadline expires before its batch launches fails at the
+        next batch seam — the SLO primitive.
+
+        With an ``AdmissionPolicy`` (ISSUE 8) a miss additionally runs
+        the overload gauntlet fail-fast, in order: regional circuit
+        breaker (``CircuitOpen``), degraded answer for opted-in queries
+        past the pressure threshold, deadline-aware admission and
+        per-class weighted occupancy (``Overloaded`` with retry-after,
+        possibly displacing a lower-priority pending with ``LoadShed``)."""
         if self._closed:
             raise ServiceClosed("EquilibriumService is closed")
         if q.fault_iter is not None and self._fault_mode is None:
@@ -339,20 +474,288 @@ class EquilibriumService:
                                       path="hit", cell=q.cell())
                 fut.set_result(res)
                 return fut
-        expiry = None if deadline is None else t0 + float(deadline)
-        # Enqueue under the gate: without it a close() between the
-        # closed-check above and the offer could run its final drain
-        # first, stranding this future.  The worker drains the batcher
-        # without taking the gate, so a blocking offer (full queue)
-        # cannot deadlock close().
-        with self._gate:
-            if self._closed:
-                raise ServiceClosed("EquilibriumService is closed")
-            self.batcher.offer((q.dtype, q.kwargs),
-                               _Pending(q, fut, t0, expiry),
-                               block=self._worker is not None)
-        self.metrics.note_queue_depth(self.batcher.depth())
+        if deadline is not None and float(deadline) <= 0.0:
+            self.metrics.record_deadline_reject()
+            self._obs.event("DEADLINE_EXCEEDED", cell=q.cell(),
+                            key=q.key(), waited_s=0.0, where="submit")
+            self._obs.counter(
+                "aiyagari_serve_deadline_rejects_total",
+                "queries rejected at submit on an expired or "
+                "unmeetable deadline").inc()
+            raise DeadlineExceeded(q.cell(), q.key(), 0.0)
+        adm = self._admission
+        region = None
+        probe = False
+        weight = 0.0
+        if adm is not None:
+            region = self.breaker.region_key(q.cell(), q.group())
+            verdict = self.breaker.admit(region, t0)
+            if verdict == "open":
+                retry_after = self.breaker.retry_after(region, t0)
+                self.metrics.record_circuit_reject()
+                self._obs.event("CIRCUIT_REJECT", cell=q.cell(),
+                                key=q.key(), region=list(region),
+                                retry_after_s=round(retry_after, 6))
+                self._obs.counter(
+                    "aiyagari_serve_circuit_rejects_total",
+                    "queries fast-failed on an open regional "
+                    "breaker").inc()
+                raise CircuitOpen(q.cell(), q.key(), region, retry_after)
+            if verdict == "probe":
+                probe = True
+                self.metrics.record_breaker("probe")
+                self._obs.event("CIRCUIT_PROBE", cell=q.cell(),
+                                key=q.key(), region=list(region))
+        acquired = False
+        try:
+            if adm is not None:
+                if (q.degraded_ok and not probe
+                        and self._pressure() >= adm.degraded_pressure):
+                    res = self._degraded_answer(q, t0)
+                    if res is not None:
+                        fut.set_result(res)
+                        return fut
+                weight = predicted_work(q.cell())
+                est_wait = self._estimate_wait()
+                if (adm.deadline_aware and deadline is not None
+                        and float(deadline) < est_wait):
+                    self._reject_overloaded(q, "deadline_unmeetable",
+                                            est_wait)
+                # Check + acquire under ONE lock hold, and BEFORE the
+                # offer makes the pending visible to the worker:
+                # concurrent submits cannot jointly overshoot the
+                # budget, and a fast worker pop cannot release (clamped
+                # at zero) ahead of the acquire and leak the weight.
+                if not self._try_acquire(q.priority, weight):
+                    if adm.shed:
+                        self._shed_for(q, t0, weight)
+                    if not self._try_acquire(q.priority, weight):
+                        self._reject_overloaded(q, "class_budget",
+                                                est_wait)
+                acquired = True
+            expiry = None if deadline is None else t0 + float(deadline)
+            pending = _Pending(q, fut, t0, expiry, weight=weight,
+                               region=region, probe=probe)
+            # Enqueue under the gate: without it a close() between the
+            # closed-check above and the offer could run its final drain
+            # first, stranding this future.  The worker drains the
+            # batcher without taking the gate, so a blocking offer (full
+            # queue) cannot deadlock close().  Admission mode never
+            # blocks: the bounded queue translates to the typed
+            # fail-fast Overloaded.
+            with self._gate:
+                if self._closed:
+                    raise ServiceClosed("EquilibriumService is closed")
+                try:
+                    self.batcher.offer(
+                        (q.dtype, q.kwargs), pending,
+                        block=self._worker is not None and adm is None)
+                except ServeQueueFull:
+                    if adm is None:
+                        raise
+                    self._reject_overloaded(q, "queue_full",
+                                            self._estimate_wait())
+        except BaseException:
+            # No rejection path may leak overload state: acquired weight
+            # is returned, and a half-open probe's region goes back to
+            # OPEN — a stuck probing flag would pin the breaker open
+            # forever (every admit short-circuits on it).
+            if acquired:
+                self._release(q.priority, weight)
+            if probe:
+                self.breaker.abort_probe(region)
+            raise
+        self._observe_depth(self.batcher.depth())
         return fut
+
+    def _reject_overloaded(self, q: EquilibriumQuery, reason: str,
+                           est_wait: float) -> None:
+        """Fail-fast admission rejection: count, journal, raise typed."""
+        depth = self.batcher.depth()
+        self.metrics.record_overloaded()
+        self._obs.event("OVERLOADED", cell=q.cell(), key=q.key(),
+                        reason=reason, depth=depth,
+                        est_wait_s=round(est_wait, 6),
+                        priority=q.priority)
+        self._obs.counter(
+            "aiyagari_serve_overloaded_total",
+            "queries rejected fail-fast by admission control").inc()
+        raise Overloaded(q.cell(), q.key(), depth,
+                         self.batcher.max_queue, est_wait, reason,
+                         priority=q.priority)
+
+    def _shed_for(self, q: EquilibriumQuery, now: float,
+                  weight: float) -> None:
+        """Priority load shedding (ISSUE 8): displace queued pendings of
+        STRICTLY lower classes — least important first, youngest within
+        a class — until the arrival fits its class budget or nothing
+        sheddable remains.  Each displaced future fails with the typed
+        ``LoadShed``; an in-flight probe among them is aborted so its
+        region can probe again.  Sheds nothing when even a FULL shed of
+        every lower class could not admit the arrival — a victim must
+        never be killed for a query that gets rejected anyway."""
+        if not self._fits_after_full_shed(q.priority, weight):
+            return
+        while not self._admit_class(q.priority, weight):
+            shed = self.batcher.shed_lowest(max_class=q.priority)
+            if shed is None:
+                return
+            _, p = shed
+            self._release_pending(p)
+            if p.probe and p.region is not None:
+                self.breaker.abort_probe(p.region)
+            waited = now - p.t_submit
+            if not p.future.done():
+                p.future.set_exception(LoadShed(
+                    p.query.cell(), p.query.key(), p.query.priority,
+                    waited, displaced_by=q.key()))
+            self.metrics.record_shed(waited)
+            self._obs.event("LOAD_SHED", cell=p.query.cell(),
+                            key=p.query.key(),
+                            priority=p.query.priority,
+                            waited_s=round(waited, 6),
+                            displaced_by=q.key())
+            self._obs.counter(
+                "aiyagari_serve_load_sheds_total",
+                "queued pendings displaced by higher-priority "
+                "arrivals").inc()
+
+    def _degraded_answer(self, q: EquilibriumQuery,
+                         t0: float) -> Optional[ServedResult]:
+        """The brown-out path (ISSUE 8, DESIGN §11): past the pressure
+        threshold an opt-in ``degraded_ok`` query is answered from the
+        store's nearest neighbor within the normalized-distance budget —
+        principled because policy/aggregate objects vary smoothly-to-
+        linearly in the far field (PAPERS 2002.09108), and honest
+        because the result is ALWAYS tagged ``degraded_neighbor`` with
+        the distance and donor fingerprint, and is never cached as this
+        query's exact answer.  None when no acceptable donor exists (the
+        query falls through to normal admission)."""
+        adm = self._admission
+        near = self.store.nearest(
+            q.cell(), q.group(),
+            require_certified=adm.degraded_require_certified)
+        if near is None:
+            return None
+        donor_key, dist = near
+        if dist > adm.degraded_distance:
+            return None
+        sol = self.store.get(donor_key)
+        if sol is None:     # evicted (LRU or corrupt) since indexing
+            return None
+        lvl = int(sol.cert_level)
+        res = _result_from_row(
+            np.asarray(sol.packed), "degraded", None, q.key(),
+            cert_level=None if lvl == UNCERTIFIED else lvl)
+        res = res._replace(quality="degraded_neighbor",
+                           degraded_distance=float(dist),
+                           donor_key=int(donor_key))
+        latency = self._clock() - t0
+        self.metrics.record_served("degraded", latency)
+        self._obs.event("DEGRADED_ANSWER", cell=q.cell(), key=q.key(),
+                        donor_key=int(donor_key),
+                        distance=round(float(dist), 6))
+        self._obs.counter(
+            "aiyagari_serve_degraded_answers_total",
+            "queries answered by a tagged nearest-neighbor under "
+            "pressure").inc()
+        self._obs.record_span("serve/query", latency, path="degraded",
+                              cell=q.cell())
+        return res
+
+    # -- occupancy accounting (admission enabled) ---------------------------
+
+    def _fits_after_full_shed(self, pclass: int, weight: float) -> bool:
+        """Could the arrival fit its nested budgets if EVERY
+        strictly-lower-class pending were shed?  Shedding only removes
+        classes > pclass, so the hypothetical keeps just the occupancy
+        of classes c..pclass in each aggregate."""
+        adm = self._admission
+        shares = adm.class_shares
+        with self._occ_lock:
+            for c in range(0, min(pclass, len(shares) - 1) + 1):
+                agg = sum(w for k, w in self._occupancy.items()
+                          if c <= k <= pclass)
+                if agg + weight > adm.max_work * shares[c]:
+                    return False
+        return True
+
+    def _try_acquire(self, pclass: int, weight: float) -> bool:
+        """Atomic admit-and-acquire: the nested budget check plus the
+        occupancy increment under ONE lock hold, so concurrent submits
+        cannot both pass the check and jointly overshoot the budget."""
+        with self._occ_lock:
+            if not self._admit_class_locked(pclass, weight):
+                return False
+            self._occupancy[pclass] = (self._occupancy.get(pclass, 0.0)
+                                       + weight)
+            return True
+
+    def _release(self, pclass: int, weight: float) -> None:
+        with self._occ_lock:
+            self._occupancy[pclass] = max(0.0,
+                                          self._occupancy.get(pclass, 0.0)
+                                          - weight)
+
+    def _release_pending(self, p: _Pending) -> None:
+        if self._admission is None:
+            return
+        self._release(p.query.priority, p.weight)
+
+    def _admit_class(self, pclass: int, weight: float) -> bool:
+        with self._occ_lock:
+            return self._admit_class_locked(pclass, weight)
+
+    def _admit_class_locked(self, pclass: int, weight: float) -> bool:
+        """Nested per-class budgets (``_occ_lock`` held): admitting
+        ``weight`` at class ``pclass`` must keep, for every class
+        c <= pclass, the total occupancy of classes >= c within
+        ``max_work * class_shares[c]`` — so less-important classes can
+        never consume the headroom reserved for more-important ones."""
+        adm = self._admission
+        shares = adm.class_shares
+        for c in range(0, min(pclass, len(shares) - 1) + 1):
+            agg = sum(w for k, w in self._occupancy.items()
+                      if k >= c)
+            if agg + weight > adm.max_work * shares[c]:
+                return False
+        return True
+
+    def _pressure(self) -> float:
+        """Total weighted queue occupancy as a fraction of the admission
+        budget — the shed/degraded trigger."""
+        with self._occ_lock:
+            total = sum(self._occupancy.values())
+        return total / max(self._admission.max_work, 1e-12)
+
+    def _estimate_wait(self) -> float:
+        """Estimated queueing delay for a new arrival: queued batches
+        ahead x recent batch latency (policy ``est_batch_s`` when
+        pinned — the load harness's deterministic mode — else a
+        measured EWMA, else ``max_wait_s`` before any batch ran).  The
+        ``Overloaded`` retry-after and the deadline-aware admission
+        bound."""
+        depth = self.batcher.depth()
+        if depth == 0:
+            return 0.0
+        adm = self._admission
+        batch_s = adm.est_batch_s if adm is not None else None
+        if batch_s is None:
+            batch_s = (self._batch_ewma_s
+                       if self._batch_ewma_s is not None
+                       else self.batcher.max_wait_s)
+        batches_ahead = -(-depth // self.batcher.max_batch)
+        return batches_ahead * float(batch_s)
+
+    def _observe_depth(self, depth: int) -> None:
+        """Queue-depth sample (submit and pre-pop): metrics histogram +
+        peak, mirrored into the obs registry histogram when enabled."""
+        self.metrics.note_queue_depth(depth)
+        if self._obs.enabled:
+            self._obs.histogram(
+                "aiyagari_serve_queue_depth",
+                "queued queries sampled at submit and at batch pop",
+                buckets=_DEPTH_BUCKETS).observe(float(depth))
 
     def query(self, crra: float, labor_ar: float, labor_sd: float = 0.2,
               dtype=None, timeout: Optional[float] = None,
@@ -395,6 +798,10 @@ class EquilibriumService:
         live = []
         for p in pendings:
             if p.deadline is not None and now >= p.deadline:
+                if p.probe and p.region is not None:
+                    # the expired pending was a half-open probe: return
+                    # its region to OPEN so the next due admit re-probes
+                    self.breaker.abort_probe(p.region)
                 if not p.future.done():
                     p.future.set_exception(DeadlineExceeded(
                         p.query.cell(), p.query.key(), now - p.t_submit))
@@ -465,6 +872,7 @@ class EquilibriumService:
         fn = _batched_solver(dtype, kwargs_items, self._fault_mode,
                              warm=True)
 
+        t_launch = self._clock()
         try:
             with self._launch_lock, self.metrics.compile, \
                     self._obs.span("serve/batch_flush", lanes=n,
@@ -481,6 +889,7 @@ class EquilibriumService:
                      "polish": float(packed[:n, 8].sum())},
                     prefix="serve/phase/")
         except BaseException as e:
+            self._abort_probes(pendings)
             for p in pendings:
                 if not p.future.done():
                     p.future.set_exception(e)
@@ -488,6 +897,12 @@ class EquilibriumService:
             if isinstance(e, Interrupted):
                 raise
             return
+        # recent-batch-latency EWMA (clock units): the estimated-wait
+        # model behind Overloaded retry-after and deadline-aware
+        # admission (policy est_batch_s, when set, takes precedence)
+        wall = self._clock() - t_launch
+        self._batch_ewma_s = (wall if self._batch_ewma_s is None
+                              else 0.25 * wall + 0.75 * self._batch_ewma_s)
 
         self.metrics.record_batch(n, shape)
         rows = np.array(np.asarray(packed), dtype=np.float64)
@@ -535,6 +950,7 @@ class EquilibriumService:
                     # there fails THIS batch's futures typed — it must
                     # never escape _launch and kill the worker with the
                     # futures stranded unresolved
+                    self._abort_probes(pendings)
                     for p in pendings:
                         if not p.future.done():
                             p.future.set_exception(e)
@@ -552,6 +968,7 @@ class EquilibriumService:
             status = int(np.rint(row[6]))
             seed, path = plans[i]
             if is_failure(status):
+                self._breaker_note(p, ok=False, now=now)
                 p.future.set_exception(EquilibriumSolveFailed(
                     p.query.cell(), status, p.query.key()))
                 self.metrics.record_failure(now - p.t_submit)
@@ -564,6 +981,7 @@ class EquilibriumService:
             if cert is not None:
                 self.metrics.record_certificate(cert.level)
                 if cert.failed:
+                    self._breaker_note(p, ok=False, now=now)
                     p.future.set_exception(CertificationFailed(
                         p.query.cell(), p.query.key(), cert))
                     self.metrics.record_failure(now - p.t_submit)
@@ -573,6 +991,7 @@ class EquilibriumService:
                                     summary=cert.summary(),
                                     where="serve")
                     continue
+            self._breaker_note(p, ok=True, now=now)
             lvl = None if cert is None else cert.level
             res = _result_from_row(row, path, seed, p.query.key(),
                                    cert_level=lvl)
@@ -602,6 +1021,42 @@ class EquilibriumService:
         """Launch everything queued regardless of deadlines."""
         return self._run_batches(self.batcher.pop_all())
 
+    def _breaker_note(self, p: _Pending, ok: bool, now: float) -> None:
+        """Feed one solved lane's outcome to its region breaker and
+        journal/count any transition (open on K failures, close on a
+        certified success — including a successful half-open probe)."""
+        if self.breaker is None or p.region is None:
+            return
+        if ok:
+            tr = self.breaker.record_success(p.region, now)
+        else:
+            tr = self.breaker.record_failure(p.region, now)
+        if tr in ("opened", "reopened"):
+            self.metrics.record_breaker(tr)
+            self._obs.event("CIRCUIT_OPEN", region=list(p.region),
+                            cell=p.query.cell(), transition=tr)
+            self._obs.counter(
+                "aiyagari_serve_breaker_opens_total",
+                "regional circuit breakers opened (incl. reopens)").inc()
+        elif tr == "closed":
+            self.metrics.record_breaker("closed")
+            self._obs.event("CIRCUIT_CLOSE", region=list(p.region),
+                            cell=p.query.cell())
+            self._obs.counter(
+                "aiyagari_serve_breaker_closes_total",
+                "regional circuit breakers closed on certified "
+                "success").inc()
+
+    def _abort_probes(self, pendings) -> None:
+        """Pendings leaving the system without a solve outcome (launch
+        error, drain, interrupt): any half-open probe among them returns
+        its region to OPEN so the next due admit can re-probe."""
+        if self.breaker is None:
+            return
+        for p in pendings:
+            if p.probe and p.region is not None:
+                self.breaker.abort_probe(p.region)
+
     def _run_batches(self, batches) -> int:
         """Launch a popped batch list under the seam protocol.  On a
         shutdown request — the flag set before any launch, or an
@@ -610,6 +1065,16 @@ class EquilibriumService:
         exception before it re-raises: a batch popped out of the batcher
         must never be silently abandoned (its waiters would hang)."""
         remaining = list(batches)
+        if remaining:
+            # queue-depth sample at the POP side (ISSUE 8 satellite):
+            # the pre-pop depth, so drain-heavy loads don't understate
+            # the peak; popped pendings release their admission
+            # occupancy here — they no longer hold queue slots
+            lanes = sum(len(p) for _, p in remaining)
+            self._observe_depth(self.batcher.depth() + lanes)
+            for _, pendings in remaining:
+                for p in pendings:
+                    self._release_pending(p)
         count = 0
         try:
             if interrupt_requested():
@@ -634,6 +1099,7 @@ class EquilibriumService:
         return count
 
     def _fail_futures(self, pendings, exc: BaseException) -> None:
+        self._abort_probes(pendings)
         for p in pendings:
             if not p.future.done():
                 p.future.set_exception(exc)
@@ -641,6 +1107,8 @@ class EquilibriumService:
 
     def _fail_pending(self, exc: BaseException) -> None:
         for _, pendings in self.batcher.pop_all():
+            for p in pendings:
+                self._release_pending(p)
             self._fail_futures(pendings, exc)
 
     def _worker_loop(self) -> None:

@@ -114,12 +114,14 @@ class Donation(NamedTuple):
 
 class _Meta(NamedTuple):
     """Host-side index row kept for every known entry (memory or disk):
-    what donor nomination needs without touching the entry itself."""
+    what donor nomination (and degraded-answer selection, ISSUE 8)
+    needs without touching the entry itself."""
 
     cell: tuple
     group: int
     r_star: float
     on_disk: bool
+    cert_level: int = UNCERTIFIED
 
 
 class SolutionStore:
@@ -258,7 +260,8 @@ class SolutionStore:
             self._meta[int(sol.key)] = _Meta(
                 cell=tuple(np.asarray(sol.cell, dtype=np.float64)),
                 group=int(sol.group),
-                r_star=float(sol.packed[0]), on_disk=True)
+                r_star=float(sol.packed[0]), on_disk=True,
+                cert_level=int(sol.cert_level))
 
     # -- core ops -----------------------------------------------------------
 
@@ -344,7 +347,8 @@ class SolutionStore:
             self._meta[key] = _Meta(
                 cell=tuple(np.asarray(sol.cell, dtype=np.float64)),
                 group=int(sol.group),
-                r_star=float(sol.packed[0]), on_disk=on_disk)
+                r_star=float(sol.packed[0]), on_disk=on_disk,
+                cert_level=int(sol.cert_level))
             self._insert(key, sol)
 
     def _insert(self, key: int, sol: StoredSolution) -> None:
@@ -390,6 +394,33 @@ class SolutionStore:
         return Donation(target=target,
                         margin=donor_margin(spread, width, r_tol),
                         donor_key=int(k0))
+
+    def nearest(self, cell, group: int,
+                require_certified: bool = False):
+        """Nearest stored neighbor of ``cell`` within solver group
+        ``group`` in normalized (σ, ρ, sd) space — the degraded-answer
+        donor (ISSUE 8, DESIGN §11).  Returns ``(key, distance)`` or
+        None.
+
+        Unlike ``nominate`` this proposes no bracket: the caller serves
+        the donor's OWN row, tagged degraded, so the donor must be a
+        real addressable entry — fetch it with ``get(key)``, which
+        re-verifies the content checksum (a corrupt donor degrades to a
+        miss, never to a served wrong answer).  With
+        ``require_certified`` only donors carrying a CERTIFIED/MARGINAL
+        ``verify`` certificate qualify (an UNCERTIFIED entry from a
+        service running without ``certify_before_cache`` is skipped)."""
+        from ..parallel.sweep import neighbor_distance
+
+        with self._lock:
+            rows = [(k, m) for k, m in self._meta.items()
+                    if m.group == int(group) and np.isfinite(m.r_star)
+                    and (not require_certified or m.cert_level >= 0)]
+        if not rows:
+            return None
+        d = neighbor_distance(cell, np.asarray([m.cell for _, m in rows]))
+        i = int(np.argmin(d))
+        return int(rows[i][0]), float(d[i])
 
     # -- introspection ------------------------------------------------------
 

@@ -62,9 +62,25 @@ INTERRUPTED = 4
 # INTERRUPTED, no numbers were produced: uncertified by construction,
 # failure side of ``is_failure``.
 DEADLINE_EXCEEDED = 5
+# Process-level overload family (ISSUE 8, DESIGN §11): the serving
+# engine's typed saturation outcomes.  No numbers were produced for any
+# of them, so all sit on the failure side of ``is_failure``; severity
+# ordering among them is nominal (they never enter ``combine_status``).
+# OVERLOADED: admission control rejected the query fail-fast (class
+# budget, unmeetable deadline, or full queue) — the error carries
+# depth + estimated wait so callers can retry-after.
+OVERLOADED = 6
+# LOAD_SHED: a queued lower-priority pending was displaced by a
+# higher-priority arrival under pressure (``serve.LoadShed``).
+LOAD_SHED = 7
+# CIRCUIT_OPEN: the query's (σ, ρ, sd) region has an open circuit
+# breaker after repeated solve/certification failures; fast-failed
+# without touching the queue (``serve.CircuitOpen``).
+CIRCUIT_OPEN = 8
 
 STATUS_NAMES = ("CONVERGED", "STALLED", "MAX_ITER", "NONFINITE",
-                "INTERRUPTED", "DEADLINE_EXCEEDED")
+                "INTERRUPTED", "DEADLINE_EXCEEDED", "OVERLOADED",
+                "LOAD_SHED", "CIRCUIT_OPEN")
 
 # NOTE marker, not a status code (it never enters ``combine_status``): a
 # mixed-precision ladder's DESCENT phase exited NONFINITE or STALLED and

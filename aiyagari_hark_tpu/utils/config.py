@@ -359,6 +359,80 @@ class SweepConfig:
                 for s in self.crra_values for r in self.rho_values]
 
 
+@dataclass(frozen=True)
+class AdmissionPolicy:
+    """Overload management for the serving engine (ISSUE 8, DESIGN §11).
+
+    ``EquilibriumService(admission=AdmissionPolicy(...))`` turns
+    saturation into a first-class typed state: fail-fast admission
+    instead of unbounded queueing, priority load shedding, degraded
+    nearest-neighbor answers, and per-region circuit breakers.  ``None``
+    (the service default) disables the whole layer — behavior, and every
+    served bit, is identical to the pre-overload engine.
+
+    Admission (``serve.service.Overloaded``):
+
+    * ``max_work`` — total queue-occupancy budget in predicted-work
+      units (``parallel.sweep.heuristic_cell_work``; a baseline
+      σ=1, ρ=0 cell weighs ~1.0).  Weighted occupancy over budget
+      rejects fail-fast with depth + estimated wait (retry-after).
+    * ``class_shares`` — nested per-priority-class budgets, indexed by
+      ``serve.Priority`` (INTERACTIVE=0 > BATCH=1 > SPECULATIVE=2):
+      classes >= c together may hold at most
+      ``max_work * class_shares[c]``, so background work can never
+      starve interactive headroom.
+    * ``shed`` — when a class budget rejects an arrival, displace the
+      least-important/youngest queued pending instead (its future fails
+      with the typed ``LoadShed``) — strictly-lower classes only.
+    * ``deadline_aware`` — reject at submit (not at the batch seam) any
+      query whose ``deadline`` is shorter than the estimated wait
+      (queued batches ahead x recent batch latency).
+    * ``est_batch_s`` — fixed modeled batch latency for the wait
+      estimate; ``None`` uses a measured EWMA (the load harness pins
+      this so admission decisions replay bit-identically).
+
+    Degraded answers (PAPERS 2002.09108 — consumption functions are
+    asymptotically linear, so a near neighbor is a principled brown-out
+    response):
+
+    * ``degraded_pressure`` — occupancy fraction past which an opt-in
+      ``degraded_ok`` query is answered from the store's nearest
+      neighbor instead of queueing a cold solve.
+    * ``degraded_distance`` — normalized (σ, ρ, sd) distance budget
+      (``parallel.sweep.neighbor_distance`` units) beyond which the
+      degraded path declines and the query falls through to admission.
+    * ``degraded_require_certified`` — only donors with a
+      CERTIFIED/MARGINAL ``verify`` certificate may answer.
+
+    Regional circuit breakers (``serve.overload.CircuitBreaker``):
+
+    * ``breaker_failures`` — consecutive failures (NONFINITE/MAX_ITER
+      solves, failed certifications) in one (σ, ρ, sd) region that open
+      its breaker (typed ``CircuitOpen`` fast-fail until a probe
+      succeeds).
+    * ``breaker_cooldown_s`` — open -> half-open probe delay in clock
+      units, doubling per reopen up to ``breaker_backoff_cap`` x.
+    * ``breaker_region_scale`` — quantization of (σ, ρ, sd) into
+      breaker regions (a region is a neighborhood, not a single cell).
+    """
+
+    max_work: float = 64.0
+    class_shares: Tuple[float, ...] = (1.0, 0.5, 0.25)
+    shed: bool = True
+    deadline_aware: bool = True
+    est_batch_s: Optional[float] = None
+    degraded_pressure: float = 0.7
+    degraded_distance: float = 0.25
+    degraded_require_certified: bool = False
+    breaker_failures: int = 3
+    breaker_cooldown_s: float = 1.0
+    breaker_backoff_cap: int = 8
+    breaker_region_scale: Tuple[float, float, float] = (2.0, 0.3, 0.1)
+
+    def replace(self, **kwargs) -> "AdmissionPolicy":
+        return dataclasses.replace(self, **kwargs)
+
+
 # -- named benchmark configurations (BASELINE.json "configs") ---------------
 
 def baseline_cell_kwargs() -> dict:

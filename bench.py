@@ -1665,6 +1665,187 @@ def _obs_drills():
     return injected, detected, detail
 
 
+# Load smoke (ISSUE 8): the overload acceptance on the Table II lattice
+# (both sd panels plus a third, so the cold-key space is wide enough to
+# saturate) at serving grid sizes.  Modeled capacity is max_batch /
+# batch_service_s = 400 cold queries per clock second; the spec arrives
+# at 3x that with a flat-ish Zipf, so admission control, shedding,
+# degraded answers, and the deadline machinery all genuinely fire while
+# the Zipf head keeps a hot exact-hit stream alive.
+LOAD_SMOKE_CELLS = tuple((s, r, sd) for sd in (0.2, 0.3, 0.4)
+                         for s in (1.0, 3.0, 5.0)
+                         for r in (0.0, 0.3, 0.6, 0.9))
+
+
+def _load_smoke() -> dict:
+    """The ``--load-smoke`` acceptance run (DESIGN §11): replay a seeded
+    open-loop Zipf overload scenario at 2.5x modeled capacity on the
+    injected clock, twice — the outcome digests must match bit-for-bit;
+    zero futures may be left unresolved; exact hits must stay fast under
+    saturation (real-wall p50 vs an unsaturated warm baseline); every
+    shed/reject/degrade must appear in the typed event journal exactly
+    as often as the report counts it (injected == journaled); and a
+    breaker drill must walk OPEN -> REJECT -> PROBE -> CLOSE with one
+    journal event each.  Emits the ``load_*`` record fields."""
+    import tempfile
+
+    import numpy as np
+
+    from aiyagari_hark_tpu.obs import ObsConfig, read_journal
+    from aiyagari_hark_tpu.serve import (
+        AdmissionPolicy,
+        CircuitOpen,
+        EquilibriumService,
+        EquilibriumSolveFailed,
+        LoadSpec,
+        make_query,
+        run_load,
+    )
+
+    kw = dict(SERVE_SMOKE_KWARGS)
+    spec = LoadSpec(cells=LOAD_SMOKE_CELLS, model_kwargs=kw,
+                    n_queries=300, seed=20260803, rate=1200.0,
+                    zipf_s=0.5, priority_mix=(0.5, 0.3, 0.2),
+                    deadline_frac=0.2, deadline_s=0.015,
+                    degraded_frac=0.3, batch_service_s=0.01,
+                    warm_frac=0.2)
+    policy = AdmissionPolicy(max_work=2.5, est_batch_s=0.01,
+                             degraded_pressure=0.4,
+                             degraded_distance=0.6)
+
+    # unsaturated exact-hit baseline (real wall): one warm service, the
+    # hottest cell, repeated hit submits
+    svc = EquilibriumService(start_worker=False, max_batch=4,
+                             ladder=(1, 2, 4))
+    hot = LOAD_SMOKE_CELLS[0]
+    svc.query(hot[0], hot[1], labor_sd=hot[2], **kw)
+    base_walls = []
+    for _ in range(64):
+        t0 = time.perf_counter()
+        fut = svc.submit(make_query(hot[0], hot[1], labor_sd=hot[2],
+                                    **kw))
+        base_walls.append((time.perf_counter() - t0) * 1e3)
+        assert fut.done()
+    svc.close()
+    hit_p50_baseline_ms = float(np.median(base_walls))
+
+    with tempfile.TemporaryDirectory() as td:
+        jp = os.path.join(td, "load.jsonl")
+        t0 = time.perf_counter()
+        rep = run_load(spec, admission=policy,
+                       obs=ObsConfig(enabled=True, journal_path=jp),
+                       measure_hit_wall=True)
+        load_wall = time.perf_counter() - t0
+        rep2 = run_load(spec, admission=policy)
+
+        # injected == journaled, event by event
+        snap = rep.snapshot
+        pairs = (
+            ("OVERLOADED", snap["serve_overloaded"]),
+            ("LOAD_SHED", snap["serve_load_sheds"]),
+            ("DEGRADED_ANSWER",
+             rep.counts.get("served:degraded_neighbor", 0)),
+            ("CIRCUIT_REJECT", snap["serve_circuit_rejects"]),
+            ("DEADLINE_EXCEEDED",
+             snap["serve_deadline_rejects_submit"]
+             + snap["serve_deadline_expirations"]),
+        )
+        journal_ok = all(len(read_journal(jp, event=e)) == n
+                         for e, n in pairs)
+
+    # breaker drill: a poisoned region walks the full state machine,
+    # one typed journal event per transition
+    with tempfile.TemporaryDirectory() as td:
+        jb = os.path.join(td, "breaker.jsonl")
+        clk = [0.0]
+        svc = EquilibriumService(
+            start_worker=False, max_batch=4, ladder=(1, 2, 4),
+            clock=lambda: clk[0], inject_fault_mode="nan",
+            admission=AdmissionPolicy(breaker_failures=1,
+                                      breaker_cooldown_s=1.0),
+            obs=ObsConfig(enabled=True, journal_path=jb))
+        fut = svc.submit(make_query(3.0, 0.6, fault_iter=0, **kw))
+        svc.flush()
+        try:
+            fut.result(0)
+            drill_ok = False
+        except EquilibriumSolveFailed:
+            try:
+                svc.submit(make_query(3.0, 0.6, **kw))
+                drill_ok = False
+            except CircuitOpen:
+                clk[0] = 1.0
+                probe = svc.submit(make_query(3.0, 0.6, **kw))
+                svc.flush()
+                drill_ok = probe.exception(0) is None
+        svc.close()
+        drill_ok = bool(drill_ok) and all(
+            len(read_journal(jb, event=e)) == 1
+            for e in ("CIRCUIT_OPEN", "CIRCUIT_REJECT",
+                      "CIRCUIT_PROBE", "CIRCUIT_CLOSE"))
+
+    hit_p50_sat_ms = (float(np.median(rep.hit_wall_ms))
+                      if rep.hit_wall_ms else None)
+    hit_ok = (hit_p50_sat_ms is not None
+              and hit_p50_sat_ms < max(5.0 * hit_p50_baseline_ms, 2.0))
+    served = sum(n for o, n in rep.counts.items()
+                 if o.startswith("served:"))
+    record = {
+        "metric": "load_smoke",
+        "backend": __import__("jax").default_backend(),
+        "load_cells": len(LOAD_SMOKE_CELLS),
+        "load_requests": rep.arrivals,
+        "load_rate_over_capacity": round(
+            spec.rate * spec.batch_service_s / 4.0, 2),
+        "load_wall_s": round(load_wall, 3),
+        "load_digest": rep.digest,
+        # acceptance: seeded replay is bit-reproducible across two runs
+        "load_replay_bit_reproducible": rep.digest == rep2.digest,
+        # acceptance: zero unresolved futures
+        "load_unresolved": rep.unresolved,
+        "load_served": served,
+        "load_served_hit": rep.counts.get("served:hit", 0),
+        "load_served_near": rep.counts.get("served:near", 0),
+        "load_served_cold": rep.counts.get("served:cold", 0),
+        "load_degraded": rep.counts.get("served:degraded_neighbor", 0),
+        "load_overloaded": snap["serve_overloaded"],
+        "load_sheds": snap["serve_load_sheds"],
+        "load_circuit_rejects": snap["serve_circuit_rejects"],
+        "load_deadline_rejects": snap["serve_deadline_rejects_submit"],
+        "load_deadline_expirations": snap["serve_deadline_expirations"],
+        "load_failures": snap["serve_failures"],
+        "load_p50_clock_ms": rep.p50_ms["all"],
+        "load_p99_clock_ms": rep.p99_ms["all"],
+        "load_queue_depth_p50": rep.queue_depth_p50,
+        "load_queue_depth_p99": rep.queue_depth_p99,
+        "load_queue_depth_peak": rep.queue_depth_peak,
+        # acceptance: exact hits stay fast under saturation
+        "load_hit_p50_baseline_ms": round(hit_p50_baseline_ms, 4),
+        "load_hit_p50_saturated_ms": (None if hit_p50_sat_ms is None
+                                      else round(hit_p50_sat_ms, 4)),
+        "load_hit_p50_ok": hit_ok,
+        # acceptance: injected == journaled; breaker walks its machine
+        "load_journal_consistent": journal_ok,
+        "load_breaker_drill": int(drill_ok),
+    }
+    n_deadline = (record["load_deadline_rejects"]
+                  + record["load_deadline_expirations"])
+    print(f"[bench] load smoke: {rep.arrivals} arrivals at "
+          f"{record['load_rate_over_capacity']}x capacity -> "
+          f"{served} served ({record['load_degraded']} degraded) / "
+          f"{record['load_overloaded']} overloaded / "
+          f"{record['load_sheds']} shed / "
+          f"{n_deadline} deadline; "
+          f"depth p99={rep.queue_depth_p99} "
+          f"digest={'OK' if record['load_replay_bit_reproducible'] else 'MISMATCH'} "
+          f"unresolved={rep.unresolved} "
+          f"hit p50 {hit_p50_sat_ms}ms vs {hit_p50_baseline_ms:.3f}ms "
+          f"journal={'OK' if journal_ok else 'MISMATCH'} "
+          f"breaker_drill={'OK' if drill_ok else 'FAIL'}",
+          file=sys.stderr)
+    return record
+
+
 def main(argv=None):
     """CLI wrapper: the preemption-tolerant run layer (ISSUE 3) around the
     measurement body.  ``--resume PATH`` gives the headline sweep a
@@ -1678,7 +1859,10 @@ def main(argv=None):
     drills) and emits the ``integrity_*`` record (ISSUE 6);
     ``--obs-smoke`` runs the observability acceptance (Chrome trace,
     metrics snapshot, event-journal drills, disabled-overhead bound) and
-    emits the ``obs_*`` record (ISSUE 7)."""
+    emits the ``obs_*`` record (ISSUE 7); ``--load-smoke`` runs the
+    overload acceptance (deterministic Zipf replay at 2.5x capacity,
+    typed outcome accounting, breaker drill) and emits the ``load_*``
+    record (ISSUE 8)."""
     import argparse
 
     from aiyagari_hark_tpu.utils.resilience import (
@@ -1708,14 +1892,24 @@ def main(argv=None):
                          "injection-drill event contract, <2%% disabled "
                          "overhead) and emit the obs_* record instead "
                          "of the full bench")
+    ap.add_argument("--load-smoke", action="store_true",
+                    help="run the overload smoke (seeded open-loop Zipf "
+                         "replay at 2.5x modeled capacity on the "
+                         "injected clock: bit-reproducible outcome "
+                         "digest, zero unresolved futures, typed "
+                         "shed/reject/degrade/breaker accounting, "
+                         "journal consistency) and emit the load_* "
+                         "record instead of the full bench")
     args = ap.parse_args(argv)
-    if args.serve_smoke or args.integrity_smoke or args.obs_smoke:
+    if (args.serve_smoke or args.integrity_smoke or args.obs_smoke
+            or args.load_smoke):
         from aiyagari_hark_tpu.utils.backend import (
             enable_compilation_cache,
         )
 
         enable_compilation_cache()
-        smoke = (_obs_smoke if args.obs_smoke
+        smoke = (_load_smoke if args.load_smoke
+                 else _obs_smoke if args.obs_smoke
                  else _integrity_smoke if args.integrity_smoke
                  else _serve_smoke)
         try:

@@ -50,6 +50,11 @@ TYPED_ERRORS = {
     "Interrupted",
     "CertificationFailed",
     "DeadlineExceeded",
+    # overload family (ISSUE 8): fail-fast admission rejection,
+    # priority displacement, breaker fast-fail
+    "Overloaded",
+    "LoadShed",
+    "CircuitOpen",
 }
 
 # Calls that count as journal-emission evidence in the enclosing

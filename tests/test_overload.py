@@ -1,0 +1,854 @@
+"""Overload-resilient serving (ISSUE 8): admission control, priority
+load shedding, degraded answers, regional circuit breakers, and the
+typed-event contract under saturation.
+
+The load-bearing contracts:
+
+* every over-capacity outcome is TYPED — ``Overloaded`` / ``LoadShed``
+  / ``CircuitOpen`` / ``DeadlineExceeded`` / a tagged degraded result —
+  and journaled exactly once (injected == journaled);
+* exact store hits bypass the overload layer entirely (µs hits at 100%
+  cold-miss saturation);
+* with admission enabled but unsaturated, served bits are identical to
+  the PR 4 packing-independence reference (``reference_solve``);
+* no future is ever left unresolved (threaded soak, slow-marked).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.obs import ObsConfig, read_journal
+from aiyagari_hark_tpu.serve import (
+    AdmissionPolicy,
+    CircuitBreaker,
+    CircuitOpen,
+    DeadlineExceeded,
+    EquilibriumService,
+    EquilibriumSolveFailed,
+    LoadShed,
+    ManualClock,
+    MicroBatcher,
+    Overloaded,
+    Priority,
+    ServeQueueFull,
+    make_query,
+    predicted_work,
+)
+from aiyagari_hark_tpu.solver_health import (
+    CIRCUIT_OPEN,
+    LOAD_SHED,
+    OVERLOADED,
+    is_failure,
+    status_name,
+)
+
+# The suite-shared tiny-cell configuration (tests/test_serve.py), so the
+# compiled executables are reused across files.
+KW = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-4,
+          max_bisect=16)
+
+
+def manual_service(**over):
+    kw = dict(start_worker=False, max_batch=4, max_wait_s=60.0,
+              ladder=(1, 2, 4))
+    kw.update(over)
+    return EquilibriumService(**kw)
+
+
+def assert_rows_equal(a, b):
+    assert (a.r_star, a.capital, a.labor) == (b.r_star, b.capital, b.labor)
+    assert (a.bisect_iters, a.egm_iters, a.dist_iters) == (
+        b.bisect_iters, b.egm_iters, b.dist_iters)
+    assert a.status == b.status
+
+
+# ---------------------------------------------------------------------------
+# Batcher satellites: injected-clock offer, payload, shedding, ready().
+# ---------------------------------------------------------------------------
+
+def test_queue_full_carries_retry_after_payload():
+    clk = ManualClock()
+    b = MicroBatcher(max_batch=4, max_queue=2, clock=clk)
+    b.offer("g", 1)
+    clk.advance(0.5)
+    b.offer("g", 2)
+    with pytest.raises(ServeQueueFull) as exc:
+        b.offer("g", 3, block=False)
+    assert exc.value.depth == 2
+    assert exc.value.max_queue == 2
+    assert exc.value.oldest_wait_s == pytest.approx(0.5)
+
+
+def test_offer_block_timeout_rides_the_injected_clock():
+    """A blocked offer's timeout is measured on the injected clock:
+    advancing the fake clock past it and kicking wakes the caller with
+    the typed, payload-carrying ``ServeQueueFull`` — deterministically,
+    long before the real-time backstop."""
+    clk = ManualClock()
+    b = MicroBatcher(max_batch=4, max_queue=1, clock=clk)
+    b.offer("g", 1)
+    outcome = {}
+
+    def blocked():
+        try:
+            b.offer("g", 2, timeout=30.0)
+            outcome["raised"] = False
+        except ServeQueueFull as e:
+            outcome["raised"] = True
+            outcome["depth"] = e.depth
+    t = threading.Thread(target=blocked)
+    t.start()
+    # let the thread enter the wait, then expire the injected clock
+    import time
+    time.sleep(0.05)
+    clk.advance(31.0)
+    b.kick()
+    t.join(5.0)
+    assert not t.is_alive(), "offer must wake on the injected clock"
+    assert outcome == {"raised": True, "depth": 1}
+
+
+def test_offer_real_time_backstop_with_stalled_fake_clock():
+    """A fake clock nobody advances must not block a caller forever:
+    the real-time backstop of the same magnitude still fires."""
+    b = MicroBatcher(max_batch=4, max_queue=1, clock=ManualClock())
+    b.offer("g", 1)
+    with pytest.raises(ServeQueueFull):
+        b.offer("g", 2, timeout=0.02)
+
+
+def test_shed_lowest_orders_by_class_then_youngest():
+    clk = ManualClock()
+    b = MicroBatcher(max_batch=8, clock=clk,
+                     priority_of=lambda item: item[0])
+    b.offer("g", (Priority.BATCH, "b0"))
+    clk.advance(1.0)
+    b.offer("g", (Priority.SPECULATIVE, "s0"))
+    clk.advance(1.0)
+    b.offer("g", (Priority.SPECULATIVE, "s1"))
+    # lowest class first; youngest within the class
+    assert b.shed_lowest()[1] == (Priority.SPECULATIVE, "s1")
+    assert b.shed_lowest()[1] == (Priority.SPECULATIVE, "s0")
+    # strictly-lower-class only: nothing below BATCH remains for a
+    # BATCH-class displacement
+    assert b.shed_lowest(max_class=Priority.BATCH) is None
+    assert b.shed_lowest(max_class=Priority.INTERACTIVE)[1] == (
+        Priority.BATCH, "b0")
+    assert b.depth() == 0
+
+
+def test_ready_matches_pop_ready_at_the_deadline_boundary():
+    """ready()/pop_ready() must agree with next_deadline()'s arithmetic
+    at the exact boundary instant (the load harness advances the clock
+    to precisely that float)."""
+    clk = ManualClock(t=0.0133457)
+    b = MicroBatcher(max_batch=4, max_wait_s=0.005, clock=clk)
+    b.offer("g", "r")
+    nd = b.next_deadline()
+    clk.t = nd
+    assert b.ready()
+    assert b.pop_ready() == [("g", ["r"])]
+
+
+# ---------------------------------------------------------------------------
+# Admission control.
+# ---------------------------------------------------------------------------
+
+def test_overloaded_reject_carries_depth_and_retry_after():
+    pol = AdmissionPolicy(max_work=1.0, shed=False, est_batch_s=0.5)
+    svc = manual_service(admission=pol)
+    fut = svc.submit(make_query(3.0, 0.6, **KW))
+    with pytest.raises(Overloaded) as exc:
+        svc.submit(make_query(1.0, 0.0, **KW))
+    e = exc.value
+    assert e.reason == "class_budget"
+    assert e.depth == 1 and e.max_queue == svc.batcher.max_queue
+    assert e.est_wait_s == e.retry_after_s == pytest.approx(0.5)
+    assert e.status == OVERLOADED and is_failure(e.status)
+    assert status_name(e.status) == "OVERLOADED"
+    # draining frees the occupancy: the same query is admitted now
+    svc.flush()
+    assert not is_failure(fut.result(0).status)
+    fut2 = svc.submit(make_query(1.0, 0.0, **KW))
+    svc.flush()
+    assert not is_failure(fut2.result(0).status)
+    snap = svc.metrics.snapshot()
+    assert snap["serve_overloaded"] == 1
+    svc.close()
+
+
+def test_occupancy_is_weighted_by_predicted_work():
+    """Queue slots are weighted by the PR 2 work heuristic: a budget
+    that admits two cheap high-ρ cells rejects the second slow-mixing
+    ρ=0 cell."""
+    w_cheap = predicted_work((3.0, 0.9, 0.2))
+    w_slow = predicted_work((3.0, 0.0, 0.2))
+    assert w_slow > w_cheap
+    pol = AdmissionPolicy(max_work=2.05 * w_cheap, shed=False)
+    svc = manual_service(admission=pol)
+    svc.submit(make_query(3.0, 0.9, **KW))
+    svc.submit(make_query(5.0, 0.9, **KW))      # ~same weight: admitted
+    svc2 = manual_service(admission=pol)
+    svc2.submit(make_query(3.0, 0.0, **KW))
+    with pytest.raises(Overloaded):
+        svc2.submit(make_query(5.0, 0.0, **KW))  # 2 x slow > budget
+    svc.close()
+    svc2.close()
+
+
+def test_deadline_aware_admission_rejects_unmeetable_at_submit():
+    pol = AdmissionPolicy(max_work=64.0, est_batch_s=1.0)
+    svc = manual_service(admission=pol)
+    svc.submit(make_query(3.0, 0.6, **KW))      # depth 1 -> est wait 1s
+    with pytest.raises(Overloaded) as exc:
+        svc.submit(make_query(1.0, 0.0, **KW), deadline=0.5)
+    assert exc.value.reason == "deadline_unmeetable"
+    # a meetable deadline is admitted
+    fut = svc.submit(make_query(1.0, 0.0, **KW), deadline=5.0)
+    svc.flush()
+    assert not is_failure(fut.result(0).status)
+    svc.close()
+
+
+def test_already_expired_deadline_rejected_at_submit():
+    """ISSUE 8 satellite: a query whose deadline has effectively passed
+    never occupies a queue slot — typed ``DeadlineExceeded`` at submit,
+    counted APART from seam expirations (no admission policy needed)."""
+    clk = ManualClock()
+    svc = manual_service(clock=clk, max_wait_s=0.8)
+    with pytest.raises(DeadlineExceeded):
+        svc.submit(make_query(3.0, 0.6, **KW), deadline=0.0)
+    assert svc.batcher.depth() == 0
+    # a seam expiration still counts in the OTHER bucket
+    fut = svc.submit(make_query(3.0, 0.6, **KW), deadline=0.5)
+    clk.advance(1.0)                    # past max_wait: the batch pops,
+    svc.pump()                          # the seam gate expires it
+    with pytest.raises(DeadlineExceeded):
+        fut.result(0)
+    snap = svc.metrics.snapshot()
+    assert snap["serve_deadline_rejects_submit"] == 1
+    assert snap["serve_deadline_expirations"] == 1
+    svc.close()
+
+
+def test_exact_hits_bypass_admission_at_saturation():
+    """The hit path must stay a dict lookup even at 100% occupancy."""
+    pol = AdmissionPolicy(max_work=1.0, shed=False)
+    svc = manual_service(admission=pol)
+    hot = svc.query(3.0, 0.6, **KW)             # warm the store
+    svc.submit(make_query(1.0, 0.0, **KW))      # saturate the budget
+    with pytest.raises(Overloaded):
+        svc.submit(make_query(5.0, 0.9, **KW))
+    fut = svc.submit(make_query(3.0, 0.6, **KW))
+    assert fut.done()                            # resolved AT submit
+    assert fut.result().path == "hit"
+    assert_rows_equal(fut.result(), hot)
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Priority load shedding.
+# ---------------------------------------------------------------------------
+
+def test_interactive_displaces_youngest_speculative():
+    clk = ManualClock()
+    pol = AdmissionPolicy(max_work=2.0, class_shares=(1.0, 1.0, 1.0),
+                          shed=True)
+    svc = manual_service(admission=pol, clock=clk)
+    fs0 = svc.submit(make_query(3.0, 0.9,
+                                priority=Priority.SPECULATIVE, **KW))
+    clk.advance(1.0)
+    fs1 = svc.submit(make_query(5.0, 0.9,
+                                priority=Priority.SPECULATIVE, **KW))
+    clk.advance(1.0)
+    qi = make_query(3.0, 0.0, priority=Priority.INTERACTIVE, **KW)
+    fi = svc.submit(qi)
+    # the YOUNGEST speculative was shed with the typed LoadShed payload
+    with pytest.raises(LoadShed) as exc:
+        fs1.result(0)
+    e = exc.value
+    assert e.priority == Priority.SPECULATIVE
+    assert e.waited_s == pytest.approx(1.0)
+    assert e.displaced_by == qi.key()
+    assert e.status == LOAD_SHED
+    assert not fs0.done()
+    svc.flush()
+    assert not is_failure(fi.result(0).status)
+    assert not is_failure(fs0.result(0).status)
+    assert svc.metrics.snapshot()["serve_load_sheds"] == 1
+    svc.close()
+
+
+def test_shedding_never_displaces_equal_or_higher_class():
+    pol = AdmissionPolicy(max_work=1.0, class_shares=(1.0, 1.0, 1.0),
+                          shed=True)
+    svc = manual_service(admission=pol)
+    fb = svc.submit(make_query(3.0, 0.6, priority=Priority.BATCH, **KW))
+    with pytest.raises(Overloaded):
+        svc.submit(make_query(1.0, 0.0, priority=Priority.BATCH, **KW))
+    with pytest.raises(Overloaded):
+        svc.submit(make_query(1.0, 0.0,
+                              priority=Priority.SPECULATIVE, **KW))
+    assert not fb.done()
+    svc.flush()
+    assert not is_failure(fb.result(0).status)
+    svc.close()
+
+
+def test_nested_class_budgets_reserve_interactive_headroom():
+    """SPECULATIVE is capped at its share even when the queue is
+    otherwise empty; the reserved headroom still admits INTERACTIVE."""
+    w = predicted_work((3.0, 0.9, 0.2))
+    pol = AdmissionPolicy(max_work=4.0 * w,
+                          class_shares=(1.0, 0.5, 0.25), shed=False)
+    svc = manual_service(admission=pol)
+    svc.submit(make_query(3.0, 0.9, priority=Priority.SPECULATIVE, **KW))
+    with pytest.raises(Overloaded):
+        # a second speculative would exceed the 25% share
+        svc.submit(make_query(5.0, 0.9,
+                              priority=Priority.SPECULATIVE, **KW))
+    svc.submit(make_query(5.0, 0.9, priority=Priority.INTERACTIVE, **KW))
+    svc.close(drain=True)
+
+
+def _occ_total(svc):
+    with svc._occ_lock:
+        return sum(svc._occupancy.values())
+
+
+def test_futile_shed_kills_no_victims():
+    """A victim must never be displaced for an arrival that gets
+    rejected anyway: when even a FULL shed of every lower class could
+    not admit the arrival, nothing is shed."""
+    w_spec = predicted_work((3.0, 0.9, 0.2))
+    w_int = predicted_work((3.0, 0.0, 0.2))
+    w_arr = predicted_work((5.0, 0.0, 0.2))
+    pol = AdmissionPolicy(max_work=(w_spec + w_int) * 1.001,
+                          class_shares=(1.0, 1.0, 1.0), shed=True)
+    # premise: with the speculative gone, INTERACTIVE + arrival still
+    # exceeds the budget — shedding cannot possibly help
+    assert w_int + w_arr > pol.max_work
+    svc = manual_service(admission=pol)
+    fs = svc.submit(make_query(3.0, 0.9,
+                               priority=Priority.SPECULATIVE, **KW))
+    fi = svc.submit(make_query(3.0, 0.0,
+                               priority=Priority.INTERACTIVE, **KW))
+    with pytest.raises(Overloaded):
+        svc.submit(make_query(5.0, 0.0,
+                              priority=Priority.INTERACTIVE, **KW))
+    assert not fs.done(), "victim shed for a doomed arrival"
+    assert svc.metrics.snapshot()["serve_load_sheds"] == 0
+    svc.flush()
+    assert not is_failure(fs.result(0).status)
+    assert not is_failure(fi.result(0).status)
+    svc.close()
+
+
+def test_queue_full_rejection_releases_occupancy():
+    """The queue_full rejection path must return its acquired weight:
+    leaked occupancy would ratchet until admission rejects everything
+    on an idle queue."""
+    pol = AdmissionPolicy(max_work=1000.0, shed=False)
+    svc = manual_service(admission=pol, max_queue=1)
+    svc.submit(make_query(3.0, 0.9, **KW))
+    w1 = _occ_total(svc)
+    assert w1 > 0.0
+    with pytest.raises(Overloaded) as exc:
+        svc.submit(make_query(5.0, 0.9, **KW))
+    assert exc.value.reason == "queue_full"
+    assert _occ_total(svc) == pytest.approx(w1)
+    svc.flush()
+    assert _occ_total(svc) == pytest.approx(0.0)
+    svc.close()
+
+
+def test_concurrent_submits_never_overshoot_budget():
+    """Admit + acquire is atomic: racing submits cannot jointly push
+    the weighted occupancy past the budget."""
+    w = predicted_work((3.0, 0.9, 0.2))
+    pol = AdmissionPolicy(max_work=3.05 * w, shed=False)
+    svc = manual_service(admission=pol, max_queue=64)
+    n = 8
+    barrier = threading.Barrier(n)
+    rejected = []
+
+    def race(i):
+        barrier.wait()
+        try:
+            svc.submit(make_query(2.0 + 0.1 * i, 0.9, **KW))
+        except Overloaded:
+            rejected.append(i)
+    threads = [threading.Thread(target=race, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    assert _occ_total(svc) <= pol.max_work + 1e-9
+    assert len(rejected) >= n - 3          # ~3 weights fit the budget
+    svc.close(drain=True)
+    assert _occ_total(svc) == pytest.approx(0.0)
+
+
+# ---------------------------------------------------------------------------
+# Degraded answers.
+# ---------------------------------------------------------------------------
+
+def test_degraded_answer_is_tagged_and_never_cached():
+    pol = AdmissionPolicy(degraded_pressure=0.0, degraded_distance=0.5)
+    svc = manual_service(admission=pol, donor_cutoff=0.5)
+    donor = svc.query(3.0, 0.6, **KW)
+    q = make_query(3.0, 0.65, degraded_ok=True, **KW)
+    fut = svc.submit(q)
+    assert fut.done()                        # store read, no queueing
+    res = fut.result()
+    assert res.path == "degraded"
+    assert res.quality == "degraded_neighbor"
+    assert res.donor_key == donor.key
+    assert res.degraded_distance == pytest.approx(0.05 / 0.9)
+    # the donor's NUMBERS, the query's OWN key — and never cached as the
+    # query's exact answer: a later same-key query still solves
+    assert res.r_star == donor.r_star and res.key == q.key()
+    assert svc.store.get(q.key()) is None
+    later = svc.query(3.0, 0.65, **KW)
+    assert later.path in ("near", "cold") and later.quality == "exact"
+    assert svc.metrics.snapshot()["serve_degraded_rate"] > 0
+    svc.close()
+
+
+def test_degraded_declines_beyond_distance_budget_and_without_consent():
+    pol = AdmissionPolicy(degraded_pressure=0.0, degraded_distance=0.01)
+    svc = manual_service(admission=pol)
+    svc.query(3.0, 0.6, **KW)
+    # outside the distance budget -> falls through to a normal queue
+    fut = svc.submit(make_query(1.0, 0.0, degraded_ok=True, **KW))
+    assert not fut.done()
+    svc.flush()
+    assert fut.result(0).quality == "exact"
+    # no consent -> never degraded, even in range
+    pol2 = AdmissionPolicy(degraded_pressure=0.0, degraded_distance=0.5)
+    svc2 = manual_service(admission=pol2)
+    svc2.query(3.0, 0.6, **KW)
+    fut2 = svc2.submit(make_query(3.0, 0.65, **KW))
+    assert not fut2.done()
+    svc2.flush()
+    assert fut2.result(0).quality == "exact"
+    svc.close()
+    svc2.close()
+
+
+def test_degraded_gated_by_pressure_threshold():
+    pol = AdmissionPolicy(max_work=2.0, degraded_pressure=0.3,
+                          degraded_distance=0.5)
+    svc = manual_service(admission=pol, donor_cutoff=0.5)
+    svc.query(3.0, 0.6, **KW)
+    # idle service: a degraded_ok query queues normally
+    fut = svc.submit(make_query(3.0, 0.65, degraded_ok=True, **KW))
+    assert not fut.done()
+    # pressure past the threshold: the same query degrades
+    fut2 = svc.submit(make_query(3.0, 0.55, degraded_ok=True, **KW))
+    assert fut2.done()
+    assert fut2.result().quality == "degraded_neighbor"
+    svc.close(drain=True)
+
+
+def test_degraded_require_certified_skips_uncertified_donors():
+    pol = AdmissionPolicy(degraded_pressure=0.0, degraded_distance=0.5,
+                          degraded_require_certified=True)
+    svc = manual_service(admission=pol)
+    svc.query(3.0, 0.6, **KW)                # UNCERTIFIED store entry
+    fut = svc.submit(make_query(3.0, 0.65, degraded_ok=True, **KW))
+    assert not fut.done()                    # no certified donor
+    svc.close(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# Regional circuit breakers.
+# ---------------------------------------------------------------------------
+
+def breaker_service(clk, **pol_over):
+    pol = AdmissionPolicy(breaker_failures=2, breaker_cooldown_s=1.0,
+                          **pol_over)
+    return manual_service(admission=pol, clock=clk,
+                          inject_fault_mode="nan")
+
+
+def fail_once(svc, crra=1.0, rho=0.3):
+    fut = svc.submit(make_query(crra, rho, fault_iter=0, **KW))
+    svc.flush()
+    with pytest.raises(EquilibriumSolveFailed):
+        fut.result(0)
+
+
+def test_breaker_opens_fast_fails_probes_and_closes():
+    clk = ManualClock()
+    svc = breaker_service(clk)
+    region = svc.breaker.region_key(
+        (1.0, 0.3, 0.2), make_query(1.0, 0.3, **KW).group())
+    fail_once(svc)
+    assert svc.breaker.state(region) == "closed"    # 1 < K
+    fail_once(svc)
+    assert svc.breaker.state(region) == "open"      # K = 2
+    # fast-fail, typed, with the probe schedule in the payload
+    with pytest.raises(CircuitOpen) as exc:
+        svc.submit(make_query(1.0, 0.3, **KW))
+    assert exc.value.status == CIRCUIT_OPEN
+    assert exc.value.region == region
+    assert exc.value.retry_after_s == pytest.approx(1.0)
+    # a NEIGHBOR in the same quantized region fast-fails too
+    assert svc.breaker.region_key(
+        (0.9, 0.32, 0.2), make_query(1.0, 0.3, **KW).group()) == region
+    with pytest.raises(CircuitOpen):
+        svc.submit(make_query(0.9, 0.32, **KW))
+    # ... but a far cell in another region is untouched
+    far = svc.submit(make_query(5.0, 0.9, **KW))
+    svc.flush()
+    assert not is_failure(far.result(0).status)
+    # half-open: exactly one probe at/after the cooldown
+    clk.advance(1.0)
+    probe = svc.submit(make_query(1.0, 0.3, **KW))
+    assert svc.breaker.state(region) == "half_open"
+    with pytest.raises(CircuitOpen):             # concurrent query still
+        svc.submit(make_query(1.0, 0.31, **KW))  # fast-fails mid-probe
+    svc.flush()
+    assert not is_failure(probe.result(0).status)
+    assert svc.breaker.state(region) == "closed"
+    # closed: normal service resumes
+    ok = svc.submit(make_query(1.0, 0.32, **KW))
+    svc.flush()
+    assert not is_failure(ok.result(0).status)
+    snap = svc.metrics.snapshot()
+    assert snap["serve_breaker_opens"] == 1
+    assert snap["serve_breaker_probes"] == 1
+    assert snap["serve_breaker_closes"] == 1
+    assert snap["serve_circuit_rejects"] == 3
+    svc.close()
+
+
+def test_failed_probe_reopens_with_doubled_cooldown():
+    clk = ManualClock()
+    svc = breaker_service(clk)
+    region = svc.breaker.region_key(
+        (1.0, 0.3, 0.2), make_query(1.0, 0.3, **KW).group())
+    fail_once(svc)
+    fail_once(svc)
+    clk.advance(1.0)
+    fail_once(svc)                        # the probe itself fails
+    assert svc.breaker.state(region) == "open"
+    assert svc.breaker.retry_after(region, clk()) == pytest.approx(2.0)
+    clk.advance(1.0)                      # inside the doubled cooldown
+    with pytest.raises(CircuitOpen):
+        svc.submit(make_query(1.0, 0.3, **KW))
+    clk.advance(1.0)                      # cooldown elapsed -> probe
+    probe = svc.submit(make_query(1.0, 0.3, **KW))
+    svc.flush()
+    assert not is_failure(probe.result(0).status)
+    assert svc.breaker.state(region) == "closed"
+    assert svc.metrics.snapshot()["serve_breaker_reopens"] == 1
+    svc.close()
+
+
+def test_shed_probe_reopens_the_probe_window():
+    """A probe displaced by shedding must not wedge the region in
+    half-open: the breaker returns to OPEN and the next due admit
+    probes again."""
+    clk = ManualClock()
+    svc = breaker_service(clk, max_work=1.0,
+                          class_shares=(1.0, 1.0, 1.0), shed=True)
+    region = svc.breaker.region_key(
+        (1.0, 0.3, 0.2), make_query(1.0, 0.3, **KW).group())
+    fail_once(svc)
+    fail_once(svc)
+    clk.advance(1.0)
+    probe = svc.submit(make_query(1.0, 0.3,
+                                  priority=Priority.SPECULATIVE, **KW))
+    assert svc.breaker.state(region) == "half_open"
+    svc.submit(make_query(5.0, 0.9, priority=Priority.INTERACTIVE, **KW))
+    with pytest.raises(LoadShed):
+        probe.result(0)
+    assert svc.breaker.state(region) == "open"
+    svc.flush()                       # drain the displacing interactive
+    probe2 = svc.submit(make_query(1.0, 0.3, **KW))   # re-probe, due now
+    svc.flush()
+    assert not is_failure(probe2.result(0).status)
+    assert svc.breaker.state(region) == "closed"
+    svc.close()
+
+
+def test_probe_rejected_by_admission_reopens_the_probe_window():
+    """A half-open probe that the ADMISSION layer rejects (budget or
+    deadline) must not wedge the region: the probing flag is released
+    with the raise, so the next due admit probes again — a leaked flag
+    would pin the breaker open forever."""
+    clk = ManualClock()
+    w_probe = predicted_work((1.0, 0.3, 0.2))
+    w_far = predicted_work((5.0, 0.9, 0.2))
+    svc = breaker_service(clk, shed=False,
+                          max_work=max(w_probe, w_far)
+                          + 0.5 * min(w_probe, w_far))
+    region = svc.breaker.region_key(
+        (1.0, 0.3, 0.2), make_query(1.0, 0.3, **KW).group())
+    fail_once(svc)
+    fail_once(svc)
+    assert svc.breaker.state(region) == "open"
+    svc.submit(make_query(5.0, 0.9, **KW))     # saturate the budget
+    clk.advance(1.0)                           # cooldown elapsed
+    with pytest.raises(Overloaded):            # probe verdict, then the
+        svc.submit(make_query(1.0, 0.3, **KW))  # class budget rejects
+    assert svc.breaker.state(region) == "open", \
+        "rejected probe wedged the region half-open"
+    # the deadline-unmeetable rejection must release it too
+    with pytest.raises(Overloaded) as exc:
+        svc.submit(make_query(1.0, 0.3, **KW), deadline=1e-9)
+    assert exc.value.reason in ("deadline_unmeetable", "class_budget")
+    assert svc.breaker.state(region) == "open"
+    svc.flush()                                # free the budget
+    probe = svc.submit(make_query(1.0, 0.3, **KW))
+    assert svc.breaker.state(region) == "half_open"
+    svc.flush()
+    assert not is_failure(probe.result(0).status)
+    assert svc.breaker.state(region) == "closed"
+    svc.close()
+
+
+def test_breaker_unit_state_machine():
+    """Host-only breaker contract, no solves: deterministic schedule."""
+    b = CircuitBreaker(failures=3, cooldown_s=2.0, backoff_cap=4)
+    r = b.region_key((3.0, 0.6, 0.2), 7)
+    assert b.admit(r, 0.0) == "ok"
+    assert b.record_failure(r, 0.0) is None
+    assert b.record_failure(r, 0.1) is None
+    assert b.record_success(r, 0.2) is None          # resets the count
+    assert b.record_failure(r, 0.3) is None
+    assert b.record_failure(r, 0.4) is None
+    assert b.record_failure(r, 0.5) == "opened"
+    assert b.admit(r, 0.6) == "open"
+    assert b.retry_after(r, 0.6) == pytest.approx(1.9)
+    assert b.admit(r, 2.5) == "probe"
+    assert b.admit(r, 2.6) == "open"                 # one probe only
+    assert b.record_failure(r, 2.7) == "reopened"
+    assert b.retry_after(r, 2.7) == pytest.approx(4.0)
+    assert b.admit(r, 6.7) == "probe"
+    assert b.record_success(r, 6.8) == "closed"
+    assert b.admit(r, 6.9) == "ok"
+    kinds = [w for _, _, w in b.transitions()]
+    assert kinds == ["opened", "probe", "reopened", "probe", "closed"]
+
+
+# ---------------------------------------------------------------------------
+# Event contract: every typed overload outcome journals exactly once.
+# ---------------------------------------------------------------------------
+
+def test_every_overload_path_emits_exactly_one_typed_event(tmp_path):
+    def journal(name):
+        return str(tmp_path / f"{name}.jsonl")
+
+    # OVERLOADED (class budget)
+    jp = journal("overloaded")
+    svc = manual_service(admission=AdmissionPolicy(max_work=1.0,
+                                                   shed=False),
+                         obs=ObsConfig(enabled=True, journal_path=jp))
+    svc.submit(make_query(3.0, 0.6, **KW))
+    with pytest.raises(Overloaded):
+        svc.submit(make_query(1.0, 0.0, **KW))
+    svc.close(drain=True)
+    evs = read_journal(jp, event="OVERLOADED")
+    assert len(evs) == 1 and evs[0]["reason"] == "class_budget"
+
+    # LOAD_SHED
+    jp = journal("shed")
+    svc = manual_service(
+        admission=AdmissionPolicy(max_work=1.0,
+                                  class_shares=(1.0, 1.0, 1.0)),
+        obs=ObsConfig(enabled=True, journal_path=jp))
+    shed_fut = svc.submit(make_query(3.0, 0.6,
+                                     priority=Priority.SPECULATIVE, **KW))
+    svc.submit(make_query(1.0, 0.0, priority=Priority.INTERACTIVE, **KW))
+    with pytest.raises(LoadShed):
+        shed_fut.result(0)
+    svc.close(drain=True)
+    evs = read_journal(jp, event="LOAD_SHED")
+    assert len(evs) == 1 and evs[0]["priority"] == Priority.SPECULATIVE
+
+    # DEGRADED_ANSWER
+    jp = journal("degraded")
+    svc = manual_service(
+        admission=AdmissionPolicy(degraded_pressure=0.0,
+                                  degraded_distance=0.5),
+        obs=ObsConfig(enabled=True, journal_path=jp))
+    svc.query(3.0, 0.6, **KW)
+    assert svc.submit(
+        make_query(3.0, 0.65, degraded_ok=True, **KW)).result(0)
+    svc.close(drain=True)
+    evs = read_journal(jp, event="DEGRADED_ANSWER")
+    assert len(evs) == 1 and "donor_key" in evs[0]
+
+    # DEADLINE_EXCEEDED at submit (where="submit")
+    jp = journal("deadline")
+    svc = manual_service(obs=ObsConfig(enabled=True, journal_path=jp))
+    with pytest.raises(DeadlineExceeded):
+        svc.submit(make_query(3.0, 0.6, **KW), deadline=0.0)
+    svc.close(drain=True)
+    evs = read_journal(jp, event="DEADLINE_EXCEEDED")
+    assert len(evs) == 1 and evs[0]["where"] == "submit"
+
+    # breaker family: OPEN x1, REJECT x1, PROBE x1, CLOSE x1
+    jp = journal("breaker")
+    clk = ManualClock()
+    svc = manual_service(
+        admission=AdmissionPolicy(breaker_failures=1,
+                                  breaker_cooldown_s=1.0),
+        clock=clk, inject_fault_mode="nan",
+        obs=ObsConfig(enabled=True, journal_path=jp))
+    fail_once(svc)
+    with pytest.raises(CircuitOpen):
+        svc.submit(make_query(1.0, 0.3, **KW))
+    clk.advance(1.0)
+    probe = svc.submit(make_query(1.0, 0.3, **KW))
+    svc.flush()
+    assert not is_failure(probe.result(0).status)
+    svc.close(drain=True)
+    for etype, n in (("CIRCUIT_OPEN", 1), ("CIRCUIT_REJECT", 1),
+                     ("CIRCUIT_PROBE", 1), ("CIRCUIT_CLOSE", 1)):
+        assert len(read_journal(jp, event=etype)) == n, etype
+
+
+# ---------------------------------------------------------------------------
+# Bit-identity with admission enabled (the PR 4 contract survives).
+# ---------------------------------------------------------------------------
+
+def test_unsaturated_admission_serves_bit_identical_results():
+    """Admission control gates the QUEUE, never the numbers: below
+    saturation, every served result equals the direct single-cell
+    reference launch bit for bit — the PR 4 packing-independence
+    contract with the overload layer enabled."""
+    svc = manual_service(admission=AdmissionPolicy(), donor_cutoff=0.5)
+    ra = svc.query(3.0, 0.6, **KW)
+    fb = svc.submit(make_query(3.0, 0.65, **KW))    # near
+    fc = svc.submit(make_query(1.0, 0.0, **KW))     # cold
+    fd = svc.submit(make_query(3.0, 0.55, **KW))    # near
+    assert svc.flush() == 1
+    rb, rc, rd = fb.result(0), fc.result(0), fd.result(0)
+    assert rb.path == "near" and rc.path == "cold" and rd.path == "near"
+    for res, q in ((ra, make_query(3.0, 0.6, **KW)),
+                   (rb, make_query(3.0, 0.65, **KW)),
+                   (rc, make_query(1.0, 0.0, **KW)),
+                   (rd, make_query(3.0, 0.55, **KW))):
+        ref = svc.reference_solve(q, bracket_init=res.bracket_init)
+        assert_rows_equal(res, ref)
+        assert res.quality == "exact"
+    svc.close()
+
+
+def test_unsaturated_admission_matches_no_admission_bits():
+    """The same queries through an admission-enabled and a plain service
+    produce identical bits (and identical paths)."""
+    plain = manual_service(donor_cutoff=0.5)
+    gated = manual_service(admission=AdmissionPolicy(), donor_cutoff=0.5)
+    for svc in (plain, gated):
+        svc.query(3.0, 0.6, **KW)
+    results = {}
+    for name, svc in (("plain", plain), ("gated", gated)):
+        futs = [svc.submit(make_query(c, r, **KW))
+                for c, r in ((3.0, 0.65), (1.0, 0.0), (5.0, 0.9))]
+        svc.flush()
+        results[name] = [f.result(0) for f in futs]
+    for a, b in zip(results["plain"], results["gated"]):
+        assert_rows_equal(a, b)
+        assert a.path == b.path
+    plain.close()
+    gated.close()
+
+
+# ---------------------------------------------------------------------------
+# Metrics satellites.
+# ---------------------------------------------------------------------------
+
+def test_queue_depth_sampled_at_pop_and_histogrammed():
+    svc = manual_service()
+    for rho in (0.0, 0.3, 0.6):
+        svc.submit(make_query(1.0, rho, **KW))
+    pre = svc.metrics.depth_hist.count
+    assert pre == 3                         # one sample per submit
+    svc.flush()
+    assert svc.metrics.depth_hist.count == pre + 1   # pre-pop sample
+    snap = svc.metrics.snapshot()
+    assert snap["serve_queue_depth_peak"] == 3
+    assert snap["serve_queue_depth_p50"] is not None
+    assert snap["serve_queue_depth_p99"] is not None
+    svc.close()
+
+
+def test_depth_histogram_reaches_obs_registry(tmp_path):
+    obs = ObsConfig(enabled=True)
+    svc = manual_service(obs=obs)
+    svc.submit(make_query(3.0, 0.6, **KW))
+    svc.flush()
+    reg = svc._obs.registry
+    hist = reg.histogram("aiyagari_serve_queue_depth")
+    assert hist.count >= 2                  # submit + pop samples
+    svc.close()
+
+
+def test_make_query_validates_priority():
+    with pytest.raises(ValueError):
+        make_query(3.0, 0.6, priority=7, **KW)
+    q = make_query(3.0, 0.6, priority=Priority.BATCH, degraded_ok=True,
+                   **KW)
+    # overload knobs never move the solution address
+    assert q.key() == make_query(3.0, 0.6, **KW).key()
+
+
+# ---------------------------------------------------------------------------
+# Threaded overload soak (slow): no future ever hangs unresolved.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_threaded_overload_soak_every_future_resolves():
+    """4 threads x 40 submits against a tiny admission budget through a
+    LIVE worker: every single future reaches a typed outcome — a
+    ServedResult, or Overloaded/LoadShed/CircuitOpen raised at submit,
+    or a typed failure on the future.  Zero hangs, zero bare errors."""
+    rng = np.random.default_rng(99)
+    lattice = [(c, r) for c in (1.0, 3.0) for r in (0.0, 0.3, 0.6, 0.9)]
+    picks = rng.integers(0, len(lattice), 160)
+    prios = rng.integers(0, 3, 160)
+    pol = AdmissionPolicy(max_work=3.0, est_batch_s=0.01)
+    svc = EquilibriumService(max_batch=4, max_wait_s=0.002,
+                             max_queue=16, ladder=(1, 2, 4),
+                             admission=pol)
+    outcomes = [None] * len(picks)
+
+    def submitter(tid):
+        for i in range(tid, len(picks), 4):
+            c, r = lattice[int(picks[i])]
+            try:
+                fut = svc.submit(make_query(c, r, priority=int(prios[i]),
+                                            **KW))
+            except (Overloaded, CircuitOpen) as e:
+                outcomes[i] = type(e).__name__
+                continue
+            try:
+                res = fut.result(120)       # must NEVER hang
+                outcomes[i] = f"served:{res.path}"
+            except (LoadShed, DeadlineExceeded,
+                    EquilibriumSolveFailed) as e:
+                outcomes[i] = type(e).__name__
+
+    threads = [threading.Thread(target=submitter, args=(t,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+        assert not t.is_alive(), "submitter hung: a future never resolved"
+    svc.close()
+    assert all(o is not None for o in outcomes)
+    served = sum(1 for o in outcomes if o.startswith("served:"))
+    assert served > 0
+    snap = svc.metrics.snapshot()
+    assert snap["serve_requests"] + snap["serve_overloaded"] \
+        + snap["serve_load_sheds"] + snap["serve_circuit_rejects"] \
+        >= len(picks)
