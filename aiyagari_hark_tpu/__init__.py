@@ -2,14 +2,15 @@
 framework with the capabilities of the Aiyagari-HARK reference replication.
 
 Layers (mirroring SURVEY.md §1, rebuilt TPU-first):
-  * ``ops``      — numerics core (grids, Tauchen, CRRA, batched interp, OLS)
-  * ``models``   — EGM household solver, simulators, equilibrium loops
-  * ``parallel`` — device meshes, calibration sweeps, sharded agent panels
-  * ``serve``    — micro-batched equilibrium query engine + solution store
-  * ``verify``   — a posteriori certification, checksum chain, SDC defense
-  * ``obs``      — run-scoped tracing spans, metrics registry, event journal
-  * ``utils``    — typed configs, checkpointing, logging, statistics
-  * ``facade``   — notebook-compatible AiyagariType / AiyagariEconomy classes
+  * ``ops``       — numerics core (grids, Tauchen, CRRA, batched interp, OLS)
+  * ``models``    — EGM household solver, simulators, equilibrium loops
+  * ``parallel``  — device meshes, calibration sweeps, sharded agent panels
+  * ``scenarios`` — pluggable model families riding the whole run stack
+  * ``serve``     — micro-batched equilibrium query engine + solution store
+  * ``verify``    — a posteriori certification, checksum chain, SDC defense
+  * ``obs``       — run-scoped tracing spans, metrics registry, event journal
+  * ``utils``     — typed configs, checkpointing, logging, statistics
+  * ``facade``    — notebook-compatible AiyagariType / AiyagariEconomy classes
 """
 
 __version__ = "0.1.0"

@@ -5,10 +5,16 @@ first-class)."""
 from . import multihost
 from .mesh import balanced_lane_order, make_mesh, pad_to_multiple, sharding
 from .panel import initial_panel_sharded, simulate_panel_sharded
-from .sweep import SweepResult, run_table2_sweep
+from .sweep import (
+    ScenarioSweepResult,
+    SweepResult,
+    run_sweep,
+    run_table2_sweep,
+)
 
 __all__ = [
     "balanced_lane_order", "make_mesh", "pad_to_multiple", "sharding",
     "initial_panel_sharded", "simulate_panel_sharded",
-    "SweepResult", "run_table2_sweep",
+    "ScenarioSweepResult", "SweepResult", "run_sweep",
+    "run_table2_sweep",
 ]

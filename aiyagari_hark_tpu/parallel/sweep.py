@@ -54,7 +54,7 @@ from ..utils.fingerprint import (
     solution_fingerprint,
     work_fingerprint,
 )
-from ..utils.config import PACKED_ROW_WIDTH, SweepConfig
+from ..utils.config import SweepConfig
 from ..utils.resilience import (
     LedgerState,
     RetryPolicy,
@@ -369,24 +369,26 @@ def _load_sidecar(path, fingerprint):
     try:
         return load_sweep_sidecar(path, fingerprint)
     except CheckpointMismatchError as e:
-        warnings.warn(f"sweep sidecar ignored: {e}", stacklevel=4)
+        warnings.warn(f"sweep sidecar ignored: {e}", stacklevel=5)
         return None
     except IntegrityError as e:
         # silent corruption (DESIGN §9): the file parsed and carried the
         # right fingerprint, but its content no longer hashes to its
         # solve-time checksum — degrade to the heuristic, loudly
         warnings.warn(f"sweep sidecar failed integrity verification: {e}",
-                      stacklevel=4)
+                      stacklevel=5)
         return None
     except CORRUPT_NPZ_ERRORS:
         return None
 
 
-def _predict_work(cells: np.ndarray, side) -> np.ndarray:
+def _predict_work(cells: np.ndarray, side,
+                  heuristic=heuristic_cell_work) -> np.ndarray:
     """Per-cell predicted work: sidecar counters where available (scaled
     into the heuristic's units via the median ratio over matched cells, so
-    mixed predictions stay comparable), heuristic elsewhere."""
-    pred = heuristic_cell_work(cells)
+    mixed predictions stay comparable), heuristic elsewhere.
+    ``heuristic`` is the scenario's ``CellSpace.work`` cost model."""
+    pred = heuristic(cells)
     if side is None:
         return pred
     measured = np.full(len(cells), np.nan)
@@ -472,12 +474,15 @@ def _plan_buckets(order: np.ndarray, n_buckets: int):
 NEIGHBOR_CELL_SCALE = (4.0, 0.9, 0.4)
 
 
-def neighbor_distance(cell, cells) -> np.ndarray:
-    """Normalized L1 distance from ``cell`` to each row of ``cells``."""
+def neighbor_distance(cell, cells, scale=NEIGHBOR_CELL_SCALE) -> np.ndarray:
+    """Normalized L1 distance from ``cell`` to each row of ``cells``.
+    ``scale`` defaults to the Aiyagari lattice span; other scenarios pass
+    their ``CellSpace.scale`` (one rule per family, shared by the sweep's
+    in-batch seeding and the store's donor nomination)."""
     cell = np.asarray(cell, dtype=np.float64)
     cells = np.asarray(cells, dtype=np.float64)
-    return sum(np.abs(cells[..., i] - cell[i]) / NEIGHBOR_CELL_SCALE[i]
-               for i in range(3))
+    return sum(np.abs(cells[..., i] - cell[i]) / scale[i]
+               for i in range(len(scale)))
 
 
 def donor_margin(spread, width: float, r_tol: float) -> float:
@@ -490,7 +495,7 @@ def donor_margin(spread, width: float, r_tol: float) -> float:
 
 
 def _neighbor_seed(cell, cells, r_solved, solved_ok, width, r_tol,
-                   warm_margin):
+                   warm_margin, scale=NEIGHBOR_CELL_SCALE):
     """Bracket seed for ``cell`` from the nearest already-solved neighbor
     in normalized (σ, ρ, sd) space: target = neighbor's root, margin = the
     local r*-variation between the two nearest solved neighbors (how far
@@ -499,7 +504,7 @@ def _neighbor_seed(cell, cells, r_solved, solved_ok, width, r_tol,
     idx = np.nonzero(solved_ok)[0]
     if len(idx) == 0:
         return None
-    d = neighbor_distance(cell, cells[idx])
+    d = neighbor_distance(cell, cells[idx], scale=scale)
     near = idx[np.argsort(d, kind="stable")]
     target = float(r_solved[near[0]])
     if warm_margin > 0.0:
@@ -546,14 +551,17 @@ def _timed_launch(device_call, label, fn, args):
     return packed, t[0]
 
 
-def _solve_scheduled(sweep: SweepConfig, crra, rho, sd, rho_nominal,
+def _solve_scheduled(scn, sweep: SweepConfig, cells_p, cells_nom,
                      fault_iters, fault_mode, mesh, axis, dtype,
                      kwargs_items, model_kwargs, perturb=0.0,
                      side=None, ledger=None, device_call=None,
                      inject_preempt=None, obs=NULL_OBS):
-    """The work-balanced bucketed solve: returns per-cell packed results
-    ``[C, PACKED_ROW_WIDTH]`` in ORIGINAL cell order, the summed launch
-    wall, the bucket assignment, and the predicted-work vector.
+    """The work-balanced bucketed solve for one scenario ``scn``: returns
+    per-cell packed results ``[C, scn.schema.width]`` in ORIGINAL cell
+    order, the summed launch wall, the bucket assignment, and the
+    predicted-work vector.  ``cells_p`` are the (possibly perturbed)
+    solver inputs, ``cells_nom`` the nominal coordinates the work model,
+    sidecar lookups, and neighbor distances use.
 
     Order of operations per bucket (cheapest predicted bucket first):
     warm-bracket seeds from the sidecar (same cell) or the nearest solved
@@ -570,12 +578,15 @@ def _solve_scheduled(sweep: SweepConfig, crra, rho, sd, rho_nominal,
     later buckets' neighbor warm seeds see exactly the results an
     uninterrupted run would have had, preserving bit-identity.  Launches
     go through ``device_call`` (transient-fault retry)."""
-    n_orig = len(crra)
-    cells = np.stack([crra, rho_nominal, sd], axis=1)
+    n_orig = len(cells_p)
+    schema = scn.schema
+    root_col = schema.idx(schema.root)
+    status_col = schema.idx(schema.status)
+    cells = np.asarray(cells_nom, dtype=np.float64)
     if device_call is None:
         def device_call(label, f):
             return f()
-    pred = _predict_work(cells, side)
+    pred = _predict_work(cells, side, heuristic=scn.cells.work)
     if ledger is not None:
         ledger.pred = np.asarray(pred, dtype=np.float64)
     order = np.argsort(pred, kind="stable")
@@ -585,28 +596,32 @@ def _solve_scheduled(sweep: SweepConfig, crra, rho, sd, rho_nominal,
     b_pad = size + (-size % n_shards)
     shard = None if mesh is None else sharding(mesh, axis)
 
-    r_lo, r_hi = _host_bracket(model_kwargs, dtype)
-    width = float(r_hi) - float(r_lo)
-    r_tol = _host_r_tol(model_kwargs, dtype)
-    max_levels = max(0, int(model_kwargs.get("max_bisect", 60)) - 6)
-    # Same-cell sidecar seeds descend DEEP: the prior root is exact to
-    # r_tol for an identical configuration, and the expensive evaluations
-    # are the near-root ones (slow-mixing distribution fixed points cost a
-    # ~constant certification floor per evaluation regardless of warm
-    # carry), so every level skipped near the root saves a floor-cost
-    # solve.  2x r_tol keeps the verified ball strictly containing the
-    # root; the continuation still performs >= 2 certified evaluations.
-    # The |perturb| term covers the benchmark methodology: a perturbed
-    # timed rerun moves the root by ~perturb * dr*/drho (dr*/drho is
-    # O(0.03) on the Table II lattice, so 4|perturb| has ~100x slack) —
-    # without it an f64 rerun's margin (2e-10) sits INSIDE the root
-    # shift, every seed fails verification, and the "warm" sweep pays
-    # cold work plus two verification solves per lane.
-    margin_same = (float(sweep.warm_margin) if sweep.warm_margin > 0.0
-                   else max(2.0 * r_tol, 4.0 * abs(float(perturb)),
-                            16.0 * np.finfo(np.dtype(dtype)).eps * width))
+    warm_enabled = sweep.warm_brackets and scn.warm is not None
+    if warm_enabled:
+        r_lo, r_hi = scn.warm.host_bracket(model_kwargs, dtype)
+        width = float(r_hi) - float(r_lo)
+        r_tol = scn.warm.host_r_tol(model_kwargs, dtype)
+        max_levels = scn.warm.max_levels(model_kwargs)
+        # Same-cell sidecar seeds descend DEEP: the prior root is exact to
+        # r_tol for an identical configuration, and the expensive
+        # evaluations are the near-root ones (slow-mixing distribution
+        # fixed points cost a ~constant certification floor per evaluation
+        # regardless of warm carry), so every level skipped near the root
+        # saves a floor-cost solve.  2x r_tol keeps the verified ball
+        # strictly containing the root; the continuation still performs
+        # >= 2 certified evaluations.  The |perturb| term covers the
+        # benchmark methodology: a perturbed timed rerun moves the root by
+        # ~perturb * dr*/drho (dr*/drho is O(0.03) on the Table II
+        # lattice, so 4|perturb| has ~100x slack) — without it an f64
+        # rerun's margin (2e-10) sits INSIDE the root shift, every seed
+        # fails verification, and the "warm" sweep pays cold work plus
+        # two verification solves per lane.
+        margin_same = (float(sweep.warm_margin) if sweep.warm_margin > 0.0
+                       else max(2.0 * r_tol, 4.0 * abs(float(perturb)),
+                                16.0 * np.finfo(np.dtype(dtype)).eps
+                                * width))
 
-    results = np.full((n_orig, PACKED_ROW_WIDTH), np.nan)
+    results = np.full((n_orig, schema.width), np.nan)
     solved = np.zeros(n_orig, dtype=bool)
     bucket_of = np.full(n_orig, -1, dtype=np.int64)
     # per-cell launch provenance for the SDC recheck (DESIGN §9): the
@@ -634,10 +649,11 @@ def _solve_scheduled(sweep: SweepConfig, crra, rho, sd, rho_nominal,
             lanes = lanes[balanced_lane_order(pred[lanes], n_shards)]
 
         seeds = None
-        if sweep.warm_brackets:
+        if warm_enabled:
             status_so_far = np.rint(
-                np.nan_to_num(results[:, 6], nan=3.0)).astype(np.int64)
-            solved_ok = (solved & np.isfinite(results[:, 0])
+                np.nan_to_num(results[:, status_col],
+                              nan=3.0)).astype(np.int64)
+            solved_ok = (solved & np.isfinite(results[:, root_col])
                          & ~is_failure(status_so_far))
             targets = []
             for li in lanes:
@@ -647,9 +663,11 @@ def _solve_scheduled(sweep: SweepConfig, crra, rho, sd, rho_nominal,
                     if j is not None and np.isfinite(side.r_star[j]):
                         seed = (float(side.r_star[j]), margin_same)
                 if seed is None:
-                    seed = _neighbor_seed(cells[li], cells, results[:, 0],
+                    seed = _neighbor_seed(cells[li], cells,
+                                          results[:, root_col],
                                           solved_ok, width, r_tol,
-                                          float(sweep.warm_margin))
+                                          float(sweep.warm_margin),
+                                          scale=scn.cells.scale)
                 targets.append(seed)
             known = [t for t in targets if t is not None]
             if known:
@@ -672,10 +690,9 @@ def _solve_scheduled(sweep: SweepConfig, crra, rho, sd, rho_nominal,
                 seeds = per_lane
 
         warm = seeds is not None
-        fn = _batched_solver(dtype, kwargs_items, fault_mode, warm)
-        args = [jnp.asarray(crra[lanes], dtype=dtype),
-                jnp.asarray(rho[lanes], dtype=dtype),
-                jnp.asarray(sd[lanes], dtype=dtype)]
+        fn = scn.batched_solver(dtype, kwargs_items, fault_mode, warm)
+        args = [jnp.asarray(cells_p[lanes, j], dtype=dtype)
+                for j in range(cells_p.shape[1])]
         if warm:
             args += [jnp.asarray(np.asarray([s[0] for s in seeds]),
                                  dtype=dtype),
@@ -703,10 +720,14 @@ def _solve_scheduled(sweep: SweepConfig, crra, rho, sd, rho_nominal,
         # (DESIGN §10): descent/polish step totals subdivide the bucket
         # span proportionally as synthetic children
         bsp.annotate(wall_s=launch_wall)
-        bsp.subdivide({"descent": float(results[bucket, 7].sum()),
-                       "polish": float(results[bucket, 8].sum())},
-                      prefix="sweep/phase/")
-        obs.event("BUCKET_LAUNCH", bucket=int(bi),
+        if schema.phases is not None:
+            bsp.subdivide(
+                {"descent": float(
+                    results[bucket, schema.idx(schema.phases[0])].sum()),
+                 "polish": float(
+                     results[bucket, schema.idx(schema.phases[1])].sum())},
+                prefix="sweep/phase/")
+        obs.event("BUCKET_LAUNCH", bucket=int(bi), scenario=scn.name,
                   cells=[int(c) for c in bucket], warm=warm,
                   wall_s=launch_wall)
         obs.histogram("aiyagari_sweep_bucket_wall_seconds",
@@ -729,7 +750,7 @@ def _solve_scheduled(sweep: SweepConfig, crra, rho, sd, rho_nominal,
 # ---------------------------------------------------------------------------
 
 def sdc_sample(cells: np.ndarray, kwargs_items: tuple, dtype,
-               fraction: float) -> np.ndarray:
+               fraction: float, scenario: str = "aiyagari") -> np.ndarray:
     """The fingerprint-sampled recheck subset: rank cells by their
     ``solution_fingerprint`` (a content hash — uniform-ish over cells,
     deterministic per configuration, uncorrelated with lattice position)
@@ -743,12 +764,13 @@ def sdc_sample(cells: np.ndarray, kwargs_items: tuple, dtype,
         return np.asarray([], dtype=np.int64)
     ranks = np.asarray(
         [solution_fingerprint(cell[0], cell[1], cell[2], kwargs_items,
-                              dtype) for cell in np.asarray(cells)],
+                              dtype, scenario=scenario)
+         for cell in np.asarray(cells)],
         dtype=np.int64)
     return np.sort(np.argsort(ranks, kind="stable")[:min(k, c)])
 
 
-def _sdc_recheck(rows, crra, rho, sd, sample, seeds_used, fault_iters,
+def _sdc_recheck(scn, rows, cells_p, sample, seeds_used, fault_iters,
                  fault_mode, dtype, kwargs_items, device_call):
     """Re-solve the sampled cells through the SAME executable family and
     compare packed rows BITWISE against the batched results.
@@ -776,9 +798,8 @@ def _sdc_recheck(rows, crra, rho, sd, sample, seeds_used, fault_iters,
                           []).append(int(i))
     for warm, idx in sorted(groups.items()):
         lanes = [idx[0]] + idx
-        args = [jnp.asarray(crra[lanes], dtype=dtype),
-                jnp.asarray(rho[lanes], dtype=dtype),
-                jnp.asarray(sd[lanes], dtype=dtype)]
+        args = [jnp.asarray(cells_p[lanes, j], dtype=dtype)
+                for j in range(cells_p.shape[1])]
         if warm:
             seeds = [seeds_used[i] for i in lanes]
             args += [jnp.asarray(np.asarray([s[0] for s in seeds]),
@@ -789,7 +810,7 @@ def _sdc_recheck(rows, crra, rho, sd, sample, seeds_used, fault_iters,
                                             dtype=np.int32))]
         if fault_mode is not None:
             args.append(jnp.asarray(fault_iters[lanes]))
-        fn = _batched_solver(dtype, kwargs_items, fault_mode, warm)
+        fn = scn.batched_solver(dtype, kwargs_items, fault_mode, warm)
         packed, launch_wall = _timed_launch(
             device_call, f"sdc recheck [{len(lanes)}]", fn, args)
         wall += launch_wall
@@ -820,8 +841,584 @@ def _ensure_compilation_cache() -> None:
         enable_compilation_cache()
     except OSError as e:
         warnings.warn(f"persistent compilation cache unavailable: {e}",
-                      stacklevel=4)
+                      stacklevel=5)
     _COMPILATION_CACHE_ON = True   # resolved either way: stop re-checking
+
+
+
+
+# ---------------------------------------------------------------------------
+# Scenario-generic sweep engine (ISSUE 9, DESIGN §12).  ``run_sweep`` runs
+# ANY registered scenario through the full machinery built in PRs 1-8 —
+# balanced scheduling, quarantine, durable resume, SDC rechecks,
+# certification, obs — and ``run_table2_sweep`` is its Aiyagari
+# instantiation (bit-identical to the pre-refactor behavior).
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ScenarioSweepResult:
+    """Per-cell packed rows of one scenario sweep (``run_sweep``), in
+    ORIGINAL cell order.
+
+    ``rows`` is the final ``[C, W]`` float64 block in the scenario's
+    ``RowSchema`` layout — batched results with quarantine outcomes
+    applied, failed cells' ``mask_on_failure`` columns NaN-masked, and
+    the status column synced with ``status``.  Read columns by NAME
+    (``col``/``icol``): hard-coded indices are exactly the coupling the
+    schema exists to remove.  Semantics of ``status``/``retries``/
+    ``bucket``/``predicted_work``/``sdc_suspected``/``cert_level`` and
+    the three wall clocks match ``SweepResult`` field-for-field."""
+
+    scenario: str
+    schema: object            # scenarios.base.RowSchema
+    cells: np.ndarray         # [C, 3] nominal cell coordinates
+    rows: np.ndarray          # [C, W] float64 final packed rows
+    status: np.ndarray        # [C] int64 solver_health codes (final)
+    retries: np.ndarray       # [C] quarantine attempts used
+    wall_seconds: float
+    methods: dict             # scenario-recorded method metadata
+    bucket: Optional[np.ndarray] = None
+    predicted_work: Optional[np.ndarray] = None
+    sdc_suspected: Optional[np.ndarray] = None
+    cert_level: Optional[np.ndarray] = None
+    recheck_wall_seconds: float = 0.0
+    certify_wall_seconds: float = 0.0
+
+    def col(self, name: str) -> np.ndarray:
+        """One named row column (float64 view)."""
+        return self.rows[:, self.schema.idx(name)]
+
+    def icol(self, name: str) -> np.ndarray:
+        """One named counter/status column cast back to int64 (counters
+        ride the device transfer exactly — values ≪ 2^24)."""
+        return np.asarray(np.rint(self.col(name)), dtype=np.int64)
+
+    def failed_cells(self) -> np.ndarray:
+        return np.nonzero(is_failure(self.status))[0]
+
+    def total_work(self) -> np.ndarray:
+        """Per-cell inner-loop step count (the schema's work counters)."""
+        return sum(self.icol(f) for f in self.schema.work)
+
+    def iteration_skew(self) -> float:
+        w = self.total_work()
+        return float(w.max() / max(w.min(), 1))
+
+    def scheduled_iteration_skew(self) -> float:
+        if self.bucket is None:
+            return self.iteration_skew()
+        w = self.total_work()
+        worst = 1.0
+        for b in np.unique(self.bucket[self.bucket >= 0]):
+            wb = w[self.bucket == b]
+            worst = max(worst, float(wb.max() / max(wb.min(), 1)))
+        return worst
+
+
+def run_sweep(scenario, sweep: SweepConfig = SweepConfig(),
+              cells=None, mesh: Optional[Mesh] = None, axis: str = "cells",
+              dtype=None, timer=None, perturb: float = 0.0,
+              quarantine: bool = True, max_retries: int = 3,
+              inject_fault: Optional[dict] = None,
+              resume_path: Optional[str] = None,
+              retry: Optional[RetryPolicy] = None,
+              inject_transient: Optional[dict] = None,
+              inject_preempt: Optional[dict] = None,
+              inject_sdc: Optional[dict] = None,
+              cert_thresholds=None, obs=None,
+              **model_kwargs) -> ScenarioSweepResult:
+    """Solve a cell lattice for any registered ``scenario`` as batched
+    program launches — the scenario-generic engine behind
+    ``run_table2_sweep`` (whose docstring carries the full contract:
+    scheduling, quarantine, resilience, integrity, and observability
+    semantics are identical here, supplied per family by the
+    ``scenarios.Scenario`` bundle).
+
+    ``scenario`` is a registered name (``scenarios.scenario_names()``)
+    or a ``Scenario`` instance; an unknown name raises the typed
+    ``scenarios.UnknownScenarioError``.  ``cells`` is a ``[C, 3]`` array
+    of cell coordinates in the scenario's ``CellSpace`` order (default:
+    ``sweep.cells()`` — the (σ, ρ, sd) lattice every built-in family
+    sweeps).  Scenario identity keys every fingerprint (sidecar, resume
+    ledger, SDC sample, certification), so artifacts can never cross
+    model families."""
+    from ..scenarios.registry import get_scenario
+
+    scn = get_scenario(scenario)
+    if cells is None:
+        cells = sweep.cells()
+    cells = np.asarray(cells, dtype=np.float64)
+    return _run_sweep_shell(
+        scn, sweep, cells, mesh, axis, dtype, timer, perturb, quarantine,
+        max_retries, inject_fault, resume_path, retry, inject_transient,
+        inject_preempt, inject_sdc, cert_thresholds, obs, **model_kwargs)
+
+
+def _run_sweep_shell(scn, sweep, cells, mesh, axis, dtype, timer, perturb,
+                     quarantine, max_retries, inject_fault, resume_path,
+                     retry, inject_transient, inject_preempt, inject_sdc,
+                     cert_thresholds, obs, **model_kwargs):
+    # The observability shell around the solve (ISSUE 7, DESIGN §10):
+    # resolve the obs bundle (argument beats SweepConfig.obs; None is the
+    # near-free NULL_OBS), make it the ACTIVE scope so deep seams
+    # (retry_transient, ledger restore, checksum verification) journal
+    # into this run, and wrap everything in the root "sweep/run" span.
+    # A bundle built HERE from an ObsConfig is owned here — closed (trace
+    # flushed, RUN_END journaled) even when the run exits via the typed
+    # Interrupted; a caller-provided Obs spans multiple subsystems and
+    # stays open.
+    # NOTE: BOTH public entry points (run_sweep, run_table2_sweep) call
+    # this shell directly, so the user's frame sits a uniform FOUR levels
+    # above any warn inside the impl (user -> entry -> shell -> impl) —
+    # every stacklevel-tuned warnings.warn below counts on it.
+    obs, owned = resolve_obs(obs if obs is not None else sweep.obs)
+    try:
+        with obs.activate(), obs.span(
+                "sweep/run", schedule=sweep.schedule,
+                cells=len(cells), scenario=scn.name) as sp:
+            res = _run_sweep_impl(
+                scn, sweep, cells, mesh, axis, dtype, timer, perturb,
+                quarantine, max_retries, inject_fault, resume_path, retry,
+                inject_transient, inject_preempt, inject_sdc,
+                cert_thresholds, obs, **model_kwargs)
+            sp.annotate(wall_s=res.wall_seconds,
+                        skew=res.scheduled_iteration_skew(),
+                        failed_cells=len(res.failed_cells()))
+            return res
+    finally:
+        if owned:
+            obs.close()
+
+
+def _run_sweep_impl(scn, sweep, cells_nom, mesh, axis, dtype, timer,
+                    perturb, quarantine, max_retries, inject_fault,
+                    resume_path, retry, inject_transient, inject_preempt,
+                    inject_sdc, cert_thresholds, obs,
+                    **model_kwargs) -> ScenarioSweepResult:
+    schema = scn.schema
+    status_col = schema.idx(schema.status)
+    root_col = schema.idx(schema.root)
+    cells_p = np.array(cells_nom, dtype=np.float64)   # solver inputs
+    if perturb:
+        cells_p[:, scn.cells.perturb_axis] = (
+            cells_p[:, scn.cells.perturb_axis] + perturb)
+    n_orig = cells_p.shape[0]
+    dtype = _canonical_dtype(dtype)
+    if sweep.compilation_cache:
+        _ensure_compilation_cache()
+    fault_mode = None
+    fault_iters = None
+    if inject_fault is not None:
+        fault_mode = str(inject_fault.get("mode", "nan"))
+        fault_iters = np.full(n_orig, -1, dtype=np.int32)
+        fault_iters[int(inject_fault["cell"])] = int(
+            inject_fault.get("at_iter", 0))
+
+    # family-level sweep kwarg defaults (e.g. Aiyagari's backend-aware
+    # dist_method/egm_method selection) applied IN PLACE; the returned
+    # metadata records what actually runs
+    methods = dict(scn.prepare_kwargs(model_kwargs) or {})
+
+    kwargs_items = _hashable_kwargs(model_kwargs)
+    schedule = sweep.schedule
+    if schedule == "auto":
+        # Balanced by default only where dispatch is cheap: through the
+        # tunneled TPU every launch costs ~0.7 s round trip
+        # (bench ``dispatch_roundtrip_s``), so bucketing a small batch
+        # there trades straggler waste for a larger fixed cost — and the
+        # pallas lane grid already de-stragglers the dominant
+        # distribution loop per lane.  Accelerator callers opt in
+        # explicitly (the bench's warm-scheduled phase does).
+        on_accel = jax.default_backend() in ("tpu", "axon")
+        schedule = "balanced" if (n_orig >= 8 and not on_accel) else "locked"
+    if schedule not in ("balanced", "locked"):
+        raise ValueError(f"schedule must be 'auto', 'balanced' or "
+                         f"'locked', got {sweep.schedule!r}")
+
+    # -- resilience plumbing (ISSUE 3): sidecar hoisted up here because
+    # the resume ledger's fingerprint must cover its CONTENT (warm seeds
+    # read it live, so a sidecar swapped between interrupt and resume
+    # would silently change trajectories); transient-retry wrapper around
+    # every device launch; the per-bucket resume ledger itself.
+    side = None
+    if schedule == "balanced" and sweep.work_model in ("auto", "sidecar"):
+        side = _load_sidecar(sweep.sidecar_path,
+                             _work_fingerprint(kwargs_items, dtype,
+                                               scenario=scn.name))
+        if sweep.work_model == "sidecar" and side is None:
+            warnings.warn("work_model='sidecar' but no valid sidecar at "
+                          f"{sweep.sidecar_path!r}; using the heuristic",
+                          stacklevel=4)
+    retry_policy = retry if retry is not None else RetryPolicy()
+    injector = (TransientInjector.from_spec(inject_transient)
+                if inject_transient is not None else None)
+
+    def device_call(label, f):
+        return retry_transient(f, retry_policy, inject=injector,
+                               label=label)
+
+    if resume_path is None:
+        resume_path = sweep.resume_path
+    ledger = None
+    if resume_path is not None:
+        ledger_fp = ledger_fingerprint(
+            cells_p, kwargs_items, dtype, schedule,
+            sweep.n_buckets, sweep.warm_brackets, sweep.warm_margin,
+            fault_mode, fault_iters, max_retries, quarantine, side,
+            scenario=scn.name, row_fields=schema.fields)
+        ledger = LedgerState.resume(resume_path, ledger_fp, n_orig,
+                                    width=schema.width)
+
+    bucket_of = None
+    pred = None
+    seeds_used: list = [None] * n_orig
+    restored_mask = np.zeros(n_orig, dtype=bool)
+    if schedule == "balanced":
+        (packed, wall, bucket_of, pred, seeds_used,
+         restored_mask) = _solve_scheduled(
+            scn, sweep, cells_p, cells_nom, fault_iters, fault_mode,
+            mesh, axis, dtype, kwargs_items, model_kwargs,
+            perturb=perturb, side=side, ledger=ledger,
+            device_call=device_call, inject_preempt=inject_preempt,
+            obs=obs)
+        sl = slice(0, n_orig)
+    elif ledger is not None and ledger.solved.all():
+        # locked path, fully solved by the interrupted run: restore the
+        # batched phase from the ledger (quarantine may still be pending)
+        packed = ledger.packed
+        wall = 0.0
+        sl = slice(0, n_orig)
+    else:
+        if mesh is not None:
+            shard = sharding(mesh, axis)
+            n_shards = mesh.shape[axis]
+            cols = []
+            for j in range(cells_p.shape[1]):
+                col_d, _ = pad_to_multiple(cells_p[:, j], n_shards)
+                cols.append(jax.device_put(
+                    jnp.asarray(col_d, dtype=dtype), shard))
+            fault_d = None
+            if fault_iters is not None:
+                # edge-replication padding may duplicate the LAST cell; pad
+                # with healthy -1 lanes instead so a fault is injected
+                # exactly once
+                pad = cols[0].shape[0] - n_orig
+                fault_d = np.concatenate(
+                    [fault_iters, np.full(pad, -1, dtype=np.int32)])
+                fault_d = jax.device_put(jnp.asarray(fault_d), shard)
+        else:
+            cols = [jnp.asarray(cells_p[:, j], dtype=dtype)
+                    for j in range(cells_p.shape[1])]
+            fault_d = (None if fault_iters is None
+                       else jnp.asarray(fault_iters))
+
+        fn = scn.batched_solver(dtype, kwargs_items, fault_mode, False)
+        args = tuple(cols) if fault_d is None else (*cols, fault_d)
+        with obs.span("sweep/bucket", bucket=0, cells=n_orig,
+                      warm=False, device_profile=True) as bsp:
+            packed, wall = _timed_launch(       # [C, W], one transfer
+                device_call, "sweep launch", fn, args)
+        bsp.annotate(wall_s=wall)
+        if schema.phases is not None:
+            d_col = schema.idx(schema.phases[0])
+            p_col = schema.idx(schema.phases[1])
+            bsp.subdivide(
+                {"descent": float(np.asarray(packed)[:n_orig, d_col].sum()),
+                 "polish": float(np.asarray(packed)[:n_orig, p_col].sum())},
+                prefix="sweep/phase/")
+        obs.event("BUCKET_LAUNCH", bucket=0, scenario=scn.name,
+                  cells=list(range(n_orig)), warm=False, wall_s=wall)
+        obs.histogram("aiyagari_sweep_bucket_wall_seconds",
+                      "per-bucket launch wall").observe(wall)
+        # the single lock-step launch is bucket 0 of 1 to the seam protocol
+        _resilience_seam(
+            ledger,
+            lambda led: led.record_bucket(np.arange(n_orig),
+                                          np.asarray(packed)[:n_orig], 0),
+            progress={"completed_buckets": 1, "n_buckets": 1},
+            inject_preempt=inject_preempt, bucket_id=0)
+        sl = slice(0, n_orig)
+    if timer is not None:
+        timer(wall)
+
+    # ONE host copy of the packed rows (the device transfer's buffer is
+    # read-only; the injection/quarantine paths write rows in place)
+    rows = np.array(np.asarray(packed), dtype=np.float64)[sl]
+
+    def cell_attrs(i):
+        # per-cell event attributes named by the scenario's axes (the
+        # Aiyagari space keeps the historical crra/rho/sd keys)
+        return {name: float(cells_nom[i, j])
+                for j, name in enumerate(scn.cells.names)}
+
+    # -- SDC injection + spot recheck (DESIGN §9) ---------------------------
+    # Injection corrupts the host copy AFTER the solve (and after the
+    # ledger recorded the true bits) — the silent-data-corruption model:
+    # finite numbers, healthy status, wrong bits.
+    if inject_sdc is not None:
+        ci = int(inject_sdc["cell"])
+        if "bit" in inject_sdc:
+            from ..verify.inject import flip_row_bit
+
+            rows[ci] = flip_row_bit(rows[ci],
+                                    field=int(inject_sdc.get("field", 0)),
+                                    bit=int(inject_sdc["bit"]))
+        else:
+            rows[ci, int(inject_sdc.get("field", 0))] += float(
+                inject_sdc.get("amplitude", 1e-6))
+    sdc_suspected = None
+    recheck_wall = 0.0
+    if sweep.recheck_fraction > 0.0:
+        sample = sdc_sample(cells_nom, kwargs_items, dtype,
+                            sweep.recheck_fraction, scenario=scn.name)
+        # Two classes of ledger-restored cell cannot be bitwise-rechecked
+        # against a fresh batched launch, and are skipped LOUDLY, never
+        # silently: warm-bracket cells whose launch seeds were not
+        # recorded, and quarantine-RETRIED cells — their restored row is
+        # the serial quarantine outcome, which the batched executable can
+        # never reproduce (a mismatch there would be a false alarm, not
+        # corruption).
+        skipped = []
+        if sweep.warm_brackets and restored_mask.any():
+            skipped += [int(i) for i in sample if restored_mask[i]
+                        and seeds_used[int(i)] is None]
+        if ledger is not None and ledger.retried.any():
+            skipped += [int(i) for i in sample
+                        if ledger.retried[i] and int(i) not in skipped]
+        if skipped:
+            warnings.warn(
+                f"sdc recheck: skipping ledger-restored cell(s) "
+                f"{sorted(skipped)} (warm seeds unknown, or the row is a "
+                f"serial quarantine outcome)", stacklevel=4)
+            sample = np.asarray([i for i in sample
+                                 if int(i) not in set(skipped)],
+                                dtype=np.int64)
+        with obs.span("sweep/sdc_recheck", sampled=len(sample)) as rsp:
+            suspects, recheck_wall = _sdc_recheck(
+                scn, rows, cells_p, sample, seeds_used, fault_iters,
+                fault_mode, dtype, kwargs_items, device_call)
+        rsp.annotate(wall_s=recheck_wall, suspects=len(suspects))
+        sdc_suspected = np.zeros(n_orig, dtype=bool)
+        sdc_suspected[suspects] = True
+        for i in suspects:
+            obs.event("SDC_SUSPECTED", cell=int(i), scenario=scn.name,
+                      **cell_attrs(i))
+        obs.counter("aiyagari_sweep_sdc_suspected_total",
+                    "bitwise recheck mismatches").inc(len(suspects))
+        if suspects:
+            warnings.warn(
+                "sdc recheck: bitwise mismatch for cell(s) "
+                + ", ".join(str(i) for i in suspects)
+                + " — silent data corruption suspected; routing through "
+                "the quarantine ladder", stacklevel=4)
+
+    # The counters and status rode the device transfer in the float dtype
+    # (exact — values ≪ 2^24, which f32 represents without rounding); the
+    # status array is the int64 authority from here on and is synced back
+    # into the rows' status column before anything downstream reads them.
+    status = np.asarray(np.rint(rows[:, status_col]), dtype=np.int64)
+    retries = np.zeros(n_orig, dtype=np.int64)
+
+    # Host-side escalation: quarantine failed cells and walk the bounded
+    # retry ladder serially (never re-injecting a fault, never reusing a
+    # warm bracket seed).  Runs after the timed batched solve —
+    # wall_seconds stays the honest batched-program wall.
+    # Cells whose quarantine ladder already completed in an interrupted
+    # run: restore the final outcome (recovered values or the exhausted
+    # failing status) and the rung count bit-exactly — a recovered cell's
+    # ledger row holds a HEALTHY status, so it must be excluded from the
+    # failure scan below, not re-walked.
+    restored_retry = np.zeros(n_orig, dtype=bool)
+    if ledger is not None and quarantine:
+        for i in np.nonzero(ledger.retried)[0]:
+            rows[i] = ledger.packed[i]
+            status[i] = int(np.rint(rows[i, status_col]))
+            retries[i] = int(ledger.retries[i])
+            restored_retry[i] = True
+    demoted = np.zeros(n_orig, dtype=bool)
+    if sdc_suspected is not None:
+        # a suspected cell's batched numbers are untrusted no matter how
+        # healthy its status looks: demote it to NONFINITE (corrupt bits
+        # ARE garbage) so the quarantine ladder re-solves it; whatever
+        # the ladder cannot recover is purged wholesale after it runs
+        demoted = sdc_suspected & ~restored_retry
+        status[demoted] = NONFINITE
+    failed = is_failure(status) & ~restored_retry
+    if quarantine and (failed.any() or restored_retry.any()):
+        ladder = tuple(scn.retry_rungs(model_kwargs))[
+            :max(0, int(max_retries))]
+        for i in np.nonzero(failed)[0]:
+            status_before = int(status[i])
+            for attempt, overrides in enumerate(ladder, start=1):
+                retries[i] = attempt
+                with obs.span("sweep/quarantine", cell=int(i),
+                              rung=attempt):
+                    row_new = device_call(
+                        f"quarantine retry cell {int(i)}",
+                        lambda: scn.eager_row(
+                            cells_p[i], dtype,
+                            {**model_kwargs, **overrides}))
+                row_new = np.asarray(row_new, dtype=np.float64)
+                cell_status = int(np.rint(row_new[status_col]))
+                if not is_failure(cell_status):
+                    rows[i] = row_new
+                    status[i] = cell_status
+                    break
+            obs.event("QUARANTINE", cell=int(i), scenario=scn.name,
+                      **cell_attrs(i),
+                      status_before=status_name(status_before),
+                      status_after=status_name(int(status[i])),
+                      recovered=not bool(is_failure(int(status[i]))),
+                      retries=int(retries[i]))
+            obs.counter("aiyagari_sweep_quarantined_cells_total",
+                        "cells routed through the retry ladder").inc()
+            # quarantine seam: the outcome (recovered or exhausted) is
+            # final for this run — same commit-then-poll protocol as the
+            # launch seams
+            row_led = rows[i].copy()
+            row_led[status_col] = float(status[i])
+            _resilience_seam(
+                ledger,
+                lambda led: led.record_retry(int(i), row_led,
+                                             int(retries[i])),
+                progress={"retried_cell": int(i)})
+        still = np.nonzero(is_failure(status))[0]
+        # NaN-mask what the retries could not certify: a failed cell must
+        # read as failed everywhere, not as a plausible number
+        for f in schema.mask_on_failure:
+            rows[still, schema.idx(f)] = np.nan
+        if len(still):
+            warnings.warn(
+                f"{scn.name} sweep: cells "
+                + ", ".join(f"{int(i)} ({status_name(status[i])})"
+                            for i in still)
+                + " failed every quarantine retry; their values are "
+                "NaN-masked in the result", stacklevel=4)
+
+    # KNOWN-corrupt cells no retry recovered (or that had no ladder to
+    # run) must not leak ANY field into the result or the sidecar work
+    # model: an honest MAX_ITER best-iterate keeps its labor/counters,
+    # corrupt bits keep nothing — the sidecar's warm-seed rule trusts
+    # any finite root and its bucket planner trusts the counters.
+    zero_fields = tuple(schema.counters) + tuple(schema.phases or ())
+    value_fields = tuple(f for f in schema.fields
+                         if f != schema.status and f not in zero_fields)
+    purge = demoted & is_failure(status)
+    if purge.any():
+        for f in value_fields:
+            rows[purge, schema.idx(f)] = np.nan
+        for f in zero_fields:
+            rows[purge, schema.idx(f)] = 0.0
+
+    # sync the int64 status authority back into the packed rows: every
+    # downstream consumer (sidecar, certifier, ledger already handled,
+    # the returned result) reads ONE consistent block
+    rows[:, status_col] = status.astype(np.float64)
+
+    # Precision-ladder escalations (DESIGN §5) as journal events: the
+    # counter rode the packed row out of the jitted program; the journal
+    # line is where "which cell abandoned its cheap descent" becomes
+    # greppable next to the bucket that ran it.
+    escal = None
+    if schema.phases is not None:
+        escal = np.asarray(np.rint(rows[:, schema.idx(schema.phases[2])]),
+                           dtype=np.int64)
+        for i in np.nonzero(escal > 0)[0]:
+            obs.event("PRECISION_ESCALATED", cell=int(i),
+                      scenario=scn.name, **cell_attrs(i),
+                      escalations=int(escal[i]))
+
+    if sweep.sidecar_path is not None:
+        # persist this run's counters/roots for the next run's scheduler
+        # (work model + warm brackets); best-effort — an unwritable path
+        # must not take down a finished solve
+        c0, c1, c2 = (np.asarray(np.rint(rows[:, schema.idx(c)]),
+                                 dtype=np.int64) for c in schema.counters)
+        phase_kw = {}
+        if schema.phases is not None:
+            phase_kw = dict(
+                descent_steps=np.asarray(
+                    np.rint(rows[:, schema.idx(schema.phases[0])]),
+                    dtype=np.int64),
+                polish_steps=np.asarray(
+                    np.rint(rows[:, schema.idx(schema.phases[1])]),
+                    dtype=np.int64))
+        try:
+            save_sweep_sidecar(
+                sweep.sidecar_path, cells_nom, rows[:, root_col],
+                c0, c1, c2, status,
+                _work_fingerprint(kwargs_items, dtype, scenario=scn.name),
+                **phase_kw)
+        except OSError as e:
+            warnings.warn(f"could not write sweep sidecar "
+                          f"{sweep.sidecar_path!r}: {e}", stacklevel=4)
+
+    # -- a posteriori certification (DESIGN §9) -----------------------------
+    # Runs on the FINAL values (quarantine outcomes included), outside
+    # the timed wall: one vmapped recompute-certifier launch over the
+    # healthy cells; failed cells certify FAILED trivially.  Runs BEFORE
+    # ledger.complete() and through device_call (transient retry), so a
+    # certification-time fault cannot cost a completed sweep its resume
+    # state — a restarted run restores every cell and re-certifies.
+    cert_level = None
+    certify_wall = 0.0
+    if sweep.certify:
+        if scn.certify_rows is None:
+            raise ValueError(
+                f"scenario {scn.name!r} has no certify_rows hook; "
+                "run without SweepConfig(certify=True)")
+        t0 = time.perf_counter()
+        with obs.span("sweep/certify", cells=n_orig) as csp:
+            certs = device_call(
+                "a posteriori certification",
+                lambda: scn.certify_rows(
+                    rows, cells_p, dtype, kwargs_items,
+                    thresholds=cert_thresholds))
+        cert_level = np.asarray([c.level for c in certs], dtype=np.int64)
+        certify_wall = time.perf_counter() - t0
+        csp.annotate(wall_s=certify_wall,
+                     failed=int((cert_level == 2).sum()))
+        for i in np.nonzero(cert_level == 2)[0]:
+            obs.event("CERT_FAILED", cell=int(i), scenario=scn.name,
+                      **cell_attrs(i), summary=certs[int(i)].summary())
+        obs.counter("aiyagari_sweep_cert_failed_total",
+                    "cells whose certificate graded FAILED").inc(
+            int((cert_level == 2).sum()))
+
+    if ledger is not None:
+        # the run completed: a finished ledger must not satisfy the next
+        # run's launches silently
+        ledger.complete()
+
+    # Mirror the run's counters into the metrics registry (ISSUE 7): the
+    # result dataclass keeps its API; the registry is where the same
+    # numbers become scrapeable/snapshot-able alongside serve's.
+    work_total = sum(
+        np.asarray(np.rint(rows[:, schema.idx(f)]), dtype=np.int64)
+        for f in schema.work)
+    obs.counter("aiyagari_sweep_cells_total",
+                "cells solved by sweeps this run").inc(n_orig)
+    obs.counter("aiyagari_sweep_inner_steps_total",
+                "EGM + distribution inner steps").inc(
+        float(work_total.sum()))
+    obs.counter("aiyagari_sweep_quarantine_retries_total",
+                "quarantine ladder rungs consumed").inc(
+        int(retries.sum()))
+    if escal is not None:
+        obs.counter("aiyagari_sweep_precision_escalations_total",
+                    "ladder descent->reference fallbacks").inc(
+            int(escal.sum()))
+    obs.gauge("aiyagari_sweep_wall_seconds",
+              "last sweep's honest batched wall").set(wall)
+
+    return ScenarioSweepResult(
+        scenario=scn.name, schema=schema,
+        cells=np.asarray(cells_nom, dtype=np.float64), rows=rows,
+        status=status, retries=retries, wall_seconds=wall,
+        methods=methods, bucket=bucket_of, predicted_work=pred,
+        sdc_suspected=sdc_suspected, cert_level=cert_level,
+        recheck_wall_seconds=recheck_wall,
+        certify_wall_seconds=certify_wall)
 
 
 def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
@@ -836,43 +1433,11 @@ def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
                      inject_sdc: Optional[dict] = None,
                      cert_thresholds=None, obs=None,
                      **model_kwargs) -> SweepResult:
-    # The observability shell around the solve (ISSUE 7, DESIGN §10):
-    # resolve the obs bundle (argument beats SweepConfig.obs; None is the
-    # near-free NULL_OBS), make it the ACTIVE scope so deep seams
-    # (retry_transient, ledger restore, checksum verification) journal
-    # into this run, and wrap everything in the root "sweep/run" span.
-    # A bundle built HERE from an ObsConfig is owned here — closed (trace
-    # flushed, RUN_END journaled) even when the run exits via the typed
-    # Interrupted; a caller-provided Obs spans multiple subsystems and
-    # stays open.  The full sweep contract is documented on
-    # ``_run_table2_sweep_impl`` (re-exported onto this wrapper below).
-    # NOTE: this wrapper adds one stack frame between the user and the
-    # impl — every stacklevel-tuned warnings.warn inside counts it.
-    obs, owned = resolve_obs(obs if obs is not None else sweep.obs)
-    try:
-        with obs.activate(), obs.span(
-                "sweep/run", schedule=sweep.schedule,
-                cells=len(sweep.cells())) as sp:
-            res = _run_table2_sweep_impl(
-                sweep, mesh, axis, dtype, timer, perturb, quarantine,
-                max_retries, inject_fault, resume_path, retry,
-                inject_transient, inject_preempt, inject_sdc,
-                cert_thresholds, obs, **model_kwargs)
-            sp.annotate(wall_s=res.wall_seconds,
-                        skew=res.scheduled_iteration_skew(),
-                        failed_cells=len(res.failed_cells()))
-            return res
-    finally:
-        if owned:
-            obs.close()
-
-
-def _run_table2_sweep_impl(sweep, mesh, axis, dtype, timer, perturb,
-                           quarantine, max_retries, inject_fault,
-                           resume_path, retry, inject_transient,
-                           inject_preempt, inject_sdc, cert_thresholds,
-                           obs, **model_kwargs) -> SweepResult:
-    """Solve every (σ, ρ, sd) cell as batched program launches.
+    """Solve every (σ, ρ, sd) cell as batched program launches — the
+    Aiyagari Table II instantiation of the scenario-generic ``run_sweep``
+    (ISSUE 9: this wrapper IS ``run_sweep(scenario="aiyagari", ...)``
+    plus the Table II closed forms, bit-identical to the pre-scenario
+    engine).
 
     Scheduling: ``sweep.schedule`` picks between the single lock-step
     launch ("locked" — every lane runs until the slowest cell converges)
@@ -896,7 +1461,8 @@ def _run_table2_sweep_impl(sweep, mesh, axis, dtype, timer, perturb,
     With ``quarantine`` on (the default), failed cells (MAX_ITER /
     NONFINITE — a single diverged calibration must not poison the batch)
     are NaN-masked and re-run serially on the host through the bounded
-    ``_retry_ladder`` (up to ``max_retries`` rungs: alternate
+    scenario retry ladder (up to ``max_retries`` rungs;
+    ``scenarios.Scenario.retry_rungs`` — for Aiyagari: alternate
     distribution method — reused on every rung, never the known-failing
     one — damped updates, padded bracket); a recovered cell's values and
     counters replace the quarantined ones, a cell that exhausts the
@@ -981,455 +1547,39 @@ def _run_table2_sweep_impl(sweep, mesh, axis, dtype, timer, perturb,
     bits — ``wall_seconds`` semantics are untouched either way (spans
     bracket the same clock reads the honest wall already makes).
     """
-    cells = np.asarray(sweep.cells(), dtype=np.float64)  # [C, 3] (σ, ρ, sd)
-    crra, rho, sd = cells[:, 0], cells[:, 1], cells[:, 2]
-    rho_label = rho             # result metadata keeps the nominal ρ values
-    if perturb:
-        rho = rho + perturb
-    n_orig = crra.shape[0]
-    dtype = _canonical_dtype(dtype)
-    if sweep.compilation_cache:
-        _ensure_compilation_cache()
-    fault_mode = None
-    fault_iters = None
-    if inject_fault is not None:
-        fault_mode = str(inject_fault.get("mode", "nan"))
-        fault_iters = np.full(n_orig, -1, dtype=np.int32)
-        fault_iters[int(inject_fault["cell"])] = int(
-            inject_fault.get("at_iter", 0))
+    from ..scenarios.registry import get_scenario
 
-    two_phase = model_kwargs.get("precision", "reference") != "reference"
-    if "dist_method" not in model_kwargs:
-        # Sweep-level default, distinct from stationary_wealth's "auto".
-        # On accelerators: "pallas" — the lane-grid kernel (one program
-        # instance per cell via the custom_vmap batching rule,
-        # ``household._pallas_fixed_point_vmappable``) lets every cell's
-        # distribution fixed point exit at its OWN convergence instead of
-        # vmap-of-while lock-step, measured 1.26 s vs dense's 2.16 s on
-        # the 12-cell sweep (one v5e chip, identical r*).  Fallback
-        # "dense" (batched MXU matvecs) when Mosaic can't compile the
-        # kernel.  NOT "solve" — with the EGM Anderson acceleration and
-        # the stall exit in place, iterating the dense operator beats
-        # paying a (D*N)^3 LU per midpoint (measured: dense 2.8s vs solve
-        # 4.8s).  On CPU, "auto" (scatter) — dense/LU/pallas are the
-        # wrong trade there.
-        if jax.default_backend() in ("tpu", "axon"):
-            if two_phase:
-                # the precision ladder needs the two-phase XLA paths (the
-                # VMEM kernel runs one precision end-to-end); dense IS the
-                # ladder's MXU path, so record what actually runs
-                model_kwargs["dist_method"] = "dense"
-            else:
-                from ..ops.pallas_kernels import pallas_grid_tpu_available
-                model_kwargs["dist_method"] = (
-                    "pallas" if pallas_grid_tpu_available() else "dense")
-        else:
-            model_kwargs["dist_method"] = "auto"
-    if "egm_method" not in model_kwargs:
-        # Same default logic for the POLICY loop (ISSUE 2 tentpole): the
-        # lane-grid EGM kernel lets a converged cell stop burning MXU
-        # cycles instead of lock-stepping to the slowest lane; probe-gated
-        # with the XLA while_loop as the universal fallback.
-        if jax.default_backend() in ("tpu", "axon") and not two_phase:
-            from ..ops.pallas_kernels import pallas_egm_grid_tpu_available
-            model_kwargs["egm_method"] = (
-                "pallas" if pallas_egm_grid_tpu_available() else "xla")
-        else:
-            model_kwargs["egm_method"] = "xla"
+    # calls the SHELL, not run_sweep, so warnings raised inside the impl
+    # sit the same number of frames below a run_table2_sweep caller as
+    # below a run_sweep caller (see the depth NOTE on _run_sweep_shell)
+    res = _run_sweep_shell(
+        get_scenario("aiyagari"), sweep,
+        np.asarray(sweep.cells(), dtype=np.float64), mesh, axis, dtype,
+        timer, perturb, quarantine, max_retries, inject_fault,
+        resume_path, retry, inject_transient, inject_preempt, inject_sdc,
+        cert_thresholds, obs, **model_kwargs)
 
-    kwargs_items = _hashable_kwargs(model_kwargs)
-    schedule = sweep.schedule
-    if schedule == "auto":
-        # Balanced by default only where dispatch is cheap: through the
-        # tunneled TPU every launch costs ~0.7 s round trip
-        # (bench ``dispatch_roundtrip_s``), so bucketing a small batch
-        # there trades straggler waste for a larger fixed cost — and the
-        # pallas lane grid already de-stragglers the dominant
-        # distribution loop per lane.  Accelerator callers opt in
-        # explicitly (the bench's warm-scheduled phase does).
-        on_accel = jax.default_backend() in ("tpu", "axon")
-        schedule = "balanced" if (n_orig >= 8 and not on_accel) else "locked"
-    if schedule not in ("balanced", "locked"):
-        raise ValueError(f"schedule must be 'auto', 'balanced' or "
-                         f"'locked', got {sweep.schedule!r}")
-
-    # -- resilience plumbing (ISSUE 3): sidecar hoisted up here because
-    # the resume ledger's fingerprint must cover its CONTENT (warm seeds
-    # read it live, so a sidecar swapped between interrupt and resume
-    # would silently change trajectories); transient-retry wrapper around
-    # every device launch; the per-bucket resume ledger itself.
-    side = None
-    if schedule == "balanced" and sweep.work_model in ("auto", "sidecar"):
-        side = _load_sidecar(sweep.sidecar_path,
-                             _work_fingerprint(kwargs_items, dtype))
-        if sweep.work_model == "sidecar" and side is None:
-            warnings.warn("work_model='sidecar' but no valid sidecar at "
-                          f"{sweep.sidecar_path!r}; using the heuristic",
-                          stacklevel=3)
-    retry_policy = retry if retry is not None else RetryPolicy()
-    injector = (TransientInjector.from_spec(inject_transient)
-                if inject_transient is not None else None)
-
-    def device_call(label, f):
-        return retry_transient(f, retry_policy, inject=injector,
-                               label=label)
-
-    if resume_path is None:
-        resume_path = sweep.resume_path
-    ledger = None
-    if resume_path is not None:
-        ledger_fp = ledger_fingerprint(
-            crra, rho, sd, kwargs_items, dtype, schedule,
-            sweep.n_buckets, sweep.warm_brackets, sweep.warm_margin,
-            fault_mode, fault_iters, max_retries, quarantine, side)
-        ledger = LedgerState.resume(resume_path, ledger_fp, n_orig)
-
-    bucket_of = None
-    pred = None
-    seeds_used: list = [None] * n_orig
-    restored_mask = np.zeros(n_orig, dtype=bool)
-    if schedule == "balanced":
-        (packed, wall, bucket_of, pred, seeds_used,
-         restored_mask) = _solve_scheduled(
-            sweep, crra, rho, sd, rho_label, fault_iters, fault_mode,
-            mesh, axis, dtype, kwargs_items, model_kwargs,
-            perturb=perturb, side=side, ledger=ledger,
-            device_call=device_call, inject_preempt=inject_preempt,
-            obs=obs)
-        sl = slice(0, n_orig)
-    elif ledger is not None and ledger.solved.all():
-        # locked path, fully solved by the interrupted run: restore the
-        # batched phase from the ledger (quarantine may still be pending)
-        packed = ledger.packed
-        wall = 0.0
-        sl = slice(0, n_orig)
-    else:
-        if mesh is not None:
-            shard = sharding(mesh, axis)
-            n_shards = mesh.shape[axis]
-            crra_d, _ = pad_to_multiple(crra, n_shards)
-            rho_d, _ = pad_to_multiple(rho, n_shards)
-            sd_d, _ = pad_to_multiple(sd, n_shards)
-            crra_d = jax.device_put(jnp.asarray(crra_d, dtype=dtype), shard)
-            rho_d = jax.device_put(jnp.asarray(rho_d, dtype=dtype), shard)
-            sd_d = jax.device_put(jnp.asarray(sd_d, dtype=dtype), shard)
-            fault_d = None
-            if fault_iters is not None:
-                # edge-replication padding may duplicate the LAST cell; pad
-                # with healthy -1 lanes instead so a fault is injected
-                # exactly once
-                pad = crra_d.shape[0] - n_orig
-                fault_d = np.concatenate(
-                    [fault_iters, np.full(pad, -1, dtype=np.int32)])
-                fault_d = jax.device_put(jnp.asarray(fault_d), shard)
-        else:
-            crra_d = jnp.asarray(crra, dtype=dtype)
-            rho_d = jnp.asarray(rho, dtype=dtype)
-            sd_d = jnp.asarray(sd, dtype=dtype)
-            fault_d = (None if fault_iters is None
-                       else jnp.asarray(fault_iters))
-
-        fn = _batched_solver(dtype, kwargs_items, fault_mode)
-        args = ((crra_d, rho_d, sd_d) if fault_d is None
-                else (crra_d, rho_d, sd_d, fault_d))
-        with obs.span("sweep/bucket", bucket=0, cells=n_orig,
-                      warm=False, device_profile=True) as bsp:
-            packed, wall = _timed_launch(       # [C, W], one transfer
-                device_call, "sweep launch", fn, args)
-        bsp.annotate(wall_s=wall)
-        bsp.subdivide(
-            {"descent": float(np.asarray(packed)[:n_orig, 7].sum()),
-             "polish": float(np.asarray(packed)[:n_orig, 8].sum())},
-            prefix="sweep/phase/")
-        obs.event("BUCKET_LAUNCH", bucket=0,
-                  cells=list(range(n_orig)), warm=False, wall_s=wall)
-        obs.histogram("aiyagari_sweep_bucket_wall_seconds",
-                      "per-bucket launch wall").observe(wall)
-        # the single lock-step launch is bucket 0 of 1 to the seam protocol
-        _resilience_seam(
-            ledger,
-            lambda led: led.record_bucket(np.arange(n_orig),
-                                          np.asarray(packed)[:n_orig], 0),
-            progress={"completed_buckets": 1, "n_buckets": 1},
-            inject_preempt=inject_preempt, bucket_id=0)
-        sl = slice(0, n_orig)
-    if timer is not None:
-        timer(wall)
-
-    # ONE host copy of the packed rows (the device transfer's buffer is
-    # read-only; the injection/quarantine paths write rows in place)
-    rows = np.array(np.asarray(packed), dtype=np.float64)[sl]
-
-    # -- SDC injection + spot recheck (DESIGN §9) ---------------------------
-    # Injection corrupts the host copy AFTER the solve (and after the
-    # ledger recorded the true bits) — the silent-data-corruption model:
-    # finite numbers, healthy status, wrong bits.
-    if inject_sdc is not None:
-        ci = int(inject_sdc["cell"])
-        if "bit" in inject_sdc:
-            from ..verify.inject import flip_row_bit
-
-            rows[ci] = flip_row_bit(rows[ci],
-                                    field=int(inject_sdc.get("field", 0)),
-                                    bit=int(inject_sdc["bit"]))
-        else:
-            rows[ci, int(inject_sdc.get("field", 0))] += float(
-                inject_sdc.get("amplitude", 1e-6))
-    sdc_suspected = None
-    recheck_wall = 0.0
-    if sweep.recheck_fraction > 0.0:
-        sample = sdc_sample(np.stack([crra, rho_label, sd], axis=1),
-                            kwargs_items, dtype, sweep.recheck_fraction)
-        # Two classes of ledger-restored cell cannot be bitwise-rechecked
-        # against a fresh batched launch, and are skipped LOUDLY, never
-        # silently: warm-bracket cells whose launch seeds were not
-        # recorded, and quarantine-RETRIED cells — their restored row is
-        # the serial quarantine outcome, which the batched executable can
-        # never reproduce (a mismatch there would be a false alarm, not
-        # corruption).
-        skipped = []
-        if sweep.warm_brackets and restored_mask.any():
-            skipped += [int(i) for i in sample if restored_mask[i]
-                        and seeds_used[int(i)] is None]
-        if ledger is not None and ledger.retried.any():
-            skipped += [int(i) for i in sample
-                        if ledger.retried[i] and int(i) not in skipped]
-        if skipped:
-            warnings.warn(
-                f"sdc recheck: skipping ledger-restored cell(s) "
-                f"{sorted(skipped)} (warm seeds unknown, or the row is a "
-                f"serial quarantine outcome)", stacklevel=3)
-            sample = np.asarray([i for i in sample
-                                 if int(i) not in set(skipped)],
-                                dtype=np.int64)
-        with obs.span("sweep/sdc_recheck", sampled=len(sample)) as rsp:
-            suspects, recheck_wall = _sdc_recheck(
-                rows, crra, rho, sd, sample, seeds_used, fault_iters,
-                fault_mode, dtype, kwargs_items, device_call)
-        rsp.annotate(wall_s=recheck_wall, suspects=len(suspects))
-        sdc_suspected = np.zeros(n_orig, dtype=bool)
-        sdc_suspected[suspects] = True
-        for i in suspects:
-            obs.event("SDC_SUSPECTED", cell=int(i),
-                      crra=float(crra[i]), rho=float(rho_label[i]),
-                      sd=float(sd[i]))
-        obs.counter("aiyagari_sweep_sdc_suspected_total",
-                    "bitwise recheck mismatches").inc(len(suspects))
-        if suspects:
-            warnings.warn(
-                "sdc recheck: bitwise mismatch for cell(s) "
-                + ", ".join(str(i) for i in suspects)
-                + " — silent data corruption suspected; routing through "
-                "the quarantine ladder", stacklevel=3)
-
-    r = rows[:, 0].copy()
-    K = rows[:, 1].copy()
-    L = rows[:, 2].copy()
+    # value columns by schema NAME (the coupling RowSchema removes must
+    # not sneak back in as literal indices here)
+    r = res.col("r_star").copy()
+    K = res.col("capital").copy()
+    L = res.col("labor").copy()
     # The counters and status rode the device transfer in the float dtype
     # (exact — values ≪ 2^24, which f32 represents without rounding); cast
     # back to integers HERE so downstream consumers (total_work sums,
     # jsonified bench records, status comparisons) never see counters
     # silently become floats (ADVICE r5 #2).
-    iters = np.asarray(np.rint(rows[:, 3]), dtype=np.int64)
-    egm_it = np.asarray(np.rint(rows[:, 4]), dtype=np.int64)
-    dist_it = np.asarray(np.rint(rows[:, 5]), dtype=np.int64)
-    status = np.asarray(np.rint(rows[:, 6]), dtype=np.int64)
-    desc_it = np.asarray(np.rint(rows[:, 7]), dtype=np.int64)
-    pol_it = np.asarray(np.rint(rows[:, 8]), dtype=np.int64)
-    escal = np.asarray(np.rint(rows[:, 9]), dtype=np.int64)
-    retries = np.zeros(n_orig, dtype=np.int64)
-
-    # Host-side escalation: quarantine failed cells and walk the bounded
-    # retry ladder serially (never re-injecting a fault, never reusing a
-    # warm bracket seed).  Runs after the timed batched solve —
-    # wall_seconds stays the batched-program wall.
-    # Cells whose quarantine ladder already completed in an interrupted
-    # run: restore the final outcome (recovered values or the exhausted
-    # failing status) and the rung count bit-exactly — a recovered cell's
-    # ledger row holds a HEALTHY status, so it must be excluded from the
-    # failure scan below, not re-walked.
-    restored_retry = np.zeros(n_orig, dtype=bool)
-    if ledger is not None and quarantine:
-        for i in np.nonzero(ledger.retried)[0]:
-            row = ledger.packed[i]
-            r[i], K[i], L[i] = row[0], row[1], row[2]
-            iters[i] = int(np.rint(row[3]))
-            egm_it[i] = int(np.rint(row[4]))
-            dist_it[i] = int(np.rint(row[5]))
-            status[i] = int(np.rint(row[6]))
-            desc_it[i] = int(np.rint(row[7]))
-            pol_it[i] = int(np.rint(row[8]))
-            escal[i] = int(np.rint(row[9]))
-            retries[i] = int(ledger.retries[i])
-            restored_retry[i] = True
-    demoted = np.zeros(n_orig, dtype=bool)
-    if sdc_suspected is not None:
-        # a suspected cell's batched numbers are untrusted no matter how
-        # healthy its status looks: demote it to NONFINITE (corrupt bits
-        # ARE garbage) so the quarantine ladder re-solves it; whatever
-        # the ladder cannot recover is purged wholesale after it runs
-        demoted = sdc_suspected & ~restored_retry
-        status[demoted] = NONFINITE
-    failed = is_failure(status) & ~restored_retry
-    if quarantine and (failed.any() or restored_retry.any()):
-        ladder = _retry_ladder(model_kwargs)[:max(0, int(max_retries))]
-        for i in np.nonzero(failed)[0]:
-            status_before = int(status[i])
-            for attempt, overrides in enumerate(ladder, start=1):
-                retries[i] = attempt
-                with obs.span("sweep/quarantine", cell=int(i),
-                              rung=attempt):
-                    lean = device_call(
-                        f"quarantine retry cell {int(i)}",
-                        lambda: jax.block_until_ready(
-                            solve_calibration_lean(
-                                crra[i], rho[i], labor_sd=sd[i],
-                                dtype=dtype,
-                                **{**model_kwargs, **overrides})))
-                cell_status = int(lean.status)
-                if not is_failure(cell_status):
-                    r[i] = float(lean.r_star)
-                    K[i] = float(lean.capital)
-                    L[i] = float(lean.labor)
-                    iters[i] = int(lean.bisect_iters)
-                    egm_it[i] = int(lean.egm_iters)
-                    dist_it[i] = int(lean.dist_iters)
-                    desc_it[i] = int(lean.descent_steps)
-                    pol_it[i] = int(lean.polish_steps)
-                    escal[i] = int(lean.escalations)
-                    status[i] = cell_status
-                    break
-            obs.event("QUARANTINE", cell=int(i), crra=float(crra[i]),
-                      rho=float(rho_label[i]), sd=float(sd[i]),
-                      status_before=status_name(status_before),
-                      status_after=status_name(int(status[i])),
-                      recovered=not bool(is_failure(int(status[i]))),
-                      retries=int(retries[i]))
-            obs.counter("aiyagari_sweep_quarantined_cells_total",
-                        "cells routed through the retry ladder").inc()
-            # quarantine seam: the outcome (recovered or exhausted) is
-            # final for this run — same commit-then-poll protocol as the
-            # launch seams
-            row = np.asarray([r[i], K[i], L[i], iters[i], egm_it[i],
-                              dist_it[i], status[i], desc_it[i],
-                              pol_it[i], escal[i]], dtype=np.float64)
-            _resilience_seam(
-                ledger,
-                lambda led: led.record_retry(int(i), row,
-                                             int(retries[i])),
-                progress={"retried_cell": int(i)})
-        still = np.nonzero(is_failure(status))[0]
-        # NaN-mask what the retries could not certify: a failed cell must
-        # read as failed everywhere, not as a plausible number
-        r[still] = np.nan
-        K[still] = np.nan
-        if len(still):
-            warnings.warn(
-                "table2 sweep: cells "
-                + ", ".join(f"{int(i)} ({status_name(status[i])})"
-                            for i in still)
-                + " failed every quarantine retry; their values are "
-                "NaN-masked in the SweepResult", stacklevel=3)
-
-    # KNOWN-corrupt cells no retry recovered (or that had no ladder to
-    # run) must not leak ANY field into the result or the sidecar work
-    # model: an honest MAX_ITER best-iterate keeps its labor/counters,
-    # corrupt bits keep nothing — the sidecar's warm-seed rule trusts
-    # any finite r_star and its bucket planner trusts the counters.
-    purge = demoted & is_failure(status)
-    if purge.any():
-        r[purge] = np.nan
-        K[purge] = np.nan
-        L[purge] = np.nan
-        for arr in (iters, egm_it, dist_it, desc_it, pol_it, escal):
-            arr[purge] = 0
-
-    # Precision-ladder escalations (DESIGN §5) as journal events: the
-    # counter rode the packed row out of the jitted program; the journal
-    # line is where "which cell abandoned its cheap descent" becomes
-    # greppable next to the bucket that ran it.
-    for i in np.nonzero(escal > 0)[0]:
-        obs.event("PRECISION_ESCALATED", cell=int(i),
-                  crra=float(crra[i]), rho=float(rho_label[i]),
-                  sd=float(sd[i]), escalations=int(escal[i]))
-
-    if sweep.sidecar_path is not None:
-        # persist this run's counters/roots for the next run's scheduler
-        # (work model + warm brackets); best-effort — an unwritable path
-        # must not take down a finished solve
-        try:
-            save_sweep_sidecar(
-                sweep.sidecar_path, np.stack([crra, rho_label,
-                                              np.asarray(sd)], axis=1),
-                r, iters, egm_it, dist_it, status,
-                _work_fingerprint(kwargs_items, dtype),
-                descent_steps=desc_it, polish_steps=pol_it)
-        except OSError as e:
-            warnings.warn(f"could not write sweep sidecar "
-                          f"{sweep.sidecar_path!r}: {e}", stacklevel=3)
-
-    # -- a posteriori certification (DESIGN §9) -----------------------------
-    # Runs on the FINAL values (quarantine outcomes included), outside
-    # the timed wall: one vmapped recompute-certifier launch over the
-    # healthy cells; failed cells certify FAILED trivially.  Runs BEFORE
-    # ledger.complete() and through device_call (transient retry), so a
-    # certification-time fault cannot cost a completed sweep its resume
-    # state — a restarted run restores every cell and re-certifies.
-    cert_level = None
-    certify_wall = 0.0
-    if sweep.certify:
-        from ..verify.certificate import certify_packed_rows
-
-        t0 = time.perf_counter()
-        final_rows = np.stack(
-            [r, K, L, iters.astype(np.float64), egm_it.astype(np.float64),
-             dist_it.astype(np.float64), status.astype(np.float64),
-             desc_it.astype(np.float64), pol_it.astype(np.float64),
-             escal.astype(np.float64)], axis=1)
-        with obs.span("sweep/certify", cells=n_orig) as csp:
-            certs = device_call(
-                "a posteriori certification",
-                lambda: certify_packed_rows(
-                    final_rows,
-                    np.stack([crra, rho, np.asarray(sd)], axis=1),
-                    dtype, kwargs_items, thresholds=cert_thresholds))
-        cert_level = np.asarray([c.level for c in certs], dtype=np.int64)
-        certify_wall = time.perf_counter() - t0
-        csp.annotate(wall_s=certify_wall,
-                     failed=int((cert_level == 2).sum()))
-        for i in np.nonzero(cert_level == 2)[0]:
-            obs.event("CERT_FAILED", cell=int(i), crra=float(crra[i]),
-                      rho=float(rho_label[i]), sd=float(sd[i]),
-                      summary=certs[int(i)].summary())
-        obs.counter("aiyagari_sweep_cert_failed_total",
-                    "cells whose certificate graded FAILED").inc(
-            int((cert_level == 2).sum()))
-
-    if ledger is not None:
-        # the run completed: a finished ledger must not satisfy the next
-        # run's launches silently
-        ledger.complete()
+    iters = res.icol("bisect_iters")
+    egm_it = res.icol("egm_iters")
+    dist_it = res.icol("dist_iters")
+    desc_it = res.icol("descent_steps")
+    pol_it = res.icol("polish_steps")
+    escal = res.icol("precision_escalations")
 
     # Host-side closed forms (firm.py identities in numpy — numpy, not jnp,
     # so nothing touches the device after the solve): demand from the
-    # inverted marginal product of capital, Y from Cobb-Douglas, s = delta*K/Y.
-    # Mirror the run's counters into the metrics registry (ISSUE 7): the
-    # SweepResult dataclass keeps its API; the registry is where the
-    # same numbers become scrapeable/snapshot-able alongside serve's.
-    obs.counter("aiyagari_sweep_cells_total",
-                "cells solved by sweeps this run").inc(n_orig)
-    obs.counter("aiyagari_sweep_inner_steps_total",
-                "EGM + distribution inner steps").inc(
-        float((egm_it + dist_it).sum()))
-    obs.counter("aiyagari_sweep_quarantine_retries_total",
-                "quarantine ladder rungs consumed").inc(
-        int(retries.sum()))
-    obs.counter("aiyagari_sweep_precision_escalations_total",
-                "ladder descent->reference fallbacks").inc(
-        int(escal.sum()))
-    obs.gauge("aiyagari_sweep_wall_seconds",
-              "last sweep's honest batched wall").set(wall)
-
+    # inverted marginal product of capital, Y from Cobb-Douglas,
+    # s = delta*K/Y.
     alpha = model_kwargs.get("cap_share", 0.36)
     delta = model_kwargs.get("depr_fac", 0.08)
     prod = model_kwargs.get("prod", 1.0)
@@ -1437,20 +1587,17 @@ def _run_table2_sweep_impl(sweep, mesh, axis, dtype, timer, perturb,
     output = prod * K ** alpha * L ** (1.0 - alpha)
     srate = delta * K / output
     return SweepResult(
-        crra=crra[sl], labor_ar=rho_label[sl], labor_sd=np.asarray(sd)[sl],
+        crra=res.cells[:, 0], labor_ar=res.cells[:, 1],
+        labor_sd=res.cells[:, 2],
         r_star_pct=r * 100.0, saving_rate_pct=srate * 100.0,
         capital=K, excess=K - demand,
         bisect_iters=iters, egm_iters=egm_it, dist_iters=dist_it,
-        wall_seconds=wall,
-        dist_method=str(model_kwargs["dist_method"]),
-        egm_method=str(model_kwargs["egm_method"]),
-        status=status, retries=retries, bucket=bucket_of,
-        predicted_work=pred, descent_steps=desc_it, polish_steps=pol_it,
-        precision_escalations=escal, sdc_suspected=sdc_suspected,
-        cert_level=cert_level, recheck_wall_seconds=recheck_wall,
-        certify_wall_seconds=certify_wall)
-
-
-# The public wrapper carries the impl's full contract docstring (the
-# wrapper body is only the observability shell).
-run_table2_sweep.__doc__ = _run_table2_sweep_impl.__doc__
+        wall_seconds=res.wall_seconds,
+        dist_method=str(res.methods.get("dist_method", "auto")),
+        egm_method=str(res.methods.get("egm_method", "xla")),
+        status=res.status, retries=res.retries, bucket=res.bucket,
+        predicted_work=res.predicted_work, descent_steps=desc_it,
+        polish_steps=pol_it, precision_escalations=escal,
+        sdc_suspected=res.sdc_suspected, cert_level=res.cert_level,
+        recheck_wall_seconds=res.recheck_wall_seconds,
+        certify_wall_seconds=res.certify_wall_seconds)

@@ -1,0 +1,36 @@
+"""Pluggable model families riding the whole sweep/serve/verify stack
+(ISSUE 9, DESIGN §12).  Importing this package registers the built-in
+scenarios; ``run_sweep(scenario=...)`` / ``serve.make_query(scenario=...)``
+resolve names through ``get_scenario``."""
+
+from .base import (
+    CELL_DIM,
+    BracketWarmStart,
+    CellSpace,
+    DuplicateScenarioError,
+    RowSchema,
+    Scenario,
+    ScenarioError,
+    UnknownScenarioError,
+)
+from .registry import get_scenario, register, scenario_names, unregister
+
+# built-in families self-register on import
+from . import aiyagari  # noqa: E402,F401
+from . import huggett  # noqa: E402,F401
+from . import epstein_zin  # noqa: E402,F401
+
+__all__ = [
+    "CELL_DIM",
+    "BracketWarmStart",
+    "CellSpace",
+    "DuplicateScenarioError",
+    "RowSchema",
+    "Scenario",
+    "ScenarioError",
+    "UnknownScenarioError",
+    "get_scenario",
+    "register",
+    "scenario_names",
+    "unregister",
+]

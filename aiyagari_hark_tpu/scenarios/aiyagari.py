@@ -1,0 +1,161 @@
+"""The Aiyagari scenario: the existing Table II cell solver as a
+registered ``Scenario`` — byte-for-byte the pre-scenario behavior.
+
+Everything here delegates to the machinery the sweep/serve stack always
+used (``parallel.sweep._batched_solver`` IS the executable factory, so
+the scenario shares its lru_cache with every direct caller;
+``models.equilibrium.solve_calibration_lean`` is the quarantine path;
+``verify.certificate.certify_packed_rows`` the certifier) — the scenario
+object only names the seams the engine used to hard-code.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.config import PACKED_ROW_FIELDS
+from .base import BracketWarmStart, CellSpace, RowSchema, Scenario
+from .registry import register
+
+# The canonical Aiyagari packed-row layout (``config.PACKED_ROW_FIELDS``
+# is its definition site; this RowSchema is how every other subsystem now
+# reads it — scripts/check_row_schema.py bans fresh direct imports).
+AIYAGARI_SCHEMA = RowSchema(
+    fields=tuple(PACKED_ROW_FIELDS),
+    root="r_star",
+    status="status",
+    counters=("bisect_iters", "egm_iters", "dist_iters"),
+    work=("egm_iters", "dist_iters"),
+    phases=("descent_steps", "polish_steps", "precision_escalations"),
+    mask_on_failure=("r_star", "capital"),
+)
+
+
+def _batched_solver(dtype, kwargs_items=(), fault_mode=None, warm=False):
+    from ..parallel.sweep import _batched_solver as factory
+
+    return factory(dtype, kwargs_items, fault_mode, warm)
+
+
+def _eager_row(cell, dtype, model_kwargs) -> np.ndarray:
+    """One trusted serial solve (the quarantine rung path): the eager
+    ``solve_calibration_lean`` call the pre-scenario engine made, its
+    scalars packed into the row layout."""
+    import jax
+
+    from ..models.equilibrium import solve_calibration_lean
+
+    lean = jax.block_until_ready(solve_calibration_lean(
+        cell[0], cell[1], labor_sd=cell[2], dtype=dtype, **model_kwargs))
+    return np.asarray(
+        [float(lean.r_star), float(lean.capital), float(lean.labor),
+         int(lean.bisect_iters), int(lean.egm_iters),
+         int(lean.dist_iters), int(lean.status),
+         int(lean.descent_steps), int(lean.polish_steps),
+         int(lean.escalations)], dtype=np.float64)
+
+
+def _retry_rungs(model_kwargs: dict) -> tuple:
+    from ..parallel.sweep import _retry_ladder
+
+    return _retry_ladder(model_kwargs)
+
+
+def _prepare_kwargs(model_kwargs: dict) -> dict:
+    """The sweep-level method defaulting the engine used to inline
+    (backend-aware dist/egm engine selection; DESIGN §4b/§5) — applied in
+    place, the resolved choices returned as result metadata."""
+    import jax
+
+    two_phase = model_kwargs.get("precision", "reference") != "reference"
+    if "dist_method" not in model_kwargs:
+        # Sweep-level default, distinct from stationary_wealth's "auto".
+        # On accelerators: "pallas" — the lane-grid kernel (one program
+        # instance per cell via the custom_vmap batching rule,
+        # ``household._pallas_fixed_point_vmappable``) lets every cell's
+        # distribution fixed point exit at its OWN convergence instead of
+        # vmap-of-while lock-step, measured 1.26 s vs dense's 2.16 s on
+        # the 12-cell sweep (one v5e chip, identical r*).  Fallback
+        # "dense" (batched MXU matvecs) when Mosaic can't compile the
+        # kernel.  NOT "solve" — with the EGM Anderson acceleration and
+        # the stall exit in place, iterating the dense operator beats
+        # paying a (D*N)^3 LU per midpoint (measured: dense 2.8s vs solve
+        # 4.8s).  On CPU, "auto" (scatter) — dense/LU/pallas are the
+        # wrong trade there.
+        if jax.default_backend() in ("tpu", "axon"):
+            if two_phase:
+                # the precision ladder needs the two-phase XLA paths (the
+                # VMEM kernel runs one precision end-to-end); dense IS the
+                # ladder's MXU path, so record what actually runs
+                model_kwargs["dist_method"] = "dense"
+            else:
+                from ..ops.pallas_kernels import pallas_grid_tpu_available
+                model_kwargs["dist_method"] = (
+                    "pallas" if pallas_grid_tpu_available() else "dense")
+        else:
+            model_kwargs["dist_method"] = "auto"
+    if "egm_method" not in model_kwargs:
+        # Same default logic for the POLICY loop (ISSUE 2 tentpole): the
+        # lane-grid EGM kernel lets a converged cell stop burning MXU
+        # cycles instead of lock-stepping to the slowest lane; probe-gated
+        # with the XLA while_loop as the universal fallback.
+        if jax.default_backend() in ("tpu", "axon") and not two_phase:
+            from ..ops.pallas_kernels import pallas_egm_grid_tpu_available
+            model_kwargs["egm_method"] = (
+                "pallas" if pallas_egm_grid_tpu_available() else "xla")
+        else:
+            model_kwargs["egm_method"] = "xla"
+    return {"dist_method": str(model_kwargs["dist_method"]),
+            "egm_method": str(model_kwargs["egm_method"])}
+
+
+def _host_bracket(model_kwargs, dtype):
+    from ..parallel.sweep import _host_bracket as hb
+
+    return hb(model_kwargs, dtype)
+
+
+def _host_r_tol(model_kwargs, dtype):
+    from ..parallel.sweep import _host_r_tol as ht
+
+    return ht(model_kwargs, dtype)
+
+
+def _max_levels(model_kwargs):
+    return max(0, int(model_kwargs.get("max_bisect", 60)) - 6)
+
+
+def _certify_rows(rows, cells, dtype, kwargs_items, thresholds=None):
+    from ..verify.certificate import certify_packed_rows
+
+    return certify_packed_rows(rows, cells, dtype, kwargs_items,
+                               thresholds=thresholds,
+                               schema=AIYAGARI_SCHEMA)
+
+
+def _heuristic_work(cells):
+    from ..parallel.sweep import heuristic_cell_work
+
+    return heuristic_cell_work(cells)
+
+
+AIYAGARI = Scenario(
+    name="aiyagari",
+    schema=AIYAGARI_SCHEMA,
+    cells=CellSpace(
+        names=("crra", "rho", "sd"),
+        scale=(4.0, 0.9, 0.4),      # == parallel.sweep.NEIGHBOR_CELL_SCALE
+        work=_heuristic_work,
+        perturb_axis=1,
+    ),
+    batched_solver=_batched_solver,
+    eager_row=_eager_row,
+    retry_rungs=_retry_rungs,
+    prepare_kwargs=_prepare_kwargs,
+    warm=BracketWarmStart(host_bracket=_host_bracket,
+                          host_r_tol=_host_r_tol,
+                          max_levels=_max_levels),
+    certify_rows=_certify_rows,
+)
+
+register(AIYAGARI)

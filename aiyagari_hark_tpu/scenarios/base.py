@@ -1,0 +1,255 @@
+"""The Scenario abstraction: pluggable model families for the whole run
+stack (ISSUE 9, DESIGN §12).
+
+Everything built in PRs 1-8 — quarantine, the balanced scheduler, resume
+ledgers, the SolutionStore/serving engine, the precision ladder,
+certification, obs, and overload control — was hard-wired to the Aiyagari
+cell solver.  A ``Scenario`` bundles what that infrastructure actually
+needs from a model family, so Huggett, Epstein-Zin, lifecycle, and future
+high-dimensional families (PAPERS 2202.06555) ride the same machinery:
+
+* a **packed-row batched solver** — a jitted vmapped ``(cells...) ->
+  [B, W]`` program packing every per-cell output into ONE stacked float
+  row (the one-transfer-per-launch discipline of
+  ``parallel.sweep._batched_solver``);
+* a declarative **RowSchema** — the named row layout generalizing the
+  fixed ``config.PACKED_ROW_FIELDS``, with the semantic roles (root,
+  status, counters, precision phases, failure masking) the engine,
+  ledger, store, and certifier read instead of hard-coded indices;
+* a **CellSpace** descriptor — parameter names, the normalization scale
+  nearest-neighbor donor ranking uses, and the work heuristic the PR 2
+  scheduler buckets by;
+* **warm-start semantics** — ``BracketWarmStart`` (verified dyadic
+  bracket seeding, the Aiyagari/Huggett mode) or ``None`` (cold-only);
+* a **quarantine retry ladder** (``retry_rungs``) and a
+  **certification hook** (``certify_rows``) for ``verify``.
+
+Scenario identity is part of EVERY fingerprint (sidecar, resume ledger,
+store key, serve group — ``utils.fingerprint``), so a cache entry solved
+under one family is structurally unaddressable from another even at
+numerically identical parameters.
+
+Layering: this module is host-side vocabulary (numpy + stdlib); concrete
+scenarios import their solvers lazily inside the bundled callables so
+``import aiyagari_hark_tpu.scenarios`` stays cheap.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from ..utils.fingerprint import config_fingerprint
+
+
+class ScenarioError(ValueError):
+    """Base of the scenario registry's typed errors."""
+
+
+class UnknownScenarioError(ScenarioError, KeyError):
+    """A scenario name is not registered.  Subclasses ``KeyError`` too so
+    dict-minded callers degrade naturally, but carries the registry's
+    vocabulary in the message."""
+
+    def __init__(self, name, known):
+        self.name = name
+        self.known = tuple(known)
+        super().__init__(
+            f"unknown scenario {name!r}; registered scenarios: "
+            f"{sorted(self.known)}")
+
+
+class DuplicateScenarioError(ScenarioError):
+    """``register`` refused to overwrite an existing scenario name —
+    silently replacing a family would re-key every fingerprint that
+    hashes the name while old artifacts still carry it."""
+
+
+# The framework's cell spaces are (currently) 3-dimensional: every
+# registered family sweeps a (param0, param1, param2) lattice and the
+# shared fingerprints/stores address cells as triples.  Opening a
+# genuinely high-dimensional family (ROADMAP item 3 / 2202.06555) is the
+# next format change; widening this is deliberate, not accidental.
+CELL_DIM = 3
+
+
+@dataclass(frozen=True)
+class RowSchema:
+    """Declarative layout of one scenario's packed device row.
+
+    ``fields`` generalizes ``config.PACKED_ROW_FIELDS``: the batched
+    solver stacks exactly these values per cell, in order, in the compute
+    float dtype (counters/status ride exactly — values ≪ 2^24).  The
+    roles tell the engine, ledger, sidecar, store, and certifier WHICH
+    columns to read, replacing the hard-coded indices the Aiyagari-only
+    stack used:
+
+    * ``root`` — the solved scalar warm-start seeding and donor
+      nomination target (``r_star`` everywhere so far);
+    * ``status`` — the ``solver_health`` code column (quarantine,
+      failure masking, store refusal all key on it);
+    * ``counters`` — exactly (bisect-like, egm-like, dist-like) work
+      counters, in that order: the resume ledger and the scheduler
+      sidecar persist these three named columns;
+    * ``work`` — the counter subset summed into the scheduler's
+      measured-work model;
+    * ``phases`` — optional (descent, polish, escalations) triple for
+      precision-ladder accounting (None = the scenario does not split
+      phases; engine/metrics skip phase accounting);
+    * ``mask_on_failure`` — value columns NaN-masked when a cell fails
+      every quarantine retry (a failed cell must poison its own entries
+      loudly, never the table silently).
+
+    ``checksum()`` fingerprints the layout + roles: ledgers and store
+    entries record it, so a stale layout refuses to resume / drops
+    instead of feeding wrong-shaped rows downstream.
+    """
+
+    fields: Tuple[str, ...]
+    root: str = "r_star"
+    status: str = "status"
+    counters: Tuple[str, str, str] = ("bisect_iters", "egm_iters",
+                                      "dist_iters")
+    work: Tuple[str, ...] = ("egm_iters", "dist_iters")
+    phases: Optional[Tuple[str, str, str]] = None
+    mask_on_failure: Tuple[str, ...] = ("r_star",)
+
+    def __post_init__(self):
+        if len(set(self.fields)) != len(self.fields):
+            raise ScenarioError(f"RowSchema fields repeat: {self.fields}")
+        named = ((self.root, self.status) + tuple(self.counters)
+                 + tuple(self.work) + tuple(self.phases or ())
+                 + tuple(self.mask_on_failure))
+        missing = [n for n in named if n not in self.fields]
+        if missing:
+            raise ScenarioError(
+                f"RowSchema roles name fields not in the layout: "
+                f"{missing} (fields: {self.fields})")
+        if len(self.counters) != 3:
+            raise ScenarioError(
+                "RowSchema.counters must be exactly (bisect-like, "
+                f"egm-like, dist-like), got {self.counters}")
+        # cache the layout fingerprint once: the serving hot path reads
+        # it per query (store schema validation) and md5 per hit would
+        # be a silly tax on the sub-ms budget
+        object.__setattr__(self, "_checksum", config_fingerprint(
+            "row-schema", repr(self.fields), self.root, self.status,
+            repr(self.counters), repr(self.work),
+            repr(self.phases), repr(self.mask_on_failure)))
+
+    @property
+    def width(self) -> int:
+        return len(self.fields)
+
+    def idx(self, name: str) -> int:
+        try:
+            return self.fields.index(name)
+        except ValueError:
+            raise ScenarioError(
+                f"row field {name!r} not in schema {self.fields}") from None
+
+    def has(self, name: str) -> bool:
+        return name in self.fields
+
+    def checksum(self) -> int:
+        """Layout + role fingerprint (int64) — recorded by store entries
+        so stale layouts drop loudly (cached at construction)."""
+        return self._checksum
+
+
+@dataclass(frozen=True)
+class CellSpace:
+    """The scenario's parameter lattice descriptor.
+
+    ``names`` label the ``CELL_DIM`` cell coordinates (display/docs);
+    ``scale`` normalizes per-axis distances for nearest-neighbor donor
+    ranking (one rule shared by sweep seeding and the serving store —
+    the ``parallel.sweep.NEIGHBOR_CELL_SCALE`` contract, per scenario);
+    ``work`` maps ``[C, CELL_DIM] -> [C]`` relative predicted work (the
+    PR 2 scheduler's cold-start cost model and the overload layer's
+    queue weight); ``perturb_axis`` is the column benchmark reruns nudge
+    (``run_sweep(perturb=)``)."""
+
+    names: Tuple[str, ...]
+    scale: Tuple[float, ...]
+    work: Callable[[np.ndarray], np.ndarray]
+    perturb_axis: int = 1
+
+    def __post_init__(self):
+        if len(self.names) != CELL_DIM or len(self.scale) != CELL_DIM:
+            raise ScenarioError(
+                f"cell spaces are {CELL_DIM}-dimensional (names="
+                f"{self.names}, scale={self.scale})")
+        if not 0 <= self.perturb_axis < CELL_DIM:
+            raise ScenarioError(
+                f"perturb_axis {self.perturb_axis} out of range")
+
+
+@dataclass(frozen=True)
+class BracketWarmStart:
+    """Verified-bracket warm-start semantics (the Aiyagari mode): the
+    host replays the device's dyadic bisection arithmetic toward a known
+    root (``parallel.sweep.dyadic_bracket``) and the solver verifies the
+    seed in-program, falling back to the cold trajectory on a bad seed.
+
+    ``host_bracket(model_kwargs, dtype) -> (lo, hi)`` must reproduce the
+    compiled program's economic bracket endpoints bit-exactly;
+    ``host_r_tol(model_kwargs, dtype)`` its effective tolerance;
+    ``max_levels(model_kwargs)`` how deep descent may go.  ``mode`` is
+    the declared semantics label ("bracket" here; a scenario without a
+    ``warm`` spec is "cold-only", and one whose solver replays recorded
+    seeds verbatim would declare "seed-replay")."""
+
+    host_bracket: Callable
+    host_r_tol: Callable
+    max_levels: Callable
+    mode: str = "bracket"
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """One registered model family — everything the sweep/serve/verify
+    stack needs, with the family's own solvers behind stable callables.
+
+    * ``batched_solver(dtype, kwargs_items, fault_mode, warm)`` returns
+      the jitted vmapped packed-row program (memoize per configuration —
+      the engine calls it per bucket/launch and relies on executable
+      reuse; ``dtype`` arrives canonical).  ``warm`` is only requested
+      when ``warm`` semantics exist; ``fault_mode`` (static) compiles in
+      the deterministic fault hook or is None.
+    * ``eager_row(cell, dtype, model_kwargs) -> np.ndarray [width]`` —
+      one trusted serial solve for quarantine rungs (blocks until the
+      row is on host).
+    * ``retry_rungs(model_kwargs) -> tuple[dict, ...]`` — the bounded
+      quarantine ladder, safest-last (scenario-supplied; the engine
+      truncates to ``max_retries``).
+    * ``prepare_kwargs(model_kwargs) -> dict`` — apply the family's
+      sweep-level kwarg defaults IN PLACE (e.g. Aiyagari's backend-aware
+      ``dist_method``/``egm_method``) and return the method metadata the
+      result should record.
+    * ``certify_rows(rows, cells, dtype, kwargs_items, thresholds)`` —
+      a posteriori certification of packed rows (``verify`` vocabulary:
+      a list of ``Certificate``), or None when the family has no
+      certifier yet (``SweepConfig.certify`` then raises).
+    """
+
+    name: str
+    schema: RowSchema
+    cells: CellSpace
+    batched_solver: Callable
+    eager_row: Callable
+    retry_rungs: Callable
+    prepare_kwargs: Callable = field(default=lambda kw: {})
+    warm: Optional[BracketWarmStart] = None
+    certify_rows: Optional[Callable] = None
+
+    def __post_init__(self):
+        if not self.name or not isinstance(self.name, str):
+            raise ScenarioError(f"scenario name must be a non-empty "
+                                f"string, got {self.name!r}")
+
+    @property
+    def warm_mode(self) -> str:
+        return "cold-only" if self.warm is None else self.warm.mode

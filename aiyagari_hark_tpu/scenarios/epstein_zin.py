@@ -1,0 +1,367 @@
+"""The Epstein-Zin scenario: recursive preferences (risk aversion
+decoupled from the EIS, ``models.epstein_zin``; PAPERS "The EGM for
+Epstein-Zin Preferences", 2601.04438) as a registered sweep/serve
+workload.
+
+Cells are (gamma, rho, sd): the RISK-AVERSION axis replaces CRRA as the
+first coordinate (at gamma == the ``ez_rho`` kwarg the family collapses
+to CRRA — the test oracle); the intertemporal-substitution parameter
+rides as the static sweep kwarg ``ez_rho``.  The bisection solves COLD at
+every midpoint by design (``solve_ez_equilibrium``'s determinism
+rationale: a warm-started inner fixed point makes the excess map
+history-dependent at the reported-residual level), so warm-start
+semantics are declared **cold-only** — the serving engine's store still
+gives exact hits and the sweep still buckets/quarantines/resumes; there
+is simply no bracket seeding to replay.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import NamedTuple
+
+import numpy as np
+
+from .base import CellSpace, RowSchema, Scenario
+from .registry import register
+
+EZ_FIELDS = ("r_star", "capital", "labor", "bisect_iters", "egm_iters",
+             "dist_iters", "status")
+
+EZ_SCHEMA = RowSchema(
+    fields=EZ_FIELDS,
+    root="r_star",
+    status="status",
+    counters=("bisect_iters", "egm_iters", "dist_iters"),
+    work=("egm_iters", "dist_iters"),
+    phases=None,
+    mask_on_failure=("r_star", "capital"),
+)
+
+
+class EZLean(NamedTuple):
+    """Scalar-only Epstein-Zin equilibrium for packed sweeps."""
+
+    r_star: object
+    capital: object
+    labor: object
+    bisect_iters: object
+    egm_iters: object
+    dist_iters: object
+    status: object
+
+
+def solve_ez_lean(model, disc_fac, gamma, ez_rho, cap_share, depr_fac,
+                  r_tol=None, max_bisect: int = 60, egm_tol=None,
+                  dist_tol=None, dist_method: str = "auto",
+                  accel_every: int = 32, fault_iter=None,
+                  fault_mode=None) -> EZLean:
+    """Bracketed bisection on r with the EZ household inside, scalar
+    outputs only — jit/vmap-able, with the sweep-stack contract
+    (accumulated counters, combined ``solver_health`` status with a
+    non-finite tripwire, deterministic fault hook).  Every midpoint
+    solves COLD (see module docstring)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.epstein_zin import as_household_policy, \
+        solve_ez_household
+    from ..models.equilibrium import _bisection_setup
+    from ..models.firm import k_to_l_from_r, wage_rate
+    from ..models.household import (
+        aggregate_capital,
+        aggregate_labor,
+        stationary_wealth,
+    )
+    from ..solver_health import (
+        CONVERGED,
+        MAX_ITER,
+        NONFINITE,
+        combine_status,
+    )
+
+    dtype = model.a_grid.dtype
+    r_tol, egm_tol, dist_tol, r_lo, r_hi = _bisection_setup(
+        model, disc_fac, depr_fac, r_tol, egm_tol, dist_tol)
+    labor = aggregate_labor(model)
+    zi = jnp.asarray(0, jnp.int32)
+
+    def excess_at(r):
+        k_to_l = k_to_l_from_r(r, cap_share, depr_fac)
+        W = wage_rate(k_to_l, cap_share)
+        pol, e_it, _, e_st = solve_ez_household(
+            1.0 + r, W, model, disc_fac, ez_rho, gamma, tol=egm_tol,
+            accel_every=accel_every)
+        dist, d_it, _, d_st = stationary_wealth(
+            as_household_policy(pol), 1.0 + r, W, model, tol=dist_tol,
+            method=dist_method)
+        supply = aggregate_capital(dist, model)
+        ex = supply - k_to_l * labor
+        st = combine_status(e_st, d_st,
+                            jnp.where(jnp.isfinite(ex), CONVERGED,
+                                      NONFINITE))
+        return ex, supply, jnp.asarray(e_it, jnp.int32), \
+            jnp.asarray(d_it, jnp.int32), st
+
+    if fault_iter is None:
+        fault_iter = jnp.asarray(-1, jnp.int32)
+
+    def cond(state):
+        lo, hi, it, st = state[0], state[1], state[2], state[5]
+        return ((hi - lo) > r_tol) & (it < max_bisect) & (st < NONFINITE)
+
+    def body(state):
+        lo, hi, it, e_a, d_a, st = state
+        mid = 0.5 * (lo + hi)
+        ex, _, e_it, d_it, st2 = excess_at(mid)
+        if fault_mode is not None:
+            trip = (fault_iter >= 0) & (it == fault_iter)
+            ex = jnp.where(trip, jnp.asarray(jnp.nan, dtype=dtype), ex)
+            st2 = combine_status(
+                st2, jnp.where(trip, NONFINITE, CONVERGED))
+        finite = jnp.isfinite(ex)
+        take_hi = ex > 0
+        lo = jnp.where(finite & ~take_hi, mid, lo)
+        hi = jnp.where(finite & take_hi, mid, hi)
+        return (lo, hi, it + 1, e_a + e_it, d_a + d_it,
+                combine_status(st, st2))
+
+    lo, hi, iters, e_acc, d_acc, st_acc = jax.lax.while_loop(
+        cond, body, (r_lo, r_hi, zi, zi, zi,
+                     jnp.asarray(CONVERGED, jnp.int32)))
+
+    st_exit = jnp.where((hi - lo) <= r_tol, CONVERGED, MAX_ITER)
+    r_star = 0.5 * (lo + hi)
+    _, supply, e_it, d_it, st2 = excess_at(r_star)
+    status = combine_status(st_acc, st2, st_exit)
+    return EZLean(r_star=r_star, capital=supply, labor=labor,
+                  bisect_iters=iters + 1, egm_iters=e_acc + e_it,
+                  dist_iters=d_acc + d_it, status=status)
+
+
+def solve_ez_cell(gamma, rho, sd=0.2, dtype=None, disc_fac=0.96,
+                  ez_rho=2.0, cap_share=0.36, depr_fac=0.08,
+                  labor_states=7, labor_bound=3.0, a_min=0.001,
+                  a_max=50.0, a_count=32, a_nest_fac=2, dist_count=500,
+                  **solver_kwargs) -> EZLean:
+    """Build the model for one (gamma, rho, sd) cell and run the lean EZ
+    solver.  ``ez_rho`` (1/EIS) is a static sweep kwarg; gamma is the
+    swept risk-aversion axis."""
+    from ..models.household import build_simple_model
+
+    model = build_simple_model(
+        labor_states=labor_states, labor_ar=rho, labor_sd=sd,
+        labor_bound=labor_bound, a_min=a_min, a_max=a_max,
+        a_count=a_count, a_nest_fac=a_nest_fac, dist_count=dist_count,
+        dtype=dtype)
+    return solve_ez_lean(model, disc_fac, gamma, ez_rho, cap_share,
+                         depr_fac, **solver_kwargs)
+
+
+@lru_cache(maxsize=None)
+def batched_ez_solver(dtype, kwargs_items=(), fault_mode=None,
+                      warm=False):
+    """Jitted vmapped EZ cell solver (the shared-executable discipline).
+    ``warm`` must be False — the scenario declares cold-only semantics
+    and the engine never requests a warm executable for it."""
+    import jax
+    import jax.numpy as jnp
+
+    if warm:
+        raise ValueError("the epstein_zin scenario is cold-only: no warm "
+                         "executable exists (Scenario.warm is None)")
+    model_kwargs = dict(kwargs_items)
+
+    def pack(res: EZLean):
+        f = res.r_star.dtype
+        return jnp.stack([res.r_star, res.capital, res.labor,
+                          res.bisect_iters.astype(f),
+                          res.egm_iters.astype(f),
+                          res.dist_iters.astype(f),
+                          res.status.astype(f)])
+
+    def solve_cell(gamma, rho, sd, fault_it=None):
+        extra = {}
+        if fault_mode is not None:
+            extra.update(fault_iter=fault_it, fault_mode=fault_mode)
+        return pack(solve_ez_cell(gamma, rho, sd, dtype=dtype, **extra,
+                                  **model_kwargs))
+
+    if fault_mode is None:
+        def solve_one(gamma, rho, sd):
+            return solve_cell(gamma, rho, sd)
+    else:
+        def solve_one(gamma, rho, sd, fault_it):
+            return solve_cell(gamma, rho, sd, fault_it=fault_it)
+
+    return jax.jit(jax.vmap(solve_one))
+
+
+def _eager_row(cell, dtype, model_kwargs) -> np.ndarray:
+    import jax
+    import jax.numpy as jnp
+
+    from ..utils.fingerprint import hashable_kwargs
+
+    fn = batched_ez_solver(dtype, hashable_kwargs(model_kwargs), None,
+                           False)
+    out = jax.block_until_ready(fn(
+        jnp.asarray([cell[0]], dtype=dtype),
+        jnp.asarray([cell[1]], dtype=dtype),
+        jnp.asarray([cell[2]], dtype=dtype)))
+    return np.asarray(out, dtype=np.float64)[0]
+
+
+def _retry_rungs(model_kwargs: dict) -> tuple:
+    prior = model_kwargs.get("dist_method", "auto")
+    alternate = "dense" if prior in ("auto", "scatter") else "scatter"
+    return (
+        {"dist_method": alternate},
+        {"dist_method": alternate, "accel_every": 0},
+        # the EZ certainty-equivalent powers overflow before the bracket
+        # does; more bisection budget is the honest last rung
+        {"dist_method": alternate, "accel_every": 0,
+         "max_bisect": int(model_kwargs.get("max_bisect", 60)) + 20},
+    )
+
+
+def _prepare_kwargs(model_kwargs: dict) -> dict:
+    return {"dist_method": str(model_kwargs.get("dist_method", "auto"))}
+
+
+@lru_cache(maxsize=None)
+def _ez_certifier(dtype, kwargs_items=()):
+    """Independent recompute certifier for EZ rows: re-solve the EZ
+    household COLD at the reported rate, direct/fresh distribution, and
+    grade market clearing + the capital claim + structural invariants.
+    The ``euler`` slot reports 0.0 — the EZ Euler equation with its
+    risk-adjustment weights has no cheap independent oracle here (the
+    certifier would have to replay the producer's own update); market
+    clearing, stationarity, and shape are the binding checks."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.epstein_zin import as_household_policy, \
+        solve_ez_household
+    from ..models.firm import k_to_l_from_r, wage_rate
+    from ..models.household import (
+        aggregate_capital,
+        aggregate_labor,
+        build_simple_model,
+        stationary_wealth,
+    )
+    from ..solver_health import combine_status
+    from ..verify.certificate import (
+        _cert_dist_method,
+        _split_kwargs,
+        lorenz_residual,
+        shape_residual,
+        stationarity_residuals,
+    )
+
+    model_kwargs = dict(kwargs_items)
+    ez_rho = float(model_kwargs.get("ez_rho", 2.0))
+
+    def one(gamma, rho, sd, r_star, capital):
+        build, price, egm_tol, dist_tol = _split_kwargs(
+            {**model_kwargs, "__dtype__": dtype})
+        model = build_simple_model(labor_ar=rho, labor_sd=sd,
+                                   dtype=dtype, **build)
+        k_to_l = k_to_l_from_r(r_star, price["cap_share"],
+                               price["depr_fac"])
+        W = wage_rate(k_to_l, price["cap_share"])
+        R = 1.0 + r_star
+        pol, _, _, e_st = solve_ez_household(
+            R, W, model, price["disc_fac"], ez_rho, gamma, tol=egm_tol)
+        hpol = as_household_policy(pol)
+        dist, _, _, d_st = stationary_wealth(
+            hpol, R, W, model, tol=dist_tol,
+            method=_cert_dist_method(build), precision="reference")
+        supply = aggregate_capital(dist, model)
+        demand = k_to_l * aggregate_labor(model)
+        tiny = jnp.asarray(np.finfo(np.float64).tiny,
+                           dtype=supply.dtype)
+        denom = jnp.maximum(jnp.abs(supply), tiny)
+        station, mass = stationarity_residuals(hpol, dist, R, W, model)
+        resids = jnp.stack([
+            jnp.zeros((), dtype=supply.dtype),   # euler: no cheap oracle
+            station,
+            mass,
+            jnp.abs(supply - demand) / denom,
+            jnp.abs(capital - supply) / denom,
+            shape_residual(hpol),
+            lorenz_residual(dist, model),
+            combine_status(e_st, d_st).astype(supply.dtype),
+        ])
+        return resids.astype(jnp.float64) \
+            if resids.dtype != jnp.float64 else resids
+
+    return jax.jit(jax.vmap(one))
+
+
+def _certify_rows(rows, cells, dtype, kwargs_items, thresholds=None):
+    from ..solver_health import is_failure
+    from ..verify.certificate import (
+        CERT_CHECKS,
+        _thresholds_from_kwargs,
+    )
+
+    rows = np.asarray(rows, dtype=np.float64)
+    cells = np.asarray(cells, dtype=np.float64)
+    schema = EZ_SCHEMA
+    status_col = schema.idx("status")
+    thr = _thresholds_from_kwargs(thresholds, dtype, dict(kwargs_items))
+    healthy = ~np.asarray([is_failure(int(np.rint(r[status_col])))
+                           for r in rows])
+    out: list = [None] * len(rows)
+    if healthy.any():
+        import jax.numpy as jnp
+
+        from ..obs.runtime import active_span
+
+        idx = np.nonzero(healthy)[0]
+        fn = _ez_certifier(dtype, kwargs_items)
+        with active_span("verify/certify_rows", rows=int(len(idx)),
+                         scenario="epstein_zin"):
+            resids = np.asarray(fn(
+                jnp.asarray(cells[idx, 0], dtype=dtype),
+                jnp.asarray(cells[idx, 1], dtype=dtype),
+                jnp.asarray(cells[idx, 2], dtype=dtype),
+                jnp.asarray(rows[idx, schema.idx("r_star")], dtype=dtype),
+                jnp.asarray(rows[idx, schema.idx("capital")],
+                            dtype=dtype)),
+                dtype=np.float64)
+        for j, i in enumerate(idx):
+            out[int(i)] = thr.certificate(resids[j])
+    for i in np.nonzero(~healthy)[0]:
+        status = int(np.rint(rows[i][status_col]))
+        resids = np.full(len(CERT_CHECKS), np.nan)
+        resids[CERT_CHECKS.index("recompute")] = float(status)
+        out[int(i)] = thr.certificate(resids)
+    return out
+
+
+def _heuristic_work(cells):
+    from ..parallel.sweep import heuristic_cell_work
+
+    return heuristic_cell_work(cells)
+
+
+EPSTEIN_ZIN = Scenario(
+    name="epstein_zin",
+    schema=EZ_SCHEMA,
+    cells=CellSpace(
+        names=("gamma", "rho", "sd"),
+        scale=(8.0, 0.9, 0.4),    # gamma sweeps wider than CRRA's 4-span
+        work=_heuristic_work,
+        perturb_axis=1,
+    ),
+    batched_solver=batched_ez_solver,
+    eager_row=_eager_row,
+    retry_rungs=_retry_rungs,
+    prepare_kwargs=_prepare_kwargs,
+    warm=None,                       # cold-only (module docstring)
+    certify_rows=_certify_rows,
+)
+
+register(EPSTEIN_ZIN)

@@ -1,0 +1,65 @@
+"""The scenario registry: name -> ``Scenario``, with typed failure.
+
+One process-global table (scenarios are stateless bundles of callables;
+there is nothing per-run to scope).  The built-in families register
+themselves when ``aiyagari_hark_tpu.scenarios`` is imported;
+``get_scenario`` lazily triggers that import so callers deep in the
+stack (``parallel.sweep``, ``serve.service``) can resolve names without
+import-order ceremony.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from .base import DuplicateScenarioError, Scenario, UnknownScenarioError
+
+_REGISTRY: Dict[str, Scenario] = {}
+
+
+def register(scenario: Scenario, replace: bool = False) -> Scenario:
+    """Add ``scenario`` to the registry.  A duplicate name raises the
+    typed ``DuplicateScenarioError`` (silently replacing a family would
+    re-key every fingerprint hashing the name while stored artifacts
+    still carry it); ``replace=True`` is the explicit test escape hatch
+    and returns the PREVIOUS scenario so fixtures can restore it."""
+    name = scenario.name
+    prior = _REGISTRY.get(name)
+    if prior is not None and not replace:
+        raise DuplicateScenarioError(
+            f"scenario {name!r} is already registered; pass replace=True "
+            "only if you really mean to re-key it")
+    _REGISTRY[name] = scenario
+    return prior if prior is not None else scenario
+
+
+def unregister(name: str) -> None:
+    """Remove a scenario (test fixtures restoring a clean registry)."""
+    _REGISTRY.pop(name, None)
+
+
+def _ensure_builtins() -> None:
+    # the built-in families self-register at package import; resolving a
+    # name before anyone imported the package must still find them
+    if "aiyagari" not in _REGISTRY:
+        from . import aiyagari, epstein_zin, huggett  # noqa: F401
+
+
+def get_scenario(scenario) -> Scenario:
+    """Resolve a scenario name (or pass a ``Scenario`` through).  An
+    unknown name raises the typed ``UnknownScenarioError`` listing what
+    IS registered — a typo must never silently address a fresh cache
+    namespace."""
+    if isinstance(scenario, Scenario):
+        return scenario
+    _ensure_builtins()
+    try:
+        return _REGISTRY[scenario]
+    except (KeyError, TypeError):
+        raise UnknownScenarioError(scenario, _REGISTRY.keys()) from None
+
+
+def scenario_names() -> Tuple[str, ...]:
+    """Registered names, sorted (built-ins included)."""
+    _ensure_builtins()
+    return tuple(sorted(_REGISTRY))

@@ -71,6 +71,11 @@ class ServeMetrics:
     def __init__(self):
         self._lock = threading.Lock()
         self.served = {p: 0 for p in PATHS}
+        # scenario disaggregation (ISSUE 9 satellite): per-(scenario,
+        # path) served counts, so a multi-scenario service's traffic mix
+        # is visible in snapshot()/prometheus_text() instead of blending
+        # model families into one rate
+        self.served_by_scenario: dict = {}
         self.failures = 0
         self.batches = 0
         self.lanes_real = 0
@@ -186,9 +191,13 @@ class ServeMetrics:
         with self._lock:
             self.certificates[name] += 1
 
-    def record_served(self, path: str, latency_s: float) -> None:
+    def record_served(self, path: str, latency_s: float,
+                      scenario: str = "aiyagari") -> None:
         with self._lock:
             self.served[path] += 1
+            per = self.served_by_scenario.setdefault(
+                str(scenario), {p: 0 for p in PATHS})
+            per[path] += 1
             self.latency[path].add(latency_s)
             self.latency_all.add(latency_s)
 
@@ -239,6 +248,17 @@ class ServeMetrics:
                                                          (int, float)):
                 continue
             registry.gauge(f"aiyagari_{name}").set(float(value))
+        # per-scenario disaggregation (ISSUE 9 satellite): one gauge per
+        # (scenario, path) so prometheus_text() splits the traffic mix
+        # by model family
+        with self._lock:
+            per = {s: dict(c) for s, c in self.served_by_scenario.items()}
+        for scenario, counts in per.items():
+            for path, n in counts.items():
+                if n:
+                    registry.gauge(
+                        f"aiyagari_serve_served_{path}_scenario_"
+                        f"{scenario}").set(float(n))
 
     def snapshot(self) -> dict:
         """The serving record fields, bench-JSON ready (``serve_*``)."""
@@ -291,4 +311,9 @@ class ServeMetrics:
                 "serve_marginal_certificates": self.certificates["marginal"],
                 "serve_failed_certificates": self.certificates["failed"],
                 "store_corrupt_evictions": self._store_evictions(),
+                # per-scenario served counts (ISSUE 9): {scenario:
+                # {path: n}} — JSON-ready; publish() mirrors the nonzero
+                # cells as per-scenario gauges
+                "serve_scenarios": {s: dict(c) for s, c in
+                                    self.served_by_scenario.items()},
             }

@@ -64,14 +64,16 @@ def priority_name(p: int) -> str:
     return f"UNKNOWN({p})"
 
 
-def predicted_work(cell) -> float:
-    """Predicted relative solve work for one (σ, ρ, sd) cell — the PR 2
-    scheduler's cold-start cost model (``heuristic_cell_work``), reused
-    as the admission layer's queue-slot weight so occupancy is measured
-    in work, not request count."""
-    from ..parallel.sweep import heuristic_cell_work
+def predicted_work(cell, scenario: str = "aiyagari") -> float:
+    """Predicted relative solve work for one cell — the PR 2 scheduler's
+    cold-start cost model, supplied per model family by the scenario's
+    ``CellSpace.work`` (ISSUE 9), reused as the admission layer's
+    queue-slot weight so occupancy is measured in work, not request
+    count."""
+    from ..scenarios.registry import get_scenario
 
-    return float(heuristic_cell_work(np.asarray([cell]))[0])
+    work = get_scenario(scenario).cells.work
+    return float(work(np.asarray([cell]))[0])
 
 
 class _RegionState:

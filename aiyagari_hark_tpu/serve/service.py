@@ -82,6 +82,14 @@ _DEPTH_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
                   256.0, 512.0, 1024.0)
 
 
+def _scenario_of(name: str):
+    """Resolve a query's scenario bundle (registry dict lookup — cheap
+    enough for the submit hot path; ``make_query`` already validated)."""
+    from ..scenarios.registry import get_scenario
+
+    return get_scenario(name)
+
+
 class ServeError(RuntimeError):
     """Base of the serving layer's typed errors."""
 
@@ -219,7 +227,9 @@ class EquilibriumQuery(NamedTuple):
 
     Build with ``make_query`` (which canonicalizes dtype and kwargs);
     equality of two queries' ``key()`` is exactly "every input that can
-    move a bit of the answer matches".  ``fault_iter`` is the
+    move a bit of the answer matches" — the SCENARIO (model family,
+    ISSUE 9) included: a huggett query can never address an aiyagari
+    entry at numerically identical parameters.  ``fault_iter`` is the
     deterministic fault-injection hook (tests only; requires the service
     to be constructed with ``inject_fault_mode``): faulted queries bypass
     the cache on both read and write."""
@@ -237,41 +247,55 @@ class EquilibriumQuery(NamedTuple):
     # addresses the same cached solution.
     priority: int = Priority.INTERACTIVE
     degraded_ok: bool = False
+    # the registered model family (ISSUE 9): part of key() AND group(),
+    # so executables, store entries, donor groups, and breaker regions
+    # are all per-scenario.  The field names above keep their historical
+    # Aiyagari spellings; for another family read them as the scenario's
+    # first/second/third cell coordinates.
+    scenario: str = "aiyagari"
 
     def cell(self) -> Tuple[float, float, float]:
         return (self.crra, self.labor_ar, self.labor_sd)
 
     def key(self) -> int:
         return solution_fingerprint(self.crra, self.labor_ar,
-                                    self.labor_sd, self.kwargs, self.dtype)
+                                    self.labor_sd, self.kwargs, self.dtype,
+                                    scenario=self.scenario)
 
     def group(self) -> int:
-        return work_fingerprint(self.kwargs, self.dtype)
+        return work_fingerprint(self.kwargs, self.dtype,
+                                scenario=self.scenario)
 
 
 def make_query(crra: float, labor_ar: float, labor_sd: float = 0.2,
                dtype=None, fault_iter: Optional[int] = None,
                priority: int = Priority.INTERACTIVE,
-               degraded_ok: bool = False,
+               degraded_ok: bool = False, scenario: str = "aiyagari",
                **model_kwargs) -> EquilibriumQuery:
     """Canonicalize one request: dtype to the concrete compute dtype
     (``dtype=None`` and the explicit default address the same solution),
     kwargs to the sorted hashable items every fingerprint hashes.
     ``priority``/``degraded_ok`` are the overload-layer knobs (ISSUE 8);
-    they shape admission, never the answer's bits."""
+    they shape admission, never the answer's bits.  ``scenario`` names
+    the registered model family (ISSUE 9) — validated HERE, so a typo
+    raises the typed ``scenarios.UnknownScenarioError`` at build time
+    instead of silently addressing a fresh cache namespace."""
     from ..parallel.sweep import _canonical_dtype
+    from ..scenarios.registry import get_scenario
 
     priority = int(priority)
     if not 0 <= priority <= Priority.SPECULATIVE:
         raise ValueError(
             f"priority must be one of serve.Priority "
             f"(0..{Priority.SPECULATIVE}), got {priority}")
+    scn = get_scenario(scenario)
     return EquilibriumQuery(
         crra=float(crra), labor_ar=float(labor_ar),
         labor_sd=float(labor_sd), dtype=_canonical_dtype(dtype),
         kwargs=hashable_kwargs(model_kwargs),
         fault_iter=None if fault_iter is None else int(fault_iter),
-        priority=priority, degraded_ok=bool(degraded_ok))
+        priority=priority, degraded_ok=bool(degraded_ok),
+        scenario=scn.name)
 
 
 class ServedResult(NamedTuple):
@@ -309,19 +333,45 @@ class ServedResult(NamedTuple):
     #   distance to the donor (degraded answers only)
     donor_key: Optional[int] = None  # the donor's solution fingerprint
     #   (degraded answers only)
+    # scenario layer (ISSUE 9): which model family answered, plus the
+    # FULL packed row under its named fields — the Aiyagari-shaped
+    # accessors above stay (NaN/0 where a family lacks the field), and
+    # ``value("net_demand")`` reads any scenario-specific column.
+    scenario: str = "aiyagari"
+    fields: tuple = ()
+    values: tuple = ()
+
+    def value(self, name: str) -> float:
+        """One named packed-row field of the answering scenario."""
+        return self.values[self.fields.index(name)]
 
 
-def _result_from_row(row: np.ndarray, path: str, bracket_init,
-                     key: int, cert_level=None) -> ServedResult:
+def _result_from_row(schema, row: np.ndarray, path: str, bracket_init,
+                     key: int, cert_level=None,
+                     scenario: str = "aiyagari") -> ServedResult:
+    def g(name):
+        return (float(row[schema.idx(name)]) if schema.has(name)
+                else float("nan"))
+
+    def gi(name):
+        return (int(np.rint(row[schema.idx(name)])) if schema.has(name)
+                else 0)
+
+    c_bisect, c_egm, c_dist = schema.counters
+    ph = schema.phases
     return ServedResult(
-        r_star=float(row[0]), capital=float(row[1]), labor=float(row[2]),
-        bisect_iters=int(np.rint(row[3])), egm_iters=int(np.rint(row[4])),
-        dist_iters=int(np.rint(row[5])), status=int(np.rint(row[6])),
+        r_star=float(row[schema.idx(schema.root)]),
+        capital=g("capital"), labor=g("labor"),
+        bisect_iters=gi(c_bisect), egm_iters=gi(c_egm),
+        dist_iters=gi(c_dist),
+        status=int(np.rint(row[schema.idx(schema.status)])),
         path=path, bracket_init=bracket_init, key=int(key),
-        descent_steps=int(np.rint(row[7])),
-        polish_steps=int(np.rint(row[8])),
-        precision_escalations=int(np.rint(row[9])),
-        cert_level=cert_level)
+        descent_steps=gi(ph[0]) if ph else 0,
+        polish_steps=gi(ph[1]) if ph else 0,
+        precision_escalations=gi(ph[2]) if ph else 0,
+        cert_level=cert_level, scenario=scenario,
+        fields=tuple(schema.fields),
+        values=tuple(float(v) for v in np.asarray(row)))
 
 
 class _Pending(NamedTuple):
@@ -461,22 +511,28 @@ class EquilibriumService:
                 "without inject_fault_mode")
         t0 = self._clock()
         fut: Future = Future()
+        scn = _scenario_of(q.scenario)
         if q.fault_iter is None:
-            sol = self.store.get(q.key())
+            sol = self.store.get(q.key(),
+                                 schema_ck=scn.schema.checksum())
             if sol is not None:
                 lvl = int(sol.cert_level)
                 res = _result_from_row(
-                    np.asarray(sol.packed), "hit", None, q.key(),
-                    cert_level=None if lvl == UNCERTIFIED else lvl)
+                    scn.schema, np.asarray(sol.packed), "hit", None,
+                    q.key(), cert_level=None if lvl == UNCERTIFIED
+                    else lvl, scenario=scn.name)
                 latency = self._clock() - t0
-                self.metrics.record_served("hit", latency)
+                self.metrics.record_served("hit", latency,
+                                           scenario=scn.name)
                 self._obs.record_span("serve/query", latency,
-                                      path="hit", cell=q.cell())
+                                      path="hit", cell=q.cell(),
+                                      scenario=scn.name)
                 fut.set_result(res)
                 return fut
         if deadline is not None and float(deadline) <= 0.0:
             self.metrics.record_deadline_reject()
             self._obs.event("DEADLINE_EXCEEDED", cell=q.cell(),
+                            scenario=q.scenario,
                             key=q.key(), waited_s=0.0, where="submit")
             self._obs.counter(
                 "aiyagari_serve_deadline_rejects_total",
@@ -494,6 +550,7 @@ class EquilibriumService:
                 retry_after = self.breaker.retry_after(region, t0)
                 self.metrics.record_circuit_reject()
                 self._obs.event("CIRCUIT_REJECT", cell=q.cell(),
+                                scenario=q.scenario,
                                 key=q.key(), region=list(region),
                                 retry_after_s=round(retry_after, 6))
                 self._obs.counter(
@@ -505,6 +562,7 @@ class EquilibriumService:
                 probe = True
                 self.metrics.record_breaker("probe")
                 self._obs.event("CIRCUIT_PROBE", cell=q.cell(),
+                                scenario=q.scenario,
                                 key=q.key(), region=list(region))
         acquired = False
         try:
@@ -515,7 +573,7 @@ class EquilibriumService:
                     if res is not None:
                         fut.set_result(res)
                         return fut
-                weight = predicted_work(q.cell())
+                weight = predicted_work(q.cell(), scenario=q.scenario)
                 est_wait = self._estimate_wait()
                 if (adm.deadline_aware and deadline is not None
                         and float(deadline) < est_wait):
@@ -547,8 +605,10 @@ class EquilibriumService:
                 if self._closed:
                     raise ServiceClosed("EquilibriumService is closed")
                 try:
+                    # batch groups are per (scenario, dtype, kwargs):
+                    # one executable family per model family (ISSUE 9)
                     self.batcher.offer(
-                        (q.dtype, q.kwargs), pending,
+                        (q.scenario, q.dtype, q.kwargs), pending,
                         block=self._worker is not None and adm is None)
                 except ServeQueueFull:
                     if adm is None:
@@ -574,6 +634,7 @@ class EquilibriumService:
         depth = self.batcher.depth()
         self.metrics.record_overloaded()
         self._obs.event("OVERLOADED", cell=q.cell(), key=q.key(),
+                        scenario=q.scenario,
                         reason=reason, depth=depth,
                         est_wait_s=round(est_wait, 6),
                         priority=q.priority)
@@ -611,6 +672,7 @@ class EquilibriumService:
                     waited, displaced_by=q.key()))
             self.metrics.record_shed(waited)
             self._obs.event("LOAD_SHED", cell=p.query.cell(),
+                            scenario=p.query.scenario,
                             key=p.query.key(),
                             priority=p.query.priority,
                             waited_s=round(waited, 6),
@@ -632,35 +694,39 @@ class EquilibriumService:
         query's exact answer.  None when no acceptable donor exists (the
         query falls through to normal admission)."""
         adm = self._admission
+        scn = _scenario_of(q.scenario)
         near = self.store.nearest(
             q.cell(), q.group(),
-            require_certified=adm.degraded_require_certified)
+            require_certified=adm.degraded_require_certified,
+            scale=scn.cells.scale)
         if near is None:
             return None
         donor_key, dist = near
         if dist > adm.degraded_distance:
             return None
-        sol = self.store.get(donor_key)
+        sol = self.store.get(donor_key, schema_ck=scn.schema.checksum())
         if sol is None:     # evicted (LRU or corrupt) since indexing
             return None
         lvl = int(sol.cert_level)
         res = _result_from_row(
-            np.asarray(sol.packed), "degraded", None, q.key(),
-            cert_level=None if lvl == UNCERTIFIED else lvl)
+            scn.schema, np.asarray(sol.packed), "degraded", None,
+            q.key(), cert_level=None if lvl == UNCERTIFIED else lvl,
+            scenario=scn.name)
         res = res._replace(quality="degraded_neighbor",
                            degraded_distance=float(dist),
                            donor_key=int(donor_key))
         latency = self._clock() - t0
-        self.metrics.record_served("degraded", latency)
+        self.metrics.record_served("degraded", latency,
+                                   scenario=scn.name)
         self._obs.event("DEGRADED_ANSWER", cell=q.cell(), key=q.key(),
-                        donor_key=int(donor_key),
+                        scenario=scn.name, donor_key=int(donor_key),
                         distance=round(float(dist), 6))
         self._obs.counter(
             "aiyagari_serve_degraded_answers_total",
             "queries answered by a tagged nearest-neighbor under "
             "pressure").inc()
         self._obs.record_span("serve/query", latency, path="degraded",
-                              cell=q.cell())
+                              cell=q.cell(), scenario=scn.name)
         return res
 
     # -- occupancy accounting (admission enabled) ---------------------------
@@ -760,12 +826,14 @@ class EquilibriumService:
     def query(self, crra: float, labor_ar: float, labor_sd: float = 0.2,
               dtype=None, timeout: Optional[float] = None,
               deadline: Optional[float] = None,
+              scenario: str = "aiyagari",
               **model_kwargs) -> ServedResult:
         """Synchronous convenience: build the query, submit, wait.  In
         manual (no-worker) mode pending batches are flushed immediately —
         a lone synchronous caller must not wait out ``max_wait_s``."""
         fut = self.submit(make_query(crra, labor_ar, labor_sd=labor_sd,
-                                     dtype=dtype, **model_kwargs),
+                                     dtype=dtype, scenario=scenario,
+                                     **model_kwargs),
                           deadline=deadline)
         if self._worker is None and not fut.done():
             self.flush()
@@ -773,14 +841,20 @@ class EquilibriumService:
 
     # -- launch machinery ---------------------------------------------------
 
-    def _plan_seed(self, q: EquilibriumQuery, host) -> Tuple[tuple, str]:
+    def _plan_seed(self, scn, q: EquilibriumQuery,
+                   host) -> Tuple[tuple, str]:
         """The lane's bracket seed and serving path: donor descent when
-        the store nominates one, the pseudo-cold seed otherwise."""
+        the store nominates one, the pseudo-cold seed otherwise.  A
+        cold-only scenario (``scn.warm is None``) has no seed at all —
+        ``host`` is None and every miss is an honest "cold"."""
         from ..parallel.sweep import dyadic_bracket
 
+        if host is None:
+            return None, "cold"
         r_lo, r_hi, r_tol, max_levels = host
         nom = self.store.nominate(q.cell(), q.group(),
-                                  float(r_hi) - float(r_lo), r_tol)
+                                  float(r_hi) - float(r_lo), r_tol,
+                                  scale=scn.cells.scale)
         if nom is not None:
             lo, hi, lev = dyadic_bracket(r_lo, r_hi, nom.target,
                                          nom.margin, max_levels, q.dtype)
@@ -808,6 +882,7 @@ class EquilibriumService:
                 self.metrics.record_expired(now - p.t_submit)
                 self._obs.event("DEADLINE_EXCEEDED",
                                 cell=p.query.cell(),
+                                scenario=p.query.scenario,
                                 key=p.query.key(),
                                 waited_s=now - p.t_submit)
                 self._obs.counter(
@@ -836,47 +911,49 @@ class EquilibriumService:
         worker can drain."""
         import jax.numpy as jnp
 
-        from ..parallel.sweep import (
-            _batched_solver,
-            _host_bracket,
-            _host_r_tol,
-        )
-
         pendings = self._expire_due(pendings)
         if not pendings:
             return
-        dtype, kwargs_items = group
+        scenario_name, dtype, kwargs_items = group
+        scn = _scenario_of(scenario_name)
+        schema = scn.schema
         model_kwargs = dict(kwargs_items)
-        r_lo, r_hi = _host_bracket(model_kwargs, dtype)
-        r_tol = _host_r_tol(model_kwargs, dtype)
-        max_levels = max(0, int(model_kwargs.get("max_bisect", 60)) - 6)
-        host = (r_lo, r_hi, r_tol, max_levels)
+        host = None
+        if scn.warm is not None:
+            r_lo, r_hi = scn.warm.host_bracket(model_kwargs, dtype)
+            host = (r_lo, r_hi,
+                    scn.warm.host_r_tol(model_kwargs, dtype),
+                    scn.warm.max_levels(model_kwargs))
 
-        plans = [self._plan_seed(p.query, host) for p in pendings]
+        plans = [self._plan_seed(scn, p.query, host) for p in pendings]
         n = len(pendings)
         shape = self.batcher.pad_to(n)
         lanes = list(range(n)) + [n - 1] * (shape - n)
         cells = [pendings[i].query.cell() for i in lanes]
-        seeds = [plans[i][0] for i in lanes]
         args = [jnp.asarray(np.asarray([c[0] for c in cells]), dtype=dtype),
                 jnp.asarray(np.asarray([c[1] for c in cells]), dtype=dtype),
-                jnp.asarray(np.asarray([c[2] for c in cells]), dtype=dtype),
-                jnp.asarray(np.asarray([s[0] for s in seeds]), dtype=dtype),
-                jnp.asarray(np.asarray([s[1] for s in seeds]), dtype=dtype),
+                jnp.asarray(np.asarray([c[2] for c in cells]), dtype=dtype)]
+        if host is not None:
+            seeds = [plans[i][0] for i in lanes]
+            args += [
+                jnp.asarray(np.asarray([s[0] for s in seeds]),
+                            dtype=dtype),
+                jnp.asarray(np.asarray([s[1] for s in seeds]),
+                            dtype=dtype),
                 jnp.asarray(np.asarray([s[2] for s in seeds],
                                        dtype=np.int32))]
         if self._fault_mode is not None:
             fault = [(-1 if pendings[i].query.fault_iter is None
                       else pendings[i].query.fault_iter) for i in lanes]
             args.append(jnp.asarray(np.asarray(fault, dtype=np.int32)))
-        fn = _batched_solver(dtype, kwargs_items, self._fault_mode,
-                             warm=True)
+        fn = scn.batched_solver(dtype, kwargs_items, self._fault_mode,
+                                host is not None)
 
         t_launch = self._clock()
         try:
             with self._launch_lock, self.metrics.compile, \
                     self._obs.span("serve/batch_flush", lanes=n,
-                                   shape=shape,
+                                   shape=shape, scenario=scn.name,
                                    device_profile=True) as bsp:
                 packed = retry_transient(
                     lambda: np.asarray(fn(*args)), self._retry,
@@ -884,10 +961,15 @@ class EquilibriumService:
                 # phase split from the returned counters (no tracing
                 # inside jit): real lanes only — padding duplicates
                 # would double-count
-                bsp.subdivide(
-                    {"descent": float(packed[:n, 7].sum()),
-                     "polish": float(packed[:n, 8].sum())},
-                    prefix="serve/phase/")
+                if schema.phases is not None:
+                    bsp.subdivide(
+                        {"descent": float(
+                            packed[:n, schema.idx(schema.phases[0])]
+                            .sum()),
+                         "polish": float(
+                             packed[:n, schema.idx(schema.phases[1])]
+                             .sum())},
+                        prefix="serve/phase/")
         except BaseException as e:
             self._abort_probes(pendings)
             for p in pendings:
@@ -924,11 +1006,10 @@ class EquilibriumService:
         # FAILED solution
         certs = [None] * len(pendings)
         if self._certify:
-            from ..verify.certificate import certify_packed_rows
-
+            status_col = schema.idx(schema.status)
             idx = [i for i, p in enumerate(pendings)
                    if p.query.fault_iter is None
-                   and not is_failure(int(np.rint(rows[i][6])))]
+                   and not is_failure(int(np.rint(rows[i][status_col])))]
             if idx:
                 # padded to the ladder shape (last lane duplicated) like
                 # the solve launch, so a warmed service owns ONE
@@ -939,9 +1020,14 @@ class EquilibriumService:
                 cells = np.asarray([pendings[i].query.cell()
                                     for i in pidx])
                 try:
+                    if scn.certify_rows is None:
+                        raise ValueError(
+                            f"scenario {scn.name!r} has no certify_rows "
+                            "hook; run the service without "
+                            "certify_before_cache")
                     with self._launch_lock, self.metrics.compile:
                         graded = retry_transient(
-                            lambda: certify_packed_rows(
+                            lambda: scn.certify_rows(
                                 rows[pidx], cells, dtype, kwargs_items,
                                 thresholds=self._cert_thresholds),
                             self._retry, label=f"serve certify [{pad}]")
@@ -963,9 +1049,10 @@ class EquilibriumService:
                     certs[i] = cert
 
         now = self._clock()
+        status_col = schema.idx(schema.status)
         for i, p in enumerate(pendings):
             row = rows[i]
-            status = int(np.rint(row[6]))
+            status = int(np.rint(row[status_col]))
             seed, path = plans[i]
             if is_failure(status):
                 self._breaker_note(p, ok=False, now=now)
@@ -974,6 +1061,7 @@ class EquilibriumService:
                 self.metrics.record_failure(now - p.t_submit)
                 self._obs.event("SOLVER_DIVERGED",
                                 cell=p.query.cell(),
+                                scenario=scn.name,
                                 status=status_name(status),
                                 where="serve")
                 continue
@@ -987,22 +1075,27 @@ class EquilibriumService:
                     self.metrics.record_failure(now - p.t_submit)
                     self._obs.event("CERT_FAILED",
                                     cell=p.query.cell(),
+                                    scenario=scn.name,
                                     key=p.query.key(),
                                     summary=cert.summary(),
                                     where="serve")
                     continue
             self._breaker_note(p, ok=True, now=now)
             lvl = None if cert is None else cert.level
-            res = _result_from_row(row, path, seed, p.query.key(),
-                                   cert_level=lvl)
+            res = _result_from_row(schema, row, path, seed,
+                                   p.query.key(), cert_level=lvl,
+                                   scenario=scn.name)
             if p.query.fault_iter is None:
                 self.store.put(make_solution(
                     p.query.cell(), row, p.query.group(), p.query.key(),
-                    cert_level=UNCERTIFIED if lvl is None else lvl))
+                    cert_level=UNCERTIFIED if lvl is None else lvl,
+                    schema=schema))
             p.future.set_result(res)
-            self.metrics.record_served(path, now - p.t_submit)
+            self.metrics.record_served(path, now - p.t_submit,
+                                       scenario=scn.name)
             self._obs.record_span("serve/query", now - p.t_submit,
-                                  path=path, cell=p.query.cell())
+                                  path=path, cell=p.query.cell(),
+                                  scenario=scn.name)
             self.metrics.record_phases(res.descent_steps, res.polish_steps,
                                        res.precision_escalations)
 
@@ -1034,14 +1127,16 @@ class EquilibriumService:
         if tr in ("opened", "reopened"):
             self.metrics.record_breaker(tr)
             self._obs.event("CIRCUIT_OPEN", region=list(p.region),
-                            cell=p.query.cell(), transition=tr)
+                            cell=p.query.cell(),
+                            scenario=p.query.scenario, transition=tr)
             self._obs.counter(
                 "aiyagari_serve_breaker_opens_total",
                 "regional circuit breakers opened (incl. reopens)").inc()
         elif tr == "closed":
             self.metrics.record_breaker("closed")
             self._obs.event("CIRCUIT_CLOSE", region=list(p.region),
-                            cell=p.query.cell())
+                            cell=p.query.cell(),
+                            scenario=p.query.scenario)
             self._obs.counter(
                 "aiyagari_serve_breaker_closes_total",
                 "regional circuit breakers closed on certified "
@@ -1174,10 +1269,9 @@ class EquilibriumService:
         served result's ``bracket_init`` reproduces its bits."""
         import jax.numpy as jnp
 
-        from ..parallel.sweep import _batched_solver
-
+        scn = _scenario_of(q.scenario)
         warm = bracket_init is not None
-        fn = _batched_solver(q.dtype, q.kwargs, None, warm)
+        fn = scn.batched_solver(q.dtype, q.kwargs, None, warm)
         args = [jnp.asarray([q.crra], dtype=q.dtype),
                 jnp.asarray([q.labor_ar], dtype=q.dtype),
                 jnp.asarray([q.labor_sd], dtype=q.dtype)]
@@ -1186,4 +1280,5 @@ class EquilibriumService:
                      jnp.asarray([bracket_init[1]], dtype=q.dtype),
                      jnp.asarray([bracket_init[2]], dtype=np.int32)]
         row = np.asarray(fn(*args), dtype=np.float64)[0]
-        return _result_from_row(row, "reference", bracket_init, q.key())
+        return _result_from_row(scn.schema, row, "reference",
+                                bracket_init, q.key(), scenario=scn.name)

@@ -51,7 +51,6 @@ import numpy as np
 from ..obs.runtime import NULL_OBS, active_obs
 from ..solver_health import is_failure
 from ..utils.checkpoint import CORRUPT_NPZ_ERRORS, load_pytree, save_pytree
-from ..utils.config import PACKED_ROW_WIDTH
 from ..utils.fingerprint import packed_row_checksum
 
 # verify.certificate.UNCERTIFIED, inlined to keep this module's imports
@@ -63,36 +62,56 @@ UNCERTIFIED = -1
 class StoredSolution(NamedTuple):
     """One cached equilibrium, npz-able as a pytree (disk tier).
 
-    ``packed`` is the batched solver's device row in the
-    ``config.PACKED_ROW_FIELDS`` layout, in float64 — float64 round-trips
-    npz bit-exactly and holds every narrower compute dtype exactly, so a
-    reload serves the original bits.  A pre-widening disk entry fails the
-    template load and degrades like any corrupt entry.
+    ``packed`` is the batched solver's device row in its SCENARIO's
+    ``RowSchema`` layout (ISSUE 9: widths differ per family), in float64
+    — float64 round-trips npz bit-exactly and holds every narrower
+    compute dtype exactly, so a reload serves the original bits.
+    ``schema_ck`` is the producing scenario's ``RowSchema.checksum()``;
+    ``status``/``root`` lift the schema's status code and warm-start
+    target out of the row so the store never hard-codes a column index.
+    A pre-scenario disk entry fails the template load and degrades like
+    any corrupt entry; a same-key entry with a STALE schema checksum is
+    evicted at read time.
 
     ``checksum`` is the solve-time ``packed_row_checksum`` of ``packed``
     (verified at every boundary, DESIGN §9); ``cert_level`` the
     ``verify`` certificate verdict for this solution (``UNCERTIFIED``
     when the service ran without ``certify_before_cache``)."""
 
-    cell: np.ndarray    # [3] (σ, ρ, sd) float64
-    packed: np.ndarray  # [PACKED_ROW_WIDTH] float64
+    cell: np.ndarray    # [3] cell coordinates, float64
+    packed: np.ndarray  # [W] float64 — scenario row layout
     group: np.ndarray   # scalar int64 — work_fingerprint (solver config)
     key: np.ndarray     # scalar int64 — solution_fingerprint (full address)
     checksum: np.ndarray    # scalar int64 — solve-time row checksum
     cert_level: np.ndarray  # scalar int64 — verify certificate level
+    schema_ck: np.ndarray   # scalar int64 — RowSchema.checksum()
+    status: np.ndarray      # scalar int64 — solver_health code
+    root: np.ndarray        # scalar float64 — donor/warm-start target
 
 
 def _template() -> StoredSolution:
+    # leaf SHAPES come from the file (load_pytree), so one template loads
+    # every scenario's row width; structure (leaf count) is what gates
     return StoredSolution(cell=np.zeros(3),
-                          packed=np.zeros(PACKED_ROW_WIDTH),
+                          packed=np.zeros(1),
                           group=np.zeros((), np.int64),
                           key=np.zeros((), np.int64),
                           checksum=np.zeros((), np.int64),
-                          cert_level=np.zeros((), np.int64))
+                          cert_level=np.zeros((), np.int64),
+                          schema_ck=np.zeros((), np.int64),
+                          status=np.zeros((), np.int64),
+                          root=np.zeros(()))
 
 
 def make_solution(cell, packed, group: int, key: int,
-                  cert_level: int = UNCERTIFIED) -> StoredSolution:
+                  cert_level: int = UNCERTIFIED,
+                  schema=None) -> StoredSolution:
+    """Build one entry from a packed row.  ``schema`` is the producing
+    scenario's ``RowSchema`` (None = the Aiyagari layout): it names the
+    status and root columns and stamps ``schema_ck`` so stale layouts
+    drop instead of misparsing."""
+    if schema is None:
+        from ..scenarios.aiyagari import AIYAGARI_SCHEMA as schema
     packed = np.asarray(packed, dtype=np.float64)
     return StoredSolution(
         cell=np.asarray(cell, dtype=np.float64),
@@ -100,7 +119,12 @@ def make_solution(cell, packed, group: int, key: int,
         group=np.asarray(group, np.int64),
         key=np.asarray(key, np.int64),
         checksum=np.asarray(packed_row_checksum(packed), np.int64),
-        cert_level=np.asarray(int(cert_level), np.int64))
+        cert_level=np.asarray(int(cert_level), np.int64),
+        schema_ck=np.asarray(schema.checksum(), np.int64),
+        status=np.asarray(
+            int(np.rint(packed[schema.idx(schema.status)])), np.int64),
+        root=np.asarray(float(packed[schema.idx(schema.root)]),
+                        np.float64))
 
 
 class Donation(NamedTuple):
@@ -119,9 +143,10 @@ class _Meta(NamedTuple):
 
     cell: tuple
     group: int
-    r_star: float
+    r_star: float            # the schema root value (donor target)
     on_disk: bool
     cert_level: int = UNCERTIFIED
+    schema_ck: int = 0       # producing scenario's RowSchema.checksum()
 
 
 class SolutionStore:
@@ -245,13 +270,9 @@ class SolutionStore:
             try:
                 sol = load_pytree(path, _template())
             except CORRUPT_NPZ_ERRORS as e:
+                # includes pre-scenario entry formats (leaf-count
+                # mismatch): stale layouts drop, never misparse
                 self._evict_corrupt(path, f"unreadable ({e})")
-                continue
-            if sol.packed.shape != (PACKED_ROW_WIDTH,):
-                # pre-widening row layout: unreadable by this version
-                self._evict_corrupt(path,
-                                    f"stale row layout {sol.packed.shape}",
-                                    key=sol.key)
                 continue
             if not self._verified(sol):
                 self._evict_corrupt(path, "checksum mismatch",
@@ -260,22 +281,41 @@ class SolutionStore:
             self._meta[int(sol.key)] = _Meta(
                 cell=tuple(np.asarray(sol.cell, dtype=np.float64)),
                 group=int(sol.group),
-                r_star=float(sol.packed[0]), on_disk=True,
-                cert_level=int(sol.cert_level))
+                r_star=float(sol.root), on_disk=True,
+                cert_level=int(sol.cert_level),
+                schema_ck=int(sol.schema_ck))
 
     # -- core ops -----------------------------------------------------------
 
-    def get(self, key: int) -> Optional[StoredSolution]:
+    def get(self, key: int,
+            schema_ck: Optional[int] = None) -> Optional[StoredSolution]:
         """Exact lookup; promotes to most-recently-used.  A disk-resident
         entry is loaded and promoted into memory (evicting LRU).  EVERY
         return path re-verifies the entry's content checksum — a
         memory-tier bit flip is as silent as a disk one — and a failed
         verification evicts the entry (both tiers + disk file) and
         reports a miss, so the caller re-solves instead of serving
-        corruption."""
+        corruption.
+
+        ``schema_ck`` (ISSUE 9): the querying scenario's
+        ``RowSchema.checksum()``.  An entry stored under a DIFFERENT row
+        layout is evicted as stale (a widened schema must drop old
+        entries, never misparse their columns); None skips the check."""
         key = int(key)
         with self._lock:
             sol = self._mem.get(key)
+            if (sol is not None and schema_ck is not None
+                    and int(sol.schema_ck) != int(schema_ck)):
+                self._mem.pop(key, None)
+                self._meta.pop(key, None)
+                self._record_eviction("stale row schema", "memory", "",
+                                      key=key, stacklevel=3)
+                if self.disk_path is not None:
+                    try:
+                        os.remove(self._file(key))
+                    except OSError:
+                        pass
+                return None
             if sol is not None:
                 if not self._verified(sol):
                     # in-RAM corruption: drop ONLY the memory copy — the
@@ -312,10 +352,9 @@ class SolutionStore:
             except CORRUPT_NPZ_ERRORS as e:
                 self._evict_corrupt(path, f"unreadable ({e})", key=key)
                 return None
-            if sol.packed.shape != (PACKED_ROW_WIDTH,):
-                self._evict_corrupt(path,
-                                    f"stale row layout {sol.packed.shape}",
-                                    key=key)
+            if (schema_ck is not None
+                    and int(sol.schema_ck) != int(schema_ck)):
+                self._evict_corrupt(path, "stale row schema", key=key)
                 return None
             if not self._verified(sol):
                 self._evict_corrupt(path, "checksum mismatch", key=key)
@@ -326,7 +365,7 @@ class SolutionStore:
     def put(self, sol: StoredSolution) -> None:
         """Insert (or refresh) one solution.  Failed statuses are refused
         loudly — caching an uncertified result is a caller bug."""
-        status = int(np.rint(sol.packed[6]))
+        status = int(sol.status)
         if is_failure(status):
             raise ValueError(
                 f"refusing to store a failed solution (status={status}); "
@@ -347,8 +386,9 @@ class SolutionStore:
             self._meta[key] = _Meta(
                 cell=tuple(np.asarray(sol.cell, dtype=np.float64)),
                 group=int(sol.group),
-                r_star=float(sol.packed[0]), on_disk=on_disk,
-                cert_level=int(sol.cert_level))
+                r_star=float(sol.root), on_disk=on_disk,
+                cert_level=int(sol.cert_level),
+                schema_ck=int(sol.schema_ck))
             self._insert(key, sol)
 
     def _insert(self, key: int, sol: StoredSolution) -> None:
@@ -366,7 +406,7 @@ class SolutionStore:
     # -- donor nomination ---------------------------------------------------
 
     def nominate(self, cell, group: int, width: float,
-                 r_tol: float) -> Optional[Donation]:
+                 r_tol: float, scale=None) -> Optional[Donation]:
         """Warm-start donor for ``cell`` within solver group ``group``:
         target = nearest stored root in normalized (σ, ρ, sd) space,
         margin = the r*-spread between the two nearest donors (how far the
@@ -374,16 +414,25 @@ class SolutionStore:
         scheduler's neighbor rule (``parallel.sweep.neighbor_distance`` /
         ``donor_margin``, one shared implementation) pointed at the store.
         ``width`` is the economic bracket width and ``r_tol`` the
-        bisection tolerance of the *querying* configuration.  None when
-        the group holds no donors (or none inside ``donor_cutoff``)."""
-        from ..parallel.sweep import donor_margin, neighbor_distance
+        bisection tolerance of the *querying* configuration; ``scale``
+        the querying scenario's ``CellSpace.scale`` (None = the Aiyagari
+        lattice normalization).  None when the group holds no donors (or
+        none inside ``donor_cutoff``)."""
+        from ..parallel.sweep import (
+            NEIGHBOR_CELL_SCALE,
+            donor_margin,
+            neighbor_distance,
+        )
 
+        if scale is None:
+            scale = NEIGHBOR_CELL_SCALE
         with self._lock:
             rows = [(k, m) for k, m in self._meta.items()
                     if m.group == int(group) and np.isfinite(m.r_star)]
         if not rows:
             return None
-        d = neighbor_distance(cell, np.asarray([m.cell for _, m in rows]))
+        d = neighbor_distance(cell, np.asarray([m.cell for _, m in rows]),
+                              scale=scale)
         order = np.argsort(d, kind="stable")
         if float(d[order[0]]) > self.donor_cutoff:
             return None
@@ -396,7 +445,7 @@ class SolutionStore:
                         donor_key=int(k0))
 
     def nearest(self, cell, group: int,
-                require_certified: bool = False):
+                require_certified: bool = False, scale=None):
         """Nearest stored neighbor of ``cell`` within solver group
         ``group`` in normalized (σ, ρ, sd) space — the degraded-answer
         donor (ISSUE 8, DESIGN §11).  Returns ``(key, distance)`` or
@@ -410,15 +459,21 @@ class SolutionStore:
         ``require_certified`` only donors carrying a CERTIFIED/MARGINAL
         ``verify`` certificate qualify (an UNCERTIFIED entry from a
         service running without ``certify_before_cache`` is skipped)."""
-        from ..parallel.sweep import neighbor_distance
+        from ..parallel.sweep import (
+            NEIGHBOR_CELL_SCALE,
+            neighbor_distance,
+        )
 
+        if scale is None:
+            scale = NEIGHBOR_CELL_SCALE
         with self._lock:
             rows = [(k, m) for k, m in self._meta.items()
                     if m.group == int(group) and np.isfinite(m.r_star)
                     and (not require_certified or m.cert_level >= 0)]
         if not rows:
             return None
-        d = neighbor_distance(cell, np.asarray([m.cell for _, m in rows]))
+        d = neighbor_distance(cell, np.asarray([m.cell for _, m in rows]),
+                              scale=scale)
         i = int(np.argmin(d))
         return int(rows[i][0]), float(d[i])
 

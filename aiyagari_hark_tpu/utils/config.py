@@ -138,14 +138,16 @@ def resolve_precision(policy) -> PrecisionSpec:
             f"got {policy!r}") from None
 
 
-# Packed device-row layout of the batched cell solver: ONE stacked float
-# row per cell means ONE device->host transfer per launch (the round-5
-# packing rationale, ``parallel.sweep._batched_solver``).  The layout is
-# shared by the sweep, the resume ledger (``resilience.SweepLedger``),
-# and the serving store (``serve.SolutionStore``) — widening it is a
-# format change for all three, so the tuple lives HERE and the ledger
-# fingerprint hashes it (an old-width ledger refuses to resume instead of
-# crashing a restarted sweep).
+# Packed device-row layout of the AIYAGARI batched cell solver: ONE
+# stacked float row per cell means ONE device->host transfer per launch
+# (the round-5 packing rationale, ``parallel.sweep._batched_solver``).
+# This tuple is the DEFINITION SITE only (ISSUE 9): every consumer —
+# sweep engine, resume ledger, serving store, certifier — now reads the
+# layout through the scenario's ``scenarios.RowSchema`` (built from this
+# constant in ``scenarios/aiyagari.py``), and the ledger fingerprint
+# hashes the schema's field names (an old-layout ledger refuses to
+# resume instead of crashing a restarted sweep).  Direct imports outside
+# ``scenarios/`` are banned by ``scripts/check_row_schema.py``.
 PACKED_ROW_FIELDS = ("r_star", "capital", "labor", "bisect_iters",
                      "egm_iters", "dist_iters", "status",
                      "descent_steps", "polish_steps",

@@ -26,7 +26,7 @@ import json
 
 import numpy as np
 
-from .config import PACKED_ROW_FIELDS, resolve_precision
+from .config import resolve_precision
 
 
 class IntegrityError(RuntimeError):
@@ -169,46 +169,72 @@ def hashable_kwargs(model_kwargs: dict) -> tuple:
     return tuple(items)
 
 
-def work_fingerprint(kwargs_items: tuple, dtype) -> int:
-    """Solver-configuration key: the method choices, tolerances, and grid
-    sizes that shape a cell's counters and root, plus the dtype.  Cell
-    triples are NOT part of the key — rows/entries are matched per cell.
+# Scenario identity (ISSUE 9, DESIGN §12): every durable key below hashes
+# the scenario NAME, default "aiyagari".  A sidecar, ledger, store entry,
+# or serve group produced under one model family is therefore structurally
+# unaddressable from another — two scenarios colliding would require a
+# full md5 collision on inputs differing in the scenario token, never a
+# mere coincidence of numerically identical cell parameters.
+DEFAULT_SCENARIO = "aiyagari"
+
+
+def _scenario_token(scenario: str) -> str:
+    return f"scenario:{scenario}"
+
+
+def work_fingerprint(kwargs_items: tuple, dtype,
+                     scenario: str = DEFAULT_SCENARIO) -> int:
+    """Solver-configuration key: the scenario (model family), the method
+    choices, tolerances, and grid sizes that shape a cell's counters and
+    root, plus the dtype.  Cell triples are NOT part of the key —
+    rows/entries are matched per cell.
 
     Shared verbatim by the sweep sidecar (``checkpoint.SweepSidecar``) and
     the serving store's donor groups (``serve.SolutionStore``): a sidecar
     and a store entry written under the same solver configuration MUST
     carry the same group key, or warm starts silently stop flowing between
     the batch and serving paths."""
-    return config_fingerprint(str(np.dtype(dtype)), repr(kwargs_items))
+    return config_fingerprint(_scenario_token(scenario),
+                              str(np.dtype(dtype)), repr(kwargs_items))
 
 
 def solution_fingerprint(crra, labor_ar, labor_sd, kwargs_items: tuple,
-                         dtype) -> int:
+                         dtype, scenario: str = DEFAULT_SCENARIO) -> int:
     """Content address of ONE equilibrium solution: the solver group
-    (``work_fingerprint`` inputs) plus the calibration cell.  The serving
-    store's exact-hit key — two queries collide iff every input that can
-    move a bit of the answer matches."""
+    (``work_fingerprint`` inputs, scenario included) plus the calibration
+    cell.  The serving store's exact-hit key — two queries collide iff
+    every input that can move a bit of the answer matches, and a huggett
+    query can never address an aiyagari entry at the same (σ, ρ, sd)."""
     return config_fingerprint(
+        _scenario_token(scenario),
         str(np.dtype(dtype)), repr(kwargs_items),
         float(crra), float(labor_ar), float(labor_sd))
 
 
-def ledger_fingerprint(crra, rho, sd, kwargs_items: tuple, dtype,
+def ledger_fingerprint(cells, kwargs_items: tuple, dtype,
                        schedule: str, n_buckets: int, warm_brackets: bool,
                        warm_margin: float, fault_mode, fault_iters,
                        max_retries: int, quarantine: bool,
-                       sidecar) -> int:
+                       sidecar, scenario: str = DEFAULT_SCENARIO,
+                       row_fields=None) -> int:
     """Validity key of the sweep resume ledger (``resilience.SweepLedger``):
-    everything that shapes the result bits — cells (perturb included),
-    solver kwargs, dtype, schedule knobs, fault injection, and the
-    warm-start sidecar's CONTENT (seeds read it live, so a sidecar swapped
-    between interrupt and resume would silently change trajectories) — and
-    the packed-row LAYOUT (``config.PACKED_ROW_FIELDS``): a ledger written
-    under an older row width must refuse to resume instead of feeding
-    wrong-shaped rows into a restarted sweep."""
+    everything that shapes the result bits — the scenario, cells (perturb
+    included; a ``[C, k]`` array), solver kwargs, dtype, schedule knobs,
+    fault injection, and the warm-start sidecar's CONTENT (seeds read it
+    live, so a sidecar swapped between interrupt and resume would silently
+    change trajectories) — and the packed-row LAYOUT (``row_fields``, the
+    scenario's ``RowSchema.fields``; None resolves the registered
+    scenario's): a ledger written under an older row layout must refuse
+    to resume instead of feeding wrong-shaped rows into a restarted
+    sweep."""
+    if row_fields is None:
+        from ..scenarios.registry import get_scenario
+
+        row_fields = get_scenario(scenario).schema.fields
     return config_fingerprint(
-        repr(PACKED_ROW_FIELDS),
-        crra, rho, sd, repr(kwargs_items), str(np.dtype(dtype)),
+        _scenario_token(scenario), repr(tuple(row_fields)),
+        np.asarray(cells, dtype=np.float64),
+        repr(kwargs_items), str(np.dtype(dtype)),
         schedule, int(n_buckets), bool(warm_brackets),
         float(warm_margin), str(fault_mode),
         "none" if fault_iters is None else fault_iters,

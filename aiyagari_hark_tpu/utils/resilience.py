@@ -57,7 +57,6 @@ import numpy as np
 
 from ..obs.runtime import emit_event
 from ..solver_health import INTERRUPTED, SolverDivergenceError
-from .config import PACKED_ROW_WIDTH
 from .checkpoint import (
     CORRUPT_NPZ_ERRORS,
     gc_orphaned_tmp,
@@ -423,8 +422,9 @@ class SweepLedger(NamedTuple):
     cleared so the sweep recomputes it — instead of reassembling silent
     garbage into a "bit-identical" result."""
 
-    packed: np.ndarray       # [C, PACKED_ROW_WIDTH] float64; NaN rows =
-    #                          not yet solved
+    packed: np.ndarray       # [C, W] float64 in the run's scenario row
+    #                          layout (``scenarios.RowSchema``); NaN rows
+    #                          = not yet solved
     solved: np.ndarray       # [C] bool — batched result present
     bucket: np.ndarray       # [C] int64 launch group (-1 = unassigned)
     pred: np.ndarray         # [C] float64 scheduler work model
@@ -434,9 +434,9 @@ class SweepLedger(NamedTuple):
     fingerprint: np.ndarray  # scalar int64
 
 
-def _ledger_template(n: int) -> SweepLedger:
+def _ledger_template(n: int, width: int) -> SweepLedger:
     return SweepLedger(
-        packed=np.full((n, PACKED_ROW_WIDTH), np.nan),
+        packed=np.full((n, int(width)), np.nan),
         solved=np.zeros(n, dtype=bool),
         bucket=np.full(n, -1, dtype=np.int64),
         pred=np.full(n, np.nan),
@@ -454,10 +454,18 @@ class LedgerState:
     torn one.  ``complete()`` removes the file: a finished run must not
     satisfy the next run's launches silently."""
 
-    def __init__(self, path: str, fingerprint: int, n_cells: int):
+    def __init__(self, path: str, fingerprint: int, n_cells: int,
+                 width: int = 10):
+        # ``width`` is the run's scenario row width
+        # (``scenarios.RowSchema.width``); the default is the Aiyagari
+        # layout's, kept literal so this module never imports a row
+        # layout constant directly (scripts/check_row_schema.py) — the
+        # ledger fingerprint hashes the actual field names, so a wrong
+        # width can never silently resume anyway.
         self.path = path
         self.fingerprint = int(fingerprint)
-        t = _ledger_template(n_cells)
+        self.width = int(width)
+        t = _ledger_template(n_cells, width)
         self.packed = t.packed
         self.solved = t.solved
         self.bucket = t.bucket
@@ -470,19 +478,19 @@ class LedgerState:
         #                           checksum verification (recomputed)
 
     @classmethod
-    def resume(cls, path: str, fingerprint: int,
-               n_cells: int) -> "LedgerState":
+    def resume(cls, path: str, fingerprint: int, n_cells: int,
+               width: int = 10) -> "LedgerState":
         """Fresh state, or the prior run's — when ``path`` holds a ledger
         for the SAME run (fingerprint match).  A missing file is the
         normal first-run state; a corrupt/mismatched one warns and starts
         fresh (it will be overwritten at the first flush) — resume must
         degrade to recompute, never to wrong bits."""
-        self = cls(path, fingerprint, n_cells)
+        self = cls(path, fingerprint, n_cells, width=width)
         gc_orphaned_tmp(path)     # a prior hard kill may have stranded tmps
         if not os.path.exists(path):
             return self
         try:
-            led = load_pytree(path, _ledger_template(n_cells))
+            led = load_pytree(path, _ledger_template(n_cells, width))
         except CORRUPT_NPZ_ERRORS as e:
             warnings.warn(f"sweep resume ledger {path} unreadable ({e}); "
                           f"starting fresh", stacklevel=2)

@@ -465,20 +465,33 @@ def _thresholds_from_kwargs(thresholds, dtype, model_kwargs: dict):
 
 
 def certify_packed_rows(rows, cells, dtype, kwargs_items,
-                        thresholds: Optional[CertThresholds] = None):
-    """Certify a block of packed device rows (``PACKED_ROW_FIELDS``
-    layout) for the given (σ, ρ, sd) cells — the sweep/store/serve form.
-    One vmapped launch for the whole block.  Returns a list of
-    ``Certificate``; a row whose solver status is already a failure
-    certifies FAILED trivially (it is loudly NaN-masked upstream — the
-    certificate records the verdict without wasting a recomputation)."""
+                        thresholds: Optional[CertThresholds] = None,
+                        schema=None):
+    """Certify a block of packed device rows for the given (σ, ρ, sd)
+    cells — the sweep/store/serve form.  One vmapped launch for the whole
+    block.  Returns a list of ``Certificate``; a row whose solver status
+    is already a failure certifies FAILED trivially (it is loudly
+    NaN-masked upstream — the certificate records the verdict without
+    wasting a recomputation).
+
+    ``schema`` is the row layout (``scenarios.RowSchema``; ISSUE 9
+    satellite — the status/root/capital columns are read by NAME, never
+    by hard-coded index).  None resolves the Aiyagari layout, whose
+    solver family this recompute certifier belongs to."""
     from ..obs.runtime import active_span
 
+    if schema is None:
+        from ..scenarios.aiyagari import AIYAGARI_SCHEMA as schema
+    status_col = schema.idx(schema.status)
+    root_col = schema.idx(schema.root)
+    cap_col = (schema.idx("capital") if schema.has("capital")
+               else root_col)
     rows = np.asarray(rows, dtype=np.float64)
     cells = np.asarray(cells, dtype=np.float64)
     model_kwargs = dict(kwargs_items)
     thr = _thresholds_from_kwargs(thresholds, dtype, model_kwargs)
-    healthy = ~np.asarray([is_failure(int(np.rint(r[6]))) for r in rows])
+    healthy = ~np.asarray([is_failure(int(np.rint(r[status_col])))
+                           for r in rows])
     out: list = [None] * len(rows)
     if healthy.any():
         import jax.numpy as jnp
@@ -493,13 +506,13 @@ def certify_packed_rows(rows, cells, dtype, kwargs_items,
                 jnp.asarray(cells[idx, 0], dtype=dtype),
                 jnp.asarray(cells[idx, 1], dtype=dtype),
                 jnp.asarray(cells[idx, 2], dtype=dtype),
-                jnp.asarray(rows[idx, 0], dtype=dtype),
-                jnp.asarray(rows[idx, 1], dtype=dtype)),
+                jnp.asarray(rows[idx, root_col], dtype=dtype),
+                jnp.asarray(rows[idx, cap_col], dtype=dtype)),
                 dtype=np.float64)
         for j, i in enumerate(idx):
             out[int(i)] = thr.certificate(resids[j])
     for i in np.nonzero(~healthy)[0]:
-        status = int(np.rint(rows[i][6]))
+        status = int(np.rint(rows[i][status_col]))
         # the full CERT_CHECKS-ordered vector (every consumer zips
         # against it): the unevaluated checks carry NaN residuals —
         # "could not certify" grades FAILED, never CERTIFIED-by-default

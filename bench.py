@@ -1106,6 +1106,129 @@ def _serve_smoke() -> dict:
     return record
 
 
+def _scenario_smoke() -> dict:
+    """The ``--scenario-smoke`` acceptance run (ISSUE 9): the non-Aiyagari
+    families ride the whole stack on CPU — a balanced Huggett sweep with
+    certification and a quarantine drill, a serve replay (cold fill,
+    zero-compile exact-hit replay, near-hit neighbor replay), and a small
+    Epstein-Zin certified sweep — emitting the ``scenario_*`` record
+    (per-scenario cells/sec, warm-replay compile count, cert verdicts)."""
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    from aiyagari_hark_tpu.parallel.sweep import run_sweep
+    from aiyagari_hark_tpu.scenarios import scenario_names
+    from aiyagari_hark_tpu.serve import EquilibriumService, make_query
+    from aiyagari_hark_tpu.utils.config import SweepConfig
+    from aiyagari_hark_tpu.utils.timing import CompileCounter
+
+    backend = jax.default_backend()
+    record = {"metric": "scenario_smoke", "backend": backend,
+              "scenario_names": list(scenario_names())}
+
+    # -- phase 1: Huggett balanced sweep, certified, quarantine drill ----
+    hkw = dict(a_count=12, dist_count=48, labor_states=3, r_tol=1e-5,
+               max_bisect=20, egm_tol=1e-5, dist_tol=1e-9,
+               borrow_limit=-2.0)
+    hcfg = SweepConfig(crra_values=(1.5, 3.0), rho_values=(0.3, 0.6),
+                       schedule="balanced", n_buckets=2, certify=True)
+    res = run_sweep("huggett", sweep=hcfg, **hkw)   # warm-up + compile
+    t0 = time.perf_counter()
+    timed = run_sweep("huggett", sweep=hcfg, perturb=1e-6, **hkw)
+    wall = time.perf_counter() - t0
+    cert = np.asarray(timed.cert_level)
+    record.update({
+        "scenario_huggett_cells": int(len(timed.rows)),
+        "scenario_huggett_sweep_wall_s": round(wall, 3),
+        "scenario_huggett_cells_per_sec": round(len(timed.rows) / wall,
+                                                3),
+        "scenario_huggett_failed_cells": int(
+            len(timed.failed_cells())),
+        "scenario_huggett_cert_certified": int((cert == 0).sum()),
+        "scenario_huggett_cert_marginal": int((cert == 1).sum()),
+        "scenario_huggett_cert_failed": int((cert == 2).sum()),
+    })
+    drill = run_sweep("huggett", sweep=hcfg.replace(certify=False),
+                      inject_fault={"cell": 1, "at_iter": 2,
+                                    "mode": "nan"},
+                      max_retries=2, **hkw)
+    record["scenario_huggett_quarantine_recovered"] = bool(
+        int(drill.retries[1]) >= 1 and not len(drill.failed_cells()))
+    print(f"[bench] scenario smoke: huggett sweep "
+          f"{record['scenario_huggett_cells_per_sec']} cells/s, cert "
+          f"C/M/F {record['scenario_huggett_cert_certified']}/"
+          f"{record['scenario_huggett_cert_marginal']}/"
+          f"{record['scenario_huggett_cert_failed']}, quarantine "
+          f"recovered={record['scenario_huggett_quarantine_recovered']}",
+          file=sys.stderr)
+
+    # -- phase 2: Huggett serve replay -----------------------------------
+    cells = [(s, r) for s in (1.5, 3.0) for r in (0.3, 0.6)]
+    svc = EquilibriumService(start_worker=False, max_batch=4,
+                             ladder=(1, 2, 4), donor_cutoff=1.0,
+                             certify_before_cache=True)
+    t0 = time.perf_counter()
+    futs = [svc.submit(make_query(s, r, scenario="huggett", **hkw))
+            for s, r in cells]
+    svc.flush()
+    cold = [f.result(0) for f in futs]
+    cold_wall = time.perf_counter() - t0
+    with CompileCounter() as c_hits:
+        for s, r in cells:
+            fut = svc.submit(make_query(s, r, scenario="huggett", **hkw))
+            assert fut.done(), "exact replay must resolve at submit"
+            fut.result(0)
+    futs = [svc.submit(make_query(s, r + 0.05, scenario="huggett",
+                                  **hkw)) for s, r in cells]
+    svc.flush()
+    near = [f.result(0) for f in futs]
+    snap = svc.metrics.snapshot()
+    record.update({
+        "scenario_serve_cold_wall_s": round(cold_wall, 3),
+        "scenario_serve_cold_paths": [r.path for r in cold],
+        # acceptance: the warmed exact replay compiles NOTHING
+        "scenario_serve_hit_replay_compiles": c_hits.compile_events,
+        "scenario_serve_hit_p50_ms": snap["serve_hit_p50_ms"],
+        "scenario_serve_near_rate": round(
+            [r.path for r in near].count("near") / len(near), 4),
+        "scenario_serve_certified": snap["serve_certified"],
+        "scenario_serve_scenarios": snap["serve_scenarios"],
+    })
+    svc.close()
+    print(f"[bench] scenario smoke: serve hit p50="
+          f"{snap['serve_hit_p50_ms']}ms, replay compiles="
+          f"{c_hits.compile_events}, near rate="
+          f"{record['scenario_serve_near_rate']}", file=sys.stderr)
+
+    # -- phase 3: Epstein-Zin certified mini-sweep -----------------------
+    ekw = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-4,
+               max_bisect=12, egm_tol=1e-5, dist_tol=1e-8, ez_rho=2.0)
+    ecfg = SweepConfig(crra_values=(2.0, 6.0), rho_values=(0.3,),
+                       certify=True)
+    t0 = time.perf_counter()
+    ez = run_sweep("epstein_zin", sweep=ecfg, **ekw)
+    ez_wall = time.perf_counter() - t0
+    ez_cert = np.asarray(ez.cert_level)
+    record.update({
+        "scenario_ez_cells": int(len(ez.rows)),
+        "scenario_ez_sweep_wall_s": round(ez_wall, 3),
+        "scenario_ez_cells_per_sec": round(len(ez.rows) / ez_wall, 3),
+        "scenario_ez_cert_certified": int((ez_cert == 0).sum()),
+        "scenario_ez_cert_failed": int((ez_cert == 2).sum()),
+        # risk aversion up at fixed EIS -> r* down (the EZ oracle)
+        "scenario_ez_gamma_monotone": bool(
+            float(ez.col("r_star")[1]) < float(ez.col("r_star")[0])),
+    })
+    print(f"[bench] scenario smoke: epstein_zin "
+          f"{record['scenario_ez_cells_per_sec']} cells/s, cert "
+          f"C/F {record['scenario_ez_cert_certified']}/"
+          f"{record['scenario_ez_cert_failed']}, gamma-monotone="
+          f"{record['scenario_ez_gamma_monotone']}", file=sys.stderr)
+    return record
+
+
 # Integrity smoke (ISSUE 6): certification/recheck economics measured at
 # the committed-golden 12-cell configuration (tests/data/
 # table2_golden_test.json — real f64 physics, so the certificate
@@ -1900,15 +2023,24 @@ def main(argv=None):
                          "shed/reject/degrade/breaker accounting, "
                          "journal consistency) and emit the load_* "
                          "record instead of the full bench")
+    ap.add_argument("--scenario-smoke", action="store_true",
+                    help="run the scenario-registry smoke (ISSUE 9: "
+                         "balanced+certified Huggett sweep with a "
+                         "quarantine drill, Huggett serve replay with "
+                         "zero-compile exact hits and near-hit warm "
+                         "starts, certified Epstein-Zin mini-sweep) and "
+                         "emit the scenario_* record instead of the "
+                         "full bench")
     args = ap.parse_args(argv)
     if (args.serve_smoke or args.integrity_smoke or args.obs_smoke
-            or args.load_smoke):
+            or args.load_smoke or args.scenario_smoke):
         from aiyagari_hark_tpu.utils.backend import (
             enable_compilation_cache,
         )
 
         enable_compilation_cache()
-        smoke = (_load_smoke if args.load_smoke
+        smoke = (_scenario_smoke if args.scenario_smoke
+                 else _load_smoke if args.load_smoke
                  else _obs_smoke if args.obs_smoke
                  else _integrity_smoke if args.integrity_smoke
                  else _serve_smoke)

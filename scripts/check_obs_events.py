@@ -66,10 +66,10 @@ EMIT_NAMES = {"emit", "emit_event", "event", "_record_eviction"}
 
 # Recovering seams (no error escapes, so the construction rule cannot
 # see them) that must emit anyway: quarantine/retry/evict sites.  The
-# sweep's quarantine ladder is inline in ``_run_table2_sweep_impl`` —
+# sweep's quarantine ladder is inline in ``_run_sweep_impl`` —
 # listed here so stripping its QUARANTINE event is a lint failure too.
 SEAM_DEFS = {"_evict_corrupt", "_record_eviction", "retry_transient",
-             "_run_table2_sweep_impl"}
+             "_run_sweep_impl"}
 
 
 def _call_name(node: ast.Call):

@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Static lint: packed-row layouts are read through a scenario's
+``RowSchema``, never via the module-level Aiyagari constants (ISSUE 9).
+
+Before the scenario registry, ``config.PACKED_ROW_FIELDS`` /
+``PACKED_ROW_WIDTH`` were imported directly by the sweep engine, the
+resume ledger, the solution store, and the certifier — exactly the
+coupling that hard-wired the whole run stack to one model family (and
+the coupling a second family would silently misparse: a width-7 Huggett
+row read through a width-10 constant is column soup, not an error).  The
+registry routes every consumer through ``Scenario.schema``; this lint
+keeps fresh direct uses from regressing in:
+
+any NAME USE of ``PACKED_ROW_FIELDS`` / ``PACKED_ROW_WIDTH`` (import or
+reference) in the package or entry points must be in
+
+* ``utils/config.py`` — the definition site (the canonical Aiyagari
+  layout constant itself), or
+* ``scenarios/`` — where the Aiyagari ``RowSchema`` is built FROM the
+  constant, or
+* a line carrying an explicit ``# row-schema-ok`` waiver stating why a
+  direct read is correct (e.g. a docstring-generation helper).
+
+Run standalone (exits 1 on findings) or via tier-1
+(``tests/test_scenarios.py``).  tests/ are out of scope — pinning the
+constant's literal value IS a test's job.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCAN_ROOTS = ("aiyagari_hark_tpu",)
+SCAN_FILES = ("bench.py", "reproduce.py")
+
+BANNED = {"PACKED_ROW_FIELDS", "PACKED_ROW_WIDTH"}
+WAIVER = "# row-schema-ok"
+
+# Definition site + the scenario package that wraps it into a RowSchema.
+ALLOWED_FILES = {os.path.join("aiyagari_hark_tpu", "utils", "config.py")}
+ALLOWED_DIRS = (os.path.join("aiyagari_hark_tpu", "scenarios"),)
+
+
+def _allowed(rel: str) -> bool:
+    rel = rel.replace(os.sep, "/")
+    if rel in {a.replace(os.sep, "/") for a in ALLOWED_FILES}:
+        return True
+    return any(rel.startswith(d.replace(os.sep, "/") + "/")
+               for d in ALLOWED_DIRS)
+
+
+def scan_source(src: str, rel: str) -> list:
+    """Findings for one file's source text (exposed for fixture tests)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [(rel, e.lineno or 0, f"unparseable: {e.msg}")]
+    lines = src.splitlines()
+    findings = []
+
+    def _flag(lineno: int, what: str) -> None:
+        line = lines[lineno - 1] if lineno <= len(lines) else ""
+        if WAIVER in line:
+            return
+        findings.append(
+            (rel, lineno,
+             f"direct use of {what} outside scenarios/ — read the row "
+             "layout through the scenario's RowSchema "
+             "(scenarios.get_scenario(...).schema), or waive with "
+             "'# row-schema-ok'"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name in BANNED:
+                    _flag(node.lineno, alias.name)
+        elif isinstance(node, ast.Import):
+            continue
+        elif isinstance(node, ast.Name) and node.id in BANNED:
+            _flag(node.lineno, node.id)
+        elif (isinstance(node, ast.Attribute)
+              and node.attr in BANNED):
+            _flag(node.lineno, node.attr)
+    return findings
+
+
+def scan_file(path: str, rel: str) -> list:
+    if _allowed(rel):
+        return []
+    with open(path) as fh:
+        return scan_source(fh.read(), rel)
+
+
+def scan_targets(repo: str = REPO) -> list:
+    targets = []
+    for root in SCAN_ROOTS:
+        for dirpath, _, names in os.walk(os.path.join(repo, root)):
+            if "__pycache__" in dirpath:
+                continue
+            targets += [os.path.join(dirpath, n) for n in sorted(names)
+                        if n.endswith(".py")]
+    targets += [os.path.join(repo, f) for f in SCAN_FILES]
+    return targets
+
+
+def scan(repo: str = REPO) -> list:
+    findings = []
+    for path in scan_targets(repo):
+        if os.path.exists(path):
+            findings += scan_file(path, os.path.relpath(path, repo))
+    return findings
+
+
+def main() -> int:
+    findings = scan()
+    for rel, lineno, msg in findings:
+        print(f"{rel}:{lineno}: {msg}")
+    if findings:
+        print(f"{len(findings)} direct row-layout use(s); see "
+              f"scripts/check_row_schema.py docstring")
+        return 1
+    print("row-schema lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -106,34 +106,30 @@ def test_precision_policy_in_group_keys():
 def test_ledger_fingerprint_covers_row_layout():
     """A resume ledger written under a different packed-row layout must
     never fingerprint-match (the pre-widening ledger would feed
-    wrong-shaped rows into a restarted sweep)."""
-    from aiyagari_hark_tpu.utils import fingerprint as fp
+    wrong-shaped rows into a restarted sweep).  The layout now arrives
+    as the SCENARIO's ``RowSchema.fields`` (ISSUE 9)."""
     from aiyagari_hark_tpu.utils.config import PACKED_ROW_FIELDS
 
-    crra = np.asarray([1.0])
-    rho = np.asarray([0.3])
-    sd = np.asarray([0.2])
-    args = dict(crra=crra, rho=rho, sd=sd,
+    cells = np.asarray([[1.0, 0.3, 0.2]])
+    args = dict(cells=cells,
                 kwargs_items=hashable_kwargs(KW), dtype=np.float64,
                 schedule="locked", n_buckets=0, warm_brackets=False,
                 warm_margin=0.0, fault_mode=None, fault_iters=None,
                 max_retries=3, quarantine=True, sidecar=None)
-    base = ledger_fingerprint(**args)
-    try:
-        fp.PACKED_ROW_FIELDS = PACKED_ROW_FIELDS[:7]   # the pre-PR layout
-        assert ledger_fingerprint(**args) != base
-    finally:
-        fp.PACKED_ROW_FIELDS = PACKED_ROW_FIELDS
+    base = ledger_fingerprint(**args, row_fields=PACKED_ROW_FIELDS)
+    # None resolves the registered scenario's schema — same key
+    assert ledger_fingerprint(**args) == base
+    # the pre-PR-5 7-field layout must never match
+    assert ledger_fingerprint(
+        **args, row_fields=PACKED_ROW_FIELDS[:7]) != base
 
 
 def test_ledger_fingerprint_sensitivity():
-    crra = np.asarray([1.0, 3.0])
-    rho = np.asarray([0.3, 0.6])
-    sd = np.asarray([0.2, 0.2])
+    cells = np.asarray([[1.0, 0.3, 0.2], [3.0, 0.6, 0.2]])
     items = hashable_kwargs(KW)
 
     def fp(**over):
-        kw = dict(crra=crra, rho=rho, sd=sd, kwargs_items=items,
+        kw = dict(cells=cells, kwargs_items=items,
                   dtype=np.float64, schedule="balanced", n_buckets=0,
                   warm_brackets=False, warm_margin=0.0, fault_mode=None,
                   fault_iters=None, max_retries=3, quarantine=True,
@@ -145,8 +141,11 @@ def test_ledger_fingerprint_sensitivity():
     assert fp() == base
     assert fp(schedule="locked") != base
     assert fp(warm_brackets=True) != base
-    assert fp(rho=rho + 1e-6) != base                  # perturb included
+    assert fp(cells=cells + 1e-6) != base              # perturb included
     assert fp(fault_iters=np.asarray([0, -1])) != base
+    # scenario identity keys the ledger too (ISSUE 9): the same cells
+    # and kwargs under another model family can never resume each other
+    assert fp(scenario="huggett") != base
     # the sidecar's CONTENT is part of the key (a swapped sidecar between
     # interrupt and resume must invalidate the ledger)
     from aiyagari_hark_tpu.utils.checkpoint import SweepSidecar

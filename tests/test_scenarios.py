@@ -1,0 +1,485 @@
+"""Scenario registry (ISSUE 9): the registry contract, row-schema
+round-trips, scenario-keyed fingerprints, and the full-pipeline
+acceptance for the non-Aiyagari families — Huggett and Epstein-Zin run
+the balanced sweep with quarantine, SIGTERM-resume bit-identity, and the
+serve paths with certification, exactly like Aiyagari does."""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.parallel.sweep import run_sweep, run_table2_sweep
+from aiyagari_hark_tpu.scenarios import (
+    CellSpace,
+    DuplicateScenarioError,
+    RowSchema,
+    Scenario,
+    ScenarioError,
+    UnknownScenarioError,
+    get_scenario,
+    register,
+    scenario_names,
+    unregister,
+)
+from aiyagari_hark_tpu.serve import (
+    EquilibriumService,
+    SolutionStore,
+    make_query,
+    make_solution,
+)
+from aiyagari_hark_tpu.solver_health import CONVERGED, is_failure
+from aiyagari_hark_tpu.utils.config import SweepConfig
+from aiyagari_hark_tpu.utils.fingerprint import (
+    hashable_kwargs,
+    solution_fingerprint,
+    work_fingerprint,
+)
+from aiyagari_hark_tpu.utils.resilience import (
+    Interrupted,
+    LedgerState,
+    preemption_guard,
+)
+
+# The same tiny-cell Aiyagari configuration as tests/test_serve.py, so
+# cross-scenario service tests share compiled executables with the rest
+# of the suite.
+KW = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-4,
+          max_bisect=16)
+
+# Small-but-real Huggett configuration (x64; one shared dict so every
+# test in this file addresses ONE executable family per shape).
+HKW = dict(a_count=12, dist_count=48, labor_states=3, r_tol=1e-5,
+           max_bisect=20, egm_tol=1e-5, dist_tol=1e-9,
+           borrow_limit=-2.0)
+HCFG = SweepConfig(crra_values=(1.5, 3.0), rho_values=(0.3, 0.6),
+                   schedule="balanced", n_buckets=2)
+
+# Tiny Epstein-Zin configuration (cold solves per midpoint are the
+# expensive part — keep the budget small).
+EKW = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-4,
+           max_bisect=12, egm_tol=1e-5, dist_tol=1e-8, ez_rho=2.0)
+ECFG = SweepConfig(crra_values=(2.0, 6.0), rho_values=(0.3, 0.6),
+                   schedule="balanced", n_buckets=2)
+
+
+def assert_rows_identical(a, b, skip_cells=()):
+    """Bitwise equality of two ScenarioSweepResults' rows/status/retries
+    (optionally ignoring specific cells)."""
+    keep = np.ones(len(a.rows), dtype=bool)
+    for i in skip_cells:
+        keep[i] = False
+    assert np.array_equal(a.rows[keep], b.rows[keep], equal_nan=True)
+    assert np.array_equal(a.status[keep], b.status[keep])
+    assert np.array_equal(a.retries[keep], b.retries[keep])
+
+
+# ---------------------------------------------------------------------------
+# Registry contract.
+# ---------------------------------------------------------------------------
+
+def test_builtins_registered():
+    names = scenario_names()
+    for name in ("aiyagari", "huggett", "epstein_zin"):
+        assert name in names
+        scn = get_scenario(name)
+        assert scn.name == name
+        assert scn.schema.width == len(scn.schema.fields)
+
+
+def test_unknown_scenario_raises_typed():
+    with pytest.raises(UnknownScenarioError) as ei:
+        get_scenario("hugget")            # the typo must not auto-create
+    assert "hugget" in str(ei.value)
+    assert "huggett" in str(ei.value)     # the message lists what exists
+    assert isinstance(ei.value, KeyError)
+    with pytest.raises(UnknownScenarioError):
+        make_query(3.0, 0.6, scenario="not-a-family", **KW)
+
+
+def test_duplicate_registration_raises():
+    scn = get_scenario("huggett")
+    with pytest.raises(DuplicateScenarioError):
+        register(scn)
+    # replace=True is the explicit escape hatch and returns the prior
+    prior = register(scn, replace=True)
+    assert prior is scn
+    # a fresh name registers cleanly and can be removed again
+    extra = Scenario(name="huggett-test-clone", schema=scn.schema,
+                     cells=scn.cells, batched_solver=scn.batched_solver,
+                     eager_row=scn.eager_row, retry_rungs=scn.retry_rungs)
+    try:
+        register(extra)
+        assert get_scenario("huggett-test-clone") is extra
+    finally:
+        unregister("huggett-test-clone")
+    with pytest.raises(UnknownScenarioError):
+        get_scenario("huggett-test-clone")
+
+
+def test_row_schema_validation():
+    with pytest.raises(ScenarioError):
+        RowSchema(fields=("a", "a", "status"))          # repeated field
+    with pytest.raises(ScenarioError):
+        RowSchema(fields=("r_star", "status"),
+                  counters=("x", "y", "z"))             # roles not in layout
+    with pytest.raises(ScenarioError):
+        CellSpace(names=("a", "b"), scale=(1.0, 1.0),
+                  work=lambda c: c[:, 0])               # not CELL_DIM
+    schema = get_scenario("huggett").schema
+    assert schema.idx("net_demand") == 1
+    with pytest.raises(ScenarioError):
+        schema.idx("capital")                           # typed, not ValueError
+
+
+def test_schema_checksums_distinct_per_layout():
+    cks = {get_scenario(n).schema.checksum() for n in scenario_names()}
+    # aiyagari (10 fields) / huggett (7) / epstein_zin (7, different
+    # names) must all disagree — same-width layouts included
+    assert len(cks) == len(scenario_names())
+
+
+# ---------------------------------------------------------------------------
+# Scenario identity in every fingerprint (the structural-collision
+# property of the acceptance criteria).
+# ---------------------------------------------------------------------------
+
+def test_fingerprints_scenario_keyed_property():
+    """For a grid of cells and kwargs variants, the work/solution keys of
+    different scenarios NEVER collide — scenario identity is a hashed
+    token, so a collision would need md5 to collide, not parameters to
+    coincide."""
+    rng = np.random.default_rng(7)
+    kwargs_variants = [KW, {**KW, "r_tol": 2e-4}, {}]
+    names = scenario_names()
+    for kw in kwargs_variants:
+        items = hashable_kwargs(dict(kw))
+        groups = [work_fingerprint(items, np.float64, scenario=n)
+                  for n in names]
+        assert len(set(groups)) == len(names)
+        for _ in range(10):
+            cell = rng.uniform([1.0, 0.0, 0.1], [6.0, 0.9, 0.4])
+            keys = [solution_fingerprint(cell[0], cell[1], cell[2],
+                                         items, np.float64, scenario=n)
+                    for n in names]
+            assert len(set(keys)) == len(names)
+
+
+def test_query_keys_scenario_keyed():
+    qa = make_query(3.0, 0.6, **KW)
+    qh = make_query(3.0, 0.6, scenario="huggett", **KW)
+    assert qa.key() != qh.key()
+    assert qa.group() != qh.group()
+
+
+# ---------------------------------------------------------------------------
+# Schema <-> checksum <-> ledger <-> store round-trip, per scenario.
+# ---------------------------------------------------------------------------
+
+def _synthetic_row(schema):
+    row = np.arange(1.0, schema.width + 1.0)
+    row[schema.idx(schema.root)] = 0.0371
+    row[schema.idx(schema.status)] = float(CONVERGED)
+    return row
+
+
+@pytest.mark.parametrize("name", ["aiyagari", "huggett", "epstein_zin"])
+def test_schema_ledger_store_roundtrip(tmp_path, name):
+    scn = get_scenario(name)
+    schema = scn.schema
+    row = _synthetic_row(schema)
+
+    # ledger: record at the scenario's width, flush, resume bit-identical
+    path = str(tmp_path / f"{name}_ledger.npz")
+    led = LedgerState(path, fingerprint=42, n_cells=3,
+                      width=schema.width)
+    led.record_bucket(np.asarray([0, 2]), np.stack([row, row * 2.0]), 0)
+    led.flush()
+    back = LedgerState.resume(path, 42, 3, width=schema.width)
+    assert back.resumed
+    assert np.array_equal(back.packed[[0, 2]],
+                          np.stack([row, row * 2.0]))
+    assert not back.solved[1]
+
+    # store: entry carries the schema checksum, lifts root/status by
+    # name, round-trips through the disk tier, and refuses a stale
+    # schema at read time
+    store = SolutionStore(capacity=4,
+                          disk_path=str(tmp_path / f"{name}_store"))
+    sol = make_solution((3.0, 0.6, 0.2), row, group=7, key=11,
+                        schema=schema)
+    assert int(sol.schema_ck) == schema.checksum()
+    assert float(sol.root) == row[schema.idx(schema.root)]
+    assert int(sol.status) == CONVERGED
+    store.put(sol)
+    got = store.get(11, schema_ck=schema.checksum())
+    assert got is not None
+    assert np.array_equal(np.asarray(got.packed), row)
+    # a DIFFERENT schema checksum is a stale layout: evicted, not served
+    other = get_scenario("huggett" if name != "huggett"
+                         else "aiyagari").schema
+    with pytest.warns(UserWarning, match="stale row schema"):
+        assert store.get(11, schema_ck=other.checksum()) is None
+    assert store.get(11, schema_ck=schema.checksum()) is None  # gone
+
+
+def test_cross_scenario_store_never_serves(tmp_path):
+    """An aiyagari entry can NEVER answer a huggett query at numerically
+    identical parameters: the keys differ structurally, so the store has
+    no entry at the huggett address at all."""
+    store = SolutionStore(capacity=8)
+    qa = make_query(3.0, 0.6, **KW)
+    qh = make_query(3.0, 0.6, scenario="huggett", **KW)
+    row = _synthetic_row(get_scenario("aiyagari").schema)
+    store.put(make_solution(qa.cell(), row, qa.group(), qa.key()))
+    assert store.get(qa.key()) is not None
+    assert store.get(qh.key()) is None
+    # and the donor path is scenario-local too: the huggett group holds
+    # no donors even though a numerically identical cell is cached
+    assert store.nominate(qh.cell(), qh.group(), 0.1, 1e-6) is None
+
+
+# ---------------------------------------------------------------------------
+# run_sweep("aiyagari") IS run_table2_sweep (the thin-wrapper pin).
+# ---------------------------------------------------------------------------
+
+def test_aiyagari_wrapper_is_thin():
+    cfg = SweepConfig(crra_values=(1.0, 5.0), rho_values=(0.0, 0.9))
+    table = run_table2_sweep(cfg, **KW)
+    rows = run_sweep("aiyagari", sweep=cfg, **KW)
+    assert rows.scenario == "aiyagari"
+    assert np.array_equal(rows.col("r_star") * 100.0, table.r_star_pct,
+                          equal_nan=True)
+    assert np.array_equal(rows.col("capital"), table.capital,
+                          equal_nan=True)
+    assert np.array_equal(rows.icol("egm_iters"), table.egm_iters)
+    assert np.array_equal(rows.status, table.status)
+
+
+# ---------------------------------------------------------------------------
+# Huggett: the full pipeline (balanced sweep + quarantine, SIGTERM
+# resume, serve paths + certification).
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def huggett_clean():
+    """The reference Huggett run: balanced 4-cell sweep, certified."""
+    res = run_sweep("huggett", sweep=HCFG.replace(certify=True), **HKW)
+    assert not is_failure(res.status).any()
+    assert res.cert_level is not None
+    assert (res.cert_level <= 1).all()        # CERTIFIED or MARGINAL
+    # the economics: r* below the autarky bound, positive borrower mass
+    assert (res.col("r_star") < (1.0 - 0.96) / 0.96).all()
+    assert (res.col("borrower_share") > 0.0).all()
+    return res
+
+
+def test_huggett_balanced_sweep_with_quarantine(huggett_clean):
+    """An injected NaN at one cell's bisection trips quarantine; the
+    retry ladder recovers it and every OTHER cell is bit-identical to
+    the clean run."""
+    res = run_sweep("huggett", sweep=HCFG,
+                    inject_fault={"cell": 1, "at_iter": 2, "mode": "nan"},
+                    max_retries=2, **HKW)
+    assert int(res.retries[1]) >= 1            # the ladder really ran
+    assert not is_failure(res.status).any()    # and recovered
+    assert_rows_identical(res, huggett_clean, skip_cells=(1,))
+    # the recovered root agrees with the clean one at solver noise
+    assert abs(float(res.col("r_star")[1])
+               - float(huggett_clean.col("r_star")[1])) < 5e-4
+
+
+def test_huggett_sigterm_resume_bit_identical(tmp_path, huggett_clean):
+    """SIGTERM after bucket 0 raises the typed Interrupted with a valid
+    ledger; the resumed run reassembles bit-identically to the clean
+    run."""
+    ledger = str(tmp_path / "huggett_ledger.npz")
+    with preemption_guard():
+        with pytest.raises(Interrupted) as ei:
+            run_sweep("huggett", sweep=HCFG, resume_path=ledger,
+                      inject_preempt={"after_bucket": 0,
+                                      "mode": "signal"}, **HKW)
+    assert ei.value.signum == signal.SIGTERM
+    assert os.path.exists(ledger)
+    resumed = run_sweep("huggett", sweep=HCFG, resume_path=ledger, **HKW)
+    assert not os.path.exists(ledger)
+    assert_rows_identical(resumed, huggett_clean)
+
+
+def test_huggett_serve_paths_and_certification():
+    """One service serves Huggett cold / exact-hit / near (verified
+    bracket seeds) with certify-before-cache; served bits equal the
+    reference batch-of-1 launch with the same seed."""
+    svc = EquilibriumService(start_worker=False, max_batch=4,
+                             ladder=(1, 2, 4), donor_cutoff=1.0,
+                             certify_before_cache=True)
+    cells = [(1.5, 0.3), (3.0, 0.6)]
+    futs = [svc.submit(make_query(s, r, scenario="huggett", **HKW))
+            for s, r in cells]
+    svc.flush()
+    cold = [f.result(0) for f in futs]
+    assert [r.path for r in cold] == ["cold", "cold"]
+    assert all(r.scenario == "huggett" for r in cold)
+    assert all(r.cert_level is not None and r.cert_level <= 1
+               for r in cold)
+    # scenario-specific fields ride the result by name
+    assert cold[0].value("borrower_share") > 0.0
+    assert np.isnan(cold[0].capital)          # no such field: NaN, not junk
+
+    # exact hits resolve at submit, microseconds, cert level preserved
+    for (s, r), base in zip(cells, cold):
+        fut = svc.submit(make_query(s, r, scenario="huggett", **HKW))
+        assert fut.done()
+        hit = fut.result(0)
+        assert hit.path == "hit"
+        assert hit.r_star == base.r_star
+        assert hit.values == base.values
+
+    # near path: a shifted rho gets a verified donor bracket
+    futs = [svc.submit(make_query(s, r + 0.05, scenario="huggett",
+                                  **HKW)) for s, r in cells]
+    svc.flush()
+    near = [f.result(0) for f in futs]
+    assert "near" in [r.path for r in near]
+    # the bit-identity contract: served == reference solve, same seed
+    for (s, r), res in zip(cells, near):
+        q = make_query(s, r + 0.05, scenario="huggett", **HKW)
+        ref = svc.reference_solve(q, bracket_init=res.bracket_init)
+        assert res.r_star == ref.r_star
+        assert res.values == ref.values
+    snap = svc.metrics.snapshot()
+    assert snap["serve_scenarios"]["huggett"]["cold"] == 2
+    assert snap["serve_scenarios"]["huggett"]["hit"] == 2
+    svc.close()
+
+
+def test_cross_scenario_service_no_hit():
+    """End to end: a cached aiyagari solution at (3, 0.6, 0.2) is NOT an
+    exact hit for the huggett query at identical parameters — the
+    huggett query cold-solves its own (different) answer."""
+    svc = EquilibriumService(start_worker=False, max_batch=2,
+                             ladder=(1, 2))
+    ra = svc.query(3.0, 0.6, **KW)
+    assert ra.path == "cold"
+    hit = svc.submit(make_query(3.0, 0.6, **KW))
+    assert hit.done() and hit.result(0).path == "hit"
+    # the SAME numeric parameters under the huggett scenario: no hit
+    fut = svc.submit(make_query(3.0, 0.6, scenario="huggett", **KW))
+    assert not fut.done()
+    svc.flush()
+    rh = fut.result(0)
+    assert rh.path == "cold" and rh.scenario == "huggett"
+    assert rh.r_star != ra.r_star             # different economies
+    snap = svc.metrics.snapshot()
+    assert set(snap["serve_scenarios"]) == {"aiyagari", "huggett"}
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Epstein-Zin: the full pipeline for the second non-Aiyagari family.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ez_clean():
+    res = run_sweep("epstein_zin", sweep=ECFG.replace(certify=True),
+                    **EKW)
+    assert not is_failure(res.status).any()
+    assert (res.cert_level <= 1).all()
+    # risk aversion alone (gamma up, EIS fixed) strengthens
+    # precautionary saving: r* falls in gamma at each rho
+    r = res.col("r_star")
+    assert r[2] < r[0] and r[3] < r[1]
+    return res
+
+
+def test_ez_collapses_to_crra(ez_clean):
+    """At gamma == ez_rho the EZ equilibrium IS the CRRA equilibrium
+    (up to the lean solver's warm-carry inner noise)."""
+    ai = run_sweep("aiyagari",
+                   sweep=SweepConfig(crra_values=(2.0,),
+                                     rho_values=(0.3,)),
+                   **{k: v for k, v in EKW.items() if k != "ez_rho"})
+    diff = abs(float(ez_clean.col("r_star")[0])
+               - float(ai.col("r_star")[0]))
+    assert diff < 5e-4
+
+
+def test_ez_quarantine_and_resume(tmp_path, ez_clean):
+    """Fault injection quarantines and recovers; SIGTERM resume is
+    bit-identical — the same machinery, third family."""
+    res = run_sweep("epstein_zin", sweep=ECFG,
+                    inject_fault={"cell": 2, "at_iter": 1, "mode": "nan"},
+                    max_retries=2, **EKW)
+    assert int(res.retries[2]) >= 1
+    assert not is_failure(res.status).any()
+    assert_rows_identical(res, ez_clean, skip_cells=(2,))
+
+    ledger = str(tmp_path / "ez_ledger.npz")
+    with preemption_guard():
+        with pytest.raises(Interrupted):
+            run_sweep("epstein_zin", sweep=ECFG, resume_path=ledger,
+                      inject_preempt={"after_bucket": 0,
+                                      "mode": "signal"}, **EKW)
+    resumed = run_sweep("epstein_zin", sweep=ECFG, resume_path=ledger,
+                        **EKW)
+    assert_rows_identical(resumed, ez_clean)
+
+
+def test_ez_serve_cold_only():
+    """The cold-only scenario serves exact hits and cold misses (near is
+    structurally absent: Scenario.warm is None) with certification."""
+    scn = get_scenario("epstein_zin")
+    assert scn.warm is None and scn.warm_mode == "cold-only"
+    svc = EquilibriumService(start_worker=False, max_batch=2,
+                             ladder=(1, 2), certify_before_cache=True)
+    r0 = svc.query(2.0, 0.3, scenario="epstein_zin", **EKW)
+    assert r0.path == "cold" and r0.bracket_init is None
+    assert r0.cert_level is not None and r0.cert_level <= 1
+    fut = svc.submit(make_query(2.0, 0.3, scenario="epstein_zin", **EKW))
+    assert fut.done() and fut.result(0).path == "hit"
+    # a neighbor query has a donor in range but NO warm machinery: it
+    # must be an honest cold, never a fabricated near
+    r1 = svc.query(2.0, 0.35, scenario="epstein_zin", **EKW)
+    assert r1.path == "cold"
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# The row-schema lint (ISSUE 9 satellite).
+# ---------------------------------------------------------------------------
+
+def _load_lint():
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "check_row_schema",
+        os.path.join(repo, "scripts", "check_row_schema.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod, repo
+
+
+def test_row_schema_lint_repo_clean():
+    mod, repo = _load_lint()
+    findings = mod.scan(repo)
+    assert findings == [], "\n".join(
+        f"{r}:{ln}: {m}" for r, ln, m in findings)
+
+
+def test_row_schema_lint_fixtures():
+    mod, repo = _load_lint()
+    bad = "from aiyagari_hark_tpu.utils.config import PACKED_ROW_FIELDS\n"
+    assert mod.scan_source(bad, "aiyagari_hark_tpu/foo.py")
+    waived = ("from aiyagari_hark_tpu.utils.config import "
+              "PACKED_ROW_FIELDS  # row-schema-ok\n")
+    assert not mod.scan_source(waived, "aiyagari_hark_tpu/foo.py")
+    attr = "w = config.PACKED_ROW_WIDTH\n"
+    assert mod.scan_source(attr, "aiyagari_hark_tpu/foo.py")
+    # scenarios/ builds the schema FROM the constant: allowed
+    path = os.path.join(repo, "aiyagari_hark_tpu", "scenarios",
+                        "aiyagari.py")
+    assert mod.scan_file(
+        path, os.path.join("aiyagari_hark_tpu", "scenarios",
+                           "aiyagari.py")) == []
