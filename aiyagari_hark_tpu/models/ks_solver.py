@@ -16,7 +16,6 @@ The convergence metric is HARK's distance on the rule parameters:
 from __future__ import annotations
 
 import os
-import time
 from dataclasses import dataclass, field
 from typing import List
 
@@ -291,7 +290,7 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
         raise_if_interrupted,
         retry_transient,
     )
-    from ..utils.timing import PhaseTimer
+    from ..utils.timing import PhaseTimer, Stopwatch
     if timer is None:
         timer = PhaseTimer()
     retry_policy = retry if retry is not None else RetryPolicy()
@@ -568,7 +567,7 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
     policy = None
     converged = False
     for it in range(it_start, econ.max_loops):
-        t0 = time.time()
+        iter_sw = Stopwatch()
         with timer.phase("solve"):
             policy, egm_iters, _, egm_status = _device(
                 f"KS household solve (iter {it})",
@@ -617,7 +616,7 @@ def solve_ks_economy(agent: AgentConfig, econ: EconomyConfig,
             slope=[float(x) for x in afunc.slope],
             r_squared=[float(x) for x in rsq],
             distance=distance, egm_iters=int(egm_iters),
-            wall_seconds=time.time() - t0,
+            wall_seconds=iter_sw.elapsed(),
             egm_status=int(egm_status))
         records.append(rec)
         if econ.verbose:

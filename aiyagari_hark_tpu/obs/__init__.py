@@ -30,9 +30,33 @@ from .metrics import (  # noqa: F401
     default_registry,
     reset_default_registry,
 )
+from .profile import (  # noqa: F401
+    ROOFLINE_CLASSES,
+    ROOFLINE_COMPUTE,
+    ROOFLINE_LATENCY,
+    ROOFLINE_MEMORY,
+    ROOFLINE_UNKNOWN,
+    CostEntry,
+    CostLedger,
+    DeviceTelemetry,
+    classify_roofline,
+    peak_membw_per_chip,
+)
+from .regress import (  # noqa: F401
+    NOISE,
+    OK,
+    REGRESSED,
+    MetricFinding,
+    RegressionReport,
+    UnknownMetricError,
+    direction_of_goodness,
+    evaluate_history,
+    flatten_record,
+)
 from .runtime import (  # noqa: F401
     NULL_INSTRUMENT,
     NULL_OBS,
+    FlightRecorder,
     Obs,
     ObsConfig,
     active_obs,

@@ -58,6 +58,13 @@ EVENT_TYPES = (
     "CIRCUIT_OPEN", "CIRCUIT_PROBE", "CIRCUIT_CLOSE", "CIRCUIT_REJECT",
     # typed solver divergence escaping to a caller (models, facade)
     "SOLVER_DIVERGED",
+    # performance-observability tier (ISSUE 10, obs.profile/obs.regress):
+    # the run's cost-ledger summary at close, a bench-regression sentinel
+    # finding graded REGRESSED, the flight-recorder crash artifact
+    # written after a quarantine-ladder exhaustion, and a per-device
+    # memory high-water mark growing
+    "PROFILE_SNAPSHOT", "REGRESSION_FLAGGED",
+    "FLIGHT_RECORD_DUMP", "DEVICE_MEM_HIGH_WATER",
 )
 
 

@@ -1,0 +1,508 @@
+"""Measured performance attribution: the cost ledger, the roofline, and
+per-device telemetry (ISSUE 10, DESIGN §10b).
+
+Until now every MFU/FLOP number in the bench came from ONE source — the
+analytic ``utils.timing.model_flops`` step-count model — and nothing in
+the framework ever read XLA's own opinion of the programs it compiles.
+A drifted analytic model is invisible: the MFU denominator quietly stops
+describing the executable.  This module is the measured half:
+
+* ``CostLedger`` — keyed by the compile-cache work fingerprint
+  (``utils.fingerprint.work_fingerprint`` + executable flavor + padded
+  shape: the same identity the jit/persistent caches deduplicate on), it
+  captures at COMPILE time each jitted executable's XLA
+  ``cost_analysis()`` (flops, bytes accessed, transcendentals) plus the
+  lowering and compile walls, and aggregates at LAUNCH time the wall,
+  launch count, achieved FLOP/s, arithmetic intensity, and a roofline
+  classification against ``utils.timing.peak_flops_per_chip``.  Capture
+  is strictly best-effort: a backend without cost analysis records WHY
+  (``cost_source``), never crashes a solve, and never changes the bits
+  the real launch produces (the profiled program is compiled AOT on the
+  side; the solve still runs through the jit cache — with the persistent
+  compilation cache enabled the XLA work is shared, so the capture costs
+  one lowering plus a cache-served compile per executable, once).
+* ``classify_roofline`` — the deterministic latency/memory/compute
+  taxonomy (table pinned by ``tests/test_profile.py``): an executable
+  whose achieved fraction of its roofline ceiling is below
+  ``latency_util_frac`` is LATENCY-bound (the measured ~0.06% MFU sweep
+  regime — dispatch and serialization, not silicon); otherwise its
+  arithmetic intensity against the ridge (peak FLOP/s ÷ peak bytes/s)
+  separates MEMORY- from COMPUTE-bound.
+* ``DeviceTelemetry`` — per-device ``memory_stats()`` gauges sampled at
+  sweep bucket seams and serve batch flushes (graceful None off-TPU: a
+  CPU device reports no stats and the sample records only its own
+  count), with a per-device high-water mark that journals
+  ``DEVICE_MEM_HIGH_WATER`` whenever it grows — the evidence trail a
+  1→8-chip scaling claim needs.
+
+Everything here rides the ISSUE 7 obs substrate: ledger totals mirror
+into the metrics registry, per-launch samples land as Chrome-trace
+COUNTER tracks (``Tracer.counter``), and the run's ``PROFILE_SNAPSHOT``
+journal line carries the ledger summary under the run_id.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+# Roofline classification outcomes (a closed vocabulary, like the journal
+# event set: downstream consumers switch on these strings).
+ROOFLINE_UNKNOWN = "unknown"
+ROOFLINE_LATENCY = "latency"
+ROOFLINE_MEMORY = "memory"
+ROOFLINE_COMPUTE = "compute"
+ROOFLINE_CLASSES = (ROOFLINE_UNKNOWN, ROOFLINE_LATENCY,
+                    ROOFLINE_MEMORY, ROOFLINE_COMPUTE)
+
+# Achieved/ceiling fraction below which an executable is latency-bound:
+# it is not meaningfully engaging EITHER roof, so the binding constraint
+# is dispatch/serialization, not silicon (the 12-cell sweep measures
+# ~6e-4 of peak on TPU — two orders below this line).
+LATENCY_UTIL_FRAC = 0.02
+# Off-accelerator fallback when no peak is known: a per-launch wall at or
+# under this is dominated by dispatch, not execution.
+LATENCY_WALL_FLOOR_S = 1e-3
+# Ridge (FLOP/byte) used when the backend publishes no peak pair — the
+# order of magnitude shared by modern CPUs and accelerators; only the
+# memory/compute SIDE depends on it, never a number in the record.
+DEFAULT_RIDGE_FLOPS_PER_BYTE = 10.0
+
+
+def peak_membw_per_chip(backend: str) -> Tuple[Optional[float], bool]:
+    """Nominal peak HBM bytes/s of one chip for the roofline ridge, with
+    an ``assumed`` flag mirroring ``utils.timing.peak_flops_per_chip``'s
+    honesty contract (v5e 819 GB/s, v4 1228 GB/s, v5p 2765 GB/s; None
+    off-accelerator — a host's effective bandwidth has no honest
+    single-number peak)."""
+    if backend not in ("tpu", "axon"):
+        return None, False
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:   # noqa: BLE001 — device query is best-effort
+        kind = ""
+    if "v5 lite" in kind or "v5e" in kind or "v5lite" in kind:
+        return 819e9, False
+    if "v4" in kind:
+        return 1228e9, False
+    if "v5p" in kind or "v5" in kind:
+        return 2765e9, False
+    return 819e9, True      # unknown TPU: the v5e class guess, flagged
+
+
+def classify_roofline(flops, bytes_accessed, wall_s, launches,
+                      peak_flops=None, peak_bytes_per_s=None,
+                      latency_util_frac: float = LATENCY_UTIL_FRAC,
+                      latency_wall_floor_s: float = LATENCY_WALL_FLOOR_S,
+                      default_ridge: float = DEFAULT_RIDGE_FLOPS_PER_BYTE
+                      ) -> str:
+    """The deterministic roofline taxonomy (DESIGN §10b, pinned by the
+    classification table in ``tests/test_profile.py``):
+
+    1. ``unknown`` — no cost analysis (flops/bytes missing) or no
+       measured launches, so no classification is honest;
+    2. ``latency`` — the achieved fraction of the roofline ceiling
+       ``min(peak_flops, AI * peak_bw)`` is under ``latency_util_frac``
+       (or, with no published peak, the per-launch wall sits at/under
+       ``latency_wall_floor_s``): the program never engages a roof;
+    3. ``compute`` / ``memory`` — arithmetic intensity (FLOP/byte) at or
+       above / below the ridge (``peak_flops / peak_bytes_per_s``, or
+       ``default_ridge`` when the backend publishes no peak pair).
+    """
+    if (flops is None or bytes_accessed is None or not flops > 0.0
+            or not bytes_accessed > 0.0 or not launches
+            or wall_s is None or not wall_s > 0.0):
+        return ROOFLINE_UNKNOWN
+    ai = float(flops) / float(bytes_accessed)
+    achieved = float(flops) * float(launches) / float(wall_s)
+    if peak_flops is not None and peak_flops > 0.0:
+        ceiling = peak_flops
+        if peak_bytes_per_s is not None and peak_bytes_per_s > 0.0:
+            ceiling = min(peak_flops, ai * peak_bytes_per_s)
+        if achieved / ceiling < latency_util_frac:
+            return ROOFLINE_LATENCY
+        ridge = (peak_flops / peak_bytes_per_s
+                 if peak_bytes_per_s is not None and peak_bytes_per_s > 0.0
+                 else default_ridge)
+    else:
+        if wall_s / float(launches) <= latency_wall_floor_s:
+            return ROOFLINE_LATENCY
+        ridge = default_ridge
+    return ROOFLINE_COMPUTE if ai >= ridge else ROOFLINE_MEMORY
+
+
+def _parse_cost_analysis(ca) -> dict:
+    """Normalize ``Compiled.cost_analysis()`` across jax versions (dict,
+    or a one-element list of dicts) to the three fields the ledger
+    records.  Missing keys are None, not 0 — absence must stay visible."""
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        raise TypeError(f"unexpected cost_analysis payload: {type(ca)}")
+
+    def get(name):
+        v = ca.get(name)
+        return None if v is None else float(v)
+
+    return {"flops": get("flops"),
+            "bytes_accessed": get("bytes accessed"),
+            "transcendentals": get("transcendentals")}
+
+
+_SLUG_RE = re.compile(r"[^a-zA-Z0-9_]+")
+
+
+def _slug(label: str) -> str:
+    """Prometheus-safe metric-name fragment from a free-form label."""
+    return _SLUG_RE.sub("_", str(label)).strip("_").lower() or "exe"
+
+
+@dataclass
+class CostEntry:
+    """One executable's measured cost record (one per ledger key).
+
+    ``cost_source`` is the provenance honesty bit: ``xla_cost_analysis``
+    when the numbers came from the compiled executable itself,
+    ``"unavailable: <reason>"`` when the backend/version could not serve
+    them (the fields stay None and every downstream consumer must treat
+    them as absent-with-a-reason, never as zero)."""
+
+    key: tuple
+    label: str
+    lowering_s: Optional[float] = None
+    compile_s: Optional[float] = None
+    flops: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    transcendentals: Optional[float] = None
+    cost_source: str = "uncaptured"
+    launches: int = 0
+    launch_wall_s: float = 0.0
+
+    def achieved_flops_per_sec(self) -> Optional[float]:
+        if (self.flops is None or not self.launches
+                or not self.launch_wall_s > 0.0):
+            return None
+        return self.flops * self.launches / self.launch_wall_s
+
+    def arithmetic_intensity(self) -> Optional[float]:
+        if (self.flops is None or self.bytes_accessed is None
+                or not self.bytes_accessed > 0.0):
+            return None
+        return self.flops / self.bytes_accessed
+
+    def roofline(self, peak_flops=None, peak_bytes_per_s=None) -> str:
+        return classify_roofline(
+            self.flops, self.bytes_accessed, self.launch_wall_s,
+            self.launches, peak_flops=peak_flops,
+            peak_bytes_per_s=peak_bytes_per_s)
+
+
+@dataclass
+class _Peaks:
+    flops: Optional[float] = None
+    flops_assumed: bool = False
+    bytes_per_s: Optional[float] = None
+    bytes_assumed: bool = False
+
+
+class CostLedger:
+    """Measured cost attribution for every profiled executable of a run.
+
+    ``capture(key, fn, args)`` is memoized per key (the compile-cache
+    work-fingerprint identity): the first call lowers and AOT-compiles
+    the jitted ``fn`` at ``args``' shapes — timed, so the record carries
+    the real lowering/compile walls — and reads the compiled
+    executable's ``cost_analysis()``; later calls are a dict hit.
+    ``record_launch(key, wall_s)`` aggregates the measured launch walls
+    and optionally drops a Chrome-trace counter sample on a tracer.
+    Both are exception-tight: profiling must never take down a solve.
+    """
+
+    def __init__(self, backend: Optional[str] = None,
+                 peak_flops: Optional[float] = None,
+                 peak_bytes_per_s: Optional[float] = None):
+        self._lock = threading.Lock()
+        self._entries: Dict[tuple, CostEntry] = {}
+        self._peaks: Optional[_Peaks] = None
+        if peak_flops is not None or peak_bytes_per_s is not None:
+            self._peaks = _Peaks(peak_flops, False, peak_bytes_per_s,
+                                 False)
+        self._backend = backend
+
+    # -- peaks (lazy: jax.default_backend may not be initialized yet) ------
+
+    def peaks(self) -> _Peaks:
+        if self._peaks is None:
+            backend = self._backend
+            if backend is None:
+                try:
+                    import jax
+                    backend = jax.default_backend()
+                except Exception:   # noqa: BLE001 — probing is best-effort
+                    backend = "cpu"
+            from ..utils.timing import peak_flops_per_chip
+
+            pf = peak_flops_per_chip(backend)
+            bw, bw_assumed = peak_membw_per_chip(backend)
+            self._peaks = _Peaks(pf.value, pf.assumed, bw, bw_assumed)
+        return self._peaks
+
+    # -- capture / launch ---------------------------------------------------
+
+    def capture(self, key: tuple, fn, args, label: str = "") -> CostEntry:
+        """Compile-time capture for ``key``, once: lowering wall, compile
+        wall, and the XLA cost analysis of the executable ``fn`` compiles
+        for ``args``' shapes."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                return entry
+            entry = self._entries[key] = CostEntry(
+                key=key, label=str(label) or "exe")
+        from ..utils.timing import stopwatch
+
+        try:
+            with stopwatch() as sw_low:
+                lowered = fn.lower(*args)
+            entry.lowering_s = sw_low.seconds
+            with stopwatch() as sw_comp:
+                compiled = lowered.compile()
+            entry.compile_s = sw_comp.seconds
+            cost = _parse_cost_analysis(compiled.cost_analysis())
+        except Exception as e:   # noqa: BLE001 — profiling is best-effort
+            entry.cost_source = (f"unavailable: "
+                                 f"{type(e).__name__}: {e}"[:200])
+            return entry
+        entry.flops = cost["flops"]
+        entry.bytes_accessed = cost["bytes_accessed"]
+        entry.transcendentals = cost["transcendentals"]
+        entry.cost_source = "xla_cost_analysis"
+        return entry
+
+    def record_launch(self, key: tuple, wall_s: float,
+                      tracer=None) -> None:
+        """Aggregate one measured launch wall onto ``key``'s entry (which
+        ``capture`` must have created) and, with a ``tracer``, sample the
+        entry's achieved FLOP/s and launch wall onto Chrome-trace counter
+        tracks."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                entry = self._entries[key] = CostEntry(
+                    key=key, label="exe")
+            entry.launches += 1
+            entry.launch_wall_s += float(wall_s)
+        if tracer is not None:
+            slug = _slug(entry.label)
+            tracer.counter(f"profile/{slug}/launch_wall_s",
+                           value=float(wall_s))
+            achieved = entry.achieved_flops_per_sec()
+            if achieved is not None:
+                tracer.counter(f"profile/{slug}/achieved_flops_per_sec",
+                               value=achieved)
+
+    def entries(self) -> list:
+        with self._lock:
+            return list(self._entries.values())
+
+    # -- aggregation / export ----------------------------------------------
+
+    def measured_flops_total(self) -> Optional[float]:
+        """Sum of per-launch XLA flops x launches over entries that have
+        cost analysis; None when NO entry has it (absence must not read
+        as zero work)."""
+        totals = [e.flops * e.launches for e in self.entries()
+                  if e.flops is not None and e.launches]
+        return sum(totals) if totals else None
+
+    def flops_model_vs_measured_ratio(self, analytic_flops
+                                      ) -> Optional[float]:
+        """The cross-check headline: analytic ``model_flops`` over XLA's
+        own count for the same launches.  1.0 means the hand model and
+        the compiler agree; drift in the MFU denominator is THIS number
+        moving (note: XLA counts a while-loop body once — a large ratio
+        on iterative solvers is expected and documents exactly how much
+        of the analytic count rides trip counts XLA cannot see)."""
+        measured = self.measured_flops_total()
+        if measured is None or not measured > 0.0 or analytic_flops is None:
+            return None
+        return float(analytic_flops) / measured
+
+    def snapshot(self) -> dict:
+        """The ledger as one JSON-able dict: per-entry records plus run
+        totals with the roofline classification — the payload behind the
+        ``profile_*`` bench fields and the PROFILE_SNAPSHOT journal
+        event."""
+        peaks = self.peaks()
+        entries = self.entries()
+        per = {}
+        for e in entries:
+            # slugs must stay one-per-entry: two keys can share a label
+            # (e.g. the same executable with and without a fault hook),
+            # and a silent merge would break the executable-ladder audit
+            slug = base = _slug(e.label)
+            n = 2
+            while slug in per:
+                slug = f"{base}_{n}"
+                n += 1
+            per[slug] = {
+                "label": e.label,
+                "launches": e.launches,
+                "launch_wall_s": e.launch_wall_s,
+                "lowering_s": e.lowering_s,
+                "compile_s": e.compile_s,
+                "flops": e.flops,
+                "bytes_accessed": e.bytes_accessed,
+                "transcendentals": e.transcendentals,
+                "cost_source": e.cost_source,
+                "achieved_flops_per_sec": e.achieved_flops_per_sec(),
+                "arithmetic_intensity": e.arithmetic_intensity(),
+                "roofline": e.roofline(peaks.flops, peaks.bytes_per_s),
+            }
+        wall = sum(e.launch_wall_s for e in entries)
+        launches = sum(e.launches for e in entries)
+        flops_total = self.measured_flops_total()
+        bytes_totals = [e.bytes_accessed * e.launches for e in entries
+                        if e.bytes_accessed is not None and e.launches]
+        bytes_total = sum(bytes_totals) if bytes_totals else None
+        achieved = (flops_total / wall
+                    if flops_total is not None and wall > 0.0 else None)
+        ai = (flops_total / bytes_total
+              if flops_total is not None and bytes_total else None)
+        # classify on PER-LAUNCH flops/bytes (the totals already carry
+        # the launch multiplier; classify_roofline multiplies by
+        # ``launches`` itself — feeding it totals would inflate the
+        # achieved rate by the launch count)
+        roofline = classify_roofline(
+            None if flops_total is None else flops_total / max(launches,
+                                                              1),
+            None if bytes_total is None else bytes_total / max(launches,
+                                                               1),
+            wall, launches,
+            peak_flops=peaks.flops, peak_bytes_per_s=peaks.bytes_per_s)
+        mfu = (None if peaks.flops is None or achieved is None
+               else 100.0 * achieved / peaks.flops)
+        sources = {}
+        for e in entries:
+            tag = e.cost_source.split(":", 1)[0]
+            sources[tag] = sources.get(tag, 0) + 1
+        return {
+            "executables": len(entries),
+            "launches": launches,
+            "launch_wall_s": wall,
+            "lowering_wall_s": sum(e.lowering_s or 0.0 for e in entries),
+            "compile_wall_s": sum(e.compile_s or 0.0 for e in entries),
+            "measured_flops_total": flops_total,
+            "bytes_accessed_total": bytes_total,
+            "achieved_flops_per_sec": achieved,
+            "arithmetic_intensity": ai,
+            "roofline": roofline,
+            "mfu_pct": mfu,
+            "peak_flops_per_chip": peaks.flops,
+            "peak_flops_assumed": peaks.flops_assumed,
+            "peak_bytes_per_s_per_chip": peaks.bytes_per_s,
+            "peak_bytes_assumed": peaks.bytes_assumed,
+            "cost_sources": sources,
+            "entries": per,
+        }
+
+    def publish(self, registry, prefix: str = "aiyagari_profile_"
+                ) -> None:
+        """Mirror the ledger into a metrics registry (totals as gauges,
+        plus per-executable launch wall / launches / achieved FLOP/s
+        under slugged names) — levels, re-publishable, matching the
+        ``ServeMetrics.publish`` convention."""
+        if registry is None:
+            return
+        snap = self.snapshot()
+        for name, help_text in (
+                ("executables", "profiled executables this run"),
+                ("launches", "profiled launches this run"),
+                ("launch_wall_s", "summed profiled launch wall"),
+                ("compile_wall_s", "summed AOT compile wall"),
+                ("lowering_wall_s", "summed lowering wall")):
+            registry.gauge(prefix + name, help_text).set(
+                float(snap[name] or 0.0))
+        if snap["achieved_flops_per_sec"] is not None:
+            registry.gauge(prefix + "achieved_flops_per_sec",
+                           "measured FLOP/s over profiled launches").set(
+                snap["achieved_flops_per_sec"])
+        for slug, e in snap["entries"].items():
+            registry.gauge(f"{prefix}launch_wall_s_{slug}",
+                           f"launch wall: {e['label']}").set(
+                e["launch_wall_s"])
+            registry.gauge(f"{prefix}launches_{slug}",
+                           f"launches: {e['label']}").set(e["launches"])
+            if e["achieved_flops_per_sec"] is not None:
+                registry.gauge(
+                    f"{prefix}achieved_flops_per_sec_{slug}",
+                    f"achieved FLOP/s: {e['label']}").set(
+                    e["achieved_flops_per_sec"])
+
+
+class DeviceTelemetry:
+    """Per-device memory telemetry with a journaled high-water mark.
+
+    ``sample(obs, where=...)`` reads every device's ``memory_stats()``
+    (None off-TPU — the sample still counts, the device just contributes
+    no gauges), mirrors ``bytes_in_use`` / ``peak_bytes_in_use`` /
+    ``bytes_limit`` into the run's registry, and emits ONE
+    ``DEVICE_MEM_HIGH_WATER`` journal event per device each time its
+    observed high-water mark grows — a bounded, monotone event stream
+    (at most one line per actual new peak, never one per sample)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._high_water: Dict[int, float] = {}
+        self.samples = 0
+        self.devices_without_stats = 0
+
+    def sample(self, obs, where: str = "") -> int:
+        """Sample all devices once; returns how many had stats."""
+        try:
+            import jax
+            devices = jax.devices()
+        except Exception:   # noqa: BLE001 — telemetry is best-effort
+            return 0
+        with self._lock:
+            self.samples += 1
+        with_stats = 0
+        for i, dev in enumerate(devices):
+            try:
+                stats = dev.memory_stats()
+            except Exception:   # noqa: BLE001
+                stats = None
+            if not stats:
+                with self._lock:
+                    self.devices_without_stats += 1
+                continue
+            with_stats += 1
+            in_use = float(stats.get("bytes_in_use", 0) or 0)
+            peak = float(stats.get("peak_bytes_in_use", in_use) or in_use)
+            limit = stats.get("bytes_limit")
+            obs.gauge(f"aiyagari_device{i}_mem_bytes_in_use",
+                      "device bytes in use at the last sample").set(in_use)
+            obs.gauge(f"aiyagari_device{i}_mem_peak_bytes_in_use",
+                      "device peak bytes in use").set(peak)
+            if limit:
+                obs.gauge(f"aiyagari_device{i}_mem_bytes_limit",
+                          "device memory limit").set(float(limit))
+            hw = max(in_use, peak)
+            with self._lock:
+                prev = self._high_water.get(i, 0.0)
+                grew = hw > prev
+                if grew:
+                    self._high_water[i] = hw
+            if grew:
+                obs.event("DEVICE_MEM_HIGH_WATER", device=int(i),
+                          bytes=int(hw), where=where,
+                          **({} if not limit
+                             else {"bytes_limit": int(limit)}))
+        return with_stats
+
+    def high_water(self) -> dict:
+        with self._lock:
+            return dict(self._high_water)

@@ -1,0 +1,331 @@
+"""Bench-regression sentinel: robust baselines over the committed
+BENCH history, typed severity-ordered findings (ISSUE 10, DESIGN §10b).
+
+The repo commits one bench record per round (``BENCH_r01.json`` ...) and
+nothing watches the trajectory: a PR that silently halves
+``egm_gridpoints_per_sec_per_chip`` lands green.  The sentinel closes
+that hole with the same discipline ``solver_health`` gave numeric
+failure — a CLOSED severity vocabulary and one declared
+direction-of-goodness per metric:
+
+* per metric, the baseline is the MEDIAN of the last ``window`` prior
+  values and the noise band is ``max(IQR, rel_floor * |baseline|,
+  abs_floor)`` — robust to the committed history's machine-to-machine
+  swings (the r02 CPU round is 6x faster than r03's; a mean would be
+  garbage);
+* a value flags only when it is worse than BOTH the baseline+band AND
+  the worst value history already contains (a number no worse than a
+  committed round is by construction not a new regression);
+* severity: ``OK < NOISE < REGRESSED`` — NOISE is outside the band but
+  under ``regress_frac`` relative movement (suspicious, not
+  actionable); REGRESSED is a >= ``regress_frac`` (default 10%) move in
+  the bad direction, so the ISSUE 10 acceptance drill (a 20% injected
+  slowdown) always lands REGRESSED on a stable metric;
+* every numeric bench field must resolve in the direction-of-goodness
+  table below (``direction_of_goodness(field, strict=True)`` raises
+  ``UnknownMetricError`` otherwise — ``tests/test_regress.py`` pins
+  completeness over the whole committed history), so a new bench field
+  cannot ride along unclassified.
+
+``scripts/check_bench_regress.py`` runs the sentinel in tier-1 against
+the committed history; a REGRESSED finding under an active obs scope
+also journals a typed ``REGRESSION_FLAGGED`` event.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# Severity order (matching solver_health's small-int convention: higher
+# is worse, comparisons are meaningful).
+OK = 0
+NOISE = 1
+REGRESSED = 2
+SEVERITY_NAMES = {OK: "OK", NOISE: "NOISE", REGRESSED: "REGRESSED"}
+
+# Directions of goodness.
+UP = "up"          # bigger is better (throughput, speedup, MFU)
+DOWN = "down"      # smaller is better (walls, skew, error margins)
+NEUTRAL = "neutral"  # informational (device counts, sizes, ids)
+
+# -- the direction-of-goodness table (ONE place, DESIGN §10b) ---------------
+# Explicit field names first; fields not listed resolve through the
+# suffix rules below.  ``last_tpu.<field>`` recurses on ``<field>``.
+DIRECTION_EXPLICIT: Dict[str, str] = {
+    "value": DOWN,                 # the headline sweep wall (seconds)
+    "vs_baseline": UP,             # speedup factor over the reference
+    "mfu_pct": UP,                 # bare spelling ("_mfu_pct" suffixed
+    #                                fields resolve via the suffix rule)
+    "iteration_skew": DOWN,
+    "iteration_skew_scheduled": DOWN,
+    "scheduled_iteration_skew": DOWN,
+    "n_devices": NEUTRAL,
+    "n_buckets": NEUTRAL,
+    "lanes": NEUTRAL,
+    "backend_attempts": NEUTRAL,
+    "exact_bits": NEUTRAL,
+}
+
+# Suffix/affix rules, first match wins.  Kept coarse on purpose: bench
+# fields are named by convention (units in the suffix), and the rules
+# make the convention load-bearing.
+DIRECTION_SUFFIX_RULES: Tuple[Tuple[str, str], ...] = (
+    ("_wall_s", DOWN), ("_walls_s", DOWN), ("_seconds", DOWN),
+    ("_wait_s", DOWN), ("_roundtrip_s", DOWN), ("_s", DOWN),
+    ("_ms", DOWN), ("_us", DOWN),
+    ("_per_sec_per_chip", UP), ("_per_sec", UP), ("_per_chip", UP),
+    ("_mfu_pct", UP), ("_speedup", UP), ("_hit_rate", UP),
+    ("_max_bp", DOWN), ("_bp", DOWN), ("_skew", DOWN),
+    ("_overhead_frac", DOWN), ("_frac", NEUTRAL),
+    ("_pct", NEUTRAL), ("_ratio", NEUTRAL),
+    ("_count", NEUTRAL), ("_cells", NEUTRAL), ("_events", NEUTRAL),
+    ("_bytes", NEUTRAL), ("_evals", DOWN), ("_steps", DOWN),
+    ("_iters", DOWN), ("_compiles", DOWN), ("_misses", DOWN),
+    ("_retries", DOWN), ("_errors", DOWN), ("_violations", DOWN),
+    ("_failures", DOWN), ("_expirations", DOWN), ("_evictions", DOWN),
+)
+# Prefix rules (checked after suffixes): counts and ids are neutral.
+DIRECTION_PREFIX_RULES: Tuple[Tuple[str, str], ...] = (
+    ("n_", NEUTRAL), ("num_", NEUTRAL),
+)
+# Affix (anywhere) rules, last resort before UnknownMetricError.
+DIRECTION_AFFIX_RULES: Tuple[Tuple[str, str], ...] = (
+    ("mfu", UP), ("flops_per_sec", UP), ("cells_per_sec", UP),
+    ("p50", DOWN), ("p95", DOWN), ("p99", DOWN),
+    ("wall", DOWN), ("compile", DOWN), ("overhead", DOWN),
+    ("drift", DOWN), ("residual", DOWN), ("corrupt", DOWN),
+    ("injected", NEUTRAL), ("detected", NEUTRAL),
+)
+
+
+class UnknownMetricError(KeyError):
+    """A numeric bench field with no declared direction of goodness —
+    the table above must grow an entry (or the field a conventional
+    suffix) before the sentinel can grade it."""
+
+
+def direction_of_goodness(field: str, strict: bool = True) -> str:
+    """Resolve one bench field to ``"up"``/``"down"``/``"neutral"``.
+
+    ``strict=True`` raises ``UnknownMetricError`` on an unclassifiable
+    field (the completeness contract tests pin); ``strict=False``
+    degrades to NEUTRAL — the sentinel's runtime choice, so a brand-new
+    field shows up as ungraded rather than crashing CI (the strict test
+    is what forces the table entry)."""
+    name = field.rsplit(".", 1)[-1]   # "last_tpu.compile_s" -> "compile_s"
+    if name in DIRECTION_EXPLICIT:
+        return DIRECTION_EXPLICIT[name]
+    for suffix, direction in DIRECTION_SUFFIX_RULES:
+        if name.endswith(suffix):
+            return direction
+    for prefix, direction in DIRECTION_PREFIX_RULES:
+        if name.startswith(prefix):
+            return direction
+    for affix, direction in DIRECTION_AFFIX_RULES:
+        if affix in name:
+            return direction
+    if strict:
+        raise UnknownMetricError(
+            f"bench field {field!r} has no direction of goodness; add it "
+            "to obs.regress.DIRECTION_EXPLICIT (or use a conventional "
+            "suffix: _wall_s/_per_sec/_mfu_pct/...)")
+    return NEUTRAL
+
+
+def flatten_record(record: dict, prefix: str = "") -> Dict[str, float]:
+    """Numeric scalar fields of one bench record, nested dicts flattened
+    with dotted keys (``last_tpu.compile_s``); bools, strings, lists
+    (e.g. ``lanes_scaling``) are skipped — the sentinel grades scalars."""
+    out: Dict[str, float] = {}
+    for k, v in record.items():
+        key = prefix + str(k)
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, dict):
+            out.update(flatten_record(v, key + "."))
+        elif isinstance(v, (int, float)):
+            out[key] = float(v)
+    return out
+
+
+def _median(vals: Sequence[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def _iqr(vals: Sequence[float]) -> float:
+    """Interquartile range by linear interpolation (numpy's default
+    percentile method, stdlib-only so the sentinel can run anywhere)."""
+    s = sorted(vals)
+    n = len(s)
+    if n < 2:
+        return 0.0
+
+    def q(p: float) -> float:
+        idx = p * (n - 1)
+        lo = int(idx)
+        hi = min(lo + 1, n - 1)
+        return s[lo] + (s[hi] - s[lo]) * (idx - lo)
+
+    return q(0.75) - q(0.25)
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricFinding:
+    """One metric's grade against its robust baseline."""
+
+    metric: str
+    severity: int                 # OK < NOISE < REGRESSED
+    direction: str                # up | down | neutral
+    value: Optional[float]
+    baseline: Optional[float]     # median of the prior window
+    band: Optional[float]         # noise half-width around the baseline
+    worst_prior: Optional[float]  # worst value history already contains
+    delta_frac: Optional[float]   # signed relative move, + = worse
+    n_prior: int = 0
+    note: str = ""
+
+    @property
+    def severity_name(self) -> str:
+        return SEVERITY_NAMES[self.severity]
+
+
+@dataclasses.dataclass
+class RegressionReport:
+    """Severity-ordered findings for the latest bench record against its
+    history.  ``worst`` is the report's headline grade; ``regressed()``
+    / ``noisy()`` slice by severity; ``summary()`` renders the one-line
+    digest the CI log shows."""
+
+    findings: List[MetricFinding]
+    n_records: int
+    latest_round: str
+    baseline_rounds: List[str]
+    unknown_fields: List[str]
+
+    @property
+    def worst(self) -> int:
+        return max((f.severity for f in self.findings), default=OK)
+
+    def regressed(self) -> List[MetricFinding]:
+        return [f for f in self.findings if f.severity == REGRESSED]
+
+    def noisy(self) -> List[MetricFinding]:
+        return [f for f in self.findings if f.severity == NOISE]
+
+    def summary(self) -> str:
+        n_reg, n_noise = len(self.regressed()), len(self.noisy())
+        return (f"bench-regress [{self.latest_round} vs "
+                f"{len(self.baseline_rounds)} prior]: "
+                f"{SEVERITY_NAMES[self.worst]} "
+                f"({n_reg} regressed, {n_noise} noise, "
+                f"{len(self.findings) - n_reg - n_noise} ok"
+                + (f", {len(self.unknown_fields)} ungraded"
+                   if self.unknown_fields else "") + ")")
+
+
+def grade_metric(metric: str, value: float, priors: Sequence[float],
+                 direction: Optional[str] = None,
+                 window: int = 5, rel_floor: float = 0.05,
+                 abs_floor: float = 1e-12,
+                 regress_frac: float = 0.10) -> MetricFinding:
+    """Grade one metric value against its prior history (the unit the
+    report loops; exposed for tests to pin the severity rules)."""
+    if direction is None:
+        direction = direction_of_goodness(metric, strict=False)
+    priors = [float(p) for p in priors][-int(window):]
+    n_prior = len(priors)
+    if direction == NEUTRAL or n_prior < 2:
+        note = ("neutral" if direction == NEUTRAL
+                else f"insufficient history ({n_prior} prior)")
+        return MetricFinding(metric, OK, direction, value,
+                             _median(priors) if priors else None,
+                             None, None, None, n_prior, note)
+    baseline = _median(priors)
+    band = max(_iqr(priors), rel_floor * abs(baseline), abs_floor)
+    worst_prior = max(priors) if direction == DOWN else min(priors)
+    # signed badness: positive = moved in the bad direction
+    bad_delta = (value - baseline) if direction == DOWN \
+        else (baseline - value)
+    delta_frac = (bad_delta / abs(baseline)) if baseline else None
+    beyond_band = bad_delta > band
+    beyond_worst = (value > worst_prior if direction == DOWN
+                    else value < worst_prior)
+    if not (beyond_band and beyond_worst):
+        return MetricFinding(metric, OK, direction, value, baseline,
+                             band, worst_prior, delta_frac, n_prior)
+    severity = (REGRESSED if delta_frac is not None
+                and delta_frac >= regress_frac else NOISE)
+    return MetricFinding(metric, severity, direction, value, baseline,
+                         band, worst_prior, delta_frac, n_prior)
+
+
+def evaluate_history(history: Sequence[Tuple[str, dict]],
+                     window: int = 5, rel_floor: float = 0.05,
+                     regress_frac: float = 0.10) -> RegressionReport:
+    """The sentinel: grade the LAST record of ``history`` (a sequence of
+    ``(round_name, record_dict)``, oldest first) against the robust
+    baseline of the earlier ones, emitting ``REGRESSION_FLAGGED`` into
+    the active obs scope for every REGRESSED finding."""
+    from .runtime import emit_event
+
+    if not history:
+        return RegressionReport([], 0, "<none>", [], [])
+    flat = [(name, flatten_record(rec)) for name, rec in history]
+    latest_name, latest = flat[-1]
+    prior_rounds = [name for name, _ in flat[:-1]]
+    findings: List[MetricFinding] = []
+    unknown: List[str] = []
+    for metric in sorted(latest):
+        try:
+            direction = direction_of_goodness(metric, strict=True)
+        except UnknownMetricError:
+            unknown.append(metric)
+            direction = NEUTRAL
+        priors = [f[metric] for _, f in flat[:-1] if metric in f]
+        finding = grade_metric(metric, latest[metric], priors,
+                               direction=direction, window=window,
+                               rel_floor=rel_floor,
+                               regress_frac=regress_frac)
+        findings.append(finding)
+        if finding.severity == REGRESSED:
+            emit_event("REGRESSION_FLAGGED", metric=metric,
+                       value=finding.value, baseline=finding.baseline,
+                       band=finding.band,
+                       delta_frac=finding.delta_frac,
+                       direction=finding.direction,
+                       latest_round=latest_name)
+    findings.sort(key=lambda f: (-f.severity,
+                                 -(f.delta_frac or 0.0), f.metric))
+    return RegressionReport(findings, len(flat), latest_name,
+                            prior_rounds, unknown)
+
+
+def load_bench_history(repo_dir: str) -> List[Tuple[str, dict]]:
+    """The committed ``BENCH_r*.json`` history, oldest first, as
+    ``(round, record)`` pairs.  Each file wraps the bench's JSON record
+    under ``"parsed"`` (None when that round's bench failed — skipped,
+    the sentinel grades measurements, not absences)."""
+    import glob
+    import json
+    import os
+    import re
+
+    out: List[Tuple[str, dict]] = []
+    paths = glob.glob(os.path.join(repo_dir, "BENCH_r*.json"))
+
+    def round_key(p: str) -> int:
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else 0
+
+    for path in sorted(paths, key=round_key):
+        with open(path) as fh:
+            wrapper = json.load(fh)
+        rec = wrapper.get("parsed")
+        if isinstance(rec, dict):
+            out.append((os.path.basename(path)[len("BENCH_"):-len(".json")],
+                        rec))
+    return out

@@ -30,6 +30,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import threading
+import time
 from typing import List, Optional
 
 from .journal import EventJournal
@@ -52,7 +53,21 @@ class ObsConfig:
     * ``run_id`` — correlation id; auto-generated when None.
     * ``device_trace_dir`` — opt-in bridge to ``utils.timing
       .device_trace``: spans created with ``device_profile=True``
-      capture an XLA profiler dump under this directory."""
+      capture an XLA profiler dump under this directory.
+    * ``profile`` — the performance tier (ISSUE 10, DESIGN §10b): a
+      ``obs.profile.CostLedger`` capturing each profiled executable's
+      XLA cost analysis and lowering/compile walls and aggregating
+      launch walls into achieved-FLOP/s + roofline numbers, plus
+      ``DeviceTelemetry`` sampling per-device ``memory_stats()`` at
+      sweep bucket seams and serve batch flushes.  Off by default —
+      capture AOT-compiles each executable once, a cost the disabled
+      path must never pay.
+    * ``flight_path`` — where the flight recorder dumps its ring as a
+      crash artifact when a typed failure escalates past the quarantine
+      ladder (``Obs.dump_flight``).  None derives a sibling of
+      ``journal_path`` (``<journal>.flight.json``) when that is set,
+      else disables dumping (the in-memory ring still records).
+    * ``flight_limit`` — bounded size of the flight-recorder ring."""
 
     enabled: bool = False
     trace: bool = True
@@ -61,6 +76,9 @@ class ObsConfig:
     journal_path: Optional[str] = None
     run_id: Optional[str] = None
     device_trace_dir: Optional[str] = None
+    profile: bool = False
+    flight_path: Optional[str] = None
+    flight_limit: int = 256
 
     def replace(self, **kwargs) -> "ObsConfig":
         return dataclasses.replace(self, **kwargs)
@@ -89,6 +107,41 @@ NULL_INSTRUMENT = _NullInstrument()
 _NULL_ACTIVATE_CM = contextlib.nullcontext(None)
 
 
+class FlightRecorder:
+    """A bounded ring of a run's most recent lifecycle entries (ISSUE
+    10): every journal event and every completed span lands here (plus
+    externally-timed ``record_span`` latencies), so when a typed failure
+    escalates past the quarantine ladder the run can dump "what just
+    happened" as one crash artifact — the post-mortem the PR 1/3/6
+    failure modes never had.  Oldest entries fall off; ``dropped``
+    counts them so a dump can never silently read as complete."""
+
+    def __init__(self, limit: int = 256, clock=time.time):
+        import collections
+
+        self.limit = max(1, int(limit))
+        self._ring = collections.deque(maxlen=self.limit)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.noted = 0
+
+    def note(self, kind: str, payload: dict) -> None:
+        rec = {"t": round(float(self._clock()), 6), "kind": str(kind)}
+        rec.update(payload)
+        with self._lock:
+            self._ring.append(rec)
+            self.noted += 1
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return max(0, self.noted - len(self._ring))
+
+    def entries(self) -> list:
+        with self._lock:
+            return list(self._ring)
+
+
 class Obs:
     """One run's observability bundle (build via ``build_obs``)."""
 
@@ -98,12 +151,19 @@ class Obs:
                  tracer: Optional[Tracer] = None,
                  registry: Optional[MetricsRegistry] = None,
                  journal: Optional[EventJournal] = None,
-                 trace_path: Optional[str] = None):
+                 trace_path: Optional[str] = None,
+                 cost_ledger=None, telemetry=None,
+                 flight: Optional[FlightRecorder] = None,
+                 flight_path: Optional[str] = None):
         self.run_id = run_id if run_id is not None else new_run_id()
         self.tracer = tracer
         self.registry = registry
         self.journal = journal
         self.trace_path = trace_path
+        self.cost_ledger = cost_ledger    # obs.profile.CostLedger | None
+        self.telemetry = telemetry        # obs.profile.DeviceTelemetry
+        self.flight = flight
+        self.flight_path = flight_path
         self._closed = False
 
     # -- spans --------------------------------------------------------------
@@ -111,17 +171,44 @@ class Obs:
     def span(self, name: str, **attrs):
         if self.tracer is None:
             return NULL_SPAN_CM
-        return self.tracer.span(name, **attrs)
+        cm = self.tracer.span(name, **attrs)
+        if self.flight is None:
+            return cm
+        return self._flight_span(cm, name)
+
+    @contextlib.contextmanager
+    def _flight_span(self, cm, name: str):
+        """Wrap a tracer span so its completion also lands in the flight
+        ring (name + wall; full attrs stay in the trace — the ring is a
+        post-mortem digest, not a second trace)."""
+        sp = None
+        try:
+            with cm as sp:
+                yield sp
+        finally:
+            if sp is not None and sp.t1 is not None:
+                self.flight.note("span", {"name": name,
+                                          "wall_s": sp.duration()})
 
     def record_span(self, name: str, duration_s: float, **attrs) -> None:
         if self.tracer is not None:
             self.tracer.record(name, duration_s, **attrs)
+        if self.flight is not None:
+            self.flight.note("span", {"name": name,
+                                      "wall_s": float(duration_s),
+                                      "external": True})
 
     # -- events -------------------------------------------------------------
 
     def event(self, etype: str, **attrs) -> None:
         if self.journal is not None:
             self.journal.emit(etype, **attrs)
+        if self.flight is not None:
+            from .trace import _jsonable
+
+            self.flight.note("event", {"event": etype,
+                                       **{str(k): _jsonable(v)
+                                          for k, v in attrs.items()}})
 
     # -- metrics ------------------------------------------------------------
 
@@ -140,6 +227,44 @@ class Obs:
             return NULL_INSTRUMENT
         return self.registry.histogram(name, help, **kw)
 
+    # -- performance tier (ISSUE 10) ----------------------------------------
+
+    def sample_devices(self, where: str = "") -> int:
+        """Sample per-device ``memory_stats()`` into gauges + high-water
+        events (``obs.profile.DeviceTelemetry``).  No-op (returns 0)
+        unless the profile pillar is on — the sampling sites (sweep
+        bucket seams, serve batch flushes) call unconditionally."""
+        if self.telemetry is None:
+            return 0
+        return self.telemetry.sample(self, where=where)
+
+    def dump_flight(self, reason: str, **attrs) -> Optional[str]:
+        """Dump the flight-recorder ring as a crash artifact (atomic
+        JSON via ``utils.checkpoint``) and journal FLIGHT_RECORD_DUMP.
+        Returns the path written, or None when the recorder is off or no
+        dump path is configured.  The dump embeds the metrics-registry
+        snapshot — the "recent metric samples" leg of the ring — and the
+        ring's drop count, so a truncated window reads as truncated."""
+        if self.flight is None or self.flight_path is None:
+            return None
+        from ..utils.checkpoint import atomic_write_json
+        from .trace import _jsonable
+
+        payload = {
+            "run_id": self.run_id,
+            "reason": str(reason),
+            "dumped_at": round(float(self.flight._clock()), 6),
+            "attrs": {str(k): _jsonable(v) for k, v in attrs.items()},
+            "entries": self.flight.entries(),
+            "entries_dropped": self.flight.dropped,
+            "metrics": (self.registry.snapshot()
+                        if self.registry is not None else None),
+        }
+        atomic_write_json(self.flight_path, payload)
+        self.event("FLIGHT_RECORD_DUMP", path=self.flight_path,
+                   reason=str(reason), entries=len(payload["entries"]))
+        return self.flight_path
+
     # -- lifecycle ----------------------------------------------------------
 
     def activate(self):
@@ -149,12 +274,26 @@ class Obs:
         return _activation(self)
 
     def close(self) -> None:
-        """Flush run-end artifacts: the Chrome trace (atomic write) and
-        the RUN_END journal event.  Idempotent — a run interrupted
-        between seams may close through more than one ``finally``."""
+        """Flush run-end artifacts: the cost-ledger summary
+        (PROFILE_SNAPSHOT event + registry mirror), the RUN_END journal
+        event, and the Chrome trace (atomic write).  Idempotent — a run
+        interrupted between seams may close through more than one
+        ``finally``."""
         if self._closed:
             return
         self._closed = True
+        if self.cost_ledger is not None:
+            snap = self.cost_ledger.snapshot()
+            self.cost_ledger.publish(self.registry)
+            self.event("PROFILE_SNAPSHOT",
+                       executables=snap["executables"],
+                       launches=snap["launches"],
+                       launch_wall_s=snap["launch_wall_s"],
+                       measured_flops_total=snap["measured_flops_total"],
+                       achieved_flops_per_sec=snap[
+                           "achieved_flops_per_sec"],
+                       roofline=snap["roofline"],
+                       cost_sources=snap["cost_sources"])
         self.event("RUN_END")
         if self.tracer is not None and self.trace_path is not None:
             self.tracer.save_chrome_trace(self.trace_path)
@@ -261,8 +400,20 @@ def build_obs(config: Optional[ObsConfig]) -> Obs:
     registry = MetricsRegistry() if config.metrics else None
     journal = (EventJournal(config.journal_path, run_id)
                if config.journal_path is not None else None)
+    cost_ledger = telemetry = None
+    if config.profile:
+        from .profile import CostLedger, DeviceTelemetry
+
+        cost_ledger = CostLedger()
+        telemetry = DeviceTelemetry()
+    flight = FlightRecorder(limit=config.flight_limit)
+    flight_path = config.flight_path
+    if flight_path is None and config.journal_path is not None:
+        flight_path = str(config.journal_path) + ".flight.json"
     obs = Obs(run_id=run_id, tracer=tracer, registry=registry,
-              journal=journal, trace_path=config.trace_path)
+              journal=journal, trace_path=config.trace_path,
+              cost_ledger=cost_ledger, telemetry=telemetry,
+              flight=flight, flight_path=flight_path)
     obs.event("RUN_START")
     return obs
 

@@ -143,6 +143,12 @@ class Tracer:
         self.max_spans = int(max_spans)
         self.dropped = 0
         self.spans: List[Span] = []
+        # counter-track samples (ISSUE 10): (name, t, values) triples
+        # exported as Chrome "C" events — the cost ledger's per-launch
+        # achieved-FLOP/s stream renders as its own counter row in
+        # Perfetto.  Same bounded-and-counted policy as spans.
+        self._counters: List[tuple] = []
+        self.counters_dropped = 0
 
     def _append(self, sp: Span) -> None:
         # Dropping the NEWEST keeps nesting exportable — children
@@ -228,6 +234,19 @@ class Tracer:
             out.append(child)
         return out
 
+    def counter(self, name: str, **values) -> None:
+        """Record one counter-track sample at now (Chrome-trace "C"
+        event): ``tracer.counter("profile/sweep/achieved_flops_per_sec",
+        value=2.6e8)``.  Values must be numeric; each distinct ``name``
+        renders as its own counter row in the trace viewer."""
+        t = self._clock()
+        sample = (name, t, {str(k): float(v) for k, v in values.items()})
+        with self._lock:
+            if len(self._counters) >= self.max_spans:
+                self.counters_dropped += 1
+            else:
+                self._counters.append(sample)
+
     def record(self, name: str, duration_s: float, **attrs) -> None:
         """Record an externally-timed span ending now — for paths whose
         start predates any tracer involvement (a serve query's
@@ -260,6 +279,8 @@ class Tracer:
         with self._lock:
             spans = list(self.spans)
             dropped = self.dropped
+            counters = list(self._counters)
+            counters_dropped = self.counters_dropped
         expanded = []
         for sp in spans:
             expanded.append(sp)
@@ -290,10 +311,21 @@ class Tracer:
                 "tid": sp.tid,
                 "args": args,
             })
+        for name, t, values in counters:
+            events.append({
+                "name": name,
+                "ph": "C",
+                "ts": round((t - self._t_base) * 1e6, 3),
+                "pid": pid,
+                "tid": 0,
+                "args": values,
+            })
         events.sort(key=lambda e: e["ts"])
         meta = {"run_id": self.run_id}
         if dropped:
             meta["spans_dropped"] = dropped   # never a silent cap
+        if counters_dropped:
+            meta["counters_dropped"] = counters_dropped
         return {"traceEvents": events,
                 "displayTimeUnit": "ms",
                 "metadata": meta}

@@ -27,7 +27,6 @@ bit-order-identical to the lock-step path.
 
 from __future__ import annotations
 
-import time
 import warnings
 from dataclasses import dataclass
 from functools import lru_cache
@@ -55,6 +54,7 @@ from ..utils.fingerprint import (
     work_fingerprint,
 )
 from ..utils.config import SweepConfig
+from ..utils.timing import stopwatch
 from ..utils.resilience import (
     LedgerState,
     RetryPolicy,
@@ -542,9 +542,9 @@ def _timed_launch(device_call, label, fn, args):
     t = [float("nan")]
 
     def timed():
-        t0 = time.perf_counter()
-        out = np.asarray(fn(*args))
-        t[0] = time.perf_counter() - t0
+        with stopwatch() as sw:
+            out = np.asarray(fn(*args))
+        t[0] = sw.seconds
         return out
 
     packed = device_call(label, timed)
@@ -586,6 +586,12 @@ def _solve_scheduled(scn, sweep: SweepConfig, cells_p, cells_nom,
     if device_call is None:
         def device_call(label, f):
             return f()
+    # measured cost attribution (ISSUE 10): the ledger keys on the same
+    # compile-cache identity the executables deduplicate on (work
+    # fingerprint + cold/warm flavor + padded shape)
+    prof = obs.cost_ledger
+    prof_wf = (_work_fingerprint(kwargs_items, dtype, scenario=scn.name)
+               if prof is not None else None)
     pred = _predict_work(cells, side, heuristic=scn.cells.work)
     if ledger is not None:
         ledger.pred = np.asarray(pred, dtype=np.float64)
@@ -705,12 +711,21 @@ def _solve_scheduled(scn, sweep: SweepConfig, cells_p, cells_nom,
         if shard is not None:
             args = [jax.device_put(a, shard) for a in args]
 
+        prof_key = None
+        if prof is not None:
+            flavor = "warm" if warm else "cold"
+            prof_key = ("sweep", scn.name, prof_wf, flavor, b_pad,
+                        fault_mode)
+            prof.capture(prof_key, fn, args,
+                         label=f"sweep/{scn.name}/{flavor}{b_pad}")
         with obs.span("sweep/bucket", bucket=int(bi),
                       cells=len(bucket), lanes=len(lanes), warm=warm,
                       device_profile=True) as bsp:
             packed, launch_wall = _timed_launch(     # [B, W], one transfer
                 device_call, f"sweep bucket {bi}", fn, args)
         wall_total += launch_wall
+        if prof is not None:
+            prof.record_launch(prof_key, launch_wall, tracer=obs.tracer)
 
         # un-permute: padding lanes duplicate a real lane's inputs, so the
         # duplicate rows carry identical bits and last-write-wins is exact
@@ -732,6 +747,24 @@ def _solve_scheduled(scn, sweep: SweepConfig, cells_p, cells_nom,
                   wall_s=launch_wall)
         obs.histogram("aiyagari_sweep_bucket_wall_seconds",
                       "per-bucket launch wall").observe(launch_wall)
+        if obs.enabled:
+            # per-bucket lane telemetry (ISSUE 10): how full the padded
+            # launch really was, and how evenly the predicted work split
+            # across devices — the numbers a 1->8-chip scaling claim
+            # must show staying flat
+            obs.gauge("aiyagari_sweep_bucket_lane_occupancy",
+                      "real cells / padded lanes of the last bucket"
+                      ).set(len(bucket) / float(len(lanes)))
+            if n_shards > 1:
+                per_dev = pred[lanes].reshape(n_shards, -1).sum(axis=1)
+                dev_skew = float(per_dev.max() / max(per_dev.min(),
+                                                     1e-12))
+            else:
+                dev_skew = 1.0
+            obs.gauge("aiyagari_sweep_bucket_device_work_skew",
+                      "max/min per-device predicted work of the last "
+                      "bucket").set(dev_skew)
+            obs.sample_devices(where=f"sweep/bucket{bi}")
         if warm:
             for pos, li in enumerate(lanes):
                 seeds_used[li] = seeds[pos]
@@ -1114,10 +1147,22 @@ def _run_sweep_impl(scn, sweep, cells_nom, mesh, axis, dtype, timer,
 
         fn = scn.batched_solver(dtype, kwargs_items, fault_mode, False)
         args = tuple(cols) if fault_d is None else (*cols, fault_d)
+        prof = obs.cost_ledger
+        prof_key = None
+        if prof is not None:
+            shape0 = int(np.asarray(args[0]).shape[0])
+            prof_key = ("sweep", scn.name,
+                        _work_fingerprint(kwargs_items, dtype,
+                                          scenario=scn.name),
+                        "cold", shape0, fault_mode)
+            prof.capture(prof_key, fn, args,
+                         label=f"sweep/{scn.name}/cold{shape0}")
         with obs.span("sweep/bucket", bucket=0, cells=n_orig,
                       warm=False, device_profile=True) as bsp:
             packed, wall = _timed_launch(       # [C, W], one transfer
                 device_call, "sweep launch", fn, args)
+        if prof is not None:
+            prof.record_launch(prof_key, wall, tracer=obs.tracer)
         bsp.annotate(wall_s=wall)
         if schema.phases is not None:
             d_col = schema.idx(schema.phases[0])
@@ -1130,6 +1175,11 @@ def _run_sweep_impl(scn, sweep, cells_nom, mesh, axis, dtype, timer,
                   cells=list(range(n_orig)), warm=False, wall_s=wall)
         obs.histogram("aiyagari_sweep_bucket_wall_seconds",
                       "per-bucket launch wall").observe(wall)
+        if obs.enabled:
+            obs.gauge("aiyagari_sweep_bucket_lane_occupancy",
+                      "real cells / padded lanes of the last bucket"
+                      ).set(n_orig / float(np.asarray(args[0]).shape[0]))
+            obs.sample_devices(where="sweep/bucket0")
         # the single lock-step launch is bucket 0 of 1 to the seam protocol
         _resilience_seam(
             ledger,
@@ -1294,6 +1344,15 @@ def _run_sweep_impl(scn, sweep, cells_nom, mesh, axis, dtype, timer,
                             for i in still)
                 + " failed every quarantine retry; their values are "
                 "NaN-masked in the result", stacklevel=4)
+            # typed failure past the quarantine ladder: dump the flight
+            # recorder as the post-mortem artifact (ISSUE 10 — the ring
+            # holds the run's recent spans/events; the dump embeds the
+            # metrics snapshot), journaled as FLIGHT_RECORD_DUMP
+            obs.dump_flight(
+                f"{scn.name} sweep: {len(still)} cell(s) exhausted the "
+                "quarantine ladder",
+                cells=[int(i) for i in still],
+                statuses=[status_name(int(status[i])) for i in still])
 
     # KNOWN-corrupt cells no retry recovered (or that had no ladder to
     # run) must not leak ANY field into the result or the sidecar work
@@ -1367,15 +1426,15 @@ def _run_sweep_impl(scn, sweep, cells_nom, mesh, axis, dtype, timer,
             raise ValueError(
                 f"scenario {scn.name!r} has no certify_rows hook; "
                 "run without SweepConfig(certify=True)")
-        t0 = time.perf_counter()
-        with obs.span("sweep/certify", cells=n_orig) as csp:
-            certs = device_call(
-                "a posteriori certification",
-                lambda: scn.certify_rows(
-                    rows, cells_p, dtype, kwargs_items,
-                    thresholds=cert_thresholds))
+        with stopwatch() as cert_sw:
+            with obs.span("sweep/certify", cells=n_orig) as csp:
+                certs = device_call(
+                    "a posteriori certification",
+                    lambda: scn.certify_rows(
+                        rows, cells_p, dtype, kwargs_items,
+                        thresholds=cert_thresholds))
         cert_level = np.asarray([c.level for c in certs], dtype=np.int64)
-        certify_wall = time.perf_counter() - t0
+        certify_wall = cert_sw.seconds
         csp.annotate(wall_s=certify_wall,
                      failed=int((cert_level == 2).sum()))
         for i in np.nonzero(cert_level == 2)[0]:

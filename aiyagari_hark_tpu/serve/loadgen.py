@@ -41,11 +41,11 @@ from __future__ import annotations
 
 import hashlib
 import json
-import time
 from typing import List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
+from ..utils.timing import stopwatch
 from .service import EquilibriumService, ServeError, make_query
 
 
@@ -219,13 +219,12 @@ def run_load(spec: LoadSpec, admission=None, obs=None,
                            degraded_ok=a.degraded_ok,
                            **spec.model_kwargs)
             try:
-                w0 = time.perf_counter() if measure_hit_wall else 0.0
-                fut = svc.submit(q, deadline=a.deadline)
+                with stopwatch() as sw:
+                    fut = svc.submit(q, deadline=a.deadline)
                 if measure_hit_wall and fut.done():
-                    wall = time.perf_counter() - w0
                     if (fut.exception() is None
                             and fut.result().path == "hit"):
-                        hit_wall_ms.append(wall * 1e3)
+                        hit_wall_ms.append(sw.seconds * 1e3)
                 slots[i] = fut
             except ServeError as e:
                 slots[i] = e

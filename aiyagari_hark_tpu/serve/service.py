@@ -949,6 +949,21 @@ class EquilibriumService:
         fn = scn.batched_solver(dtype, kwargs_items, self._fault_mode,
                                 host is not None)
 
+        # measured cost attribution (ISSUE 10): same compile-cache
+        # keying as the sweep's ledger — a warmed service owns one
+        # executable per (scenario, flavor, ladder shape), so the
+        # ledger's entry count IS the executable-ladder audit
+        prof = self._obs.cost_ledger
+        prof_key = None
+        if prof is not None:
+            flavor = "warm" if host is not None else "cold"
+            prof_key = ("serve", scn.name,
+                        work_fingerprint(kwargs_items, dtype,
+                                         scenario=scn.name),
+                        flavor, shape, self._fault_mode)
+            prof.capture(prof_key, fn, args,
+                         label=f"serve/{scn.name}/{flavor}{shape}")
+
         t_launch = self._clock()
         try:
             with self._launch_lock, self.metrics.compile, \
@@ -985,6 +1000,15 @@ class EquilibriumService:
         wall = self._clock() - t_launch
         self._batch_ewma_s = (wall if self._batch_ewma_s is None
                               else 0.25 * wall + 0.75 * self._batch_ewma_s)
+        if prof is not None:
+            prof.record_launch(prof_key, wall, tracer=self._obs.tracer)
+        if self._obs.enabled:
+            # per-flush lane telemetry (ISSUE 10): padding efficiency of
+            # the ladder shape, plus the per-device memory sample
+            self._obs.gauge("aiyagari_serve_batch_lane_occupancy",
+                            "real lanes / ladder shape of the last "
+                            "flush").set(n / float(shape))
+            self._obs.sample_devices(where="serve/batch_flush")
 
         self.metrics.record_batch(n, shape)
         rows = np.array(np.asarray(packed), dtype=np.float64)

@@ -50,6 +50,41 @@ class PhaseTimer:
         return "\n".join(lines + [f"{'total':>12s}: {total:8.3f}s"])
 
 
+class Stopwatch:
+    """A started wall timer: ``elapsed()`` reads the running interval,
+    ``seconds`` is filled at exit when used through ``stopwatch()`` (NaN
+    while still running)."""
+
+    __slots__ = ("t0", "seconds")
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+        self.seconds = float("nan")
+
+    def elapsed(self) -> float:
+        """Seconds since construction (monotonic clock)."""
+        return time.perf_counter() - self.t0
+
+
+@contextlib.contextmanager
+def stopwatch():
+    """Measure one wall interval: ``with stopwatch() as sw: ...`` then
+    read ``sw.seconds`` (or construct ``Stopwatch()`` directly and poll
+    ``elapsed()`` for loop-shaped measurement).  The ONE blessed
+    ad-hoc-timing primitive for hot modules (ISSUE 10:
+    ``scripts/check_timing_discipline.py`` bans bare
+    ``time.perf_counter()``/``time.time()`` pairs in ``parallel/``,
+    ``serve/``, ``obs/``, ``models/`` — a measured wall must either be a
+    ``Tracer`` span or flow through here, so every timing read uses the
+    same monotonic clock and the same exception-safe fill-on-exit
+    semantics)."""
+    sw = Stopwatch()
+    try:
+        yield sw
+    finally:
+        sw.seconds = time.perf_counter() - sw.t0
+
+
 def write_records_jsonl(path: str, records: Iterable,
                         append: bool = False) -> None:
     """Persist iteration records (e.g. ``KSIterationRecord`` dataclasses or
@@ -174,23 +209,58 @@ def peak_flops_per_chip(backend: str) -> PeakFlops:
 
 def flop_report(egm_iters: float, dist_iters: float, wall_s: float,
                 a_count: int, n_states: int, d_count: int,
-                dense_dist: bool, backend: str) -> dict:
+                dense_dist: bool, backend: str,
+                measured_flops: float | None = None) -> dict:
     """Achieved FLOP rate + MFU for one measured phase, as record fields:
-    ``{"flops_per_sec": ..., "mfu_pct": ..., "peak_flops_assumed": ...}``
-    (mfu None off-accelerator; ``peak_flops_assumed`` True when the MFU
-    denominator is the unknown-chip class guess).  Never raises on a
-    degenerate wall — a broken phase records nulls, not a crashed
-    bench."""
+    ``{"flops_per_sec": ..., "mfu_pct": ..., "peak_flops_assumed": ...,
+    "flops_provenance": ...}`` (mfu None off-accelerator;
+    ``peak_flops_assumed`` True when the MFU denominator is the
+    unknown-chip class guess).  Never raises on a degenerate wall — a
+    broken phase records nulls, not a crashed bench.
+
+    ``measured_flops`` is the optional MEASURED numerator (ISSUE 10): a
+    total FLOP count from XLA's own cost analysis
+    (``obs.profile.CostLedger.measured_flops_total``) used INSTEAD of
+    the analytic step-count model.  ``flops_provenance`` records which
+    source produced the fields — ``"analytic"`` (the ``model_flops``
+    hand model) or ``"xla_cost_analysis"`` — so ``peak_flops_assumed``
+    is no longer the only honesty bit on an MFU number: a reader can now
+    see whether BOTH sides of the ratio were measured."""
     if wall_s is None or not wall_s > 0:
         return {"flops_per_sec": None, "mfu_pct": None,
-                "peak_flops_assumed": False}
-    flops = model_flops(egm_iters, dist_iters, a_count, n_states, d_count,
-                        dense_dist)
+                "peak_flops_assumed": False, "flops_provenance": None}
+    if measured_flops is not None:
+        flops = float(measured_flops)
+        provenance = "xla_cost_analysis"
+    else:
+        flops = model_flops(egm_iters, dist_iters, a_count, n_states,
+                            d_count, dense_dist)
+        provenance = "analytic"
     peak = peak_flops_per_chip(backend)
     return {"flops_per_sec": round(flops / wall_s),
             "mfu_pct": (None if peak.value is None
                         else round(100.0 * flops / wall_s / peak.value, 4)),
-            "peak_flops_assumed": peak.assumed}
+            "peak_flops_assumed": peak.assumed,
+            "flops_provenance": provenance}
+
+
+def record_flop_fields(record: dict, prefix: str, egm_iters: float,
+                       dist_iters: float, wall_s: float, a_count: int,
+                       n_states: int, d_count: int, dense_dist: bool,
+                       backend: str,
+                       measured_flops: float | None = None) -> dict:
+    """Stamp one phase's ``flop_report`` onto a bench record under
+    ``prefix`` (``record[prefix + "flops_per_sec"]`` etc., provenance
+    included) and return the record — the ONE spelling every bench
+    phase uses, so no phase can strand a null field or omit the
+    provenance bit again (ISSUE 10 satellite; the fine-grid fields went
+    null twice before ``model_flops`` was centralized)."""
+    rep = flop_report(egm_iters, dist_iters, wall_s, a_count, n_states,
+                      d_count, dense_dist, backend,
+                      measured_flops=measured_flops)
+    for key, value in rep.items():
+        record[prefix + key] = value
+    return record
 
 
 # -- XLA compile counting (jax.monitoring) ----------------------------------

@@ -108,6 +108,7 @@ LANES_SD_PANELS = {
 from aiyagari_hark_tpu.utils.timing import (  # noqa: E402
     model_flops as _model_flops,
     peak_flops_per_chip as _peak_flops_per_chip,
+    record_flop_fields,
 )
 
 _ORACLE_CODE = """
@@ -385,10 +386,6 @@ def _fine_grid_metrics(backend: str, timer) -> dict:
     if peak.assumed:
         out["fine_grid_peak_flops_assumed"] = True
 
-    def mfu(flops, wall):
-        return (None if peak.value is None
-                else round(100.0 * flops / wall / peak.value, 3))
-
     # -- primary method (dense matvecs on the accelerator, scatter on CPU);
     # on a failed primary, fall through to the next method so the record
     # still carries an accelerator number.
@@ -442,9 +439,16 @@ def _fine_grid_metrics(backend: str, timer) -> dict:
         out.update({
             "fine_grid_wall_s": round(wall, 4),
             "fine_grid_method": method,
-            "fine_grid_flops_per_sec": round(flops / wall),
-            "fine_grid_mfu_pct": mfu(flops, wall),
         })
+        # one spelling for flops/mfu/provenance fields (ISSUE 10
+        # satellite, utils.timing.record_flop_fields): stamps
+        # fine_grid_{flops_per_sec, mfu_pct, peak_flops_assumed,
+        # flops_provenance}
+        record_flop_fields(out, "fine_grid_", egm_it, dist_it, wall,
+                           FINE_A_COUNT, FINE_LABOR_STATES,
+                           FINE_DIST_COUNT,
+                           dense_dist=(method == "dense"),
+                           backend=backend)
         print(f"[bench] fine grid ({FINE_A_COUNT}x{FINE_LABOR_STATES}, "
               f"D={FINE_DIST_COUNT}, {method}): r*={r_star:.4%} "
               f"wall={wall:.3f}s -> {flops / wall:.3e} FLOP/s",
@@ -476,14 +480,15 @@ def _fine_grid_metrics(backend: str, timer) -> dict:
     else:
         try:
             wall4, egm4, dist4 = _timed_fine_lanes(4, primary, timer)
-            flops4 = _model_flops(egm4, dist4, FINE_A_COUNT,
-                                  FINE_LABOR_STATES, FINE_DIST_COUNT,
-                                  dense_dist=(primary == "dense"))
             out.update({
                 "fine_grid_lanes4_wall_s": round(wall4, 4),
                 "fine_grid_lanes4_cells_per_sec": round(4.0 / wall4, 4),
-                "fine_grid_lanes4_mfu_pct": mfu(flops4, wall4),
             })
+            record_flop_fields(out, "fine_grid_lanes4_", egm4, dist4,
+                               wall4, FINE_A_COUNT, FINE_LABOR_STATES,
+                               FINE_DIST_COUNT,
+                               dense_dist=(primary == "dense"),
+                               backend=backend)
             print(f"[bench] fine grid x4 lanes ({primary}): "
                   f"wall={wall4:.3f}s -> {4.0 / wall4:.3f} cells/s",
                   file=sys.stderr)
@@ -1788,6 +1793,182 @@ def _obs_drills():
     return injected, detected, detail
 
 
+# Profile smoke (ISSUE 10): measured-cost-attribution acceptance on the
+# same committed-golden 12-cell configuration as the obs smoke; the
+# overhead budget covers obs AND the cost ledger together.
+PROFILE_OVERHEAD_BUDGET = 0.02
+
+
+def _profile_smoke() -> dict:
+    """The ``--profile-smoke`` acceptance run (DESIGN §10b): run the
+    12-cell golden CPU sweep with the performance tier on
+    (``ObsConfig(profile=True)``), assert profiling-enabled results
+    bit-identical to the committed goldens, obs+profile overhead < 2%
+    against plain runs, ``profile_*`` record fields non-null (the
+    cost-analysis fields may be null only with a recorded reason in
+    ``profile_cost_sources``), the analytic-vs-measured FLOP cross-check
+    recorded, and the bench-regression sentinel clean on the committed
+    history."""
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import jax.numpy as jnp
+
+    from aiyagari_hark_tpu.obs import ObsConfig, build_obs, read_journal
+    from aiyagari_hark_tpu.obs.regress import (
+        REGRESSED,
+        SEVERITY_NAMES,
+        evaluate_history,
+        load_bench_history,
+    )
+    from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+    from aiyagari_hark_tpu.utils.config import SweepConfig
+    from aiyagari_hark_tpu.utils.timing import model_flops
+
+    backend = jax.default_backend()
+    kw = dict(OBS_SMOKE_KWARGS)
+    golden_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "tests", "data", "table2_golden_test.json")
+    with open(golden_path) as f:
+        golden = json.load(f)
+    assert golden["config"] == kw, "golden drifted from OBS_SMOKE_KWARGS"
+    golden_r = np.asarray(golden["r_star_pct"], dtype=np.float64)
+
+    # phase 1: warm-up — compiles the sweep executable AND pays the cost
+    # ledger's one-time AOT capture (lower + cache-served compile), so
+    # the timed phases below measure steady-state profiling overhead
+    t0 = time.perf_counter()
+    run_table2_sweep(SweepConfig(), dtype=jnp.float64, **kw)
+    print(f"[bench] profile smoke: warm-up in "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    with tempfile.TemporaryDirectory() as td:
+        trace_path = os.path.join(td, "trace.json")
+        journal_path = os.path.join(td, "events.jsonl")
+        obs = build_obs(ObsConfig(enabled=True, profile=True,
+                                  trace_path=trace_path,
+                                  journal_path=journal_path))
+        # capture warm-up inside the profiled bundle (the AOT compile
+        # lands here, outside the timed interleave below)
+        run_table2_sweep(SweepConfig(), dtype=jnp.float64, obs=obs, **kw)
+
+        # phases 2+3: timed plain vs profiled runs, interleaved (off,
+        # on, off, on — same drift argument as the obs smoke), best-of
+        timed_rounds = 2
+        walls_off, walls_on, res_off, res_on = [], [], None, None
+        for _ in range(timed_rounds):
+            t0 = time.perf_counter()
+            res_off = run_table2_sweep(SweepConfig(), dtype=jnp.float64,
+                                       **kw)
+            walls_off.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            res_on = run_table2_sweep(SweepConfig(), dtype=jnp.float64,
+                                      obs=obs, **kw)
+            walls_on.append(time.perf_counter() - t0)
+
+        snap = obs.cost_ledger.snapshot()
+        dev_stats = obs.sample_devices(where="profile_smoke")
+        obs.close()     # journals PROFILE_SNAPSHOT + flushes the trace
+
+        overhead = min(walls_on) / max(min(walls_off), 1e-9) - 1.0
+        on_off_identical = bool(
+            np.array_equal(res_on.r_star_pct, res_off.r_star_pct)
+            and np.array_equal(res_on.saving_rate_pct,
+                               res_off.saving_rate_pct)
+            and np.array_equal(res_on.status, res_off.status))
+        golden_identical = bool(
+            np.array_equal(np.asarray(res_on.r_star_pct), golden_r))
+        golden_max_diff = float(
+            np.max(np.abs(np.asarray(res_on.r_star_pct) - golden_r)))
+
+        # the analytic-vs-measured FLOP cross-check: the hand model from
+        # the profiled run's own counters over XLA's static count x
+        # launches (>> 1 expected: XLA counts a while body once; the
+        # RATIO is the recorded, watchable number).  The ledger
+        # accumulated over EVERY profiled run under this bundle (the
+        # in-bundle warm-up plus the timed on-runs — identical inputs,
+        # so identical per-run counters), so the analytic side must
+        # cover the same launches or the ratio becomes a
+        # harness-structure artifact.
+        n_profiled_runs = 1 + timed_rounds   # in-bundle warm-up + on-runs
+        analytic = n_profiled_runs * model_flops(
+            float(res_on.egm_iters.sum()), float(res_on.dist_iters.sum()),
+            kw["a_count"], LABOR_STATES, kw["dist_count"],
+            dense_dist=(res_on.dist_method in ("dense", "pallas")))
+        ratio = obs.cost_ledger.flops_model_vs_measured_ratio(analytic)
+
+        snapshots = read_journal(journal_path, run_id=obs.run_id,
+                                 event="PROFILE_SNAPSHOT")
+        with open(trace_path) as f:
+            trace = json.load(f)
+        counter_events = [e for e in trace["traceEvents"]
+                          if e.get("ph") == "C"]
+
+    # phase 4: the bench-regression sentinel on the committed history
+    report = evaluate_history(load_bench_history(_repo_dir()))
+    regress_clean = bool(report.worst < REGRESSED)
+
+    record = {
+        "metric": "profile_smoke",
+        "backend": backend,
+        "profile_run_id": obs.run_id,
+        "profile_smoke_cells": len(golden_r),
+        # measured cost attribution (non-null acceptance; cost-analysis
+        # fields may be null only with the reason in cost_sources)
+        "profile_executables": snap["executables"],
+        "profile_launches": snap["launches"],
+        "profile_launch_wall_s": round(snap["launch_wall_s"], 4),
+        "profile_lowering_wall_s": round(snap["lowering_wall_s"], 4),
+        "profile_compile_wall_s": round(snap["compile_wall_s"], 4),
+        "profile_measured_flops_total": snap["measured_flops_total"],
+        "profile_bytes_accessed_total": snap["bytes_accessed_total"],
+        "profile_achieved_flops_per_sec": snap["achieved_flops_per_sec"],
+        "profile_arithmetic_intensity": snap["arithmetic_intensity"],
+        "profile_roofline": snap["roofline"],
+        "profile_mfu_pct": snap["mfu_pct"],
+        "profile_cost_sources": snap["cost_sources"],
+        "profile_flops_model_vs_measured_ratio": (
+            None if ratio is None else round(ratio, 2)),
+        "profile_trace_counter_events": len(counter_events),
+        "profile_snapshot_events": len(snapshots),
+        # per-device telemetry (CPU: zero devices report stats, by
+        # design — the graceful-None contract)
+        "profile_device_mem_stats_devices": dev_stats,
+        # overhead + bit-identity acceptance
+        "profile_wall_off_s": round(min(walls_off), 4),
+        "profile_wall_on_s": round(min(walls_on), 4),
+        "profile_overhead_frac": round(max(0.0, overhead), 4),
+        "profile_overhead_under_2pct": bool(
+            overhead < PROFILE_OVERHEAD_BUDGET),
+        "profile_on_vs_off_bit_identical": on_off_identical,
+        "profile_golden_bit_identical": golden_identical,
+        "profile_golden_max_abs_diff": golden_max_diff,
+        # bench-regression sentinel acceptance
+        "profile_bench_regress_clean": regress_clean,
+        "profile_bench_regress_worst": SEVERITY_NAMES[report.worst],
+        "profile_bench_regress_findings": len(report.findings),
+        "profile_bench_regress_ungraded": len(report.unknown_fields),
+    }
+    print(f"[bench] profile smoke: {snap['executables']} executable(s), "
+          f"{snap['launches']} launches, "
+          f"measured {snap['measured_flops_total'] or 0:.3e} FLOPs "
+          f"({snap['roofline']}), model/measured "
+          f"{ratio if ratio is not None else float('nan'):.1f}x, "
+          f"overhead {100 * max(0.0, overhead):.2f}%, golden "
+          f"{'OK' if golden_identical else 'DIFF'}, sentinel "
+          f"{report.summary()}", file=sys.stderr)
+    if not (on_off_identical and golden_identical):
+        print("[bench] profile smoke: BIT-IDENTITY FAILED — profiling "
+              "changed solver bits", file=sys.stderr)
+    return record
+
+
 # Load smoke (ISSUE 8): the overload acceptance on the Table II lattice
 # (both sd panels plus a third, so the cold-key space is wide enough to
 # saturate) at serving grid sizes.  Modeled capacity is max_batch /
@@ -1985,7 +2166,11 @@ def main(argv=None):
     emits the ``obs_*`` record (ISSUE 7); ``--load-smoke`` runs the
     overload acceptance (deterministic Zipf replay at 2.5x capacity,
     typed outcome accounting, breaker drill) and emits the ``load_*``
-    record (ISSUE 8)."""
+    record (ISSUE 8); ``--profile-smoke`` runs the
+    performance-observability acceptance (XLA cost-analysis capture,
+    roofline classification, model-vs-measured FLOP cross-check,
+    bench-regression sentinel on the committed history) and emits the
+    ``profile_*`` record (ISSUE 10)."""
     import argparse
 
     from aiyagari_hark_tpu.utils.resilience import (
@@ -2015,6 +2200,15 @@ def main(argv=None):
                          "injection-drill event contract, <2%% disabled "
                          "overhead) and emit the obs_* record instead "
                          "of the full bench")
+    ap.add_argument("--profile-smoke", action="store_true",
+                    help="run the performance-observability smoke "
+                         "(12-cell golden sweep with the cost ledger on: "
+                         "XLA cost-analysis capture, roofline "
+                         "classification, model-vs-measured FLOP "
+                         "cross-check, <2%% overhead, bit-identity to "
+                         "goldens, bench-regression sentinel on the "
+                         "committed history) and emit the profile_* "
+                         "record instead of the full bench")
     ap.add_argument("--load-smoke", action="store_true",
                     help="run the overload smoke (seeded open-loop Zipf "
                          "replay at 2.5x modeled capacity on the "
@@ -2033,13 +2227,15 @@ def main(argv=None):
                          "full bench")
     args = ap.parse_args(argv)
     if (args.serve_smoke or args.integrity_smoke or args.obs_smoke
-            or args.load_smoke or args.scenario_smoke):
+            or args.load_smoke or args.scenario_smoke
+            or args.profile_smoke):
         from aiyagari_hark_tpu.utils.backend import (
             enable_compilation_cache,
         )
 
         enable_compilation_cache()
-        smoke = (_scenario_smoke if args.scenario_smoke
+        smoke = (_profile_smoke if args.profile_smoke
+                 else _scenario_smoke if args.scenario_smoke
                  else _load_smoke if args.load_smoke
                  else _obs_smoke if args.obs_smoke
                  else _integrity_smoke if args.integrity_smoke
@@ -2211,6 +2407,10 @@ def _run_bench(resume_path=None):
         # True when the MFU denominator is the unknown-chip class guess
         # (ISSUE 4 satellite): an assumed peak must read as assumed
         "peak_flops_assumed": peak.assumed,
+        # Which source produced the FLOP numerator (ISSUE 10 satellite):
+        # the headline rides the analytic step-count model; the measured
+        # XLA side lives in the --profile-smoke profile_* record
+        "flops_provenance": "analytic",
         "dist_method": dist_method,
     }
     if on_accel:

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Tier-1 bench-regression sentinel: grade the committed BENCH history
+(ISSUE 10, ``obs.regress``).
+
+Loads every committed ``BENCH_r*.json``, grades the newest record
+against the robust baseline of the earlier rounds (median of the last K
+with an IQR noise band, per-metric direction of goodness), and exits:
+
+* 0 — no REGRESSED finding (NOISE findings are printed but do not
+  fail: outside the band yet under the 10% actionability line);
+* 1 — at least one REGRESSED finding, printed worst-first with its
+  baseline, band, and relative move — a committed bench number moved
+  >= 10% in the bad direction past everything history contains.
+
+Run standalone or via tier-1 (``tests/test_regress.py`` calls ``scan``
+and additionally drills the injected-slowdown path: a synthetic 20%
+slowdown appended to the history MUST flag REGRESSED).  ``--json``
+prints the full report as one JSON object for tooling.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from aiyagari_hark_tpu.obs.regress import (  # noqa: E402
+    NOISE,
+    REGRESSED,
+    evaluate_history,
+    load_bench_history,
+)
+
+
+def scan(repo: str = REPO, window: int = 5):
+    """The sentinel report for the committed history (exposed so tier-1
+    tests pin clean-on-committed and flag-on-injection behavior)."""
+    history = load_bench_history(repo)
+    return evaluate_history(history, window=window)
+
+
+def _fmt(f) -> str:
+    base = (f"  {f.severity_name:9s} {f.metric} = {f.value:g}"
+            if f.value is not None else
+            f"  {f.severity_name:9s} {f.metric}")
+    if f.baseline is not None and f.band is not None:
+        base += (f" (baseline {f.baseline:g} ± {f.band:g}"
+                 + (f", moved {100.0 * f.delta_frac:+.1f}% "
+                    f"{'worse' if f.delta_frac > 0 else 'better'}"
+                    if f.delta_frac is not None else "")
+                 + f", direction-of-goodness {f.direction})")
+    elif f.note:
+        base += f" ({f.note})"
+    return base
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true",
+                    help="print the full report as JSON")
+    ap.add_argument("--window", type=int, default=5,
+                    help="baseline window: last K prior rounds "
+                         "(default 5)")
+    args = ap.parse_args(argv)
+
+    report = scan(window=args.window)
+    if args.json:
+        import dataclasses
+
+        print(json.dumps({
+            "summary": report.summary(),
+            "worst": report.worst,
+            "latest_round": report.latest_round,
+            "baseline_rounds": report.baseline_rounds,
+            "unknown_fields": report.unknown_fields,
+            "findings": [dataclasses.asdict(f) for f in report.findings],
+        }))
+    else:
+        print(report.summary())
+        for f in report.findings:
+            if f.severity >= NOISE:
+                print(_fmt(f))
+        for metric in report.unknown_fields:
+            print(f"  UNGRADED  {metric} (no direction of goodness — "
+                  "add to obs.regress.DIRECTION_EXPLICIT)")
+    return 1 if report.worst >= REGRESSED else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

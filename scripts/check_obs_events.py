@@ -68,8 +68,12 @@ EMIT_NAMES = {"emit", "emit_event", "event", "_record_eviction"}
 # see them) that must emit anyway: quarantine/retry/evict sites.  The
 # sweep's quarantine ladder is inline in ``_run_sweep_impl`` —
 # listed here so stripping its QUARANTINE event is a lint failure too.
+# ISSUE 10 additions: the flight-recorder dump site (must journal
+# FLIGHT_RECORD_DUMP next to the artifact it writes) and the
+# bench-regression sentinel's grading loop (must journal
+# REGRESSION_FLAGGED for every REGRESSED finding).
 SEAM_DEFS = {"_evict_corrupt", "_record_eviction", "retry_transient",
-             "_run_sweep_impl"}
+             "_run_sweep_impl", "dump_flight", "evaluate_history"}
 
 
 def _call_name(node: ast.Call):

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Static lint: hot-module timing discipline (ISSUE 10).
+
+The performance-observability tier (DESIGN §10b) is only trustworthy if
+every measured wall in the hot modules flows through ONE clock and one
+exception-safe idiom: a ``Tracer`` span, ``utils.timing.PhaseTimer``, or
+``utils.timing.stopwatch()``/``Stopwatch``.  An ad-hoc
+``t0 = time.perf_counter(); ...; t1 - t0`` pair is exactly how the
+pre-ISSUE-7 wall-clock story fractured into four disconnected encodings
+— and a bare ``time.time()`` wall is additionally wrong under clock
+adjustment.  One violation class, scoped to the modules whose seams the
+obs layer instruments (``HOT_DIRS``):
+
+* a CALL to ``time.perf_counter()`` or ``time.time()`` (an attribute
+  reference like ``clock=time.time`` — injectable-clock plumbing — does
+  not match, by design: passing the clock is the pattern we want).
+
+A hit is a finding unless its line carries an explicit ``# timing-ok``
+waiver stating why a raw clock read is required (e.g. a module that IS
+the timing substrate).  Docstrings are blanked before scanning so prose
+examples cannot trip it.  Run standalone (exits 1 on findings) or via
+tier-1 (``tests/test_timing_lint.py``), next to the sibling
+``check_dtype_discipline.py`` / ``check_atomic_writes.py`` lints.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The hot-module scope: every package dir whose seams the obs layer
+# instruments.  utils/ is deliberately OUT of scope — utils/timing.py is
+# the blessed substrate the rule routes callers through.
+HOT_DIRS = (
+    os.path.join("aiyagari_hark_tpu", "parallel"),
+    os.path.join("aiyagari_hark_tpu", "serve"),
+    os.path.join("aiyagari_hark_tpu", "obs"),
+    os.path.join("aiyagari_hark_tpu", "models"),
+)
+
+WAIVER = "# timing-ok"
+
+_CLOCK_CALL = re.compile(r"\btime\.(perf_counter|time)\s*\(")
+
+_TRIPLE_STRING = re.compile(r"('''|\"\"\")(.*?)(\1)", re.DOTALL)
+
+
+def _blank_strings(src: str) -> str:
+    """Triple-quoted strings (docstrings) blanked out, newlines kept, so
+    the line scan cannot trip on prose like ``time.time() pairs``."""
+    def blank(m):
+        return m.group(1) + re.sub(r"[^\n]", " ", m.group(2)) + m.group(3)
+    return _TRIPLE_STRING.sub(blank, src)
+
+
+def scan_source(src: str, rel: str) -> list:
+    """All findings in one module's source, as (rel, lineno, message)."""
+    findings = []
+    src = _blank_strings(src)
+    lines = src.splitlines()
+    for m in _CLOCK_CALL.finditer(src):
+        lineno = src.count("\n", 0, m.start()) + 1
+        line = lines[lineno - 1] if lineno <= len(lines) else ""
+        if WAIVER in line:
+            continue
+        if line.split("#", 1)[0].strip() == "":
+            continue        # match sits in a line comment
+        findings.append(
+            (rel, lineno,
+             f"ad-hoc time.{m.group(1)}() in a hot module — route the "
+             "measurement through a Tracer span, utils.timing.PhaseTimer, "
+             "or utils.timing.stopwatch()/Stopwatch (one clock, "
+             "exception-safe; DESIGN §10b), or waive with '# timing-ok'"))
+    return findings
+
+
+def scan_targets(repo: str = REPO) -> list:
+    """Every file the lint covers (absolute paths) — exposed so the
+    lint's own test can pin coverage instead of trusting the walk."""
+    targets = []
+    for rel_dir in HOT_DIRS:
+        for dirpath, _, names in os.walk(os.path.join(repo, rel_dir)):
+            if "__pycache__" in dirpath:
+                continue
+            targets += [os.path.join(dirpath, n) for n in sorted(names)
+                        if n.endswith(".py")]
+    return targets
+
+
+def scan(repo: str = REPO) -> list:
+    findings = []
+    for path in scan_targets(repo):
+        if os.path.exists(path):
+            with open(path) as fh:
+                findings += scan_source(fh.read(),
+                                        os.path.relpath(path, repo))
+    return findings
+
+
+def main() -> int:
+    findings = scan()
+    for rel, lineno, msg in findings:
+        print(f"{rel}:{lineno}: {msg}")
+    if findings:
+        print(f"{len(findings)} timing-discipline violation(s); see "
+              f"scripts/check_timing_discipline.py docstring")
+        return 1
+    print("timing-discipline lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,8 +83,16 @@ def test_model_flops_and_flop_report():
     rep = flop_report(100, 1000, 2.0, 32, 7, 500, dense_dist=False,
                       backend="cpu")
     assert rep["flops_per_sec"] > 0 and rep["mfu_pct"] is None
+    # provenance honesty bit (ISSUE 10 satellite): which source produced
+    # the numerator — the analytic model by default, XLA when a measured
+    # count is passed
+    assert rep["flops_provenance"] == "analytic"
+    measured = flop_report(100, 1000, 2.0, 32, 7, 500, dense_dist=False,
+                           backend="cpu", measured_flops=1e9)
+    assert measured["flops_provenance"] == "xla_cost_analysis"
+    assert measured["flops_per_sec"] == round(1e9 / 2.0)
     nulls = {"flops_per_sec": None, "mfu_pct": None,
-             "peak_flops_assumed": False}
+             "peak_flops_assumed": False, "flops_provenance": None}
     assert flop_report(1, 1, None, 32, 7, 500, False, "cpu") == nulls
     assert flop_report(1, 1, 0.0, 32, 7, 500, False, "cpu") == nulls
 

@@ -41,8 +41,23 @@ def test_obs_event_lint_covers_instrumented_seams():
                      "aiyagari_hark_tpu/parallel/sweep.py",
                      "aiyagari_hark_tpu/models/ks_solver.py",
                      "aiyagari_hark_tpu/facade.py",
+                     "aiyagari_hark_tpu/obs/runtime.py",
+                     "aiyagari_hark_tpu/obs/profile.py",
+                     "aiyagari_hark_tpu/obs/regress.py",
                      "bench.py"):
         assert required in rels, required
+
+
+def test_lint_requires_emit_in_new_perf_seams():
+    """The ISSUE 10 dump/flag sites are seam functions: stripping their
+    journal event must be a lint failure, structurally."""
+    mod, _ = _load_lint()
+    assert "dump_flight" in mod.SEAM_DEFS
+    assert "evaluate_history" in mod.SEAM_DEFS
+    findings = mod.scan_source(
+        "def dump_flight(self, reason):\n"
+        "    return write(reason)\n", "fixture.py")
+    assert len(findings) == 1 and "seam function" in findings[0][2]
 
 
 def test_lint_fires_on_unjournaled_typed_raise():

@@ -1,0 +1,427 @@
+"""Performance observability (ISSUE 10, DESIGN §10b): cost ledger,
+roofline taxonomy, per-device telemetry, flight recorder.
+
+Four contracts:
+
+* **Measured cost attribution** — ``CostLedger.capture`` on CPU records
+  XLA's own ``cost_analysis()`` (flops/bytes present,
+  ``cost_source="xla_cost_analysis"``) plus real lowering/compile
+  walls; a backend that cannot serve cost analysis records a REASON,
+  never a crash, and launch aggregation keeps working.
+* **Roofline classification** — the latency/memory/compute table is
+  deterministic and pinned input-by-input.
+* **Bit-identity** — a profiled sweep (``ObsConfig(profile=True)``)
+  produces byte-identical rows/statuses to a plain sweep: capture is an
+  AOT side channel, never a solver-path change.
+* **Flight recorder** — a quarantine-ladder exhaustion dumps the ring
+  atomically (valid JSON, recent events embedded, metrics snapshot
+  attached) and journals exactly one FLIGHT_RECORD_DUMP.
+
+Sweep configs mirror ``tests/test_obs.py`` so this module rides the same
+warm jit caches instead of compiling its own programs.
+"""
+
+import json
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.obs import (
+    ObsConfig,
+    build_obs,
+    read_journal,
+)
+from aiyagari_hark_tpu.obs.profile import (
+    ROOFLINE_COMPUTE,
+    ROOFLINE_LATENCY,
+    ROOFLINE_MEMORY,
+    ROOFLINE_UNKNOWN,
+    CostLedger,
+    DeviceTelemetry,
+    classify_roofline,
+    peak_membw_per_chip,
+)
+from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+from aiyagari_hark_tpu.utils.config import SweepConfig
+from aiyagari_hark_tpu.utils.timing import (
+    Stopwatch,
+    flop_report,
+    record_flop_fields,
+    stopwatch,
+)
+
+# Same cache keys as tests/test_obs.py (its sweep drills).
+KW = dict(a_count=12, dist_count=48, labor_states=4, r_tol=1e-5,
+          max_bisect=30)
+SMALL = SweepConfig(crra_values=(1.0, 5.0), rho_values=(0.0, 0.9),
+                    schedule="balanced", n_buckets=2)
+LOCKSTEP = SweepConfig(crra_values=(1.0, 3.0), rho_values=(0.3, 0.6))
+DRILL_KW = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-5,
+                max_bisect=24)
+
+
+# ---------------------------------------------------------------------------
+# Cost ledger: capture with cost_analysis present.
+# ---------------------------------------------------------------------------
+
+def test_cost_ledger_captures_xla_cost_analysis_on_cpu():
+    ledger = CostLedger(backend="cpu")
+    fn = jax.jit(lambda x: jnp.matmul(
+        x, x, preferred_element_type=jnp.float64))
+    x = jnp.ones((32, 32), dtype=jnp.float64)
+    key = ("test", "matmul", 32)
+    entry = ledger.capture(key, fn, (x,), label="test/matmul32")
+    assert entry.cost_source == "xla_cost_analysis"
+    assert entry.flops is not None and entry.flops > 0
+    assert entry.bytes_accessed is not None and entry.bytes_accessed > 0
+    assert entry.lowering_s is not None and entry.lowering_s >= 0
+    assert entry.compile_s is not None and entry.compile_s > 0
+    # memoized: a second capture is the same entry, not a recompile
+    assert ledger.capture(key, fn, (x,)) is entry
+
+    ledger.record_launch(key, 0.25)
+    ledger.record_launch(key, 0.25)
+    assert entry.launches == 2
+    assert entry.launch_wall_s == pytest.approx(0.5)
+    assert entry.achieved_flops_per_sec() == pytest.approx(
+        entry.flops * 2 / 0.5)
+    assert entry.arithmetic_intensity() == pytest.approx(
+        entry.flops / entry.bytes_accessed)
+
+    snap = ledger.snapshot()
+    json.dumps(snap)            # JSON-able by construction
+    assert snap["executables"] == 1
+    assert snap["launches"] == 2
+    assert snap["measured_flops_total"] == pytest.approx(entry.flops * 2)
+    assert snap["cost_sources"] == {"xla_cost_analysis": 1}
+    assert snap["roofline"] in (ROOFLINE_MEMORY, ROOFLINE_COMPUTE,
+                                ROOFLINE_LATENCY)
+
+
+def test_cost_ledger_records_reason_when_cost_analysis_absent():
+    ledger = CostLedger(backend="cpu")
+
+    class NoAOT:
+        def lower(self, *a):
+            raise NotImplementedError("no AOT path on this backend")
+
+    entry = ledger.capture(("k",), NoAOT(), (), label="broken")
+    assert entry.cost_source.startswith("unavailable: NotImplementedError")
+    assert entry.flops is None and entry.bytes_accessed is None
+    # launch aggregation still works; derived fields stay honest Nones
+    ledger.record_launch(("k",), 1.0)
+    assert entry.launches == 1
+    assert entry.achieved_flops_per_sec() is None
+    snap = ledger.snapshot()
+    assert snap["measured_flops_total"] is None
+    assert snap["roofline"] == ROOFLINE_UNKNOWN
+    assert snap["cost_sources"] == {"unavailable": 1}
+    assert ledger.flops_model_vs_measured_ratio(1e9) is None
+
+
+def test_snapshot_roofline_not_inflated_by_launch_count():
+    """The run-level roofline must classify per-launch work: totals
+    already carry the launch multiplier, and re-multiplying inside the
+    classifier would promote a latency-bound run to memory/compute
+    once it launches often enough (the double-count regression)."""
+    ledger = CostLedger(peak_flops=V5E_FLOPS, peak_bytes_per_s=V5E_BW)
+    key = ("k",)
+    entry = ledger.capture(key, object(), ())     # capture fails ->
+    entry.flops = 1e9                             # synthesize the cost
+    entry.bytes_accessed = 1e7                    # analysis fields
+    entry.cost_source = "xla_cost_analysis"
+    for _ in range(100):
+        ledger.record_launch(key, 0.01)           # total wall 1.0 s
+    # honest achieved = 1e9 * 100 / 1.0 = 1e11; ceiling = AI(100) * bw
+    # ~ 8.2e13 -> util ~ 1.2e-3 << 2% -> latency.  A double count
+    # (x100 again) would read 12% and misclassify as compute/memory.
+    assert entry.roofline(V5E_FLOPS, V5E_BW) == ROOFLINE_LATENCY
+    snap = ledger.snapshot()
+    assert snap["roofline"] == ROOFLINE_LATENCY
+    assert snap["achieved_flops_per_sec"] == pytest.approx(1e11)
+
+
+def test_snapshot_slug_collision_keeps_every_entry():
+    """Two ledger keys sharing a display label (same executable with
+    and without a fault hook) must stay two snapshot entries — the
+    executable-ladder audit cannot silently merge them."""
+    ledger = CostLedger(backend="cpu")
+    fn = jax.jit(lambda x: x * 2.0)
+    x = jnp.ones((4,), dtype=jnp.float64)
+    ledger.capture(("a", None), fn, (x,), label="sweep/cold4")
+    ledger.capture(("a", "nan"), fn, (x,), label="sweep/cold4")
+    ledger.record_launch(("a", None), 0.1)
+    ledger.record_launch(("a", "nan"), 0.2)
+    snap = ledger.snapshot()
+    assert snap["executables"] == 2
+    assert len(snap["entries"]) == 2
+    assert set(snap["entries"]) == {"sweep_cold4", "sweep_cold4_2"}
+    walls = sorted(e["launch_wall_s"] for e in snap["entries"].values())
+    assert walls == [pytest.approx(0.1), pytest.approx(0.2)]
+
+
+def test_cost_ledger_publish_mirrors_registry():
+    from aiyagari_hark_tpu.obs import MetricsRegistry
+
+    ledger = CostLedger(backend="cpu")
+    fn = jax.jit(lambda x: x + 1.0)
+    x = jnp.ones((8,), dtype=jnp.float64)
+    ledger.capture(("k",), fn, (x,), label="test/add")
+    ledger.record_launch(("k",), 0.1)
+    reg = MetricsRegistry()
+    ledger.publish(reg)
+    names = reg.names()
+    assert "aiyagari_profile_executables" in names
+    assert "aiyagari_profile_launch_wall_s_test_add" in names
+    assert reg.gauge("aiyagari_profile_launches").value == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Roofline classification table.
+# ---------------------------------------------------------------------------
+
+V5E_FLOPS, V5E_BW = 197e12, 819e9       # ridge ~240 FLOP/byte
+
+
+@pytest.mark.parametrize("flops,bytes_,wall,launches,pf,pbw,expect", [
+    # no cost analysis / no launches -> unknown
+    (None, 1e6, 1.0, 1, V5E_FLOPS, V5E_BW, ROOFLINE_UNKNOWN),
+    (1e9, None, 1.0, 1, V5E_FLOPS, V5E_BW, ROOFLINE_UNKNOWN),
+    (1e9, 1e6, 1.0, 0, V5E_FLOPS, V5E_BW, ROOFLINE_UNKNOWN),
+    (1e9, 1e6, 0.0, 1, V5E_FLOPS, V5E_BW, ROOFLINE_UNKNOWN),
+    # the measured sweep regime: tiny program, achieved ~1e11 << ceiling
+    # -> latency-bound on the accelerator
+    (1e8, 1e6, 1.0, 1, V5E_FLOPS, V5E_BW, ROOFLINE_LATENCY),
+    # high-AI program achieving ~60% of peak -> compute-bound
+    (1.2e14, 1e9, 1.0, 1, V5E_FLOPS, V5E_BW, ROOFLINE_COMPUTE),
+    # low-AI program saturating ~60% of its bandwidth roof -> memory
+    (5e11, 1e12, 1.0, 1, V5E_FLOPS, V5E_BW, ROOFLINE_MEMORY),
+    # no published peak (CPU): sub-ms per-launch wall -> latency
+    (1e6, 1e6, 5e-4, 1, None, None, ROOFLINE_LATENCY),
+    # no published peak: AI 1000 >= default ridge -> compute
+    (1e9, 1e6, 1.0, 1, None, None, ROOFLINE_COMPUTE),
+    # no published peak: AI 0.2 < default ridge -> memory
+    (2e5, 1e6, 1.0, 1, None, None, ROOFLINE_MEMORY),
+])
+def test_roofline_classification_table(flops, bytes_, wall, launches,
+                                       pf, pbw, expect):
+    assert classify_roofline(flops, bytes_, wall, launches,
+                             peak_flops=pf,
+                             peak_bytes_per_s=pbw) == expect
+
+
+def test_peak_membw_graceful_off_accelerator():
+    assert peak_membw_per_chip("cpu") == (None, False)
+
+
+# ---------------------------------------------------------------------------
+# Profiled sweep: bit-identity + snapshot/journal plumbing.
+# ---------------------------------------------------------------------------
+
+def test_profiled_sweep_bit_identical_and_snapshotted(tmp_path):
+    jp = str(tmp_path / "events.jsonl")
+    obs = build_obs(ObsConfig(enabled=True, profile=True,
+                              journal_path=jp,
+                              trace_path=str(tmp_path / "trace.json")))
+    res_on = run_table2_sweep(SMALL, dtype=jnp.float64, obs=obs, **KW)
+    res_off = run_table2_sweep(SMALL, dtype=jnp.float64, **KW)
+    # the AOT capture is a side channel: bits must not move
+    assert np.array_equal(res_on.r_star_pct, res_off.r_star_pct)
+    assert np.array_equal(res_on.saving_rate_pct, res_off.saving_rate_pct)
+    assert np.array_equal(res_on.status, res_off.status)
+
+    snap = obs.cost_ledger.snapshot()
+    assert snap["executables"] >= 1
+    assert snap["launches"] >= 2            # two buckets minimum
+    assert snap["launch_wall_s"] > 0.0
+    assert snap["cost_sources"].get("xla_cost_analysis", 0) >= 1
+    assert snap["measured_flops_total"] > 0
+    ratio = obs.cost_ledger.flops_model_vs_measured_ratio(1e12)
+    assert ratio is not None and ratio > 0
+
+    obs.close()
+    # exactly one PROFILE_SNAPSHOT journal line, under this run_id
+    snaps = read_journal(jp, run_id=obs.run_id, event="PROFILE_SNAPSHOT")
+    assert len(snaps) == 1
+    assert snaps[0]["executables"] == snap["executables"]
+    # the trace carries counter-track samples for the launches
+    with open(str(tmp_path / "trace.json")) as f:
+        trace = json.load(f)
+    counters = [e for e in trace["traceEvents"] if e.get("ph") == "C"]
+    assert len(counters) >= snap["launches"]
+    # registry mirror landed at close
+    assert "aiyagari_profile_executables" in obs.registry.names()
+    # lane telemetry gauges landed at the bucket seams
+    assert "aiyagari_sweep_bucket_lane_occupancy" in obs.registry.names()
+
+
+@pytest.mark.slow
+def test_profiled_sweep_bit_identical_to_committed_goldens():
+    """Profiling on, the COMMITTED golden cells must come back
+    bit-for-bit (the --profile-smoke acceptance, runnable in-tree; the
+    fast profile pins on/off bit-identity on the small config above)."""
+    golden_path = os.path.join(os.path.dirname(__file__), "data",
+                               "table2_golden_test.json")
+    golden = json.load(open(golden_path))
+    obs = build_obs(ObsConfig(enabled=True, profile=True))
+    res = run_table2_sweep(SweepConfig(), dtype=jnp.float64, obs=obs,
+                           **golden["config"])
+    obs.close()
+    assert np.array_equal(
+        np.asarray(res.r_star_pct),
+        np.asarray(golden["r_star_pct"], dtype=np.float64))
+
+
+# ---------------------------------------------------------------------------
+# Device telemetry: graceful off-TPU.
+# ---------------------------------------------------------------------------
+
+def test_device_telemetry_graceful_on_cpu(tmp_path):
+    jp = str(tmp_path / "events.jsonl")
+    obs = build_obs(ObsConfig(enabled=True, profile=True,
+                              journal_path=jp))
+    n = obs.sample_devices(where="test")
+    # CPU devices expose no memory_stats: zero devices report, nothing
+    # raises, the sample is still counted
+    assert n == 0
+    assert obs.telemetry.samples == 1
+    assert obs.telemetry.devices_without_stats == len(jax.devices())
+    assert read_journal(jp, event="DEVICE_MEM_HIGH_WATER") == []
+    obs.close()
+
+
+def test_device_telemetry_high_water_events_monotone(tmp_path):
+    """With synthetic stats, DEVICE_MEM_HIGH_WATER fires only on a NEW
+    per-device peak — one event per growth, none on flat samples."""
+    jp = str(tmp_path / "events.jsonl")
+    obs = build_obs(ObsConfig(enabled=True, journal_path=jp))
+    tel = DeviceTelemetry()
+
+    class FakeDev:
+        def __init__(self):
+            self.stats = {"bytes_in_use": 100, "peak_bytes_in_use": 100,
+                          "bytes_limit": 1000}
+
+        def memory_stats(self):
+            return self.stats
+
+    dev = FakeDev()
+    import unittest.mock as mock
+    with mock.patch.object(jax, "devices", lambda *a: [dev]):
+        assert tel.sample(obs, where="a") == 1     # first peak: event
+        assert tel.sample(obs, where="b") == 1     # flat: no event
+        dev.stats = dict(dev.stats, bytes_in_use=500,
+                         peak_bytes_in_use=500)
+        tel.sample(obs, where="c")                 # growth: event
+    events = read_journal(jp, event="DEVICE_MEM_HIGH_WATER")
+    assert [e["where"] for e in events] == ["a", "c"]
+    assert events[-1]["bytes"] == 500
+    assert tel.high_water() == {0: 500.0}
+    obs.close()
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder.
+# ---------------------------------------------------------------------------
+
+def test_flight_recorder_dumps_on_quarantine_exhaustion(tmp_path):
+    jp = str(tmp_path / "events.jsonl")
+    fp = str(tmp_path / "flight.json")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        res = run_table2_sweep(
+            LOCKSTEP, dtype=jnp.float64,
+            obs=ObsConfig(enabled=True, journal_path=jp, flight_path=fp),
+            inject_fault={"cell": 1, "at_iter": 1, "mode": "nan"},
+            max_retries=0, **DRILL_KW)
+    assert list(res.failed_cells()) == [1]
+    assert os.path.exists(fp)
+    dump = json.load(open(fp))
+    assert dump["reason"].startswith("aiyagari sweep: 1 cell(s)")
+    assert dump["attrs"]["cells"] == [1]
+    kinds = {e["kind"] for e in dump["entries"]}
+    assert "event" in kinds                 # recent journal events ride
+    assert any(e.get("event") == "BUCKET_LAUNCH"
+               for e in dump["entries"])
+    assert dump["metrics"] is not None      # registry snapshot embedded
+    assert dump["entries_dropped"] == 0
+    # exactly one typed journal line, pointing at the artifact
+    dumps = read_journal(jp, event="FLIGHT_RECORD_DUMP")
+    assert len(dumps) == 1 and dumps[0]["path"] == fp
+
+
+def test_flight_recorder_ring_is_bounded(tmp_path):
+    obs = build_obs(ObsConfig(enabled=True, flight_limit=4,
+                              journal_path=str(tmp_path / "j.jsonl"),
+                              flight_path=str(tmp_path / "f.json")))
+    for i in range(10):
+        obs.event("RUN_START", i=i)         # any typed event will do
+    assert len(obs.flight.entries()) == 4
+    assert obs.flight.dropped == 7          # RUN_START at build + 10 - 4
+    path = obs.dump_flight("test")
+    dump = json.load(open(path))
+    assert len(dump["entries"]) <= 4 + 1    # ring (+ the dump's event)
+    assert dump["entries_dropped"] >= 7
+    obs.close()
+
+
+def test_no_dump_without_quarantine_exhaustion(tmp_path):
+    jp = str(tmp_path / "events.jsonl")
+    fp = str(tmp_path / "flight.json")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        res = run_table2_sweep(
+            LOCKSTEP, dtype=jnp.float64,
+            obs=ObsConfig(enabled=True, journal_path=jp, flight_path=fp),
+            inject_fault={"cell": 1, "at_iter": 1, "mode": "nan"},
+            max_retries=2, **DRILL_KW)
+    # the ladder recovered the cell: no crash artifact, no dump event
+    assert len(res.failed_cells()) == 0
+    assert not os.path.exists(fp)
+    assert read_journal(jp, event="FLIGHT_RECORD_DUMP") == []
+
+
+# ---------------------------------------------------------------------------
+# flop_report provenance (ISSUE 10 satellite) + stopwatch.
+# ---------------------------------------------------------------------------
+
+def test_flop_report_provenance_analytic_vs_measured():
+    analytic = flop_report(100, 1000, 2.0, 32, 7, 500, dense_dist=False,
+                           backend="cpu")
+    assert analytic["flops_provenance"] == "analytic"
+    assert analytic["flops_per_sec"] > 0
+    measured = flop_report(100, 1000, 2.0, 32, 7, 500, dense_dist=False,
+                           backend="cpu", measured_flops=4.0e9)
+    assert measured["flops_provenance"] == "xla_cost_analysis"
+    assert measured["flops_per_sec"] == round(4.0e9 / 2.0)
+    # degenerate wall: nulls, provenance null too (nothing was measured)
+    nulls = flop_report(1, 1, None, 32, 7, 500, False, "cpu")
+    assert nulls == {"flops_per_sec": None, "mfu_pct": None,
+                     "peak_flops_assumed": False,
+                     "flops_provenance": None}
+
+
+def test_record_flop_fields_stamps_prefix():
+    rec = {}
+    out = record_flop_fields(rec, "phase_", 100, 1000, 2.0, 32, 7, 500,
+                             dense_dist=False, backend="cpu",
+                             measured_flops=2.0e9)
+    assert out is rec
+    assert rec["phase_flops_per_sec"] == round(1.0e9)
+    assert rec["phase_flops_provenance"] == "xla_cost_analysis"
+    assert rec["phase_peak_flops_assumed"] is False
+    assert rec["phase_mfu_pct"] is None     # no CPU peak
+
+
+def test_stopwatch_fills_on_exit_and_elapsed_runs():
+    with stopwatch() as sw:
+        inner = sw.elapsed()
+        assert inner >= 0.0
+    assert np.isfinite(sw.seconds) and sw.seconds >= inner
+    direct = Stopwatch()
+    assert direct.elapsed() >= 0.0
+    assert np.isnan(direct.seconds)

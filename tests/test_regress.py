@@ -1,0 +1,198 @@
+"""Bench-regression sentinel (ISSUE 10, ``obs.regress`` +
+``scripts/check_bench_regress.py``).
+
+Pins the acceptance contract:
+
+* the sentinel is CLEAN on the committed BENCH_r*.json history (no
+  REGRESSED finding — the tier-1 gate ``check_bench_regress.main``
+  exits 0);
+* a 20% injected synthetic slowdown MUST flag REGRESSED — both on a
+  stable synthetic history and on a stable metric of the committed
+  history — and journals a typed REGRESSION_FLAGGED event under an
+  active obs scope;
+* severities are ordered OK < NOISE < REGRESSED and the band rules
+  (median-of-last-K baseline, IQR noise band, worse-than-worst-prior
+  gate, 10% actionability line) grade deterministically;
+* the direction-of-goodness table is COMPLETE over every numeric field
+  of every committed bench record (strict resolution never raises).
+"""
+
+import copy
+import json
+import os
+import sys
+
+import pytest
+
+from aiyagari_hark_tpu.obs import ObsConfig, build_obs, read_journal
+from aiyagari_hark_tpu.obs.regress import (
+    DOWN,
+    NEUTRAL,
+    NOISE,
+    OK,
+    REGRESSED,
+    UP,
+    UnknownMetricError,
+    direction_of_goodness,
+    evaluate_history,
+    flatten_record,
+    grade_metric,
+    load_bench_history,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import check_bench_regress  # noqa: E402
+
+
+def _committed():
+    history = load_bench_history(REPO)
+    assert len(history) >= 2, "committed BENCH history went missing"
+    return history
+
+
+# ---------------------------------------------------------------------------
+# Clean on committed history.
+# ---------------------------------------------------------------------------
+
+def test_committed_history_is_clean():
+    report = evaluate_history(_committed())
+    assert report.worst < REGRESSED, report.summary()
+    assert report.regressed() == []
+    # and nothing rode along ungraded
+    assert report.unknown_fields == []
+
+
+def test_check_script_exits_clean_on_committed_history(capsys):
+    assert check_bench_regress.main([]) == 0
+    out = capsys.readouterr().out
+    assert "bench-regress" in out and "REGRESSED" not in out.split("\n")[0]
+
+
+def test_check_script_json_mode(capsys):
+    assert check_bench_regress.main(["--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["worst"] < REGRESSED
+    assert payload["findings"]
+
+
+# ---------------------------------------------------------------------------
+# Injected slowdowns must flag.
+# ---------------------------------------------------------------------------
+
+def test_injected_20pct_slowdown_on_committed_history_flags():
+    history = copy.deepcopy(_committed())
+    # iteration_skew is stable across committed rounds — the 20%
+    # synthetic slowdown drill of the ISSUE 10 acceptance
+    history[-1][1]["iteration_skew"] *= 1.2
+    report = evaluate_history(history)
+    assert report.worst == REGRESSED
+    assert [f.metric for f in report.regressed()] == ["iteration_skew"]
+    finding = report.regressed()[0]
+    assert finding.delta_frac == pytest.approx(0.2, abs=0.05)
+    assert finding.direction == DOWN
+
+
+def test_injected_slowdown_on_synthetic_stable_history_flags():
+    synth = [(f"r{i:02d}", {"value": v})
+             for i, v in enumerate([10.0, 10.1, 9.9, 10.05])]
+    synth.append(("r99", {"value": 12.0}))        # +20% wall
+    report = evaluate_history(synth)
+    assert report.worst == REGRESSED
+    assert report.regressed()[0].metric == "value"
+
+
+def test_improvement_and_noise_grades():
+    # an IMPROVEMENT (wall down) never flags
+    synth = [(f"r{i}", {"value": v}) for i, v in
+             enumerate([10.0, 10.1, 9.9, 8.0])]
+    assert evaluate_history(synth).worst == OK
+    # outside the band but under the 10% actionability line -> NOISE
+    history = copy.deepcopy(_committed())
+    history[-1][1]["iteration_skew"] *= 1.06
+    report = evaluate_history(history)
+    assert report.worst == NOISE
+    assert [f.metric for f in report.noisy()] == ["iteration_skew"]
+
+
+def test_regression_flagged_event_journaled(tmp_path):
+    jp = str(tmp_path / "events.jsonl")
+    obs = build_obs(ObsConfig(enabled=True, journal_path=jp))
+    history = copy.deepcopy(_committed())
+    history[-1][1]["iteration_skew"] *= 1.3
+    with obs.activate():
+        evaluate_history(history)
+    obs.close()
+    events = read_journal(jp, event="REGRESSION_FLAGGED")
+    assert len(events) == 1
+    assert events[0]["metric"] == "iteration_skew"
+    assert events[0]["direction"] == DOWN
+
+
+# ---------------------------------------------------------------------------
+# Grading rules.
+# ---------------------------------------------------------------------------
+
+def test_severity_order_is_total():
+    assert OK < NOISE < REGRESSED
+
+
+def test_grade_metric_rules():
+    priors = [10.0, 10.2, 9.9, 10.1]
+    # inside the band: OK
+    assert grade_metric("x_wall_s", 10.3, priors).severity == OK
+    # beyond band but NOT beyond the worst prior: OK (history already
+    # contained a worse committed value)
+    assert grade_metric("x_wall_s", 10.9,
+                        priors + [11.5]).severity == OK
+    # beyond both, >= 10% -> REGRESSED
+    f = grade_metric("x_wall_s", 12.0, priors)
+    assert f.severity == REGRESSED and f.worst_prior == 10.2
+    # beyond both, < 10% -> NOISE
+    assert grade_metric("x_wall_s", 10.8, priors).severity == NOISE
+    # an UP metric regresses downward
+    assert grade_metric("x_per_sec", 8.0, priors).severity == REGRESSED
+    # neutral metrics never grade
+    assert grade_metric("n_devices", 99.0, priors).severity == OK
+    # insufficient history is OK-with-a-note, never a guess
+    f = grade_metric("x_wall_s", 99.0, [10.0])
+    assert f.severity == OK and "insufficient history" in f.note
+
+
+# ---------------------------------------------------------------------------
+# Direction-of-goodness completeness.
+# ---------------------------------------------------------------------------
+
+def test_direction_table_complete_for_every_committed_numeric_field():
+    seen = 0
+    for _, record in _committed():
+        for field in flatten_record(record):
+            direction = direction_of_goodness(field, strict=True)
+            assert direction in (UP, DOWN, NEUTRAL)
+            seen += 1
+    assert seen > 20    # the committed history really was traversed
+
+
+def test_direction_known_fields_and_nesting():
+    assert direction_of_goodness("value") == DOWN
+    assert direction_of_goodness("vs_baseline") == UP
+    assert direction_of_goodness("mfu_pct") == UP
+    assert direction_of_goodness("last_tpu.compile_s") == DOWN
+    assert direction_of_goodness("egm_gridpoints_per_sec_per_chip") == UP
+    assert direction_of_goodness("r_star_f32_f64_max_bp") == DOWN
+    assert direction_of_goodness("profile_overhead_frac") == DOWN
+
+
+def test_direction_unknown_field_raises_strict_only():
+    with pytest.raises(UnknownMetricError):
+        direction_of_goodness("utterly_unclassifiable_thing",
+                              strict=True)
+    assert direction_of_goodness("utterly_unclassifiable_thing",
+                                 strict=False) == NEUTRAL
+
+
+def test_flatten_record_skips_non_scalars():
+    flat = flatten_record({"a": 1, "b": True, "c": "x", "d": [1, 2],
+                           "e": {"f": 2.5}, "g": None})
+    assert flat == {"a": 1.0, "e.f": 2.5}

@@ -1,0 +1,76 @@
+"""check_timing_discipline lint (ISSUE 10 satellite): ad-hoc
+``time.perf_counter()``/``time.time()`` calls in the hot modules
+(``parallel/``, ``serve/``, ``obs/``, ``models/``) must flow through a
+``Tracer`` span, ``utils.timing.PhaseTimer``, or
+``utils.timing.stopwatch()`` — or carry an explicit ``# timing-ok``
+waiver.  Run in tier-1 so a raw clock pair cannot regress in, with
+fixture tests proving the lint fires on the patterns it guards."""
+
+import importlib.util
+import os
+
+
+def _load_lint():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "check_timing_discipline",
+        os.path.join(repo, "scripts", "check_timing_discipline.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod, repo
+
+
+def test_timing_lint_is_clean():
+    """The hot modules contain no unwaived raw clock calls — failing
+    here, not in code review."""
+    mod, repo = _load_lint()
+    findings = mod.scan(repo)
+    assert findings == [], "\n".join(
+        f"{rel}:{line}: {msg}" for rel, line, msg in findings)
+
+
+def test_timing_lint_covers_hot_modules():
+    mod, repo = _load_lint()
+    rels = {os.path.relpath(t, repo).replace(os.sep, "/")
+            for t in mod.scan_targets(repo)}
+    for required in ("aiyagari_hark_tpu/parallel/sweep.py",
+                     "aiyagari_hark_tpu/serve/service.py",
+                     "aiyagari_hark_tpu/serve/loadgen.py",
+                     "aiyagari_hark_tpu/obs/trace.py",
+                     "aiyagari_hark_tpu/models/ks_solver.py"):
+        assert required in rels, required
+    # utils/ is deliberately OUT of scope: utils/timing.py is the
+    # blessed substrate the rule routes callers through
+    assert not any(r.startswith("aiyagari_hark_tpu/utils/")
+                   for r in rels)
+
+
+def test_lint_fires_on_raw_clock_calls():
+    mod, _ = _load_lint()
+    findings = mod.scan_source(
+        "import time\n"
+        "t0 = time.perf_counter()\n"
+        "work()\n"
+        "wall = time.time() - t0\n", "fixture.py")
+    assert [line for _, line, _ in findings] == [2, 4]
+    assert "stopwatch" in findings[0][2]
+
+
+def test_lint_accepts_waivers_and_clock_references():
+    mod, _ = _load_lint()
+    findings = mod.scan_source(
+        "import time\n"
+        "t0 = time.perf_counter()   # timing-ok: substrate primitive\n"
+        "def f(clock=time.perf_counter):\n"     # reference, not a call
+        "    return clock\n"
+        "g = dict(clock=time.time)\n", "fixture.py")
+    assert findings == []
+
+
+def test_lint_ignores_docstrings_and_comments():
+    mod, _ = _load_lint()
+    findings = mod.scan_source(
+        '"""Prose about time.perf_counter() pairs."""\n'
+        "# a comment about time.time() too\n"
+        "x = 1\n", "fixture.py")
+    assert findings == []
