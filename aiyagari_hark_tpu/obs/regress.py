@@ -65,6 +65,16 @@ DIRECTION_EXPLICIT: Dict[str, str] = {
     "lanes": NEUTRAL,
     "backend_attempts": NEUTRAL,
     "exact_bits": NEUTRAL,
+    # multi-chip scaling leg (ISSUE 11, bench --chips-scaling): the
+    # device-count-suffixed speedups defeat the _speedup suffix rule
+    # (they end in _Ndev), so they are declared here — the sentinel
+    # grades the chips_* record from its first committed round instead
+    # of raising unclassified.  chips_cells_per_sec_{N}dev needs no
+    # entry: the 'cells_per_sec' affix rule already resolves it UP.
+    "chips_speedup_2dev": UP,
+    "chips_speedup_4dev": UP,
+    "chips_speedup_8dev": UP,
+    "chips_mem_stats_devices": NEUTRAL,
 }
 
 # Suffix/affix rules, first match wins.  Kept coarse on purpose: bench

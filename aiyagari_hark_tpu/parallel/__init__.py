@@ -3,7 +3,17 @@ and device-sharded agent panels (SURVEY.md §2.4's latent axes made
 first-class)."""
 
 from . import multihost
-from .mesh import balanced_lane_order, make_mesh, pad_to_multiple, sharding
+from .mesh import (
+    balanced_lane_order,
+    cells_mesh,
+    make_mesh,
+    mesh_axis_size,
+    pad_to_multiple,
+    resolve_mesh,
+    shard_map_compat,
+    sharded_launcher,
+    sharding,
+)
 from .panel import initial_panel_sharded, simulate_panel_sharded
 from .sweep import (
     ScenarioSweepResult,
@@ -13,7 +23,9 @@ from .sweep import (
 )
 
 __all__ = [
-    "balanced_lane_order", "make_mesh", "pad_to_multiple", "sharding",
+    "balanced_lane_order", "cells_mesh", "make_mesh", "mesh_axis_size",
+    "pad_to_multiple", "resolve_mesh", "shard_map_compat",
+    "sharded_launcher", "sharding",
     "initial_panel_sharded", "simulate_panel_sharded",
     "ScenarioSweepResult", "SweepResult", "run_sweep",
     "run_table2_sweep",

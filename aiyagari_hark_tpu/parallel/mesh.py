@@ -1,4 +1,5 @@
-"""Device-mesh helpers.
+"""Device-mesh helpers: mesh construction, the ``shard_map`` version shim,
+and the sharded-launch wrapper every multi-chip dispatch rides (ISSUE 11).
 
 The reference is single-process NumPy (SURVEY.md §2.4); its latent parallel
 axes are the calibration sweep (embarrassingly parallel — the domain's "data
@@ -10,12 +11,23 @@ Here those become named axes of a ``jax.sharding.Mesh``:
   * ``"agents"`` — the simulated household panel; each period ends in a
     cross-shard mean (``psum`` over ICI).
 
+``sharded_launcher`` is the ONE way a batched per-lane program (the sweep's
+``_batched_solver`` family, the serve batcher's flush executable) goes
+multi-chip: ``jit(shard_map(fn))`` over the lane axis, each device running
+the identical per-lane code on its contiguous lane block with NO cross-device
+traffic until the output gather — manual SPMD, so GSPMD cannot invent
+collectives inside the while loops.  Memoized per (fn, mesh, axis) so a
+warmed process owns ONE sharded executable per underlying program, exactly
+the shared-executable discipline of the 1-device paths.
+
 Multi-chip hardware is exercised through ``--xla_force_host_platform_device_count``
-virtual CPU devices in tests and through the driver's ``dryrun_multichip``.
+virtual CPU devices in tests/bench (``utils.backend.force_cpu_platform``)
+and through the driver's ``dryrun_multichip``.
 """
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Optional, Sequence
 
 import jax
@@ -53,9 +65,106 @@ def make_mesh(axis_names: Sequence[str] = ("cells",),
     return Mesh(grid, tuple(axis_names))
 
 
+def cells_mesh(devices=None, axis: str = "cells") -> Mesh:
+    """One-axis mesh over ALL local devices (default) — the sweep/serve
+    scale-out mesh (ISSUE 11).  On a TPU slice these are the real chips;
+    on a host forced to N virtual CPU devices
+    (``utils.backend.force_cpu_platform(n)``) they are the CPU smoke's
+    stand-ins.  ``cells_mesh()`` on a 1-device host is a valid (trivial)
+    mesh, so callers can pass it unconditionally."""
+    return make_mesh((axis,), devices=devices)
+
+
 def sharding(mesh: Mesh, *spec) -> NamedSharding:
     """``NamedSharding(mesh, PartitionSpec(*spec))`` shorthand."""
     return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """``shard_map`` across jax versions — THE one spelling of the shim
+    (ISSUE 11 satellite; previously private to ``parallel.panel``): the
+    top-level ``jax.shard_map`` (with ``check_vma``) landed after 0.4.x;
+    older jaxlibs ship it as ``jax.experimental.shard_map.shard_map``
+    (with ``check_rep``).  The replication check is disabled in both
+    spellings: the panel's per-period ``pmean`` already replicates its
+    aggregates by construction, and the sweep/serve launchers have no
+    replicated outputs at all (every output is lane-sharded)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
+
+
+def lane_specs(axis: str = "cells") -> PartitionSpec:
+    """The batch-axis partition spec shared by every per-lane argument
+    and output of a sharded launch: ``PartitionSpec(axis)`` used as a
+    pytree PREFIX, so a rank-1 lane array shards its only dim and the
+    packed ``[B, W]`` output shards its leading dim with the row
+    replicated (the SNIPPETS [1] partition-rule pattern, collapsed to
+    the one rule this program family needs: everything is lane-major)."""
+    return PartitionSpec(axis)
+
+
+@lru_cache(maxsize=None)
+def sharded_launcher(fn, mesh: Mesh, axis: str = "cells"):
+    """``jit(shard_map(fn))`` over the lane axis — the multi-chip launch
+    wrapper for a batched per-lane program (ISSUE 11 tentpole).
+
+    ``fn`` is a jitted vmapped ``(*per_lane_args) -> [B, W]`` program
+    whose per-lane bits are independent of batch size, lane position, and
+    batchmates (the packing-independence contract the sweep and serving
+    layers property-test).  Each device therefore runs the IDENTICAL
+    per-lane code on its contiguous ``B / n_devices`` lane block and the
+    only cross-device traffic is the final output gather — which is what
+    makes "sharded == 1-device bit-for-bit" a theorem about placement,
+    not a numerical accident.  Every lane argument must have leading dim
+    divisible by ``mesh.shape[axis]`` (pad with ``pad_to_multiple`` /
+    the bucket planner's device-multiple padding first).
+
+    Memoized per (fn, mesh, axis): ``fn`` comes from a memoized factory
+    (``Scenario.batched_solver``) and ``Mesh`` hashes by device grid +
+    axis names, so repeated launches — every bucket of a scheduled
+    sweep, every warmed serve flush — reuse ONE wrapped executable and a
+    replayed workload performs ZERO new XLA compiles."""
+    spec = lane_specs(axis)
+    return jax.jit(shard_map_compat(fn, mesh, in_specs=spec,
+                                    out_specs=spec))
+
+
+def mesh_axis_size(mesh: Optional[Mesh], axis: str) -> int:
+    """Shard count of ``axis`` (1 for no mesh or an absent axis) — the
+    one spelling of "how many ways is the lane axis split" shared by the
+    sweep's bucket padding, the serve ladder rounding, and the resume
+    ledger's mesh fingerprint."""
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get(axis, 1))
+
+
+def resolve_mesh(mesh, axis: str = "cells") -> Optional[Mesh]:
+    """The ONE spelling of the ``mesh=`` argument contract shared by
+    ``run_sweep`` and ``EquilibriumService`` (ISSUE 11): ``None`` stays
+    unsharded, ``"auto"`` builds the all-local-device lane mesh
+    (trivially None on a 1-device host), any other string raises typed,
+    and a real ``Mesh`` must actually DEFINE ``axis`` — a mesh without
+    the lane axis would otherwise silently resolve to shard count 1 and
+    run unsharded while the caller believes it is scaled out."""
+    if mesh is None:
+        return None
+    if isinstance(mesh, str):
+        if mesh != "auto":
+            raise ValueError(f"mesh must be a Mesh, None, or 'auto', "
+                             f"got {mesh!r}")
+        return cells_mesh(axis=axis) if len(jax.devices()) > 1 else None
+    if axis not in mesh.shape:
+        raise ValueError(
+            f"mesh axes {tuple(mesh.shape)} do not define the lane axis "
+            f"{axis!r}; build one with cells_mesh(axis={axis!r}) or "
+            f"make_mesh(({axis!r},), ...)")
+    return mesh
 
 
 def balanced_lane_order(work, n_shards: int) -> np.ndarray:

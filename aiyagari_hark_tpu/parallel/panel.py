@@ -17,21 +17,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.ks_model import KSCalibration, KSPolicy
 from ..models.simulate import PanelState, initial_panel, simulate_panel
+from .mesh import shard_map_compat
 
-
-def _shard_map(fn, mesh, in_specs, out_specs):
-    """``shard_map`` across jax versions: the top-level ``jax.shard_map``
-    (with ``check_vma``) landed after 0.4.x; older jaxlibs ship it as
-    ``jax.experimental.shard_map.shard_map`` (with ``check_rep``).  The
-    replication check is disabled in both spellings — the per-period
-    ``pmean`` already replicates the aggregates by construction."""
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
-    from jax.experimental.shard_map import shard_map
-
-    return shard_map(fn, mesh=mesh, in_specs=in_specs,
-                     out_specs=out_specs, check_rep=False)
+# The version shim lives in ``mesh.shard_map_compat`` now (ISSUE 11
+# satellite: one shim, shared by panel/sweep/serve); the private name
+# stays for existing callers.
+_shard_map = shard_map_compat
 
 
 def initial_panel_sharded(cal: KSCalibration, agent_count: int,

@@ -35,7 +35,6 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh
 
 from ..models.equilibrium import solve_calibration_lean
 from ..obs.runtime import NULL_OBS, resolve_obs
@@ -63,7 +62,14 @@ from ..utils.resilience import (
     raise_if_interrupted,
     retry_transient,
 )
-from .mesh import balanced_lane_order, pad_to_multiple, sharding
+from .mesh import (
+    balanced_lane_order,
+    mesh_axis_size,
+    pad_to_multiple,
+    resolve_mesh,
+    sharded_launcher,
+    sharding,
+)
 
 
 @dataclass
@@ -451,13 +457,25 @@ def dyadic_bracket(r_lo, r_hi, target: float, margin: float,
     return lo, hi, levels
 
 
-def _plan_buckets(order: np.ndarray, n_buckets: int):
+def _plan_buckets(order: np.ndarray, n_buckets: int, n_shards: int = 1):
     """Split the work-sorted cell order into equal-size contiguous buckets
     (cheapest first).  0 = auto: ~C/3 buckets capped at 8 — small enough
     buckets to homogenize work, few enough launches to keep dispatch
-    overhead negligible."""
+    overhead negligible.  On a multi-device mesh the auto plan
+    additionally keeps bucket size >= the device count (ISSUE 11):
+    every bucket pads up to a device multiple, so a 3-cell bucket on an
+    8-way mesh would launch 8 lanes to solve 3 — padding waste the
+    planner, which knows both numbers, must not create.  An EXPLICIT
+    ``n_buckets`` is honored as given.  NOTE the bit-identity interplay
+    (DESIGN §6b): a mesh-dependent plan regroups cells, which on the
+    default cold-bracket path changes nothing per lane, but under
+    ``warm_brackets=True`` changes which already-solved neighbors seed
+    which cells — warm sweeps carry the verified-seed tolerance
+    contract across mesh geometries, not bitwise identity."""
     n = len(order)
     k = n_buckets if n_buckets > 0 else max(1, min(8, n // 3))
+    if n_buckets <= 0 and n_shards > 1:
+        k = max(1, min(k, n // n_shards))
     k = min(k, n)
     size = -(-n // k)
     return [order[i * size:(i + 1) * size]
@@ -596,9 +614,9 @@ def _solve_scheduled(scn, sweep: SweepConfig, cells_p, cells_nom,
     if ledger is not None:
         ledger.pred = np.asarray(pred, dtype=np.float64)
     order = np.argsort(pred, kind="stable")
-    buckets, size = _plan_buckets(order, sweep.n_buckets)
-
-    n_shards = 1 if mesh is None else mesh.shape[axis]
+    n_shards = mesh_axis_size(mesh, axis)
+    buckets, size = _plan_buckets(order, sweep.n_buckets,
+                                  n_shards=n_shards)
     b_pad = size + (-size % n_shards)
     shard = None if mesh is None else sharding(mesh, axis)
 
@@ -697,6 +715,14 @@ def _solve_scheduled(scn, sweep: SweepConfig, cells_p, cells_nom,
 
         warm = seeds is not None
         fn = scn.batched_solver(dtype, kwargs_items, fault_mode, warm)
+        if n_shards > 1:
+            # multi-chip launch (ISSUE 11): jit(shard_map(fn)) over the
+            # lane axis — each device runs the identical per-lane program
+            # on its contiguous lane block (the LPT layout above placed
+            # work-balanced blocks), no cross-device traffic until the
+            # output gather.  Memoized: every bucket reuses ONE wrapped
+            # executable per (fn, mesh).
+            fn = sharded_launcher(fn, mesh, axis)
         args = [jnp.asarray(cells_p[lanes, j], dtype=dtype)
                 for j in range(cells_p.shape[1])]
         if warm:
@@ -715,9 +741,10 @@ def _solve_scheduled(scn, sweep: SweepConfig, cells_p, cells_nom,
         if prof is not None:
             flavor = "warm" if warm else "cold"
             prof_key = ("sweep", scn.name, prof_wf, flavor, b_pad,
-                        fault_mode)
+                        fault_mode, n_shards)
             prof.capture(prof_key, fn, args,
-                         label=f"sweep/{scn.name}/{flavor}{b_pad}")
+                         label=f"sweep/{scn.name}/{flavor}{b_pad}"
+                               + (f"x{n_shards}" if n_shards > 1 else ""))
         with obs.span("sweep/bucket", bucket=int(bi),
                       cells=len(bucket), lanes=len(lanes), warm=warm,
                       device_profile=True) as bsp:
@@ -949,7 +976,7 @@ class ScenarioSweepResult:
 
 
 def run_sweep(scenario, sweep: SweepConfig = SweepConfig(),
-              cells=None, mesh: Optional[Mesh] = None, axis: str = "cells",
+              cells=None, mesh=None, axis: str = "cells",
               dtype=None, timer=None, perturb: float = 0.0,
               quarantine: bool = True, max_retries: int = 3,
               inject_fault: Optional[dict] = None,
@@ -974,7 +1001,24 @@ def run_sweep(scenario, sweep: SweepConfig = SweepConfig(),
     ``sweep.cells()`` — the (σ, ρ, sd) lattice every built-in family
     sweeps).  Scenario identity keys every fingerprint (sidecar, resume
     ledger, SDC sample, certification), so artifacts can never cross
-    model families."""
+    model families.
+
+    ``mesh`` (ISSUE 11): a ``jax.sharding.Mesh`` shards the lane axis
+    over ``axis`` via the ``mesh.sharded_launcher`` shard_map wrapper —
+    every bucket padded to a device multiple, per-device work balanced
+    by the LPT lane layout, and (on the default cold-bracket path) the
+    root/status/counter/mask columns bit-identical to the 1-device run
+    (property-tested; the one aggregate contraction — capital — agrees
+    to reduction-order noise across program widths, DESIGN §6b).  With
+    ``warm_brackets=True`` the mesh-AWARE auto bucket plan may group
+    cells differently than a 1-device run, changing which neighbors
+    seed which cells — warm sweeps keep only their usual verified-seed
+    tolerance contract across mesh geometries, exactly as they already
+    do across schedules.  ``"auto"`` builds a ``cells`` mesh over all
+    local devices (None on a 1-device host); ``None`` (default) runs
+    unsharded.  The mesh shape is hashed into the resume-ledger
+    fingerprint, so an N-device ledger refuses-to-resume under M
+    devices (warn + recompute)."""
     from ..scenarios.registry import get_scenario
 
     scn = get_scenario(scenario)
@@ -1031,6 +1075,10 @@ def _run_sweep_impl(scn, sweep, cells_nom, mesh, axis, dtype, timer,
     schema = scn.schema
     status_col = schema.idx(schema.status)
     root_col = schema.idx(schema.root)
+    # mesh contract (ISSUE 11): "auto" = all local devices (None on a
+    # 1-device host); a real Mesh must define the lane axis — one rule,
+    # shared with EquilibriumService (mesh.resolve_mesh)
+    mesh = resolve_mesh(mesh, axis)
     cells_p = np.array(cells_nom, dtype=np.float64)   # solver inputs
     if perturb:
         cells_p[:, scn.cells.perturb_axis] = (
@@ -1098,7 +1146,8 @@ def _run_sweep_impl(scn, sweep, cells_nom, mesh, axis, dtype, timer,
             cells_p, kwargs_items, dtype, schedule,
             sweep.n_buckets, sweep.warm_brackets, sweep.warm_margin,
             fault_mode, fault_iters, max_retries, quarantine, side,
-            scenario=scn.name, row_fields=schema.fields)
+            scenario=scn.name, row_fields=schema.fields,
+            mesh_shards=mesh_axis_size(mesh, axis))
         ledger = LedgerState.resume(resume_path, ledger_fp, n_orig,
                                     width=schema.width)
 
@@ -1122,9 +1171,9 @@ def _run_sweep_impl(scn, sweep, cells_nom, mesh, axis, dtype, timer,
         wall = 0.0
         sl = slice(0, n_orig)
     else:
+        n_shards = mesh_axis_size(mesh, axis)
         if mesh is not None:
             shard = sharding(mesh, axis)
-            n_shards = mesh.shape[axis]
             cols = []
             for j in range(cells_p.shape[1]):
                 col_d, _ = pad_to_multiple(cells_p[:, j], n_shards)
@@ -1146,6 +1195,11 @@ def _run_sweep_impl(scn, sweep, cells_nom, mesh, axis, dtype, timer,
                        else jnp.asarray(fault_iters))
 
         fn = scn.batched_solver(dtype, kwargs_items, fault_mode, False)
+        if n_shards > 1:
+            # multi-chip lock-step launch (ISSUE 11): same shard_map
+            # wrapper as the scheduled path — one padded launch, each
+            # device solving its lane block, gather at the end
+            fn = sharded_launcher(fn, mesh, axis)
         args = tuple(cols) if fault_d is None else (*cols, fault_d)
         prof = obs.cost_ledger
         prof_key = None
@@ -1154,9 +1208,10 @@ def _run_sweep_impl(scn, sweep, cells_nom, mesh, axis, dtype, timer,
             prof_key = ("sweep", scn.name,
                         _work_fingerprint(kwargs_items, dtype,
                                           scenario=scn.name),
-                        "cold", shape0, fault_mode)
+                        "cold", shape0, fault_mode, n_shards)
             prof.capture(prof_key, fn, args,
-                         label=f"sweep/{scn.name}/cold{shape0}")
+                         label=f"sweep/{scn.name}/cold{shape0}"
+                               + (f"x{n_shards}" if n_shards > 1 else ""))
         with obs.span("sweep/bucket", bucket=0, cells=n_orig,
                       warm=False, device_profile=True) as bsp:
             packed, wall = _timed_launch(       # [C, W], one transfer
@@ -1481,7 +1536,7 @@ def _run_sweep_impl(scn, sweep, cells_nom, mesh, axis, dtype, timer,
 
 
 def run_table2_sweep(sweep: SweepConfig = SweepConfig(),
-                     mesh: Optional[Mesh] = None, axis: str = "cells",
+                     mesh=None, axis: str = "cells",
                      dtype=None, timer=None, perturb: float = 0.0,
                      quarantine: bool = True, max_retries: int = 3,
                      inject_fault: Optional[dict] = None,

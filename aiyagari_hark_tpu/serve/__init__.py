@@ -9,7 +9,12 @@ mechanism, cold misses micro-batched onto a fixed ladder of executable
 shapes shared with the sweep's compiled cell solver.
 """
 
-from .batcher import MicroBatcher, ServeQueueFull, default_ladder  # noqa: F401
+from .batcher import (  # noqa: F401
+    MicroBatcher,
+    ServeQueueFull,
+    default_ladder,
+    shard_ladder,
+)
 from .loadgen import (  # noqa: F401
     Arrival,
     LoadReport,

@@ -63,6 +63,22 @@ def default_ladder(max_batch: int) -> Tuple[int, ...]:
     return tuple(ladder)
 
 
+def shard_ladder(ladder: Tuple[int, ...], multiple: int) -> Tuple[int, ...]:
+    """Round every ladder shape up to a multiple of ``multiple`` (the
+    lane-axis device count) and dedupe, preserving order by size — the
+    multi-chip ladder (ISSUE 11): every flush shape divides evenly
+    across the mesh, so a sharded launch never needs a second padding
+    pass and a warmed multi-chip service still owns ONE executable per
+    (rounded) ladder shape per solver group.  ``multiple=1`` is the
+    identity."""
+    if multiple < 1:
+        raise ValueError(f"shard multiple must be >= 1, got {multiple}")
+    if multiple == 1:
+        return tuple(ladder)
+    return tuple(sorted({-(-int(s) // multiple) * multiple
+                         for s in ladder}))
+
+
 class MicroBatcher:
     """Collects requests per group behind a bounded queue and releases
     them as ladder-shaped batches on size or deadline."""
@@ -70,9 +86,16 @@ class MicroBatcher:
     def __init__(self, max_batch: int = 8, max_wait_s: float = 0.002,
                  max_queue: int = 1024,
                  ladder: Optional[Tuple[int, ...]] = None,
-                 clock=time.monotonic, priority_of=None):
-        self.ladder = (default_ladder(max_batch) if ladder is None
-                       else tuple(sorted(set(int(s) for s in ladder))))
+                 clock=time.monotonic, priority_of=None,
+                 shard_multiple: int = 1):
+        # shard_multiple (ISSUE 11): the lane-axis device count — every
+        # ladder shape rounds UP to a multiple so flushes dispatch
+        # evenly across a mesh (1 = unsharded, the identity)
+        self.shard_multiple = int(shard_multiple)
+        self.ladder = shard_ladder(
+            default_ladder(max_batch) if ladder is None
+            else tuple(sorted(set(int(s) for s in ladder))),
+            self.shard_multiple)
         if not self.ladder or self.ladder[0] < 1:
             raise ValueError(f"invalid ladder {self.ladder}")
         self.max_batch = self.ladder[-1]
@@ -132,7 +155,7 @@ class MicroBatcher:
         advances must not block a caller forever)."""
         t0 = self.clock()
         real_deadline = (None if timeout is None
-                         else time.monotonic() + timeout)
+                         else time.monotonic() + timeout)  # timing-ok: real-time backstop, not a measured wall
         with self._cond:
             while self._depth >= self.max_queue:
                 if not block:
@@ -140,7 +163,7 @@ class MicroBatcher:
                         f"serving queue at capacity ({self.max_queue})")
                 if timeout is not None:
                     clock_left = timeout - (self.clock() - t0)
-                    real_left = real_deadline - time.monotonic()
+                    real_left = real_deadline - time.monotonic()  # timing-ok: backstop deadline check
                     if clock_left <= 0 or real_left <= 0:
                         raise self._full_error(
                             f"serving queue still at capacity "
@@ -265,7 +288,8 @@ class MicroBatcher:
         return the due batches; ``[]`` on timeout.  The worker thread's
         wait primitive — uses the injected clock only for deadlines, real
         time for the condition wait."""
-        end = None if timeout is None else time.monotonic() + timeout
+        end = (None if timeout is None
+               else time.monotonic() + timeout)  # timing-ok: worker real-time wait bound
         with self._cond:
             while True:
                 ready = self.pop_ready()
@@ -276,7 +300,7 @@ class MicroBatcher:
                 if nd is not None:
                     wait = max(0.0, nd - self.clock())
                 if end is not None:
-                    remaining = end - time.monotonic()
+                    remaining = end - time.monotonic()  # timing-ok: wait bound, not a wall
                     if remaining <= 0:
                         return []
                     wait = remaining if wait is None else min(wait,

@@ -409,7 +409,17 @@ class EquilibriumService:
     ``inject_corrupt_lane={"at_launch": k, "lane": j, "field": f,
     "amplitude": a}`` deterministically corrupts one solved lane of the
     k-th launch post-solve, pre-certification (tests only) — the serve
-    path's silent-data-corruption drill."""
+    path's silent-data-corruption drill.
+
+    Multi-chip (ISSUE 11): ``mesh`` (a ``jax.sharding.Mesh``, or
+    ``"auto"`` for one ``cells`` mesh over all local devices) shards
+    every cold-miss flush over ``mesh_axis`` — the ladder rounds up to
+    per-device multiples (``shard_ladder``) and launches ride the same
+    memoized ``parallel.mesh.sharded_launcher`` shard_map wrapper as
+    sweep buckets, so served answers match the 1-device path (bitwise on
+    root/status/counters; the aggregate contraction to reduction-order
+    noise, DESIGN §6b) and exact replay still performs zero new XLA
+    compiles."""
 
     def __init__(self, store: Optional[SolutionStore] = None,
                  capacity: int = 256, disk_path: Optional[str] = None,
@@ -424,7 +434,18 @@ class EquilibriumService:
                  certify_before_cache: bool = False,
                  cert_thresholds=None,
                  inject_corrupt_lane: Optional[dict] = None,
-                 obs=None, admission=None):
+                 obs=None, admission=None,
+                 mesh=None, mesh_axis: str = "cells"):
+        # Multi-chip mesh contract FIRST (ISSUE 11): resolve_mesh raises
+        # typed on a mesh without the lane axis, and that must happen
+        # before this constructor acquires anything that needs closing
+        # (an owned obs bundle, the store's disk handle) — a rejected
+        # misconfiguration must not leak resources.
+        from ..parallel.mesh import mesh_axis_size, resolve_mesh
+
+        self._mesh = resolve_mesh(mesh, str(mesh_axis))
+        self._mesh_axis = str(mesh_axis)
+        self._mesh_shards = mesh_axis_size(self._mesh, self._mesh_axis)
         # Observability (ISSUE 7, DESIGN §10): an ObsConfig builds a
         # bundle owned (and closed) by this service; a shared Obs
         # correlates serving with a caller's wider run.  The store
@@ -444,12 +465,21 @@ class EquilibriumService:
         self._corrupt_lane = (dict(inject_corrupt_lane)
                               if inject_corrupt_lane is not None else None)
         self._launch_count = 0
+        # Multi-chip serving (ISSUE 11): with a mesh, cold-miss flushes
+        # pad to per-device multiples (the batcher's ladder rounds up to
+        # shard multiples) and dispatch through the same memoized
+        # jit(shard_map) wrapper the sweep launches ride
+        # (``parallel.mesh.sharded_launcher``) — a warmed multi-chip
+        # service still owns ONE executable per ladder shape per solver
+        # group, and exact replay performs zero new XLA compiles.
         self.batcher = MicroBatcher(max_batch=max_batch,
                                     max_wait_s=max_wait_s,
                                     max_queue=max_queue, ladder=ladder,
                                     clock=clock,
-                                    priority_of=lambda p: p.query.priority)
+                                    priority_of=lambda p: p.query.priority,
+                                    shard_multiple=self._mesh_shards)
         # Overload layer (ISSUE 8, DESIGN §11): an AdmissionPolicy turns
+        # (the mesh was resolved at the top of __init__, pre-resources)
         # saturation into typed, observable behavior — weighted
         # per-class occupancy with fail-fast Overloaded rejection,
         # priority shedding, degraded neighbor answers past the pressure
@@ -948,6 +978,19 @@ class EquilibriumService:
             args.append(jnp.asarray(np.asarray(fault, dtype=np.int32)))
         fn = scn.batched_solver(dtype, kwargs_items, self._fault_mode,
                                 host is not None)
+        if self._mesh_shards > 1:
+            # multi-chip flush (ISSUE 11): the ladder shape divides the
+            # mesh (shard_ladder rounding), so one shard_map-wrapped
+            # launch of the shared executable dispatches the batch
+            # across every device — same wrapper, same memoization, as
+            # the sweep's bucket launches
+            import jax
+
+            from ..parallel.mesh import sharded_launcher, sharding
+
+            fn = sharded_launcher(fn, self._mesh, self._mesh_axis)
+            shard = sharding(self._mesh, self._mesh_axis)
+            args = [jax.device_put(a, shard) for a in args]
 
         # measured cost attribution (ISSUE 10): same compile-cache
         # keying as the sweep's ledger — a warmed service owns one
@@ -960,9 +1003,12 @@ class EquilibriumService:
             prof_key = ("serve", scn.name,
                         work_fingerprint(kwargs_items, dtype,
                                          scenario=scn.name),
-                        flavor, shape, self._fault_mode)
+                        flavor, shape, self._fault_mode,
+                        self._mesh_shards)
             prof.capture(prof_key, fn, args,
-                         label=f"serve/{scn.name}/{flavor}{shape}")
+                         label=f"serve/{scn.name}/{flavor}{shape}"
+                               + (f"x{self._mesh_shards}"
+                                  if self._mesh_shards > 1 else ""))
 
         t_launch = self._clock()
         try:

@@ -216,7 +216,7 @@ def ledger_fingerprint(cells, kwargs_items: tuple, dtype,
                        warm_margin: float, fault_mode, fault_iters,
                        max_retries: int, quarantine: bool,
                        sidecar, scenario: str = DEFAULT_SCENARIO,
-                       row_fields=None) -> int:
+                       row_fields=None, mesh_shards: int = 1) -> int:
     """Validity key of the sweep resume ledger (``resilience.SweepLedger``):
     everything that shapes the result bits — the scenario, cells (perturb
     included; a ``[C, k]`` array), solver kwargs, dtype, schedule knobs,
@@ -226,7 +226,14 @@ def ledger_fingerprint(cells, kwargs_items: tuple, dtype,
     scenario's ``RowSchema.fields``; None resolves the registered
     scenario's): a ledger written under an older row layout must refuse
     to resume instead of feeding wrong-shaped rows into a restarted
-    sweep."""
+    sweep.
+
+    ``mesh_shards`` is the lane-axis device count the sweep ran under
+    (ISSUE 11): the per-lane BITS are mesh-independent (property-tested),
+    but the bucket padding and lane layout are not, so a ledger written
+    on an N-device mesh refuses-to-resume (typed warn + recompute) under
+    an M-device mesh instead of silently restoring rows whose launch
+    geometry the restarted run cannot reproduce."""
     if row_fields is None:
         from ..scenarios.registry import get_scenario
 
@@ -238,5 +245,5 @@ def ledger_fingerprint(cells, kwargs_items: tuple, dtype,
         schedule, int(n_buckets), bool(warm_brackets),
         float(warm_margin), str(fault_mode),
         "none" if fault_iters is None else fault_iters,
-        int(max_retries), bool(quarantine),
+        int(max_retries), bool(quarantine), int(mesh_shards),
         *(tuple(sidecar) if sidecar is not None else ("no-sidecar",)))

@@ -2150,6 +2150,159 @@ def _load_smoke() -> dict:
     return record
 
 
+# Chips-scaling smoke (ISSUE 11): the multi-chip tentpole, measured — the
+# same balanced sweep dispatched through the shard_map launcher at mesh
+# sizes 1/2/4/8 ('cells' axis), on real chips when an accelerator answers
+# the probe and on forced host-platform CPU devices otherwise
+# (utils.backend.force_cpu_platform — the committed MULTICHIP dryruns'
+# device source).  24 cells (both Table II sd panels) so an 8-way mesh
+# still holds 3 real lanes per device.
+CHIPS_MESH_SIZES = (1, 2, 4, 8)
+CHIPS_SMOKE_KWARGS = dict(a_count=10, dist_count=32, labor_states=3,
+                          r_tol=1e-5, max_bisect=24)
+
+
+def _chips_scaling() -> dict:
+    """The ``--chips-scaling`` acceptance run (ISSUE 11): cells/sec for
+    the balanced 24-cell sweep at mesh sizes 1/2/4/8, every sharded
+    result bit-compared against the 1-device-mesh run (values, statuses,
+    counters), per-device predicted-work skew and ``DeviceTelemetry``
+    memory gauges recorded, and the scalar ``chips_*`` fields graded by
+    the bench-regression sentinel from their first committed record
+    (``obs.regress.DIRECTION_EXPLICIT`` knows them)."""
+    import numpy as np
+
+    ambient = _probe_default_backend()
+    forced_host = ambient is None or ambient == "cpu"
+    if forced_host:
+        from aiyagari_hark_tpu.utils.backend import force_cpu_platform
+
+        force_cpu_platform(max(CHIPS_MESH_SIZES))
+
+    import jax
+
+    if forced_host:
+        jax.config.update("jax_enable_x64", True)
+
+    from aiyagari_hark_tpu.obs import ObsConfig, build_obs
+    from aiyagari_hark_tpu.parallel.mesh import make_mesh
+    from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+    from aiyagari_hark_tpu.utils.config import SweepConfig
+
+    backend = jax.default_backend()
+    devices = jax.devices()
+    sizes = [n for n in CHIPS_MESH_SIZES if n <= len(devices)]
+    kw = dict(CHIPS_SMOKE_KWARGS)
+    cfg = SweepConfig(labor_sd=(0.2, 0.4), schedule="balanced")
+    n_cells = len(cfg.cells())
+    print(f"[bench] chips scaling: backend={backend} "
+          f"devices={len(devices)} "
+          f"({'forced host' if forced_host else 'real chips'}), "
+          f"mesh sizes {sizes}, {n_cells} cells", file=sys.stderr)
+
+    entries = []
+    results = {}
+    skew = {}
+    mem_devices = 0
+    mem_peak = None
+    for n in sizes:
+        mesh = make_mesh(("cells",), (n,), devices=devices[:n])
+        # profile=True: DeviceTelemetry (the memory-gauge sampler) only
+        # exists on the performance tier — without it sample_devices()
+        # is a no-op and the leg could never populate its gauges
+        obs = build_obs(ObsConfig(enabled=True, profile=True))
+        run_table2_sweep(cfg, mesh=mesh, obs=obs, **kw)   # compile+warm
+        res = run_table2_sweep(cfg, mesh=mesh, perturb=PERTURB, obs=obs,
+                               **kw)
+        mem_devices = max(mem_devices,
+                          obs.sample_devices(where=f"chips{n}"))
+        reg = obs.registry.snapshot()
+        skew[n] = reg.get("aiyagari_sweep_bucket_device_work_skew",
+                          {}).get("value")
+        peaks = [e["value"] for name, e in reg.items()
+                 if name.endswith("_mem_peak_bytes_in_use")]
+        if peaks:
+            mem_peak = max(mem_peak or 0.0, max(peaks))
+        obs.close()
+        results[n] = res
+        cps = n_cells / res.wall_seconds
+        entries.append({
+            "n_devices": n,
+            "wall_s": round(res.wall_seconds, 4),
+            "cells_per_sec": round(cps, 3),
+            "device_work_skew": (None if skew[n] is None
+                                 else round(skew[n], 3)),
+            "n_buckets": (0 if res.bucket is None
+                          else int(res.bucket.max()) + 1),
+        })
+        print(f"[bench] chips={n}: wall={res.wall_seconds:.3f}s -> "
+              f"{cps:.2f} cells/s (device work skew "
+              f"{skew[n] if skew[n] is not None else 'n/a'})",
+              file=sys.stderr)
+
+    base = results[sizes[0]]
+    # the sharded contract (DESIGN §6b): root/status/counters bitwise vs
+    # the 1-device mesh; the aggregate contraction (capital) rides XLA
+    # reduction orders that differ across program widths, so it is
+    # recorded as a drift, not asserted bitwise
+    bit_identical = all(
+        np.array_equal(results[n].r_star_pct, base.r_star_pct,
+                       equal_nan=True)
+        and np.array_equal(results[n].status, base.status)
+        and np.array_equal(results[n].egm_iters, base.egm_iters)
+        and np.array_equal(results[n].dist_iters, base.dist_iters)
+        and np.array_equal(results[n].bisect_iters, base.bisect_iters)
+        for n in sizes[1:])
+    ok = ~np.isnan(base.capital)        # quarantine-exhausted cells are
+    #                                     NaN-masked identically (checked
+    #                                     above) and carry no drift
+    capital_drift = max(
+        (float(np.max(np.abs(results[n].capital[ok] - base.capital[ok])
+                      / np.abs(base.capital[ok]), initial=0.0))
+         for n in sizes[1:]), default=0.0)
+
+    cps = {e["n_devices"]: e["cells_per_sec"] for e in entries}
+    record = {
+        "metric": "chips_scaling",
+        "backend": backend,
+        "chips_forced_host": bool(forced_host),
+        "chips_smoke_cells": n_cells,
+        "chips_scaling": entries,
+        # acceptance: sharded == 1-device-mesh bit-for-bit on the root,
+        # statuses, and every counter, at every measured mesh size;
+        # capital's relative reduction-order drift recorded alongside
+        "chips_bit_identical": bit_identical,
+        "chips_capital_drift": capital_drift,
+        "chips_device_work_skew": (
+            None if skew.get(sizes[-1]) is None
+            else round(skew[sizes[-1]], 3)),
+        "chips_mem_stats_devices": mem_devices,
+        "chips_mem_peak_bytes": mem_peak,
+    }
+    for n in sizes:
+        record[f"chips_cells_per_sec_{n}dev"] = cps[n]
+        if n > sizes[0]:
+            record[f"chips_speedup_{n}dev"] = round(cps[n] / cps[sizes[0]],
+                                                    3)
+    top = sizes[-1]
+    # the acceptance flag is defined AT 8 devices (>= 3x on the CPU
+    # smoke, near-linear on real chips); on a host that cannot reach an
+    # 8-way mesh the criterion is unmeasurable, not failed
+    record["chips_speedup_ok"] = (
+        bool(record.get("chips_speedup_8dev", 0.0) >= 3.0)
+        if top == 8 else None)
+    print(f"[bench] chips scaling: "
+          + " ".join(f"{n}dev={cps[n]:.2f}c/s" for n in sizes)
+          + f" speedup_{top}dev="
+          f"{record.get(f'chips_speedup_{top}dev', 'n/a')} "
+          f"bit_identical={'OK' if bit_identical else 'MISMATCH'} "
+          f"mem_stats_devices={mem_devices}", file=sys.stderr)
+    if not bit_identical:
+        print("[bench] chips scaling: BIT-IDENTITY FAILED — sharded "
+              "results differ from the 1-device mesh", file=sys.stderr)
+    return record
+
+
 def main(argv=None):
     """CLI wrapper: the preemption-tolerant run layer (ISSUE 3) around the
     measurement body.  ``--resume PATH`` gives the headline sweep a
@@ -2170,7 +2323,10 @@ def main(argv=None):
     performance-observability acceptance (XLA cost-analysis capture,
     roofline classification, model-vs-measured FLOP cross-check,
     bench-regression sentinel on the committed history) and emits the
-    ``profile_*`` record (ISSUE 10)."""
+    ``profile_*`` record (ISSUE 10); ``--chips-scaling`` runs the
+    multi-chip scaling acceptance (shard_map-dispatched sweep at mesh
+    sizes 1/2/4/8 with bit-identity, work-skew, and memory telemetry)
+    and emits the ``chips_*`` record (ISSUE 11)."""
     import argparse
 
     from aiyagari_hark_tpu.utils.resilience import (
@@ -2217,6 +2373,15 @@ def main(argv=None):
                          "shed/reject/degrade/breaker accounting, "
                          "journal consistency) and emit the load_* "
                          "record instead of the full bench")
+    ap.add_argument("--chips-scaling", action="store_true",
+                    help="run the multi-chip scaling smoke (ISSUE 11: "
+                         "the balanced 24-cell sweep dispatched through "
+                         "the shard_map launcher at mesh sizes 1/2/4/8 "
+                         "— real chips on an accelerator, forced "
+                         "host-platform CPU devices otherwise — with "
+                         "bit-identity vs the 1-device mesh, per-device "
+                         "work skew, and memory gauges) and emit the "
+                         "chips_* record instead of the full bench")
     ap.add_argument("--scenario-smoke", action="store_true",
                     help="run the scenario-registry smoke (ISSUE 9: "
                          "balanced+certified Huggett sweep with a "
@@ -2228,13 +2393,14 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if (args.serve_smoke or args.integrity_smoke or args.obs_smoke
             or args.load_smoke or args.scenario_smoke
-            or args.profile_smoke):
+            or args.profile_smoke or args.chips_scaling):
         from aiyagari_hark_tpu.utils.backend import (
             enable_compilation_cache,
         )
 
         enable_compilation_cache()
-        smoke = (_profile_smoke if args.profile_smoke
+        smoke = (_chips_scaling if args.chips_scaling
+                 else _profile_smoke if args.profile_smoke
                  else _scenario_smoke if args.scenario_smoke
                  else _load_smoke if args.load_smoke
                  else _obs_smoke if args.obs_smoke

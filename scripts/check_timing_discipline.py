@@ -11,13 +11,18 @@ pre-ISSUE-7 wall-clock story fractured into four disconnected encodings
 adjustment.  One violation class, scoped to the modules whose seams the
 obs layer instruments (``HOT_DIRS``):
 
-* a CALL to ``time.perf_counter()`` or ``time.time()`` (an attribute
-  reference like ``clock=time.time`` — injectable-clock plumbing — does
-  not match, by design: passing the clock is the pattern we want).
+* a CALL to ``time.perf_counter()``, ``time.time()``, or — since the
+  multi-chip launch sites landed (ISSUE 11 satellite) —
+  ``time.monotonic()`` (an attribute reference like
+  ``clock=time.monotonic`` — injectable-clock plumbing — does not
+  match, by design: passing the clock is the pattern we want; a bare
+  ``monotonic()`` CALL next to a launch is an ad-hoc wall that belongs
+  in a Tracer span or ``stopwatch()``).
 
 A hit is a finding unless its line carries an explicit ``# timing-ok``
 waiver stating why a raw clock read is required (e.g. a module that IS
-the timing substrate).  Docstrings are blanked before scanning so prose
+the timing substrate, or the batcher's real-time wait backstops that
+exist precisely to bound a stalled injected clock).  Docstrings are blanked before scanning so prose
 examples cannot trip it.  Run standalone (exits 1 on findings) or via
 tier-1 (``tests/test_timing_lint.py``), next to the sibling
 ``check_dtype_discipline.py`` / ``check_atomic_writes.py`` lints.
@@ -43,7 +48,7 @@ HOT_DIRS = (
 
 WAIVER = "# timing-ok"
 
-_CLOCK_CALL = re.compile(r"\btime\.(perf_counter|time)\s*\(")
+_CLOCK_CALL = re.compile(r"\btime\.(perf_counter|time|monotonic)\s*\(")
 
 _TRIPLE_STRING = re.compile(r"('''|\"\"\")(.*?)(\1)", re.DOTALL)
 

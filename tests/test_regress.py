@@ -184,6 +184,44 @@ def test_direction_known_fields_and_nesting():
     assert direction_of_goodness("profile_overhead_frac") == DOWN
 
 
+def test_direction_covers_chips_scaling_record():
+    """The ``--chips-scaling`` leg's scalar fields (ISSUE 11) resolve
+    strictly — the sentinel grades a chips record from its FIRST
+    committed round instead of raising unclassified — and a synthetic
+    chips history grades clean end to end."""
+    chips_record = {
+        "metric": "chips_scaling", "backend": "cpu",
+        "chips_forced_host": True, "chips_smoke_cells": 24,
+        "chips_scaling": [{"n_devices": 1, "wall_s": 2.0,
+                           "cells_per_sec": 12.0}],
+        "chips_bit_identical": True,
+        "chips_device_work_skew": 1.1,
+        "chips_mem_stats_devices": 0,
+        "chips_mem_peak_bytes": None,
+        "chips_cells_per_sec_1dev": 12.0,
+        "chips_cells_per_sec_2dev": 22.0,
+        "chips_cells_per_sec_4dev": 40.0,
+        "chips_cells_per_sec_8dev": 72.0,
+        "chips_speedup_2dev": 1.8, "chips_speedup_4dev": 3.3,
+        "chips_speedup_8dev": 6.0, "chips_speedup_ok": True,
+    }
+    for field in flatten_record(chips_record):
+        direction = direction_of_goodness(field, strict=True)
+        assert direction in (UP, DOWN, NEUTRAL), field
+    assert direction_of_goodness("chips_cells_per_sec_8dev") == UP
+    assert direction_of_goodness("chips_speedup_8dev") == UP
+    assert direction_of_goodness("chips_device_work_skew") == DOWN
+    # a stable synthetic chips history grades clean; a throughput drop
+    # at 8 devices flags REGRESSED in the declared (UP) direction
+    hist = [(f"r{i:02d}", dict(chips_record)) for i in range(4)]
+    assert evaluate_history(hist).worst == OK
+    worse = dict(chips_record)
+    worse["chips_cells_per_sec_8dev"] = 40.0
+    hist_bad = hist[:-1] + [("r99", worse)]
+    flagged = [f.metric for f in evaluate_history(hist_bad).regressed()]
+    assert "chips_cells_per_sec_8dev" in flagged
+
+
 def test_direction_unknown_field_raises_strict_only():
     with pytest.raises(UnknownMetricError):
         direction_of_goodness("utterly_unclassifiable_thing",

@@ -321,6 +321,70 @@ def test_stale_ledger_warns_and_recomputes(tmp_path):
     assert not os.path.exists(ledger)
 
 
+def test_mesh_shape_refuses_resume_and_recomputes(tmp_path):
+    """Mesh-shape resume safety (ISSUE 11): the lane-axis device count is
+    hashed into the ledger fingerprint, so a ledger written under an
+    8-device mesh loaded under 1 device — and vice versa — warns typed
+    ("different run") and recomputes, with the final SweepResult
+    bit-identical to an uninterrupted run either way (the per-lane bits
+    are mesh-independent; only the resume GEOMETRY is not)."""
+    from aiyagari_hark_tpu.parallel.mesh import cells_mesh
+
+    mesh = cells_mesh()
+    clean = run_table2_sweep(SMALL, **KW)               # 1-device ref
+    clean_8 = run_table2_sweep(SMALL, mesh=mesh, **KW)  # 8-device ref
+
+    # written on 8 devices, resumed on 1: refuse + recompute, and the
+    # recomputed run is bit-identical to the uninterrupted 1-DEVICE run
+    # (same launch geometry — the comparison the fingerprint protects)
+    ledger = str(tmp_path / "mesh8_ledger.npz")
+    with preemption_guard():
+        with pytest.raises(Interrupted):
+            run_table2_sweep(
+                SMALL, mesh=mesh, resume_path=ledger,
+                inject_preempt={"after_bucket": 0, "mode": "flag"}, **KW)
+    assert os.path.exists(ledger)
+    with pytest.warns(UserWarning, match="different run"):
+        res_1 = run_table2_sweep(SMALL, resume_path=ledger, **KW)
+    assert not os.path.exists(ledger)
+    assert_sweep_identical(res_1, clean)
+
+    # written on 1 device, resumed on 8: refuse + recompute, bit-identical
+    # to the uninterrupted 8-device run
+    ledger = str(tmp_path / "mesh1_ledger.npz")
+    with preemption_guard():
+        with pytest.raises(Interrupted):
+            run_table2_sweep(
+                SMALL, resume_path=ledger,
+                inject_preempt={"after_bucket": 0, "mode": "flag"}, **KW)
+    assert os.path.exists(ledger)
+    with pytest.warns(UserWarning, match="different run"):
+        res_8 = run_table2_sweep(SMALL, mesh=mesh, resume_path=ledger,
+                                 **KW)
+    assert not os.path.exists(ledger)
+    assert_sweep_identical(res_8, clean_8)
+
+    # the SAME mesh shape DOES resume: no recompute warning, and the
+    # restored-bucket result is bit-identical to the uninterrupted
+    # 8-device run
+    ledger = str(tmp_path / "mesh_same_ledger.npz")
+    with preemption_guard():
+        with pytest.raises(Interrupted):
+            run_table2_sweep(
+                SMALL, mesh=mesh, resume_path=ledger,
+                inject_preempt={"after_bucket": 0, "mode": "flag"}, **KW)
+    resumed = run_table2_sweep(SMALL, mesh=mesh, resume_path=ledger, **KW)
+    assert not os.path.exists(ledger)
+    assert_sweep_identical(resumed, clean_8)
+
+    # per-lane solver bits are mesh-independent up to the documented
+    # aggregate-contraction carve-out: r*/status/counters bitwise across
+    # the two geometries
+    assert np.array_equal(clean.r_star_pct, clean_8.r_star_pct)
+    assert np.array_equal(clean.status, clean_8.status)
+    assert np.array_equal(clean.egm_iters, clean_8.egm_iters)
+
+
 def test_locked_schedule_resumes_through_quarantine(tmp_path):
     """The lock-step path is one "bucket" to the ledger: a preemption
     between the launch and the quarantine rungs resumes without

@@ -174,6 +174,23 @@ def test_default_ladder_shapes():
     assert [b.pad_to(n) for n in (1, 2, 3, 5, 8)] == [1, 2, 4, 8, 8]
 
 
+def test_shard_ladder_rounds_to_device_multiples():
+    """Multi-chip ladders (ISSUE 11): every shape rounds UP to a shard
+    multiple and dedupes, so a sharded flush always divides the mesh."""
+    from aiyagari_hark_tpu.serve import shard_ladder
+
+    assert shard_ladder((1, 2, 4, 8), 1) == (1, 2, 4, 8)
+    assert shard_ladder((1, 2, 4, 8), 4) == (4, 8)
+    assert shard_ladder((1, 2, 4, 8), 8) == (8,)
+    assert shard_ladder((1, 2, 4, 8, 12), 8) == (8, 16)
+    assert shard_ladder((3,), 2) == (4,)
+    with pytest.raises(ValueError):
+        shard_ladder((1, 2), 0)
+    b = MicroBatcher(max_batch=8, shard_multiple=4)
+    assert b.ladder == (4, 8)
+    assert [b.pad_to(n) for n in (1, 4, 5, 8)] == [4, 4, 8, 8]
+
+
 def test_batcher_deadline_and_size_flush():
     clk = FakeClock()
     b = MicroBatcher(max_batch=4, max_wait_s=0.010, clock=clk)
@@ -229,6 +246,52 @@ def test_batch_occupancy_and_queue_metrics():
 # ---------------------------------------------------------------------------
 # Cache-hit contract (ISSUE 4 satellite: tier-1 smoke).
 # ---------------------------------------------------------------------------
+
+def test_sharded_service_bit_identical_and_zero_compiles_on_replay():
+    """The PR 4 zero-compile smoke extended to the sharded batcher
+    (ISSUE 11): a service over the 8-device mesh pads flushes to
+    per-device multiples, serves bits identical to the 1-device service,
+    resolves exact replays with zero XLA work, and a second same-shape
+    cold wave is a pure executable-cache hit (one wrapped executable per
+    ladder shape per solver group, mesh included)."""
+    from aiyagari_hark_tpu.parallel.mesh import cells_mesh
+    from aiyagari_hark_tpu.utils.timing import CompileCounter
+
+    mesh = cells_mesh()
+    svc = manual_service(max_batch=8, ladder=(1, 2, 4, 8), mesh=mesh)
+    assert svc.batcher.ladder == (8,)         # rounded to the mesh
+    cells = [(s, r) for s in (1.0, 3.0)
+             for r in (0.0, 0.3, 0.6, 0.9)]
+    queries = [make_query(s, r, **KW) for s, r in cells]
+    futs = [svc.submit(q) for q in queries]
+    svc.flush()
+    served = [f.result(0) for f in futs]
+    # the PR 4 bit-identity reference: a batch-of-1 launch of the same
+    # executable family with the same seed (cold here), unsharded
+    for q, a in zip(queries, served):
+        b = svc.reference_solve(q, bracket_init=a.bracket_init)
+        assert_rows_equal(a, b)
+        assert a.values == b.values           # the full packed row
+
+    with CompileCounter() as c_hit:           # exact replay: pure hits
+        for s, r in cells:
+            fut = svc.submit(make_query(s, r, **KW))
+            assert fut.done()
+            fut.result(0)
+    assert c_hit.compile_events == 0 and c_hit.cache_misses == 0
+
+    # a second cold wave at the same ladder shape: zero NEW compiles —
+    # the sharded launcher is memoized per (fn, mesh), so the warmed
+    # multi-chip service still owns ONE executable per shape
+    shifted = [(s, r, 0.4) for s, r in cells]
+    with CompileCounter() as c_cold:
+        futs = [svc.submit(make_query(s, r, labor_sd=sd, **KW))
+                for s, r, sd in shifted]
+        svc.flush()
+        [f.result(0) for f in futs]
+    assert c_cold.cache_misses == 0, c_cold.__dict__
+    svc.close()
+
 
 def test_second_identical_query_is_hit_with_zero_compiles():
     from aiyagari_hark_tpu.utils.timing import CompileCounter

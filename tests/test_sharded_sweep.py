@@ -1,0 +1,285 @@
+"""Multi-chip sharded sweep (ISSUE 11): the bit-identity property on the
+8-virtual-device CPU mesh.
+
+The load-bearing contract extends PR 2's scheduler parity to placement:
+a sweep dispatched through the ``jit(shard_map)`` launcher
+(``parallel.mesh.sharded_launcher``) over the ``cells`` axis must return
+the root (r*), NaN masks, statuses, retries, and every iteration counter
+BIT-identical to the 1-device run — both panels, a quarantined
+(fault-injected) cell, and all three registered scenario families —
+because each device runs the identical per-lane program on its lane
+block and the only cross-device traffic is the output gather.  The ONE
+exception is the PR 4 carve-out, now measured across program widths: the
+within-lane aggregate contraction (capital, and its derived
+saving-rate/excess) rides XLA reduction orders that differ between a
+width-B and a width-B/n compilation of the same per-lane code, so it
+agrees to reduction-order noise (~1e-12 relative; asserted tightly, not
+bitwise).  A subprocess fixture additionally proves the property in a
+FRESH interpreter whose host-device flag is set before jax initializes
+(the forced-host-platform bootstrap ``bench.py --chips-scaling`` and the
+driver's ``dryrun_multichip`` rely on).
+
+Configs deliberately mirror test_sweep_scheduler / test_resilience /
+test_scenarios so the 1-device references are jit-cache hits and each
+test adds at most one new (sharded) executable to the suite's compile
+bill.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.parallel.mesh import (
+    cells_mesh,
+    make_mesh,
+    mesh_axis_size,
+    shard_map_compat,
+    sharded_launcher,
+)
+from aiyagari_hark_tpu.parallel.sweep import run_sweep, run_table2_sweep
+from aiyagari_hark_tpu.utils.config import SweepConfig
+
+# Same solver config + fault as tests/test_sweep_scheduler.py (shared
+# jit/lru cache keys: the 1-device fault executables are already
+# compiled there in tier-1).
+KW = dict(a_count=12, dist_count=48, labor_states=4, r_tol=1e-5,
+          max_bisect=30)
+TWO_PANEL = SweepConfig(crra_values=(1.0, 5.0), rho_values=(0.0, 0.9),
+                        labor_sd=(0.2, 0.4))
+FAULT = {"cell": 2, "at_iter": 2, "mode": "stall"}
+# Same 4-cell config as tests/test_resilience.py's SMALL.
+SMALL = SweepConfig(crra_values=(1.0, 5.0), rho_values=(0.0, 0.9),
+                    schedule="balanced", n_buckets=2)
+# Same Huggett / Epstein-Zin configs as tests/test_scenarios.py.
+HKW = dict(a_count=12, dist_count=48, labor_states=3, r_tol=1e-5,
+           max_bisect=20, egm_tol=1e-5, dist_tol=1e-9,
+           borrow_limit=-2.0)
+HCFG = SweepConfig(crra_values=(1.5, 3.0), rho_values=(0.3, 0.6),
+                   schedule="balanced", n_buckets=2)
+EKW = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-4,
+           max_bisect=12, egm_tol=1e-5, dist_tol=1e-8, ez_rho=2.0)
+ECFG = SweepConfig(crra_values=(2.0, 6.0), rho_values=(0.3, 0.6),
+                   schedule="balanced", n_buckets=2)
+
+
+# ---------------------------------------------------------------------------
+# Mesh-layer helpers (no solves).
+# ---------------------------------------------------------------------------
+
+def test_mesh_axis_size_and_cells_mesh():
+    assert mesh_axis_size(None, "cells") == 1
+    mesh = cells_mesh()
+    assert mesh_axis_size(mesh, "cells") == 8
+    assert mesh_axis_size(mesh, "absent") == 1
+    two = make_mesh(("cells",), (2,))
+    assert mesh_axis_size(two, "cells") == 2
+
+
+def test_sharded_launcher_memoized_per_fn_and_mesh():
+    """One wrapped executable per (fn, mesh, axis): equal meshes hash
+    equal, so repeated bucket/flush launches reuse the same jitted
+    wrapper — the zero-new-compiles-on-replay contract's first half.
+    (jit is lazy: nothing compiles here.)"""
+    from aiyagari_hark_tpu.scenarios.registry import get_scenario
+    from aiyagari_hark_tpu.utils.fingerprint import hashable_kwargs
+
+    scn = get_scenario("aiyagari")
+    fn = scn.batched_solver(np.dtype(np.float64),
+                            hashable_kwargs(dict(KW)), None, False)
+    m1 = make_mesh(("cells",), (2,))
+    m2 = make_mesh(("cells",), (2,))     # equal grid -> equal hash
+    assert sharded_launcher(fn, m1) is sharded_launcher(fn, m2)
+    m4 = make_mesh(("cells",), (4,))
+    assert sharded_launcher(fn, m1) is not sharded_launcher(fn, m4)
+
+
+def test_panel_shim_is_the_mesh_shim():
+    """The jax-version shard_map shim lives in ONE place now: the
+    panel's private name must be the promoted ``mesh.shard_map_compat``
+    (ISSUE 11 satellite — the 0.4.x/check_vma logic cannot fork)."""
+    from aiyagari_hark_tpu.parallel import panel
+
+    assert panel._shard_map is shard_map_compat
+
+
+def test_mesh_auto_rejects_unknown_string():
+    with pytest.raises(ValueError, match="auto"):
+        run_table2_sweep(SMALL, mesh="all-of-them", **KW)
+
+
+def test_resolve_mesh_contract():
+    """One mesh-argument rule for sweep AND serve: None passes through,
+    "auto" builds the all-device mesh, a mesh that does not define the
+    lane axis is rejected loudly (it would otherwise silently run
+    unsharded at shard count 1)."""
+    from aiyagari_hark_tpu.parallel.mesh import resolve_mesh
+    from aiyagari_hark_tpu.serve import EquilibriumService
+
+    assert resolve_mesh(None) is None
+    auto = resolve_mesh("auto")
+    assert mesh_axis_size(auto, "cells") == 8
+    wrong = make_mesh(("lanes",), (2,))
+    with pytest.raises(ValueError, match="lane axis"):
+        resolve_mesh(wrong, "cells")
+    with pytest.raises(ValueError, match="lane axis"):
+        EquilibriumService(start_worker=False, mesh=wrong)
+    with pytest.raises(ValueError, match="lane axis"):
+        run_table2_sweep(SMALL, mesh=wrong, **KW)
+
+
+# ---------------------------------------------------------------------------
+# Bit-identity properties on the session's 8-device mesh.
+# ---------------------------------------------------------------------------
+
+def assert_sharded_contract(a, b):
+    """The sharded == 1-device contract: root/status/retries/counters/
+    masks bitwise; the aggregate-contraction fields (capital and its
+    derived saving rate / excess) to reduction-order noise — the PR 4
+    eager-vs-vmap carve-out, measured across program widths."""
+    assert np.array_equal(a.r_star_pct, b.r_star_pct, equal_nan=True)
+    assert np.array_equal(a.status, b.status)
+    assert np.array_equal(a.retries, b.retries)
+    assert np.array_equal(a.egm_iters, b.egm_iters)
+    assert np.array_equal(a.dist_iters, b.dist_iters)
+    assert np.array_equal(a.bisect_iters, b.bisect_iters)
+    for f in ("capital", "saving_rate_pct", "excess"):
+        x, y = np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+        assert np.array_equal(np.isnan(x), np.isnan(y)), f
+        ok = ~np.isnan(x)
+        # atol floor: excess is a near-zero market-clearing residual, so
+        # the reduction-order noise must be measured against the
+        # aggregate's scale (capital ~ O(5)), not the residual's
+        np.testing.assert_allclose(x[ok], y[ok], rtol=1e-9, atol=1e-8,
+                                   err_msg=f)
+
+
+def test_sharded_sweep_bit_identical_with_quarantined_cell():
+    """Both Table II panels through the shard_map launcher on 8 devices,
+    locked AND balanced, vs the 1-device lock-step reference — values,
+    NaN masks, statuses, counters all bit-equal, including the failed
+    (stalled, unretried) cell's NaN mask.  The two sharded schedules pad
+    to the same shape-8 launch, so this costs ONE new executable."""
+    mesh = cells_mesh()
+    ref = run_table2_sweep(TWO_PANEL.replace(schedule="locked"),
+                           inject_fault=FAULT, max_retries=0, **KW)
+    sharded_locked = run_table2_sweep(
+        TWO_PANEL.replace(schedule="locked"), mesh=mesh,
+        inject_fault=FAULT, max_retries=0, **KW)
+    assert_sharded_contract(ref, sharded_locked)
+    sharded_balanced = run_table2_sweep(
+        TWO_PANEL.replace(schedule="balanced", n_buckets=2), mesh=mesh,
+        inject_fault=FAULT, max_retries=0, **KW)
+    assert_sharded_contract(ref, sharded_balanced)
+    # the two sharded schedules pad to the SAME shape-8 launch of the
+    # same executable, so between THEMSELVES they are fully bitwise
+    assert np.array_equal(sharded_locked.capital,
+                          sharded_balanced.capital, equal_nan=True)
+    assert sharded_balanced.bucket is not None
+    assert np.isnan(sharded_balanced.r_star_pct[FAULT["cell"]])
+    assert len(sharded_balanced.failed_cells()) == 1
+
+
+def test_sharded_sweep_bit_identical_other_scenarios():
+    """Every registered family rides the one scenario-generic sharding
+    pass: huggett and epstein_zin rows obey the sharded contract between
+    the 8-device mesh and the 1-device run (aiyagari is pinned above) —
+    root/status/counters bitwise by name, the remaining value columns
+    (aggregate contractions) to reduction-order noise."""
+    mesh = cells_mesh()
+    for name, cfg, kw in (("huggett", HCFG, HKW),
+                          ("epstein_zin", ECFG, EKW)):
+        res_1 = run_sweep(name, sweep=cfg, **kw)
+        res_n = run_sweep(name, sweep=cfg, mesh=mesh, **kw)
+        schema = res_1.schema
+        exact = ((schema.root, schema.status) + tuple(schema.counters)
+                 + tuple(schema.phases or ()))
+        for f in schema.fields:
+            x, y = res_1.col(f), res_n.col(f)
+            if f in exact:
+                assert np.array_equal(x, y, equal_nan=True), (name, f)
+            else:
+                assert np.array_equal(np.isnan(x), np.isnan(y)), (name, f)
+                ok = ~np.isnan(x)
+                np.testing.assert_allclose(x[ok], y[ok], rtol=1e-9,
+                                           err_msg=f"{name}:{f}")
+        assert np.array_equal(res_1.status, res_n.status), name
+        assert np.array_equal(res_1.retries, res_n.retries), name
+
+
+def test_mesh_auto_resolves_to_all_devices():
+    """``mesh="auto"`` builds the cells mesh over every local device and
+    returns the same answer as no mesh at all (sharded contract)."""
+    res_auto = run_table2_sweep(SMALL, mesh="auto", **KW)
+    res_none = run_table2_sweep(SMALL, **KW)
+    assert_sharded_contract(res_none, res_auto)
+
+
+# ---------------------------------------------------------------------------
+# Fresh-interpreter subprocess proof (the forced-host-device bootstrap).
+# ---------------------------------------------------------------------------
+
+_CHILD = r"""
+import json, os, sys
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+from aiyagari_hark_tpu.utils.backend import enable_compilation_cache
+enable_compilation_cache()
+import numpy as np
+from aiyagari_hark_tpu.parallel.mesh import cells_mesh
+from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+from aiyagari_hark_tpu.utils.config import SweepConfig
+
+kw = dict(a_count=12, dist_count=48, labor_states=4, r_tol=1e-5,
+          max_bisect=30)
+cfg = SweepConfig(crra_values=(1.0, 5.0), rho_values=(0.0, 0.9),
+                  schedule="balanced", n_buckets=2)
+res_1 = run_table2_sweep(cfg, **kw)
+mesh = cells_mesh()
+res_8 = run_table2_sweep(cfg, mesh=mesh, **kw)
+print(json.dumps({
+    "n_devices": len(jax.devices()),
+    "mesh_cells": int(mesh.shape["cells"]),
+    "bit_identical": bool(
+        np.array_equal(res_1.r_star_pct, res_8.r_star_pct)
+        and np.array_equal(res_1.status, res_8.status)
+        and np.array_equal(res_1.egm_iters, res_8.egm_iters)
+        and np.array_equal(res_1.dist_iters, res_8.dist_iters)),
+}))
+"""
+
+
+@pytest.fixture(scope="module")
+def forced_host_report():
+    """Run the sharded-vs-1-device comparison in a FRESH interpreter that
+    sets ``--xla_force_host_platform_device_count`` BEFORE jax
+    initializes — the exact bootstrap ``bench.py --chips-scaling`` and
+    ``dryrun_multichip`` depend on, which an in-suite test (whose
+    backend the conftest already initialized) cannot exercise.  Shares
+    the persistent compile cache with the in-process tests above, so the
+    child pays imports + solves, not fresh XLA compiles."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)            # the child must set it itself
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run([sys.executable, "-c", _CHILD],
+                         capture_output=True, text=True, timeout=560,
+                         env=env, cwd=os.path.dirname(
+                             os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_forced_host_subprocess_sharded_bit_identity(forced_host_report):
+    rep = forced_host_report
+    assert rep["n_devices"] == 8
+    assert rep["mesh_cells"] == 8
+    assert rep["bit_identical"] is True

@@ -56,6 +56,24 @@ def test_lint_fires_on_raw_clock_calls():
     assert "stopwatch" in findings[0][2]
 
 
+def test_lint_fires_on_monotonic_walls_and_accepts_clock_injection():
+    """The ISSUE 11 extension: a bare ``time.monotonic()`` CALL next to a
+    (sharded) launch is an ad-hoc wall — finding; passing the clock as
+    an injectable default (``clock=time.monotonic``) is the blessed
+    plumbing pattern — clean; a waived real-time backstop is clean."""
+    mod, _ = _load_lint()
+    findings = mod.scan_source(
+        "import time\n"
+        "t0 = time.monotonic()\n"
+        "launch()\n"
+        "wall = time.monotonic() - t0\n"
+        "def f(clock=time.monotonic):\n"        # reference, not a call
+        "    return clock\n"
+        "end = time.monotonic() + t  # timing-ok: wait backstop\n",
+        "fixture.py")
+    assert [line for _, line, _ in findings] == [2, 4]
+
+
 def test_lint_accepts_waivers_and_clock_references():
     mod, _ = _load_lint()
     findings = mod.scan_source(
