@@ -28,7 +28,7 @@ from ..solver_health import (
     NONFINITE,
     combine_status,
 )
-from ..utils.config import resolve_precision
+from ..utils.config import resolve_grid, resolve_precision
 from .household import (
     R_DESCENT_WIDTH_SCALE,
     HouseholdPolicy,
@@ -87,6 +87,7 @@ def household_capital_supply(r, model: SimpleModel, disc_fac, crra,
                              egm_method: str = "xla",
                              accel_every: int | None = None,
                              precision: str = "reference",
+                             grid="reference",
                              descent_fault_iter: int | None = None,
                              descent_fault_mode: str = "nan",
                              ) -> SupplyEval:
@@ -110,7 +111,12 @@ def household_capital_supply(r, model: SimpleModel, disc_fac, crra,
 
     ``precision`` threads the mixed-precision ladder policy (DESIGN §5)
     into BOTH inner fixed points; the per-phase step split rides the
-    returned counters.  ``descent_fault_iter`` (tests; ISSUE 7 event
+    returned counters.  ``grid`` threads the grid policy (DESIGN §5b)
+    into the POLICY fixed point (analytic tail + coarse-to-fine
+    ladder); the distribution loop reaches compaction through the
+    model's own (compacted) histogram support — a support LADDER there
+    was built and measured to fight the bisection's warm-start carry
+    (see ``stationary_wealth``'s grid-policy note), so it does not run.  ``descent_fault_iter`` (tests; ISSUE 7 event
     drills) poisons both inner DESCENT phases at that iteration so the
     ladder's escalation path is deterministically injectable from the
     sweep level — compiled out when None, like the bisection's
@@ -127,8 +133,8 @@ def household_capital_supply(r, model: SimpleModel, disc_fac, crra,
         egm_kw["descent_fault_mode"] = str(descent_fault_mode)
     policy, egm_it, _, egm_status, egm_ph = solve_household(
         R, W, model, disc_fac, crra, tol=egm_tol, init_policy=init_policy,
-        method=egm_method, precision=precision, return_phases=True,
-        **egm_kw)
+        method=egm_method, precision=precision, grid=grid,
+        return_phases=True, **egm_kw)
     dist, dist_it, _, dist_status, dist_ph = stationary_wealth(
         policy, R, W, model, tol=dist_tol, init_dist=init_dist,
         method=dist_method, precision=precision, return_phases=True,
@@ -237,7 +243,8 @@ def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
                                 max_bisect: int = 60,
                                 egm_tol: float | None = None,
                                 dist_tol: float | None = None,
-                                precision: str = "reference") -> EquilibriumResult:
+                                precision: str = "reference",
+                                grid="reference") -> EquilibriumResult:
     """Bisect r until the capital market clears.
 
     Fully jit-able/vmappable: a fixed-trip ``while_loop`` whose body solves
@@ -254,7 +261,7 @@ def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
         supply = household_capital_supply(
             r, model, disc_fac, crra, cap_share, depr_fac, prod,
             egm_tol=egm_tol, dist_tol=dist_tol,
-            precision=precision).supply
+            precision=precision, grid=grid).supply
         demand = firm.k_to_l_from_r(r, cap_share, depr_fac, prod) * labor
         return supply - demand
 
@@ -263,7 +270,8 @@ def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
 
     ev = household_capital_supply(
         r_star, model, disc_fac, crra, cap_share, depr_fac, prod,
-        egm_tol=egm_tol, dist_tol=dist_tol, precision=precision)
+        egm_tol=egm_tol, dist_tol=dist_tol, precision=precision,
+        grid=grid)
     supply, wage, k_to_l = ev.supply, ev.wage, ev.k_to_l
     demand = k_to_l * labor
     output = prod * supply ** cap_share * labor ** (1.0 - cap_share)
@@ -314,6 +322,7 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
                            bracket_pad: float = 1.0,
                            bracket_init=None,
                            precision: str = "reference",
+                           grid="reference",
                            fault_iter=None,
                            fault_mode: str = "nan",
                            descent_fault_iter: int | None = None,
@@ -402,7 +411,10 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
     # Every midpoint still solves to the FULL dist_tol: a looser tolerance
     # at wide brackets risks flipping the excess sign when the root happens
     # to sit near an early midpoint, silently excluding it from the bracket.
-    p0 = initial_policy(model)
+    # Under a compact grid policy (DESIGN §5b) the carried policy is
+    # tail-closed — the initial iterate must share that shape.
+    gspec = resolve_grid(grid)
+    p0 = initial_policy(model, analytic_tail=gspec.compact)
     d0 = initial_distribution(model)
     use_illinois = root_method == "illinois"
     if root_method not in ("illinois", "bisect"):
@@ -419,7 +431,7 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
                 egm_tol=egm_tol, dist_tol=dist_tol,
                 init_policy=pol, init_dist=dist, dist_method=dist_method,
                 egm_method=egm_method, accel_every=accel_every,
-                precision=prec,
+                precision=prec, grid=grid,
                 descent_fault_iter=descent_fault_iter,
                 descent_fault_mode=descent_fault_mode)
         return eval_at
@@ -646,15 +658,19 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
 def _solve_cell(solver, crra, labor_ar, labor_sd=0.2, labor_states=7,
                 disc_fac=0.96, cap_share=0.36, depr_fac=0.08,
                 a_min=0.001, a_max=50.0, a_count=32, a_nest_fac=2,
-                dist_count=500, dtype=None, **solver_kwargs):
+                dist_count=500, grid="reference", dtype=None,
+                **solver_kwargs):
     """Build the model for one (crra, rho, sd) cell and run ``solver`` on it.
     ``crra``/``labor_ar``/``labor_sd`` may be traced (vmap over cells); every
-    other argument is static structure."""
+    other argument is static structure.  ``grid`` (DESIGN §5b) shapes BOTH
+    sides: the model build (compacted asset/histogram grids) and the
+    solver (analytic tail + coarse-to-fine ladder)."""
     model = build_simple_model(
         labor_states=labor_states, labor_ar=labor_ar, labor_sd=labor_sd,
         a_min=a_min, a_max=a_max, a_count=a_count, a_nest_fac=a_nest_fac,
-        dist_count=dist_count, dtype=dtype)
-    return solver(model, disc_fac, crra, cap_share, depr_fac, **solver_kwargs)
+        dist_count=dist_count, grid=grid, dtype=dtype)
+    return solver(model, disc_fac, crra, cap_share, depr_fac, grid=grid,
+                  **solver_kwargs)
 
 
 def solve_calibration(crra: float, labor_ar: float,

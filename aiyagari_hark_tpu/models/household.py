@@ -29,14 +29,25 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from ..ops.grids import make_asset_grid, make_grid_exp_mult
-from ..ops.interp import interp1d, interp1d_rowwise, locate_in_grid
+import numpy as np
+
+from ..ops.grids import build_asset_grids, resolve_grid
+from ..ops.interp import (
+    append_tail_knot,
+    interp1d,
+    interp1d_rowwise,
+    locate_in_grid,
+)
 from ..ops.markov import (
     normalized_labor_states,
     stationary_distribution,
     tauchen_labor_process,
 )
-from ..ops.utility import inverse_marginal_utility, marginal_utility
+from ..ops.utility import (
+    asymptotic_mpc,
+    inverse_marginal_utility,
+    marginal_utility,
+)
 from ..solver_health import (
     NONFINITE,
     STALLED,
@@ -48,6 +59,88 @@ from ..utils.config import resolve_precision
 
 # The reference's borrowing-constraint knot value (Aiyagari_Support.py:1503).
 CONSTRAINT_EPS = 1e-7
+
+
+# First-tail-segment slope blend (DESIGN §5b): s_bar = kappa +
+# TAIL_SLOPE_BLEND * (s_local - kappa).  The true tail slope decays from
+# the local MPC toward the limit MPC; both pure endpoints are provably
+# biased by concavity (kappa-only understates tail consumption, measured
+# -0.65bp of r* at the worst golden cell; local-slope overstates it,
+# +0.31bp), so the blend sits inside the bracketing band — 3/4 centers
+# the measured drift across the 12 golden cells (worst cell +0.01bp) and
+# reflects that an exponentially-decaying slope spends most of the
+# segment near its initial value.
+TAIL_SLOPE_BLEND = 0.75
+
+
+def perfect_foresight_human_wealth(R, W, labor_levels, transition):
+    """Per-state expected PV of future labor income discounted at ``R``
+    — the intercept of the consumption function's asymptote (DESIGN
+    §5b): Ma-Stachurski-Toda (arXiv:2002.09108) give ``c(m) -> kappa (m
+    + h_s)``, with ``h`` solving ``h = P (y + h) / R`` for ``y = W l``.
+    ``R`` is floored just above 1 — a transient bisection probe at a
+    negative rate has no convergent PV, and the tail only needs a finite
+    monotone surrogate there (the final root sits at r > 0)."""
+    y = W * labor_levels
+    dt = y.dtype
+    R_eff = jnp.maximum(jnp.asarray(R, dtype=dt), 1.0 + 1e-3)
+    n = labor_levels.shape[0]
+    rhs = jnp.matmul(transition, y[:, None],
+                     precision=jax.lax.Precision.HIGHEST,
+                     preferred_element_type=dt)[:, 0] / R_eff
+    return jnp.linalg.solve(jnp.eye(n, dtype=dt) - transition / R_eff,
+                            rhs)
+
+
+def _append_analytic_tail(m_knots, c_knots, R, W, disc_fac, crra,
+                          labor_levels, transition):
+    """Close a consumption policy with the TWO-knot analytic linear tail
+    (DESIGN §5b): ride the LOCAL MPC (the last endogenous segment's
+    slope) from the truncation knot until it meets the perfect-foresight
+    asymptote ``c = kappa (m + h_s)`` (slope = the model's MPC limit
+    ``ops.utility.asymptotic_mpc``, intercept = per-state human wealth),
+    then ride the asymptote — which, being the LAST segment, also
+    governs extrapolation to arbitrary wealth (``ops.interp.interp1d``).
+
+    Rationale: the true consumption function is concave, approaching the
+    asymptote from BELOW with local MPC decaying toward ``kappa`` from
+    above — so a pure-``kappa`` tail anchored at the truncation knot
+    understates tail consumption (measured −0.65bp of r* at the worst
+    golden cell) while riding the local secant slope overstates it
+    (+0.31bp).  The first tail segment therefore uses the BLENDED slope
+    ``kappa + TAIL_SLOPE_BLEND * (s_local - kappa)`` — the 3/4 weight
+    that centers the measured drift band (see the constant's rationale)
+    — capped by the asymptote itself (an upper bound on a concave
+    function approached from below);
+    the second segment runs at exactly ``kappa``, which, being the LAST
+    segment, also governs extrapolation to arbitrary wealth.  Knot
+    POSITIONS are fixed one grid-span apart (no crossing-point division
+    — a near-parallel local slope must not amplify tolerance-scale
+    iterate noise into knot movement, which would stall the fixed
+    point's sup-norm certificate).  Slopes are clipped into (0, 1] so a
+    transient probe at a pathological rate (negative r makes the PF MPC
+    negative) still produces a strictly monotone, positive-consumption
+    tail.
+    """
+    dt = m_knots.dtype
+    tiny = jnp.asarray(np.finfo(np.float64).tiny, dtype=dt)
+    kappa = jnp.clip(asymptotic_mpc(R, disc_fac, crra),
+                     1e-3, 0.999).astype(dt)
+    h = perfect_foresight_human_wealth(R, W, labor_levels, transition)
+    m_top = m_knots[:, -1]
+    c_top = c_knots[:, -1]
+    span = jnp.maximum(m_top - m_knots[:, 0], 1.0)
+    s_loc = ((c_knots[:, -1] - c_knots[:, -2])
+             / jnp.maximum(m_knots[:, -1] - m_knots[:, -2], tiny))
+    s_bar = jnp.clip(kappa + TAIL_SLOPE_BLEND * (s_loc - kappa),
+                     kappa, 1.0)
+    m1 = m_top + span
+    c1 = jnp.minimum(c_top + s_bar * span, kappa * (m1 + h))
+    c1 = jnp.maximum(c1, c_top + kappa * span)   # monotone floor
+    m2 = m1 + span
+    c2 = c1 + kappa * span
+    return (jnp.concatenate([m_knots, m1[:, None], m2[:, None]], axis=1),
+            jnp.concatenate([c_knots, c1[:, None], c2[:, None]], axis=1))
 
 
 class HouseholdPolicy(NamedTuple):
@@ -73,6 +166,7 @@ def build_simple_model(labor_states: int = 7, labor_ar: float = 0.6,
                        a_min: float = 0.001, a_max: float = 50.0,
                        a_count: int = 32, a_nest_fac: int = 2,
                        dist_count: int = 500, borrow_limit: float = 0.0,
+                       grid="reference", grid_tail: str = "analytic",
                        dtype=None) -> SimpleModel:
     """Assemble the calibration arrays.  ``labor_ar``/``labor_sd`` may be
     traced scalars (sweep axes); grid sizes are static.
@@ -84,19 +178,24 @@ def build_simple_model(labor_states: int = 7, labor_ar: float = 0.6,
     b above the natural limit at the prices it solves under
     (``-W l_min / r`` for r > 0), else the constrained agent cannot service
     debt and consumption turns negative.
+
+    ``grid`` (ISSUE 12, DESIGN §5b): the grid policy, resolved through
+    the ``ops.grids.build_asset_grids`` seam — "reference" (default)
+    builds the historical grids bit-identically; "compact"/"adaptive"
+    spend the (smaller) point budget on the curved low-wealth region
+    only and close the top with a linear tail.  ``grid_tail`` picks the
+    tail contract: "analytic" (the solver appends a knot at the
+    asymptotic MPC slope — ``solve_household``'s EGM path) or "anchors"
+    (sparse geometric solution points close [a_hat, a_max] structurally
+    — solvers without a tail contract, e.g. Epstein-Zin).
     """
-    a_grid = borrow_limit + make_asset_grid(a_min, a_max - borrow_limit,
-                                            a_count, a_nest_fac, dtype=dtype)
+    a_grid, dist_grid, _ = build_asset_grids(
+        grid, a_min, a_max, a_count, a_nest_fac, dist_count,
+        borrow_limit=borrow_limit, dtype=dtype, tail=grid_tail)
     tauchen = tauchen_labor_process(labor_states, labor_ar, labor_sd,
                                     bound=labor_bound, dtype=dtype)
     levels = normalized_labor_states(tauchen.grid)
     pi = stationary_distribution(tauchen.transition)
-    # Wealth histogram support: start at the borrowing limit, then an
-    # exp-mult grid up to a_max so mass near the constraint is resolved.
-    inner = make_grid_exp_mult(a_min, a_max - borrow_limit, dist_count - 1,
-                               a_nest_fac, dtype=dtype)
-    dist_grid = borrow_limit + jnp.concatenate(
-        [jnp.zeros((1,), dtype=inner.dtype), inner])
     return SimpleModel(a_grid=a_grid, labor_levels=levels,
                        transition=tauchen.transition, labor_stationary=pi,
                        dist_grid=dist_grid,
@@ -114,22 +213,35 @@ def initial_distribution(model) -> jnp.ndarray:
             .at[0, :].set(model.labor_stationary))
 
 
-def initial_policy(model: SimpleModel) -> HouseholdPolicy:
+def initial_policy(model: SimpleModel,
+                   analytic_tail: bool = False) -> HouseholdPolicy:
     """Terminal guess c(m) = m - b (consume all resources above the debt
     limit) — the reference's ``IdentityFunction`` terminal solution
     (``Aiyagari_Support.py:898``) expressed as knots with slope 1, shifted
-    so consumption stays positive under a negative borrowing limit."""
+    so consumption stays positive under a negative borrowing limit.
+
+    ``analytic_tail`` (grid compaction, DESIGN §5b): append the TWO
+    linear tail knots so the initial iterate already carries the compact
+    policy shape ``[N, A+3]``; the identity guess's tail slopes are 1
+    (the first EGM step replaces them with the local-MPC/asymptote
+    pair)."""
     n = model.labor_levels.shape[0]
     eps = jnp.asarray(CONSTRAINT_EPS, dtype=model.a_grid.dtype)
     b = jnp.asarray(model.borrow_limit, dtype=model.a_grid.dtype)
     m_row = jnp.concatenate([b[None] + eps, model.a_grid + eps])
     m_knots = jnp.tile(m_row, (n, 1))
-    return HouseholdPolicy(m_knots=m_knots, c_knots=m_knots - b)
+    c_knots = m_knots - b
+    if analytic_tail:
+        one = jnp.asarray(1.0, dtype=m_knots.dtype)
+        m_knots, c_knots = append_tail_knot(m_knots, c_knots, one)
+        m_knots, c_knots = append_tail_knot(m_knots, c_knots, one)
+    return HouseholdPolicy(m_knots=m_knots, c_knots=c_knots)
 
 
 def egm_step(policy: HouseholdPolicy, R, W, model: SimpleModel,
              disc_fac, crra,
-             matmul_precision=jax.lax.Precision.HIGHEST) -> HouseholdPolicy:
+             matmul_precision=jax.lax.Precision.HIGHEST,
+             analytic_tail: bool = False) -> HouseholdPolicy:
     """One EGM backward step on the [A, N] block.  The expectation over next
     states is a single [A,N']x[N',N] matmul (MXU-friendly), replacing the
     reference's per-state Python loop (``Aiyagari_Support.py:1479-1485``).
@@ -139,7 +251,19 @@ def egm_step(policy: HouseholdPolicy, R, W, model: SimpleModel,
     policy (r* moves >1bp) when EVERY step runs that way.  The mixed-
     precision ladder's descent phase (DESIGN §5) passes DEFAULT instead:
     bf16 matmul inputs, accumulation pinned to the iterate dtype via
-    ``preferred_element_type``, with the polish phase erasing the drift."""
+    ``preferred_element_type``, with the polish phase erasing the drift.
+
+    ``analytic_tail`` (grid compaction, DESIGN §5b — static): the model's
+    asset grid is the curved low-wealth region only, and the policy is
+    closed above its top endogenous knot by the TWO-knot analytic tail
+    (``_append_analytic_tail``: blended-slope approach segment, then the
+    asymptotic-MPC line ``ops.utility.asymptotic_mpc``) — every
+    evaluation above the knee (the ``c_next`` queries at high ``R a + W
+    l`` here, the distribution push-forward in ``wealth_transition``)
+    then rides the asymptotic linear form instead of grid interpolation.
+    Policy shape is ``[N, A+3]`` (constraint knot + A endogenous + two
+    tail knots).
+    """
     a = model.a_grid                                  # [A]
     m_next = R * a[:, None] + W * model.labor_levels[None, :]   # [A, N']
     # c_next(m) per next-state: rowwise interp with per-state knots.
@@ -157,6 +281,10 @@ def egm_step(policy: HouseholdPolicy, R, W, model: SimpleModel,
     b = jnp.asarray(model.borrow_limit, dtype=c_now.dtype)
     c_knots = jnp.concatenate([eps, c_now], axis=0).T   # [N, A+1]
     m_knots = jnp.concatenate([b + eps, m_now], axis=0).T
+    if analytic_tail:
+        m_knots, c_knots = _append_analytic_tail(
+            m_knots, c_knots, R, W, disc_fac, crra, model.labor_levels,
+            model.transition)
     return HouseholdPolicy(m_knots=m_knots, c_knots=c_knots)
 
 
@@ -440,6 +568,62 @@ def ladder_distribution_fixed_point(push_cheap, push_ref, dist0, tol: float,
     return dist, it_d + it_p, diff, status, phases
 
 
+# ---------------------------------------------------------------------------
+# Coarse-to-fine grid ladder (ISSUE 12, DESIGN §5b).
+# ---------------------------------------------------------------------------
+
+def _coarse_knot_indices(a_count: int) -> np.ndarray:
+    """Static subsample of a compact asset grid for the ladder's coarse
+    descent phase: every other point plus the top point (both endpoints
+    kept, so prolongation never extrapolates)."""
+    idx = np.arange(0, int(a_count), 2)
+    if idx[-1] != a_count - 1:
+        idx = np.append(idx, a_count - 1)
+    return idx
+
+
+def _restrict_policy(policy: HouseholdPolicy,
+                     idx: np.ndarray) -> HouseholdPolicy:
+    """Restrict a tail-closed fine policy ``[N, A+3]`` to the coarse knot
+    subset ``[N, Ac+3]``: constraint knot, the subsampled endogenous
+    knots, the two tail knots (recomputed analytically by the next EGM
+    step)."""
+    k = policy.m_knots.shape[1]
+    cols = np.concatenate([[0], 1 + idx, [k - 2, k - 1]])
+    return HouseholdPolicy(m_knots=policy.m_knots[:, cols],
+                           c_knots=policy.c_knots[:, cols])
+
+
+def _prolong_policy(pol_c: HouseholdPolicy, a_coarse, a_fine,
+                    borrow_limit, close_tail) -> HouseholdPolicy:
+    """Monotone prolongation of a coarse-grid policy onto the fine grid
+    (the ladder's coarse->fine hand-off): the coarse endogenous knot
+    curves ``a -> (m, c)`` are strictly increasing in ``a``, so linear
+    interpolation at the fine gridpoints (a superset containing both
+    endpoints) is strictly increasing too; the constraint knot is rebuilt
+    exactly and the analytic tail re-appended by ``close_tail``
+    (``_append_analytic_tail`` — the linear-tail extension).  Purely an
+    initial ITERATE for the polish phase — any prolongation error is
+    erased by subsequent exact EGM steps, convergence is still certified
+    by a plain-step diff."""
+    m_endo_c = pol_c.m_knots[:, 1:-2]                 # [N, Ac]
+    c_endo_c = pol_c.c_knots[:, 1:-2]
+    n = m_endo_c.shape[0]
+    dt = m_endo_c.dtype
+    aq = jnp.broadcast_to(jnp.asarray(a_fine, dtype=dt),
+                          (n,) + a_fine.shape)
+    ac = jnp.broadcast_to(jnp.asarray(a_coarse, dtype=dt),
+                          (n,) + a_coarse.shape)
+    m_endo = interp1d_rowwise(aq, ac, m_endo_c)
+    c_endo = interp1d_rowwise(aq, ac, c_endo_c)
+    eps = jnp.full((n, 1), CONSTRAINT_EPS, dtype=dt)
+    b = jnp.asarray(borrow_limit, dtype=dt)
+    m_k = jnp.concatenate([b + eps, m_endo], axis=1)
+    c_k = jnp.concatenate([eps, c_endo], axis=1)
+    m_k, c_k = close_tail(m_k, c_k)
+    return HouseholdPolicy(m_knots=m_k, c_knots=c_k)
+
+
 @functools.lru_cache(maxsize=None)
 def _pallas_egm_fixed_point_vmappable(tol: float, max_iter: int,
                                       accel_every: int):
@@ -494,6 +678,7 @@ def solve_household(R, W, model: SimpleModel, disc_fac, crra,
                     init_policy: HouseholdPolicy | None = None,
                     accel_every: int = 32, method: str = "xla",
                     precision: str = "reference",
+                    grid="reference",
                     return_phases: bool = False,
                     descent_fault_iter: int | None = None,
                     descent_fault_mode: str = "nan"):
@@ -530,11 +715,37 @@ def solve_household(R, W, model: SimpleModel, disc_fac, crra,
     kernel runs a single-precision program, so non-reference policies
     demote ``method`` to "xla".  ``descent_fault_iter`` (tests) wraps the
     DESCENT step with ``solver_health.inject_fault`` from that iteration
-    — the deterministic trigger for the escalation path.
+    — the deterministic trigger for the escalation path (precision AND
+    grid ladders alike).
+
+    ``grid`` (DESIGN §5b, ``utils.config.GRID_POLICIES``): "reference"
+    (default) solves on the model's grid as-is, bit-identical.
+    "compact"/"adaptive" expect a compact model
+    (``build_simple_model(grid=...)``) and (a) close every policy iterate
+    with the ANALYTIC linear-tail knot (slope = the asymptotic MPC), and
+    (b) run the coarse-to-fine grid ladder inside the jitted program:
+    descend on a static subsample of the compact grid to a floored
+    tolerance (``GridSpec.coarse_tol_factor`` x tol — composed with the
+    precision ladder: under "mixed" the coarse phase runs in the cheap
+    dtype), prolong the policy monotonically onto the compact grid
+    (``_prolong_policy``), and polish to the ORIGINAL ``tol`` at the
+    contract precision.  A NONFINITE/STALLED coarse phase escalates: the
+    polish restarts from the caller's initial iterate with the full
+    budget (``solver_health.GRID_ESCALATED`` note; counted in the
+    returned phases' ``escalated`` flag, the same slot the precision
+    escalation uses — the quarantine-level fallback to the dense
+    reference grid is the sweep ladder's job).  The VMEM kernel runs the
+    fixed reference knot layout, so compact grids demote ``method`` to
+    "xla" exactly like non-reference precision does.
     """
     spec = resolve_precision(precision)
-    p0 = initial_policy(model) if init_policy is None else init_policy
-    if not spec.two_phase:
+    gspec = resolve_grid(grid)
+    tail = gspec.compact
+    if tail and method in ("pallas", "auto"):
+        method = "xla"
+    p0 = (initial_policy(model, analytic_tail=tail)
+          if init_policy is None else init_policy)
+    if not spec.two_phase and not gspec.ladder:
         if method == "auto":
             from ..ops.pallas_kernels import pallas_egm_grid_tpu_available
             on_tpu = jax.default_backend() in ("tpu", "axon")
@@ -561,37 +772,120 @@ def solve_household(R, W, model: SimpleModel, disc_fac, crra,
             raise ValueError(f"method must be 'xla', 'pallas' or 'auto', "
                              f"got {method!r}")
         out = accelerated_policy_fixed_point(
-            lambda p: egm_step(p, R, W, model, disc_fac, crra),
+            lambda p: egm_step(p, R, W, model, disc_fac, crra,
+                               analytic_tail=tail),
             p0, tol, max_iter, accel_every)
         return _with_phases(out, return_phases)
 
-    # -- mixed / fast: the two-phase ladder (DESIGN §5) --------------------
     if method not in ("xla", "auto", "pallas"):
         raise ValueError(f"method must be 'xla', 'pallas' or 'auto', "
                          f"got {method!r}")
-    cheap = descent_dtype(model.a_grid.dtype)
-    model_c = cast_floating(model, cheap)
+
+    if not gspec.ladder:
+        # -- mixed / fast: the two-phase precision ladder (DESIGN §5) ------
+        cheap = descent_dtype(model.a_grid.dtype)
+        model_c = cast_floating(model, cheap)
+        Rc = jnp.asarray(R).astype(cheap)
+        Wc = jnp.asarray(W).astype(cheap)
+        bc = jnp.asarray(disc_fac).astype(cheap)
+        cc = jnp.asarray(crra).astype(cheap)
+
+        def step_cheap(p):
+            return egm_step(p, Rc, Wc, model_c, bc, cc,
+                            matmul_precision=DESCENT_MATMUL_PRECISION,
+                            analytic_tail=tail)
+
+        if descent_fault_iter is not None:
+            step_cheap = inject_fault(
+                step_cheap, descent_fault_mode,
+                at_iter=descent_fault_iter,
+                amplitude=10.0 * descent_tolerance(
+                    tol, cheap, POLICY_DESCENT_TOL_SCALE))
+        pol, it, diff, status, phases = ladder_policy_fixed_point(
+            step_cheap,
+            lambda p: egm_step(p, R, W, model, disc_fac, crra,
+                               analytic_tail=tail),
+            p0, tol,
+            descent_tolerance(tol, cheap, POLICY_DESCENT_TOL_SCALE),
+            max_iter, accel_every, polish=spec.polish, cheap_dtype=cheap)
+        return _with_phases((pol, it, diff, status), return_phases, phases)
+
+    # -- coarse-to-fine grid ladder, composed with the precision ladder ----
+    # (DESIGN §5b): ONE descent phase — subsampled grid, cheap dtype when
+    # the precision policy is two-phase — then ONE polish phase on the
+    # compact grid at the contract precision ("fast" keeps the cheap
+    # dtype and its relaxed tolerance, honestly).
+    ref_dt = model.a_grid.dtype
+    a_count = model.a_grid.shape[0]
+    idx = _coarse_knot_indices(a_count)
+    coarse_model = model._replace(a_grid=model.a_grid[idx])
+    cheap = descent_dtype(ref_dt) if spec.two_phase else ref_dt
+    mat_prec = (DESCENT_MATMUL_PRECISION if spec.two_phase
+                else jax.lax.Precision.HIGHEST)
+    cm_c = cast_floating(coarse_model, cheap)
     Rc = jnp.asarray(R).astype(cheap)
     Wc = jnp.asarray(W).astype(cheap)
     bc = jnp.asarray(disc_fac).astype(cheap)
     cc = jnp.asarray(crra).astype(cheap)
 
-    def step_cheap(p):
-        return egm_step(p, Rc, Wc, model_c, bc, cc,
-                        matmul_precision=DESCENT_MATMUL_PRECISION)
+    def step_coarse(p):
+        return egm_step(p, Rc, Wc, cm_c, bc, cc,
+                        matmul_precision=mat_prec, analytic_tail=True)
 
+    tol_d = gspec.coarse_tol_factor * float(tol)
+    if spec.two_phase:
+        tol_d = max(tol_d, descent_tolerance(tol, cheap,
+                                             POLICY_DESCENT_TOL_SCALE))
     if descent_fault_iter is not None:
-        step_cheap = inject_fault(step_cheap, descent_fault_mode,
-                                  at_iter=descent_fault_iter,
-                                  amplitude=10.0 * descent_tolerance(
-                                      tol, cheap, POLICY_DESCENT_TOL_SCALE))
-    pol, it, diff, status, phases = ladder_policy_fixed_point(
-        step_cheap,
-        lambda p: egm_step(p, R, W, model, disc_fac, crra),
-        p0, tol,
-        descent_tolerance(tol, cheap, POLICY_DESCENT_TOL_SCALE),
-        max_iter, accel_every, polish=spec.polish, cheap_dtype=cheap)
-    return _with_phases((pol, it, diff, status), return_phases, phases)
+        step_coarse = inject_fault(step_coarse, descent_fault_mode,
+                                   at_iter=descent_fault_iter,
+                                   amplitude=10.0 * tol_d)
+    p0_c = cast_floating(_restrict_policy(p0, idx), cheap)
+    pol_d, it_d, diff_d, status_d = accelerated_policy_fixed_point(
+        step_coarse, p0_c, tol_d, max_iter, accel_every)
+
+    ref_polish = spec.polish or not spec.two_phase
+    pol_dt = ref_dt if ref_polish else cheap
+    pol_model = model if ref_polish else cast_floating(model, cheap)
+    Rp = jnp.asarray(R).astype(pol_dt)
+    Wp = jnp.asarray(W).astype(pol_dt)
+    bp = jnp.asarray(disc_fac).astype(pol_dt)
+    cp = jnp.asarray(crra).astype(pol_dt)
+
+    def step_fine(p):
+        return egm_step(p, Rp, Wp, pol_model, bp, cp,
+                        matmul_precision=(jax.lax.Precision.HIGHEST
+                                          if ref_polish else mat_prec),
+                        analytic_tail=True)
+
+    tol_p = (float(tol) if ref_polish
+             else descent_tolerance(tol, cheap, POLICY_DESCENT_TOL_SCALE))
+    # Escalation (GRID_ESCALATED): a poisoned or floored coarse phase
+    # must not seed the polish — restart from the caller's initial
+    # iterate with the full budget, a pure compact-grid solve; the
+    # quarantine rung's grid="reference" re-solve is the dense-grid
+    # fallback beyond this.
+    escalated = (status_d == NONFINITE) | (status_d == STALLED)
+
+    def close_tail(mk, ck):
+        return _append_analytic_tail(mk, ck, Rp, Wp, bp, cp,
+                                     pol_model.labor_levels,
+                                     pol_model.transition)
+
+    prolonged = _prolong_policy(
+        cast_floating(pol_d, pol_dt), coarse_model.a_grid, model.a_grid,
+        model.borrow_limit, close_tail)
+    p0_fine = cast_floating(p0, pol_dt)
+    start = jax.tree.map(
+        lambda cold, warm: jnp.where(escalated, cold, warm),
+        p0_fine, prolonged)
+    pol, it_p, diff, status = accelerated_policy_fixed_point(
+        step_fine, start, tol_p, max_iter, _polish_cadence(accel_every))
+    pol = cast_floating(pol, ref_dt)
+    phases = PrecisionPhases(descent_steps=it_d, polish_steps=it_p,
+                             escalated=escalated)
+    return _with_phases((pol, it_d + it_p, diff.astype(ref_dt), status),
+                        return_phases, phases)
 
 
 def consumption_at(policy: HouseholdPolicy, m, state_idx=None):
@@ -789,6 +1083,18 @@ def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
     is already a direct-then-polish scheme).  ``return_phases`` appends a
     ``PrecisionPhases``; ``descent_fault_iter`` (tests) poisons the
     descent phase via ``solver_health.inject_fault``.
+
+    Grid-policy note (DESIGN §5b): this loop deliberately does NOT run
+    a coarse-to-fine support ladder.  It was built and measured: under
+    the bisection's warm-start carry every midpoint arrives with a
+    near-converged fine distribution, and restricting it to a coarse
+    support forces the slow accumulation mode to re-converge from the
+    O(h^2) coarse/fine stationary gap at every midpoint — 3x the total
+    steps and 2x the wall on the 12-cell golden sweep.  Compaction
+    reaches this loop through the model build instead (the compacted
+    histogram support itself); the coarse-to-fine ladder lives in the
+    POLICY loop, whose prolongation error the warm carry does not pay
+    repeatedly.
     """
     spec = resolve_precision(precision)
     trans = wealth_transition(policy, R, W, model)
@@ -848,6 +1154,7 @@ def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
     else:
         raise ValueError(f"method must be 'auto', 'scatter', 'dense', "
                          f"'pallas' or 'solve', got {method!r}")
+
     if not spec.two_phase:
         out = accelerated_distribution_fixed_point(
             push, dist0, tol, max_iter, accel_every)

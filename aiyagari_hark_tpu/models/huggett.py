@@ -27,7 +27,7 @@ import jax.numpy as jnp
 
 import numpy as np
 
-from ..ops.grids import make_asset_grid
+from ..ops.grids import make_asset_grid  # grid-ok: credit-crunch per-date grids (below)
 from .household import (
     HouseholdPolicy,
     SimpleModel,
@@ -231,7 +231,7 @@ def solve_credit_crunch(model_loose: SimpleModel, disc_fac, crra,
     a_min = float(model_loose.a_grid[0]) - b_loose
     # per-date end-of-period grids, host-built like build_simple_model's
     a_grids = jnp.asarray(np.stack([
-        b + np.asarray(make_asset_grid(a_min, a_max - b, a_count,
+        b + np.asarray(make_asset_grid(a_min, a_max - b, a_count,  # grid-ok: per-date grids must stay consistent with model_loose's reference layout
                                        a_nest_fac, dtype=jnp.float64))
         for b in b_path]), dtype=dtype)
     if np.isclose(b_path[0], b_loose) and not np.allclose(

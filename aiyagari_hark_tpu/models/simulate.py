@@ -229,9 +229,9 @@ def make_sim_dist_grid(cal: KSCalibration, dist_count: int = 500,
     """Histogram support for the simulator: 0 (borrowing limit) then an
     exp-mult grid up to ``top_factor`` x the policy grid's top, so the
     ergodic right tail is not clipped at the solution grid boundary."""
-    from ..ops.grids import make_grid_exp_mult
+    from ..ops.grids import make_grid_exp_mult  # grid-ok: KS panel histogram, reference parity
 
-    inner = make_grid_exp_mult(1e-3, top_factor * float(cal.a_grid[-1]),
+    inner = make_grid_exp_mult(1e-3, top_factor * float(cal.a_grid[-1]),  # grid-ok
                                dist_count - 1, 2, dtype=cal.a_grid.dtype)
     return jnp.concatenate([jnp.zeros((1,), dtype=inner.dtype), inner])
 

@@ -75,6 +75,25 @@ DIRECTION_EXPLICIT: Dict[str, str] = {
     "chips_speedup_4dev": UP,
     "chips_speedup_8dev": UP,
     "chips_mem_stats_devices": NEUTRAL,
+    # grid-compaction leg (ISSUE 12, bench --compaction-smoke): the
+    # sentinel grades the grid_* record from its first committed round —
+    # gridpoints DOWN is good (the compaction's whole point), reductions
+    # and certified counts UP.  grid_total_inner_steps_* and
+    # grid_*_wall_s resolve through the _steps/_s suffix rules;
+    # grid_r_drift_max_bp through _max_bp.
+    "grid_points_reference": NEUTRAL,     # config constant, not a metric
+    "grid_points_compact": DOWN,
+    "grid_total_inner_steps_reference": NEUTRAL,   # baseline side
+    "grid_total_inner_steps_compact": DOWN,
+    "grid_effective_gridpoint_steps_reference": NEUTRAL,
+    "grid_effective_gridpoint_steps_compact": DOWN,
+    "grid_point_reduction": UP,
+    "grid_step_reduction": UP,
+    "grid_wall_reduction": UP,
+    "grid_effective_reduction": UP,
+    "grid_cells_certified": UP,
+    "grid_escalations": DOWN,
+    "grid_knee": NEUTRAL,
 }
 
 # Suffix/affix rules, first match wins.  Kept coarse on purpose: bench

@@ -1,8 +1,18 @@
 """Numerics core: grids, Markov machinery, CRRA utility, batched
 interpolation, and masked OLS — the L1-equivalent layer (SURVEY.md §1)."""
 
-from .grids import make_asset_grid, make_grid_exp_mult
+from .grids import (
+    GRID_POLICIES,
+    GridSpec,
+    build_asset_grids,
+    compact_knee,
+    grid_point_counts,
+    make_asset_grid,
+    make_grid_exp_mult,
+    resolve_grid,
+)
 from .interp import (
+    append_tail_knot,
     eval_policy_agents,
     interp1d,
     interp1d_rowwise,
@@ -20,10 +30,18 @@ from .markov import (
     tauchen_labor_process,
 )
 from .regression import OLSResult, masked_ols
-from .utility import crra_utility, inverse_marginal_utility, marginal_utility
+from .utility import (
+    asymptotic_mpc,
+    crra_utility,
+    inverse_marginal_utility,
+    marginal_utility,
+)
 
 __all__ = [
     "make_asset_grid", "make_grid_exp_mult",
+    "GRID_POLICIES", "GridSpec", "resolve_grid", "build_asset_grids",
+    "compact_knee", "grid_point_counts",
+    "append_tail_knot", "asymptotic_mpc",
     "eval_policy_agents", "interp1d", "interp1d_rowwise", "interp_on_interp",
     "locate_in_grid",
     "TauchenResult", "aggregate_markov_matrix", "employment_markov_matrix",

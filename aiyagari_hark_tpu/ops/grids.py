@@ -1,4 +1,4 @@
-"""Grid construction utilities.
+"""Grid construction utilities — and the grid-policy resolution seam.
 
 TPU-native reimplementation of the grid semantics the reference relies on
 (`/root/reference/Aiyagari_Support.py:875-890` constructs the asset grid with
@@ -8,10 +8,25 @@ multi-exponential grid is a standard HARK/econ-ark utility: apply
 transformed coordinate, then invert.  Points therefore cluster near the lower
 endpoint, where the consumption function has curvature.
 
+Grid COMPACTION (ISSUE 12, DESIGN §5b): the consumption function is
+asymptotically linear in wealth (Ma-Stachurski-Toda arXiv:2002.09108), so
+the dense high-wealth region of the reference grids buys nothing — the
+curved region is confined to low wealth.  ``build_asset_grids`` is the ONE
+resolution seam from a ``utils.config.GridSpec`` to concrete grids:
+"reference" reproduces the historical grids bit-identically; "compact"/
+"adaptive" spend the (smaller) point budget only below a knee ``a_hat``
+and close the top either with an ANALYTIC linear tail (the solver appends
+a tail knot at the asymptotic MPC slope — ``models.household``) or with
+sparse geometric ANCHORS (the structural variant for solvers without a
+tail contract).  Solver hot paths must route through this seam —
+``scripts/check_grid_discipline.py`` bans direct ``make_asset_grid``/
+``make_grid_exp_mult`` calls there (waiver ``# grid-ok``).
+
 Grids are calibration constants with static sizes — they are built **once on
 host in NumPy float64** (so the nested log/exp roundtrip doesn't erode the
 endpoints) and cast to the requested device dtype at the end.  Never called
-inside jit.
+inside jit (under a trace they produce concrete constants: every input is
+static configuration).
 """
 
 from __future__ import annotations
@@ -19,20 +34,22 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
+# The grid-policy vocabulary lives next to the precision policy's in
+# utils.config (host-side, importable by utils.fingerprint without jax);
+# re-exported here because this module is the policy's resolution seam.
+from ..utils.config import GRID_POLICIES, GridSpec, resolve_grid
 
-def make_grid_exp_mult(ming: float, maxg: float, ng: int, timestonest: int = 20,
-                       dtype=None) -> jnp.ndarray:
-    """Multi-exponentially spaced grid on [ming, maxg] with ``ng`` points.
+__all__ = [
+    "GRID_POLICIES", "GridSpec", "resolve_grid",
+    "make_grid_exp_mult", "make_asset_grid",
+    "compact_knee", "build_asset_grids", "grid_point_counts",
+]
 
-    Matches the behavior of HARK's ``make_grid_exp_mult`` (called at
-    ``Aiyagari_Support.py:880`` with ``timestonest = aNestFac``): with
-    ``timestonest > 0`` the endpoints are pushed through ``log(1+x)`` that many
-    times, a linear grid is laid out in the nested-log coordinate, and the
-    transform is inverted pointwise.  ``timestonest == 0`` falls back to a
-    plain exponential (log-linear) grid.
-    """
-    if ng < 2:
-        raise ValueError("need at least two grid points")
+
+def _exp_mult_host(ming: float, maxg: float, ng: int,
+                   timestonest: int) -> np.ndarray:
+    """The host-side float64 exp-mult grid (the shared core of
+    ``make_grid_exp_mult`` and the compact builders)."""
     ming = np.float64(ming)
     maxg = np.float64(maxg)
     if timestonest > 0:
@@ -45,10 +62,188 @@ def make_grid_exp_mult(ming: float, maxg: float, ng: int, timestonest: int = 20,
             grid = np.exp(grid) - 1.0
     else:
         grid = np.exp(np.linspace(np.log(ming), np.log(maxg), ng))
-    return jnp.asarray(grid, dtype=dtype)
+    return grid
+
+
+def make_grid_exp_mult(ming: float, maxg: float, ng: int, timestonest: int = 20,
+                       dtype=None) -> jnp.ndarray:
+    """Multi-exponentially spaced grid on [ming, maxg] with ``ng`` points.
+
+    Matches the behavior of HARK's ``make_grid_exp_mult`` (called at
+    ``Aiyagari_Support.py:880`` with ``timestonest = aNestFac``): with
+    ``timestonest > 0`` the endpoints are pushed through ``log(1+x)`` that many
+    times, a linear grid is laid out in the nested-log coordinate, and the
+    transform is inverted pointwise.  ``timestonest == 0`` falls back to a
+    plain exponential (log-linear) grid.
+
+    Domain: both branches take logs of the lower endpoint — ``log(ming)``
+    directly at ``timestonest == 0``, ``log(1 + ming)`` nested otherwise —
+    so ``ming <= 0`` (resp. ``ming <= -1``) would silently produce
+    NaN/-inf gridpoints that poison every downstream fixed point.  Raise
+    the typed ``ValueError`` here instead (ISSUE 12 satellite).
+    """
+    if ng < 2:
+        raise ValueError("need at least two grid points")
+    if maxg <= ming:
+        raise ValueError(
+            f"grid endpoints must be ordered: ming={ming!r} >= maxg={maxg!r}")
+    if timestonest > 0:
+        if ming <= -1.0:
+            raise ValueError(
+                f"make_grid_exp_mult needs ming > -1 (log(1+x) nesting), "
+                f"got ming={ming!r}")
+    elif ming <= 0.0:
+        raise ValueError(
+            f"make_grid_exp_mult with timestonest=0 needs ming > 0 "
+            f"(log-linear spacing takes log(ming)), got ming={ming!r}")
+    return jnp.asarray(_exp_mult_host(ming, maxg, ng, timestonest),
+                       dtype=dtype)
 
 
 def make_asset_grid(a_min: float, a_max: float, a_count: int, nest_fac: int = 2,
                     dtype=None) -> jnp.ndarray:
     """End-of-period asset grid, reference defaults (0.001, 50, 32, nest 2)."""
     return make_grid_exp_mult(a_min, a_max, a_count, nest_fac, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Compacted grids (ISSUE 12 tentpole).
+# ---------------------------------------------------------------------------
+
+def compact_knee(spec: GridSpec, a_min: float, span: float, a_count: int,
+                 nest_fac: int) -> float:
+    """The knee ``a_hat`` separating the curved low-wealth region (dense
+    points) from the asymptotically-linear tail, on the UNSHIFTED span
+    ``[a_min, a_min + span]`` (the borrow-limit shift is applied by the
+    caller, exactly like the reference builders).
+
+    Static ``knee_frac`` places it at that fraction of the span;
+    ``knee_frac=None`` derives it from the reference grid's own density:
+    the gridpoint below which the reference exp-mult grid already spends
+    ``knee_density`` of its points — adaptive in the sense that a
+    finer/more-nested reference profile moves the knee with it."""
+    a_max = float(a_min) + float(span)
+    if spec.knee_frac is not None:
+        a_hat = float(a_min) + float(spec.knee_frac) * float(span)
+    else:
+        ref = _exp_mult_host(a_min, a_max, max(int(a_count), 2),
+                             nest_fac)
+        j = int(np.ceil(spec.knee_density * (len(ref) - 1)))
+        a_hat = float(ref[min(j, len(ref) - 2)])
+    # the knee must leave a real tail AND a real curved region
+    lo = float(a_min) + 0.05 * float(span)
+    hi = float(a_min) + 0.8 * float(span)
+    return float(min(max(a_hat, lo), hi))
+
+
+def _thin_tail(tail_ref: np.ndarray, n_keep: int) -> np.ndarray:
+    """Evenly-thinned subset of the reference tail points, FIRST and LAST
+    always kept (the top point is the support span — dropping it would
+    silently shrink the domain savings are clipped into)."""
+    n_keep = max(2, min(int(n_keep), len(tail_ref)))
+    idx = np.unique(np.round(
+        np.linspace(0, len(tail_ref) - 1, n_keep)).astype(int))
+    return tail_ref[idx]
+
+
+def _compact_host_grids(spec: GridSpec, a_min: float, span: float,
+                        a_count: int, nest_fac: int, dist_count: int,
+                        tail: str):
+    """Host-side compact (solver points, histogram inner points, knee) —
+    truncation of the reference grids (see ``build_asset_grids``)."""
+    a_hat = compact_knee(spec, a_min, span, a_count, nest_fac)
+    ref_a = _exp_mult_host(a_min, span, a_count, nest_fac)
+    curved = ref_a[ref_a <= a_hat]
+    if len(curved) < 4:
+        curved = ref_a[:4]
+    if tail == "anchors":
+        tail_a = ref_a[len(curved):]
+        if len(tail_a):
+            curved = np.concatenate(
+                [curved, _thin_tail(tail_a, spec.tail_points)])
+    ref_d = _exp_mult_host(a_min, span, dist_count - 1, nest_fac)
+    low = ref_d[ref_d <= a_hat]
+    tail_d = ref_d[len(low):]
+    if len(tail_d):
+        n_keep = max(spec.tail_points,
+                     int(np.ceil(spec.dist_tail_frac * len(tail_d))))
+        inner = np.concatenate([low, _thin_tail(tail_d, n_keep)])
+    else:
+        inner = low
+    return curved, inner, a_hat
+
+
+def build_asset_grids(grid, a_min: float, a_max: float, a_count: int,
+                      nest_fac: int, dist_count: int,
+                      borrow_limit: float = 0.0, dtype=None,
+                      tail: str = "analytic"):
+    """THE grid-policy resolution seam (DESIGN §5b): concrete
+    (end-of-period asset grid, wealth-histogram support) for one model
+    build.  Returns ``(a_grid, dist_grid, a_hat)`` with ``a_hat`` the
+    knee (``None`` under "reference").
+
+    ``grid="reference"`` reproduces ``models.household.build_simple_model``'s
+    historical construction BIT-identically (same calls, same order, same
+    dtype casts).  Under "compact"/"adaptive" the compaction is a
+    TRUNCATION of those same reference grids — the kept points are
+    bit-identical subsets, so the curved region's discretization (and
+    its contribution to r*) is exactly the goldens' own:
+
+    * the solver grid keeps every reference point below the knee
+      ``a_hat`` and drops the tail.  With ``tail="analytic"`` the solver
+      closes the top with an analytic linear-tail knot at the asymptotic
+      MPC slope (``models.household.egm_step`` — evaluation above the
+      knee rides the asymptotic linear form instead of grid
+      interpolation); with ``tail="anchors"`` an evenly-thinned subset
+      of the reference tail points closes [a_hat, a_max] structurally
+      (solvers without a tail contract: the anchors are exact solution
+      points and the long segments between them are near-exact by
+      asymptotic linearity);
+    * the histogram support keeps its full reference density below the
+      knee and crosses the tail on an evenly-thinned reference subset
+      (``dist_tail_frac``; the top point is always kept) — the
+      two-point lottery preserves the MEAN of assets exactly and the
+      policy is asymptotically linear there, so tail coarseness is a
+      second-order (curvature x spacing^2) effect.
+
+    ``borrow_limit`` b <= 0 shifts both grids exactly as the reference
+    construction does.
+    """
+    spec = resolve_grid(grid)
+    span = a_max - borrow_limit
+
+    if not spec.compact:
+        a_grid = borrow_limit + make_asset_grid(a_min, span, a_count,
+                                                nest_fac, dtype=dtype)
+        inner = make_grid_exp_mult(a_min, span, dist_count - 1,
+                                   nest_fac, dtype=dtype)
+        dist_grid = borrow_limit + jnp.concatenate(
+            [jnp.zeros((1,), dtype=inner.dtype), inner])
+        return a_grid, dist_grid, None
+
+    if tail not in ("analytic", "anchors"):
+        raise ValueError(f"tail must be 'analytic' or 'anchors', "
+                         f"got {tail!r}")
+    curved, inner, a_hat = _compact_host_grids(
+        spec, a_min, span, a_count, nest_fac, dist_count, tail)
+    a_grid = borrow_limit + jnp.asarray(curved, dtype=dtype)
+    inner = jnp.asarray(inner, dtype=dtype)
+    dist_grid = borrow_limit + jnp.concatenate(
+        [jnp.zeros((1,), dtype=inner.dtype), inner])
+    return a_grid, dist_grid, float(a_hat)
+
+
+def grid_point_counts(grid, a_count: int, dist_count: int,
+                      a_min: float = 0.001, a_max: float = 50.0,
+                      nest_fac: int = 2, borrow_limit: float = 0.0,
+                      tail: str = "analytic") -> tuple:
+    """Host-side (solver points, histogram points) one model build will
+    use under ``grid`` — the bench's gridpoint-reduction accounting,
+    computed without building a model."""
+    spec = resolve_grid(grid)
+    if not spec.compact:
+        return int(a_count), int(dist_count)
+    curved, inner, _ = _compact_host_grids(
+        spec, a_min, a_max - borrow_limit, a_count, nest_fac,
+        dist_count, tail)
+    return int(len(curved)), int(len(inner)) + 1

@@ -75,6 +75,33 @@ def eval_policy_agents(m: jnp.ndarray, state_idx: jnp.ndarray, M: jnp.ndarray,
     return v0 + w * (v1 - v0)
 
 
+def append_tail_knot(m_knots: jnp.ndarray, c_knots: jnp.ndarray, slope):
+    """Close a knot-array policy with an ANALYTIC linear tail (ISSUE 12,
+    DESIGN §5b): append one knot per state at a span beyond the last
+    endogenous knot, placed on the line of the given ``slope``.
+
+    Because ``interp1d`` extrapolates beyond the last knot along the
+    terminal segment, every evaluation above the previous top knot —
+    interior of the tail segment and beyond it alike — then rides
+    ``c(m) = c_top + slope * (m - m_top)``: the asymptotic linear form
+    (slope = the model's MPC limit, ``ops.utility.asymptotic_mpc``)
+    replaces grid interpolation above the compaction knee.  The span is
+    scale-proportional (one grid-width past the top knot, floored at 1)
+    so the synthetic knot stays strictly monotone in ``m`` for any
+    borrow limit; its exact position is immaterial — the segment and its
+    extrapolation share one slope.
+
+    ``m_knots``/``c_knots``: [N, K]; ``slope`` a (possibly traced)
+    scalar in (0, 1).  Returns [N, K+1] arrays.
+    """
+    m_top = m_knots[:, -1:]
+    span = jnp.maximum(m_top - m_knots[:, :1], 1.0)
+    m_tail = m_top + span
+    c_tail = c_knots[:, -1:] + slope * span
+    return (jnp.concatenate([m_knots, m_tail], axis=1),
+            jnp.concatenate([c_knots, c_tail], axis=1))
+
+
 def locate_in_grid(x: jnp.ndarray, grid: jnp.ndarray):
     """Bracket index and right-neighbor weight for histogram (Young-method)
     lotteries: ``x`` lands between ``grid[i]`` and ``grid[i+1]`` with weight

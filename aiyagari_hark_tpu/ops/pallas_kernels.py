@@ -244,7 +244,14 @@ def egm_policy_pallas(m0: jnp.ndarray, c0: jnp.ndarray, a_grid: jnp.ndarray,
     (R, W, disc_fac, crra, borrow_limit).  Returns
     (m_knots, c_knots, n_iter, final_diff) — the
     ``accelerated_policy_fixed_point`` contract minus the status code,
-    which ``solve_household`` reconstructs from (iters, diff)."""
+    which ``solve_household`` reconstructs from (iters, diff).
+
+    Grid-policy note (DESIGN §5b): this kernel runs the fixed REFERENCE
+    knot layout ([N, A+1]: constraint + A endogenous) — the compact
+    policies' analytic tail knot and coarse-to-fine ladder live on the
+    XLA path only, so ``solve_household`` demotes ``method`` to "xla"
+    under a non-reference ``grid`` exactly as it does under
+    non-reference precision."""
     from jax.experimental import pallas as pl
 
     if interpret is None:

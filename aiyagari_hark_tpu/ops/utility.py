@@ -42,6 +42,27 @@ def inverse_marginal_utility(vp: jnp.ndarray, crra: float) -> jnp.ndarray:
     return vp ** (-1.0 / crra)
 
 
+def asymptotic_mpc(R, disc_fac, crra):
+    """The asymptotic marginal propensity to consume — the grid-compaction
+    tail slope (ISSUE 12, DESIGN §5b).
+
+    Ma-Stachurski-Toda (arXiv:2002.09108) show the income-fluctuation
+    consumption function is asymptotically linear, ``c(m)/m -> kappa``,
+    and with CERTAIN returns the limit slope is the perfect-foresight
+    MPC::
+
+        kappa = 1 - (beta R)^(1/crra) / R
+
+    On the economic bisection bracket ``r < (1-beta)/beta`` we have
+    ``beta R < 1`` so ``0 < kappa < 1`` — the analytic tail's slope is a
+    valid consumption slope and the implied savings slope ``R (1-kappa)
+    = (beta R)^(1/crra)`` lies in (0, 1): savings grow sublinearly, the
+    ordering the committed ``afunc_slope`` artifact pins for the
+    aggregate law (``tests/test_artifacts.py``: slopes in (0, 1.2)).
+    All arguments may be traced (sweep axes)."""
+    return 1.0 - (disc_fac * R) ** (1.0 / crra) / R
+
+
 def inverse_utility(v: jnp.ndarray, crra) -> jnp.ndarray:
     """u^{-1}(v): the consumption level whose one-period utility is ``v`` —
     the "value-inverse" (HARK's vNvrs) transform that makes CRRA value

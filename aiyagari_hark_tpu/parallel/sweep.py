@@ -322,6 +322,13 @@ def _retry_ladder(model_kwargs: dict) -> tuple:
     # distribution method (DESIGN §5).
     if model_kwargs.get("precision", "reference") != "reference":
         rungs = tuple({**r, "precision": "reference"} for r in rungs)
+    # Same rule for a non-reference GRID policy (DESIGN §5b): quarantine
+    # escalates to the DENSE REFERENCE grid — the in-program
+    # GRID_ESCALATED fallback already retried the coarse phase on the
+    # compact grid, so the rungs must re-solve at the one grid layout the
+    # goldens certify.
+    if model_kwargs.get("grid", "reference") != "reference":
+        rungs = tuple({**r, "grid": "reference"} for r in rungs)
     return rungs
 
 
@@ -1095,6 +1102,13 @@ def _run_sweep_impl(scn, sweep, cells_nom, mesh, axis, dtype, timer,
         fault_iters[int(inject_fault["cell"])] = int(
             inject_fault.get("at_iter", 0))
 
+    # SweepConfig.grid (DESIGN §5b) is a model-kwarg DEFAULT: an explicit
+    # run_sweep(..., grid=...) kwarg wins, and the resolved spelling rides
+    # kwargs_items into every fingerprint below (hashable_kwargs drops an
+    # explicit "reference", so the two default spellings cannot split a
+    # cache or a ledger)
+    if sweep.grid != "reference":
+        model_kwargs.setdefault("grid", sweep.grid)
     # family-level sweep kwarg defaults (e.g. Aiyagari's backend-aware
     # dist_method/egm_method selection) applied IN PLACE; the returned
     # metadata records what actually runs

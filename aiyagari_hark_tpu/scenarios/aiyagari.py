@@ -68,6 +68,7 @@ def _prepare_kwargs(model_kwargs: dict) -> dict:
     import jax
 
     two_phase = model_kwargs.get("precision", "reference") != "reference"
+    compacted = model_kwargs.get("grid", "reference") != "reference"
     if "dist_method" not in model_kwargs:
         # Sweep-level default, distinct from stationary_wealth's "auto".
         # On accelerators: "pallas" — the lane-grid kernel (one program
@@ -98,8 +99,12 @@ def _prepare_kwargs(model_kwargs: dict) -> dict:
         # Same default logic for the POLICY loop (ISSUE 2 tentpole): the
         # lane-grid EGM kernel lets a converged cell stop burning MXU
         # cycles instead of lock-stepping to the slowest lane; probe-gated
-        # with the XLA while_loop as the universal fallback.
-        if jax.default_backend() in ("tpu", "axon") and not two_phase:
+        # with the XLA while_loop as the universal fallback.  A compact
+        # grid policy (DESIGN §5b) demotes to "xla" like non-reference
+        # precision: the VMEM kernel runs the fixed reference knot
+        # layout, not the tail-closed compact one.
+        if (jax.default_backend() in ("tpu", "axon") and not two_phase
+                and not compacted):
             from ..ops.pallas_kernels import pallas_egm_grid_tpu_available
             model_kwargs["egm_method"] = (
                 "pallas" if pallas_egm_grid_tpu_available() else "xla")

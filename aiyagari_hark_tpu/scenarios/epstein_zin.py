@@ -143,17 +143,22 @@ def solve_ez_cell(gamma, rho, sd=0.2, dtype=None, disc_fac=0.96,
                   ez_rho=2.0, cap_share=0.36, depr_fac=0.08,
                   labor_states=7, labor_bound=3.0, a_min=0.001,
                   a_max=50.0, a_count=32, a_nest_fac=2, dist_count=500,
+                  grid="reference",
                   **solver_kwargs) -> EZLean:
     """Build the model for one (gamma, rho, sd) cell and run the lean EZ
     solver.  ``ez_rho`` (1/EIS) is a static sweep kwarg; gamma is the
     swept risk-aversion axis."""
     from ..models.household import build_simple_model
 
+    # EZ has no analytic-tail contract (the recursive value's tail
+    # form is not the CRRA MPC line), so compact grids take the
+    # STRUCTURAL tail: thinned reference anchors close [a_hat, a_max]
+    # and the solver runs unchanged on the compacted knots (DESIGN §5b)
     model = build_simple_model(
         labor_states=labor_states, labor_ar=rho, labor_sd=sd,
         labor_bound=labor_bound, a_min=a_min, a_max=a_max,
         a_count=a_count, a_nest_fac=a_nest_fac, dist_count=dist_count,
-        dtype=dtype)
+        grid=grid, grid_tail="anchors", dtype=dtype)
     return solve_ez_lean(model, disc_fac, gamma, ez_rho, cap_share,
                          depr_fac, **solver_kwargs)
 
@@ -215,7 +220,7 @@ def _eager_row(cell, dtype, model_kwargs) -> np.ndarray:
 def _retry_rungs(model_kwargs: dict) -> tuple:
     prior = model_kwargs.get("dist_method", "auto")
     alternate = "dense" if prior in ("auto", "scatter") else "scatter"
-    return (
+    rungs = (
         {"dist_method": alternate},
         {"dist_method": alternate, "accel_every": 0},
         # the EZ certainty-equivalent powers overflow before the bracket
@@ -223,6 +228,11 @@ def _retry_rungs(model_kwargs: dict) -> tuple:
         {"dist_method": alternate, "accel_every": 0,
          "max_bisect": int(model_kwargs.get("max_bisect", 60)) + 20},
     )
+    # grid escalation (DESIGN §5b): quarantine re-solves on the dense
+    # reference grid, the one layout the goldens certify
+    if model_kwargs.get("grid", "reference") != "reference":
+        rungs = tuple({**r, "grid": "reference"} for r in rungs)
+    return rungs
 
 
 def _prepare_kwargs(model_kwargs: dict) -> dict:
@@ -266,7 +276,8 @@ def _ez_certifier(dtype, kwargs_items=()):
         build, price, egm_tol, dist_tol = _split_kwargs(
             {**model_kwargs, "__dtype__": dtype})
         model = build_simple_model(labor_ar=rho, labor_sd=sd,
-                                   dtype=dtype, **build)
+                                   grid_tail="anchors", dtype=dtype,
+                                   **build)
         k_to_l = k_to_l_from_r(r_star, price["cap_share"],
                                price["depr_fac"])
         W = wage_rate(k_to_l, price["cap_share"])

@@ -69,6 +69,7 @@ def solve_huggett_lean(model, disc_fac, crra, r_tol=None,
                        r_lo: float = -0.10, dist_method: str = "auto",
                        accel_every: int = 32,
                        precision: str = "reference",
+                       grid="reference",
                        bracket_init=None, fault_iter=None,
                        fault_mode=None) -> HuggettLean:
     """Bisect the bond rate until the credit market clears (E[a] = 0),
@@ -112,7 +113,11 @@ def solve_huggett_lean(model, disc_fac, crra, r_tol=None,
         dist_tol = 1e-11 if f64 else 1e-8
     hi_full = jnp.asarray(1.0 / disc_fac - 1.0 - 1e-4, dtype=dtype)
     lo_cold = jnp.asarray(r_lo, dtype=dtype)
-    p0 = initial_policy(model)
+    # compact grid policies (DESIGN §5b) close the carried policy with the
+    # analytic tail knot — the initial iterate must share that shape
+    from ..utils.config import resolve_grid
+
+    p0 = initial_policy(model, analytic_tail=resolve_grid(grid).compact)
     d0 = initial_distribution(model)
     zi = jnp.asarray(0, dtype=jnp.int32)
 
@@ -120,7 +125,7 @@ def solve_huggett_lean(model, disc_fac, crra, r_tol=None,
         policy, e_it, _, e_st = solve_household(
             1.0 + r, 1.0, model, disc_fac, crra, tol=egm_tol,
             init_policy=pol_in, accel_every=accel_every,
-            precision=precision)
+            precision=precision, grid=grid)
         dist, d_it, _, d_st = stationary_wealth(
             policy, 1.0 + r, 1.0, model, tol=dist_tol,
             init_dist=dist_in, method=dist_method, precision=precision)
@@ -226,6 +231,7 @@ def solve_huggett_cell(crra, rho, sd=0.2, dtype=None, disc_fac=0.96,
                        labor_states=7, labor_bound=3.0, a_min=0.001,
                        a_max=50.0, a_count=32, a_nest_fac=2,
                        dist_count=500, borrow_limit=-2.0,
+                       grid="reference",
                        **solver_kwargs) -> HuggettLean:
     """Build the bond-economy model for one (crra, rho, sd) cell and run
     the lean solver — the Huggett analogue of
@@ -236,8 +242,9 @@ def solve_huggett_cell(crra, rho, sd=0.2, dtype=None, disc_fac=0.96,
         labor_states=labor_states, labor_ar=rho, labor_sd=sd,
         labor_bound=labor_bound, a_min=a_min, a_max=a_max,
         a_count=a_count, a_nest_fac=a_nest_fac, dist_count=dist_count,
-        borrow_limit=borrow_limit, dtype=dtype)
-    return solve_huggett_lean(model, disc_fac, crra, **solver_kwargs)
+        borrow_limit=borrow_limit, grid=grid, dtype=dtype)
+    return solve_huggett_lean(model, disc_fac, crra, grid=grid,
+                              **solver_kwargs)
 
 
 @lru_cache(maxsize=None)
@@ -320,6 +327,10 @@ def _retry_rungs(model_kwargs: dict) -> tuple:
     )
     if model_kwargs.get("precision", "reference") != "reference":
         rungs = tuple({**r, "precision": "reference"} for r in rungs)
+    # grid escalation (DESIGN §5b): quarantine re-solves on the dense
+    # reference grid, the one layout the goldens certify
+    if model_kwargs.get("grid", "reference") != "reference":
+        rungs = tuple({**r, "grid": "reference"} for r in rungs)
     return rungs
 
 
@@ -386,9 +397,14 @@ def _huggett_certifier(dtype, kwargs_items=()):
         model = build_simple_model(labor_ar=rho, labor_sd=sd,
                                    dtype=dtype, **build)
         R = 1.0 + r_star
+        # the certifier re-solves on the SAME grid layout the production
+        # solve used (DESIGN §5b): under a compact policy the reference
+        # policy must carry the analytic tail closure too, exactly as
+        # the aiyagari recompute certifier does
         policy, _, _, e_st = solve_household(
             R, 1.0, model, price["disc_fac"], crra, tol=egm_tol,
-            method="xla", precision="reference")
+            method="xla", precision="reference",
+            grid=build.get("grid", "reference"))
         dist, _, _, d_st = stationary_wealth(
             policy, R, 1.0, model, tol=dist_tol,
             method=_cert_dist_method(build), precision="reference")

@@ -279,7 +279,15 @@ def make_query(crra: float, labor_ar: float, labor_sd: float = 0.2,
     they shape admission, never the answer's bits.  ``scenario`` names
     the registered model family (ISSUE 9) — validated HERE, so a typo
     raises the typed ``scenarios.UnknownScenarioError`` at build time
-    instead of silently addressing a fresh cache namespace."""
+    instead of silently addressing a fresh cache namespace.
+
+    ``precision`` and ``grid`` policy kwargs ride ``model_kwargs`` and
+    are validated/canonicalized by ``hashable_kwargs`` (explicit
+    defaults dropped — the no-drift pin; unknown policies raise here at
+    build time): a ``grid="compact"`` query therefore keys its OWN
+    store entries, donor groups, and executables — a compacted solution
+    can never be served for a reference query or vice versa (DESIGN
+    §5b)."""
     from ..parallel.sweep import _canonical_dtype
     from ..scenarios.registry import get_scenario
 

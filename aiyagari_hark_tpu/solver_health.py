@@ -92,6 +92,18 @@ STATUS_NAMES = ("CONVERGED", "STALLED", "MAX_ITER", "NONFINITE",
 # ``"note"`` key.
 PRECISION_ESCALATED = "PRECISION_ESCALATED"
 
+# NOTE marker, same family (DESIGN §5b): a grid ladder's COARSE phase
+# exited NONFINITE or STALLED and the polish restarted cold on the
+# compact grid with the full budget — the in-program escalation.  The
+# out-of-program escalation to the DENSE REFERENCE grid is the sweep
+# quarantine ladder's job (every rung forces ``grid="reference"``), so a
+# cell can only ever fail at the configuration the goldens certify.
+# Counted in the same ladder-escalation slot as PRECISION_ESCALATED
+# (``PrecisionPhases.escalated`` / ``SweepResult.precision_escalations``
+# — one counter of "the cheap phase was abandoned", whichever ladder it
+# belonged to).
+GRID_ESCALATED = "GRID_ESCALATED"
+
 
 def status_name(code) -> str:
     """Host-side pretty name for one integer status code."""

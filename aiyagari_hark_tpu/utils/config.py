@@ -138,6 +138,107 @@ def resolve_precision(policy) -> PrecisionSpec:
             f"got {policy!r}") from None
 
 
+# -- grid policy (ISSUE 12, DESIGN §5b) --------------------------------------
+#
+# Every fixed point, device transfer, and compile in the framework scales
+# with the grid sizes, and the reference spends dense gridpoints on the
+# high-wealth region where the consumption function is provably almost
+# linear (Ma-Stachurski-Toda arXiv:2002.09108: the curved region is
+# confined to low wealth; the policy approaches a line whose slope is the
+# perfect-foresight MPC).  The grid POLICY makes that trade explicit, the
+# exact shape of the precision policy above:
+#
+# * ``"reference"`` (default) — today's grids, bit-identical: the full
+#   exp-mult asset/histogram grids of ``ops.grids.make_asset_grid``.
+# * ``"compact"`` — spend the point budget only on the curved low-wealth
+#   region [a_min, a_hat] and close the top with an ANALYTIC linear tail:
+#   above the knee, policy evaluation and the distribution push-forward
+#   ride a linear segment whose slope is the model's asymptotic MPC
+#   (``ops.utility.asymptotic_mpc``) instead of grid interpolation.  A
+#   coarse-to-fine grid ladder runs inside the jitted program (descend on
+#   a subsampled grid to a floored tolerance, prolong monotonically,
+#   polish on the compact grid — composed with the precision ladder's
+#   phases).  Scenario solvers without a tail contract (Epstein-Zin) get
+#   the structural variant: sparse geometric anchors close [a_hat, a_max].
+# * ``"adaptive"`` — like "compact" with the knee chosen from the
+#   reference grid's own point-density profile (the wealth level below
+#   which the reference already spends ``knee_density`` of its points)
+#   and a slightly tighter point budget.
+#
+# The tolerance/certification contract is UNCHANGED under every policy:
+# ``verify.certify_equilibrium``'s off-grid Euler midpoint check is the
+# referee (the tail segment's midpoint directly measures the linearity
+# error), and a failed/STALLED coarse phase escalates
+# (``solver_health.GRID_ESCALATED``; quarantine rungs force
+# ``grid="reference"`` — the dense-grid fallback).
+
+GRID_POLICIES = ("reference", "compact", "adaptive")
+
+
+@dataclass(frozen=True)
+class GridSpec:
+    """Resolved knobs for one grid policy (DESIGN §5b).
+
+    Compaction is TRUNCATION-based: the compact grids keep the reference
+    gridpoints below the knee BIT-exactly (nested grids — the curved
+    region's discretization, and therefore its contribution to r*, is
+    the goldens' own) and drop/thin only the asymptotically-linear tail.
+
+    ``compact`` — compaction is active: the solver grid is truncated at
+    the knee and closed with a linear tail; the histogram keeps its
+    reference density below the knee and crosses the tail on a thinned
+    point subset.  ``ladder`` — the in-program coarse-to-fine policy
+    ladder runs (subsampled descent, monotone prolongation, compact-grid
+    polish).  ``knee_frac`` — static knee position as a fraction of the
+    grid span (None = density knee); ``knee_density`` — the reference
+    solver-grid point quantile the density knee sits at (0.85 = the knee
+    is where the reference has already spent 85% of its points — above
+    it the exp-mult spacing is wide and the policy provably near-linear).
+    ``dist_tail_frac`` — the fraction of reference HISTOGRAM tail points
+    kept (evenly thinned, top point always kept so the support span is
+    unchanged).  ``tail_points`` — minimum tail points, and the anchor
+    count for the structural ("anchors") solver-tail variant.
+    ``coarse_tol_factor`` — the grid ladder's descent-tolerance
+    relaxation over the requested tol."""
+
+    policy: str
+    compact: bool
+    ladder: bool
+    knee_frac: Optional[float] = None
+    knee_density: float = 0.85
+    dist_tail_frac: float = 0.5
+    tail_points: int = 6
+    coarse_tol_factor: float = 50.0
+
+
+_GRID_SPECS = {
+    "reference": GridSpec("reference", compact=False, ladder=False),
+    "compact": GridSpec("compact", compact=True, ladder=True,
+                        knee_frac=None, knee_density=0.85,
+                        dist_tail_frac=0.5, tail_points=6,
+                        coarse_tol_factor=50.0),
+    "adaptive": GridSpec("adaptive", compact=True, ladder=True,
+                         knee_frac=None, knee_density=0.75,
+                         dist_tail_frac=0.34, tail_points=6,
+                         coarse_tol_factor=50.0),
+}
+
+
+def resolve_grid(policy) -> GridSpec:
+    """Validate a grid policy name (or pass a spec through) — the ONE
+    validation surface, mirrored on ``resolve_precision``: an unknown
+    policy raises here, before it can alias a real one in any cache key
+    (``utils.fingerprint.hashable_kwargs`` routes through this)."""
+    if isinstance(policy, GridSpec):
+        return policy
+    try:
+        return _GRID_SPECS[policy]
+    except (KeyError, TypeError):
+        raise ValueError(
+            f"grid policy must be one of {GRID_POLICIES}, "
+            f"got {policy!r}") from None
+
+
 # Packed device-row layout of the AIYAGARI batched cell solver: ONE
 # stacked float row per cell means ONE device->host transfer per launch
 # (the round-5 packing rationale, ``parallel.sweep._batched_solver``).
@@ -318,6 +419,19 @@ class SweepConfig:
       ``verify.CertThresholds`` for this configuration, recorded
       per-cell in ``SweepResult.cert_level``.
 
+    Grid knob (ISSUE 12, DESIGN §5b):
+
+    * ``grid`` — the grid policy every cell solves under
+      (``GRID_POLICIES``): "reference" (default, bit-identical dense
+      grids), "compact"/"adaptive" (curved-region point budget +
+      analytic linear tail + in-program coarse-to-fine ladder).
+      Applied as a model-kwarg default — an explicit
+      ``run_sweep(..., grid=...)`` kwarg wins — so it rides every
+      fingerprint (sidecar, resume ledger, store keys) through the
+      same ``hashable_kwargs`` normalization as ``precision``.
+      Quarantine rungs force ``grid="reference"`` (the dense-grid
+      escalation).
+
     Observability knob (ISSUE 7, DESIGN §10):
 
     * ``obs`` — an ``obs.ObsConfig``: run-scoped tracing spans
@@ -343,6 +457,7 @@ class SweepConfig:
     resume_path: str | None = None
     recheck_fraction: float = 0.0
     certify: bool = False
+    grid: str = "reference"
     obs: Optional[ObsConfig] = None
 
     def replace(self, **kwargs) -> "SweepConfig":

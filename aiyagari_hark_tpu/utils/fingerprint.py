@@ -26,7 +26,7 @@ import json
 
 import numpy as np
 
-from .config import resolve_precision
+from .config import resolve_grid, resolve_precision
 
 
 class IntegrityError(RuntimeError):
@@ -141,7 +141,14 @@ def hashable_kwargs(model_kwargs: dict) -> tuple:
     mix — on a no-op spelling).  Non-default policies stay in the items
     and therefore key every cache downstream (the cross-policy inequality
     pinned by ``tests/test_fingerprint.py``); an unknown policy fails
-    here, before it can silently alias a real one."""
+    here, before it can silently alias a real one.
+
+    Grid-policy normalization (DESIGN §5b): the IDENTICAL rule for
+    ``grid`` — explicit "reference" dropped (no-drift pin), non-default
+    policies hashed by canonical name so compacted solves key their own
+    sidecars/ledgers/store entries (a ledger or store entry written
+    under one grid layout is structurally unaddressable from another),
+    unknown policies raise via ``resolve_grid`` before they can alias."""
     items = []
     for k, v in sorted(model_kwargs.items()):
         if k == "precision":
@@ -149,6 +156,12 @@ def hashable_kwargs(model_kwargs: dict) -> tuple:
             # (an unknown policy raises here, before it can alias a real
             # one in any cache key); hash the canonical policy name
             v = resolve_precision(v).policy
+            if v == "reference":
+                continue
+        if k == "grid":
+            # same authority pattern: resolve_grid validates and
+            # canonicalizes (DESIGN §5b)
+            v = resolve_grid(v).policy
             if v == "reference":
                 continue
         if isinstance(v, (list, np.ndarray)):

@@ -1969,6 +1969,147 @@ def _profile_smoke() -> dict:
     return record
 
 
+# Compaction smoke (ISSUE 12): grid-compaction acceptance on the
+# committed-golden 12-cell configuration — the compact policy must keep
+# every cell CERTIFIED with r* within 0.1bp of the committed goldens
+# while measurably shrinking gridpoints, inner-step work, and wall.
+COMPACTION_SMOKE_KWARGS = dict(a_count=24, dist_count=150)
+COMPACTION_DRIFT_BUDGET_BP = 0.1
+
+
+def _compaction_smoke() -> dict:
+    """The ``--compaction-smoke`` acceptance run (DESIGN §5b): run the
+    12-cell golden CPU sweep under ``grid="compact"`` with certification
+    on, assert every cell CERTIFIED and r* within 0.1bp of the committed
+    goldens, pin the default ``grid="reference"`` path bit-identical to
+    those goldens (and to the explicit-default spelling), and record the
+    measured gridpoint / inner-step / effective-gridpoint-step / wall
+    reductions as ``grid_*`` fields for the regression sentinel."""
+    import numpy as np
+
+    import jax
+
+    # CPU float64, like the integrity/obs smokes: the golden cells are
+    # f64 physics and the smoke runs standalone before any backend
+    # initializes.
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from aiyagari_hark_tpu.models.equilibrium import solve_calibration_lean
+    from aiyagari_hark_tpu.ops.grids import (
+        build_asset_grids,
+        grid_point_counts,
+    )
+    from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+    from aiyagari_hark_tpu.utils.config import SweepConfig
+
+    backend = jax.default_backend()
+    kw = dict(COMPACTION_SMOKE_KWARGS)
+    golden_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "tests", "data", "table2_golden_test.json")
+    with open(golden_path) as f:
+        golden = json.load(f)
+    assert golden["config"] == kw, \
+        "golden drifted from COMPACTION_SMOKE_KWARGS"
+    golden_r = np.asarray(golden["r_star_pct"], dtype=np.float64)
+
+    a_ref, d_ref = grid_point_counts("reference", **kw)
+    a_cmp, d_cmp = grid_point_counts("compact", **kw)
+    _, _, knee = build_asset_grids("compact", 0.001, 50.0, kw["a_count"],
+                                   2, kw["dist_count"])
+
+    # phase 1: warm-up — compiles the reference and compact sweep
+    # executables plus both certifiers, so the timed walls below measure
+    # steady-state solve cost, not compiles
+    t0 = time.perf_counter()
+    run_table2_sweep(SweepConfig(certify=True), **kw)
+    run_table2_sweep(SweepConfig(certify=True), grid="compact", **kw)
+    print(f"[bench] compaction smoke: warm-up in "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    # phase 2: timed reference run — also the golden bit-identity pin
+    res_ref = run_table2_sweep(SweepConfig(certify=True), perturb=0.0,
+                               **kw)
+    golden_identical = bool(
+        np.array_equal(np.asarray(res_ref.r_star_pct), golden_r))
+
+    # explicit-default spelling: one cell, bit-identical to the bare call
+    # (hashable_kwargs drops grid="reference", so the two spellings share
+    # one executable — this asserts the VALUES agree bitwise too)
+    lean_bare = solve_calibration_lean(3.0, 0.6, **kw)
+    lean_expl = solve_calibration_lean(3.0, 0.6, grid="reference", **kw)
+    explicit_identical = bool(
+        np.asarray(lean_bare.r_star).tobytes()
+        == np.asarray(lean_expl.r_star).tobytes())
+
+    # phase 3: timed compact run — certification is the referee
+    res_cmp = run_table2_sweep(SweepConfig(certify=True), perturb=0.0,
+                               grid="compact", **kw)
+    drift_bp = float(
+        np.max(np.abs(np.asarray(res_cmp.r_star_pct) - golden_r)) * 100.0)
+    certs = [int(v) for v in res_cmp.cert_level]
+    all_certified = bool((res_cmp.cert_level == 0).all())
+
+    # measured work accounting: total inner steps, and EFFECTIVE
+    # gridpoint-steps — each EGM step weighted by the policy-grid points
+    # it updates, each distribution step by the histogram points it
+    # pushes.  The grid ladder's COARSE (descent) steps are POLICY steps
+    # on the every-other-point subsample (the distribution loop runs no
+    # support ladder — DESIGN §5b), so exactly those steps count at half
+    # the policy-grid weight.  This is the number every fixed point,
+    # transfer, and flush actually scales with.
+    steps_ref = int(res_ref.total_work().sum())
+    steps_cmp = int(res_cmp.total_work().sum())
+    eff_ref = int((res_ref.egm_iters * a_ref
+                   + res_ref.dist_iters * d_ref).sum())
+    eff_cmp = int((res_cmp.egm_iters * a_cmp
+                   + res_cmp.dist_iters * d_cmp
+                   - 0.5 * res_cmp.descent_steps * a_cmp).sum())
+    wall_ref = float(res_ref.wall_seconds)
+    wall_cmp = float(res_cmp.wall_seconds)
+
+    record = {
+        "metric": "compaction_smoke",
+        "backend": backend,
+        "grid_cells": len(golden_r),
+        "grid_knee": round(float(knee), 4),
+        "grid_points_reference": a_ref + d_ref,
+        "grid_points_compact": a_cmp + d_cmp,
+        "grid_point_reduction": round((a_ref + d_ref)
+                                      / max(a_cmp + d_cmp, 1), 4),
+        "grid_total_inner_steps_reference": steps_ref,
+        "grid_total_inner_steps_compact": steps_cmp,
+        "grid_step_reduction": round(steps_ref / max(steps_cmp, 1), 4),
+        "grid_effective_gridpoint_steps_reference": eff_ref,
+        "grid_effective_gridpoint_steps_compact": eff_cmp,
+        "grid_effective_reduction": round(eff_ref / max(eff_cmp, 1), 4),
+        "grid_reference_wall_s": round(wall_ref, 3),
+        "grid_compact_wall_s": round(wall_cmp, 3),
+        "grid_wall_reduction": round(wall_ref / max(wall_cmp, 1e-9), 4),
+        # acceptance: verdicts + drift + bit-identity
+        "grid_cert_levels": certs,
+        "grid_cells_certified": int((res_cmp.cert_level == 0).sum()),
+        "grid_all_certified": all_certified,
+        "grid_r_drift_max_bp": round(drift_bp, 4),
+        "grid_drift_under_budget": bool(
+            drift_bp < COMPACTION_DRIFT_BUDGET_BP),
+        "grid_escalations": int(res_cmp.precision_escalations.sum()),
+        "grid_reference_bit_identical": bool(golden_identical
+                                             and explicit_identical),
+    }
+    print(f"[bench] compaction smoke: {a_ref + d_ref} -> "
+          f"{a_cmp + d_cmp} gridpoints (knee {knee:.1f}), "
+          f"effective work x{record['grid_effective_reduction']:.2f}, "
+          f"wall {wall_ref:.1f}s -> {wall_cmp:.1f}s, drift "
+          f"{drift_bp:.4f}bp, certs {certs}, reference golden "
+          f"{'OK' if golden_identical else 'DIFF'}", file=sys.stderr)
+    if not all_certified or drift_bp >= COMPACTION_DRIFT_BUDGET_BP:
+        print("[bench] compaction smoke: ACCEPTANCE FAILED — compact "
+              "cells must all certify within the drift budget",
+              file=sys.stderr)
+    return record
+
+
 # Load smoke (ISSUE 8): the overload acceptance on the Table II lattice
 # (both sd panels plus a third, so the cold-key space is wide enough to
 # saturate) at serving grid sizes.  Modeled capacity is max_batch /
@@ -2326,7 +2467,12 @@ def main(argv=None):
     ``profile_*`` record (ISSUE 10); ``--chips-scaling`` runs the
     multi-chip scaling acceptance (shard_map-dispatched sweep at mesh
     sizes 1/2/4/8 with bit-identity, work-skew, and memory telemetry)
-    and emits the ``chips_*`` record (ISSUE 11)."""
+    and emits the ``chips_*`` record (ISSUE 11); ``--compaction-smoke``
+    runs the grid-compaction acceptance (12-cell golden sweep under
+    ``grid="compact"``: all cells CERTIFIED, r* within 0.1bp of the
+    committed goldens, measured gridpoint/step/wall reductions,
+    reference path bit-identical) and emits the ``grid_*`` record
+    (ISSUE 12)."""
     import argparse
 
     from aiyagari_hark_tpu.utils.resilience import (
@@ -2382,6 +2528,14 @@ def main(argv=None):
                          "bit-identity vs the 1-device mesh, per-device "
                          "work skew, and memory gauges) and emit the "
                          "chips_* record instead of the full bench")
+    ap.add_argument("--compaction-smoke", action="store_true",
+                    help="run the grid-compaction smoke (ISSUE 12: the "
+                         "12-cell golden CPU sweep under grid='compact' "
+                         "— all cells CERTIFIED, r* within 0.1bp of the "
+                         "committed goldens, measured gridpoint/step/"
+                         "wall reductions, default reference path "
+                         "bit-identical) and emit the grid_* record "
+                         "instead of the full bench")
     ap.add_argument("--scenario-smoke", action="store_true",
                     help="run the scenario-registry smoke (ISSUE 9: "
                          "balanced+certified Huggett sweep with a "
@@ -2393,13 +2547,15 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if (args.serve_smoke or args.integrity_smoke or args.obs_smoke
             or args.load_smoke or args.scenario_smoke
-            or args.profile_smoke or args.chips_scaling):
+            or args.profile_smoke or args.chips_scaling
+            or args.compaction_smoke):
         from aiyagari_hark_tpu.utils.backend import (
             enable_compilation_cache,
         )
 
         enable_compilation_cache()
-        smoke = (_chips_scaling if args.chips_scaling
+        smoke = (_compaction_smoke if args.compaction_smoke
+                 else _chips_scaling if args.chips_scaling
                  else _profile_smoke if args.profile_smoke
                  else _scenario_smoke if args.scenario_smoke
                  else _load_smoke if args.load_smoke

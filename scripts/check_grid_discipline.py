@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Static lint: solver hot paths build grids through the GridPolicy
+resolution seam, never by calling the raw builders directly (ISSUE 12).
+
+The grid-compaction layer (DESIGN §5b) only works if every solver-path
+model build routes through ``ops.grids.build_asset_grids`` (the ONE
+``GridSpec -> concrete grids`` seam): a hot path that calls
+``make_asset_grid``/``make_grid_exp_mult`` directly silently pins the
+dense reference layout regardless of the requested grid policy — and
+worse, produces a model whose grids disagree with the policy every
+fingerprint downstream hashed.  This lint bans direct uses of the raw
+builders in the solver hot directories (``models/``, ``parallel/``,
+``serve/``, ``scenarios/``, ``verify/``):
+
+any CALL of (or ``from``-import naming) ``make_asset_grid`` /
+``make_grid_exp_mult`` there must carry an explicit ``# grid-ok`` waiver
+on its line stating why the raw builder is correct — e.g. the
+KS/portfolio reference-parity paths that deliberately do not ride the
+grid policy, or the credit-crunch experiment's per-date grids that must
+stay consistent with a model built elsewhere.
+
+``ops/`` is the seam itself and is out of scope, as are tests (pinning
+builder behavior IS a test's job).  Run standalone (exits 1 on findings)
+or via tier-1 (``tests/test_grid_discipline.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The solver hot directories: everywhere a model build can sit on a
+# sweep/serve/certify path.
+SCAN_DIRS = (
+    os.path.join("aiyagari_hark_tpu", "models"),
+    os.path.join("aiyagari_hark_tpu", "parallel"),
+    os.path.join("aiyagari_hark_tpu", "serve"),
+    os.path.join("aiyagari_hark_tpu", "scenarios"),
+    os.path.join("aiyagari_hark_tpu", "verify"),
+)
+
+BANNED = {"make_asset_grid", "make_grid_exp_mult"}
+WAIVER = "# grid-ok"
+
+
+def scan_source(src: str, rel: str) -> list:
+    """Findings for one file's source text (exposed for fixture tests)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [(rel, e.lineno or 0, f"unparseable: {e.msg}")]
+    lines = src.splitlines()
+    findings = []
+
+    def _flag(lineno: int, what: str) -> None:
+        line = lines[lineno - 1] if lineno <= len(lines) else ""
+        if WAIVER in line:
+            return
+        findings.append(
+            (rel, lineno,
+             f"direct {what} in a solver hot path — build grids through "
+             "the GridPolicy seam (ops.grids.build_asset_grids / "
+             "build_simple_model(grid=...)), or waive with '# grid-ok'"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name in BANNED:
+                    _flag(node.lineno, f"import of {alias.name}")
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            name = (fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute)
+                    else None)
+            if name in BANNED:
+                _flag(node.lineno, f"call of {name}")
+    return findings
+
+
+def scan_targets(repo: str = REPO) -> list:
+    """The files the lint covers, absolute paths — exposed so the lint's
+    own test can assert coverage instead of trusting the list silently."""
+    targets = []
+    for root in SCAN_DIRS:
+        base = os.path.join(repo, root)
+        for dirpath, _, names in os.walk(base):
+            if "__pycache__" in dirpath:
+                continue
+            targets += [os.path.join(dirpath, n) for n in sorted(names)
+                        if n.endswith(".py")]
+    return targets
+
+
+def scan(repo: str = REPO) -> list:
+    findings = []
+    for path in scan_targets(repo):
+        if os.path.exists(path):
+            with open(path) as fh:
+                findings += scan_source(fh.read(),
+                                        os.path.relpath(path, repo))
+    return findings
+
+
+def main() -> int:
+    findings = scan()
+    for rel, lineno, msg in findings:
+        print(f"{rel}:{lineno}: {msg}")
+    if findings:
+        print(f"{len(findings)} grid-discipline violation(s); see "
+              f"scripts/check_grid_discipline.py docstring")
+        return 1
+    print("grid-discipline lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

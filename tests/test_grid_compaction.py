@@ -1,0 +1,253 @@
+"""Grid compaction end to end (ISSUE 12, DESIGN §5b).
+
+The contracts under test:
+
+* ``grid="reference"`` (the default) is BIT-identical to an unspecified
+  grid — the explicit spelling shares the executable cache entry, the
+  fingerprints, and the bits (the committed packing/resume/precision
+  goldens pin the default path's values untouched; this file pins the
+  spelling equivalence).
+* the ANALYTIC TAIL: on every golden (σ, ρ) cell, the compact policy's
+  consumption agrees with the dense reference policy's across the tail
+  region (above the knee, where the compact grid has no points and
+  evaluation rides the asymptotic linear form) to the asymptotic
+  linearity tolerance — and the tail slope is the model's MPC limit,
+  inside the committed ``afunc_slope`` artifact's ordering band.
+* the coarse-to-fine ladder escalates deterministically: a NaN injected
+  into the COARSE phase restarts the polish cold on the compact grid
+  (``GRID_ESCALATED`` — same escalation slot as the precision ladder)
+  with a healthy final status; at the sweep level quarantine rungs
+  force ``grid="reference"`` (the dense-grid fallback).
+* compacted sweeps key their own fingerprints: a compact solve can
+  never collide with a reference solve in any sidecar/ledger/store.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.models.equilibrium import solve_calibration_lean
+from aiyagari_hark_tpu.models.household import (
+    build_simple_model,
+    consumption_at,
+    initial_policy,
+    solve_household,
+)
+from aiyagari_hark_tpu.ops.utility import asymptotic_mpc
+from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+from aiyagari_hark_tpu.solver_health import CONVERGED, GRID_ESCALATED
+from aiyagari_hark_tpu.utils.config import SweepConfig
+
+# The tier-1 workload: the full 12-cell Table II lattice at smoke grid
+# sizes (the compaction claims are about tail structure and ladder
+# phases; full-size drift/certification is the bench's grid_* phase).
+KW = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-5,
+          max_bisect=24)
+GOLDEN_CELLS = [(s, r) for s in (1.0, 3.0, 5.0)
+                for r in (0.0, 0.3, 0.6, 0.9)]
+
+
+@pytest.fixture(scope="module")
+def sweeps():
+    bare = run_table2_sweep(SweepConfig(), **KW)
+    explicit = run_table2_sweep(SweepConfig(), grid="reference", **KW)
+    compact = run_table2_sweep(SweepConfig(), grid="compact", **KW)
+    return bare, explicit, compact
+
+
+def test_reference_default_and_explicit_are_bit_identical(sweeps):
+    bare, explicit, _ = sweeps
+    for f in ("r_star_pct", "capital", "egm_iters", "dist_iters",
+              "status", "descent_steps", "polish_steps"):
+        a, b = getattr(bare, f), getattr(explicit, f)
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), f
+
+
+def test_sweep_config_grid_field_is_a_kwarg_default(sweeps):
+    _, _, compact = sweeps
+    via_config = run_table2_sweep(SweepConfig(grid="compact"), **KW)
+    assert np.asarray(via_config.r_star_pct).tobytes() \
+        == np.asarray(compact.r_star_pct).tobytes()
+
+
+def test_compact_sweep_converges_near_reference(sweeps):
+    bare, _, compact = sweeps
+    assert not compact.failed_cells().size
+    # tiny-grid discretizations legally differ more than the golden
+    # config's (the 0.1bp acceptance lives in bench --compaction-smoke,
+    # at the committed-golden sizes); this pins the sane-agreement band
+    drift_bp = np.max(np.abs(compact.r_star_pct - bare.r_star_pct)) * 100
+    assert drift_bp < 50.0
+
+
+def test_analytic_tail_matches_dense_reference_on_all_golden_cells():
+    """Policy values in the TAIL region: compact-grid + analytic tail vs
+    the dense reference policy, across all 12 golden cells.  One jitted
+    program, executed per cell (sigma/rho are traced scalars)."""
+    import jax as _jax
+
+    mod_probe = build_simple_model(labor_states=3, a_count=10,
+                                   dist_count=32, grid="compact")
+    knee = float(np.asarray(mod_probe.a_grid)[-1])
+    top = float(np.asarray(mod_probe.dist_grid)[-1])
+    q = jnp.linspace(knee, 1.2 * top, 64)
+    qs = jnp.broadcast_to(q, (3, 64))
+
+    @_jax.jit
+    def tail_pair(sig, rho):
+        mod_r = build_simple_model(labor_states=3, labor_ar=rho,
+                                   a_count=10, dist_count=32)
+        mod_c = build_simple_model(labor_states=3, labor_ar=rho,
+                                   a_count=10, dist_count=32,
+                                   grid="compact")
+        R, W = 1.03, 1.2
+        pol_r, _, _, st_r = solve_household(R, W, mod_r, 0.96, sig)
+        pol_c, _, _, st_c = solve_household(R, W, mod_c, 0.96, sig,
+                                            grid="compact")
+        return (consumption_at(pol_r, qs), consumption_at(pol_c, qs),
+                st_r, st_c)
+
+    for sig, rho in GOLDEN_CELLS:
+        c_r, c_c, st_r, st_c = tail_pair(sig, rho)
+        assert int(st_r) == CONVERGED and int(st_c) == CONVERGED
+        c_r, c_c = np.asarray(c_r), np.asarray(c_c)
+        rel = np.max(np.abs(c_c - c_r) / np.maximum(c_r, 1e-12))
+        assert rel < 0.05, (sig, rho, rel)
+
+
+def test_tail_slope_is_the_mpc_limit_inside_the_artifact_band():
+    """The appended tail segment's slope equals the analytic asymptotic
+    MPC, and the implied savings slope sits in the committed
+    ``afunc_slope`` artifact's ordering band (0, 1.2)."""
+    mod = build_simple_model(labor_states=3, a_count=10, dist_count=32,
+                             grid="compact")
+    R, beta, sig = 1.03, 0.96, 3.0
+    pol, _, _, st = solve_household(R, 1.2, mod, beta, sig,
+                                    grid="compact")
+    assert int(st) == CONVERGED
+    kappa = float(asymptotic_mpc(R, beta, sig))
+    assert 0.0 < kappa < 1.0
+    m = np.asarray(pol.m_knots)
+    c = np.asarray(pol.c_knots)
+    tail_slope = (c[:, -1] - c[:, -2]) / (m[:, -1] - m[:, -2])
+    np.testing.assert_allclose(tail_slope, kappa, rtol=1e-10)
+    # the analytic savings slope d a'/d a = (beta R)^(1/sigma) — the
+    # ordering the committed afunc_slope artifact pins for the
+    # aggregate law (tests/test_artifacts.py band)
+    savings_slope = R * (1.0 - kappa)
+    assert 0.0 < savings_slope < 1.2
+
+
+def test_compact_policy_shapes_carry_the_tail_knots():
+    mod = build_simple_model(labor_states=3, a_count=10, dist_count=32,
+                             grid="compact")
+    a_pts = int(np.asarray(mod.a_grid).shape[0])
+    p0 = initial_policy(mod, analytic_tail=True)
+    assert p0.m_knots.shape[0] == 3
+    assert p0.m_knots.shape[1] > a_pts + 1   # constraint + endo + tail
+    assert bool(jnp.all(jnp.diff(p0.m_knots, axis=1) > 0))
+
+
+def test_grid_ladder_coarse_fault_escalates_inside(sweeps=None):
+    """A NaN injected into the COARSE phase escalates in-program
+    (GRID_ESCALATED): healthy final status, escalation counted, values
+    reference-grade."""
+    assert isinstance(GRID_ESCALATED, str)   # note marker, like
+    #                                          PRECISION_ESCALATED
+    clean = solve_calibration_lean(3.0, 0.6, grid="compact", **KW)
+    faulted = solve_calibration_lean(3.0, 0.6, grid="compact",
+                                     descent_fault_iter=1, **KW)
+    assert not bool(np.isnan(float(faulted.r_star)))
+    assert int(faulted.status) == CONVERGED
+    assert int(faulted.escalations) > 0
+    # the escalated solve lands on the same root (cold compact restart)
+    assert abs(float(faulted.r_star) - float(clean.r_star)) < 1e-4
+
+
+def test_quarantine_rungs_force_reference_grid():
+    from aiyagari_hark_tpu.parallel.sweep import _retry_ladder
+
+    rungs = _retry_ladder({"grid": "compact"})
+    assert all(r.get("grid") == "reference" for r in rungs)
+    rungs_ref = _retry_ladder({})
+    assert all("grid" not in r for r in rungs_ref)
+    # the scenario bundles carry the same rule
+    from aiyagari_hark_tpu.scenarios.epstein_zin import (
+        _retry_rungs as ez_rungs,
+    )
+    from aiyagari_hark_tpu.scenarios.huggett import (
+        _retry_rungs as hug_rungs,
+    )
+
+    assert all(r.get("grid") == "reference"
+               for r in hug_rungs({"grid": "compact"}))
+    assert all(r.get("grid") == "reference"
+               for r in ez_rungs({"grid": "adaptive"}))
+
+
+def test_compact_sweep_quarantine_recovers_on_the_dense_grid(sweeps):
+    """An injected persistent fault routes a compact cell through the
+    quarantine ladder, whose rungs re-solve at grid='reference'; the
+    other cells stay bit-identical to the clean compact sweep."""
+    ref, _, clean = sweeps
+    res = run_table2_sweep(SweepConfig(), grid="compact",
+                           inject_fault={"cell": 5, "at_iter": 0,
+                                         "mode": "nan"}, **KW)
+    assert int(res.retries[5]) >= 1
+    assert int(res.status[5]) == CONVERGED
+    mask = np.ones(len(res.r_star_pct), dtype=bool)
+    mask[5] = False
+    assert np.asarray(res.r_star_pct)[mask].tobytes() \
+        == np.asarray(clean.r_star_pct)[mask].tobytes()
+    # the rung re-solved on the DENSE grid, so the recovered root is the
+    # reference discretization's (to the bracket width — the rung's
+    # alternate dist method may land the last bisection trips
+    # differently), not the compact one's
+    assert float(res.r_star_pct[5]) == pytest.approx(
+        float(ref.r_star_pct[5]), abs=2 * KW["r_tol"] * 100)
+
+
+def test_huggett_and_ez_cells_ride_compact_grids():
+    from aiyagari_hark_tpu.scenarios.epstein_zin import solve_ez_cell
+    from aiyagari_hark_tpu.scenarios.huggett import solve_huggett_cell
+
+    tiny = dict(labor_states=3, a_count=10, dist_count=32)
+    hug_r = solve_huggett_cell(2.0, 0.3, r_tol=1e-5, **tiny)
+    hug_c = solve_huggett_cell(2.0, 0.3, r_tol=1e-5, grid="compact",
+                               **tiny)
+    assert int(hug_c.status) == CONVERGED
+    assert abs(float(hug_c.r_star) - float(hug_r.r_star)) < 5e-3
+    ez_r = solve_ez_cell(3.0, 0.3, r_tol=1e-4, max_bisect=24, **tiny)
+    ez_c = solve_ez_cell(3.0, 0.3, r_tol=1e-4, max_bisect=24,
+                         grid="compact", **tiny)
+    assert int(ez_c.status) == CONVERGED
+    assert abs(float(ez_c.r_star) - float(ez_r.r_star)) < 5e-3
+
+
+def test_compact_certifies_under_grid_aware_thresholds():
+    from aiyagari_hark_tpu.verify import CertThresholds, certify_equilibrium
+
+    lean = solve_calibration_lean(3.0, 0.6, grid="compact", **KW)
+    cert = certify_equilibrium(lean, crra=3.0, labor_ar=0.6,
+                               grid="compact", **KW)
+    assert cert.level <= 1   # CERTIFIED or MARGINAL at tiny grids
+    thr_ref = CertThresholds.for_solver()
+    thr_cmp = CertThresholds.for_solver(grid="compact")
+    assert thr_cmp.euler > thr_ref.euler
+    assert thr_cmp.market_clearing > thr_ref.market_clearing
+
+
+def test_grid_spec_resolution_on_serve_queries():
+    """grid rides serve-query kwargs through the same normalization —
+    distinct fingerprints, validated at build time."""
+    from aiyagari_hark_tpu.serve import make_query
+
+    q_ref = make_query(3.0, 0.6, **KW)
+    q_cmp = make_query(3.0, 0.6, grid="compact", **KW)
+    assert q_ref.key() != q_cmp.key()
+    assert q_ref.group() != q_cmp.group()
+    q_expl = make_query(3.0, 0.6, grid="reference", **KW)
+    assert q_expl.key() == q_ref.key()
+    with pytest.raises(ValueError, match="grid policy"):
+        make_query(3.0, 0.6, grid="bogus", **KW)

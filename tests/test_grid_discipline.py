@@ -1,0 +1,64 @@
+"""Grid-discipline lint (ISSUE 12 satellite): solver hot paths build
+grids through the GridPolicy seam, never the raw builders directly."""
+
+import importlib.util
+import os
+
+import pytest
+
+repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+spec = importlib.util.spec_from_file_location(
+    "check_grid_discipline",
+    os.path.join(repo, "scripts", "check_grid_discipline.py"))
+lint = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(lint)
+
+
+def test_grid_discipline_lint_is_clean():
+    findings = lint.scan()
+    assert not findings, "\n".join(
+        f"{rel}:{line}: {msg}" for rel, line, msg in findings)
+
+
+def test_grid_discipline_covers_the_hot_dirs():
+    rels = {os.path.relpath(p, repo).replace(os.sep, "/")
+            for p in lint.scan_targets()}
+    # the seam's consumers are in scope ...
+    assert "aiyagari_hark_tpu/models/household.py" in rels
+    assert "aiyagari_hark_tpu/scenarios/huggett.py" in rels
+    assert "aiyagari_hark_tpu/verify/certificate.py" in rels
+    assert any(r.startswith("aiyagari_hark_tpu/serve/") for r in rels)
+    # ... the seam itself is not (ops/ IS the resolution layer)
+    assert not any(r.startswith("aiyagari_hark_tpu/ops/") for r in rels)
+
+
+@pytest.mark.parametrize("src,n_expected", [
+    # a bare call is a finding
+    ("from ..ops.grids import make_asset_grid\n"
+     "g = make_asset_grid(0.001, 50.0, 32)\n", 2),
+    # attribute-form call too
+    ("from ..ops import grids\n"
+     "g = grids.make_grid_exp_mult(0.001, 50.0, 32, 2)\n", 1),
+    # a waived line is not
+    ("from ..ops.grids import make_asset_grid  # grid-ok: fixture\n"
+     "g = make_asset_grid(0.001, 50.0, 32)  # grid-ok: fixture\n", 0),
+    # the seam call is never banned
+    ("from ..ops.grids import build_asset_grids\n"
+     "a, d, k = build_asset_grids('compact', 0.001, 50.0, 32, 2, 500)\n",
+     0),
+])
+def test_grid_discipline_fixtures(src, n_expected):
+    findings = lint.scan_source(src, "aiyagari_hark_tpu/models/x.py")
+    assert len(findings) == n_expected, findings
+
+
+def test_grid_discipline_script_exit_codes(tmp_path):
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "scripts", "check_grid_discipline.py")],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "clean" in out.stdout

@@ -1,0 +1,208 @@
+"""Grid construction + GridPolicy resolution (ISSUE 12, DESIGN §5b).
+
+The contracts under test:
+
+* ``make_grid_exp_mult`` endpoint fidelity and strict monotonicity on
+  BOTH branches (nested ``timestonest > 0`` and log-linear
+  ``timestonest == 0``), and the typed ``ValueError`` on a lower
+  endpoint outside the branch's log domain (``ming <= 0`` log-linear,
+  ``ming <= -1`` nested) — previously a silent NaN/-inf grid.
+* ``resolve_grid`` mirrors ``resolve_precision``: known policies
+  resolve, unknown ones raise before they can alias a cache key, and
+  ``hashable_kwargs`` drops the explicit default (the no-drift pin)
+  while keeping non-default policies distinct.
+* ``build_asset_grids``: the "reference" path is bit-identical to the
+  raw builders; compact grids are strictly monotone TRUNCATIONS of the
+  reference grids (kept points bit-equal, knee honored, support span
+  preserved) with fewer points.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.ops.grids import (
+    GRID_POLICIES,
+    build_asset_grids,
+    compact_knee,
+    grid_point_counts,
+    make_asset_grid,
+    make_grid_exp_mult,
+    resolve_grid,
+)
+from aiyagari_hark_tpu.utils.fingerprint import (
+    hashable_kwargs,
+    work_fingerprint,
+)
+
+
+# -- make_grid_exp_mult: endpoint fidelity, monotonicity, typed domain ------
+
+@pytest.mark.parametrize("nest", [0, 1, 2, 3])
+def test_exp_mult_endpoints_and_monotone(nest):
+    g = np.asarray(make_grid_exp_mult(0.001, 50.0, 32, nest))
+    assert g.shape == (32,)
+    np.testing.assert_allclose(g[0], 0.001, rtol=0, atol=1e-12)
+    np.testing.assert_allclose(g[-1], 50.0, rtol=1e-12)
+    assert (np.diff(g) > 0).all()
+    assert np.isfinite(g).all()
+
+
+def test_exp_mult_log_linear_branch_rejects_nonpositive_min():
+    # timestonest=0 takes log(ming): ming <= 0 used to produce NaN/-inf
+    # gridpoints silently (ISSUE 12 satellite) — now a typed ValueError
+    with pytest.raises(ValueError, match="timestonest=0"):
+        make_grid_exp_mult(0.0, 50.0, 16, 0)
+    with pytest.raises(ValueError, match="timestonest=0"):
+        make_grid_exp_mult(-0.5, 50.0, 16, 0)
+
+
+def test_exp_mult_nested_branch_rejects_min_at_or_below_minus_one():
+    # the nested branch takes log(1 + ming): the domain edge is -1
+    with pytest.raises(ValueError, match="ming > -1"):
+        make_grid_exp_mult(-1.0, 50.0, 16, 2)
+    # a negative ming above -1 is legal there (shifted Huggett grids)
+    g = np.asarray(make_grid_exp_mult(-0.5, 50.0, 16, 2))
+    assert np.isfinite(g).all() and (np.diff(g) > 0).all()
+
+
+def test_exp_mult_rejects_degenerate_spans_and_counts():
+    with pytest.raises(ValueError, match="two grid points"):
+        make_grid_exp_mult(0.001, 50.0, 1, 2)
+    with pytest.raises(ValueError, match="ordered"):
+        make_grid_exp_mult(50.0, 0.001, 16, 2)
+
+
+# -- GridPolicy resolution ---------------------------------------------------
+
+def test_resolve_grid_policies():
+    assert resolve_grid("reference").compact is False
+    assert resolve_grid("reference").ladder is False
+    for name in ("compact", "adaptive"):
+        spec = resolve_grid(name)
+        assert spec.compact and spec.ladder
+        assert spec.coarse_tol_factor >= 1.0
+    assert set(GRID_POLICIES) == {"reference", "compact", "adaptive"}
+    # a spec passes through (the bench's tuning path)
+    spec = resolve_grid("compact")
+    assert resolve_grid(spec) is spec
+
+
+def test_resolve_grid_unknown_raises():
+    with pytest.raises(ValueError, match="grid policy"):
+        resolve_grid("sparse")
+    with pytest.raises(ValueError, match="grid policy"):
+        resolve_grid(None)
+
+
+def test_hashable_kwargs_grid_no_drift_pin():
+    # explicit default dropped: the two spellings share every
+    # fingerprint and executable cache entry
+    assert hashable_kwargs({"grid": "reference", "a_count": 10}) \
+        == hashable_kwargs({"a_count": 10})
+    # non-default policies are distinct from the default AND each other
+    ref = work_fingerprint(hashable_kwargs({"a_count": 10}), np.float64)
+    cmp_ = work_fingerprint(
+        hashable_kwargs({"grid": "compact", "a_count": 10}), np.float64)
+    ada = work_fingerprint(
+        hashable_kwargs({"grid": "adaptive", "a_count": 10}), np.float64)
+    assert len({ref, cmp_, ada}) == 3
+    # unknown policies fail at normalization, not deep in a cache
+    with pytest.raises(ValueError, match="grid policy"):
+        hashable_kwargs({"grid": "bogus"})
+
+
+# -- build_asset_grids: the resolution seam ---------------------------------
+
+def test_reference_grids_bit_identical_to_raw_builders():
+    a_grid, dist_grid, knee = build_asset_grids(
+        "reference", 0.001, 50.0, 24, 2, 150)
+    assert knee is None
+    raw_a = make_asset_grid(0.001, 50.0, 24, 2)
+    raw_inner = make_grid_exp_mult(0.001, 50.0, 149, 2)
+    assert np.asarray(a_grid).tobytes() == np.asarray(raw_a).tobytes()
+    expect = np.concatenate([[0.0], np.asarray(raw_inner)])
+    assert np.asarray(dist_grid).tobytes() == expect.tobytes()
+
+
+@pytest.mark.parametrize("policy", ["compact", "adaptive"])
+@pytest.mark.parametrize("tail", ["analytic", "anchors"])
+def test_compact_grids_are_monotone_truncations(policy, tail):
+    ref_a, ref_d, _ = build_asset_grids("reference", 0.001, 50.0, 24, 2,
+                                        150)
+    a_grid, dist_grid, knee = build_asset_grids(
+        policy, 0.001, 50.0, 24, 2, 150, tail=tail)
+    a, d = np.asarray(a_grid), np.asarray(dist_grid)
+    assert knee is not None and 0.001 < knee < 50.0
+    assert (np.diff(a) > 0).all() and (np.diff(d) > 0).all()
+    # every kept point is a BIT-equal member of the reference grid
+    # (truncation, not re-spacing — the curved region's discretization
+    # is the goldens' own)
+    ref_a_set = set(np.asarray(ref_a).tolist())
+    ref_d_set = set(np.asarray(ref_d).tolist())
+    assert all(x in ref_a_set for x in a.tolist())
+    assert all(x in ref_d_set for x in d.tolist())
+    # fewer points (the analytic variant drops the whole solver tail;
+    # anchors can only thin what exists — at a small a_count the tail
+    # may already be at the anchor floor), histogram span preserved
+    if tail == "analytic":
+        assert len(a) < len(np.asarray(ref_a))
+    else:
+        assert len(a) <= len(np.asarray(ref_a))
+    assert len(d) < len(np.asarray(ref_d))
+    assert d[-1] == np.asarray(ref_d)[-1]
+    assert d[0] == 0.0
+    if tail == "analytic":
+        # the solver grid is the curved region only: it stops at the knee
+        assert a[-1] <= knee
+    else:
+        # anchors close the span structurally
+        assert a[-1] == np.asarray(ref_a)[-1]
+
+
+def test_compact_point_counts_match_built_grids():
+    for policy in ("compact", "adaptive"):
+        a_grid, dist_grid, _ = build_asset_grids(
+            policy, 0.001, 50.0, 24, 2, 150)
+        na, nd = grid_point_counts(policy, 24, 150)
+        assert na == np.asarray(a_grid).shape[0]
+        assert nd == np.asarray(dist_grid).shape[0]
+    assert grid_point_counts("reference", 24, 150) == (24, 150)
+    # the compaction saves real points on the golden config (the raw
+    # point saving is modest by design — the drift budget pins the
+    # curved region to reference density; the step-work saving is the
+    # bench's grid_effective_reduction)
+    na, nd = grid_point_counts("compact", 24, 150)
+    assert na + nd < 0.95 * (24 + 150)
+    na5, nd5 = grid_point_counts("compact", 100, 500)
+    assert na5 + nd5 < 0.92 * (100 + 500)
+
+
+def test_adaptive_knee_sits_below_compact_knee():
+    # adaptive's lower density quantile truncates more aggressively
+    k_cmp = compact_knee(resolve_grid("compact"), 0.001, 50.0, 24, 2)
+    k_ada = compact_knee(resolve_grid("adaptive"), 0.001, 50.0, 24, 2)
+    assert k_ada < k_cmp
+
+
+def test_borrow_limit_shifts_compact_grids():
+    a_grid, dist_grid, _ = build_asset_grids(
+        "compact", 0.001, 50.0, 24, 2, 150, borrow_limit=-2.0)
+    assert float(np.asarray(dist_grid)[0]) == -2.0
+    assert float(np.asarray(a_grid)[0]) == pytest.approx(-2.0 + 0.001)
+    d = np.asarray(dist_grid)
+    assert (np.diff(d) > 0).all()
+    # top of the support = b + span = -2 + (50 - (-2)) = 50
+    assert d[-1] == pytest.approx(50.0)
+
+
+def test_build_asset_grids_rejects_unknown_tail():
+    with pytest.raises(ValueError, match="tail"):
+        build_asset_grids("compact", 0.001, 50.0, 24, 2, 150,
+                          tail="linear")
+
+
+def test_compact_dtype_cast():
+    a32, d32, _ = build_asset_grids("compact", 0.001, 50.0, 24, 2, 150,
+                                    dtype=jnp.float32)
+    assert a32.dtype == jnp.float32 and d32.dtype == jnp.float32

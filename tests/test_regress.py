@@ -222,6 +222,52 @@ def test_direction_covers_chips_scaling_record():
     assert "chips_cells_per_sec_8dev" in flagged
 
 
+def test_direction_covers_compaction_smoke_record():
+    """The ``--compaction-smoke`` leg's scalar fields (ISSUE 12) resolve
+    strictly — the sentinel grades the grid_* record from its FIRST
+    committed round — and a synthetic grid history grades clean, with a
+    gridpoint increase / certified-count drop flagging in the declared
+    directions (gridpoints down = good, certified up = good)."""
+    grid_record = {
+        "metric": "compaction_smoke", "backend": "cpu",
+        "grid_cells": 12, "grid_knee": 19.2,
+        "grid_points_reference": 174, "grid_points_compact": 150,
+        "grid_point_reduction": 1.16,
+        "grid_total_inner_steps_reference": 256733,
+        "grid_total_inner_steps_compact": 221000,
+        "grid_step_reduction": 1.16,
+        "grid_effective_gridpoint_steps_reference": 2050000,
+        "grid_effective_gridpoint_steps_compact": 1020000,
+        "grid_effective_reduction": 2.0,
+        "grid_reference_wall_s": 104.3, "grid_compact_wall_s": 82.0,
+        "grid_wall_reduction": 1.27,
+        "grid_cert_levels": [0] * 12,
+        "grid_cells_certified": 12, "grid_all_certified": True,
+        "grid_r_drift_max_bp": 0.05, "grid_drift_under_budget": True,
+        "grid_escalations": 0,
+        "grid_reference_bit_identical": True,
+    }
+    for field in flatten_record(grid_record):
+        direction = direction_of_goodness(field, strict=True)
+        assert direction in (UP, DOWN, NEUTRAL), field
+    assert direction_of_goodness("grid_points_compact") == DOWN
+    assert direction_of_goodness("grid_cells_certified") == UP
+    assert direction_of_goodness("grid_effective_reduction") == UP
+    assert direction_of_goodness("grid_r_drift_max_bp") == DOWN
+    assert direction_of_goodness("grid_compact_wall_s") == DOWN
+    # stable synthetic history grades clean; a gridpoint blow-up and a
+    # certified-count drop both flag in the declared directions
+    hist = [(f"r{i:02d}", dict(grid_record)) for i in range(4)]
+    assert evaluate_history(hist).worst == OK
+    worse = dict(grid_record)
+    worse["grid_points_compact"] = 174
+    worse["grid_cells_certified"] = 9
+    hist_bad = hist[:-1] + [("r99", worse)]
+    flagged = [f.metric for f in evaluate_history(hist_bad).regressed()]
+    assert "grid_points_compact" in flagged
+    assert "grid_cells_certified" in flagged
+
+
 def test_direction_unknown_field_raises_strict_only():
     with pytest.raises(UnknownMetricError):
         direction_of_goodness("utterly_unclassifiable_thing",
