@@ -28,7 +28,7 @@ from ..solver_health import (
     NONFINITE,
     combine_status,
 )
-from ..utils.config import resolve_grid, resolve_precision
+from ..utils.config import resolve_grid, resolve_kernel, resolve_precision
 from .household import (
     R_DESCENT_WIDTH_SCALE,
     HouseholdPolicy,
@@ -37,6 +37,7 @@ from .household import (
     aggregate_labor,
     build_simple_model,
     descent_dtype,
+    fused_supply_phases,
     initial_distribution,
     initial_policy,
     solve_household,
@@ -88,6 +89,7 @@ def household_capital_supply(r, model: SimpleModel, disc_fac, crra,
                              accel_every: int | None = None,
                              precision: str = "reference",
                              grid="reference",
+                             kernel="reference",
                              descent_fault_iter: int | None = None,
                              descent_fault_mode: str = "nan",
                              ) -> SupplyEval:
@@ -123,22 +125,59 @@ def household_capital_supply(r, model: SimpleModel, disc_fac, crra,
     ``fault_iter``; ``descent_fault_mode`` picks the poison ("nan" |
     "stall" — a stall escalates WITHOUT contaminating the descent-only
     bracket trips' finite excess, so the cell stays healthy end to
-    end)."""
+    end).
+
+    ``kernel`` (ISSUE 13, DESIGN §4c): under ``kernel="fused"`` with a
+    SINGLE-phase precision policy, the two inner fixed points run as
+    ONE device-resident megakernel launch
+    (``household.fused_supply_phases`` — ``dist_method``/``egm_method``
+    are then moot and ignored; the coarse-to-fine grid ladder is an
+    XLA-path feature, so a compact ``grid`` runs tail-closed without
+    it); under a two-phase policy the ladders gain the bf16 descent
+    rung instead (threaded through both inner solvers)."""
     k_to_l = firm.k_to_l_from_r(r, cap_share, depr_fac, prod)
     W = firm.wage_rate(k_to_l, cap_share, prod)
     R = 1.0 + r
+    kspec = resolve_kernel(kernel)
+    use_fused = kspec.fused and not resolve_precision(precision).two_phase
+    if use_fused and jax.default_backend() in ("tpu", "axon"):
+        # the probe gate the policy promises: a Mosaic lowering gap in
+        # the fused kernel must degrade to the launch-per-loop XLA
+        # engines below, never die at sweep compile time.  The GRID
+        # probe subsumes the single-lane one — a fused caller may be
+        # vmapped later (the sweep), where the custom_vmap rule
+        # dispatches the lane-grid kernel.
+        from ..ops.pallas_kernels import probe_kernel
+        use_fused = probe_kernel("fused_grid")
+    if use_fused:
+        policy, dist, egm_it, dist_it, egm_status, dist_status = \
+            fused_supply_phases(
+                R, W, model, disc_fac, crra, egm_tol, dist_tol,
+                init_policy_knots=init_policy, init_dist=init_dist,
+                egm_accel=(32 if accel_every is None else accel_every),
+                dist_accel=(64 if accel_every is None else accel_every),
+                grid=grid)
+        it_dtype = jnp.asarray(egm_it).dtype
+        zero = jnp.zeros((), dtype=it_dtype)
+        return SupplyEval(aggregate_capital(dist, model), policy, dist, W,
+                          k_to_l, egm_it, dist_it,
+                          combine_status(egm_status, dist_status),
+                          descent_steps=zero,
+                          polish_steps=(jnp.asarray(egm_it, it_dtype)
+                                        + jnp.asarray(dist_it, it_dtype)),
+                          escalations=zero)
     egm_kw = {} if accel_every is None else {"accel_every": accel_every}
     if descent_fault_iter is not None:
         egm_kw["descent_fault_iter"] = int(descent_fault_iter)
         egm_kw["descent_fault_mode"] = str(descent_fault_mode)
     policy, egm_it, _, egm_status, egm_ph = solve_household(
         R, W, model, disc_fac, crra, tol=egm_tol, init_policy=init_policy,
-        method=egm_method, precision=precision, grid=grid,
+        method=egm_method, precision=precision, grid=grid, kernel=kernel,
         return_phases=True, **egm_kw)
     dist, dist_it, _, dist_status, dist_ph = stationary_wealth(
         policy, R, W, model, tol=dist_tol, init_dist=init_dist,
-        method=dist_method, precision=precision, return_phases=True,
-        **egm_kw)
+        method=dist_method, precision=precision, kernel=kernel,
+        return_phases=True, **egm_kw)
     it_dtype = jnp.asarray(egm_it).dtype
     return SupplyEval(aggregate_capital(dist, model), policy, dist, W,
                       k_to_l, egm_it, dist_it,
@@ -244,7 +283,8 @@ def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
                                 egm_tol: float | None = None,
                                 dist_tol: float | None = None,
                                 precision: str = "reference",
-                                grid="reference") -> EquilibriumResult:
+                                grid="reference",
+                                kernel="reference") -> EquilibriumResult:
     """Bisect r until the capital market clears.
 
     Fully jit-able/vmappable: a fixed-trip ``while_loop`` whose body solves
@@ -261,7 +301,7 @@ def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
         supply = household_capital_supply(
             r, model, disc_fac, crra, cap_share, depr_fac, prod,
             egm_tol=egm_tol, dist_tol=dist_tol,
-            precision=precision, grid=grid).supply
+            precision=precision, grid=grid, kernel=kernel).supply
         demand = firm.k_to_l_from_r(r, cap_share, depr_fac, prod) * labor
         return supply - demand
 
@@ -271,7 +311,7 @@ def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
     ev = household_capital_supply(
         r_star, model, disc_fac, crra, cap_share, depr_fac, prod,
         egm_tol=egm_tol, dist_tol=dist_tol, precision=precision,
-        grid=grid)
+        grid=grid, kernel=kernel)
     supply, wage, k_to_l = ev.supply, ev.wage, ev.k_to_l
     demand = k_to_l * labor
     output = prod * supply ** cap_share * labor ** (1.0 - cap_share)
@@ -323,6 +363,7 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
                            bracket_init=None,
                            precision: str = "reference",
                            grid="reference",
+                           kernel="reference",
                            fault_iter=None,
                            fault_mode: str = "nan",
                            descent_fault_iter: int | None = None,
@@ -369,6 +410,14 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
     by phase; a descent-phase NONFINITE/STALLED is absorbed INSIDE the
     ladder (pure-reference fallback, counted in ``escalations``), so
     quarantine only sees failures the reference path would also produce.
+
+    ``kernel`` (ISSUE 13, DESIGN §4c): the kernel policy threaded into
+    every midpoint evaluation — "reference" (default, bit-identical
+    launch-per-loop engines), "fused" (single-phase precision: both
+    inner fixed points as ONE device-resident megakernel launch per
+    midpoint; two-phase: the bf16 descent rung).  The warm-start carry,
+    bracket continuation, and status semantics are unchanged — only the
+    engine under each evaluation moves.
 
     ``fault_iter``/``fault_mode`` are the deterministic fault-injection
     hook (``solver_health``): at bisection trip ``fault_iter`` (may be
@@ -431,7 +480,7 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
                 egm_tol=egm_tol, dist_tol=dist_tol,
                 init_policy=pol, init_dist=dist, dist_method=dist_method,
                 egm_method=egm_method, accel_every=accel_every,
-                precision=prec, grid=grid,
+                precision=prec, grid=grid, kernel=kernel,
                 descent_fault_iter=descent_fault_iter,
                 descent_fault_mode=descent_fault_mode)
         return eval_at

@@ -122,11 +122,25 @@ def _append_analytic_tail(m_knots, c_knots, R, W, disc_fac, crra,
     negative) still produces a strictly monotone, positive-consumption
     tail.
     """
+    kappa = asymptotic_mpc(R, disc_fac, crra)
+    h = perfect_foresight_human_wealth(R, W, labor_levels, transition)
+    return _append_analytic_tail_knots(m_knots, c_knots, kappa, h)
+
+
+def _append_analytic_tail_knots(m_knots, c_knots, kappa, h):
+    """The tail closure given its two model-level ingredients — the RAW
+    asymptotic MPC ``kappa`` (clipped here) and the per-state human
+    wealth ``h``.  Split out of ``_append_analytic_tail`` so the fused
+    Pallas megakernel (DESIGN §4c) can close the tail in-kernel: ``h``
+    needs an [N, N] linear solve Mosaic cannot lower, but it depends
+    only on (R, W, P) — constant across the fixed point — so the kernel
+    dispatch computes it once outside and passes it in, while the
+    elementwise ``kappa`` is computed wherever the closure runs.  Same
+    ops in the same order as before the split: the XLA compact path is
+    bit-identical."""
     dt = m_knots.dtype
     tiny = jnp.asarray(np.finfo(np.float64).tiny, dtype=dt)
-    kappa = jnp.clip(asymptotic_mpc(R, disc_fac, crra),
-                     1e-3, 0.999).astype(dt)
-    h = perfect_foresight_human_wealth(R, W, labor_levels, transition)
+    kappa = jnp.clip(kappa, 1e-3, 0.999).astype(dt)
     m_top = m_knots[:, -1]
     c_top = c_knots[:, -1]
     span = jnp.maximum(m_top - m_knots[:, 0], 1.0)
@@ -241,7 +255,8 @@ def initial_policy(model: SimpleModel,
 def egm_step(policy: HouseholdPolicy, R, W, model: SimpleModel,
              disc_fac, crra,
              matmul_precision=jax.lax.Precision.HIGHEST,
-             analytic_tail: bool = False) -> HouseholdPolicy:
+             analytic_tail: bool = False,
+             foc_dtype=None) -> HouseholdPolicy:
     """One EGM backward step on the [A, N] block.  The expectation over next
     states is a single [A,N']x[N',N] matmul (MXU-friendly), replacing the
     reference's per-state Python loop (``Aiyagari_Support.py:1479-1485``).
@@ -263,7 +278,15 @@ def egm_step(policy: HouseholdPolicy, R, W, model: SimpleModel,
     then rides the asymptotic linear form instead of grid interpolation.
     Policy shape is ``[N, A+3]`` (constraint knot + A endogenous + two
     tail knots).
-    """
+
+    ``foc_dtype`` (ISSUE 13, the bf16 descent rung — DESIGN §4c): run
+    the ``x^(-1/gamma)`` FOC inversion in this dtype and cast the result
+    back to the iterate dtype.  The inversion's fractional power is the
+    one step of the backward pass whose relative error bf16 amplifies
+    (SURVEY §"Precision" — the rest of the step is linear/monotone), so
+    the bf16 rung pins it to f32 while everything else runs in the
+    rung's dtype.  ``None`` (default) inverts in the iterate dtype —
+    bit-identical to the pre-rung step."""
     a = model.a_grid                                  # [A]
     m_next = R * a[:, None] + W * model.labor_levels[None, :]   # [A, N']
     # c_next(m) per next-state: rowwise interp with per-state knots.
@@ -272,7 +295,12 @@ def egm_step(policy: HouseholdPolicy, R, W, model: SimpleModel,
     end_of_prd_vp = disc_fac * R * jnp.matmul(
         vp_next, model.transition.T, precision=matmul_precision,
         preferred_element_type=vp_next.dtype)
-    c_now = inverse_marginal_utility(end_of_prd_vp, crra)
+    if foc_dtype is not None and end_of_prd_vp.dtype != jnp.dtype(foc_dtype):
+        c_now = inverse_marginal_utility(
+            end_of_prd_vp.astype(foc_dtype), crra).astype(
+                end_of_prd_vp.dtype)
+    else:
+        c_now = inverse_marginal_utility(end_of_prd_vp, crra)
     m_now = a[:, None] + c_now
     # borrowing-constraint knot: at m = b + eps the agent consumes eps and
     # carries a = b; interpolation below the first endogenous knot then has
@@ -427,8 +455,42 @@ def descent_dtype(dtype):
     """The cheap dtype of the ladder's descent phase: f64 models descend
     in f32; f32 (and narrower) models keep their dtype — their descent
     cheapness is the DEFAULT-precision matmul path, not a narrower
-    iterate (bf16 iterates cannot certify any useful tolerance)."""
+    iterate (bf16 iterates cannot certify any useful tolerance — which
+    is exactly why the bf16 RUNG below is a separate, coarser-tolerance
+    phase under ``kernel="fused"``, not a replacement descent dtype)."""
     return jnp.float32 if jnp.dtype(dtype) == jnp.dtype("float64") else dtype
+
+
+# -- the bf16 descent rung (ISSUE 13 leg 3, DESIGN §4c) ----------------------
+#
+# Under ``kernel="fused"`` with a two-phase precision policy the ladder
+# gains one more rung BELOW the f32 descent: a bf16-iterate phase run to
+# a very coarse tolerance (bf16 eps is 2^-7 ≈ 0.0078 — it can certify
+# only the cheap early shape of the fixed point), whose iterate seeds the
+# f32 descent.  PAPERS 2002.09108's asymptotic linearity is the license:
+# errors in the near-linear region are cheap to polish away, so the
+# earliest (most expensive, least accurate-needing) iterations may run at
+# the narrowest dtype the MXU natively eats.  The x^(-1/gamma) FOC
+# inversion stays f32 (``egm_step(foc_dtype=)`` — SURVEY §"Precision");
+# a NONFINITE/STALLED bf16 rung escalates to the f32 descent from the
+# caller's initial iterate, exactly the PRECISION_ESCALATED contract one
+# level down, and is counted in the same ``PrecisionPhases.escalated``
+# slot.  TPU-only at the solver seam (``bf16_rung_active``): off-TPU the
+# narrow iterate buys nothing (no bf16 SIMD win) and costs conversions.
+BF16_POLICY_RUNG_TOL_SCALE = 4.0    # units of bf16 eps: ~0.03 in knot sup-norm
+BF16_DIST_RUNG_TOL_SCALE = 1.0      # histogram masses <= 1: one eps ≈ 0.0078
+BF16_RUNG_BACKENDS = ("tpu", "axon")   # tests monkeypatch to drill on CPU
+
+
+def bf16_rung_active(kspec, backend: str | None = None) -> bool:
+    """Whether the fused kernel policy's bf16 descent rung runs here:
+    the policy asks for it AND the backend is a TPU (``kspec`` is a
+    ``utils.config.KernelSpec``)."""
+    if not kspec.bf16_descent:
+        return False
+    if backend is None:
+        backend = jax.default_backend()
+    return backend in BF16_RUNG_BACKENDS
 
 
 def descent_tolerance(tol, cheap_dtype, scale: float) -> float:
@@ -498,7 +560,8 @@ def _polish_cadence(accel_every: int) -> int:
 def ladder_policy_fixed_point(step_cheap, step_ref, p0, tol: float,
                               descent_tol: float, max_iter: int,
                               accel_every: int = 32, polish: bool = True,
-                              cheap_dtype=None):
+                              cheap_dtype=None, step_bf16=None,
+                              bf16_tol: float | None = None):
     """Two-phase EGM fixed point: cheap-dtype descent to ``descent_tol``,
     reference-precision polish to ``tol`` — one jitted program, two
     ``while_loop``s (DESIGN §5).
@@ -518,53 +581,92 @@ def ladder_policy_fixed_point(step_cheap, step_ref, p0, tol: float,
     Returns ``(policy, total_iters, diff, status, PrecisionPhases)`` —
     ``status``/``diff`` are the final phase's, so the caller's tolerance
     contract and solver_health semantics are unchanged under ``polish``.
+
+    ``step_bf16``/``bf16_tol`` (ISSUE 13 leg 3): when given, one MORE
+    rung runs below the cheap descent — a bf16-iterate phase to
+    ``bf16_tol`` whose cast-up result seeds the descent.  A
+    NONFINITE/STALLED bf16 rung escalates to the descent from ``p0``
+    (the caller's initial iterate) and rides the same ``escalated``
+    flag; its steps count as descent steps (they are descent work at a
+    cheaper dtype still).
     """
     ref_dt = p0.c_knots.dtype
     dt = ref_dt if cheap_dtype is None else cheap_dtype
     p0_cheap = cast_floating(p0, dt)
+    it_b = jnp.asarray(0)
+    esc_b = jnp.asarray(False)
+    if step_bf16 is not None:
+        p0_b = cast_floating(p0, jnp.bfloat16)   # dtype-ok: the bf16 rung's
+        #                                          own definition site
+        pol_b, it_b, _, status_b = accelerated_policy_fixed_point(
+            step_bf16, p0_b, bf16_tol, max_iter, accel_every)
+        esc_b = (status_b == NONFINITE) | (status_b == STALLED)
+        p0_cheap = jax.tree.map(
+            lambda cold, warm: jnp.where(esc_b, cold, warm),
+            p0_cheap, cast_floating(pol_b, dt))
     pol_d, it_d, diff_d, status_d = accelerated_policy_fixed_point(
         step_cheap, p0_cheap, descent_tol, max_iter, accel_every)
+    it_d = it_d + it_b
     pol_up = cast_floating(pol_d, ref_dt)
     if not polish:
         phases = PrecisionPhases(descent_steps=it_d,
                                  polish_steps=jnp.zeros_like(it_d),
-                                 escalated=jnp.asarray(False))
+                                 escalated=esc_b)
         return pol_up, it_d, diff_d.astype(ref_dt), status_d, phases
-    escalated = (status_d == NONFINITE) | (status_d == STALLED)
-    start = jax.tree.map(lambda cold, warm: jnp.where(escalated, cold, warm),
+    # polish restarts cold only on a DESCENT failure (a bf16-rung failure
+    # already restarted the descent cold — its certified result stands);
+    # the phases flag records either escalation.
+    esc_d = (status_d == NONFINITE) | (status_d == STALLED)
+    start = jax.tree.map(lambda cold, warm: jnp.where(esc_d, cold, warm),
                          p0, pol_up)
     pol, it_p, diff, status = accelerated_policy_fixed_point(
         step_ref, start, tol, max_iter, _polish_cadence(accel_every))
     phases = PrecisionPhases(descent_steps=it_d, polish_steps=it_p,
-                             escalated=escalated)
+                             escalated=esc_b | esc_d)
     return pol, it_d + it_p, diff, status, phases
 
 
 def ladder_distribution_fixed_point(push_cheap, push_ref, dist0, tol: float,
                                     descent_tol: float, max_iter: int,
                                     accel_every: int = 64,
-                                    polish: bool = True, cheap_dtype=None):
+                                    polish: bool = True, cheap_dtype=None,
+                                    push_bf16=None,
+                                    bf16_tol: float | None = None):
     """Two-phase stationary-distribution fixed point — the distribution
-    twin of ``ladder_policy_fixed_point`` (same escalation contract).
+    twin of ``ladder_policy_fixed_point`` (same escalation contract,
+    same optional bf16 rung below the descent — ISSUE 13 leg 3).
     The cast-up iterate is exactly renormalized before the polish (the
-    cheap phase conserved mass only to its own rounding)."""
+    cheap phase conserved mass only to its own rounding; the bf16 rung's
+    before the descent, for the same reason)."""
     ref_dt = dist0.dtype
     dt = ref_dt if cheap_dtype is None else cheap_dtype
+    d0_cheap = dist0.astype(dt)
+    it_b = jnp.asarray(0)
+    esc_b = jnp.asarray(False)
+    if push_bf16 is not None:
+        d_b, it_b, _, status_b = accelerated_distribution_fixed_point(
+            push_bf16, dist0.astype(jnp.bfloat16),   # dtype-ok: bf16 rung
+            bf16_tol, max_iter, accel_every)
+        esc_b = (status_b == NONFINITE) | (status_b == STALLED)
+        d_b_up = d_b.astype(dt)
+        d_b_up = d_b_up / jnp.sum(d_b_up)
+        d0_cheap = jnp.where(esc_b, d0_cheap, d_b_up)
     d_cheap, it_d, diff_d, status_d = accelerated_distribution_fixed_point(
-        push_cheap, dist0.astype(dt), descent_tol, max_iter, accel_every)
+        push_cheap, d0_cheap, descent_tol, max_iter, accel_every)
+    it_d = it_d + it_b
     d_up = d_cheap.astype(ref_dt)
     d_up = d_up / jnp.sum(d_up)
     if not polish:
         phases = PrecisionPhases(descent_steps=it_d,
                                  polish_steps=jnp.zeros_like(it_d),
-                                 escalated=jnp.asarray(False))
+                                 escalated=esc_b)
         return d_up, it_d, diff_d.astype(ref_dt), status_d, phases
-    escalated = (status_d == NONFINITE) | (status_d == STALLED)
-    start = jnp.where(escalated, dist0, d_up)
+    esc_d = (status_d == NONFINITE) | (status_d == STALLED)
+    start = jnp.where(esc_d, dist0, d_up)
     dist, it_p, diff, status = accelerated_distribution_fixed_point(
         push_ref, start, tol, max_iter, _polish_cadence(accel_every))
     phases = PrecisionPhases(descent_steps=it_d, polish_steps=it_p,
-                             escalated=escalated)
+                             escalated=esc_b | esc_d)
     return dist, it_d + it_p, diff, status, phases
 
 
@@ -679,6 +781,7 @@ def solve_household(R, W, model: SimpleModel, disc_fac, crra,
                     accel_every: int = 32, method: str = "xla",
                     precision: str = "reference",
                     grid="reference",
+                    kernel="reference",
                     return_phases: bool = False,
                     descent_fault_iter: int | None = None,
                     descent_fault_mode: str = "nan"):
@@ -737,16 +840,37 @@ def solve_household(R, W, model: SimpleModel, disc_fac, crra,
     reference grid is the sweep ladder's job).  The VMEM kernel runs the
     fixed reference knot layout, so compact grids demote ``method`` to
     "xla" exactly like non-reference precision does.
+
+    ``kernel`` (ISSUE 13, ``utils.config.KERNEL_POLICIES``): "reference"
+    (default) keeps the engine selection above, bit-identical.  "fused"
+    opts into the device-resident kernel path — under a single-phase
+    precision policy the VMEM EGM kernel runs wherever it is eligible
+    (probe-gated on TPU, INTERPRET-mode on CPU — the CI correctness
+    path; compact grids stay on the XLA tail/ladder path, whose
+    in-kernel twin lives in the FUSED supply megakernel only); under a
+    two-phase policy the descent ladder gains the bf16 rung
+    (``bf16_rung_active`` — TPU-only, FOC inversion pinned f32, failed
+    rung escalates into the same ``escalated`` slot).
     """
+    from ..utils.config import resolve_kernel
+
     spec = resolve_precision(precision)
     gspec = resolve_grid(grid)
+    kspec = resolve_kernel(kernel)
     tail = gspec.compact
     if tail and method in ("pallas", "auto"):
         method = "xla"
     p0 = (initial_policy(model, analytic_tail=tail)
           if init_policy is None else init_policy)
     if not spec.two_phase and not gspec.ladder:
-        if method == "auto":
+        if kspec.fused and method in ("xla", "auto") and not tail:
+            # the fused policy's single-loop engine: the VMEM kernel,
+            # interpret-mode off-TPU, probe-gated on TPU (XLA fallback)
+            from ..ops.pallas_kernels import probe_kernel
+            on_tpu = jax.default_backend() in ("tpu", "axon")
+            method = ("pallas" if not on_tpu or probe_kernel("egm_grid")
+                      else "xla")
+        elif method == "auto":
             from ..ops.pallas_kernels import pallas_egm_grid_tpu_available
             on_tpu = jax.default_backend() in ("tpu", "axon")
             method = ("pallas" if on_tpu and pallas_egm_grid_tpu_available()
@@ -795,19 +919,50 @@ def solve_household(R, W, model: SimpleModel, disc_fac, crra,
                             matmul_precision=DESCENT_MATMUL_PRECISION,
                             analytic_tail=tail)
 
+        # The bf16 descent rung (ISSUE 13 leg 3): one more rung below
+        # the cheap descent, TPU-gated; the FOC inversion stays f32.
+        rung_kw = {}
+        if bf16_rung_active(kspec):
+            bf16 = jnp.bfloat16   # dtype-ok: the bf16 rung's solver seam
+            model_b = cast_floating(model, bf16)
+            Rb = jnp.asarray(R).astype(bf16)
+            Wb = jnp.asarray(W).astype(bf16)
+            bb = jnp.asarray(disc_fac).astype(bf16)
+            cb = jnp.asarray(crra).astype(bf16)
+
+            def step_bf16(p):
+                return egm_step(p, Rb, Wb, model_b, bb, cb,
+                                matmul_precision=DESCENT_MATMUL_PRECISION,
+                                analytic_tail=tail,
+                                foc_dtype=jnp.float32)
+
+            rung_kw = dict(step_bf16=step_bf16,
+                           bf16_tol=descent_tolerance(
+                               tol, bf16, BF16_POLICY_RUNG_TOL_SCALE))
         if descent_fault_iter is not None:
             step_cheap = inject_fault(
                 step_cheap, descent_fault_mode,
                 at_iter=descent_fault_iter,
                 amplitude=10.0 * descent_tolerance(
                     tol, cheap, POLICY_DESCENT_TOL_SCALE))
+            if "step_bf16" in rung_kw:
+                # the drill must exercise the NEW rung first: the same
+                # injection poisons the bf16 phase, whose escalation
+                # restarts the f32 descent cold (which the injection
+                # then poisons too, escalating to the reference polish —
+                # the full ladder walks itself, deterministically)
+                rung_kw["step_bf16"] = inject_fault(
+                    rung_kw["step_bf16"], descent_fault_mode,
+                    at_iter=descent_fault_iter,
+                    amplitude=10.0 * rung_kw["bf16_tol"])
         pol, it, diff, status, phases = ladder_policy_fixed_point(
             step_cheap,
             lambda p: egm_step(p, R, W, model, disc_fac, crra,
                                analytic_tail=tail),
             p0, tol,
             descent_tolerance(tol, cheap, POLICY_DESCENT_TOL_SCALE),
-            max_iter, accel_every, polish=spec.polish, cheap_dtype=cheap)
+            max_iter, accel_every, polish=spec.polish, cheap_dtype=cheap,
+            **rung_kw)
         return _with_phases((pol, it, diff, status), return_phases, phases)
 
     # -- coarse-to-fine grid ladder, composed with the precision ladder ----
@@ -1031,10 +1186,118 @@ def _pallas_fixed_point_vmappable(tol: float, max_iter: int,
     return fp
 
 
+# ---------------------------------------------------------------------------
+# Fused EGM + push-forward supply evaluation (ISSUE 13 tentpole).
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _fused_cell_vmappable(tol: float, max_iter: int, accel_every: int,
+                          dist_tol: float, dist_max_iter: int,
+                          dist_accel: int, tail: bool):
+    """The fused megakernel with a custom batching rule — the
+    whole-supply-evaluation twin of ``_pallas_egm_fixed_point_vmappable``
+    / ``_pallas_fixed_point_vmappable``: a plain ``vmap`` would trace
+    every lane into ONE kernel invocation (lock-step, blown VMEM);
+    ``custom_vmap`` reroutes a batched call to
+    ``fused_cell_pallas_grid`` instead — one program instance per lane,
+    each running its EGM fixed point AND its push-forward device-resident
+    and exiting at its own convergence.  Nested batch axes collapse into
+    the lane axis exactly like the per-loop grid dispatches."""
+    from ..ops.pallas_kernels import fused_cell_pallas, fused_cell_pallas_grid
+
+    def _bcast(axis_size, in_batched, *args):
+        return tuple(a if b else jnp.broadcast_to(a, (axis_size,) + a.shape)
+                     for b, a in zip(in_batched, args))
+
+    n_out = 7
+
+    @jax.custom_batching.custom_vmap
+    def fp_grid(m0, c0, a, dg, lvl, P, scal, h, d0):
+        return fused_cell_pallas_grid(m0, c0, a, dg, lvl, P, scal, h, d0,
+                                      tol, max_iter, accel_every, dist_tol,
+                                      dist_max_iter, dist_accel, tail)
+
+    @fp_grid.def_vmap
+    def _grid_batched(axis_size, in_batched, *args):  # noqa: ANN001
+        args = _bcast(axis_size, in_batched, *args)
+        b, c = args[0].shape[0], args[0].shape[1]
+        flat = tuple(a.reshape((b * c,) + a.shape[2:]) for a in args)
+        outs = fp_grid(*flat)
+        return (tuple(o.reshape((b, c) + o.shape[1:]) for o in outs),
+                (True,) * n_out)
+
+    @jax.custom_batching.custom_vmap
+    def fp(m0, c0, a, dg, lvl, P, scal, h, d0):
+        return fused_cell_pallas(m0, c0, a, dg, lvl, P, scal, h, d0,
+                                 tol, max_iter, accel_every, dist_tol,
+                                 dist_max_iter, dist_accel, tail)
+
+    @fp.def_vmap
+    def _batched(axis_size, in_batched, *args):  # noqa: ANN001
+        args = _bcast(axis_size, in_batched, *args)
+        return fp_grid(*args), (True,) * n_out
+
+    return fp
+
+
+def fused_supply_phases(R, W, model: SimpleModel, disc_fac, crra,
+                        egm_tol: float, dist_tol: float,
+                        init_policy_knots: HouseholdPolicy | None = None,
+                        init_dist=None, egm_max_iter: int = 3000,
+                        egm_accel: int = 32, dist_max_iter: int = 20000,
+                        dist_accel: int = 64, grid="reference"):
+    """One supply evaluation's BOTH inner fixed points as ONE fused
+    kernel launch (ISSUE 13 tentpole, DESIGN §4c): the EGM policy
+    iteration and the distribution push-forward run device-resident back
+    to back (``ops.pallas_kernels.fused_cell_pallas{,_grid}``), instead
+    of the reference path's two separately-launched loops stitched by
+    the host-visible XLA program.
+
+    Under a compact ``grid`` policy the analytic linear tail closes
+    every policy iterate IN-KERNEL (the human-wealth intercept is
+    computed here — it needs an [N, N] solve and depends only on
+    (R, W, P)); the coarse-to-fine grid LADDER is an XLA-path feature
+    and does not run — the fused engine solves the compact grid
+    directly, inside the same certified-tolerance contract.
+
+    Returns ``(policy, dist, egm_iters, dist_iters, egm_status,
+    dist_status)`` with both statuses reconstructed exactly from the
+    kernel's (iters, diff) pairs (``classify_fixed_point_exit``)."""
+    gspec = resolve_grid(grid)
+    tail = gspec.compact
+    p0 = (initial_policy(model, analytic_tail=tail)
+          if init_policy_knots is None else init_policy_knots)
+    d0 = initial_distribution(model) if init_dist is None else init_dist
+    dt = model.a_grid.dtype
+    R_ = jnp.asarray(R, dtype=dt)
+    W_ = jnp.asarray(W, dtype=dt)
+    scalars = jnp.stack([R_, W_, jnp.asarray(disc_fac, dtype=dt),
+                         jnp.asarray(crra, dtype=dt),
+                         jnp.asarray(model.borrow_limit, dtype=dt)])
+    if tail:
+        h = perfect_foresight_human_wealth(R_, W_, model.labor_levels,
+                                           model.transition)
+    else:
+        h = jnp.zeros_like(model.labor_levels)
+    fp = _fused_cell_vmappable(float(egm_tol), int(egm_max_iter),
+                               int(egm_accel), float(dist_tol),
+                               int(dist_max_iter), int(dist_accel),
+                               bool(tail))
+    m, c, dist, egm_it, egm_diff, dist_it, dist_diff = fp(
+        p0.m_knots, p0.c_knots, model.a_grid, model.dist_grid,
+        model.labor_levels, model.transition, scalars, h, d0)
+    return (HouseholdPolicy(m_knots=m, c_knots=c), dist, egm_it, dist_it,
+            classify_fixed_point_exit(egm_diff, egm_tol, egm_it,
+                                      egm_max_iter),
+            classify_fixed_point_exit(dist_diff, dist_tol, dist_it,
+                                      dist_max_iter))
+
+
 def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
                       tol: float = 1e-11, max_iter: int = 20000,
                       init_dist=None, accel_every: int = 64,
                       method: str = "auto", precision: str = "reference",
+                      kernel="reference",
                       return_phases: bool = False,
                       descent_fault_iter: int | None = None,
                       descent_fault_mode: str = "nan"):
@@ -1095,12 +1358,34 @@ def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
     histogram support itself); the coarse-to-fine ladder lives in the
     POLICY loop, whose prolongation error the warm carry does not pay
     repeatedly.
+
+    ``kernel`` (ISSUE 13, DESIGN §4c): "fused" prefers the VMEM kernel
+    engine wherever the precision policy is single-phase — interpret
+    mode off-TPU (the CI correctness path), probe-gated compiled Mosaic
+    on TPU with "dense"/"scatter" fallback; under a two-phase policy the
+    ladder gains the bf16 descent rung (TPU-only,
+    ``bf16_rung_active``).
     """
+    from ..utils.config import resolve_kernel
+
     spec = resolve_precision(precision)
+    kspec = resolve_kernel(kernel)
     trans = wealth_transition(policy, R, W, model)
     dist0 = initial_distribution(model) if init_dist is None else init_dist
     d_size = model.dist_grid.shape[0]
     n = model.labor_levels.shape[0]
+    if kspec.fused and not spec.two_phase and method == "auto":
+        from ..ops.pallas_kernels import probe_kernel
+        on_tpu = jax.default_backend() in ("tpu", "axon")
+        op_bytes = n * d_size * d_size * dist0.dtype.itemsize
+        if not on_tpu:
+            method = "pallas"        # interpret-mode kernel: the CI path
+        elif op_bytes <= 8 * 2 ** 20 and probe_kernel("dense_grid"):
+            method = "pallas"
+        elif op_bytes <= 2 ** 31:
+            method = "dense"
+        else:
+            method = "scatter"
     if spec.two_phase and method in ("auto", "pallas"):
         # the ladder's method table: the kernel runs ONE precision, so the
         # descent/polish split needs the XLA paths; on accelerators the
@@ -1171,15 +1456,38 @@ def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
         trans_c = cast_floating(trans, cheap)
         push_cheap = lambda d: _push_forward(  # noqa: E731
             d, trans_c, P_c, matmul_precision=DESCENT_MATMUL_PRECISION)
+    # bf16 descent rung (ISSUE 13 leg 3): one rung below the cheap
+    # descent under kernel="fused", TPU-gated — same escalation contract.
+    rung_kw = {}
+    if bf16_rung_active(kspec):
+        bf16 = jnp.bfloat16   # dtype-ok: the bf16 rung's solver seam
+        P_b = model.transition.astype(bf16)
+        if method == "dense":
+            S_b = S.astype(bf16)
+            push_bf16 = lambda d: _push_forward_dense(  # noqa: E731
+                d, S_b, P_b, matmul_precision=DESCENT_MATMUL_PRECISION)
+        else:
+            trans_b = cast_floating(trans, bf16)
+            push_bf16 = lambda d: _push_forward(  # noqa: E731
+                d, trans_b, P_b, matmul_precision=DESCENT_MATMUL_PRECISION)
+        rung_kw = dict(push_bf16=push_bf16,
+                       bf16_tol=descent_tolerance(
+                           tol, bf16, BF16_DIST_RUNG_TOL_SCALE))
     if descent_fault_iter is not None:
         push_cheap = inject_fault(
             push_cheap, descent_fault_mode, at_iter=descent_fault_iter,
             amplitude=10.0 * descent_tolerance(tol, cheap,
                                                DIST_DESCENT_TOL_SCALE))
+        if "push_bf16" in rung_kw:
+            rung_kw["push_bf16"] = inject_fault(
+                rung_kw["push_bf16"], descent_fault_mode,
+                at_iter=descent_fault_iter,
+                amplitude=10.0 * rung_kw["bf16_tol"])
     dist, it, diff, status, phases = ladder_distribution_fixed_point(
         push_cheap, push, dist0, tol,
         descent_tolerance(tol, cheap, DIST_DESCENT_TOL_SCALE),
-        max_iter, accel_every, polish=spec.polish, cheap_dtype=cheap)
+        max_iter, accel_every, polish=spec.polish, cheap_dtype=cheap,
+        **rung_kw)
     return _with_phases((dist, it, diff, status), return_phases, phases)
 
 

@@ -94,6 +94,22 @@ DIRECTION_EXPLICIT: Dict[str, str] = {
     "grid_cells_certified": UP,
     "grid_escalations": DOWN,
     "grid_knee": NEUTRAL,
+    # fused-kernel leg (ISSUE 13, bench --kernel-smoke): the sentinel
+    # grades the kernel_* record from its first committed round.  Walls
+    # and drift resolve through the _wall_s/_max_bp suffix rules and
+    # throughputs through _per_sec_per_chip; the remaining fields are
+    # declared here — reductions and certified counts UP, escalations
+    # DOWN, launch counts informational.
+    "kernel_cells": NEUTRAL,
+    "kernel_wall_reduction": UP,
+    "kernel_cells_certified": UP,
+    "kernel_escalations": DOWN,
+    "kernel_drill_escalations": NEUTRAL,   # the injected drill's count
+    #                                        is a contract, not a trend
+    "kernel_drill_max_knot_diff": NEUTRAL,  # bounded by the drill's own
+    #                                         acceptance, not a trend
+    "kernel_fused_executables": NEUTRAL,
+    "kernel_fused_launches": NEUTRAL,
 }
 
 # Suffix/affix rules, first match wins.  Kept coarse on purpose: bench

@@ -306,7 +306,11 @@ class Tracer:
                 "name": sp.name,
                 "ph": "X",
                 "ts": ts0,
-                "dur": round((sp.t1 - sp.t0) * 1e6, 3),
+                # duration of the ROUNDED endpoints (not a third
+                # independent rounding): ts + dur is then exactly ts1,
+                # so a synthetic child sharing its parent's t1 can never
+                # export an end a rounding-ulp past the parent's
+                "dur": round(ts1 - ts0, 3),
                 "pid": pid,
                 "tid": sp.tid,
                 "args": args,

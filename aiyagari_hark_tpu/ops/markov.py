@@ -141,6 +141,54 @@ def stationary_distribution(transition: jnp.ndarray, iters: int = 2000,
     return pi
 
 
+# ---------------------------------------------------------------------------
+# Cell-batched MXU push-forward (ISSUE 13 leg 2, DESIGN §4c).
+# ---------------------------------------------------------------------------
+
+def tile_wealth_operator(S: jnp.ndarray) -> jnp.ndarray:
+    """Re-lay the per-state lottery operator ``S [N, D, D]``
+    (``models.household.dense_wealth_operator``) as ONE ``[D, N·D]``
+    left factor for the tile-shaped push-forward below:
+    ``S_t[:, n·D + k] = S[n, :, k]`` — state-n's columns occupy column
+    block n.  Built once per policy, like ``S`` itself."""
+    n, d, _ = S.shape
+    return jnp.transpose(S, (1, 0, 2)).reshape(d, n * d)
+
+
+def tiled_wealth_push_forward(dist, S_t, P,
+                              matmul_precision=jax.lax.Precision.HIGHEST):
+    """One distribution step as ONE tile-shaped MXU contraction
+    (ISSUE 13 leg 2): the asset lottery AND the labor mixing fused into
+    a single ``[D, N·D] × [N·D, N]`` matmul,
+
+        out[d, m] = sum_{n,k} S[n, d, k] · dist[k, n] · P[n, m],
+
+    instead of the reference layout's ``vmap``-of-``[D,D]×[D,1]``
+    matvecs followed by the small ``[D,N]×[N,N]`` mix.  On the MXU a
+    1-wide matvec RHS wastes 127/128 of the systolic array while costing
+    the same cycles as a full tile, so trading the matvec op count for
+    one contraction whose dims are real tiles (contraction length
+    ``N·D``, output tile ``[D, N]``) is a win exactly on the hardware
+    this targets; under a vmapped sweep the lane axis becomes the
+    ``dot_general`` batch dim, so the batch (cells × labor-states)
+    dimension lands in the contraction/tile dims as one
+    ``[C, D, N·D] × [C, N·D, N]`` batched contraction per step.
+
+    NOT bit-identical to ``models.household._push_forward_dense`` (the
+    fused contraction reorders the reductions — float-fusion noise,
+    ~1e-15 relative), so it runs only under ``kernel="fused"`` (DESIGN
+    §4c); the reference layout stays the default.
+
+    Args: ``dist [D, N]``, ``S_t [D, N·D]`` (``tile_wealth_operator``),
+    ``P [N, N]``.  Returns the next distribution ``[D, N]``."""
+    n = P.shape[0]
+    d = dist.shape[0]
+    # mixed[n·D + k, m] = dist[k, n] · P[n, m]: the dist⊗P right factor
+    mixed = (dist.T[:, :, None] * P[:, None, :]).reshape(n * d, n)
+    return jnp.matmul(S_t, mixed, precision=matmul_precision,
+                      preferred_element_type=dist.dtype)
+
+
 def aggregate_markov_matrix(dur_mean_b: float, dur_mean_g: float,
                             dtype=None) -> jnp.ndarray:
     """2x2 aggregate (Bad/Good) transition matrix from mean state durations

@@ -364,98 +364,440 @@ def egm_policy_pallas_grid(m0: jnp.ndarray, c0: jnp.ndarray,
     return m, cc, stats[:, 0, 0].astype(jnp.int32), stats[:, 0, 1]
 
 
-@functools.lru_cache(maxsize=1)
-def pallas_egm_tpu_available() -> bool:
-    """Whether the compiled Mosaic EGM kernel works on the ambient TPU —
-    probed once per process (same policy as ``pallas_tpu_available``).
-    The EGM step leans on searchsorted-style gathers the Mosaic lowering
-    may not support on every generation; a failed probe degrades the
-    policy loop to the XLA lock-step path, never kills the caller."""
+# ---------------------------------------------------------------------------
+# Fused EGM + push-forward megakernel (ISSUE 13 tentpole, DESIGN §4c).
+# ---------------------------------------------------------------------------
+
+def _fused_phases(m0_ref, c0_ref, a_ref, dg_ref, lvl_ref, P_ref, scal_ref,
+                  h_ref, d0_ref, *, tol, max_iter, accel_every, dist_tol,
+                  dist_max_iter, dist_accel, tail):
+    """The shared body of both fused kernels: ONE supply evaluation's EGM
+    policy fixed point AND distribution push-forward fixed point without
+    leaving the kernel between phases (the latency-roofline fix, DESIGN
+    §4c).  Refs arrive already lane-sliced ([N, K] policies, [1, A]/
+    [1, D] grids).  Returns (policy, dist, egm_it, egm_diff, dist_it,
+    dist_diff).
+
+    Correctness shares the exact iteration code of the XLA paths
+    (``accelerated_policy_fixed_point`` + ``egm_step``,
+    ``accelerated_distribution_fixed_point``) so the kernel cannot drift
+    from the reference logic; what changes is memory placement (grids,
+    transition matrix, and both iterates stay VMEM-resident across both
+    phases) and the push-forward layout (the tile-shaped
+    ``ops.markov.tiled_wealth_push_forward`` contraction — reduction
+    order differs from the reference matvec layout at float-fusion
+    noise, which is why the fused path is opt-in, never default).
+
+    ``tail`` (static): close every policy iterate with the PR 12
+    analytic linear tail IN-KERNEL.  The human-wealth intercept ``h``
+    needs an [N, N] linear solve, which neither Mosaic nor the kernel
+    economics want per iteration — it depends only on (R, W, P), so the
+    dispatch wrapper computes it ONCE outside and passes it in
+    (``h_ref``); the MPC-limit slope is elementwise and computed
+    in-kernel.
+    """
+    from ..models.household import (
+        HouseholdPolicy,
+        SimpleModel,
+        _append_analytic_tail_knots,
+        accelerated_distribution_fixed_point,
+        accelerated_policy_fixed_point,
+        egm_step,
+        wealth_transition,
+    )
+    from ..ops.utility import asymptotic_mpc
+    from .markov import tiled_wealth_push_forward
+
+    a = a_ref[0]          # [A] end-of-period asset grid
+    dg = dg_ref[0]        # [D] wealth-histogram support
+    lvl = lvl_ref[0]      # [N] labor levels
+    P = P_ref[:]          # [N, N] labor transition
+    R, W, disc_fac, crra, blim = _egm_scalars(scal_ref[0])
+    h = h_ref[0]          # [N] per-state human wealth (tail intercept)
+    dt = a.dtype
+    n_states = lvl.shape[0]
+    d_size = dg.shape[0]
+    # the remaining SimpleModel field (labor_stationary) is a structural
+    # placeholder so the kernel can reuse the exact production step and
+    # transition functions — nothing in this body reads it
+    model = SimpleModel(a_grid=a, labor_levels=lvl, transition=P,
+                        labor_stationary=lvl, dist_grid=dg,
+                        borrow_limit=blim)
+
+    def step(p):
+        p = egm_step(p, R, W, model, disc_fac, crra)
+        if tail:
+            kappa = asymptotic_mpc(R, disc_fac, crra)
+            mk, ck = _append_analytic_tail_knots(p.m_knots, p.c_knots,
+                                                 kappa, h)
+            p = HouseholdPolicy(m_knots=mk, c_knots=ck)
+        return p
+
+    p0 = HouseholdPolicy(m_knots=m0_ref[:], c_knots=c0_ref[:])
+    pol, egm_it, egm_diff, _ = accelerated_policy_fixed_point(
+        step, p0, tol, max_iter, accel_every)
+
+    # -- push-forward phase, same VMEM residency ---------------------------
+    # The Young lottery evaluated on the histogram support — the SAME
+    # production code as the XLA path (the policy never leaves the
+    # kernel between phases, but the lottery logic must not fork):
+    trans = wealth_transition(pol, R, W, model)
+    idx, w = trans.idx, trans.weight
+    # Per-state lottery operator built WITHOUT scatter (Mosaic has no
+    # .at[].add): column k of state n's block carries source gridpoint
+    # k's two-point lottery, placed by one-hot row compares.  Laid out
+    # directly as the [D, N·D] left factor of the tile-shaped
+    # contraction (``ops.markov.tile_wealth_operator`` layout).
+    rows = jax.lax.broadcasted_iota(jnp.int32, (d_size, d_size), 0)
+    zero = jnp.zeros((), dtype=dt)
+    blocks = []
+    for i in range(n_states):
+        left = jnp.where(rows == idx[:, i][None, :],
+                         (1.0 - w[:, i])[None, :], zero)
+        right = jnp.where(rows == (idx[:, i] + 1)[None, :],
+                          w[:, i][None, :], zero)
+        blocks.append(left + right)
+    S_t = jnp.concatenate(blocks, axis=1)                # [D, N·D]
+
+    def push(dist):
+        return tiled_wealth_push_forward(dist, S_t, P)
+
+    dist, dist_it, dist_diff, _ = accelerated_distribution_fixed_point(
+        push, d0_ref[:], dist_tol, dist_max_iter, dist_accel)
+    return pol, dist, egm_it, egm_diff, dist_it, dist_diff
+
+
+def _fused_cell_kernel(m0_ref, c0_ref, a_ref, dg_ref, lvl_ref, P_ref,
+                       scal_ref, h_ref, d0_ref, m_out, c_out, dist_out,
+                       stats_ref, *, tol, max_iter, accel_every, dist_tol,
+                       dist_max_iter, dist_accel, tail):
+    """One cell's fused supply evaluation (see ``_fused_phases``).  The
+    statuses are dropped at the kernel boundary and reconstructed from
+    the (iters, diff) pairs outside — exact, as for the per-loop
+    kernels."""
+    pol, dist, egm_it, egm_diff, dist_it, dist_diff = _fused_phases(
+        m0_ref, c0_ref, a_ref, dg_ref, lvl_ref, P_ref, scal_ref, h_ref,
+        d0_ref, tol=tol, max_iter=max_iter, accel_every=accel_every,
+        dist_tol=dist_tol, dist_max_iter=dist_max_iter,
+        dist_accel=dist_accel, tail=tail)
+    dt = dist.dtype
+    m_out[:] = pol.m_knots
+    c_out[:] = pol.c_knots
+    dist_out[:] = dist
+    stats_ref[:] = jnp.stack([egm_it.astype(dt), egm_diff.astype(dt),
+                              dist_it.astype(dt),
+                              dist_diff.astype(dt)]).reshape(1, 4)
+
+
+def fused_cell_pallas(m0, c0, a_grid, dist_grid, levels, P, scalars, h, d0,
+                      tol: float, max_iter: int = 3000,
+                      accel_every: int = 32, dist_tol: float = 1e-11,
+                      dist_max_iter: int = 20000, dist_accel: int = 64,
+                      tail: bool = False, interpret: bool | None = None):
+    """One cell's EGM policy fixed point AND distribution push-forward as
+    ONE Pallas kernel launch (ISSUE 13 tentpole): the two phases of a
+    supply evaluation run back to back with shared VMEM residency of the
+    grids/transition matrix, never returning to the host (or HBM)
+    between them.
+
+    Args: ``m0``/``c0`` [N, K] policy knots (K = A+1 reference layout,
+    A+3 tail-closed compact layout with ``tail=True``), ``a_grid`` [A],
+    ``dist_grid`` [D], ``levels`` [N], ``P`` [N, N], ``scalars`` [5]
+    packed (R, W, disc_fac, crra, borrow_limit), ``h`` [N] per-state
+    perfect-foresight human wealth (the in-kernel tail's intercept —
+    pass zeros when ``tail=False``), ``d0`` [D, N].  Returns
+    (m_knots, c_knots, dist, egm_iters, egm_diff, dist_iters,
+    dist_diff); the caller reconstructs both ``solver_health`` statuses
+    from the (iters, diff) pairs (``classify_fixed_point_exit`` — the
+    policy loop has no stall exit, the distribution loop's stall window
+    is classified exactly).
+
+    ``interpret``: None = interpret everywhere except a real TPU backend
+    (interpret-mode is the CI correctness path on CPU; the compiled
+    Mosaic kernel is the TPU path, probe-gated by
+    ``probe_kernel("fused")``)."""
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    n, k = m0.shape
+    d = dist_grid.shape[0]
+    kernel = functools.partial(_fused_cell_kernel, tol=tol,
+                               max_iter=max_iter, accel_every=accel_every,
+                               dist_tol=dist_tol,
+                               dist_max_iter=dist_max_iter,
+                               dist_accel=dist_accel, tail=tail)
+    call = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((n, k), m0.dtype),
+                   jax.ShapeDtypeStruct((n, k), m0.dtype),
+                   jax.ShapeDtypeStruct((d, n), d0.dtype),
+                   jax.ShapeDtypeStruct((1, 4), d0.dtype)),
+        interpret=interpret,
+    )
+    m, c, dist, stats = call(m0, c0, a_grid.reshape(1, -1),
+                             dist_grid.reshape(1, -1),
+                             levels.reshape(1, -1), P,
+                             scalars.reshape(1, -1), h.reshape(1, -1), d0)
+    return (m, c, dist, stats[0, 0].astype(jnp.int32), stats[0, 1],
+            stats[0, 2].astype(jnp.int32), stats[0, 3])
+
+
+def _fused_cell_kernel_lane(m0_ref, c0_ref, a_ref, dg_ref, lvl_ref, P_ref,
+                            scal_ref, h_ref, d0_ref, m_out, c_out,
+                            dist_out, stats_ref, *, tol, max_iter,
+                            accel_every, dist_tol, dist_max_iter,
+                            dist_accel, tail):
+    """One sweep lane's fused supply evaluation; refs carry a leading
+    lane axis of block size 1 (the pallas grid maps program instance ->
+    lane), so each lane runs BOTH phases and exits at its own
+    convergence — the straggler economics of the per-loop lane grids,
+    now covering the whole evaluation."""
+    pol, dist, egm_it, egm_diff, dist_it, dist_diff = _fused_phases(
+        m0_ref[0], c0_ref[0], a_ref[0], dg_ref[0], lvl_ref[0], P_ref[0],
+        scal_ref[0], h_ref[0], d0_ref[0], tol=tol, max_iter=max_iter,
+        accel_every=accel_every, dist_tol=dist_tol,
+        dist_max_iter=dist_max_iter, dist_accel=dist_accel, tail=tail)
+    dt = dist.dtype
+    m_out[0] = pol.m_knots
+    c_out[0] = pol.c_knots
+    dist_out[0] = dist
+    stats_ref[0] = jnp.stack([egm_it.astype(dt), egm_diff.astype(dt),
+                              dist_it.astype(dt),
+                              dist_diff.astype(dt)]).reshape(1, 4)
+
+
+def fused_cell_pallas_grid(m0, c0, a_grid, dist_grid, levels, P, scalars,
+                           h, d0, tol: float, max_iter: int = 3000,
+                           accel_every: int = 32, dist_tol: float = 1e-11,
+                           dist_max_iter: int = 20000,
+                           dist_accel: int = 64, tail: bool = False,
+                           interpret: bool | None = None):
+    """Batched fused supply evaluations as a Pallas GRID: one program
+    instance per sweep lane, each running its EGM fixed point AND its
+    push-forward fixed point device-resident and exiting at its OWN
+    convergence (ISSUE 13 tentpole — a whole bucket's inner work becomes
+    one launch instead of launch-per-loop-per-lane).
+
+    Args as ``fused_cell_pallas`` with a leading lane axis C:
+    ``m0``/``c0`` [C, N, K], ``a_grid`` [C, A], ``dist_grid`` [C, D],
+    ``levels`` [C, N], ``P`` [C, N, N], ``scalars`` [C, 5], ``h``
+    [C, N], ``d0`` [C, D, N].  Returns (m [C,N,K], c [C,N,K],
+    dist [C,D,N], egm_iters [C] int32, egm_diffs [C], dist_iters [C]
+    int32, dist_diffs [C])."""
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    cc, n, k = m0.shape
+    a = a_grid.shape[1]
+    d = dist_grid.shape[1]
+    kernel = functools.partial(_fused_cell_kernel_lane, tol=tol,
+                               max_iter=max_iter, accel_every=accel_every,
+                               dist_tol=dist_tol,
+                               dist_max_iter=dist_max_iter,
+                               dist_accel=dist_accel, tail=tail)
+    kwargs = {}
+    if not interpret:
+        from jax.experimental.pallas import tpu as pltpu
+
+        # Same scoped-VMEM reasoning as the distribution lane grid: the
+        # pipeline double-buffers the next lane's operands, and the
+        # in-kernel [D, N·D] tiled operator is the dominant term.
+        op_bytes = d0.dtype.itemsize * n * d * d
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=2 * op_bytes + 32 * 1024 * 1024)
+    call = pl.pallas_call(
+        kernel,
+        grid=(cc,),
+        in_specs=[
+            pl.BlockSpec((1, n, k), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, n, k), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, a), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, n), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, n, n), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, 5), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, n), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, d, n), lambda i: (i, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, n, k), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, n, k), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, d, n), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, 4), lambda i: (i, 0, 0)),
+        ),
+        out_shape=(jax.ShapeDtypeStruct((cc, n, k), m0.dtype),
+                   jax.ShapeDtypeStruct((cc, n, k), m0.dtype),
+                   jax.ShapeDtypeStruct((cc, d, n), d0.dtype),
+                   jax.ShapeDtypeStruct((cc, 1, 4), d0.dtype)),
+        interpret=interpret,
+        **kwargs,
+    )
+    m, c, dist, stats = call(m0, c0, a_grid.reshape(cc, 1, a),
+                             dist_grid.reshape(cc, 1, d),
+                             levels.reshape(cc, 1, n), P,
+                             scalars.reshape(cc, 1, 5),
+                             h.reshape(cc, 1, n), d0)
+    return (m, c, dist, stats[:, 0, 0].astype(jnp.int32), stats[:, 0, 1],
+            stats[:, 0, 2].astype(jnp.int32), stats[:, 0, 3])
+
+
+# ---------------------------------------------------------------------------
+# Kernel probes (ISSUE 13 satellite: ONE memoized prober + a registry).
+# ---------------------------------------------------------------------------
+#
+# Every compiled Mosaic kernel must be probed once per process before the
+# "auto"/policy dispatch trusts it: Mosaic lowering gaps vary by TPU
+# generation and jax version (e.g. the batched-dot attribute bug the
+# distribution kernel works around on a v5-lite), and a failed compile
+# must degrade to the XLA path, never kill the caller.  The four historic
+# copy-paste ``pallas_*_available`` functions shared exactly this
+# skeleton — backend gate, dependency probe, tiny compiled run, broad
+# except — so the skeleton now lives ONCE in ``probe_kernel`` and each
+# kernel registers only its tiny instance; a new kernel gets its probe
+# for free by adding a builder.
+
+def _probe_args(c: int | None = None):
+    """Tiny shared probe calibration; ``c`` adds a lane axis."""
+    n, a, d = 2, 8, 16
+    a_grid = jnp.linspace(0.01, 5.0, a)
+    m0 = jnp.tile(jnp.concatenate([jnp.asarray([1e-7]),
+                                   a_grid + 1e-7])[None, :], (n, 1))
+    scal = jnp.asarray([1.02, 1.0, 0.96, 2.0, 0.0])
+    P = jnp.full((n, n), 0.5)
+    lvl = jnp.asarray([0.8, 1.2])
+    dg = jnp.linspace(0.0, 5.0, d)
+    d0 = jnp.full((d, n), 1.0 / (d * n))
+    out = dict(n=n, a=a, d=d, a_grid=a_grid, m0=m0, scal=scal, P=P,
+               lvl=lvl, dg=dg, d0=d0)
+    if c is not None:
+        out.update(
+            c=c,
+            a_grid=jnp.tile(a_grid[None, :], (c, 1)),
+            m0=jnp.tile(m0[None], (c, 1, 1)),
+            scal=jnp.tile(scal[None, :], (c, 1)),
+            P=jnp.tile(P[None], (c, 1, 1)),
+            lvl=jnp.tile(lvl[None, :], (c, 1)),
+            dg=jnp.tile(dg[None, :], (c, 1)),
+            d0=jnp.tile(d0[None], (c, 1, 1)))
+    return out
+
+
+def _probe_dense():
+    n, d = 2, 16
+    S = jnp.stack([jnp.eye(d), jnp.eye(d)])
+    P = jnp.full((n, n), 0.5)
+    d0 = jnp.full((d, n), 1.0 / (d * n))
+    dist, _, _ = stationary_dense_pallas(S, P, d0, tol=1e-6,
+                                         max_iter=8, interpret=False)
+    return bool(jnp.isfinite(dist).all())
+
+
+def _probe_dense_grid():
+    c, n, d = 2, 2, 16
+    S = jnp.broadcast_to(jnp.eye(d), (c, n, d, d))
+    P = jnp.full((c, n, n), 0.5)
+    d0 = jnp.full((c, d, n), 1.0 / (d * n))
+    dist, _, _ = stationary_dense_pallas_grid(S, P, d0, tol=1e-6,
+                                              max_iter=8, interpret=False)
+    return bool(jnp.isfinite(dist).all())
+
+
+def _probe_egm():
+    p = _probe_args()
+    m, c, _, _ = egm_policy_pallas(p["m0"], p["m0"], p["a_grid"], p["lvl"],
+                                   p["P"], p["scal"], tol=1e-4, max_iter=8,
+                                   interpret=False)
+    return bool(jnp.isfinite(m).all() & jnp.isfinite(c).all())
+
+
+def _probe_egm_grid():
+    p = _probe_args(c=2)
+    m, cc, _, _ = egm_policy_pallas_grid(
+        p["m0"], p["m0"], p["a_grid"], p["lvl"], p["P"], p["scal"],
+        tol=1e-4, max_iter=8, interpret=False)
+    return bool(jnp.isfinite(m).all() & jnp.isfinite(cc).all())
+
+
+def _probe_fused():
+    p = _probe_args()
+    h = jnp.zeros_like(p["lvl"])
+    m, c, dist, _, _, _, _ = fused_cell_pallas(
+        p["m0"], p["m0"], p["a_grid"], p["dg"], p["lvl"], p["P"],
+        p["scal"], h, p["d0"], tol=1e-4, max_iter=8, dist_tol=1e-5,
+        dist_max_iter=8, interpret=False)
+    return bool(jnp.isfinite(m).all() & jnp.isfinite(c).all()
+                & jnp.isfinite(dist).all())
+
+
+def _probe_fused_grid():
+    p = _probe_args(c=2)
+    h = jnp.zeros_like(p["lvl"])
+    m, c, dist, _, _, _, _ = fused_cell_pallas_grid(
+        p["m0"], p["m0"], p["a_grid"], p["dg"], p["lvl"], p["P"],
+        p["scal"], h, p["d0"], tol=1e-4, max_iter=8, dist_tol=1e-5,
+        dist_max_iter=8, interpret=False)
+    return bool(jnp.isfinite(m).all() & jnp.isfinite(c).all()
+                & jnp.isfinite(dist).all())
+
+
+# name -> (tiny compiled run, prerequisite probe).  Grid kernels require
+# their single-lane twin first: grid lowering has materially different
+# compile requirements (dimension_semantics, raised vmem_limit_bytes),
+# and a backend where the single-lane probe passes but the grid lowering
+# fails must fall back instead of dying at sweep compile time.
+_PROBES = {
+    "dense": (_probe_dense, None),
+    "dense_grid": (_probe_dense_grid, "dense"),
+    "egm": (_probe_egm, None),
+    "egm_grid": (_probe_egm_grid, "egm"),
+    "fused": (_probe_fused, None),
+    "fused_grid": (_probe_fused_grid, "fused"),
+}
+
+
+@functools.lru_cache(maxsize=None)
+def probe_kernel(name: str) -> bool:
+    """Whether the named compiled Mosaic kernel works on the ambient TPU
+    backend — probed once per process by compiling and running the tiny
+    registered instance.  False off-TPU, False when the prerequisite
+    probe fails, False on ANY compile/runtime failure (the caller falls
+    back to the XLA path); an unknown name raises (a typo must not
+    silently read as "unavailable")."""
+    try:
+        builder, dep = _PROBES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown kernel probe {name!r}; registered: "
+            f"{sorted(_PROBES)}") from None
     if jax.default_backend() not in ("tpu", "axon"):
         return False
+    if dep is not None and not probe_kernel(dep):
+        return False
     try:
-        n, a = 2, 8
-        a_grid = jnp.linspace(0.01, 5.0, a)
-        m0 = jnp.tile(jnp.concatenate([jnp.asarray([1e-7]),
-                                       a_grid + 1e-7])[None, :], (n, 1))
-        scal = jnp.asarray([1.02, 1.0, 0.96, 2.0, 0.0])
-        P = jnp.full((n, n), 0.5)
-        lvl = jnp.asarray([0.8, 1.2])
-        m, c, _, _ = egm_policy_pallas(m0, m0, a_grid, lvl, P, scal,
-                                       tol=1e-4, max_iter=8,
-                                       interpret=False)
-        return bool(jnp.isfinite(m).all() & jnp.isfinite(c).all())
+        return bool(builder())
     except Exception:   # noqa: BLE001 — any compile/runtime failure means
         # the kernel is unusable here; the caller falls back to XLA
         return False
 
 
-@functools.lru_cache(maxsize=1)
-def pallas_egm_grid_tpu_available() -> bool:
-    """Same probe for the lane-GRID EGM kernel the batched sweep runs
-    (separate probe for the same reason as ``pallas_grid_tpu_available``:
-    grid lowering can fail where the single-lane kernel compiles)."""
-    if not pallas_egm_tpu_available():
-        return False
-    try:
-        c, n, a = 2, 2, 8
-        a_grid = jnp.linspace(0.01, 5.0, a)
-        m0 = jnp.tile(jnp.concatenate([jnp.asarray([1e-7]),
-                                       a_grid + 1e-7])[None, None, :],
-                      (c, n, 1))
-        scal = jnp.tile(jnp.asarray([1.02, 1.0, 0.96, 2.0, 0.0])[None, :],
-                        (c, 1))
-        P = jnp.full((c, n, n), 0.5)
-        lvl = jnp.tile(jnp.asarray([0.8, 1.2])[None, :], (c, 1))
-        m, cc, _, _ = egm_policy_pallas_grid(
-            m0, m0, jnp.tile(a_grid[None, :], (c, 1)), lvl, P, scal,
-            tol=1e-4, max_iter=8, interpret=False)
-        return bool(jnp.isfinite(m).all() & jnp.isfinite(cc).all())
-    except Exception:   # noqa: BLE001 — fall back to the XLA policy loop
-        return False
-
-
-@functools.lru_cache(maxsize=1)
+# The historic probe spellings, kept for callers/tests; each is now a
+# thin alias of the registry prober.
 def pallas_tpu_available() -> bool:
-    """Whether the compiled Mosaic kernel actually works on the ambient TPU
-    backend — probed once per process by compiling and running a tiny
-    instance.  Guards the "auto" method choice: a Mosaic lowering gap (e.g.
-    the batched-dot attribute bug this kernel had to work around on a
-    v5-lite) must degrade to the XLA dense path, not kill the caller."""
-    if jax.default_backend() not in ("tpu", "axon"):
-        return False
-    try:
-        n, d = 2, 16
-        S = jnp.stack([jnp.eye(d), jnp.eye(d)])
-        P = jnp.full((n, n), 0.5)
-        d0 = jnp.full((d, n), 1.0 / (d * n))
-        dist, _, _ = stationary_dense_pallas(S, P, d0, tol=1e-6,
-                                             max_iter=8, interpret=False)
-        return bool(jnp.isfinite(dist).all())
-    except Exception:   # noqa: BLE001 — any compile/runtime failure means
-        # the kernel is unusable here; the caller falls back to XLA
-        return False
+    return probe_kernel("dense")
 
 
-@functools.lru_cache(maxsize=1)
 def pallas_grid_tpu_available() -> bool:
-    """Same probe for the LANE-GRID kernel, which the batched (sweep) path
-    actually runs.  Separate from ``pallas_tpu_available`` because the grid
-    kernel has materially different compile requirements (grid
-    dimension_semantics, a raised ``vmem_limit_bytes`` for the
-    double-buffered lane operators) — a backend where the single-lane probe
-    passes but the grid lowering fails must fall back to dense instead of
-    dying at sweep compile time."""
-    if not pallas_tpu_available():
-        return False
-    try:
-        c, n, d = 2, 2, 16
-        S = jnp.broadcast_to(jnp.eye(d), (c, n, d, d))
-        P = jnp.full((c, n, n), 0.5)
-        d0 = jnp.full((c, d, n), 1.0 / (d * n))
-        dist, _, _ = stationary_dense_pallas_grid(S, P, d0, tol=1e-6,
-                                                  max_iter=8,
-                                                  interpret=False)
-        return bool(jnp.isfinite(dist).all())
-    except Exception:   # noqa: BLE001 — fall back to dense
-        return False
+    return probe_kernel("dense_grid")
+
+
+def pallas_egm_tpu_available() -> bool:
+    return probe_kernel("egm")
+
+
+def pallas_egm_grid_tpu_available() -> bool:
+    return probe_kernel("egm_grid")

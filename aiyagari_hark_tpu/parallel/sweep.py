@@ -329,6 +329,13 @@ def _retry_ladder(model_kwargs: dict) -> tuple:
     # goldens certify.
     if model_kwargs.get("grid", "reference") != "reference":
         rungs = tuple({**r, "grid": "reference"} for r in rungs)
+    # And for a non-reference KERNEL policy (ISSUE 13, DESIGN §4c):
+    # quarantine escalates to the launch-per-loop reference engines — a
+    # fused-kernel pathology (Mosaic lowering, VMEM residency, the tiled
+    # contraction) is invisible to the XLA paths, and the rungs must
+    # re-solve on the one engine the goldens certify.
+    if model_kwargs.get("kernel", "reference") != "reference":
+        rungs = tuple({**r, "kernel": "reference"} for r in rungs)
     return rungs
 
 
@@ -1109,6 +1116,13 @@ def _run_sweep_impl(scn, sweep, cells_nom, mesh, axis, dtype, timer,
     # cache or a ledger)
     if sweep.grid != "reference":
         model_kwargs.setdefault("grid", sweep.grid)
+    # SweepConfig.kernel (ISSUE 13, DESIGN §4c): the same model-kwarg
+    # DEFAULT rule as grid — an explicit run_sweep(..., kernel=...) kwarg
+    # wins, and the resolved spelling rides kwargs_items into every
+    # fingerprint (so the CostLedger keys fused executables apart from
+    # reference ones for free)
+    if sweep.kernel != "reference":
+        model_kwargs.setdefault("kernel", sweep.kernel)
     # family-level sweep kwarg defaults (e.g. Aiyagari's backend-aware
     # dist_method/egm_method selection) applied IN PLACE; the returned
     # metadata records what actually runs

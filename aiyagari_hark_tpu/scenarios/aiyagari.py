@@ -69,6 +69,15 @@ def _prepare_kwargs(model_kwargs: dict) -> dict:
 
     two_phase = model_kwargs.get("precision", "reference") != "reference"
     compacted = model_kwargs.get("grid", "reference") != "reference"
+    fused = model_kwargs.get("kernel", "reference") != "reference"
+    if fused and not two_phase:
+        # kernel="fused" single-phase (ISSUE 13, DESIGN §4c): both inner
+        # loops run inside the fused megakernel, so the per-loop method
+        # knobs are moot — default them without burning the per-loop
+        # Mosaic probes (the fused path carries its own probe + XLA
+        # fallback inside household_capital_supply)
+        model_kwargs.setdefault("dist_method", "auto")
+        model_kwargs.setdefault("egm_method", "xla")
     if "dist_method" not in model_kwargs:
         # Sweep-level default, distinct from stationary_wealth's "auto".
         # On accelerators: "pallas" — the lane-grid kernel (one program
@@ -111,7 +120,8 @@ def _prepare_kwargs(model_kwargs: dict) -> dict:
         else:
             model_kwargs["egm_method"] = "xla"
     return {"dist_method": str(model_kwargs["dist_method"]),
-            "egm_method": str(model_kwargs["egm_method"])}
+            "egm_method": str(model_kwargs["egm_method"]),
+            "kernel": str(model_kwargs.get("kernel", "reference"))}
 
 
 def _host_bracket(model_kwargs, dtype):

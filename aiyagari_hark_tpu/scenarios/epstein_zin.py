@@ -54,13 +54,20 @@ class EZLean(NamedTuple):
 def solve_ez_lean(model, disc_fac, gamma, ez_rho, cap_share, depr_fac,
                   r_tol=None, max_bisect: int = 60, egm_tol=None,
                   dist_tol=None, dist_method: str = "auto",
-                  accel_every: int = 32, fault_iter=None,
-                  fault_mode=None) -> EZLean:
+                  accel_every: int = 32, kernel="reference",
+                  fault_iter=None, fault_mode=None) -> EZLean:
     """Bracketed bisection on r with the EZ household inside, scalar
     outputs only — jit/vmap-able, with the sweep-stack contract
     (accumulated counters, combined ``solver_health`` status with a
     non-finite tripwire, deterministic fault hook).  Every midpoint
-    solves COLD (see module docstring)."""
+    solves COLD (see module docstring).
+
+    ``kernel`` (ISSUE 13, DESIGN §4c): the EZ value recursion has no
+    fused-kernel contract (the structural analogue of its "anchors"
+    grid tail), so the policy loop runs unchanged; the DISTRIBUTION
+    loop rides the kernel policy through ``stationary_wealth`` — under
+    "fused" single-phase it prefers the VMEM kernel engine, and the
+    quarantine rungs force "reference" like every family's."""
     import jax
     import jax.numpy as jnp
 
@@ -94,7 +101,7 @@ def solve_ez_lean(model, disc_fac, gamma, ez_rho, cap_share, depr_fac,
             accel_every=accel_every)
         dist, d_it, _, d_st = stationary_wealth(
             as_household_policy(pol), 1.0 + r, W, model, tol=dist_tol,
-            method=dist_method)
+            method=dist_method, kernel=kernel)
         supply = aggregate_capital(dist, model)
         ex = supply - k_to_l * labor
         st = combine_status(e_st, d_st,
@@ -232,6 +239,10 @@ def _retry_rungs(model_kwargs: dict) -> tuple:
     # reference grid, the one layout the goldens certify
     if model_kwargs.get("grid", "reference") != "reference":
         rungs = tuple({**r, "grid": "reference"} for r in rungs)
+    # kernel escalation (ISSUE 13, DESIGN §4c): quarantine re-solves on
+    # the launch-per-loop reference engines
+    if model_kwargs.get("kernel", "reference") != "reference":
+        rungs = tuple({**r, "kernel": "reference"} for r in rungs)
     return rungs
 
 

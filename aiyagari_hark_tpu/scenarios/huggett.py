@@ -70,6 +70,7 @@ def solve_huggett_lean(model, disc_fac, crra, r_tol=None,
                        accel_every: int = 32,
                        precision: str = "reference",
                        grid="reference",
+                       kernel="reference",
                        bracket_init=None, fault_iter=None,
                        fault_mode=None) -> HuggettLean:
     """Bisect the bond rate until the credit market clears (E[a] = 0),
@@ -122,13 +123,17 @@ def solve_huggett_lean(model, disc_fac, crra, r_tol=None,
     zi = jnp.asarray(0, dtype=jnp.int32)
 
     def demand(r, pol_in, dist_in):
+        # kernel policy (ISSUE 13, DESIGN §4c) threads into both inner
+        # fixed points — the family rides the fused/bf16 engines through
+        # the same per-loop seams as the Aiyagari household
         policy, e_it, _, e_st = solve_household(
             1.0 + r, 1.0, model, disc_fac, crra, tol=egm_tol,
             init_policy=pol_in, accel_every=accel_every,
-            precision=precision, grid=grid)
+            precision=precision, grid=grid, kernel=kernel)
         dist, d_it, _, d_st = stationary_wealth(
             policy, 1.0 + r, 1.0, model, tol=dist_tol,
-            init_dist=dist_in, method=dist_method, precision=precision)
+            init_dist=dist_in, method=dist_method, precision=precision,
+            kernel=kernel)
         ex = aggregate_capital(dist, model)
         st = combine_status(e_st, d_st,
                             jnp.where(jnp.isfinite(ex), CONVERGED,
@@ -331,6 +336,10 @@ def _retry_rungs(model_kwargs: dict) -> tuple:
     # reference grid, the one layout the goldens certify
     if model_kwargs.get("grid", "reference") != "reference":
         rungs = tuple({**r, "grid": "reference"} for r in rungs)
+    # kernel escalation (ISSUE 13, DESIGN §4c): quarantine re-solves on
+    # the launch-per-loop reference engines
+    if model_kwargs.get("kernel", "reference") != "reference":
+        rungs = tuple({**r, "kernel": "reference"} for r in rungs)
     return rungs
 
 

@@ -239,6 +239,82 @@ def resolve_grid(policy) -> GridSpec:
             f"got {policy!r}") from None
 
 
+# -- kernel policy (ISSUE 13, DESIGN §4c) ------------------------------------
+#
+# PR 10's CostLedger measured the sweep LATENCY-bound at ~0.06% MFU: the
+# hot loops are dominated by many tiny per-iteration launches, not by
+# arithmetic.  The kernel POLICY makes the fix opt-in, the exact shape of
+# the precision/grid policies above:
+#
+# * ``"reference"`` (default) — today's engine selection, bit-identical:
+#   the XLA while_loop paths (and the probe-gated per-loop Pallas
+#   kernels where the existing method knobs pick them).
+# * ``"fused"`` — the device-resident fused solve path, two legs gated
+#   by the ambient precision policy:
+#   (a) under a SINGLE-phase precision policy, the EGM policy iteration
+#       and the distribution push-forward of every supply evaluation run
+#       as ONE Pallas megakernel per lane (``ops.pallas_kernels.
+#       fused_cell_pallas{,_grid}``): shared VMEM residency of the
+#       grids/transition matrix, per-lane early exit, the analytic-tail
+#       closure applied in-kernel for compact grids, and the push-forward
+#       restructured into one tile-shaped MXU contraction per step
+#       (``ops.markov.tiled_wealth_push_forward``).  Probe-gated on TPU
+#       with the XLA paths as fallback; interpret-mode on CPU (the CI
+#       correctness path).
+#   (b) under a TWO-phase precision policy ("mixed"/"fast") the ladders
+#       gain the bf16 DESCENT rung below f32 (TPU-only; the x^(-1/gamma)
+#       FOC inversion stays f32) — a NONFINITE/STALLED bf16 rung
+#       escalates to the f32 descent exactly as a failed descent
+#       escalates to the reference polish today (the PRECISION_ESCALATED
+#       slot).
+#
+# The tolerance/certification contract is UNCHANGED: the fused engines
+# run the SAME iteration code to the same tolerances (values agree to
+# float-fusion noise — the tiled contraction reorders reductions), and
+# quarantine rungs force ``kernel="reference"`` (the launch-per-loop
+# fallback, the one configuration the goldens certify).
+
+KERNEL_POLICIES = ("reference", "fused")
+
+
+@dataclass(frozen=True)
+class KernelSpec:
+    """Resolved knobs for one kernel policy (ISSUE 13, DESIGN §4c).
+
+    ``fused`` — the EGM+push-forward megakernel path runs wherever the
+    ambient precision policy is single-phase (the kernel runs one
+    precision end-to-end).  ``bf16_descent`` — under a two-phase
+    precision policy the descent ladder gains the bf16 rung (gated
+    TPU-only at the solver seam, ``models.household.bf16_rung_active``).
+    """
+
+    policy: str
+    fused: bool
+    bf16_descent: bool
+
+
+_KERNEL_SPECS = {
+    "reference": KernelSpec("reference", fused=False, bf16_descent=False),
+    "fused": KernelSpec("fused", fused=True, bf16_descent=True),
+}
+
+
+def resolve_kernel(policy) -> KernelSpec:
+    """Validate a kernel policy name (or pass a spec through) — the ONE
+    validation surface, mirrored on ``resolve_precision``/``resolve_grid``:
+    an unknown policy raises here, before it can alias a real one in any
+    cache key (``utils.fingerprint.hashable_kwargs`` routes through
+    this)."""
+    if isinstance(policy, KernelSpec):
+        return policy
+    try:
+        return _KERNEL_SPECS[policy]
+    except (KeyError, TypeError):
+        raise ValueError(
+            f"kernel policy must be one of {KERNEL_POLICIES}, "
+            f"got {policy!r}") from None
+
+
 # Packed device-row layout of the AIYAGARI batched cell solver: ONE
 # stacked float row per cell means ONE device->host transfer per launch
 # (the round-5 packing rationale, ``parallel.sweep._batched_solver``).
@@ -432,6 +508,20 @@ class SweepConfig:
       Quarantine rungs force ``grid="reference"`` (the dense-grid
       escalation).
 
+    Kernel knob (ISSUE 13, DESIGN §4c):
+
+    * ``kernel`` — the kernel policy every cell solves under
+      (``KERNEL_POLICIES``): "reference" (default, bit-identical
+      launch-per-loop engines), "fused" (the device-resident
+      EGM+push-forward megakernel per supply evaluation under
+      single-phase precision; the bf16 descent rung under two-phase —
+      probe-gated on TPU, interpret-mode on CPU, XLA fallback).
+      Applied as a model-kwarg default exactly like ``grid`` — an
+      explicit ``run_sweep(..., kernel=...)`` kwarg wins — so it rides
+      every fingerprint (sidecar, resume ledger, store keys) through
+      ``hashable_kwargs``.  Quarantine rungs force
+      ``kernel="reference"`` (the launch-per-loop escalation).
+
     Observability knob (ISSUE 7, DESIGN §10):
 
     * ``obs`` — an ``obs.ObsConfig``: run-scoped tracing spans
@@ -458,6 +548,7 @@ class SweepConfig:
     recheck_fraction: float = 0.0
     certify: bool = False
     grid: str = "reference"
+    kernel: str = "reference"
     obs: Optional[ObsConfig] = None
 
     def replace(self, **kwargs) -> "SweepConfig":

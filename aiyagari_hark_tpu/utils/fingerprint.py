@@ -26,7 +26,7 @@ import json
 
 import numpy as np
 
-from .config import resolve_grid, resolve_precision
+from .config import resolve_grid, resolve_kernel, resolve_precision
 
 
 class IntegrityError(RuntimeError):
@@ -148,7 +148,15 @@ def hashable_kwargs(model_kwargs: dict) -> tuple:
     policies hashed by canonical name so compacted solves key their own
     sidecars/ledgers/store entries (a ledger or store entry written
     under one grid layout is structurally unaddressable from another),
-    unknown policies raise via ``resolve_grid`` before they can alias."""
+    unknown policies raise via ``resolve_grid`` before they can alias.
+
+    Kernel-policy normalization (ISSUE 13, DESIGN §4c): the same rule a
+    third time for ``kernel`` — explicit "reference" dropped, "fused"
+    hashed by canonical name so fused solves key their own executables,
+    sidecars, ledgers, and store entries (the CostLedger's
+    ``work_fingerprint`` keying therefore attributes cost per FUSED
+    executable for free), unknown policies raise via
+    ``resolve_kernel``."""
     items = []
     for k, v in sorted(model_kwargs.items()):
         if k == "precision":
@@ -162,6 +170,11 @@ def hashable_kwargs(model_kwargs: dict) -> tuple:
             # same authority pattern: resolve_grid validates and
             # canonicalizes (DESIGN §5b)
             v = resolve_grid(v).policy
+            if v == "reference":
+                continue
+        if k == "kernel":
+            # same authority pattern again (ISSUE 13, DESIGN §4c)
+            v = resolve_kernel(v).policy
             if v == "reference":
                 continue
         if isinstance(v, (list, np.ndarray)):

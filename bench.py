@@ -36,7 +36,17 @@ Extra BASELINE.md tracked metrics carried as fields on the same line:
  - ``egm_gridpoints_per_sec_per_chip``: total EGM work / wall / chips, where
    one EGM backward step touches a_count × labor_states policy knots
    (SURVEY.md §3.2's hot loop, minus the degenerate 4× aggregate-state
-   duplication this framework eliminates).
+   duplication this framework eliminates).  The wall in the denominator is
+   the WHOLE timed sweep — LAUNCH-WALL-INCLUSIVE: every per-iteration
+   dispatch, host round trip, and bisection-level overhead is in it, so on
+   a latency-bound backend the number measures launch overhead, not
+   hardware arithmetic (the measured ~0.06%-MFU regime, BASELINE.md).
+   Provenance matters when comparing rounds: the committed records mix
+   machines — r02's sweep ran on a tunneled TPU (~1.1M; the durable
+   ``bench_tpu_last.json`` TPU capture is 1.44M), r03/r04/r05 on CPU hosts
+   (~160-174k) — so the ``backend`` field on each record is part of the
+   metric's identity and the 174k-vs-1.44M swing is a machine change, NOT
+   a regression (the sentinel's worse-than-worst-prior gate absorbs it).
  - ``r_star_f32_f64_max_bp``: max over the 12 cells of |r*(this backend,
    f32) − r*(CPU, f64 oracle)| in basis points — the 1 bp equivalence line
    (BASELINE.md).  The oracle runs in a subprocess because a TPU process
@@ -2110,6 +2120,218 @@ def _compaction_smoke() -> dict:
     return record
 
 
+# Kernel smoke (ISSUE 13): fused-kernel acceptance on the committed-golden
+# 12-cell configuration — the fused path must keep every cell CERTIFIED
+# with r* within 0.1bp of the committed goldens while the default
+# reference path stays bit-identical; interpret-mode kernels on CPU (the
+# correctness leg), real Mosaic kernels on TPU (the roofline leg).
+KERNEL_SMOKE_KWARGS = dict(a_count=24, dist_count=150)
+KERNEL_DRIFT_BUDGET_BP = 0.1
+
+
+def _kernel_smoke() -> dict:
+    """The ``--kernel-smoke`` acceptance run (ISSUE 13, DESIGN §4c): run
+    the 12-cell golden sweep under ``kernel="fused"`` with certification
+    on (profiled, so the CostLedger keys the fused executables), assert
+    every cell CERTIFIED and r* within 0.1bp of the committed goldens,
+    pin the default ``kernel="reference"`` path bit-identical to those
+    goldens (and to the explicit-default spelling), run the bf16-rung
+    escalation drill (injected descent fault -> escalation journaled in
+    the PRECISION_ESCALATED slot, cell recovered), and grade the
+    ``kernel_*`` record against the committed history with the
+    regression sentinel.  On a TPU backend the profile snapshot is the
+    roofline witness: the fused executables' class must move off
+    "latency"; on CPU the class is recorded as measured (interpret-mode
+    kernels measure nothing about the MXU)."""
+    import numpy as np
+
+    import jax
+
+    # CPU float64 like the other golden smokes UNLESS a real accelerator
+    # is ambient — the TPU leg is exactly what the roofline acceptance
+    # needs, so don't force it away.
+    on_tpu = False
+    try:
+        on_tpu = jax.default_backend() in ("tpu", "axon")
+    except Exception:   # noqa: BLE001 — backend init failure = CPU leg
+        pass
+    if not on_tpu:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_enable_x64", True)
+
+    import jax.numpy as jnp
+
+    import aiyagari_hark_tpu.models.household as hh
+    from aiyagari_hark_tpu.models.equilibrium import solve_calibration_lean
+    from aiyagari_hark_tpu.obs import ObsConfig, build_obs
+    from aiyagari_hark_tpu.obs.regress import (
+        REGRESSED,
+        SEVERITY_NAMES,
+        evaluate_history,
+        load_bench_history,
+    )
+    from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+    from aiyagari_hark_tpu.utils.config import SweepConfig
+
+    backend = jax.default_backend()
+    n_devices = max(1, len(jax.devices()))
+    dtype = jnp.float64 if not on_tpu else None
+    kw = dict(KERNEL_SMOKE_KWARGS)
+    golden_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "tests", "data", "table2_golden_test.json")
+    with open(golden_path) as f:
+        golden = json.load(f)
+    assert golden["config"] == kw, "golden drifted from KERNEL_SMOKE_KWARGS"
+    golden_r = np.asarray(golden["r_star_pct"], dtype=np.float64)
+
+    # phase 1: warm-up — compiles the reference and fused sweep
+    # executables plus both certifiers (separate compile-cache entries:
+    # kernel="fused" rides kwargs_items into the work fingerprint)
+    t0 = time.perf_counter()
+    run_table2_sweep(SweepConfig(certify=True), dtype=dtype, **kw)
+    run_table2_sweep(SweepConfig(certify=True, kernel="fused"),
+                     dtype=dtype, **kw)
+    print(f"[bench] kernel smoke: warm-up in "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    # phase 2: timed reference run — also the golden bit-identity pin
+    t0 = time.perf_counter()
+    res_ref = run_table2_sweep(SweepConfig(certify=True), perturb=0.0,
+                               dtype=dtype, **kw)
+    wall_ref = time.perf_counter() - t0
+    golden_identical = bool(
+        np.array_equal(np.asarray(res_ref.r_star_pct), golden_r))
+
+    # explicit-default spelling: hashable_kwargs drops kernel="reference",
+    # so the two spellings share one executable — assert the VALUES agree
+    # bitwise too
+    lean_bare = solve_calibration_lean(3.0, 0.6, dtype=dtype, **kw)
+    lean_expl = solve_calibration_lean(3.0, 0.6, kernel="reference",
+                                       dtype=dtype, **kw)
+    explicit_identical = bool(
+        np.asarray(lean_bare.r_star).tobytes()
+        == np.asarray(lean_expl.r_star).tobytes())
+
+    # phase 3: timed fused run, PROFILED — certification is the numeric
+    # referee, the cost ledger the executable-identity/roofline witness
+    # (profiling is bit-identical and <2% overhead, pinned by ISSUE 10)
+    obs = build_obs(ObsConfig(enabled=True, profile=True))
+    t0 = time.perf_counter()
+    res_fus = run_table2_sweep(SweepConfig(certify=True, kernel="fused"),
+                               perturb=0.0, dtype=dtype, obs=obs, **kw)
+    wall_fus = time.perf_counter() - t0
+    snap = obs.cost_ledger.snapshot()
+    obs.close()
+    # Drift baseline: the committed goldens are f64 CPU physics; on an
+    # f32 accelerator the measured f32-vs-f64 noise (~0.097bp, BASELINE)
+    # would eat the whole budget, so the TPU leg honestly measures the
+    # fused engine against the SAME-backend reference sweep instead.
+    base_r = golden_r if not on_tpu else np.asarray(res_ref.r_star_pct)
+    drift_bp = float(
+        np.max(np.abs(np.asarray(res_fus.r_star_pct) - base_r)) * 100.0)
+    certs = [int(v) for v in res_fus.cert_level]
+    all_certified = bool((res_fus.cert_level == 0).all())
+
+    # phase 4: the bf16-rung escalation drill at the solver seam (the
+    # deterministic, accuracy-meaningful level: a whole-bisection stall
+    # drill mis-steers the descent-only bracket trips BY DESIGN and a
+    # NaN drill routes through quarantine — that leg is pinned in tier-1
+    # by test_kernel_policy's fused-sweep quarantine test).  The rung
+    # (forced on off-TPU so the drill exercises the NEW rung, not just
+    # the f32 descent) is poisoned; it must escalate into the
+    # PRECISION_ESCALATED slot and the polish must still certify the
+    # caller's tolerance, landing within it of the no-fault solve.
+    from aiyagari_hark_tpu.models.household import (
+        build_simple_model,
+        solve_household,
+    )
+
+    drill_model = build_simple_model(labor_ar=0.6, dtype=dtype,
+                                     a_count=kw["a_count"],
+                                     dist_count=kw["dist_count"])
+    saved_backends = hh.BF16_RUNG_BACKENDS
+    try:
+        hh.BF16_RUNG_BACKENDS = saved_backends + (backend,)
+        pol_ok, _, _, st_ok, ph_ok = solve_household(
+            1.02, 1.0, drill_model, 0.96, 3.0, precision="mixed",
+            kernel="fused", return_phases=True)
+        pol_dr, _, _, st_dr, ph_dr = solve_household(
+            1.02, 1.0, drill_model, 0.96, 3.0, precision="mixed",
+            kernel="fused", return_phases=True, descent_fault_iter=1)
+    finally:
+        hh.BF16_RUNG_BACKENDS = saved_backends
+    drill_esc = int(np.asarray(ph_dr.escalated))
+    drill_knot_diff = float(np.max(np.abs(
+        np.asarray(pol_dr.c_knots) - np.asarray(pol_ok.c_knots))))
+    # both solves certify sup-norm tol 1e-6; distinct certified fixed
+    # points can sit ~tol/(1-lambda) apart (lambda ~ disc_fac)
+    drill_ok = bool(int(st_dr) == 0 and drill_esc > 0
+                    and not bool(np.asarray(ph_ok.escalated))
+                    and drill_knot_diff < 1e-4)
+
+    # throughput accounting (launch-wall-inclusive, like the headline
+    # metric — see the module docstring's provenance note)
+    gp = kw["a_count"] * LABOR_STATES
+    gps_ref = float(res_ref.egm_iters.sum()) * gp / wall_ref / n_devices
+    gps_fus = float(res_fus.egm_iters.sum()) * gp / wall_fus / n_devices
+
+    record = {
+        "metric": "kernel_smoke",
+        "backend": backend,
+        "kernel_cells": len(golden_r),
+        "kernel_reference_wall_s": round(wall_ref, 3),
+        "kernel_fused_wall_s": round(wall_fus, 3),
+        "kernel_wall_reduction": round(wall_ref / max(wall_fus, 1e-9), 4),
+        "kernel_reference_egm_gridpoints_per_sec_per_chip": round(gps_ref),
+        "kernel_fused_egm_gridpoints_per_sec_per_chip": round(gps_fus),
+        # acceptance: verdicts + drift + bit-identity
+        "kernel_cert_levels": certs,
+        "kernel_cells_certified": int((res_fus.cert_level == 0).sum()),
+        "kernel_all_certified": all_certified,
+        "kernel_r_drift_max_bp": round(drift_bp, 4),
+        "kernel_drift_baseline": ("golden" if not on_tpu
+                                  else "reference_same_backend"),
+        "kernel_drift_under_budget": bool(
+            drift_bp < KERNEL_DRIFT_BUDGET_BP),
+        "kernel_escalations": int(res_fus.precision_escalations.sum()),
+        "kernel_reference_bit_identical": bool(
+            (golden_identical or on_tpu) and explicit_identical),
+        # escalation drill (the reused PRECISION_ESCALATED slot)
+        "kernel_drill_escalations": drill_esc,
+        "kernel_drill_max_knot_diff": round(drill_knot_diff, 10),
+        "kernel_drill_recovered": drill_ok,
+        # cost-ledger witness: fused executables keyed apart (their
+        # kwargs_items carry kernel="fused"), roofline class as measured
+        "kernel_fused_executables": snap["executables"],
+        "kernel_fused_launches": snap["launches"],
+        "kernel_fused_mfu_pct": snap["mfu_pct"],
+        "kernel_roofline": snap["roofline"],
+        "kernel_roofline_not_latency": bool(snap["roofline"] != "latency"),
+    }
+
+    # phase 5: the regression sentinel on committed history + this record
+    history = load_bench_history(_repo_dir()) + [("kernel_smoke", record)]
+    report = evaluate_history(history)
+    kernel_regressed = [f.metric for f in report.regressed()
+                        if f.metric.startswith("kernel_")]
+    record["kernel_sentinel_clean"] = not kernel_regressed
+    record["kernel_sentinel_worst"] = SEVERITY_NAMES[report.worst]
+
+    print(f"[bench] kernel smoke [{backend}]: reference {wall_ref:.1f}s "
+          f"({gps_ref:.3g} gp/s) vs fused {wall_fus:.1f}s "
+          f"({gps_fus:.3g} gp/s), drift {drift_bp:.4f}bp, certs {certs}, "
+          f"drill esc={drill_esc} ({'OK' if drill_ok else 'FAILED'}), "
+          f"roofline {snap['roofline']}, reference golden "
+          f"{'OK' if golden_identical else 'DIFF'}", file=sys.stderr)
+    if not all_certified or drift_bp >= KERNEL_DRIFT_BUDGET_BP:
+        print("[bench] kernel smoke: ACCEPTANCE FAILED — fused cells "
+              "must all certify within the drift budget", file=sys.stderr)
+    if on_tpu and snap["roofline"] == "latency":
+        print("[bench] kernel smoke: TPU ROOFLINE STILL LATENCY — the "
+              "fused executables did not move the class", file=sys.stderr)
+    return record
+
+
 # Load smoke (ISSUE 8): the overload acceptance on the Table II lattice
 # (both sd panels plus a third, so the cold-key space is wide enough to
 # saturate) at serving grid sizes.  Modeled capacity is max_batch /
@@ -2472,7 +2694,12 @@ def main(argv=None):
     ``grid="compact"``: all cells CERTIFIED, r* within 0.1bp of the
     committed goldens, measured gridpoint/step/wall reductions,
     reference path bit-identical) and emits the ``grid_*`` record
-    (ISSUE 12)."""
+    (ISSUE 12); ``--kernel-smoke`` runs the fused-kernel acceptance
+    (ISSUE 13: the 12-cell golden sweep under ``kernel="fused"`` —
+    interpret-mode kernels on CPU, real Mosaic on TPU — all cells
+    CERTIFIED within 0.1bp, reference path bit-identical, bf16-rung
+    escalation drill, CostLedger roofline witness, sentinel-graded
+    ``kernel_*`` fields) and emits the ``kernel_*`` record."""
     import argparse
 
     from aiyagari_hark_tpu.utils.resilience import (
@@ -2536,6 +2763,15 @@ def main(argv=None):
                          "wall reductions, default reference path "
                          "bit-identical) and emit the grid_* record "
                          "instead of the full bench")
+    ap.add_argument("--kernel-smoke", action="store_true",
+                    help="run the fused-kernel smoke (ISSUE 13: the "
+                         "12-cell golden sweep under kernel='fused' — "
+                         "interpret-mode on CPU, real Mosaic kernels on "
+                         "TPU — all cells CERTIFIED, r* within 0.1bp of "
+                         "the committed goldens, reference path "
+                         "bit-identical, bf16-rung escalation drill, "
+                         "roofline witness) and emit the kernel_* "
+                         "record instead of the full bench")
     ap.add_argument("--scenario-smoke", action="store_true",
                     help="run the scenario-registry smoke (ISSUE 9: "
                          "balanced+certified Huggett sweep with a "
@@ -2548,13 +2784,14 @@ def main(argv=None):
     if (args.serve_smoke or args.integrity_smoke or args.obs_smoke
             or args.load_smoke or args.scenario_smoke
             or args.profile_smoke or args.chips_scaling
-            or args.compaction_smoke):
+            or args.compaction_smoke or args.kernel_smoke):
         from aiyagari_hark_tpu.utils.backend import (
             enable_compilation_cache,
         )
 
         enable_compilation_cache()
-        smoke = (_compaction_smoke if args.compaction_smoke
+        smoke = (_kernel_smoke if args.kernel_smoke
+                 else _compaction_smoke if args.compaction_smoke
                  else _chips_scaling if args.chips_scaling
                  else _profile_smoke if args.profile_smoke
                  else _scenario_smoke if args.scenario_smoke

@@ -7,21 +7,32 @@ hard-codes a compute dtype.  Two violation classes, scoped to the modules
 whose inner loops the ladder runs (``HOT_MODULES``):
 
 1. **Bare matmul** — a ``jnp.matmul``/``jnp.dot``/``jnp.einsum``/
-   ``jnp.tensordot`` call without ``preferred_element_type=``, or the
-   infix ``@`` operator (which cannot carry one at all).  On TPU a matmul
-   without a pinned accumulation dtype silently accumulates at whatever
-   the precision mode implies — exactly the drift the descent phase's
+   ``jnp.tensordot`` call (or a ``lax.dot_general`` — the spelling the
+   ISSUE 13 tiled contraction and anything else hand-lowered uses)
+   without ``preferred_element_type=``, or the infix ``@`` operator
+   (which cannot carry one at all).  On TPU a matmul without a pinned
+   accumulation dtype silently accumulates at whatever the precision
+   mode implies — exactly the drift the descent phase's
    ``precision=DEFAULT`` + ``preferred_element_type`` pairing exists to
-   control (and the Pallas guide's standing MXU rule).
+   control (and the Pallas guide's standing MXU rule).  The rule covers
+   ``ops/pallas_kernels.py`` — matmuls INSIDE kernel bodies accumulate
+   on the MXU under exactly the same contract (bare accumulation in a
+   kernel is invisible to the XLA-level lint everywhere else).
 2. **Hard-coded ``jnp.float64``** — a compute dtype literal in a hot
    module pins work to the reference dtype regardless of the model dtype
    or the ladder policy.  Dtypes must flow from the model/config.
+3. **Hard-coded ``jnp.bfloat16``** (ISSUE 13) — the bf16 descent rung
+   is opt-in, TPU-gated, and escalation-protected at its definition
+   sites (``models.household``: the rung seams carry waivers); a bare
+   bf16 literal anywhere else in a hot module would smuggle the narrow
+   dtype past the ``KernelPolicy``/``PrecisionPolicy`` ladder contract
+   (no coarse-tolerance floor, no escalation, no TPU gate).
 
 A hit is a finding unless its line carries an explicit ``# dtype-ok``
 waiver (for dtype *dispatch* like ``dtype == jnp.float64``, which tests a
-dtype rather than imposing one).  Run standalone (exits 1 on findings) or
-via tier-1 (``tests/test_dtype_discipline.py``), next to
-``check_atomic_writes.py``.
+dtype rather than imposing one, and for the bf16 rung's definition
+sites).  Run standalone (exits 1 on findings) or via tier-1
+(``tests/test_dtype_discipline.py``), next to ``check_atomic_writes.py``.
 """
 
 from __future__ import annotations
@@ -43,12 +54,15 @@ HOT_MODULES = (
 
 WAIVER = "# dtype-ok"
 
-_MATMUL_CALL = re.compile(r"\bjnp\.(matmul|dot|einsum|tensordot)\s*\(")
+_MATMUL_CALL = re.compile(
+    r"\b(?:jnp\.(matmul|dot|einsum|tensordot)|(?:jax\.)?lax\.(dot_general))"
+    r"\s*\(")
 # infix matrix multiply: ' @ ' between expressions.  Decorators are
 # line-initial '@name' with no preceding expression, so requiring a
 # non-space character before ' @ ' on the same line excludes them.
 _INFIX_AT = re.compile(r"\S\s+@\s+\S")
 _F64_LITERAL = re.compile(r"\bjnp\.float64\b")
+_BF16_LITERAL = re.compile(r"\bjnp\.bfloat16\b")
 
 
 _TRIPLE_STRING = re.compile(r"('''|\"\"\")(.*?)(\1)", re.DOTALL)
@@ -88,11 +102,14 @@ def scan_source(src: str, rel: str) -> list:
             continue
         call = _call_span(src, m.end() - 1)
         if "preferred_element_type" not in call:
+            name = (f"jnp.{m.group(1)}" if m.group(1)
+                    else f"lax.{m.group(2)}")
             findings.append(
                 (rel, lineno,
-                 f"jnp.{m.group(1)} without preferred_element_type= — pin "
+                 f"{name} without preferred_element_type= — pin "
                  "the accumulation dtype (descent ladder contract, DESIGN "
-                 "§5), or waive with '# dtype-ok'"))
+                 "§5; inside kernel bodies too, DESIGN §4c), or waive "
+                 "with '# dtype-ok'"))
 
     for lineno, line in enumerate(lines, start=1):
         if WAIVER in line:
@@ -110,6 +127,14 @@ def scan_source(src: str, rel: str) -> list:
                  "hard-coded jnp.float64 in a hot-loop module — dtypes "
                  "flow from the model/config (precision policy, DESIGN "
                  "§5), or waive with '# dtype-ok'"))
+        if _BF16_LITERAL.search(code):
+            findings.append(
+                (rel, lineno,
+                 "hard-coded jnp.bfloat16 outside the bf16 descent "
+                 "rung's waived definition sites — the narrow dtype must "
+                 "ride the KernelPolicy ladder (coarse tolerance floor, "
+                 "escalation, TPU gate — DESIGN §4c), or waive with "
+                 "'# dtype-ok'"))
     return findings
 
 

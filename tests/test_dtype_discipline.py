@@ -80,5 +80,47 @@ def test_waiver_comment_suppresses_each_class():
     waived = (
         "x = jnp.matmul(a, b)  # dtype-ok: fixture\n"
         "y = a @ b  # dtype-ok: fixture\n"
-        "f64 = dtype == jnp.float64  # dtype-ok: dispatch\n")
+        "f64 = dtype == jnp.float64  # dtype-ok: dispatch\n"
+        "b16 = jnp.bfloat16  # dtype-ok: rung seam fixture\n"
+        "z = lax.dot_general(a, b, dims)  # dtype-ok: fixture\n")
     assert _messages(waived) == []
+
+
+# -- ISSUE 13 satellite: kernel-body accumulation + bf16 rung rules ---------
+
+def test_flags_bare_dot_general():
+    """``lax.dot_general`` is the hand-lowered matmul spelling (the
+    tiled contraction, kernel bodies) — bare accumulation there is the
+    same violation as a bare ``jnp.matmul``."""
+    for spelling in ("jax.lax.dot_general", "lax.dot_general"):
+        bad = (f"out = {spelling}(a, b,\n"
+               "    (((1,), (0,)), ((), ())))\n")
+        msgs = _messages(bad)
+        assert len(msgs) == 1 and "dot_general" in msgs[0], spelling
+
+
+def test_accepts_dot_general_with_preferred_element_type():
+    good = ("out = jax.lax.dot_general(a, b, dims,\n"
+            "    preferred_element_type=a.dtype)\n")
+    assert _messages(good) == []
+
+
+def test_flags_hardcoded_bfloat16_literal():
+    """A bare bf16 literal outside the waived rung seams would smuggle
+    the narrow dtype past the KernelPolicy ladder contract (no coarse
+    floor, no escalation, no TPU gate — DESIGN §4c)."""
+    msgs = _messages("x = arr.astype(jnp.bfloat16)\n")
+    assert len(msgs) == 1 and "bfloat16" in msgs[0]
+
+
+def test_bf16_rung_definition_sites_are_waived_not_unchecked():
+    """The real rung seams in ``models.household`` carry ``# dtype-ok``
+    waivers — the module must scan clean WITH the bf16 rule active, and
+    must actually contain waived bf16 literals (if the rung moves files,
+    this pins that the waiver moved with it)."""
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "aiyagari_hark_tpu", "models", "household.py")
+    with open(path) as fh:
+        src = fh.read()
+    assert "jnp.bfloat16" in src
+    assert scan_source(src, "household.py") == []

@@ -1,0 +1,362 @@
+"""Fused-kernel policy end to end (ISSUE 13, DESIGN §4c).
+
+The contracts under test:
+
+* ``kernel="reference"`` (the default) is BIT-identical to an
+  unspecified kernel — the explicit spelling shares the executable
+  cache entry, the fingerprints, and the bits (the committed
+  packing/resume/precision goldens pin the default path's values
+  untouched; this file pins the spelling equivalence).
+* the FUSED path (single-phase precision): one megakernel launch runs
+  both inner fixed points with the SAME iteration code — identical
+  step counts and statuses, values at float-fusion noise, r* within
+  the documented tolerance of the reference root.
+* the TILED push-forward contraction equals the reference matvec
+  layout numerically (it is the in-kernel step function).
+* the bf16 DESCENT RUNG (two-phase precision): converges under the
+  ladder contract with its steps counted as descent work, the FOC
+  inversion pinned f32, TPU-gated (tests force the gate open), and a
+  poisoned rung escalating into the PRECISION_ESCALATED slot with a
+  healthy final status.
+* at the sweep level quarantine rungs force ``kernel="reference"``
+  (the launch-per-loop fallback) and a faulted fused cell recovers
+  with every other cell bit-identical.
+* fused solves key their own fingerprints: a fused solve can never
+  collide with a reference solve in any sidecar/ledger/store.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import aiyagari_hark_tpu.models.household as hh
+from aiyagari_hark_tpu.models.equilibrium import (
+    household_capital_supply,
+    solve_calibration_lean,
+)
+from aiyagari_hark_tpu.models.household import (
+    build_simple_model,
+    dense_wealth_operator,
+    solve_household,
+    stationary_wealth,
+    wealth_transition,
+)
+from aiyagari_hark_tpu.ops.markov import (
+    tile_wealth_operator,
+    tiled_wealth_push_forward,
+)
+from aiyagari_hark_tpu.parallel.sweep import _retry_ladder, run_table2_sweep
+from aiyagari_hark_tpu.solver_health import CONVERGED
+from aiyagari_hark_tpu.utils.config import (
+    KERNEL_POLICIES,
+    SweepConfig,
+    resolve_kernel,
+)
+from aiyagari_hark_tpu.utils.fingerprint import (
+    hashable_kwargs,
+    work_fingerprint,
+)
+
+# Tiny tier-1 workload (full-size drift/certification is the bench's
+# kernel_* phase); 4 cells keep the sweep-level drills fast.
+KW = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-5,
+          max_bisect=24)
+SWEEP = SweepConfig(crra_values=(1.0, 3.0), rho_values=(0.0, 0.3))
+
+
+@pytest.fixture
+def model():
+    return build_simple_model(labor_states=3, a_count=12, dist_count=60)
+
+
+@pytest.fixture
+def bf16_on_cpu(monkeypatch):
+    """Force the TPU-only gate open so the rung itself runs in CI."""
+    monkeypatch.setattr(hh, "BF16_RUNG_BACKENDS",
+                        hh.BF16_RUNG_BACKENDS + ("cpu",))
+
+
+# -- policy resolution + fingerprints ---------------------------------------
+
+def test_resolve_kernel_validates():
+    assert resolve_kernel("reference").fused is False
+    spec = resolve_kernel("fused")
+    assert spec.fused and spec.bf16_descent
+    assert resolve_kernel(spec) is spec
+    with pytest.raises(ValueError, match="kernel policy"):
+        resolve_kernel("sorta-fused")
+    assert set(KERNEL_POLICIES) == {"reference", "fused"}
+
+
+def test_hashable_kwargs_drops_explicit_reference_kernel():
+    """The no-drift pin: the explicit default spelling must share every
+    fingerprint with the bare one, and an unknown policy must raise at
+    the canonicalization surface."""
+    assert hashable_kwargs({"a_count": 10}) \
+        == hashable_kwargs({"a_count": 10, "kernel": "reference"})
+    items_fused = hashable_kwargs({"a_count": 10, "kernel": "fused"})
+    assert ("kernel", "fused") in items_fused
+    with pytest.raises(ValueError, match="kernel policy"):
+        hashable_kwargs({"kernel": "mega"})
+
+
+def test_fused_solves_key_their_own_fingerprints():
+    """Cross-policy inequality: a fused solve is structurally
+    unaddressable from a reference sidecar/ledger/store group (and the
+    CostLedger therefore keys fused executables apart)."""
+    ref = work_fingerprint(hashable_kwargs({"a_count": 10}), np.float64)
+    fused = work_fingerprint(
+        hashable_kwargs({"a_count": 10, "kernel": "fused"}), np.float64)
+    assert ref != fused
+
+
+# -- default-path bit-identity ----------------------------------------------
+
+def test_reference_default_and_explicit_are_bit_identical():
+    bare = solve_calibration_lean(3.0, 0.3, **KW)
+    expl = solve_calibration_lean(3.0, 0.3, kernel="reference", **KW)
+    assert np.asarray(bare.r_star).tobytes() \
+        == np.asarray(expl.r_star).tobytes()
+    assert np.asarray(bare.capital).tobytes() \
+        == np.asarray(expl.capital).tobytes()
+    assert int(bare.egm_iters) == int(expl.egm_iters)
+
+
+# -- the tiled MXU contraction ----------------------------------------------
+
+def test_tiled_push_forward_matches_reference_matvec_layout(model):
+    """One tile-shaped contraction == the per-state matvecs + mix, to
+    float-fusion noise (the reduction order differs — which is exactly
+    why the tiled layout is opt-in, never the bit-pinned default)."""
+    pol, _, _, _ = solve_household(1.02, 1.0, model, 0.96, 2.0)
+    trans = wealth_transition(pol, 1.02, 1.0, model)
+    d = model.dist_grid.shape[0]
+    S = dense_wealth_operator(trans, d)
+    dist = hh.initial_distribution(model)
+    for _ in range(3):
+        dist = hh._push_forward_dense(dist, S, model.transition)
+    ref = hh._push_forward_dense(dist, S, model.transition)
+    tiled = tiled_wealth_push_forward(dist, tile_wealth_operator(S),
+                                      model.transition)
+    np.testing.assert_allclose(np.asarray(tiled), np.asarray(ref),
+                               rtol=1e-12, atol=1e-15)
+    assert abs(float(jnp.sum(tiled)) - 1.0) < 1e-12   # mass conserved
+
+
+# -- the fused supply path --------------------------------------------------
+
+def test_fused_supply_matches_reference_iteration_path(model):
+    """Same iteration code ⇒ same step counts and statuses; values at
+    float-fusion noise (documented tolerance: 1e-9 relative in f64 —
+    the tiled contraction and kernel boundary reorder reductions)."""
+    ref = household_capital_supply(0.02, model, 0.96, 2.0, 0.36, 0.08)
+    fus = household_capital_supply(0.02, model, 0.96, 2.0, 0.36, 0.08,
+                                   kernel="fused")
+    assert int(ref.egm_iters) == int(fus.egm_iters)
+    assert int(ref.dist_iters) == int(fus.dist_iters)
+    assert int(ref.status) == int(fus.status) == CONVERGED
+    np.testing.assert_allclose(float(fus.supply), float(ref.supply),
+                               rtol=1e-9)
+    # both engines certify the same update-norm tol; the fixed points
+    # themselves can differ by ~tol/(1-lambda) in the slow mode
+    np.testing.assert_allclose(np.asarray(fus.distribution),
+                               np.asarray(ref.distribution),
+                               rtol=1e-6, atol=1e-8)
+    # reference-style phase accounting: all steps are polish steps
+    assert int(fus.descent_steps) == 0
+    assert int(fus.polish_steps) == int(fus.egm_iters) + int(fus.dist_iters)
+
+
+def test_fused_lean_equilibrium_r_star_within_budget():
+    ref = solve_calibration_lean(3.0, 0.3, **KW)
+    fus = solve_calibration_lean(3.0, 0.3, kernel="fused", **KW)
+    drift_bp = abs(float(ref.r_star) - float(fus.r_star)) * 1e4
+    assert int(fus.status) == CONVERGED
+    # the documented budget is 0.1bp at golden tolerances; this smoke
+    # config runs r_tol=1e-5, so the honest bound is the bracket width
+    assert drift_bp < 2 * KW["r_tol"] * 1e4
+
+
+def test_fused_vmapped_dispatch_routes_to_lane_grid():
+    """The sweep path: a vmapped fused solve must reroute through the
+    custom_vmap rule to the lane-grid kernel and agree with the serial
+    fused solves lane by lane."""
+    crras = jnp.asarray([1.0, 3.0], dtype=jnp.float64)
+    batched = jax.jit(jax.vmap(
+        lambda c: solve_calibration_lean(c, 0.3, kernel="fused",
+                                         **KW).r_star))(crras)
+    for i, c in enumerate((1.0, 3.0)):
+        serial = solve_calibration_lean(c, 0.3, kernel="fused", **KW)
+        np.testing.assert_allclose(float(batched[i]),
+                                   float(serial.r_star), rtol=1e-12)
+
+
+def test_fused_stationary_wealth_dispatches_interpret_kernel(model):
+    """``stationary_wealth(kernel='fused')`` prefers the VMEM kernel
+    engine off-TPU via interpret mode — same fixed point, same stats."""
+    pol, _, _, _ = solve_household(1.02, 1.0, model, 0.96, 2.0)
+    ref = stationary_wealth(pol, 1.02, 1.0, model, method="scatter")
+    fus = stationary_wealth(pol, 1.02, 1.0, model, kernel="fused")
+    np.testing.assert_allclose(np.asarray(fus[0]), np.asarray(ref[0]),
+                               rtol=1e-6, atol=1e-8)
+    assert int(fus[3]) == int(ref[3])
+
+
+# -- the bf16 descent rung --------------------------------------------------
+
+def test_bf16_rung_converges_and_counts_descent_steps(model, bf16_on_cpu):
+    pol_ref, it_ref, _, st_ref, ph_ref = solve_household(
+        1.02, 1.0, model, 0.96, 2.0, precision="mixed",
+        return_phases=True)
+    pol, it, _, st, ph = solve_household(
+        1.02, 1.0, model, 0.96, 2.0, precision="mixed", kernel="fused",
+        return_phases=True)
+    assert int(st) == CONVERGED
+    assert not bool(ph.escalated)
+    # the rung's steps are descent work: strictly more descent steps
+    # than the f32-only ladder, with the polish certifying the same tol
+    assert int(ph.descent_steps) > int(ph_ref.descent_steps)
+    np.testing.assert_allclose(np.asarray(pol.c_knots),
+                               np.asarray(pol_ref.c_knots),
+                               rtol=0, atol=1e-4)
+
+
+def test_bf16_rung_is_tpu_gated_off_by_default(model):
+    """Without the forced gate the CPU ladder must be byte-identical to
+    the kernel-less mixed solve — the rung is TPU-only."""
+    pol_ref, it_ref, _, _, ph_ref = solve_household(
+        1.02, 1.0, model, 0.96, 2.0, precision="mixed",
+        return_phases=True)
+    pol, it, _, _, ph = solve_household(
+        1.02, 1.0, model, 0.96, 2.0, precision="mixed", kernel="fused",
+        return_phases=True)
+    assert int(it) == int(it_ref)
+    assert int(ph.descent_steps) == int(ph_ref.descent_steps)
+    assert np.asarray(pol.c_knots).tobytes() \
+        == np.asarray(pol_ref.c_knots).tobytes()
+
+
+def test_bf16_rung_escalates_on_injected_descent_fault(model, bf16_on_cpu):
+    """A poisoned rung escalates (the reused PRECISION_ESCALATED slot)
+    and the polish still certifies the caller's tolerance."""
+    pol_ref, _, _, _, _ = solve_household(
+        1.02, 1.0, model, 0.96, 2.0, precision="mixed",
+        return_phases=True)
+    pol, _, _, st, ph = solve_household(
+        1.02, 1.0, model, 0.96, 2.0, precision="mixed", kernel="fused",
+        return_phases=True, descent_fault_iter=1)
+    assert int(st) == CONVERGED
+    assert bool(ph.escalated)
+    np.testing.assert_allclose(np.asarray(pol.c_knots),
+                               np.asarray(pol_ref.c_knots),
+                               rtol=0, atol=1e-4)
+
+
+def test_bf16_rung_distribution_twin(model, bf16_on_cpu):
+    pol, _, _, _ = solve_household(1.02, 1.0, model, 0.96, 2.0)
+    ref = stationary_wealth(pol, 1.02, 1.0, model, precision="mixed",
+                            return_phases=True)
+    fus = stationary_wealth(pol, 1.02, 1.0, model, precision="mixed",
+                            kernel="fused", return_phases=True)
+    assert int(fus[3]) == CONVERGED
+    assert not bool(fus[4].escalated)
+    np.testing.assert_allclose(np.asarray(fus[0]), np.asarray(ref[0]),
+                               rtol=0, atol=1e-8)
+
+
+def test_bf16_rung_foc_inversion_stays_f32(model, bf16_on_cpu,
+                                           monkeypatch):
+    """The x^(-1/gamma) inversion must not run on bf16 operands: pin it
+    by intercepting inverse_marginal_utility during a rung'd solve."""
+    seen = []
+    orig = hh.inverse_marginal_utility
+
+    def spy(vp, crra):
+        seen.append(jnp.asarray(vp).dtype)
+        return orig(vp, crra)
+
+    monkeypatch.setattr(hh, "inverse_marginal_utility", spy)
+    solve_household(1.02, 1.0, model, 0.96, 2.0, precision="mixed",
+                    kernel="fused")
+    assert seen, "spy never fired"
+    assert jnp.dtype(jnp.bfloat16) not in {jnp.dtype(d) for d in seen}
+
+
+# -- sweep-level integration ------------------------------------------------
+
+def test_retry_ladder_forces_reference_kernel():
+    rungs = _retry_ladder({"kernel": "fused"})
+    assert rungs and all(r.get("kernel") == "reference" for r in rungs)
+    # and the huggett/EZ family ladders follow the same rule
+    from aiyagari_hark_tpu.scenarios.epstein_zin import (
+        _retry_rungs as ez_rungs,
+    )
+    from aiyagari_hark_tpu.scenarios.huggett import (
+        _retry_rungs as hug_rungs,
+    )
+    assert all(r.get("kernel") == "reference"
+               for r in hug_rungs({"kernel": "fused"}))
+    assert all(r.get("kernel") == "reference"
+               for r in ez_rungs({"kernel": "fused"}))
+
+
+@pytest.fixture(scope="module")
+def fused_sweeps():
+    ref = run_table2_sweep(SWEEP, **KW)
+    fused = run_table2_sweep(SWEEP.replace(kernel="fused"), **KW)
+    return ref, fused
+
+
+def test_fused_sweep_matches_reference_sweep(fused_sweeps):
+    ref, fus = fused_sweeps
+    assert (fus.status == CONVERGED).all()
+    drift_bp = np.max(np.abs(np.asarray(fus.r_star_pct)
+                             - np.asarray(ref.r_star_pct))) * 100.0
+    assert drift_bp < 2 * KW["r_tol"] * 1e4
+
+
+def test_fused_sweep_quarantine_recovers_on_reference_engines(fused_sweeps):
+    """An injected persistent fault routes a fused cell through the
+    quarantine ladder, whose rungs re-solve at kernel='reference'; the
+    other cells stay bit-identical to the clean fused sweep."""
+    _, clean = fused_sweeps
+    res = run_table2_sweep(SWEEP.replace(kernel="fused"),
+                           inject_fault={"cell": 2, "at_iter": 0,
+                                         "mode": "nan"}, **KW)
+    assert int(res.retries[2]) >= 1
+    assert int(res.status[2]) == CONVERGED
+    mask = np.ones(len(res.r_star_pct), dtype=bool)
+    mask[2] = False
+    assert np.asarray(res.r_star_pct)[mask].tobytes() \
+        == np.asarray(clean.r_star_pct)[mask].tobytes()
+    assert float(res.r_star_pct[2]) == pytest.approx(
+        float(clean.r_star_pct[2]), abs=2 * KW["r_tol"] * 100)
+
+
+def test_sweep_level_bf16_escalation_drill(bf16_on_cpu):
+    """The ISSUE 13 escalation drill at sweep level: every cell's rung
+    poisoned under kernel='fused' + precision='mixed' — escalations are
+    counted in the PRECISION_ESCALATED slot and every cell still
+    converges (quarantine sees nothing).  Mode "stall", the established
+    sweep-level descent drill: a NaN would poison the descent-only
+    bracket trips' excess too and route through quarantine instead."""
+    res = run_table2_sweep(SWEEP.replace(kernel="fused"),
+                           precision="mixed", descent_fault_iter=1,
+                           descent_fault_mode="stall", **KW)
+    assert (res.status == CONVERGED).all()
+    assert (res.retries == 0).all()
+    assert int(res.precision_escalations.sum()) > 0
+
+
+def test_huggett_and_ez_cells_accept_the_kernel_policy():
+    from aiyagari_hark_tpu.scenarios.epstein_zin import solve_ez_cell
+    from aiyagari_hark_tpu.scenarios.huggett import solve_huggett_cell
+
+    tiny = dict(labor_states=3, a_count=10, dist_count=32)
+    hug = solve_huggett_cell(2.0, 0.3, kernel="fused", r_tol=1e-4,
+                             **tiny)
+    assert int(hug.status) == CONVERGED
+    ez = solve_ez_cell(4.0, 0.3, kernel="fused", r_tol=1e-4,
+                       max_bisect=30, **tiny)
+    assert np.isfinite(float(ez.r_star))

@@ -1,0 +1,278 @@
+"""Interpret-mode parity for EVERY Pallas kernel (ISSUE 13 satellite).
+
+Historically the compiled kernels were exercised only when a TPU
+answered the probe — kernel logic had zero CI coverage.  These tests
+run each kernel under ``interpret=True`` on CPU against its XLA twin on
+a small lane, so a logic regression in a kernel body fails tier-1
+without hardware.  The kernels share the exact iteration code with the
+XLA paths (``accelerated_*_fixed_point``), so parity is tight: step
+counts match EXACTLY; values agree to float-fusion noise (the fused
+kernel's tiled push-forward contraction reorders reductions — the
+documented tolerance is 1e-9 relative / 1e-8 absolute in f64, the
+~tol/(1-lambda) slow-mode bound both engines' certified update norms
+imply).
+
+The fused megakernel additionally gets the 12-golden-cell parity pin
+(the ISSUE 13 acceptance): every (sigma, rho) Table II cell's supply
+evaluation, fused-vs-reference, within the documented tolerance —
+vmapped, so it rides the custom_vmap lane-grid dispatch exactly like
+the sweep does.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import aiyagari_hark_tpu.models.household as hh
+from aiyagari_hark_tpu.models.equilibrium import household_capital_supply
+from aiyagari_hark_tpu.models.household import (
+    HouseholdPolicy,
+    accelerated_distribution_fixed_point,
+    accelerated_policy_fixed_point,
+    build_simple_model,
+    dense_wealth_operator,
+    egm_step,
+    initial_distribution,
+    initial_policy,
+    solve_household,
+    wealth_transition,
+)
+from aiyagari_hark_tpu.ops.pallas_kernels import (
+    _PROBES,
+    egm_policy_pallas,
+    egm_policy_pallas_grid,
+    fused_cell_pallas,
+    fused_cell_pallas_grid,
+    probe_kernel,
+    stationary_dense_pallas,
+    stationary_dense_pallas_grid,
+)
+
+TOL_KW = dict(rtol=1e-9, atol=1e-8)   # the documented parity tolerance
+
+
+@pytest.fixture(scope="module")
+def model():
+    return build_simple_model(labor_states=3, a_count=12, dist_count=48)
+
+
+@pytest.fixture(scope="module")
+def solved(model):
+    pol, _, _, _ = solve_household(1.02, 1.0, model, 0.96, 2.0)
+    return pol
+
+
+def _scalars(model, R=1.02, W=1.0, disc=0.96, crra=2.0):
+    dt = model.a_grid.dtype
+    return jnp.asarray([R, W, disc, crra,
+                        float(model.borrow_limit)], dtype=dt)
+
+
+# -- probe registry (the dedupe satellite) ----------------------------------
+
+def test_probe_registry_covers_every_kernel_and_validates():
+    assert {"dense", "dense_grid", "egm", "egm_grid",
+            "fused", "fused_grid"} == set(_PROBES)
+    with pytest.raises(ValueError, match="unknown kernel probe"):
+        probe_kernel("warp")
+    # off-TPU every probe is False (and memoized, not an error)
+    for name in _PROBES:
+        assert probe_kernel(name) is False
+
+
+def test_legacy_probe_spellings_alias_the_registry():
+    from aiyagari_hark_tpu.ops.pallas_kernels import (
+        pallas_egm_grid_tpu_available,
+        pallas_egm_tpu_available,
+        pallas_grid_tpu_available,
+        pallas_tpu_available,
+    )
+
+    assert pallas_tpu_available() is probe_kernel("dense")
+    assert pallas_grid_tpu_available() is probe_kernel("dense_grid")
+    assert pallas_egm_tpu_available() is probe_kernel("egm")
+    assert pallas_egm_grid_tpu_available() is probe_kernel("egm_grid")
+
+
+# -- per-kernel interpret parity --------------------------------------------
+
+def test_dense_kernel_interpret_parity(model, solved):
+    trans = wealth_transition(solved, 1.02, 1.0, model)
+    S = dense_wealth_operator(trans, model.dist_grid.shape[0])
+    d0 = initial_distribution(model)
+    ref_d, ref_it, ref_diff, _ = accelerated_distribution_fixed_point(
+        lambda d: hh._push_forward_dense(d, S, model.transition),
+        d0, 1e-10, 5000, 64)
+    ker_d, ker_it, ker_diff = stationary_dense_pallas(
+        S, model.transition, d0, 1e-10, 5000, 64, interpret=True)
+    assert int(ker_it) == int(ref_it)
+    np.testing.assert_allclose(np.asarray(ker_d), np.asarray(ref_d),
+                               **TOL_KW)
+
+
+def test_dense_grid_kernel_interpret_parity(model, solved):
+    trans = wealth_transition(solved, 1.02, 1.0, model)
+    S1 = dense_wealth_operator(trans, model.dist_grid.shape[0])
+    d0 = initial_distribution(model)
+    S = jnp.stack([S1, 0.5 * (S1 + jnp.transpose(S1, (0, 2, 1)))])
+    P = jnp.stack([model.transition, model.transition])
+    d0s = jnp.stack([d0, d0])
+    dg, itg, diffg = stationary_dense_pallas_grid(
+        S, P, d0s, 1e-10, 5000, 64, interpret=True)
+    for i in range(2):
+        d1, it1, _ = stationary_dense_pallas(
+            S[i], P[i], d0s[i], 1e-10, 5000, 64, interpret=True)
+        assert int(itg[i]) == int(it1)
+        np.testing.assert_allclose(np.asarray(dg[i]), np.asarray(d1),
+                                   rtol=1e-12, atol=1e-15)
+
+
+def test_egm_kernel_interpret_parity(model):
+    p0 = initial_policy(model)
+    ref_p, ref_it, _, _ = accelerated_policy_fixed_point(
+        lambda p: egm_step(p, 1.02, 1.0, model, 0.96, 2.0),
+        p0, 1e-6, 3000, 32)
+    m, c, it, diff = egm_policy_pallas(
+        p0.m_knots, p0.c_knots, model.a_grid, model.labor_levels,
+        model.transition, _scalars(model), 1e-6, 3000, 32,
+        interpret=True)
+    assert int(it) == int(ref_it)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(ref_p.c_knots),
+                               **TOL_KW)
+    np.testing.assert_allclose(np.asarray(m), np.asarray(ref_p.m_knots),
+                               **TOL_KW)
+
+
+def test_egm_grid_kernel_interpret_parity(model):
+    p0 = initial_policy(model)
+    n = model.labor_levels.shape[0]
+    m0 = jnp.stack([p0.m_knots, p0.m_knots])
+    c0 = jnp.stack([p0.c_knots, p0.c_knots])
+    a = jnp.stack([model.a_grid, model.a_grid])
+    lvl = jnp.stack([model.labor_levels, model.labor_levels])
+    P = jnp.stack([model.transition, model.transition])
+    scal = jnp.stack([_scalars(model), _scalars(model, crra=3.0)])
+    mg, cg, itg, _ = egm_policy_pallas_grid(
+        m0, c0, a, lvl, P, scal, 1e-6, 3000, 32, interpret=True)
+    for i, crra in enumerate((2.0, 3.0)):
+        m1, c1, it1, _ = egm_policy_pallas(
+            p0.m_knots, p0.c_knots, model.a_grid, model.labor_levels,
+            model.transition, _scalars(model, crra=crra), 1e-6, 3000, 32,
+            interpret=True)
+        assert int(itg[i]) == int(it1)
+        np.testing.assert_allclose(np.asarray(cg[i]), np.asarray(c1),
+                                   rtol=1e-12, atol=1e-15)
+
+
+def test_fused_kernel_interpret_parity(model):
+    """The megakernel vs the two XLA loops it fuses: identical step
+    counts (same iteration code), values within the documented
+    tolerance (the tiled contraction reorders the push-forward's
+    reductions)."""
+    p0 = initial_policy(model)
+    d0 = initial_distribution(model)
+    h = jnp.zeros_like(model.labor_levels)
+    m, c, dist, egm_it, _, dist_it, _ = fused_cell_pallas(
+        p0.m_knots, p0.c_knots, model.a_grid, model.dist_grid,
+        model.labor_levels, model.transition, _scalars(model), h, d0,
+        1e-6, 3000, 32, 1e-10, 5000, 64, interpret=True)
+    ref_p, ref_eit, _, _ = accelerated_policy_fixed_point(
+        lambda p: egm_step(p, 1.02, 1.0, model, 0.96, 2.0),
+        p0, 1e-6, 3000, 32)
+    trans = wealth_transition(ref_p, 1.02, 1.0, model)
+    S = dense_wealth_operator(trans, model.dist_grid.shape[0])
+    ref_d, ref_dit, _, _ = accelerated_distribution_fixed_point(
+        lambda d: hh._push_forward_dense(d, S, model.transition),
+        d0, 1e-10, 5000, 64)
+    assert int(egm_it) == int(ref_eit)
+    assert int(dist_it) == int(ref_dit)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(ref_p.c_knots),
+                               **TOL_KW)
+    np.testing.assert_allclose(np.asarray(dist), np.asarray(ref_d),
+                               **TOL_KW)
+
+
+def test_fused_kernel_analytic_tail_parity(model):
+    """``tail=True``: the in-kernel tail closure (precomputed human
+    wealth, in-kernel MPC slope) == the XLA tail-closed iteration."""
+    R, W, disc, crra = 1.02, 1.0, 0.96, 2.0
+    p0 = initial_policy(model, analytic_tail=True)
+    d0 = initial_distribution(model)
+    h = hh.perfect_foresight_human_wealth(
+        jnp.asarray(R, model.a_grid.dtype),
+        jnp.asarray(W, model.a_grid.dtype),
+        model.labor_levels, model.transition)
+    m, c, dist, egm_it, _, _, _ = fused_cell_pallas(
+        p0.m_knots, p0.c_knots, model.a_grid, model.dist_grid,
+        model.labor_levels, model.transition, _scalars(model), h, d0,
+        1e-6, 3000, 32, 1e-10, 5000, 64, tail=True, interpret=True)
+    ref_p, ref_eit, _, _ = accelerated_policy_fixed_point(
+        lambda p: egm_step(p, R, W, model, disc, crra,
+                           analytic_tail=True),
+        p0, 1e-6, 3000, 32)
+    assert int(egm_it) == int(ref_eit)
+    assert m.shape == ref_p.m_knots.shape      # [N, A+3] tail-closed
+    np.testing.assert_allclose(np.asarray(c), np.asarray(ref_p.c_knots),
+                               **TOL_KW)
+
+
+def test_fused_grid_kernel_interpret_parity(model):
+    p0 = initial_policy(model)
+    d0 = initial_distribution(model)
+    h = jnp.zeros_like(model.labor_levels)
+    stack2 = lambda x: jnp.stack([x, x])   # noqa: E731
+    scal = jnp.stack([_scalars(model), _scalars(model, R=1.03)])
+    mg, cg, dg, eitg, _, ditg, _ = fused_cell_pallas_grid(
+        stack2(p0.m_knots), stack2(p0.c_knots), stack2(model.a_grid),
+        stack2(model.dist_grid), stack2(model.labor_levels),
+        stack2(model.transition), scal, stack2(h), stack2(d0),
+        1e-6, 3000, 32, 1e-10, 5000, 64, interpret=True)
+    for i, R in enumerate((1.02, 1.03)):
+        m1, c1, d1, eit1, _, dit1, _ = fused_cell_pallas(
+            p0.m_knots, p0.c_knots, model.a_grid, model.dist_grid,
+            model.labor_levels, model.transition,
+            _scalars(model, R=R), h, d0,
+            1e-6, 3000, 32, 1e-10, 5000, 64, interpret=True)
+        assert int(eitg[i]) == int(eit1)
+        assert int(ditg[i]) == int(dit1)
+        np.testing.assert_allclose(np.asarray(cg[i]), np.asarray(c1),
+                                   rtol=1e-12, atol=1e-15)
+        np.testing.assert_allclose(np.asarray(dg[i]), np.asarray(d1),
+                                   rtol=1e-12, atol=1e-15)
+
+
+# -- the 12-golden-cell fused acceptance pin --------------------------------
+
+GOLDEN_CELLS = [(s, r) for s in (1.0, 3.0, 5.0)
+                for r in (0.0, 0.3, 0.6, 0.9)]
+
+
+def test_fused_supply_parity_on_all_golden_cells():
+    """ISSUE 13 acceptance: fused == XLA reference within the documented
+    tolerance on every (sigma, rho) Table II cell — vmapped, so the 12
+    lanes ride the custom_vmap lane-grid dispatch exactly like a sweep
+    bucket does.  (Smoke grid sizes: the full-size leg is the bench's
+    ``--kernel-smoke``.)"""
+    kw = dict(labor_states=3, a_count=12, dist_count=48)
+    sig = jnp.asarray([c[0] for c in GOLDEN_CELLS], dtype=jnp.float64)
+    rho = jnp.asarray([c[1] for c in GOLDEN_CELLS], dtype=jnp.float64)
+
+    def supply(crra, labor_ar, kernel):
+        m = build_simple_model(labor_ar=labor_ar, **kw)
+        ev = household_capital_supply(0.02, m, 0.96, crra, 0.36, 0.08,
+                                      kernel=kernel)
+        return ev.supply, ev.egm_iters, ev.dist_iters, ev.status
+
+    s_ref, e_ref, d_ref, st_ref = jax.jit(jax.vmap(
+        lambda s, r: supply(s, r, "reference")))(sig, rho)
+    s_fus, e_fus, d_fus, st_fus = jax.jit(jax.vmap(
+        lambda s, r: supply(s, r, "fused")))(sig, rho)
+    np.testing.assert_array_equal(np.asarray(st_fus), np.asarray(st_ref))
+    np.testing.assert_allclose(np.asarray(s_fus), np.asarray(s_ref),
+                               rtol=1e-9)
+    # same iteration code — the vmapped reference runs lock-step while
+    # the fused lane grid exits per lane, but each LANE's own certified
+    # step counts are engine-independent
+    np.testing.assert_array_equal(np.asarray(e_fus), np.asarray(e_ref))
+    np.testing.assert_array_equal(np.asarray(d_fus), np.asarray(d_ref))

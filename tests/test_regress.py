@@ -268,6 +268,55 @@ def test_direction_covers_compaction_smoke_record():
     assert "grid_cells_certified" in flagged
 
 
+def test_direction_covers_kernel_smoke_record():
+    """The ``--kernel-smoke`` leg's scalar fields (ISSUE 13) resolve
+    strictly — the sentinel grades the kernel_* record from its FIRST
+    committed round — and a synthetic kernel history grades clean, with
+    a fused-wall blow-up / certified-count drop / throughput collapse
+    flagging in the declared directions."""
+    kernel_record = {
+        "metric": "kernel_smoke", "backend": "cpu",
+        "kernel_cells": 12,
+        "kernel_reference_wall_s": 95.0, "kernel_fused_wall_s": 90.0,
+        "kernel_wall_reduction": 1.06,
+        "kernel_reference_egm_gridpoints_per_sec_per_chip": 170000.0,
+        "kernel_fused_egm_gridpoints_per_sec_per_chip": 180000.0,
+        "kernel_cert_levels": [0] * 12,
+        "kernel_cells_certified": 12, "kernel_all_certified": True,
+        "kernel_r_drift_max_bp": 0.01, "kernel_drift_under_budget": True,
+        "kernel_escalations": 0,
+        "kernel_reference_bit_identical": True,
+        "kernel_drill_escalations": 1,
+        "kernel_drill_max_knot_diff": 2e-6,
+        "kernel_drill_recovered": True,
+        "kernel_fused_executables": 3, "kernel_fused_launches": 14,
+        "kernel_fused_mfu_pct": 0.4,
+        "kernel_roofline": "memory", "kernel_roofline_not_latency": True,
+        "kernel_sentinel_clean": True, "kernel_sentinel_worst": "OK",
+    }
+    for field in flatten_record(kernel_record):
+        direction = direction_of_goodness(field, strict=True)
+        assert direction in (UP, DOWN, NEUTRAL), field
+    assert direction_of_goodness(
+        "kernel_fused_egm_gridpoints_per_sec_per_chip") == UP
+    assert direction_of_goodness("kernel_fused_wall_s") == DOWN
+    assert direction_of_goodness("kernel_wall_reduction") == UP
+    assert direction_of_goodness("kernel_cells_certified") == UP
+    assert direction_of_goodness("kernel_r_drift_max_bp") == DOWN
+    assert direction_of_goodness("kernel_escalations") == DOWN
+    # stable synthetic history grades clean; a fused-wall blow-up and a
+    # certified-count drop both flag in the declared directions
+    hist = [(f"r{i:02d}", dict(kernel_record)) for i in range(4)]
+    assert evaluate_history(hist).worst == OK
+    worse = dict(kernel_record)
+    worse["kernel_fused_wall_s"] = 140.0
+    worse["kernel_cells_certified"] = 9
+    hist_bad = hist[:-1] + [("r99", worse)]
+    flagged = [f.metric for f in evaluate_history(hist_bad).regressed()]
+    assert "kernel_fused_wall_s" in flagged
+    assert "kernel_cells_certified" in flagged
+
+
 def test_direction_unknown_field_raises_strict_only():
     with pytest.raises(UnknownMetricError):
         direction_of_goodness("utterly_unclassifiable_thing",
