@@ -58,6 +58,14 @@ EVENT_TYPES = (
     "CIRCUIT_OPEN", "CIRCUIT_PROBE", "CIRCUIT_CLOSE", "CIRCUIT_REJECT",
     # typed solver divergence escaping to a caller (models, facade)
     "SOLVER_DIVERGED",
+    # fleet tier (ISSUE 15, serve.store / serve.service): the claim/
+    # lease election (one FLEET_CLAIM per lease won), the exactly-once
+    # publish completing a claim (FLEET_PUBLISH carries the solving
+    # query's speculative flag — prefetch attribution), a stale lease
+    # broken past its TTL (crashed-winner reclaim), and each
+    # speculative neighbor query issued by the prefetcher
+    "FLEET_CLAIM", "FLEET_PUBLISH", "FLEET_LEASE_RECLAIM",
+    "PREFETCH_ISSUED",
     # performance-observability tier (ISSUE 10, obs.profile/obs.regress):
     # the run's cost-ledger summary at close, a bench-regression sentinel
     # finding graded REGRESSED, the flight-recorder crash artifact

@@ -110,6 +110,42 @@ DIRECTION_EXPLICIT: Dict[str, str] = {
     #                                         acceptance, not a trend
     "kernel_fused_executables": NEUTRAL,
     "kernel_fused_launches": NEUTRAL,
+    # fleet serving leg (ISSUE 15, bench --fleet-smoke) + the serve
+    # snapshot's prefetch/fleet counters (they ride every serve_* record
+    # via ``ServeMetrics.snapshot``).  The load-bearing declarations:
+    # the DEDUP RATIO is cold solves / distinct cold fingerprints — 1.0
+    # is exactly-once, every increase is duplicated solve work, so DOWN
+    # (the explicit entry overrides the neutral ``_ratio`` suffix rule);
+    # leaked leases and unresolved arrivals are failures of the
+    # protocol, DOWN from the first committed record; prefetch
+    # CONVERSIONS are the prefetcher earning its solves, UP.  Fleet
+    # p50/p99 fields resolve through the ``_ms`` suffix rule and the
+    # wall through ``_s``.
+    "fleet_dedup_ratio": DOWN,
+    "fleet_leases_leaked": DOWN,
+    "fleet_unresolved": DOWN,
+    "fleet_prefetch_issued": NEUTRAL,
+    "fleet_prefetch_converted": UP,
+    "fleet_remote_hits": UP,
+    "fleet_claims_won": NEUTRAL,
+    "fleet_claims_lost": NEUTRAL,
+    "fleet_publishes": NEUTRAL,
+    "fleet_lease_reclaims": DOWN,
+    "fleet_workers": NEUTRAL,
+    "fleet_requests": NEUTRAL,
+    "fleet_served": UP,
+    "fleet_served_hit": NEUTRAL,     # traffic-mix facts, not goodness
+    "fleet_served_near": NEUTRAL,
+    "fleet_served_cold": NEUTRAL,
+    "fleet_cold_solves": NEUTRAL,
+    "fleet_distinct_fingerprints": NEUTRAL,
+    "fleet_drill_rc": NEUTRAL,
+    "fleet_value_mismatches": DOWN,
+    "fleet_value_divergence": DOWN,
+    "fleet_seeded_compares": NEUTRAL,
+    "serve_prefetch_issued": NEUTRAL,
+    "serve_prefetch_converted": UP,
+    "serve_prefetch_suppressed": NEUTRAL,
 }
 
 # Suffix/affix rules, first match wins.  Kept coarse on purpose: bench

@@ -15,12 +15,24 @@ from .batcher import (  # noqa: F401
     default_ladder,
     shard_ladder,
 )
+from .fleet import (  # noqa: F401
+    FleetClient,
+    FleetFront,
+    FleetHTTPError,
+    error_to_json,
+    result_to_json,
+    worker_main,
+)
 from .loadgen import (  # noqa: F401
     Arrival,
+    FleetReport,
+    FleetSpec,
     LoadReport,
     LoadSpec,
     ManualClock,
     generate_arrivals,
+    generate_fleet_arrivals,
+    run_fleet_load,
     run_load,
 )
 from .metrics import ServeMetrics  # noqa: F401

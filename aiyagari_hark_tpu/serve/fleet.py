@@ -1,0 +1,445 @@
+"""Fleet serving: a thin HTTP front over ``EquilibriumService`` plus the
+out-of-process worker entry point (ISSUE 15, DESIGN §14).
+
+One process was the throughput ceiling: the PR 4/8 engine answers exact
+hits in ~0.07 ms and survives overload, but every query funnels through
+one Python process.  The fleet tier scales OUT instead of up:
+
+* **N worker processes**, each running the UNCHANGED in-process
+  ``EquilibriumService`` (priorities, deadlines, admission, breakers all
+  ride through verbatim) behind a stdlib ``ThreadingHTTPServer`` — no
+  new dependencies, JSON over HTTP;
+* **one shared disk store** (``SolutionStore(shared=True)``): the
+  content-addressed fingerprints plus the PR 6 checksum and PR 9
+  ``schema_ck`` contracts make cross-process sharing verifiably safe,
+  and the claim/lease election makes cold solves exactly-once
+  fleet-wide (``serve.store`` docstring for the protocol);
+* **speculative neighbor prefetch** around misses
+  (``EquilibriumService(prefetch_k=..., prefetch_cells=...)``) riding
+  ``Priority.SPECULATIVE`` — sheddable by construction, so prefetch can
+  never displace interactive work.
+
+Endpoints (JSON in, JSON out):
+
+* ``POST /query`` — ``{"cell": [σ, ρ, sd], "kwargs": {...},
+  "scenario", "priority", "deadline", "degraded_ok", "timeout"}`` →
+  the served result, or a typed error payload (``{"error":
+  "<TypeName>", "message", "retry_after_s"?, "status"?}``) with the
+  HTTP status mapped from the serving layer's typed errors (503 +
+  ``Retry-After`` for ``Overloaded``/``CircuitOpen``, 504 for
+  deadlines/timeouts, 500 for solve/certification failures).
+* ``GET /metrics`` — the ``ServeMetrics`` snapshot (fleet counters
+  included).
+* ``GET /fleet`` — fleet introspection: owner id, published keys,
+  prefetch-issued keys, held leases (the load harness's attribution
+  and leak-audit hook).
+* ``GET /healthz`` — liveness.
+
+Worker lifecycle: ``python -m aiyagari_hark_tpu.serve.fleet --store DIR
+--kwargs '{"a_count": 10}' ...`` prints ``FLEET_READY port=<p>
+pid=<pid>`` once the server is listening and then idles under
+``resilience.preemption_guard``: SIGTERM turns into the typed
+``Interrupted`` (journaled; pending futures fail at the batch seam, the
+PR 3 protocol), the front stops, and the process exits 75 — the
+driver-facing "interrupted, not failed" code.  Leases the dying worker
+still holds are deliberately NOT released on the signal path: the lease
+TTL is the designed reclaim (survivors break stale leases and re-solve),
+and the interrupt path must not add disk I/O between the signal and
+exit.
+
+Scope, honestly: this is a single-host-N-process fleet (the lease
+protocol trusts one filesystem's O_EXCL and one wall clock).  A
+multi-host tier would swap the disk directory for an object store /
+coordination service behind the same ``SolutionStore`` claim/publish
+API; nothing above the store changes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from concurrent.futures import TimeoutError as FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+from urllib import error as urlerror
+from urllib import request as urlrequest
+
+from .batcher import ServeQueueFull
+from .service import (
+    CertificationFailed,
+    CircuitOpen,
+    DeadlineExceeded,
+    EquilibriumService,
+    EquilibriumSolveFailed,
+    LoadShed,
+    Overloaded,
+    ServeError,
+    ServiceClosed,
+    make_query,
+)
+
+# typed serving error -> (HTTP status, should carry Retry-After).  503
+# means "the fleet is saturated or this region is breaking — back off
+# and retry"; 504 "your deadline/timeout passed"; 500 "the solve itself
+# failed typed".  Unknown scenarios and malformed bodies are 400s.
+# Keyed by CLASS (exact type): a rename upstream breaks this table
+# loudly at import, not silently at serve time.
+_ERROR_STATUS = {
+    Overloaded: (503, True),
+    CircuitOpen: (503, True),
+    ServeQueueFull: (503, False),
+    LoadShed: (503, False),
+    ServiceClosed: (503, False),
+    DeadlineExceeded: (504, False),
+    EquilibriumSolveFailed: (500, False),
+    CertificationFailed: (500, False),
+}
+# Interrupted is resolved lazily (importing the resilience layer here
+# would be needless at module scope for a transport table).
+_EXTRA_STATUS = {"Interrupted": (503, False)}
+
+
+def result_to_json(res) -> dict:
+    """A ``ServedResult`` as a JSON-safe dict.  Floats serialize via
+    ``repr`` (shortest round-trip), so every float64 crosses the wire
+    BIT-EXACTLY — the fleet bit-identity acceptance compares served
+    values against a local ``reference_solve`` after one JSON hop."""
+    return {
+        "r_star": float(res.r_star),
+        "capital": float(res.capital),
+        "labor": float(res.labor),
+        "bisect_iters": int(res.bisect_iters),
+        "egm_iters": int(res.egm_iters),
+        "dist_iters": int(res.dist_iters),
+        "status": int(res.status),
+        "path": str(res.path),
+        "quality": str(res.quality),
+        "key": int(res.key),
+        "cert_level": (None if res.cert_level is None
+                       else int(res.cert_level)),
+        "scenario": str(res.scenario),
+        "fields": list(res.fields),
+        "values": [float(v) for v in res.values],
+        "bracket_init": (None if res.bracket_init is None
+                         else [float(res.bracket_init[0]),
+                               float(res.bracket_init[1]),
+                               int(res.bracket_init[2])]),
+    }
+
+
+def error_to_json(exc: BaseException) -> dict:
+    """A typed serving error as a JSON payload the client can re-type:
+    the class name, message, and whichever retry-after / status / key
+    attributes the error carries."""
+    payload = {"error": type(exc).__name__, "message": str(exc)}
+    for attr in ("retry_after_s", "est_wait_s", "status", "key",
+                 "waited_s", "depth", "max_queue", "reason"):
+        v = getattr(exc, attr, None)
+        if v is not None and isinstance(v, (int, float, str)):
+            payload[attr] = v
+    return payload
+
+
+class _FleetHandler(BaseHTTPRequestHandler):
+    """One request: decode JSON, run the service call, encode JSON.
+    The SERVICE is the authority on every serving decision — this layer
+    only transports."""
+
+    # the service is attached per-server (``FleetFront`` subclasses the
+    # server class with a ``service`` attribute)
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):   # quiet: the journal is the log
+        pass
+
+    def _send(self, code: int, payload: dict,
+              retry_after: Optional[float] = None) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if retry_after is not None:
+            self.send_header("Retry-After", f"{max(0.0, retry_after):.3f}")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        svc: EquilibriumService = self.server.service
+        if self.path == "/healthz":
+            self._send(200, {"ok": True})
+        elif self.path == "/metrics":
+            self._send(200, svc.metrics.snapshot())
+        elif self.path == "/fleet":
+            store = svc.store
+            self._send(200, {
+                "owner": getattr(store, "owner", ""),
+                "shared": bool(getattr(store, "shared", False)),
+                "published_keys": store.published_keys(),
+                "prefetch_keys": svc.prefetch_keys(),
+                "held_leases": store.held_leases(),
+                "store_known": store.known(),
+                "fleet_counts": store.fleet_counts(),
+            })
+        else:
+            self._send(404, {"error": "NotFound", "message": self.path})
+
+    def do_POST(self):
+        if self.path != "/query":
+            self._send(404, {"error": "NotFound", "message": self.path})
+            return
+        svc: EquilibriumService = self.server.service
+        try:
+            n = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(n).decode("utf-8"))
+            cell = [float(x) for x in req["cell"]]
+            q = make_query(
+                cell[0], cell[1], labor_sd=cell[2],
+                priority=int(req.get("priority", 0)),
+                degraded_ok=bool(req.get("degraded_ok", False)),
+                scenario=str(req.get("scenario", "aiyagari")),
+                **req.get("kwargs", {}))
+        except Exception as e:   # malformed request: client error
+            self._send(400, {"error": "BadRequest", "message": str(e)})
+            return
+        deadline = req.get("deadline")
+        timeout = float(req.get("timeout", 300.0))
+        try:
+            fut = svc.submit(
+                q, deadline=None if deadline is None else float(deadline))
+            res = fut.result(timeout)
+        except FutureTimeout:
+            self._send(504, {"error": "Timeout",
+                             "message": f"no result in {timeout:g}s"})
+            return
+        except BaseException as e:
+            code, with_retry = _ERROR_STATUS.get(
+                type(e), _EXTRA_STATUS.get(type(e).__name__,
+                                           (500, False)))
+            self._send(code, error_to_json(e),
+                       retry_after=(getattr(e, "retry_after_s", None)
+                                    if with_retry else None))
+            return
+        self._send(200, result_to_json(res))
+
+
+class _FleetServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class FleetFront:
+    """The HTTP front for ONE worker's service: bind, serve on a daemon
+    thread, stop.  ``port=0`` binds an ephemeral port (read ``.port``
+    after construction — the worker prints it for its spawner)."""
+
+    def __init__(self, service: EquilibriumService,
+                 host: str = "127.0.0.1", port: int = 0):
+        self._httpd = _FleetServer((host, int(port)), _FleetHandler)
+        self._httpd.service = service
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "FleetFront":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="fleet-front", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+
+    def __enter__(self) -> "FleetFront":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()
+
+
+class FleetHTTPError(ServeError):
+    """A worker answered with a typed error payload: ``payload`` is the
+    decoded JSON (``payload["error"]`` names the serving-layer type),
+    ``code`` the HTTP status."""
+
+    def __init__(self, code: int, payload: dict):
+        super().__init__(
+            f"fleet worker returned {code}: "
+            f"{payload.get('error')} ({payload.get('message')})")
+        self.code = int(code)
+        self.payload = dict(payload)
+
+
+class FleetClient:
+    """Minimal stdlib client for a worker pool: submit one query to a
+    worker, failing over to the next URL on a CONNECTION-level error (a
+    dead worker).  Typed serving errors do NOT fail over — an
+    ``Overloaded`` from a live worker is an answer, not an outage."""
+
+    def __init__(self, urls: List[str], timeout: float = 300.0):
+        if not urls:
+            raise ValueError("FleetClient needs at least one worker URL")
+        self.urls = list(urls)
+        self.timeout = float(timeout)
+
+    def _post(self, url: str, path: str, payload: dict) -> dict:
+        data = json.dumps(payload).encode("utf-8")
+        req = urlrequest.Request(url + path, data=data,
+                                 headers={"Content-Type":
+                                          "application/json"})
+        try:
+            with urlrequest.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except urlerror.HTTPError as e:
+            try:
+                payload = json.loads(e.read().decode("utf-8"))
+            except Exception:
+                payload = {"error": "HTTPError", "message": str(e)}
+            raise FleetHTTPError(e.code, payload) from None
+
+    def get(self, url: str, path: str) -> dict:
+        with urlrequest.urlopen(url + path,
+                                timeout=self.timeout) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    def query(self, cell, kwargs: dict, scenario: str = "aiyagari",
+              priority: int = 0, deadline: Optional[float] = None,
+              degraded_ok: bool = False,
+              prefer: Optional[int] = None) -> dict:
+        """POST one query, starting at ``urls[prefer]`` and failing over
+        on connection errors.  Returns the result payload; raises
+        ``FleetHTTPError`` on a typed error answer, ``ConnectionError``
+        when EVERY worker is unreachable."""
+        payload = {"cell": [float(c) for c in cell], "kwargs": kwargs,
+                   "scenario": scenario, "priority": int(priority),
+                   "deadline": deadline,
+                   "degraded_ok": bool(degraded_ok),
+                   "timeout": self.timeout}
+        start = 0 if prefer is None else int(prefer) % len(self.urls)
+        last = None
+        for i in range(len(self.urls)):
+            url = self.urls[(start + i) % len(self.urls)]
+            try:
+                return self._post(url, "/query", payload)
+            except FleetHTTPError as e:
+                # a DYING worker's typed refusal is an outage, not an
+                # answer — the query is valid, a peer can serve it
+                if e.payload.get("error") in ("ServiceClosed",
+                                              "Interrupted"):
+                    last = e
+                    continue
+                raise
+            except (urlerror.URLError, ConnectionError, OSError,
+                    TimeoutError) as e:
+                last = e
+                continue
+        raise ConnectionError(
+            f"no fleet worker reachable ({len(self.urls)} tried): "
+            f"{last}")
+
+
+# -- the out-of-process worker ----------------------------------------------
+
+def worker_main(argv=None) -> int:
+    """One fleet worker process: shared store + service + HTTP front,
+    idling under ``preemption_guard`` until SIGTERM (exit 75, the PR 3
+    interrupted-not-failed convention) or ``--max-seconds`` (exit 0)."""
+    import argparse
+    import sys
+    import time
+
+    ap = argparse.ArgumentParser(
+        description="aiyagari fleet worker (ISSUE 15)")
+    ap.add_argument("--store", required=True,
+                    help="shared disk store directory")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 binds an ephemeral port (printed)")
+    ap.add_argument("--owner", default=f"worker-{os.getpid()}")
+    ap.add_argument("--kwargs", default="{}",
+                    help="solver model kwargs, JSON")
+    ap.add_argument("--scenario", default="aiyagari")
+    ap.add_argument("--lease-ttl", type=float, default=30.0)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--ladder", default="1,2,4")
+    ap.add_argument("--capacity", type=int, default=256)
+    ap.add_argument("--prefetch-k", type=int, default=0)
+    ap.add_argument("--prefetch-cells", default=None,
+                    help="JSON list of [σ, ρ, sd] lattice cells")
+    ap.add_argument("--admission", default=None,
+                    help="AdmissionPolicy fields, JSON (omit: no "
+                         "admission layer)")
+    ap.add_argument("--journal", default=None,
+                    help="worker event-journal JSONL path")
+    ap.add_argument("--certify", action="store_true",
+                    help="certify_before_cache on cold misses")
+    ap.add_argument("--max-seconds", type=float, default=None,
+                    help="safety exit after this long (tests)")
+    args = ap.parse_args(argv)
+
+    from ..obs.runtime import NULL_OBS, ObsConfig, build_obs
+    from ..utils.config import AdmissionPolicy
+    from ..utils.resilience import interrupt_requested, preemption_guard
+    from .store import SolutionStore
+
+    obs = (build_obs(ObsConfig(enabled=True, journal_path=args.journal))
+           if args.journal else NULL_OBS)
+    admission = (AdmissionPolicy(**json.loads(args.admission))
+                 if args.admission else None)
+    prefetch_cells = (json.loads(args.prefetch_cells)
+                      if args.prefetch_cells else None)
+    store = SolutionStore(capacity=args.capacity, disk_path=args.store,
+                          shared=True, lease_ttl_s=args.lease_ttl,
+                          owner=args.owner, obs=obs)
+    svc = EquilibriumService(
+        store=store, max_batch=args.max_batch,
+        ladder=tuple(int(s) for s in args.ladder.split(",")),
+        admission=admission, obs=obs,
+        certify_before_cache=bool(args.certify),
+        prefetch_k=args.prefetch_k, prefetch_cells=prefetch_cells)
+    front = FleetFront(svc, host=args.host, port=args.port).start()
+    print(f"FLEET_READY port={front.port} pid={os.getpid()} "
+          f"owner={args.owner}", flush=True)
+
+    interrupted = False
+    deadline = (None if args.max_seconds is None
+                else time.monotonic() + args.max_seconds)  # timing-ok: test-only safety exit
+    # cleanup stays INSIDE the guard: a second SIGTERM mid-cleanup must
+    # escalate through the guard's typed path (KeyboardInterrupt), not
+    # hit a restored default handler and kill the worker untyped
+    with preemption_guard():
+        try:
+            while not interrupt_requested():
+                if (deadline is not None
+                        and time.monotonic() >= deadline):  # timing-ok: safety exit check
+                    break
+                time.sleep(0.02)
+            interrupted = interrupt_requested()
+            if interrupted and obs is not NULL_OBS:
+                obs.event("INTERRUPTED", what="fleet worker",
+                          owner=args.owner)
+            front.stop()
+            try:
+                svc.close(drain=not interrupted)
+            except BaseException:
+                pass
+            if obs is not NULL_OBS:
+                obs.close()
+        except KeyboardInterrupt:
+            interrupted = True
+    print(f"FLEET_EXIT interrupted={int(interrupted)}", flush=True)
+    return 75 if interrupted else 0
+
+
+if __name__ == "__main__":   # pragma: no cover - exercised via subprocess
+    import sys
+
+    sys.exit(worker_main())

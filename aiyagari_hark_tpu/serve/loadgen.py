@@ -41,11 +41,13 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
+import threading
 from typing import List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
-from ..utils.timing import stopwatch
+from ..utils.timing import Stopwatch, stopwatch
 from .service import EquilibriumService, ServeError, make_query
 
 
@@ -278,3 +280,391 @@ def run_load(spec: LoadSpec, admission=None, obs=None,
         breaker_transitions=(svc.breaker.transitions()
                              if svc.breaker is not None else []),
         hit_wall_ms=hit_wall_ms, snapshot=m.snapshot())
+
+
+# -- fleet mode (ISSUE 15, DESIGN §14) --------------------------------------
+#
+# ``run_load`` models overload inside ONE process on an injected clock;
+# ``run_fleet_load`` is its out-of-process sibling: N REAL worker
+# processes (``serve.fleet`` workers over one shared disk store), each
+# replayed a deterministic per-worker-seeded Zipf mix by its own client
+# thread over HTTP, with fleet-wide aggregation — per-path p50/p99, the
+# dedup ratio (cold solves / distinct cold fingerprints; 1.0 = the
+# claim/lease election held exactly-once fleet-wide), prefetch
+# hit-conversion, and the lease-leak audit.  Real wall time throughout:
+# the subjects are separate processes no injected clock can reach, so
+# outcome MIXES (not digests) are the replayable artifact — the arrival
+# traces themselves are seed-deterministic and fingerprinted.
+
+
+class FleetSpec(NamedTuple):
+    """One fleet load scenario.
+
+    ``cells`` is the query lattice in Zipf rank order; each of
+    ``n_workers`` workers replays ``queries_per_worker`` arrivals drawn
+    from ``Zipf(zipf_s)`` with stream seed ``seed + 1000 * worker``
+    (deterministic per worker, different across workers).
+    ``warm_count`` hottest cells are pre-published through worker 0
+    before the replay.  ``sigterm_worker``/``sigterm_after`` drive the
+    preemption drill: that worker receives SIGTERM after its client has
+    dispatched that many arrivals (its remaining arrivals fail over to
+    the survivors)."""
+
+    cells: Tuple[Tuple[float, float, float], ...]
+    model_kwargs: dict
+    n_workers: int = 4
+    queries_per_worker: int = 40
+    seed: int = 0
+    zipf_s: float = 0.9
+    scenario: str = "aiyagari"
+    priority_mix: Tuple[float, float] = (0.7, 0.3)  # INTERACTIVE, BATCH
+    prefetch_k: int = 0
+    lease_ttl_s: float = 2.0
+    warm_count: int = 0
+    max_batch: int = 4
+    sigterm_worker: Optional[int] = None
+    sigterm_after: Optional[int] = None
+
+
+class FleetReport(NamedTuple):
+    """One fleet run's record (``run_fleet_load``)."""
+
+    workers: int
+    arrivals: int
+    counts: dict                # outcome -> count, fleet-wide
+    outcomes_by_worker: list    # per client thread, in dispatch order
+    unresolved: int             # arrivals without a terminal outcome
+    p50_ms: dict                # real-wall latency p50 per path
+    p99_ms: dict
+    cold_solves: int            # FLEET_PUBLISH events fleet-wide
+    distinct_published: int     # |union of published keys|
+    dedup_ratio: Optional[float]  # cold_solves / distinct (1.0 = exact)
+    prefetch_issued: int
+    prefetch_converted: int     # speculative-published keys later HIT
+    remote_hits: int            # hits served from a peer's publish
+    claims_won: int
+    claims_lost: int
+    lease_reclaims: int
+    leases_leaked: int          # lease files left after the TTL sweep
+    interrupted_rcs: dict       # worker index -> return code (drilled)
+    interrupted_journaled: bool  # the SIGTERMed worker journaled typed
+    trace_digest: str           # fingerprint of the arrival traces
+    worker_snapshots: list      # /metrics of workers alive at the end
+    served_values: dict         # key -> first served value fields (the
+    #                             bit-identity acceptance input)
+    value_divergence: int       # keys whose served VALUE fields ever
+    #                             disagreed across responses (MUST be 0:
+    #                             loser-serves-winner bit-identity)
+
+
+def generate_fleet_arrivals(spec: FleetSpec, worker: int) -> list:
+    """Worker ``worker``'s deterministic Zipf trace: a list of
+    ``(cell, priority)`` drawn from one ``default_rng(seed + 1000 *
+    worker)`` stream in a fixed order."""
+    if not spec.cells:
+        raise ValueError("FleetSpec.cells must be non-empty")
+    rng = np.random.default_rng(spec.seed + 1000 * int(worker))
+    n = len(spec.cells)
+    p = np.arange(1, n + 1, dtype=np.float64) ** -float(spec.zipf_s)
+    p /= p.sum()
+    mix = np.asarray(spec.priority_mix, dtype=np.float64)
+    mix = mix / mix.sum()
+    out = []
+    for _ in range(int(spec.queries_per_worker)):
+        cell = spec.cells[int(rng.choice(n, p=p))]
+        priority = int(rng.choice(len(mix), p=mix))
+        out.append((tuple(float(c) for c in cell), priority))
+    return out
+
+
+def _spawn_fleet(spec: FleetSpec, store_dir: str,
+                 journal_paths: list, ready_timeout_s: float):
+    """Start ``n_workers`` ``serve.fleet`` worker processes over one
+    shared store; returns ``(procs, urls)`` once every worker printed
+    FLEET_READY."""
+    import json as _json
+    import subprocess
+    import sys
+
+    procs, urls = [], []
+    cells_json = _json.dumps([list(c) for c in spec.cells])
+    for i in range(spec.n_workers):
+        cmd = [sys.executable, "-m", "aiyagari_hark_tpu.serve.fleet",
+               "--store", store_dir, "--owner", f"w{i}",
+               "--kwargs", _json.dumps(spec.model_kwargs),
+               "--scenario", spec.scenario,
+               "--lease-ttl", str(spec.lease_ttl_s),
+               "--max-batch", str(spec.max_batch),
+               "--journal", journal_paths[i],
+               "--max-seconds", "600"]
+        if spec.prefetch_k > 0:
+            cmd += ["--prefetch-k", str(spec.prefetch_k),
+                    "--prefetch-cells", cells_json]
+        procs.append(subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True))
+    import selectors
+
+    watch = Stopwatch()
+    try:
+        for i, proc in enumerate(procs):
+            port = None
+            sel = selectors.DefaultSelector()
+            sel.register(proc.stdout, selectors.EVENT_READ)
+            try:
+                while True:
+                    # the timeout must bound the BLOCKED wait too: a
+                    # silent-but-alive worker (hung before its READY
+                    # print) would otherwise defeat it — readline alone
+                    # only returns on a line or on process exit
+                    left = ready_timeout_s - watch.elapsed()
+                    if left <= 0 or not sel.select(timeout=left):
+                        raise RuntimeError(
+                            f"fleet worker {i} not ready in "
+                            f"{ready_timeout_s:g}s")
+                    line = proc.stdout.readline()
+                    if not line:
+                        raise RuntimeError(
+                            f"fleet worker {i} exited before "
+                            f"FLEET_READY (rc={proc.poll()})")
+                    if line.startswith("FLEET_READY"):
+                        port = int(line.split("port=")[1].split()[0])
+                        break
+            finally:
+                sel.close()
+            urls.append(f"http://127.0.0.1:{port}")
+    except BaseException:
+        for p in procs:
+            p.kill()
+        raise
+    return procs, urls
+
+
+def run_fleet_load(spec: FleetSpec, store_dir: str,
+                   ready_timeout_s: float = 180.0,
+                   client_timeout_s: float = 300.0) -> FleetReport:
+    """Replay one fleet scenario against a freshly spawned worker pool
+    sharing ``store_dir`` and aggregate the fleet-wide record.
+
+    Outcome vocabulary per arrival: ``served:<path>`` (hit / near /
+    cold / degraded_neighbor), ``reject:<Error>`` (a typed error payload
+    from a live worker), ``error:disconnected`` (every worker
+    unreachable — only possible mid-drill).  The invariant ``unresolved
+    == 0`` (every arrival reaches a terminal outcome even with a worker
+    SIGTERMed mid-load) is part of the ISSUE 15 acceptance.
+
+    Dedup accounting comes from the workers' event journals (one
+    FLEET_PUBLISH per completed claim, key attached) — journals survive
+    the drilled worker's death, so the killed worker's solves still
+    count."""
+    import signal
+
+    from ..obs.journal import read_journal
+    from .fleet import FleetClient, FleetHTTPError
+
+    os.makedirs(store_dir, exist_ok=True)
+    journal_paths = [os.path.join(store_dir, f"journal_w{i}.jsonl")
+                     for i in range(spec.n_workers)]
+    procs, urls = _spawn_fleet(spec, store_dir, journal_paths,
+                               ready_timeout_s)
+    client = FleetClient(urls, timeout=client_timeout_s)
+    traces = [generate_fleet_arrivals(spec, i)
+              for i in range(spec.n_workers)]
+    trace_digest = hashlib.blake2b(
+        json.dumps([[list(c) + [pr] for c, pr in t] for t in traces],
+                   sort_keys=True).encode(),
+        digest_size=16).hexdigest()
+
+    warm_keys: set = set()
+    for cell in spec.cells[:spec.warm_count]:
+        res = client.query(cell, spec.model_kwargs,
+                           scenario=spec.scenario, prefer=0)
+        warm_keys.add(int(res["key"]))
+
+    outcomes_by_worker = [[] for _ in range(spec.n_workers)]
+    walls_by_path: dict = {}
+    hit_keys: set = set()
+    served_values: dict = {}
+    value_divergence = 0
+    unresolved = 0
+    lock = threading.Lock()
+    drill_fired = threading.Event()
+
+    def _client_loop(i: int) -> None:
+        nonlocal unresolved, value_divergence
+        for k, (cell, priority) in enumerate(traces[i]):
+            if (spec.sigterm_worker is not None
+                    and i == spec.sigterm_worker
+                    and k == spec.sigterm_after
+                    and not drill_fired.is_set()):
+                drill_fired.set()
+                procs[spec.sigterm_worker].send_signal(signal.SIGTERM)
+            try:
+                with stopwatch() as sw:
+                    res = client.query(cell, spec.model_kwargs,
+                                       scenario=spec.scenario,
+                                       priority=priority, prefer=i)
+                path = (res["quality"] if res["quality"] != "exact"
+                        else res["path"])
+                outcome = f"served:{path}"
+                with lock:
+                    walls_by_path.setdefault(path, []).append(
+                        sw.seconds * 1e3)
+                    if path == "hit":
+                        hit_keys.add(int(res["key"]))
+                    # loser-serves-winner bit-identity: every response
+                    # for one fingerprint must carry the SAME value
+                    # fields (the exactly-once publish is the only
+                    # source; counters ride the winner's solve too).
+                    # Degraded answers are a DIFFERENT calibration's row
+                    # served under that key on purpose — excluded.
+                    # ``bracket_init`` is non-None exactly on the
+                    # response that SOLVED the key (near/cold): keep it
+                    # when seen, so the bit-identity acceptance can
+                    # replay the same seed through reference_solve (the
+                    # PR 4 contract is same-seed, and a warm-solved
+                    # capital is evaluated under the warm seed).
+                    if res["quality"] == "exact":
+                        vals = {"cell": list(cell),
+                                "r_star": res["r_star"],
+                                "capital": res["capital"],
+                                "labor": res["labor"],
+                                "status": res["status"]}
+                        key = int(res["key"])
+                        rec = served_values.setdefault(
+                            key, dict(vals, bracket_init=None))
+                        if {k: rec[k] for k in vals} != vals:
+                            value_divergence += 1
+                        if res.get("bracket_init") is not None:
+                            rec["bracket_init"] = res["bracket_init"]
+            except FleetHTTPError as e:
+                outcome = f"reject:{e.payload.get('error')}"
+            except ConnectionError:
+                outcome = "error:disconnected"
+            except BaseException as e:
+                with lock:
+                    unresolved += 1
+                outcome = f"unresolved:{type(e).__name__}"
+            with lock:
+                outcomes_by_worker[i].append(outcome)
+
+    threads = [threading.Thread(target=_client_loop, args=(i,),
+                                name=f"fleet-client-{i}")
+               for i in range(spec.n_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(client_timeout_s + 60.0)
+        if t.is_alive():
+            unresolved += 1
+
+    # final snapshots from live workers, then graceful shutdown
+    worker_snapshots = []
+    for i, url in enumerate(urls):
+        if procs[i].poll() is not None:
+            continue
+        try:
+            worker_snapshots.append(client.get(url, "/metrics"))
+        except Exception:
+            pass
+    rcs: dict = {}
+    for i, proc in enumerate(procs):
+        # the drilled worker already received its SIGTERM; a second one
+        # landing after its preemption_guard exited (handlers restored)
+        # would kill it mid-cleanup with the default action
+        if proc.poll() is None and not (drill_fired.is_set()
+                                        and i == spec.sigterm_worker):
+            proc.send_signal(signal.SIGTERM)
+    for i, proc in enumerate(procs):
+        try:
+            rcs[i] = proc.wait(60.0)
+        except Exception:
+            proc.kill()
+            rcs[i] = proc.wait()
+        if proc.stdout is not None:
+            proc.stdout.close()
+
+    # journal-based fleet accounting (survives the drilled death)
+    publishes, spec_published, prefetch_issued = [], set(), 0
+    seed_by_key: dict = {}
+    claims_won = claims_lost = reclaims = 0
+    # vacuously true only when NO drill ran; a drilled worker whose
+    # journal never materialized is a FAILED journaling leg, not a pass
+    interrupted_journaled = spec.sigterm_worker is None
+    for i, jp in enumerate(journal_paths):
+        if not os.path.exists(jp):
+            continue
+        for ev in read_journal(jp, event="FLEET_PUBLISH"):
+            publishes.append(int(ev["key"]))
+            if ev.get("speculative"):
+                spec_published.add(int(ev["key"]))
+            if ev.get("seed") is not None:
+                seed_by_key[int(ev["key"])] = ev["seed"]
+        claims_won += len(read_journal(jp, event="FLEET_CLAIM"))
+        reclaims += len(read_journal(jp, event="FLEET_LEASE_RECLAIM"))
+        prefetch_issued += len(read_journal(jp,
+                                            event="PREFETCH_ISSUED"))
+        if i == spec.sigterm_worker:
+            interrupted_journaled = bool(
+                read_journal(jp, event="INTERRUPTED"))
+
+    # lease-leak audit through the store's own API (the canonical lease
+    # spelling lives in ONE place): anything a dead worker still held
+    # goes stale within the TTL; sweep then count what survived (must
+    # be zero)
+    import time as _time
+
+    from .store import SolutionStore
+
+    audit = SolutionStore(disk_path=store_dir, shared=True,
+                          lease_ttl_s=spec.lease_ttl_s, owner="audit")
+    deadline = Stopwatch()
+    while (audit.lease_files()
+           and deadline.elapsed() < spec.lease_ttl_s + 10.0):
+        audit.gc_stale_leases()
+        if audit.lease_files():
+            _time.sleep(0.2)
+    leaked = len(audit.lease_files())
+
+    # every published solve's exact seed came through its journal, so
+    # keys whose solving RESPONSE no client saw (prefetch solves, a
+    # drilled worker's lost reply) still compare same-seed downstream
+    for key, rec in served_values.items():
+        if rec.get("bracket_init") is None and key in seed_by_key:
+            rec["bracket_init"] = seed_by_key[key]
+
+    counts: dict = {}
+    for seq in outcomes_by_worker:
+        for o in seq:
+            counts[o] = counts.get(o, 0) + 1
+    arrivals = sum(len(s) for s in outcomes_by_worker)
+    distinct = len(set(publishes))
+    converted = len({k for k in spec_published
+                     if k in hit_keys and k not in warm_keys})
+
+    def _pctl(vals, q):
+        if not vals:
+            return None
+        s = sorted(vals)
+        return round(s[min(len(s) - 1,
+                           max(0, round(q / 100.0 * (len(s) - 1))))], 4)
+
+    remote_hits = sum(int(s.get("fleet_remote_hits", 0))
+                      for s in worker_snapshots)
+    claims_lost = sum(int(s.get("fleet_claims_lost", 0))
+                      for s in worker_snapshots)
+    return FleetReport(
+        workers=spec.n_workers, arrivals=arrivals, counts=counts,
+        outcomes_by_worker=outcomes_by_worker, unresolved=unresolved,
+        p50_ms={p: _pctl(v, 50) for p, v in walls_by_path.items()},
+        p99_ms={p: _pctl(v, 99) for p, v in walls_by_path.items()},
+        cold_solves=len(publishes), distinct_published=distinct,
+        dedup_ratio=(None if distinct == 0
+                     else round(len(publishes) / distinct, 4)),
+        prefetch_issued=prefetch_issued, prefetch_converted=converted,
+        remote_hits=remote_hits, claims_won=claims_won,
+        claims_lost=claims_lost, lease_reclaims=reclaims,
+        leases_leaked=leaked, interrupted_rcs=rcs,
+        interrupted_journaled=interrupted_journaled,
+        trace_digest=trace_digest, worker_snapshots=worker_snapshots,
+        served_values=served_values, value_divergence=value_divergence)

@@ -110,6 +110,23 @@ class ServeMetrics:
         self.breaker = {"opened": 0, "reopened": 0, "closed": 0,
                         "probe": 0}
         self.depth_hist = LatencyHistogram()
+        # speculative neighbor prefetch (ISSUE 15): queries the service
+        # issued at Priority.SPECULATIVE around misses, how many of
+        # their stored solutions later converted a would-be miss into an
+        # exact hit, and how many issues the overload layer suppressed
+        # (prefetch is best-effort by construction — a rejected
+        # speculative submit is working as designed, not an error)
+        self.prefetch_issued = 0
+        self.prefetch_converted = 0
+        self.prefetch_suppressed = 0
+        # fleet tier (ISSUE 15): exact hits served from a PEER worker's
+        # publish (discovered at the claim gate or the waiter poll)
+        self.fleet_remote_hits = 0
+        # provider id -> [WeakMethod, last dict] — the store's fleet
+        # claim/publish/reclaim counters, merged like the eviction
+        # counter below (weak, accumulate-across-stores)
+        self._fleet_counts: dict = {}
+        self._retired_fleet: dict = {}
         # provider id -> [WeakMethod, last-seen eviction count]: weak so
         # a long-lived shared metrics object cannot pin dead services'
         # stores (each bound provider strongly references its store's
@@ -139,6 +156,57 @@ class ServeMetrics:
                 self._retired_evictions += entry[1]
             self._store_counts[key] = [weakref.WeakMethod(
                 counts_provider), 0]
+
+    def attach_fleet(self, counts_provider) -> None:
+        """Register a ``SolutionStore.fleet_counts`` provider whose
+        claim/publish/reclaim counters ``snapshot`` merges — the same
+        weak, accumulate-across-stores semantics as ``attach_store``."""
+        with self._lock:
+            key = id(counts_provider.__self__)
+            entry = self._fleet_counts.get(key)
+            if entry is not None:
+                if entry[0]() is not None:
+                    return
+                for k, v in entry[1].items():
+                    self._retired_fleet[k] = (
+                        self._retired_fleet.get(k, 0) + v)
+            self._fleet_counts[key] = [weakref.WeakMethod(
+                counts_provider), {}]
+
+    def _fleet_totals(self) -> dict:
+        totals = dict(self._retired_fleet)
+        for entry in self._fleet_counts.values():
+            provider = entry[0]()
+            if provider is not None:
+                entry[1] = provider()
+            for k, v in entry[1].items():
+                totals[k] = totals.get(k, 0) + v
+        for k in ("fleet_claims_won", "fleet_claims_lost",
+                  "fleet_publishes", "fleet_lease_reclaims"):
+            totals.setdefault(k, 0)
+        return totals
+
+    def record_prefetch_issued(self) -> None:
+        """One speculative neighbor query was enqueued."""
+        with self._lock:
+            self.prefetch_issued += 1
+
+    def record_prefetch_converted(self) -> None:
+        """One exact hit was served from a solution a prefetch stored —
+        a would-be cold miss converted (counted once per stored key)."""
+        with self._lock:
+            self.prefetch_converted += 1
+
+    def record_prefetch_suppressed(self) -> None:
+        """One prefetch issue was declined by the overload layer or a
+        full queue (best-effort by construction)."""
+        with self._lock:
+            self.prefetch_suppressed += 1
+
+    def record_remote_hit(self) -> None:
+        """One exact hit served from a peer worker's publish (fleet)."""
+        with self._lock:
+            self.fleet_remote_hits += 1
 
     def _store_evictions(self) -> int:
         total = self._retired_evictions
@@ -311,6 +379,12 @@ class ServeMetrics:
                 "serve_marginal_certificates": self.certificates["marginal"],
                 "serve_failed_certificates": self.certificates["failed"],
                 "store_corrupt_evictions": self._store_evictions(),
+                # speculative prefetch + fleet tier (ISSUE 15)
+                "serve_prefetch_issued": self.prefetch_issued,
+                "serve_prefetch_converted": self.prefetch_converted,
+                "serve_prefetch_suppressed": self.prefetch_suppressed,
+                "fleet_remote_hits": self.fleet_remote_hits,
+                **self._fleet_totals(),
                 # per-scenario served counts (ISSUE 9): {scenario:
                 # {path: n}} — JSON-ready; publish() mirrors the nonzero
                 # cells as per-scenario gauges

@@ -443,7 +443,9 @@ class EquilibriumService:
                  cert_thresholds=None,
                  inject_corrupt_lane: Optional[dict] = None,
                  obs=None, admission=None,
-                 mesh=None, mesh_axis: str = "cells"):
+                 mesh=None, mesh_axis: str = "cells",
+                 prefetch_k: int = 0, prefetch_cells=None,
+                 fleet_poll_s: float = 0.005):
         # Multi-chip mesh contract FIRST (ISSUE 11): resolve_mesh raises
         # typed on a mesh without the lane axis, and that must happen
         # before this constructor acquires anything that needs closing
@@ -468,6 +470,34 @@ class EquilibriumService:
         self.store.attach_obs(self._obs)
         self.metrics = metrics if metrics is not None else ServeMetrics()
         self.metrics.attach_store(self.store.integrity_counts)
+        self.metrics.attach_fleet(self.store.fleet_counts)
+        # Fleet tier (ISSUE 15, DESIGN §14): a SHARED store turns every
+        # cold-miss launch into a claim/publish election — N worker
+        # processes over one disk directory solve each distinct
+        # fingerprint exactly once; claim losers poll for the winner's
+        # publish (``fleet_poll_s`` real-time cadence — the peer is
+        # another PROCESS, no injected clock crosses that boundary).
+        self._fleet = bool(getattr(self.store, "shared", False))
+        self._fleet_poll_s = float(fleet_poll_s)
+        # Speculative neighbor prefetch (ISSUE 15): on a miss, enqueue
+        # up to ``prefetch_k`` nearest UNSOLVED lattice neighbors (from
+        # ``prefetch_cells``, normalized CellSpace distance, same solver
+        # group) at Priority.SPECULATIVE — sheddable by construction
+        # under load (PR 8), so prefetch can never displace interactive
+        # work.  Conversion accounting: keys whose stored solution came
+        # from a speculative solve convert to "prefetch hits" when a
+        # later exact hit addresses them.
+        self._prefetch_k = int(prefetch_k)
+        self._prefetch_cells = (None if prefetch_cells is None else
+                                [tuple(float(x) for x in c)
+                                 for c in prefetch_cells])
+        if self._prefetch_k > 0 and not self._prefetch_cells:
+            raise ValueError(
+                "prefetch_k > 0 requires prefetch_cells: the prefetcher "
+                "needs a lattice to pick neighbors from")
+        self._prefetch_lock = threading.Lock()
+        self._prefetch_issued_keys: set = set()
+        self._prefetch_stored: set = set()
         self._certify = bool(certify_before_cache)
         self._cert_thresholds = cert_thresholds
         self._corrupt_lane = (dict(inject_corrupt_lane)
@@ -520,7 +550,8 @@ class EquilibriumService:
     # -- client surface -----------------------------------------------------
 
     def submit(self, q: EquilibriumQuery,
-               deadline: Optional[float] = None) -> Future:
+               deadline: Optional[float] = None,
+               _prefetch: bool = False) -> Future:
         """Enqueue one query; returns a future resolving to a
         ``ServedResult`` (or raising ``EquilibriumSolveFailed`` /
         ``DeadlineExceeded`` / ``LoadShed`` / ``Interrupted``).  Exact
@@ -562,6 +593,7 @@ class EquilibriumService:
                 latency = self._clock() - t0
                 self.metrics.record_served("hit", latency,
                                            scenario=scn.name)
+                self._note_prefetch_hit(q.key())
                 self._obs.record_span("serve/query", latency,
                                       path="hit", cell=q.cell(),
                                       scenario=scn.name)
@@ -612,6 +644,14 @@ class EquilibriumService:
                         fut.set_result(res)
                         return fut
                 weight = predicted_work(q.cell(), scenario=q.scenario)
+                # EWMA cold start (ISSUE 15 satellite): before the first
+                # flush there is no measured batch latency, so seed from
+                # this query's own predicted wall — the first rejection's
+                # retry-after is finite and solve-scaled instead of
+                # collapsing to the batcher's millisecond max_wait_s
+                if adm.est_batch_s is None and self._batch_ewma_s is None:
+                    self._batch_ewma_s = max(self.batcher.max_wait_s,
+                                             weight * adm.work_unit_s)
                 est_wait = self._estimate_wait()
                 if (adm.deadline_aware and deadline is not None
                         and float(deadline) < est_wait):
@@ -644,10 +684,13 @@ class EquilibriumService:
                     raise ServiceClosed("EquilibriumService is closed")
                 try:
                     # batch groups are per (scenario, dtype, kwargs):
-                    # one executable family per model family (ISSUE 9)
+                    # one executable family per model family (ISSUE 9);
+                    # a prefetch submit never blocks — best-effort by
+                    # construction, a full queue suppresses it
                     self.batcher.offer(
                         (q.scenario, q.dtype, q.kwargs), pending,
-                        block=self._worker is not None and adm is None)
+                        block=(self._worker is not None and adm is None
+                               and not _prefetch))
                 except ServeQueueFull:
                     if adm is None:
                         raise
@@ -664,7 +707,103 @@ class EquilibriumService:
                 self.breaker.abort_probe(region)
             raise
         self._observe_depth(self.batcher.depth())
+        if (self._prefetch_k > 0 and not _prefetch
+                and q.priority != Priority.SPECULATIVE
+                and q.fault_iter is None):
+            self._maybe_prefetch(q)
         return fut
+
+    # -- speculative neighbor prefetch (ISSUE 15) ---------------------------
+
+    def _maybe_prefetch(self, q: EquilibriumQuery) -> None:
+        """Enqueue the K nearest UNSOLVED lattice neighbors of a missed
+        query as Priority.SPECULATIVE submits (asymptotic linearity in
+        (σ, ρ, sd)-space makes neighbor locality real — PAPERS
+        2002.09108): a hot region's surroundings get solved before they
+        are asked for, converting future cold misses into exact hits.
+        Best-effort by construction: an overloaded/full-queue rejection
+        suppresses the issue (counted) and NEVER surfaces to the
+        triggering caller — and SPECULATIVE pendings are the first shed
+        under pressure, so prefetch cannot displace interactive work."""
+        import numpy as np
+
+        from ..parallel.sweep import neighbor_distance
+
+        scn = _scenario_of(q.scenario)
+        cand = [c for c in self._prefetch_cells if c != q.cell()]
+        if not cand:
+            return
+        # distances first (one vectorized pass), queries/fingerprints
+        # LAZILY and only for the nearest few: hashing a key per lattice
+        # cell per miss would make prefetch O(lattice) on the serving
+        # path, which a million-cell lattice cannot afford
+        d = neighbor_distance(q.cell(), np.asarray(cand),
+                              scale=scn.cells.scale)
+        attempts = 0
+        scanned = 0
+        scan_cap = max(4 * self._prefetch_k, 16)
+        for i in np.argsort(d, kind="stable"):
+            # K bounds ATTEMPTS, not successes: under pressure the
+            # admission layer rejects the speculative class wholesale,
+            # and probing the entire lattice about it helps nobody.
+            # The scan cap bounds the already-solved skips the same way
+            # — past the nearest handful, cells are not "neighbors".
+            if attempts >= self._prefetch_k or scanned >= scan_cap:
+                break
+            scanned += 1
+            cell = cand[int(i)]
+            nq = q._replace(crra=cell[0], labor_ar=cell[1],
+                            labor_sd=cell[2],
+                            priority=Priority.SPECULATIVE,
+                            degraded_ok=False)
+            key = nq.key()
+            with self._prefetch_lock:
+                if key in self._prefetch_issued_keys:
+                    continue
+                already = self.store.contains(key)
+                if not already:
+                    self._prefetch_issued_keys.add(key)
+            if already:
+                continue
+            attempts += 1
+            try:
+                self.submit(nq, _prefetch=True)
+            except (ServeError, ServeQueueFull):
+                # best-effort: under pressure the speculative class is
+                # exactly what admission exists to reject — allow a
+                # later retrigger for this key
+                with self._prefetch_lock:
+                    self._prefetch_issued_keys.discard(key)
+                self.metrics.record_prefetch_suppressed()
+                continue
+            self.metrics.record_prefetch_issued()
+            self._obs.event("PREFETCH_ISSUED", cell=list(cell),
+                            scenario=q.scenario, key=key,
+                            parent_cell=list(q.cell()),
+                            distance=round(float(d[int(i)]), 6))
+            self._obs.counter(
+                "aiyagari_serve_prefetch_issued_total",
+                "speculative neighbor queries issued around "
+                "misses").inc()
+
+    def _note_prefetch_stored(self, key: int) -> None:
+        with self._prefetch_lock:
+            self._prefetch_stored.add(int(key))
+
+    def _note_prefetch_hit(self, key: int) -> None:
+        """An exact hit addressed a key a prefetch solve stored: one
+        would-be cold miss converted (counted once per stored key)."""
+        with self._prefetch_lock:
+            if int(key) not in self._prefetch_stored:
+                return
+            self._prefetch_stored.discard(int(key))
+        self.metrics.record_prefetch_converted()
+
+    def prefetch_keys(self) -> list:
+        """Keys this service has issued speculative queries for (the
+        fleet harness's attribution hook)."""
+        with self._prefetch_lock:
+            return sorted(self._prefetch_issued_keys)
 
     def _reject_overloaded(self, q: EquilibriumQuery, reason: str,
                            est_wait: float) -> None:
@@ -930,6 +1069,165 @@ class EquilibriumService:
                 live.append(p)
         return live
 
+    # -- fleet claim / await (ISSUE 15, DESIGN §14) -------------------------
+
+    def _serve_stored(self, p: _Pending, sol, scn,
+                      remote: bool = False) -> None:
+        """Resolve one pending from a stored entry at a launch seam (the
+        fleet gate's re-probe or a peer's awaited publish): an exact hit
+        in every respect — the PR 6 checksum and PR 9 ``schema_ck``
+        contracts made these bytes verifiably safe to share across
+        processes."""
+        lvl = int(sol.cert_level)
+        res = _result_from_row(
+            scn.schema, np.asarray(sol.packed), "hit", None,
+            p.query.key(),
+            cert_level=None if lvl == UNCERTIFIED else lvl,
+            scenario=scn.name)
+        now = self._clock()
+        if not p.future.done():
+            p.future.set_result(res)
+        self.metrics.record_served("hit", now - p.t_submit,
+                                   scenario=scn.name)
+        if remote:
+            self.metrics.record_remote_hit()
+        self._note_prefetch_hit(p.query.key())
+        self._obs.record_span("serve/query", now - p.t_submit,
+                              path="hit", cell=p.query.cell(),
+                              scenario=scn.name)
+
+    def _fleet_gate(self, group, pendings):
+        """Partition one popped batch under the claim protocol: returns
+        ``(winners, waiters, dups)`` — claim winners this process
+        solves, claim losers that poll for a peer's publish, and
+        same-fingerprint in-batch duplicates riding their winner's lane
+        (``dups[id(winner)]``).  Pendings whose fingerprint turns out
+        already published (a peer solved it since submit) are served
+        here and appear in neither list."""
+        scenario_name, _, _ = group
+        scn = _scenario_of(scenario_name)
+        winners, waiters, dups = [], [], {}
+        owner_by_key = {}
+        for p in pendings:
+            if p.query.fault_iter is not None:
+                # injection bypasses the cache on read AND write, so it
+                # must bypass the election too (it never publishes)
+                winners.append(p)
+                continue
+            key = p.query.key()
+            if key in owner_by_key:
+                dups.setdefault(id(owner_by_key[key]), []).append(p)
+                continue
+            sol = self.store.get(key, schema_ck=scn.schema.checksum())
+            if sol is not None:
+                self._serve_stored(p, sol, scn, remote=True)
+                continue
+            verdict = self.store.claim(key)
+            if verdict == "published":
+                sol = self.store.get(key,
+                                     schema_ck=scn.schema.checksum())
+                if sol is not None:
+                    self._serve_stored(p, sol, scn, remote=True)
+                    continue
+                # published-but-unreadable (evicted as corrupt between
+                # probe and load): solve it ourselves — claim again,
+                # falling through to winner/waiter on the outcome
+                verdict = self.store.claim(key)
+            if verdict == "won":
+                owner_by_key[key] = p
+                winners.append(p)
+            else:
+                waiters.append(p)
+        return winners, waiters, dups
+
+    def _fleet_release_claims(self, pendings) -> None:
+        """Return every claim a failed batch holds (launch error, drain,
+        interrupt): an unpublishable fingerprint must become claimable
+        again immediately, not after the TTL."""
+        if not self._fleet:
+            return
+        for p in pendings:
+            if p.query.fault_iter is None:
+                self.store.release(p.query.key())
+
+    def _fleet_await(self, group, waiters) -> None:
+        """Block-or-poll for claim losers (ISSUE 15): each waiter's
+        fingerprint is being solved by a PEER process — poll the shared
+        disk for its publish (served as an exact hit, bit-identical to
+        the winner's solve by the atomic-publish + checksum chain).  A
+        lease that disappears without a publish (the winner's solve
+        failed, or crashed and was TTL-reclaimed) re-enqueues the waiter
+        for the next flush, where the claim gate re-runs the election —
+        this process may win it and solve.  Polls the preemption flag
+        (typed ``Interrupted`` at this seam, the PR 3 protocol) and each
+        waiter's deadline; real-time polling, because the peer is
+        another process no injected clock reaches."""
+        from ..utils.timing import Stopwatch
+
+        scenario_name, _, _ = group
+        scn = _scenario_of(scenario_name)
+        pending = list(waiters)
+        budget_s = 5.0 * self.store.lease_ttl_s + 30.0
+        watch = Stopwatch()
+        while pending:
+            if interrupt_requested():
+                self._obs.event("INTERRUPTED",
+                                what="fleet publish wait",
+                                waiters=len(pending))
+                exc = Interrupted(
+                    "equilibrium service interrupted while awaiting "
+                    "peer publishes; waiting queries failed at the "
+                    "fleet seam")
+                self._fail_futures(pending, exc)
+                raise exc
+            now = self._clock()
+            still = []
+            for p in pending:
+                key = p.query.key()
+                if p.deadline is not None and now >= p.deadline:
+                    if not p.future.done():
+                        p.future.set_exception(DeadlineExceeded(
+                            p.query.cell(), key, now - p.t_submit))
+                    self.metrics.record_expired(now - p.t_submit)
+                    self._obs.event("DEADLINE_EXCEEDED",
+                                    cell=p.query.cell(),
+                                    scenario=p.query.scenario,
+                                    key=key, waited_s=now - p.t_submit,
+                                    where="fleet_await")
+                    continue
+                sol = self.store.get(key,
+                                     schema_ck=scn.schema.checksum())
+                if sol is not None:
+                    self._serve_stored(p, sol, scn, remote=True)
+                    continue
+                if (not self.store.lease_present(key)
+                        or self.store.reclaim_if_stale(key)):
+                    # winner abandoned (failure) or crashed (stale):
+                    # take over — the next flush re-runs the election
+                    try:
+                        self.batcher.offer(
+                            (p.query.scenario, p.query.dtype,
+                             p.query.kwargs), p, block=False)
+                    except ServeQueueFull:
+                        if not p.future.done():
+                            p.future.set_exception(ServeError(
+                                "fleet re-election found the queue "
+                                "full; retry the query"))
+                        self.metrics.record_failure(now - p.t_submit)
+                    continue
+                still.append(p)
+            pending = still
+            if pending:
+                if watch.elapsed() > budget_s:
+                    # backstop against a pathological lease ping-pong:
+                    # fail typed rather than wedge the worker thread
+                    exc = ServeError(
+                        f"fleet publish wait exceeded {budget_s:.0f}s; "
+                        "retry the query")
+                    self._fail_futures(pending, exc)
+                    return
+                time.sleep(self._fleet_poll_s)
+
     def _launch(self, group, pendings) -> None:
         # the batch worker is a different thread from whichever run
         # built the obs bundle, and the active-scope stack is
@@ -938,20 +1236,46 @@ class EquilibriumService:
         # journal into THIS service's run, not the worker thread's
         # (empty) scope
         with self._obs.activate():
-            self._launch_impl(group, pendings)
+            pendings = self._expire_due(pendings)
+            if not pendings:
+                return
+            waiters, dups = [], {}
+            if self._fleet:
+                # fleet claim gate (ISSUE 15): re-probe the shared disk
+                # (a peer may have published since submit), elect one
+                # solver per distinct fingerprint, and split the batch
+                # into claim winners (solve here), in-batch duplicates
+                # (ride the winner's lane), and claim losers (poll for
+                # the peer's publish after the launch)
+                pendings, waiters, dups = self._fleet_gate(group,
+                                                           pendings)
+            try:
+                if pendings:
+                    self._launch_impl(group, pendings, dups)
+            except BaseException as e:
+                # only Interrupted escapes _launch_impl (every other
+                # failure is scattered onto the batch's own futures):
+                # the waiters must fail typed too before the seam
+                # protocol unwinds, or their callers hang
+                self._fail_futures(waiters, e)
+                raise
+            if waiters:
+                self._fleet_await(group, waiters)
 
-    def _launch_impl(self, group, pendings) -> None:
-        """Solve one flushed batch: expire overdue deadlines, plan seeds,
-        pad to the ladder shape, launch the shared executable, certify
-        (``certify_before_cache``), scatter rows to futures.  Any
-        launch-level failure fails this batch's futures (typed), never
-        the service; ``Interrupted`` re-raises after failing them so the
-        worker can drain."""
+    def _launch_impl(self, group, pendings, dups=None) -> None:
+        """Solve one flushed batch: plan seeds, pad to the ladder shape,
+        launch the shared executable, certify
+        (``certify_before_cache``), scatter rows to futures (deadline
+        expiry and the fleet claim gate already ran in ``_launch``).
+        ``dups`` maps a pending's id to same-fingerprint batchmates that
+        ride its lane (fleet dedup).  Any launch-level failure fails
+        this batch's futures (typed), never the service; ``Interrupted``
+        re-raises after failing them so the worker can drain."""
         import jax.numpy as jnp
 
-        pendings = self._expire_due(pendings)
         if not pendings:
             return
+        dups = dups if dups is not None else {}
         scenario_name, dtype, kwargs_items = group
         scn = _scenario_of(scenario_name)
         schema = scn.schema
@@ -1040,6 +1364,9 @@ class EquilibriumService:
                              .sum())},
                         prefix="serve/phase/")
         except BaseException as e:
+            self._fleet_release_claims(pendings)
+            pendings = pendings + [d for ps in dups.values()
+                                   for d in ps]
             self._abort_probes(pendings)
             for p in pendings:
                 if not p.future.done():
@@ -1114,6 +1441,9 @@ class EquilibriumService:
                     # there fails THIS batch's futures typed — it must
                     # never escape _launch and kill the worker with the
                     # futures stranded unresolved
+                    self._fleet_release_claims(pendings)
+                    pendings = pendings + [d for ps in dups.values()
+                                           for d in ps]
                     self._abort_probes(pendings)
                     for p in pendings:
                         if not p.future.done():
@@ -1132,11 +1462,19 @@ class EquilibriumService:
             row = rows[i]
             status = int(np.rint(row[status_col]))
             seed, path = plans[i]
+            lane_dups = dups.get(id(p), ())
             if is_failure(status):
-                self._breaker_note(p, ok=False, now=now)
-                p.future.set_exception(EquilibriumSolveFailed(
-                    p.query.cell(), status, p.query.key()))
-                self.metrics.record_failure(now - p.t_submit)
+                # a failed solve abandons the fleet claim (failures are
+                # never cached/published): the fingerprint becomes
+                # claimable again, and remote waiters re-elect
+                if self._fleet and p.query.fault_iter is None:
+                    self.store.release(p.query.key())
+                exc = EquilibriumSolveFailed(
+                    p.query.cell(), status, p.query.key())
+                for pp in (p,) + tuple(lane_dups):
+                    self._breaker_note(pp, ok=False, now=now)
+                    pp.future.set_exception(exc)
+                    self.metrics.record_failure(now - pp.t_submit)
                 self._obs.event("SOLVER_DIVERGED",
                                 cell=p.query.cell(),
                                 scenario=scn.name,
@@ -1147,10 +1485,14 @@ class EquilibriumService:
             if cert is not None:
                 self.metrics.record_certificate(cert.level)
                 if cert.failed:
-                    self._breaker_note(p, ok=False, now=now)
-                    p.future.set_exception(CertificationFailed(
-                        p.query.cell(), p.query.key(), cert))
-                    self.metrics.record_failure(now - p.t_submit)
+                    if self._fleet and p.query.fault_iter is None:
+                        self.store.release(p.query.key())
+                    exc = CertificationFailed(
+                        p.query.cell(), p.query.key(), cert)
+                    for pp in (p,) + tuple(lane_dups):
+                        self._breaker_note(pp, ok=False, now=now)
+                        pp.future.set_exception(exc)
+                        self.metrics.record_failure(now - pp.t_submit)
                     self._obs.event("CERT_FAILED",
                                     cell=p.query.cell(),
                                     scenario=scn.name,
@@ -1164,16 +1506,29 @@ class EquilibriumService:
                                    p.query.key(), cert_level=lvl,
                                    scenario=scn.name)
             if p.query.fault_iter is None:
-                self.store.put(make_solution(
+                entry = make_solution(
                     p.query.cell(), row, p.query.group(), p.query.key(),
                     cert_level=UNCERTIFIED if lvl is None else lvl,
-                    schema=schema))
-            p.future.set_result(res)
-            self.metrics.record_served(path, now - p.t_submit,
-                                       scenario=scn.name)
-            self._obs.record_span("serve/query", now - p.t_submit,
-                                  path=path, cell=p.query.cell(),
-                                  scenario=scn.name)
+                    schema=schema)
+                if self._fleet:
+                    # exactly-once completion: atomic publish + lease
+                    # release (journaled FLEET_PUBLISH) — remote waiters
+                    # polling this fingerprint serve these bits
+                    self.store.publish(
+                        entry, speculative=(p.query.priority
+                                            == Priority.SPECULATIVE),
+                        seed=seed)
+                else:
+                    self.store.put(entry)
+                if p.query.priority == Priority.SPECULATIVE:
+                    self._note_prefetch_stored(p.query.key())
+            for pp in (p,) + tuple(lane_dups):
+                pp.future.set_result(res)
+                self.metrics.record_served(path, now - pp.t_submit,
+                                           scenario=scn.name)
+                self._obs.record_span("serve/query", now - pp.t_submit,
+                                      path=path, cell=pp.query.cell(),
+                                      scenario=scn.name)
             self.metrics.record_phases(res.descent_steps, res.polish_steps,
                                        res.precision_escalations)
 
@@ -1320,6 +1675,14 @@ class EquilibriumService:
         # belt-and-braces: nothing can be queued past the gate-serialized
         # close, but a stray entry must fail typed, never hang
         self._fail_pending(ServiceClosed("service closed"))
+        # fleet hygiene: a CLEAN close returns any stray held leases (a
+        # batch that errored between claim and release).  An INTERRUPTED
+        # close deliberately does not — the preemption path must not add
+        # disk I/O between the signal and exit, and the lease TTL is the
+        # designed reclaim for a worker that stopped mid-claim
+        if self._fleet and not interrupt_requested():
+            for key in self.store.held_leases():
+                self.store.release(key)
         # observability run-end (ISSUE 7): mirror the metrics snapshot
         # into the registry, then flush trace/journal iff this service
         # owns the bundle (an ObsConfig was passed; a shared Obs belongs

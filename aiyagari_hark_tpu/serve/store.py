@@ -25,17 +25,40 @@ Failed solutions (``solver_health.is_failure``) are never stored — a
 quarantine-grade status must not become a cache hit, and a NaN root must
 never be nominated as a donor (the sidecar's NaN-row rule).
 
-Integrity (ISSUE 6, DESIGN §9): every entry carries a solve-time
-``packed_row_checksum`` verified on EVERY read — memory-tier hits
-included (hashing 80 bytes costs ~a microsecond against the sub-ms hit
-budget) — and a ``cert_level`` (``verify`` certificate verdict;
-``UNCERTIFIED`` when certification was off).  An entry failing
-verification is EVICTED: dropped from both tiers, its disk file deleted
-(a corrupt file left in place would re-degrade every restart), the
-eviction counted (``integrity_counts`` → ``ServeMetrics``
+Integrity (ISSUE 6, DESIGN §9; residency memoization ISSUE 15): every
+entry carries a solve-time ``packed_row_checksum`` verified at every
+TIER BOUNDARY — on disk load, and ONCE per in-memory residency (the
+first ``get`` after an insert).  Re-hashing on every memory hit (the
+PR 6 rule) re-verified bytes that had not crossed any boundary since
+the last verification and put a ~µs hash on the hot path's critical
+microseconds; the memoized rule keeps the corrupt-eviction semantics at
+every boundary a bit can actually go wrong across (disk write/read,
+promotion, restart) and accepts that a bit flipped INSIDE a verified
+resident Python object is out of the threat model (pinned by the
+mutate-after-residency test in ``tests/test_fleet.py`` — disk-tier
+corruption is still caught and evicted).  An entry failing verification
+is EVICTED: dropped from both tiers, its disk file deleted (a corrupt
+file left in place would re-degrade every restart), the eviction
+counted (``integrity_counts`` → ``ServeMetrics``
 ``store_corrupt_evictions``) and logged once with the entry key.  The
-store never serves bytes it cannot verify — a miss and a re-solve is the
-degrade."""
+store never serves bytes it cannot verify — a miss and a re-solve is
+the degrade.
+
+Fleet tier (ISSUE 15, DESIGN §14): ``shared=True`` makes the disk tier
+safe for N CONCURRENT WORKER PROCESSES over one directory.  Entry
+publication was already atomic (``save_pytree`` = tmp + ``os.replace``;
+readers see the old bytes or the new bytes, never a hybrid, and the
+checksum chain verifies whichever they got); what sharing adds is
+**exactly-once election**: a ``lease_<hex>.lease`` claim file per
+solution fingerprint (``utils.checkpoint.acquire_lease``,
+O_CREAT|O_EXCL — one process wins the create) so N workers racing the
+same cold miss solve it once fleet-wide, the losers blocking-or-polling
+on the winner's publish.  A crashed winner cannot wedge its
+fingerprint: leases older than ``lease_ttl_s`` are BROKEN by any
+claimant (``FLEET_LEASE_RECLAIM`` journaled) and the reclaimer solves.
+``get`` under ``shared`` additionally probes the disk directory for
+keys the in-memory index has never seen — a peer's publish after this
+process's index load must become servable without a restart."""
 
 from __future__ import annotations
 
@@ -50,8 +73,17 @@ import numpy as np
 
 from ..obs.runtime import NULL_OBS, active_obs
 from ..solver_health import is_failure
-from ..utils.checkpoint import CORRUPT_NPZ_ERRORS, load_pytree, save_pytree
-from ..utils.fingerprint import packed_row_checksum
+from ..utils.checkpoint import (
+    CORRUPT_NPZ_ERRORS,
+    LEASE_SUFFIX,
+    acquire_lease,
+    break_stale_lease,
+    lease_age_s,
+    load_pytree,
+    release_lease,
+    save_pytree,
+)
+from ..utils.fingerprint import fingerprint_hex, packed_row_checksum
 
 # verify.certificate.UNCERTIFIED, inlined to keep this module's imports
 # host-cheap (the certificate module is imported lazily by the service);
@@ -160,11 +192,36 @@ class SolutionStore:
 
     def __init__(self, capacity: int = 256,
                  disk_path: Optional[str] = None,
-                 donor_cutoff: float = float("inf"), obs=None):
+                 donor_cutoff: float = float("inf"), obs=None,
+                 shared: bool = False, lease_ttl_s: float = 30.0,
+                 owner: str = ""):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if shared and disk_path is None:
+            raise ValueError(
+                "SolutionStore(shared=True) requires a disk_path: the "
+                "shared tier IS the disk directory")
         self.capacity = int(capacity)
         self.disk_path = disk_path
+        # fleet tier (ISSUE 15): shared enables the claim/lease protocol
+        # and the unknown-key disk probe; lease_ttl_s is the stale-lease
+        # reclaim horizon; owner is a diagnostic worker id stamped into
+        # lease payloads (election correctness never reads it)
+        self.shared = bool(shared)
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.owner = str(owner)
+        self._held: set = set()          # keys whose lease WE hold
+        self._published_keys: list = []  # keys this store published
+        self._fleet = {"fleet_claims_won": 0, "fleet_claims_lost": 0,
+                       "fleet_publishes": 0, "fleet_lease_reclaims": 0}
+        # lease HEARTBEAT (ISSUE 15): a lease's mtime is refreshed every
+        # ttl/4 while its owner lives, so staleness means "the owner
+        # stopped beating" (crashed/killed), never "the solve is slower
+        # than the TTL" — without it, a first cold solve's compile wall
+        # outlives a short TTL and a LIVE winner gets its claim stolen
+        # (a measured double-solve, dedup ratio 1.5, in this PR's drill
+        # trials).  The daemon thread runs only while leases are held.
+        self._hb_thread = None
         # normalized-distance radius beyond which nominate() declines: a
         # donor across the whole lattice proposes a junk target (safe —
         # in-program verification falls back to cold — but an honest
@@ -174,6 +231,11 @@ class SolutionStore:
         self._lock = threading.RLock()
         self._mem: OrderedDict = OrderedDict()   # key -> StoredSolution
         self._meta: dict = {}                    # key -> _Meta
+        # keys whose CURRENT in-memory residency has been checksum-
+        # verified (ISSUE 15 satellite): membership is dropped whenever
+        # the memory copy changes hands (insert, promote, evict), so
+        # every residency is verified exactly once — on its first get
+        self._verified_mem: set = set()
         self._corrupt_evictions = 0
         # Eviction "log once" state is PER STORE INSTANCE (ISSUE 7
         # satellite): the old pattern leaned on the warnings module's
@@ -192,10 +254,14 @@ class SolutionStore:
     # -- tiers --------------------------------------------------------------
 
     def _file(self, key: int) -> str:
-        # keys are signed int64; hex-encode the two's-complement bits so
-        # the filename is stable and glob-able
+        # keys are signed int64; the shared hex spelling
+        # (``fingerprint_hex``) keeps entry and lease filenames agreeing
         return os.path.join(self.disk_path,
-                            f"sol_{int(key) & 0xFFFFFFFFFFFFFFFF:016x}.npz")
+                            f"sol_{fingerprint_hex(key)}.npz")
+
+    def _lease_file(self, key: int) -> str:
+        return os.path.join(self.disk_path,
+                            f"lease_{fingerprint_hex(key)}{LEASE_SUFFIX}")
 
     def attach_obs(self, obs) -> None:
         """Adopt a service's observability bundle (ISSUE 7) so eviction
@@ -247,6 +313,7 @@ class SolutionStore:
         if key is not None:
             self._mem.pop(int(key), None)
             self._meta.pop(int(key), None)
+            self._verified_mem.discard(int(key))
         self._record_eviction(reason, "disk", path, key=key)
         try:
             os.remove(path)
@@ -290,12 +357,15 @@ class SolutionStore:
     def get(self, key: int,
             schema_ck: Optional[int] = None) -> Optional[StoredSolution]:
         """Exact lookup; promotes to most-recently-used.  A disk-resident
-        entry is loaded and promoted into memory (evicting LRU).  EVERY
-        return path re-verifies the entry's content checksum — a
-        memory-tier bit flip is as silent as a disk one — and a failed
-        verification evicts the entry (both tiers + disk file) and
-        reports a miss, so the caller re-solves instead of serving
-        corruption.
+        entry is loaded and promoted into memory (evicting LRU).  Every
+        TIER BOUNDARY re-verifies the entry's content checksum — disk
+        load, and once per in-memory residency on its first get (the
+        memoized rule, ISSUE 15 satellite; module docstring for the
+        threat model) — and a failed verification evicts the entry (both
+        tiers + disk file) and reports a miss, so the caller re-solves
+        instead of serving corruption.  Under ``shared`` a key unknown
+        to the index additionally probes the disk directory: a peer
+        worker's publish becomes servable without a restart.
 
         ``schema_ck`` (ISSUE 9): the querying scenario's
         ``RowSchema.checksum()``.  An entry stored under a DIFFERENT row
@@ -308,6 +378,7 @@ class SolutionStore:
                     and int(sol.schema_ck) != int(schema_ck)):
                 self._mem.pop(key, None)
                 self._meta.pop(key, None)
+                self._verified_mem.discard(key)
                 self._record_eviction("stale row schema", "memory", "",
                                       key=key, stacklevel=3)
                 if self.disk_path is not None:
@@ -317,8 +388,10 @@ class SolutionStore:
                         pass
                 return None
             if sol is not None:
-                if not self._verified(sol):
-                    # in-RAM corruption: drop ONLY the memory copy — the
+                if (key not in self._verified_mem
+                        and not self._verified(sol)):
+                    # in-RAM corruption caught at the residency's first
+                    # verification: drop ONLY the memory copy — the
                     # disk entry is a separate byte store written
                     # atomically with its own verification on load, very
                     # plausibly still healthy; destroying it would turn
@@ -341,11 +414,19 @@ class SolutionStore:
                         self._meta.pop(key, None)
                         return None
                 else:
+                    self._verified_mem.add(key)
                     self._mem.move_to_end(key)
                     return sol
             meta = self._meta.get(key)
             if meta is None or not meta.on_disk:
-                return None
+                # shared tier (ISSUE 15): the index was built at startup
+                # (plus our own puts) — a PEER process may have
+                # published this key since.  One existence probe per
+                # miss keeps cross-process publication visible; the
+                # load below verifies the bytes like any disk read.
+                if not (self.shared and meta is None
+                        and os.path.exists(self._file(key))):
+                    return None
             path = self._file(key)
             try:
                 sol = load_pytree(path, _template())
@@ -359,7 +440,17 @@ class SolutionStore:
             if not self._verified(sol):
                 self._evict_corrupt(path, "checksum mismatch", key=key)
                 return None
+            # a verified disk load begins a verified residency; a
+            # probe-discovered peer publish also earns an index row so
+            # donor nomination sees it from now on
+            self._meta[key] = _Meta(
+                cell=tuple(np.asarray(sol.cell, dtype=np.float64)),
+                group=int(sol.group),
+                r_star=float(sol.root), on_disk=True,
+                cert_level=int(sol.cert_level),
+                schema_ck=int(sol.schema_ck))
             self._insert(key, sol)
+            self._verified_mem.add(key)
             return sol
 
     def put(self, sol: StoredSolution) -> None:
@@ -391,11 +482,204 @@ class SolutionStore:
                 schema_ck=int(sol.schema_ck))
             self._insert(key, sol)
 
+    # -- fleet claim / publish (ISSUE 15, DESIGN §14) -----------------------
+
+    def _require_shared(self, what: str) -> None:
+        if not self.shared:
+            raise ValueError(
+                f"{what} requires SolutionStore(shared=True): the "
+                "claim/lease protocol only exists on the shared tier")
+
+    def claim(self, key: int) -> str:
+        """Elect a solver for ``key`` fleet-wide.  Returns:
+
+        * ``"published"`` — the entry already exists on disk (serve it;
+          no solve needed);
+        * ``"won"`` — THIS store now holds the key's lease: the caller
+          must solve and then ``publish`` (success) or ``release``
+          (failure/abandon), or let the TTL reclaim it (crash);
+        * ``"lost"`` — a live peer holds the lease: block-or-poll for
+          its publish (``get`` probes the disk) or for the lease to go
+          stale.
+
+        A lease older than ``lease_ttl_s`` is broken here (journaled
+        ``FLEET_LEASE_RECLAIM``) and the claim re-runs — a crashed
+        winner never wedges its fingerprint."""
+        self._require_shared("claim")
+        key = int(key)
+        lease = self._lease_file(key)
+        for _ in range(2):      # once, plus once after a stale break
+            if os.path.exists(self._file(key)):
+                return "published"
+            if acquire_lease(lease, owner=self.owner):
+                with self._lock:
+                    self._held.add(key)
+                    self._fleet["fleet_claims_won"] += 1
+                    self._ensure_heartbeat_locked()
+                self._obs_scope().event("FLEET_CLAIM", key=key,
+                                        owner=self.owner)
+                # the entry may have been published between the
+                # existence probe and the create: the winner must not
+                # re-solve what the fleet already has
+                if os.path.exists(self._file(key)):
+                    self.release(key)
+                    return "published"
+                return "won"
+            if break_stale_lease(lease, self.lease_ttl_s):
+                with self._lock:
+                    self._fleet["fleet_lease_reclaims"] += 1
+                self._obs_scope().event("FLEET_LEASE_RECLAIM", key=key,
+                                        owner=self.owner)
+                continue
+            break
+        with self._lock:
+            self._fleet["fleet_claims_lost"] += 1
+        return "lost"
+
+    def publish(self, sol: StoredSolution, speculative: bool = False,
+                seed=None) -> None:
+        """Winner's completion: ``put`` (atomic disk write included) then
+        release the key's lease, journaled ``FLEET_PUBLISH``.
+        ``speculative`` tags a prefetch-driven solve (the fleet load
+        harness attributes prefetch conversions from this attr);
+        ``seed`` is the solving lane's exact bracket seed ``(lo, hi,
+        levels)`` — journaled bit-exactly so the fleet bit-identity
+        acceptance can replay ANY published solve through a same-seed
+        ``reference_solve``, including solves whose response no client
+        ever saw (prefetch, a drilled worker's in-flight reply)."""
+        self._require_shared("publish")
+        key = int(sol.key)
+        self.put(sol)
+        with self._lock:
+            self._fleet["fleet_publishes"] += 1
+            self._published_keys.append(key)
+        self._obs_scope().event(
+            "FLEET_PUBLISH", key=key, owner=self.owner,
+            speculative=bool(speculative),
+            seed=(None if seed is None else
+                  [float(seed[0]), float(seed[1]), int(seed[2])]))
+        self.release(key)
+
+    def release(self, key: int) -> None:
+        """Give up a held lease WITHOUT publishing (failed solve, cert
+        failure, abandoned batch): the fingerprint becomes claimable
+        again immediately.  Idempotent; a no-op for leases this store
+        never held."""
+        key = int(key)
+        with self._lock:
+            held = key in self._held
+            self._held.discard(key)
+        if held:
+            release_lease(self._lease_file(key))
+
+    def _ensure_heartbeat_locked(self) -> None:
+        """Start the lease-heartbeat daemon if it is not running
+        (``_lock`` held).  It exits on its own once nothing is held, so
+        a store that stops claiming stops threading."""
+        if self._hb_thread is not None and self._hb_thread.is_alive():
+            return
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name="lease-heartbeat",
+            daemon=True)
+        self._hb_thread.start()
+
+    def _heartbeat_loop(self) -> None:
+        import time
+
+        interval = max(0.05, self.lease_ttl_s / 4.0)
+        while True:
+            time.sleep(interval)
+            with self._lock:
+                held = list(self._held)
+                if not held:
+                    self._hb_thread = None
+                    return
+            for key in held:
+                try:
+                    os.utime(self._lease_file(key))
+                except OSError:
+                    pass    # released/reclaimed concurrently
+
+    def lease_present(self, key: int) -> bool:
+        self._require_shared("lease_present")
+        return os.path.exists(self._lease_file(int(key)))
+
+    def lease_stale(self, key: int) -> bool:
+        """True iff the key's lease exists and is past the TTL."""
+        self._require_shared("lease_stale")
+        age = lease_age_s(self._lease_file(int(key)))
+        return age is not None and age > self.lease_ttl_s
+
+    def reclaim_if_stale(self, key: int) -> bool:
+        """Break one stale lease (TTL reclaim outside the claim loop —
+        the waiter path); True iff this call removed it."""
+        self._require_shared("reclaim_if_stale")
+        key = int(key)
+        if break_stale_lease(self._lease_file(key), self.lease_ttl_s):
+            with self._lock:
+                self._fleet["fleet_lease_reclaims"] += 1
+            self._obs_scope().event("FLEET_LEASE_RECLAIM", key=key,
+                                    owner=self.owner)
+            return True
+        return False
+
+    def held_leases(self) -> list:
+        """Keys whose lease THIS store instance currently holds."""
+        with self._lock:
+            return sorted(self._held)
+
+    def lease_files(self) -> list:
+        """Every lease file present in the shared directory (all owners)
+        — the leak audit."""
+        self._require_shared("lease_files")
+        return sorted(glob.glob(os.path.join(
+            self.disk_path, f"lease_*{LEASE_SUFFIX}")))
+
+    def gc_stale_leases(self) -> int:
+        """Sweep every stale lease in the directory (end-of-run leak
+        reclaim; counts + journals each).  Returns how many were
+        removed."""
+        self._require_shared("gc_stale_leases")
+        removed = 0
+        for path in self.lease_files():
+            if break_stale_lease(path, self.lease_ttl_s):
+                removed += 1
+                with self._lock:
+                    self._fleet["fleet_lease_reclaims"] += 1
+                self._obs_scope().event(
+                    "FLEET_LEASE_RECLAIM", key=None, owner=self.owner,
+                    file=os.path.basename(path))
+        return removed
+
+    def contains(self, key: int) -> bool:
+        """Key addressable without loading it: indexed in either tier,
+        or (shared) published on disk by a peer."""
+        key = int(key)
+        with self._lock:
+            if key in self._meta:
+                return True
+        return self.shared and os.path.exists(self._file(key))
+
+    def published_keys(self) -> list:
+        """Keys THIS store published (fleet dedup accounting)."""
+        with self._lock:
+            return list(self._published_keys)
+
+    def fleet_counts(self) -> dict:
+        """Fleet protocol counters (``ServeMetrics`` merge)."""
+        with self._lock:
+            return dict(self._fleet)
+
     def _insert(self, key: int, sol: StoredSolution) -> None:
+        # a (re)insert starts a FRESH residency: verification membership
+        # is per-residency, so the new bytes verify on their first get
+        # unless the caller (a just-verified disk load) marks them
+        self._verified_mem.discard(key)
         self._mem[key] = sol
         self._mem.move_to_end(key)
         while len(self._mem) > self.capacity:
             old_key, _ = self._mem.popitem(last=False)
+            self._verified_mem.discard(old_key)
             meta = self._meta.get(old_key)
             if meta is not None and not meta.on_disk:
                 # memory-only tier: eviction forgets the entry entirely

@@ -127,6 +127,95 @@ def append_jsonl(path: str, lines) -> None:
         os.close(fd)
 
 
+# -- fleet leases (ISSUE 15, DESIGN §14) ------------------------------------
+#
+# The shared-store fleet tier needs one more write primitive beyond the
+# replace/append family: EXCLUSIVE CREATION.  A claim file per solution
+# fingerprint is how N worker processes racing the same cold miss elect
+# exactly one solver: ``os.open(O_CREAT | O_EXCL)`` is atomic on POSIX —
+# precisely one process wins the create, every other raises
+# ``FileExistsError`` — and the winner's single ``os.write`` of a short
+# owner payload cannot tear across the visibility boundary (losers key
+# off the file's EXISTENCE, which the O_EXCL create made atomic; the
+# payload is diagnostic).  Staleness is judged by the file's mtime (the
+# one timestamp a crashed owner cannot fail to have written), honest for
+# the single-host-N-process scope the fleet tier targets.
+
+LEASE_SUFFIX = ".lease"
+
+
+def acquire_lease(path: str, owner: str = "") -> bool:
+    """Try to create the lease file at ``path`` exclusively.  Returns
+    True iff THIS caller created it (and now owns the lease); False when
+    it already exists (someone else holds it).  Never blocks."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    try:
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
+    except FileExistsError:
+        return False
+    try:
+        import json
+
+        os.write(fd, (json.dumps({"owner": str(owner)}) + "\n").encode())
+    finally:
+        os.close(fd)
+    return True
+
+
+def read_lease(path: str):
+    """The lease's owner payload (``{"owner": ...}``) or None when the
+    file is missing; an unreadable/torn payload reads as ``{"owner":
+    None}`` — the lease still EXISTS (existence is the contract, the
+    payload is diagnostic)."""
+    import json
+
+    try:
+        with open(path, "rb") as f:
+            return json.loads(f.read().decode("utf-8"))
+    except FileNotFoundError:
+        return None
+    except (ValueError, OSError, UnicodeDecodeError):
+        return {"owner": None}
+
+
+def lease_age_s(path: str, now=None):
+    """Seconds since the lease file was created (mtime), or None when it
+    is missing.  Wall-clock (``time.time``): leases coordinate
+    PROCESSES, which share the host's wall clock — the injectable
+    monotonic clocks the serving layer uses elsewhere do not cross a
+    fork."""
+    import time
+
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return None
+    return (time.time() if now is None else float(now)) - mtime
+
+
+def release_lease(path: str) -> bool:
+    """Remove the lease file; True iff this call removed it (False when
+    already gone — release is idempotent)."""
+    try:
+        os.remove(path)
+        return True
+    except FileNotFoundError:
+        return False
+
+
+def break_stale_lease(path: str, ttl_s: float, now=None) -> bool:
+    """Reclaim a lease whose age exceeds ``ttl_s`` (a crashed owner must
+    not wedge its fingerprint forever): remove-if-stale, True iff this
+    call removed it.  A concurrent remove (another reclaimer, or the
+    owner's own release racing the reclaim) reads as False — the caller
+    re-runs its acquire either way, so double reclaim is harmless."""
+    age = lease_age_s(path, now=now)
+    if age is None or age <= float(ttl_s):
+        return False
+    return release_lease(path)
+
+
 def read_jsonl_tolerant(path: str) -> tuple:
     """Read a JSONL stream back as ``(records, skipped)``, skipping
     unparseable lines instead of raising — the reader half of

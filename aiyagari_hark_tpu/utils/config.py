@@ -598,6 +598,15 @@ class AdmissionPolicy:
     * ``est_batch_s`` — fixed modeled batch latency for the wait
       estimate; ``None`` uses a measured EWMA (the load harness pins
       this so admission decisions replay bit-identically).
+    * ``work_unit_s`` — modeled seconds of batch wall per predicted-work
+      unit, the EWMA's COLD-START seed (ISSUE 15 satellite): before the
+      first batch ever flushes there is no measured latency, so the
+      first ``Overloaded.est_wait_s`` used to collapse to the batcher's
+      ``max_wait_s`` (milliseconds against a multi-second solve — a
+      degenerate retry-after).  The first admission-checked submit seeds
+      the EWMA with its own ``heuristic_cell_work`` predicted wall
+      (``weight * work_unit_s``), which the first measured flush then
+      starts correcting.
 
     Degraded answers (PAPERS 2002.09108 — consumption functions are
     asymptotically linear, so a near neighbor is a principled brown-out
@@ -629,6 +638,7 @@ class AdmissionPolicy:
     shed: bool = True
     deadline_aware: bool = True
     est_batch_s: Optional[float] = None
+    work_unit_s: float = 0.25
     degraded_pressure: float = 0.7
     degraded_distance: float = 0.25
     degraded_require_certified: bool = False

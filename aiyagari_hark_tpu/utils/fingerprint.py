@@ -103,6 +103,16 @@ def verify_packed_row(row, expected: int, boundary: str,
             boundary=boundary, key=key)
 
 
+def fingerprint_hex(key: int) -> str:
+    """Canonical filename spelling of a signed int64 fingerprint: the
+    two's-complement bits, zero-padded hex — stable, glob-able, and
+    shared by every artifact named after a fingerprint (the store's
+    ``sol_<hex>.npz`` entries and the fleet tier's ``lease_<hex>.lease``
+    claim files MUST agree on the spelling, or a claim guards the wrong
+    entry)."""
+    return f"{int(key) & 0xFFFFFFFFFFFFFFFF:016x}"
+
+
 def config_fingerprint(*objs) -> int:
     """Deterministic int64 fingerprint of configs/arrays, used to detect
     state written under a different setup (stale-resume guard, cache

@@ -2513,6 +2513,163 @@ def _load_smoke() -> dict:
     return record
 
 
+# Fleet smoke (ISSUE 15): the 12-cell golden lattice with the shared
+# labor_sd spelled explicitly — the fleet workers' query cells.
+FLEET_SMOKE_CELLS = tuple((s, r, 0.2) for s in (1.0, 3.0, 5.0)
+                          for r in (0.0, 0.3, 0.6, 0.9))
+
+
+def _fleet_smoke() -> dict:
+    """The ``--fleet-smoke`` acceptance run (ISSUE 15, DESIGN §14): 4
+    worker PROCESSES over one shared disk store replay deterministic
+    per-worker-seeded Zipf mixes of the 12-cell golden lattice over
+    HTTP, with worker 3 SIGTERMed mid-load.  Measured acceptance:
+    fleet-wide dedup ratio 1.0 (each distinct cold fingerprint solved
+    exactly once across the fleet — the claim/lease election), served
+    values bit-identical to a single-process ``reference_solve`` (and
+    to each other: loser-serves-winner), speculative prefetch
+    converting >= 1 would-be cold miss into an exact hit, fleet p50/p99
+    per path in the ``fleet_*`` record graded by the regression
+    sentinel, and zero hung arrivals / leaked leases after the SIGTERM
+    (typed Interrupted journaled, exit 75, lease TTL reclaims)."""
+    import tempfile
+
+    import numpy as np
+
+    from aiyagari_hark_tpu.obs.regress import (
+        SEVERITY_NAMES,
+        evaluate_history,
+        load_bench_history,
+    )
+    from aiyagari_hark_tpu.serve import make_query
+    from aiyagari_hark_tpu.serve.loadgen import FleetSpec, run_fleet_load
+    from aiyagari_hark_tpu.serve.service import EquilibriumService
+
+    kw = dict(SERVE_SMOKE_KWARGS)
+    spec = FleetSpec(cells=FLEET_SMOKE_CELLS, model_kwargs=kw,
+                     n_workers=4, queries_per_worker=30,
+                     seed=20260804, zipf_s=0.8, prefetch_k=2,
+                     lease_ttl_s=2.0, warm_count=0,
+                     sigterm_worker=3, sigterm_after=10)
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory() as td:
+        rep = run_fleet_load(spec, store_dir=os.path.join(td, "store"))
+    wall = time.perf_counter() - t0
+
+    # bit-identity leg — the PR 4/11 contract, replayed through one
+    # local single-process service: a served result equals a batch-of-1
+    # reference_solve WITH THE SAME SEED, bit for bit.  The harness
+    # captured each solved fingerprint's ``bracket_init`` from the
+    # solving worker's response (the JSON hop is bit-exact: floats
+    # serialize via repr round-trip), so seeded keys compare on EVERY
+    # value field including the warm-seed-dependent capital; keys whose
+    # solving response was lost (a prefetch solve nobody queried before
+    # hitting, or the drilled worker's in-flight reply) compare on the
+    # seed-independent fields — r* (PR 2's verified-bracket contract
+    # pins the root bits warm or cold), labor, status.
+    ref_svc = EquilibriumService(start_worker=False, max_batch=4,
+                                 ladder=(1, 2, 4))
+    mismatches = 0
+    seeded = 0
+    for key, vals in sorted(rep.served_values.items()):
+        c = vals["cell"]
+        q = make_query(c[0], c[1], labor_sd=c[2], **kw)
+        seed = vals.get("bracket_init")
+        if seed is not None:
+            seeded += 1
+            ref = ref_svc.reference_solve(q, bracket_init=tuple(seed))
+            same = (vals["r_star"] == ref.r_star
+                    and vals["capital"] == ref.capital
+                    and vals["labor"] == ref.labor
+                    and vals["status"] == ref.status)
+        else:
+            ref = ref_svc.reference_solve(q)
+            same = (vals["r_star"] == ref.r_star
+                    and vals["labor"] == ref.labor
+                    and vals["status"] == ref.status)
+        if not same:
+            mismatches += 1
+    ref_svc.close()
+
+    served = sum(n for o, n in rep.counts.items()
+                 if o.startswith("served:"))
+    drill_rc = rep.interrupted_rcs.get(spec.sigterm_worker)
+    record = {
+        "metric": "fleet_smoke",
+        "backend": __import__("jax").default_backend(),
+        "fleet_workers": rep.workers,
+        "fleet_cells": len(FLEET_SMOKE_CELLS),
+        "fleet_requests": rep.arrivals,
+        "fleet_wall_s": round(wall, 3),
+        "fleet_trace_digest": rep.trace_digest,
+        "fleet_served": served,
+        "fleet_served_hit": rep.counts.get("served:hit", 0),
+        "fleet_served_near": rep.counts.get("served:near", 0),
+        "fleet_served_cold": rep.counts.get("served:cold", 0),
+        # acceptance: every arrival reached a terminal outcome
+        "fleet_unresolved": rep.unresolved,
+        # acceptance: exactly-once fleet-wide (claim/lease election)
+        "fleet_cold_solves": rep.cold_solves,
+        "fleet_distinct_fingerprints": rep.distinct_published,
+        "fleet_dedup_ratio": rep.dedup_ratio,
+        "fleet_dedup_exact": rep.dedup_ratio == 1.0,
+        # acceptance: served values == reference_solve, and every
+        # response for one fingerprint agreed (loser-serves-winner)
+        "fleet_bit_identical": (mismatches == 0
+                                and rep.value_divergence == 0),
+        "fleet_value_mismatches": mismatches,
+        "fleet_value_divergence": rep.value_divergence,
+        "fleet_seeded_compares": seeded,
+        # acceptance: prefetch converted >= 1 would-be cold miss
+        "fleet_prefetch_issued": rep.prefetch_issued,
+        "fleet_prefetch_converted": rep.prefetch_converted,
+        "fleet_remote_hits": rep.remote_hits,
+        "fleet_claims_won": rep.claims_won,
+        "fleet_claims_lost": rep.claims_lost,
+        "fleet_lease_reclaims": rep.lease_reclaims,
+        # acceptance: SIGTERM drill — typed Interrupted, exit 75, no
+        # leaked leases after the TTL sweep
+        "fleet_leases_leaked": rep.leases_leaked,
+        "fleet_drill_rc": drill_rc,
+        "fleet_drill_interrupted_typed": (drill_rc == 75
+                                          and rep.interrupted_journaled),
+        # fleet-wide latency per path (real wall, HTTP hop included)
+        "fleet_hit_p50_ms": rep.p50_ms.get("hit"),
+        "fleet_hit_p99_ms": rep.p99_ms.get("hit"),
+        "fleet_near_p50_ms": rep.p50_ms.get("near"),
+        "fleet_cold_p50_ms": rep.p50_ms.get("cold"),
+        "fleet_cold_p99_ms": rep.p99_ms.get("cold"),
+    }
+    history = load_bench_history(_repo_dir()) + [("fleet_smoke", record)]
+    report = evaluate_history(history)
+    fleet_regressed = [f.metric for f in report.regressed()
+                      if f.metric.startswith("fleet_")]
+    record["fleet_sentinel_clean"] = not fleet_regressed
+    record["fleet_sentinel_worst"] = SEVERITY_NAMES[report.worst]
+
+    print(f"[bench] fleet smoke: {rep.workers} workers, "
+          f"{rep.arrivals} arrivals -> {served} served "
+          f"(hit/near/cold {record['fleet_served_hit']}/"
+          f"{record['fleet_served_near']}/{record['fleet_served_cold']}),"
+          f" dedup {rep.dedup_ratio} ({rep.cold_solves} solves / "
+          f"{rep.distinct_published} fingerprints), bit-identical="
+          f"{'OK' if record['fleet_bit_identical'] else 'MISMATCH'}, "
+          f"prefetch {rep.prefetch_issued} issued / "
+          f"{rep.prefetch_converted} converted, hit p50 "
+          f"{record['fleet_hit_p50_ms']}ms, drill rc={drill_rc} "
+          f"journaled={rep.interrupted_journaled} "
+          f"leaked={rep.leases_leaked} unresolved={rep.unresolved}",
+          file=sys.stderr)
+    ok = (rep.dedup_ratio == 1.0 and record["fleet_bit_identical"]
+          and rep.prefetch_converted >= 1 and rep.unresolved == 0
+          and rep.leases_leaked == 0
+          and record["fleet_drill_interrupted_typed"])
+    if not ok:
+        print("[bench] fleet smoke: ACCEPTANCE FAILED — see the "
+              "fleet_* fields above", file=sys.stderr)
+    return record
+
+
 # Chips-scaling smoke (ISSUE 11): the multi-chip tentpole, measured — the
 # same balanced sweep dispatched through the shard_map launcher at mesh
 # sizes 1/2/4/8 ('cells' axis), on real chips when an accelerator answers
@@ -2699,7 +2856,13 @@ def main(argv=None):
     interpret-mode kernels on CPU, real Mosaic on TPU — all cells
     CERTIFIED within 0.1bp, reference path bit-identical, bf16-rung
     escalation drill, CostLedger roofline witness, sentinel-graded
-    ``kernel_*`` fields) and emits the ``kernel_*`` record."""
+    ``kernel_*`` fields) and emits the ``kernel_*`` record;
+    ``--fleet-smoke`` runs the fleet-serving acceptance (ISSUE 15: 4
+    worker processes over one shared disk store, per-worker-seeded Zipf
+    replay over HTTP, dedup ratio 1.0 via the claim/lease election,
+    served values bit-identical to ``reference_solve``, speculative
+    prefetch conversion, SIGTERM drill with typed ``Interrupted`` and
+    zero leaked leases) and emits the ``fleet_*`` record."""
     import argparse
 
     from aiyagari_hark_tpu.utils.resilience import (
@@ -2746,6 +2909,17 @@ def main(argv=None):
                          "shed/reject/degrade/breaker accounting, "
                          "journal consistency) and emit the load_* "
                          "record instead of the full bench")
+    ap.add_argument("--fleet-smoke", action="store_true",
+                    help="run the fleet-serving smoke (ISSUE 15: 4 "
+                         "worker processes over one shared disk store "
+                         "replay per-worker-seeded Zipf mixes of the "
+                         "12-cell golden lattice over HTTP — dedup "
+                         "ratio 1.0 via the claim/lease election, "
+                         "served values bit-identical to "
+                         "reference_solve, speculative prefetch "
+                         "conversion, SIGTERM drill with typed "
+                         "Interrupted and zero leaked leases) and emit "
+                         "the fleet_* record instead of the full bench")
     ap.add_argument("--chips-scaling", action="store_true",
                     help="run the multi-chip scaling smoke (ISSUE 11: "
                          "the balanced 24-cell sweep dispatched through "
@@ -2784,13 +2958,15 @@ def main(argv=None):
     if (args.serve_smoke or args.integrity_smoke or args.obs_smoke
             or args.load_smoke or args.scenario_smoke
             or args.profile_smoke or args.chips_scaling
-            or args.compaction_smoke or args.kernel_smoke):
+            or args.compaction_smoke or args.kernel_smoke
+            or args.fleet_smoke):
         from aiyagari_hark_tpu.utils.backend import (
             enable_compilation_cache,
         )
 
         enable_compilation_cache()
-        smoke = (_kernel_smoke if args.kernel_smoke
+        smoke = (_fleet_smoke if args.fleet_smoke
+                 else _kernel_smoke if args.kernel_smoke
                  else _compaction_smoke if args.compaction_smoke
                  else _chips_scaling if args.chips_scaling
                  else _profile_smoke if args.profile_smoke

@@ -64,6 +64,14 @@ _OPEN_W = re.compile(
 # np.savez/savez_compressed called on a PATH (a string/variable, not the
 # blessed writers' file-descriptor handle f).
 _SAVEZ = re.compile(r"\bnp\.savez(?:_compressed)?\s*\(\s*(?!f\b)")
+# Raw writable descriptors (ISSUE 15): ``os.open`` with a write/create
+# flag bypasses every blessed writer — exactly how an unblessed lease or
+# publish path would sneak in a non-crash-consistent write.  The blessed
+# spellings live in utils/checkpoint.py (``append_jsonl``'s O_APPEND
+# one-write-per-line, ``acquire_lease``'s O_CREAT|O_EXCL election);
+# anything else needs a ``# atomic-ok`` waiver stating why it is safe.
+_OS_OPEN_W = re.compile(
+    r"\bos\.open\s*\([^)]*\bO_(?:WRONLY|RDWR|CREAT|APPEND|TRUNC)\b")
 
 
 def scan_file(path: str, rel: str) -> list:
@@ -88,6 +96,13 @@ def scan_file(path: str, rel: str) -> list:
                      "np.savez to a path — use "
                      "utils.checkpoint.save_pytree (atomic), or waive "
                      "with '# atomic-ok'"))
+            elif _OS_OPEN_W.search(line):
+                findings.append(
+                    (rel, lineno,
+                     "raw writable os.open — use the blessed "
+                     "utils.checkpoint writers (append_jsonl, "
+                     "acquire_lease, atomic_write_*), or waive with "
+                     "'# atomic-ok'"))
     return findings
 
 

@@ -243,6 +243,40 @@ def test_check_atomic_writes_lint_catches_bare_append(tmp_path):
     assert [line for _, line, _ in findings] == [1, 3]
 
 
+def test_check_atomic_writes_lint_catches_raw_os_open(tmp_path):
+    """ISSUE 15 satellite: raw writable ``os.open`` descriptors joined
+    the ban — an unblessed lease/publish writer would bypass every
+    crash-consistency rule the blessed family encodes.  The blessed
+    spellings (``append_jsonl``'s O_APPEND, ``acquire_lease``'s
+    O_CREAT|O_EXCL) live in utils/checkpoint.py, which the lint
+    exempts wholesale."""
+    mod, _ = _load_lint()
+    bad = tmp_path / "leaser.py"
+    bad.write_text(
+        'fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL)\n'
+        'fd = os.open(p2, os.O_WRONLY | os.O_CREAT | os.O_APPEND, '
+        '0o644)\n'
+        'fd = os.open(p3, os.O_RDWR | os.O_TRUNC)\n'
+        'fd = os.open(p4, os.O_CREAT)  # atomic-ok\n'
+        # read-only descriptors must NOT fire
+        'fd = os.open(path, os.O_RDONLY)\n')
+    findings = mod.scan_file(str(bad), "leaser.py")
+    assert [line for _, line, _ in findings] == [1, 2, 3]
+
+
+def test_check_atomic_writes_covers_fleet_modules():
+    """ISSUE 15 satellite: the fleet tier's modules (lease/publish
+    writers, the HTTP worker) are inside the lint's scope — pinned
+    instead of trusted."""
+    mod, repo = _load_lint()
+    rels = {os.path.relpath(t, repo).replace(os.sep, "/")
+            for t in mod.scan_targets(repo)}
+    for required in ("aiyagari_hark_tpu/serve/fleet.py",
+                     "aiyagari_hark_tpu/serve/loadgen.py",
+                     "aiyagari_hark_tpu/serve/store.py"):
+        assert required in rels, required
+
+
 def test_check_atomic_writes_covers_timing_jsonl():
     """ISSUE 7 satellite: the bench/iteration JSONL writer module is in
     the lint's scope — pin it instead of trusting the walk."""

@@ -1,0 +1,520 @@
+"""Fleet serving tier (ISSUE 15): lease primitives, the store's
+claim/publish election, the service's fleet gate and waiter path,
+speculative neighbor prefetch, the admission EWMA cold-start seed, the
+HTTP front's transport contract, and the fleet_* regression directions.
+
+The two-PROCESS soak (racing writers over one disk tier) lives in
+``tests/test_fleet_store.py``; the end-to-end multi-worker replay with
+the SIGTERM drill is ``bench.py --fleet-smoke``.  This file pins the
+mechanisms deterministically and in-process."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.obs import ObsConfig
+from aiyagari_hark_tpu.obs.journal import read_journal
+from aiyagari_hark_tpu.scenarios.aiyagari import AIYAGARI_SCHEMA
+from aiyagari_hark_tpu.serve import (
+    AdmissionPolicy,
+    EquilibriumService,
+    FleetClient,
+    FleetFront,
+    FleetHTTPError,
+    Overloaded,
+    Priority,
+    make_query,
+)
+from aiyagari_hark_tpu.serve.store import SolutionStore, make_solution
+from aiyagari_hark_tpu.utils.checkpoint import (
+    acquire_lease,
+    break_stale_lease,
+    lease_age_s,
+    read_lease,
+    release_lease,
+)
+
+# the suite-shared tiny-cell configuration (compiled executables reused
+# across files)
+KW = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-4,
+          max_bisect=16)
+CELLS = [(s, r, 0.2) for s in (1.0, 3.0, 5.0)
+         for r in (0.0, 0.3, 0.6, 0.9)]
+
+
+def _row(seed: float = 0.01) -> np.ndarray:
+    """A healthy synthetic packed row in the Aiyagari schema layout."""
+    row = np.zeros(len(AIYAGARI_SCHEMA.fields))
+    row[AIYAGARI_SCHEMA.idx(AIYAGARI_SCHEMA.root)] = seed
+    return row
+
+
+def _store(tmp_path, name="s", **over) -> SolutionStore:
+    kw = dict(disk_path=str(tmp_path / "shared"), shared=True,
+              lease_ttl_s=5.0)
+    kw.update(over)
+    return SolutionStore(owner=name, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Lease primitives (utils.checkpoint).
+# ---------------------------------------------------------------------------
+
+def test_lease_exclusive_create_and_release(tmp_path):
+    path = str(tmp_path / "k.lease")
+    assert acquire_lease(path, owner="a")
+    assert not acquire_lease(path, owner="b")   # loser
+    assert read_lease(path) == {"owner": "a"}
+    assert lease_age_s(path) >= 0.0
+    assert release_lease(path)
+    assert not release_lease(path)              # idempotent
+    assert read_lease(path) is None
+    assert lease_age_s(path) is None
+
+
+def test_break_stale_lease_respects_ttl(tmp_path):
+    path = str(tmp_path / "k.lease")
+    acquire_lease(path, owner="a")
+    assert not break_stale_lease(path, ttl_s=60.0)   # fresh
+    old = time.time() - 120.0
+    os.utime(path, (old, old))
+    assert break_stale_lease(path, ttl_s=60.0)       # stale -> removed
+    assert not os.path.exists(path)
+    assert not break_stale_lease(path, ttl_s=60.0)   # already gone
+
+
+# ---------------------------------------------------------------------------
+# Store claim / publish election.
+# ---------------------------------------------------------------------------
+
+def test_claim_election_and_publish_visibility(tmp_path):
+    a = _store(tmp_path, "A")
+    b = _store(tmp_path, "B")
+    sol = make_solution((3.0, 0.6, 0.2), _row(0.0123), group=7, key=42)
+    assert a.claim(42) == "won"
+    assert b.claim(42) == "lost"
+    assert a.held_leases() == [42]
+    a.publish(sol)
+    assert a.held_leases() == []
+    assert a.lease_files() == []
+    # the loser claims again: published, and get() probes the disk for
+    # a key its index never saw
+    assert b.claim(42) == "published"
+    got = b.get(42)
+    assert got is not None
+    assert float(got.root) == 0.0123
+    assert np.array_equal(np.asarray(got.packed), _row(0.0123))
+    assert b.fleet_counts()["fleet_claims_lost"] == 1
+    assert a.fleet_counts()["fleet_publishes"] == 1
+
+
+def test_release_without_publish_reopens_election(tmp_path):
+    a = _store(tmp_path, "A")
+    b = _store(tmp_path, "B")
+    assert a.claim(7) == "won"
+    a.release(7)                      # failed solve: abandon
+    assert b.claim(7) == "won"        # immediately claimable again
+    b.release(7)
+
+
+def test_stale_lease_reclaim_and_gc(tmp_path):
+    """A crashed winner's lease (no heartbeat) is broken past the TTL —
+    by a claimant and by the end-of-run sweep."""
+    b = _store(tmp_path, "B", lease_ttl_s=1.0)
+    # a "crashed" owner: a raw lease file nobody heartbeats, backdated
+    dead = os.path.join(str(tmp_path / "shared"), "lease_feedbeef.lease")
+    acquire_lease(dead, owner="dead")
+    old = time.time() - 10.0
+    os.utime(dead, (old, old))
+    assert b.gc_stale_leases() == 1
+    assert b.fleet_counts()["fleet_lease_reclaims"] == 1
+    # and through the claim path: stale break + win in one call
+    lease = b._lease_file(9)
+    acquire_lease(lease, owner="dead")
+    os.utime(lease, (old, old))
+    assert b.claim(9) == "won"
+    assert b.fleet_counts()["fleet_lease_reclaims"] == 2
+    b.release(9)
+
+
+def test_heartbeat_keeps_live_claim_from_being_stolen(tmp_path):
+    """The lease heartbeat (mtime refresh at ttl/4): a LIVE winner whose
+    solve outlasts the TTL must not get its claim broken — staleness
+    means 'owner stopped beating', never 'solve is slow'."""
+    a = _store(tmp_path, "A", lease_ttl_s=0.4)
+    b = _store(tmp_path, "B", lease_ttl_s=0.4)
+    assert a.claim(5) == "won"
+    time.sleep(1.0)                   # 2.5x the TTL
+    assert not b.lease_stale(5)       # heartbeat refreshed the mtime
+    assert b.claim(5) == "lost"
+    assert b.fleet_counts()["fleet_lease_reclaims"] == 0
+    a.release(5)
+
+
+def test_claim_events_journaled(tmp_path):
+    jp = str(tmp_path / "j.jsonl")
+    from aiyagari_hark_tpu.obs.runtime import build_obs
+
+    obs = build_obs(ObsConfig(enabled=True, journal_path=jp))
+    a = _store(tmp_path, "A", obs=obs)
+    a.claim(1)
+    a.publish(make_solution((1.0, 0.0, 0.2), _row(), group=1, key=1),
+              speculative=True, seed=(0.0, 0.05, 3))
+    obs.close()
+    assert len(read_journal(jp, event="FLEET_CLAIM")) == 1
+    pub = read_journal(jp, event="FLEET_PUBLISH")
+    assert len(pub) == 1
+    assert pub[0]["speculative"] is True
+    assert pub[0]["seed"] == [0.0, 0.05, 3]
+
+
+# ---------------------------------------------------------------------------
+# Service fleet gate: dedup, remote hit, waiter resolution.
+# ---------------------------------------------------------------------------
+
+def _manual(store=None, **over):
+    kw = dict(start_worker=False, max_batch=4, max_wait_s=60.0,
+              ladder=(1, 2, 4))
+    kw.update(over)
+    return EquilibriumService(store=store, **kw)
+
+
+def test_fleet_in_batch_dedup_single_publish(tmp_path):
+    """Two same-fingerprint submits in one flush ride ONE lane: one
+    claim, one solve, one publish; both futures resolve identically."""
+    svc = _manual(_store(tmp_path, "A"))
+    f1 = svc.submit(make_query(5.0, 0.0, **KW))
+    f2 = svc.submit(make_query(5.0, 0.0, **KW))
+    svc.flush()
+    r1, r2 = f1.result(0), f2.result(0)
+    assert (r1.r_star, r1.capital, r1.status) == (r2.r_star, r2.capital,
+                                                  r2.status)
+    assert svc.store.fleet_counts()["fleet_publishes"] == 1
+    assert svc.store.lease_files() == []
+    svc.close()
+
+
+def test_fleet_remote_publish_served_as_hit(tmp_path):
+    """Worker B's miss on a fingerprint worker A already published is
+    served from the shared tier — bit-identical, no second solve."""
+    a = _manual(_store(tmp_path, "A"))
+    ra = a.query(3.0, 0.6, **KW)
+    b = _manual(_store(tmp_path, "B"))
+    fb = b.submit(make_query(3.0, 0.6, **KW))
+    if not fb.done():
+        b.flush()
+    rb = fb.result(0)
+    assert rb.path == "hit"
+    assert (rb.r_star, rb.capital, rb.labor, rb.status) == (
+        ra.r_star, ra.capital, ra.labor, ra.status)
+    assert b.store.fleet_counts()["fleet_publishes"] == 0
+    a.close()
+    b.close()
+
+
+def test_fleet_waiter_serves_winner_publish(tmp_path):
+    """The claim-loser path: B's flush blocks on A's in-flight claim
+    and serves A's publish the moment it lands (loser-serves-winner)."""
+    a_store = _store(tmp_path, "A")
+    b = _manual(_store(tmp_path, "B"), fleet_poll_s=0.01)
+    q = make_query(1.0, 0.3, **KW)
+    assert a_store.claim(q.key()) == "won"     # A holds the election
+    fb = b.submit(q)
+    done = threading.Event()
+
+    def _flush():
+        b.flush()
+        done.set()
+
+    t = threading.Thread(target=_flush)
+    t.start()
+    time.sleep(0.3)
+    assert not fb.done()                       # genuinely waiting
+    # A "solves" and publishes the real row (via a reference service so
+    # the bits are genuine)
+    ref = _manual(SolutionStore(capacity=8))
+    rr = ref.reference_solve(q)
+    a_store.publish(make_solution(q.cell(),
+                                  np.asarray(rr.values, dtype=np.float64),
+                                  q.group(), q.key()))
+    t.join(30.0)
+    assert done.is_set()
+    rb = fb.result(5.0)
+    assert rb.path == "hit"
+    assert rb.r_star == rr.r_star
+    assert b.metrics.snapshot()["fleet_remote_hits"] == 1
+    ref.close()
+    b.close()
+
+
+def test_fleet_waiter_takes_over_abandoned_claim(tmp_path):
+    """A lease released WITHOUT a publish (the winner's solve failed or
+    it crashed and was reclaimed): the waiter re-enqueues and the next
+    flush re-runs the election — this process wins and solves."""
+    a_store = _store(tmp_path, "A")
+    b = _manual(_store(tmp_path, "B"), fleet_poll_s=0.01)
+    q = make_query(1.0, 0.6, **KW)
+    assert a_store.claim(q.key()) == "won"
+    fb = b.submit(q)
+    t = threading.Thread(target=b.flush)
+    t.start()
+    time.sleep(0.2)
+    a_store.release(q.key())          # abandon: no publish
+    t.join(30.0)
+    assert not fb.done()              # re-enqueued, not yet solved
+    b.flush()                         # election re-runs: B wins, solves
+    rb = fb.result(5.0)
+    assert rb.path in ("cold", "near")
+    assert b.store.fleet_counts()["fleet_publishes"] == 1
+    b.close()
+
+
+# ---------------------------------------------------------------------------
+# Speculative neighbor prefetch.
+# ---------------------------------------------------------------------------
+
+def test_prefetch_issues_speculative_neighbors(tmp_path):
+    jp = str(tmp_path / "j.jsonl")
+    svc = _manual(prefetch_k=2, prefetch_cells=CELLS,
+                  obs=ObsConfig(enabled=True, journal_path=jp))
+    f = svc.submit(make_query(3.0, 0.6, **KW))
+    # parent + 2 speculative neighbors queued
+    assert svc.batcher.depth() == 3
+    svc.flush()
+    f.result(0)
+    ev = read_journal(jp, event="PREFETCH_ISSUED")
+    assert len(ev) == 2
+    # nearest lattice neighbors of (3.0, 0.6) in normalized distance
+    assert sorted(tuple(e["cell"]) for e in ev) == [
+        (3.0, 0.3, 0.2), (3.0, 0.9, 0.2)]
+    snap = svc.metrics.snapshot()
+    assert snap["serve_prefetch_issued"] == 2
+    # the neighbors are now exact hits; each converts exactly once
+    assert svc.query(3.0, 0.3, **KW).path == "hit"
+    assert svc.query(3.0, 0.3, **KW).path == "hit"
+    assert svc.metrics.snapshot()["serve_prefetch_converted"] == 1
+    svc.close()
+
+
+def test_prefetch_skips_solved_and_never_recurses():
+    svc = _manual(prefetch_k=8, prefetch_cells=CELLS[:4])
+    for c in CELLS[:4]:
+        svc.query(c[0], c[1], labor_sd=c[2], **KW)
+    issued_before = svc.metrics.snapshot()["serve_prefetch_issued"]
+    # everything solved: a fresh miss-free query issues nothing new
+    svc.query(1.0, 0.0, **KW)
+    assert svc.metrics.snapshot()["serve_prefetch_issued"] == issued_before
+    svc.close()
+
+
+def test_prefetch_sheddable_under_admission():
+    """Prefetch rides Priority.SPECULATIVE: when the class budget has no
+    room, the issue is SUPPRESSED (counted) — the triggering caller is
+    never failed by its own prefetch, and interactive work is never
+    displaced."""
+    pol = AdmissionPolicy(max_work=0.9, shed=False, est_batch_s=0.01,
+                          class_shares=(1.0, 0.5, 0.01))
+    svc = _manual(prefetch_k=2, prefetch_cells=CELLS, admission=pol)
+    f = svc.submit(make_query(3.0, 0.6, **KW))   # fills the budget
+    snap = svc.metrics.snapshot()
+    assert snap["serve_prefetch_suppressed"] == 2
+    assert snap["serve_prefetch_issued"] == 0
+    assert not f.done() or f.exception() is None
+    svc.flush()
+    assert f.result(0).path in ("cold", "near")
+    svc.close()
+
+
+def test_prefetch_requires_lattice():
+    with pytest.raises(ValueError, match="prefetch_cells"):
+        EquilibriumService(start_worker=False, prefetch_k=2)
+
+
+def test_fleet_prefetch_publish_tagged_speculative(tmp_path):
+    jp = str(tmp_path / "j.jsonl")
+    svc = _manual(_store(tmp_path, "A",
+                         obs=None), prefetch_k=1, prefetch_cells=CELLS,
+                  obs=ObsConfig(enabled=True, journal_path=jp))
+    svc.query(3.0, 0.6, **KW)
+    svc.flush()                        # drains the speculative pending
+    svc.close()
+    pub = read_journal(jp, event="FLEET_PUBLISH")
+    spec = [e for e in pub if e.get("speculative")]
+    assert len(pub) == 2 and len(spec) == 1
+    assert all(e.get("seed") is not None for e in pub)
+
+
+# ---------------------------------------------------------------------------
+# Admission EWMA cold start (satellite).
+# ---------------------------------------------------------------------------
+
+def test_first_rejection_retry_after_is_finite_and_sane():
+    """Before any batch has flushed there is no measured latency: the
+    EWMA seeds from the first admission-checked query's own
+    ``heuristic_cell_work`` predicted wall, so the FIRST ``Overloaded``
+    carries a finite, solve-scaled retry-after instead of the batcher's
+    millisecond ``max_wait_s``."""
+    pol = AdmissionPolicy(max_work=1.0, shed=False)   # est_batch_s=None
+    svc = _manual(max_wait_s=0.002, admission=pol)
+    f = svc.submit(make_query(3.0, 0.6, **KW))
+    with pytest.raises(Overloaded) as exc:
+        svc.submit(make_query(1.0, 0.0, **KW))
+    e = exc.value
+    assert np.isfinite(e.est_wait_s) and e.est_wait_s == e.retry_after_s
+    # sane: at least one predicted batch wall (>> max_wait_s), bounded
+    assert 0.002 < e.est_wait_s < 60.0
+    svc.flush()
+    f.result(0)
+    svc.close()
+
+
+def test_pinned_est_batch_s_still_takes_precedence():
+    pol = AdmissionPolicy(max_work=1.0, shed=False, est_batch_s=0.5)
+    svc = _manual(admission=pol)
+    svc.submit(make_query(3.0, 0.6, **KW))
+    with pytest.raises(Overloaded) as exc:
+        svc.submit(make_query(1.0, 0.0, **KW))
+    assert exc.value.est_wait_s == pytest.approx(0.5)
+    svc.flush()
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP front transport contract.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def front_svc():
+    svc = EquilibriumService(start_worker=True, max_batch=4,
+                             max_wait_s=0.01, ladder=(1, 2, 4))
+    front = FleetFront(svc).start()
+    yield svc, front
+    front.stop()
+    svc.close()
+
+
+def test_http_query_roundtrip_bit_exact(front_svc):
+    svc, front = front_svc
+    client = FleetClient([front.url], timeout=120.0)
+    res = client.query((3.0, 0.6, 0.2), KW)
+    assert res["path"] in ("cold", "near", "hit")
+    ref = svc.reference_solve(
+        make_query(3.0, 0.6, **KW),
+        bracket_init=(None if res["bracket_init"] is None
+                      else tuple(res["bracket_init"])))
+    # the JSON hop is bit-exact: repr round-trip floats
+    assert res["r_star"] == ref.r_star
+    assert res["capital"] == ref.capital
+    assert res["status"] == ref.status
+    # replay: exact hit now
+    res2 = client.query((3.0, 0.6, 0.2), KW)
+    assert res2["path"] == "hit"
+    assert res2["r_star"] == res["r_star"]
+
+
+def test_http_metrics_fleet_and_healthz(front_svc):
+    svc, front = front_svc
+    client = FleetClient([front.url])
+    assert client.get(front.url, "/healthz") == {"ok": True}
+    snap = client.get(front.url, "/metrics")
+    assert snap["serve_requests"] >= 1
+    fleet = client.get(front.url, "/fleet")
+    assert set(fleet) >= {"owner", "published_keys", "prefetch_keys",
+                          "held_leases", "store_known"}
+    assert client.get(front.url, "/metrics") is not None
+
+
+def test_http_typed_error_mapping(front_svc):
+    svc, front = front_svc
+    client = FleetClient([front.url])
+    # expired deadline -> 504 with the typed payload
+    with pytest.raises(FleetHTTPError) as exc:
+        client.query((5.0, 0.9, 0.2), KW, deadline=-1.0)
+    assert exc.value.code == 504
+    assert exc.value.payload["error"] == "DeadlineExceeded"
+    # unknown scenario -> 400 (make_query validates server-side)
+    with pytest.raises(FleetHTTPError) as exc:
+        client.query((3.0, 0.6, 0.2), KW, scenario="nope")
+    assert exc.value.code == 400
+    # 404 on an unknown path
+    with pytest.raises(Exception):
+        client.get(front.url, "/nope")
+
+
+def test_http_client_fails_over_to_live_worker(front_svc):
+    svc, front = front_svc
+    dead_url = "http://127.0.0.1:9"     # discard port: refused
+    client = FleetClient([dead_url, front.url])
+    res = client.query((3.0, 0.6, 0.2), KW)   # prefers urls[0], fails over
+    assert res["path"] == "hit"
+
+
+# ---------------------------------------------------------------------------
+# Regression-sentinel coverage for the fleet leg (CI satellite).
+# ---------------------------------------------------------------------------
+
+def test_direction_covers_fleet_smoke_record():
+    """Every scalar the ``--fleet-smoke`` record emits resolves in the
+    direction table, and the two load-bearing degradations — a dedup-
+    ratio rise (duplicate solves) and a fleet p99 blow-up — flag
+    REGRESSED from the first committed record."""
+    from aiyagari_hark_tpu.obs.regress import (
+        DOWN,
+        NEUTRAL,
+        OK,
+        UP,
+        direction_of_goodness,
+        evaluate_history,
+        flatten_record,
+    )
+
+    record = {
+        "metric": "fleet_smoke", "backend": "cpu",
+        "fleet_workers": 4, "fleet_cells": 12, "fleet_requests": 120,
+        "fleet_wall_s": 50.0, "fleet_trace_digest": "ab",
+        "fleet_served": 120, "fleet_served_hit": 113,
+        "fleet_served_near": 4, "fleet_served_cold": 3,
+        "fleet_unresolved": 0, "fleet_cold_solves": 12,
+        "fleet_distinct_fingerprints": 12, "fleet_dedup_ratio": 1.0,
+        "fleet_dedup_exact": True, "fleet_bit_identical": True,
+        "fleet_value_mismatches": 0, "fleet_value_divergence": 0,
+        "fleet_seeded_compares": 11,
+        "fleet_prefetch_issued": 22, "fleet_prefetch_converted": 4,
+        "fleet_remote_hits": 14, "fleet_claims_won": 12,
+        "fleet_claims_lost": 7, "fleet_lease_reclaims": 0,
+        "fleet_leases_leaked": 0, "fleet_drill_rc": 75,
+        "fleet_drill_interrupted_typed": True,
+        "fleet_hit_p50_ms": 3.2, "fleet_hit_p99_ms": 16000.0,
+        "fleet_near_p50_ms": 15000.0, "fleet_cold_p50_ms": 21000.0,
+        "fleet_cold_p99_ms": 22000.0,
+        "fleet_sentinel_clean": True, "fleet_sentinel_worst": "OK",
+    }
+    for field in flatten_record(record):
+        assert direction_of_goodness(field, strict=True) in (
+            UP, DOWN, NEUTRAL), field
+    assert direction_of_goodness("fleet_dedup_ratio") == DOWN
+    assert direction_of_goodness("fleet_leases_leaked") == DOWN
+    assert direction_of_goodness("fleet_prefetch_converted") == UP
+    assert direction_of_goodness("fleet_hit_p99_ms") == DOWN
+    # the serve snapshot's new counters resolve too (they ride every
+    # serve_* record via ServeMetrics.snapshot)
+    for f in ("serve_prefetch_issued", "serve_prefetch_converted",
+              "serve_prefetch_suppressed", "fleet_remote_hits",
+              "fleet_claims_won", "fleet_claims_lost",
+              "fleet_publishes", "fleet_lease_reclaims"):
+        assert direction_of_goodness(f, strict=True) in (UP, DOWN,
+                                                         NEUTRAL), f
+    # synthetic-history grading: stable history clean; dedup-ratio rise
+    # and p99 blow-up flag REGRESSED
+    hist = [(f"r{i:02d}", dict(record)) for i in range(4)]
+    assert evaluate_history(hist).worst == OK
+    worse = dict(record)
+    worse["fleet_dedup_ratio"] = 1.5
+    worse["fleet_hit_p99_ms"] = 40000.0
+    flagged = [f.metric for f in
+               evaluate_history(hist[:-1] + [("r99", worse)]).regressed()]
+    assert "fleet_dedup_ratio" in flagged
+    assert "fleet_hit_p99_ms" in flagged

@@ -127,17 +127,17 @@ def test_unreadable_disk_entry_evicted_at_index_load(tmp_path, mode):
     assert glob.glob(os.path.join(d, "sol_*.npz")) == []
 
 
-def test_memory_tier_corruption_evicted_on_get():
-    """A bit flip in the MEMORY tier is as silent as a disk one: get()
-    re-verifies and reports a miss instead of serving it."""
+def test_memory_tier_corruption_evicted_on_first_get():
+    """A bit flip in the MEMORY tier before the residency's first
+    verification is caught: get() verifies once per residency (the
+    ISSUE 15 memoization) and reports a miss instead of serving it."""
     store = SolutionStore(capacity=4)
     row = np.asarray([0.035, 5.0, 0.9, 11, 500, 4000, 0, 0, 4500, 0],
                      dtype=np.float64)
     store.put(make_solution((3.0, 0.6, 0.2), row, group=7, key=1))
-    assert store.get(1) is not None
-    # corrupt the cached object's bytes in place (the SDC model)
-    sol = store.get(1)
-    sol.packed[:] = flip_row_bit(sol.packed, field=0, bit=18)
+    # corrupt the cached object's bytes in place BEFORE the first get
+    # (make_solution aliases the caller's array, so `row` reaches it)
+    row[:] = flip_row_bit(row, field=0, bit=18)
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
         assert store.get(1) is None
@@ -147,16 +147,16 @@ def test_memory_tier_corruption_evicted_on_get():
 
 def test_memory_tier_corruption_recovers_from_healthy_disk_copy(tmp_path):
     """An in-RAM flip must NOT destroy the (independently verified) disk
-    copy: the get falls through, re-verifies the file, and serves it —
-    one transient memory corruption is not a permanent cache loss."""
+    copy: the first-get verification falls through, re-verifies the
+    file, and serves it — one transient memory corruption is not a
+    permanent cache loss."""
     store = SolutionStore(capacity=4, disk_path=str(tmp_path / "s"))
     row = np.asarray([0.035, 5.0, 0.9, 11, 500, 4000, 0, 0, 4500, 0],
                      dtype=np.float64)
     pristine = row.copy()   # make_solution aliases the caller's array —
     #                         the in-place flip below reaches `row` too
     store.put(make_solution((3.0, 0.6, 0.2), row, group=7, key=1))
-    sol = store.get(1)
-    sol.packed[:] = flip_row_bit(sol.packed, field=0, bit=18)
+    row[:] = flip_row_bit(row, field=0, bit=18)
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
         recovered = store.get(1)
@@ -166,6 +166,46 @@ def test_memory_tier_corruption_recovers_from_healthy_disk_copy(tmp_path):
     assert store.integrity_counts()["store_corrupt_evictions"] == 1
     # and the disk file survived
     assert store.get(1) is not None
+
+
+def test_checksum_memoized_after_residency_disk_still_caught(tmp_path):
+    """The ISSUE 15 memoization pin, both halves.  (a) A mutation AFTER
+    a residency's verified first get is out of the threat model: later
+    memory hits serve without re-hashing (that is the perf contract —
+    the hot path pays the hash once per residency, not per hit).  (b)
+    The DISK tier's corrupt-eviction semantics are unchanged: the same
+    entry's file, corrupted on disk, is still caught and evicted at
+    every load boundary (promotion and restart)."""
+    d = str(tmp_path / "s")
+    store = SolutionStore(capacity=4, disk_path=d)
+    row = np.asarray([0.035, 5.0, 0.9, 11, 500, 4000, 0, 0, 4500, 0],
+                     dtype=np.float64)
+    store.put(make_solution((3.0, 0.6, 0.2), row, group=7, key=1))
+    first = store.get(1)
+    assert first is not None            # first get verified the bytes
+    # (a) mutate after residency: served without detection (memoized)
+    first.packed[:] = flip_row_bit(first.packed, field=0, bit=18)
+    assert store.get(1) is not None
+    assert store.integrity_counts()["store_corrupt_evictions"] == 0
+    # (b) disk corruption is still caught: evict the memory copy by
+    # filling the LRU, corrupt the FILE, and re-get -> promotion
+    # verifies, evicts, deletes
+    for k in range(2, 6):
+        store.put(make_solution((1.0, 0.0, 0.2), row.copy(), group=7,
+                                key=k))
+    assert 1 not in store.mem_keys()
+    corrupt_store_entry(d, key=1, mode="perturb")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert store.get(1) is None
+    assert store.integrity_counts()["store_corrupt_evictions"] == 1
+    # and the restart-time load boundary catches one the same way
+    corrupt_store_entry(d, key=2, mode="perturb")
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        store2 = SolutionStore(capacity=4, disk_path=d)
+    assert store2.get(2) is None
+    assert store2.integrity_counts()["store_corrupt_evictions"] == 1
 
 
 def test_corrupted_entry_on_get_path_deleted(tmp_path):
