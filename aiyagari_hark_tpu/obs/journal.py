@@ -66,6 +66,15 @@ EVENT_TYPES = (
     # speculative neighbor query issued by the prefetcher
     "FLEET_CLAIM", "FLEET_PUBLISH", "FLEET_LEASE_RECLAIM",
     "PREFETCH_ISSUED",
+    # fleet robustness tier (ISSUE 16, serve.{store,chaos,fleet,
+    # loadgen}): a chaos-drill fault actually FIRING (the detection
+    # ledger's injected side), a hedged read issued for a known-
+    # published fingerprint / the hedge's answer winning the race, a
+    # worker entering or leaving the pool mid-load (the elasticity
+    # schedule), and a lease-backend operation degrading typed (substrate
+    # fault, injected partition, or a held lease found lost/stolen)
+    "FLEET_CHAOS_INJECT", "FLEET_HEDGE_ISSUED", "FLEET_HEDGE_WON",
+    "WORKER_JOIN", "WORKER_LEAVE", "LEASE_BACKEND_FAULT",
     # performance-observability tier (ISSUE 10, obs.profile/obs.regress):
     # the run's cost-ledger summary at close, a bench-regression sentinel
     # finding graded REGRESSED, the flight-recorder crash artifact

@@ -143,6 +143,39 @@ DIRECTION_EXPLICIT: Dict[str, str] = {
     "fleet_value_mismatches": DOWN,
     "fleet_value_divergence": DOWN,
     "fleet_seeded_compares": NEUTRAL,
+    # chaos smoke (ISSUE 16, bench --chaos-smoke): graded from their
+    # FIRST committed record.  AVAILABILITY is served/submitted under
+    # churn + drills — the headline robustness number, UP.  Leaked
+    # leases, unresolved arrivals, value divergence, and recovery-phase
+    # duplicate publishes are protocol violations, DOWN from record one.
+    # The drilled dedup ratio excludes the drills' EXPECTED duplicates
+    # (a stalled winner's late publish, a skew-forced double election)
+    # — what remains must stay 1.0, so any increase is a real
+    # exactly-once regression, DOWN.  Reclaims/kills/joins/leaves are
+    # the drill script's own doing, facts not goodness — NEUTRAL;
+    # injected/detected counts resolve NEUTRAL via the affix rules and
+    # are pinned equal by the acceptance gate instead.  Hedge counts
+    # are traffic facts, NEUTRAL (the hedge's latency win shows up in
+    # the p99 fields, which resolve DOWN via the _ms suffix).
+    "chaos_availability": UP,
+    "chaos_dedup_ratio": DOWN,
+    "chaos_recovery_dup_publishes": DOWN,
+    "chaos_leases_leaked": DOWN,
+    "chaos_unresolved": DOWN,
+    "chaos_value_divergence": DOWN,
+    "chaos_reclaims": NEUTRAL,
+    "chaos_workers": NEUTRAL,
+    "chaos_arrivals": NEUTRAL,
+    "chaos_served": UP,
+    "chaos_joins": NEUTRAL,
+    "chaos_leaves": NEUTRAL,
+    "chaos_kills": NEUTRAL,
+    "chaos_hedges_issued": NEUTRAL,
+    "chaos_hedges_won": NEUTRAL,
+    "chaos_value_mismatches": DOWN,
+    "chaos_seeded_compares": NEUTRAL,
+    "chaos_recovery_served": NEUTRAL,
+    "chaos_backend_faults": NEUTRAL,  # injected partitions land here
     "serve_prefetch_issued": NEUTRAL,
     "serve_prefetch_converted": UP,
     "serve_prefetch_suppressed": NEUTRAL,

@@ -15,16 +15,33 @@ from .batcher import (  # noqa: F401
     default_ladder,
     shard_ladder,
 )
+from .chaos import (  # noqa: F401
+    ChaosAgent,
+    ChaosPlan,
+    DrillError,
+    run_drills,
+)
 from .fleet import (  # noqa: F401
     FleetClient,
     FleetFront,
     FleetHTTPError,
+    HedgePolicy,
+    RetryPolicy,
     error_to_json,
     result_to_json,
     worker_main,
 )
+from .lease import (  # noqa: F401
+    CASServer,
+    LeaseBackend,
+    LoopbackCASBackend,
+    MemoryCASBackend,
+    SharedDirBackend,
+    make_backend,
+)
 from .loadgen import (  # noqa: F401
     Arrival,
+    FleetCtl,
     FleetReport,
     FleetSpec,
     LoadReport,

@@ -47,21 +47,34 @@ TTL is the designed reclaim (survivors break stale leases and re-solve),
 and the interrupt path must not add disk I/O between the signal and
 exit.
 
-Scope, honestly: this is a single-host-N-process fleet (the lease
-protocol trusts one filesystem's O_EXCL and one wall clock).  A
-multi-host tier would swap the disk directory for an object store /
-coordination service behind the same ``SolutionStore`` claim/publish
-API; nothing above the store changes.
+Scope, honestly: this is a single-host-N-process fleet by default (the
+shared-dir lease backend trusts one filesystem's O_EXCL and one wall
+clock).  ``--lease-backend cas:host:port`` swaps the directory for the
+loopback CAS authority (``serve.lease``) behind the same claim/publish
+API; nothing above the store changes, and the backend choice never
+enters solution fingerprints.
+
+ISSUE 16 additions (DESIGN §14): the client grows TYPED resilience —
+bounded deterministic exponential backoff honoring the server's 503
+``Retry-After`` (``RetryPolicy``), per-request deadlines on an
+injectable clock, and optional hedged reads for known-published
+fingerprints (``HedgePolicy``; a hedge is never issued for a cold miss
+— see the module docstring of ``serve.chaos`` for why).  Workers grow a
+``POST /chaos`` arm endpoint (only when started with ``--chaos``) and
+surface per-worker lease/heartbeat health in ``/healthz`` and
+``/fleet``.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import queue
 import threading
+import time
 from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List, Optional
+from typing import List, NamedTuple, Optional
 from urllib import error as urlerror
 from urllib import request as urlrequest
 
@@ -160,18 +173,29 @@ class _FleetHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         if retry_after is not None:
-            self.send_header("Retry-After", f"{max(0.0, retry_after):.3f}")
+            # repr(), not a fixed-width format: the header must equal
+            # the JSON payload's ``retry_after_s`` BIT-EXACTLY after one
+            # float round-trip (json.dumps also serializes floats via
+            # repr), so a client honoring either sees the same wait —
+            # pinned by tests/test_fleet_client.py.
+            self.send_header("Retry-After", repr(max(0.0, float(retry_after))))
         self.end_headers()
         self.wfile.write(body)
 
     def do_GET(self):
         svc: EquilibriumService = self.server.service
+        store = svc.store
         if self.path == "/healthz":
-            self._send(200, {"ok": True})
+            hb = (store.heartbeat_health()
+                  if hasattr(store, "heartbeat_health") else {})
+            self._send(200, {"ok": True,
+                             "owner": getattr(store, "owner", ""),
+                             "heartbeat": hb})
         elif self.path == "/metrics":
             self._send(200, svc.metrics.snapshot())
         elif self.path == "/fleet":
-            store = svc.store
+            hb = (store.heartbeat_health()
+                  if hasattr(store, "heartbeat_health") else {})
             self._send(200, {
                 "owner": getattr(store, "owner", ""),
                 "shared": bool(getattr(store, "shared", False)),
@@ -180,11 +204,16 @@ class _FleetHandler(BaseHTTPRequestHandler):
                 "held_leases": store.held_leases(),
                 "store_known": store.known(),
                 "fleet_counts": store.fleet_counts(),
+                "heartbeat": hb,
+                "lease_backend": hb.get("backend", "shared-dir"),
             })
         else:
             self._send(404, {"error": "NotFound", "message": self.path})
 
     def do_POST(self):
+        if self.path == "/chaos":
+            self._do_chaos()
+            return
         if self.path != "/query":
             self._send(404, {"error": "NotFound", "message": self.path})
             return
@@ -222,10 +251,30 @@ class _FleetHandler(BaseHTTPRequestHandler):
             return
         self._send(200, result_to_json(res))
 
+    def _do_chaos(self):
+        """Arm/disarm the worker's chaos agent (ISSUE 16 drills).  Only
+        live on workers started with ``--chaos`` — a production worker
+        404s, so the fault surface cannot be armed by accident."""
+        agent = getattr(self.server, "chaos", None)
+        if agent is None:
+            self._send(404, {"error": "ChaosDisabled",
+                             "message": "worker not started with --chaos"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", "0"))
+            cfg = (json.loads(self.rfile.read(n).decode("utf-8"))
+                   if n else {})
+            armed = agent.arm(cfg)
+        except Exception as e:
+            self._send(400, {"error": "BadRequest", "message": str(e)})
+            return
+        self._send(200, {"ok": True, "armed": armed})
+
 
 class _FleetServer(ThreadingHTTPServer):
     daemon_threads = True
     allow_reuse_address = True
+    chaos = None   # a ChaosAgent when the worker was started with --chaos
 
 
 class FleetFront:
@@ -234,9 +283,10 @@ class FleetFront:
     after construction — the worker prints it for its spawner)."""
 
     def __init__(self, service: EquilibriumService,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0, chaos=None):
         self._httpd = _FleetServer((host, int(port)), _FleetHandler)
         self._httpd.service = service
+        self._httpd.chaos = chaos
         self.host = host
         self.port = int(self._httpd.server_address[1])
         self._thread: Optional[threading.Thread] = None
@@ -268,27 +318,130 @@ class FleetFront:
 class FleetHTTPError(ServeError):
     """A worker answered with a typed error payload: ``payload`` is the
     decoded JSON (``payload["error"]`` names the serving-layer type),
-    ``code`` the HTTP status."""
+    ``code`` the HTTP status, ``retry_after_s`` the parsed
+    ``Retry-After`` header when the worker sent one (float seconds —
+    equal to the payload's ``retry_after_s``/``est_wait_s`` field by the
+    ``_send`` repr pin)."""
 
-    def __init__(self, code: int, payload: dict):
+    def __init__(self, code: int, payload: dict,
+                 retry_after_s: Optional[float] = None):
         super().__init__(
             f"fleet worker returned {code}: "
             f"{payload.get('error')} ({payload.get('message')})")
         self.code = int(code)
         self.payload = dict(payload)
+        self.retry_after_s = (None if retry_after_s is None
+                              else float(retry_after_s))
+
+
+class RetryPolicy(NamedTuple):
+    """Bounded DETERMINISTIC exponential backoff for ``FleetClient``
+    (ISSUE 16).  Attempt k waits ``base_s * multiplier**k``, raised to
+    the server's 503 ``Retry-After`` when one was sent (the worker's
+    estimate is better than the client's schedule), capped at
+    ``max_backoff_s``.  No jitter by design: the chaos drills replay
+    byte-identically only if every client wait is a pure function of
+    (policy, attempt index, server answer)."""
+
+    max_attempts: int = 4
+    base_s: float = 0.05
+    multiplier: float = 2.0
+    max_backoff_s: float = 2.0
+
+    def backoff_s(self, attempt: int,
+                  retry_after_s: Optional[float] = None) -> float:
+        wait = float(self.base_s) * float(self.multiplier) ** int(attempt)
+        if retry_after_s is not None:
+            wait = max(wait, float(retry_after_s))
+        return min(wait, float(self.max_backoff_s))
+
+
+class HedgePolicy(NamedTuple):
+    """Hedged reads for KNOWN-PUBLISHED fingerprints (ISSUE 16, DESIGN
+    §14).  If the primary worker hasn't answered within the hedge delay,
+    a second identical request goes to the next worker and the first
+    answer wins.  ``delay_s=None`` derives the delay from the client's
+    own p99 success latency (an exact hit answering slower than p99 is
+    evidence the worker is sick, not that the query is hard); the floor
+    ``min_delay_s`` also serves as the delay before any latency history
+    exists.  A hedge is only LEGAL for a fingerprint this client has
+    already seen answered — a cold miss would trigger a second
+    fleet-wide solve election and waste a worker on duplicated work, so
+    cold misses never hedge."""
+
+    delay_s: Optional[float] = None
+    min_delay_s: float = 0.01
 
 
 class FleetClient:
-    """Minimal stdlib client for a worker pool: submit one query to a
-    worker, failing over to the next URL on a CONNECTION-level error (a
-    dead worker).  Typed serving errors do NOT fail over — an
-    ``Overloaded`` from a live worker is an answer, not an outage."""
+    """Stdlib client for a worker pool: submit one query to a worker,
+    failing over to the next URL on a CONNECTION-level error (a dead
+    worker).  Typed serving errors do NOT fail over — an ``Overloaded``
+    from a live worker is an answer, not an outage.
 
-    def __init__(self, urls: List[str], timeout: float = 300.0):
+    ISSUE 16 resilience (all OPT-IN so existing callers' outcome
+    accounting is unchanged):
+
+    * ``retry=RetryPolicy(...)``: 503 answers (``Overloaded`` /
+      ``CircuitOpen`` / queue-full / shed) and full-pool connection
+      failures are retried under bounded deterministic exponential
+      backoff honoring the server's ``Retry-After``.
+    * ``deadline_s=`` per query: the whole retry/backoff schedule lives
+      inside one budget on the injectable ``clock``; when the budget
+      cannot cover the next wait the client raises typed
+      ``DeadlineExceeded`` instead of sleeping past it.
+    * ``hedge=HedgePolicy(...)``: hedged reads for known-published
+      fingerprints only (see ``HedgePolicy``); journaled as
+      ``FLEET_HEDGE_ISSUED`` / ``FLEET_HEDGE_WON`` when ``obs`` is
+      attached.
+
+    ``clock`` (monotonic seconds) and ``sleep`` are injectable so every
+    retry test runs on a fake clock in zero wall time."""
+
+    def __init__(self, urls: List[str], timeout: float = 300.0,
+                 retry: Optional[RetryPolicy] = None,
+                 hedge: Optional[HedgePolicy] = None,
+                 clock=None, sleep=None, obs=None):
         if not urls:
             raise ValueError("FleetClient needs at least one worker URL")
         self.urls = list(urls)
         self.timeout = float(timeout)
+        self.retry = retry
+        self.hedge = hedge
+        self._clock = clock if clock is not None else time.monotonic
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._obs = obs
+        # (scenario, rounded-cell) tokens this client has SEEN answered:
+        # the hedge-legality set.  Client-observed only — the client
+        # cannot compute fingerprints, and a worker-side "published"
+        # answer is exactly the evidence a hedge needs.
+        self._published = set()
+        self._lat_s: List[float] = []   # success latencies, hedge p99
+        self._hedge_counts = {"issued": 0, "won": 0}
+
+    @staticmethod
+    def _token(scenario: str, cell) -> tuple:
+        return (str(scenario), tuple(round(float(c), 9) for c in cell))
+
+    def hedge_counts(self) -> dict:
+        return dict(self._hedge_counts)
+
+    def note_published(self, scenario: str, cell) -> None:
+        """Mark a cell hedge-legal without a prior query (e.g. the
+        harness pre-warmed it through a different client)."""
+        self._published.add(self._token(scenario, cell))
+
+    def _hedge_delay_s(self) -> float:
+        assert self.hedge is not None
+        if self.hedge.delay_s is not None:
+            return max(float(self.hedge.delay_s),
+                       float(self.hedge.min_delay_s))
+        if not self._lat_s:
+            return float(self.hedge.min_delay_s)
+        ordered = sorted(self._lat_s)
+        p99 = ordered[min(len(ordered) - 1,
+                          int(0.99 * (len(ordered) - 1) + 0.5))]
+        return max(p99, float(self.hedge.min_delay_s))
 
     def _post(self, url: str, path: str, payload: dict) -> dict:
         data = json.dumps(payload).encode("utf-8")
@@ -303,27 +456,23 @@ class FleetClient:
                 payload = json.loads(e.read().decode("utf-8"))
             except Exception:
                 payload = {"error": "HTTPError", "message": str(e)}
-            raise FleetHTTPError(e.code, payload) from None
+            ra = e.headers.get("Retry-After") if e.headers else None
+            try:
+                ra = None if ra is None else float(ra)
+            except ValueError:
+                ra = None
+            raise FleetHTTPError(e.code, payload,
+                                 retry_after_s=ra) from None
 
     def get(self, url: str, path: str) -> dict:
         with urlrequest.urlopen(url + path,
                                 timeout=self.timeout) as resp:
             return json.loads(resp.read().decode("utf-8"))
 
-    def query(self, cell, kwargs: dict, scenario: str = "aiyagari",
-              priority: int = 0, deadline: Optional[float] = None,
-              degraded_ok: bool = False,
-              prefer: Optional[int] = None) -> dict:
-        """POST one query, starting at ``urls[prefer]`` and failing over
-        on connection errors.  Returns the result payload; raises
-        ``FleetHTTPError`` on a typed error answer, ``ConnectionError``
-        when EVERY worker is unreachable."""
-        payload = {"cell": [float(c) for c in cell], "kwargs": kwargs,
-                   "scenario": scenario, "priority": int(priority),
-                   "deadline": deadline,
-                   "degraded_ok": bool(degraded_ok),
-                   "timeout": self.timeout}
-        start = 0 if prefer is None else int(prefer) % len(self.urls)
+    def _query_once(self, payload: dict, start: int) -> dict:
+        """One failover sweep over the pool (the pre-ISSUE-16 behavior):
+        connection errors and dying workers' typed refusals move on to
+        the next URL; any other typed answer raises immediately."""
         last = None
         for i in range(len(self.urls)):
             url = self.urls[(start + i) % len(self.urls)]
@@ -344,6 +493,105 @@ class FleetClient:
         raise ConnectionError(
             f"no fleet worker reachable ({len(self.urls)} tried): "
             f"{last}")
+
+    def _query_hedged(self, payload: dict, start: int,
+                      token: tuple) -> dict:
+        """Primary request plus, after the hedge delay, one hedge to the
+        next worker; first SUCCESS wins.  If the first arrival is an
+        error the race waits for the straggler; only when both requests
+        fail does the primary's error propagate."""
+        results: "queue.Queue" = queue.Queue()
+
+        def _run(tag: str, offset: int) -> None:
+            try:
+                results.put((tag, None,
+                             self._query_once(payload, start + offset)))
+            except BaseException as e:   # reported through the queue
+                results.put((tag, e, None))
+
+        threading.Thread(target=_run, args=("primary", 0),
+                         daemon=True, name="fleet-hedge-primary").start()
+        delay = self._hedge_delay_s()
+        try:
+            first = results.get(timeout=delay)
+        except queue.Empty:
+            first = None
+        if first is not None and first[1] is None:
+            return first[2]               # primary answered in time
+        self._hedge_counts["issued"] += 1
+        if self._obs is not None:
+            self._obs.event("FLEET_HEDGE_ISSUED", scenario=token[0],
+                            cell=list(token[1]),
+                            delay_s=round(delay, 6))
+        threading.Thread(target=_run, args=("hedge", 1),
+                         daemon=True, name="fleet-hedge-second").start()
+        outcomes = [] if first is None else [first]
+        while len(outcomes) < 2:
+            outcomes.append(results.get())
+            tag, err, res = outcomes[-1]
+            if err is None:
+                if tag == "hedge":
+                    self._hedge_counts["won"] += 1
+                    if self._obs is not None:
+                        self._obs.event("FLEET_HEDGE_WON",
+                                        scenario=token[0],
+                                        cell=list(token[1]))
+                return res
+        for tag, err, _res in outcomes:   # both failed: primary's error
+            if tag == "primary":
+                raise err
+        raise outcomes[0][1]
+
+    def query(self, cell, kwargs: dict, scenario: str = "aiyagari",
+              priority: int = 0, deadline: Optional[float] = None,
+              degraded_ok: bool = False,
+              prefer: Optional[int] = None,
+              deadline_s: Optional[float] = None) -> dict:
+        """POST one query, starting at ``urls[prefer]`` and failing over
+        on connection errors.  Returns the result payload; raises
+        ``FleetHTTPError`` on a typed error answer, ``ConnectionError``
+        when EVERY worker is unreachable (after the retry schedule, when
+        one is attached), typed ``DeadlineExceeded`` when ``deadline_s``
+        cannot cover the next backoff wait."""
+        payload = {"cell": [float(c) for c in cell], "kwargs": kwargs,
+                   "scenario": scenario, "priority": int(priority),
+                   "deadline": deadline,
+                   "degraded_ok": bool(degraded_ok),
+                   "timeout": self.timeout}
+        start = 0 if prefer is None else int(prefer) % len(self.urls)
+        token = self._token(scenario, cell)
+        hedge_ok = (self.hedge is not None and len(self.urls) >= 2
+                    and token in self._published)
+        attempts = (1 if self.retry is None
+                    else max(1, int(self.retry.max_attempts)))
+        t0 = self._clock()
+        limit = None if deadline_s is None else t0 + float(deadline_s)
+        for attempt in range(attempts):
+            t_req = self._clock()
+            try:
+                res = (self._query_hedged(payload, start, token)
+                       if hedge_ok
+                       else self._query_once(payload, start))
+                self._lat_s.append(max(0.0, self._clock() - t_req))
+                if len(self._lat_s) > 512:
+                    del self._lat_s[:-256]
+                self._published.add(token)
+                return res
+            except FleetHTTPError as e:
+                if (self.retry is None or attempt + 1 >= attempts
+                        or e.code != 503):
+                    raise
+                wait = self.retry.backoff_s(attempt, e.retry_after_s)
+            except ConnectionError:
+                if self.retry is None or attempt + 1 >= attempts:
+                    raise
+                wait = self.retry.backoff_s(attempt)
+            if limit is not None and self._clock() + wait > limit:
+                raise DeadlineExceeded(   # obs-ok: client-side budget, journaled server-side if at all
+                    tuple(float(c) for c in cell), key=-1,
+                    waited_s=self._clock() - t0)
+            self._sleep(wait)
+        raise AssertionError("unreachable: loop raises or returns")
 
 
 # -- the out-of-process worker ----------------------------------------------
@@ -383,11 +631,20 @@ def worker_main(argv=None) -> int:
                     help="certify_before_cache on cold misses")
     ap.add_argument("--max-seconds", type=float, default=None,
                     help="safety exit after this long (tests)")
+    ap.add_argument("--lease-backend", default="dir",
+                    help="coordination backend spec: 'dir' (shared-dir "
+                         "leases, the default) or 'cas:HOST:PORT' (the "
+                         "loopback CAS authority, serve.lease)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="enable the POST /chaos fault-injection "
+                         "endpoint (ISSUE 16 drills; never on by "
+                         "default)")
     args = ap.parse_args(argv)
 
     from ..obs.runtime import NULL_OBS, ObsConfig, build_obs
     from ..utils.config import AdmissionPolicy
     from ..utils.resilience import interrupt_requested, preemption_guard
+    from .lease import make_backend
     from .store import SolutionStore
 
     obs = (build_obs(ObsConfig(enabled=True, journal_path=args.journal))
@@ -396,16 +653,26 @@ def worker_main(argv=None) -> int:
                  if args.admission else None)
     prefetch_cells = (json.loads(args.prefetch_cells)
                       if args.prefetch_cells else None)
+    backend = (None if args.lease_backend == "dir"
+               else make_backend(args.lease_backend, root=args.store))
     store = SolutionStore(capacity=args.capacity, disk_path=args.store,
                           shared=True, lease_ttl_s=args.lease_ttl,
-                          owner=args.owner, obs=obs)
+                          owner=args.owner, obs=obs,
+                          lease_backend=backend)
+    chaos = None
+    if args.chaos:
+        from .chaos import ChaosAgent
+
+        chaos = ChaosAgent(obs=obs, owner=args.owner)
+        store.set_chaos(chaos)
     svc = EquilibriumService(
         store=store, max_batch=args.max_batch,
         ladder=tuple(int(s) for s in args.ladder.split(",")),
         admission=admission, obs=obs,
         certify_before_cache=bool(args.certify),
         prefetch_k=args.prefetch_k, prefetch_cells=prefetch_cells)
-    front = FleetFront(svc, host=args.host, port=args.port).start()
+    front = FleetFront(svc, host=args.host, port=args.port,
+                       chaos=chaos).start()
     print(f"FLEET_READY port={front.port} pid={os.getpid()} "
           f"owner={args.owner}", flush=True)
 

@@ -1,0 +1,536 @@
+"""Pluggable lease-coordination backends for the fleet tier (ISSUE 16,
+DESIGN §14).
+
+The claim/lease/publish protocol the shared ``SolutionStore`` runs
+(exactly-once election per solution fingerprint, heartbeat-refreshed
+liveness, TTL reclaim of a crashed winner) was born fused to ONE
+implementation: lease files on one filesystem, ``O_CREAT | O_EXCL`` for
+the election and mtime for staleness.  ROADMAP item 2's multi-host tier
+needs the same protocol over an object store or coordination service —
+so the protocol is now a trait, ``LeaseBackend``, with the election
+semantics specified by one shared conformance suite
+(``tests/test_lease_backend.py``) instead of by whatever the filesystem
+happens to do:
+
+* ``SharedDirBackend`` — the existing shared-directory implementation,
+  verbatim semantics (``lease_<hex>.lease`` files via
+  ``utils.checkpoint``); the fleet default.  Byte-compatible with
+  pre-ISSUE-16 stores: same filenames, same payloads.
+* ``MemoryCASBackend`` — an in-memory backend modeling OBJECT-STORE
+  conditional-put semantics: a lease is a versioned record, acquisition
+  is put-if-absent, heartbeat is read-check-owner-bump, and reclaim is
+  delete-if-version-unchanged — the compare-and-swap shape an
+  S3/GCS/etcd backend would use, so the reclaim-vs-heartbeat race is
+  closed by VERSION, not by filesystem atomicity.  Single-process by
+  construction (it is a dict); its job is to pin the conformance
+  contract a real remote backend must meet.
+* ``CASServer`` + ``LoopbackCASBackend`` — the memory backend served
+  over a line-JSON TCP loopback, so REAL separate processes can run the
+  conformance races (two interpreters' concurrent claims) against the
+  CAS semantics, and a fleet worker can be pointed at a shared CAS
+  authority with ``--lease-backend cas:<host>:<port>``.
+
+Contract notes shared by every backend:
+
+* ``release``/``heartbeat`` are OWNER-CHECKED: a stalled winner whose
+  lease was TTL-reclaimed and re-acquired by a peer must not delete the
+  peer's fresh lease when it finally wakes and releases (the unchecked
+  ``os.remove`` release had exactly this bug), and its heartbeat must
+  return False — "you no longer hold this" — instead of resurrecting a
+  stolen claim.
+* ages are CLAMPED at zero and staleness honors a ``skew_tolerance_s``
+  window (ISSUE 16 satellite): a backward wall-clock step never makes a
+  fresh lease stale, and a reclaimer's forward skew must exceed
+  ``ttl + tolerance`` before it can steal from a live owner.
+* backend choice NEVER enters solution fingerprints or served bytes —
+  it decides who solves, not what a solve produces.
+
+No jax imports; everything here is host-side coordination.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..utils.checkpoint import (
+    LEASE_SUFFIX,
+    acquire_lease,
+    break_stale_lease,
+    lease_age_s,
+    read_lease,
+    release_lease,
+)
+from ..utils.fingerprint import fingerprint_hex
+
+
+def key_from_hex(hex_str: str) -> int:
+    """Inverse of ``utils.fingerprint.fingerprint_hex``: the signed
+    int64 back from its two's-complement hex spelling."""
+    v = int(hex_str, 16)
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+class LeaseBackend:
+    """The coordination trait: per-fingerprint lease election with
+    heartbeat liveness and TTL reclaim.  Keys are signed int64 solution
+    fingerprints; owners are diagnostic worker ids (election correctness
+    never depends on reading them, but release/heartbeat verify them).
+
+    Every method is non-blocking and exception-free under normal
+    operation; a backend whose substrate can fail transiently (network
+    CAS) raises ``OSError``/``ConnectionError`` and the store degrades
+    through its typed ``LEASE_BACKEND_FAULT`` path."""
+
+    name = "abstract"
+
+    def try_acquire(self, key: int, owner: str) -> bool:
+        """Atomically create the key's lease.  True iff THIS caller now
+        owns it; False when any lease for the key already exists."""
+        raise NotImplementedError
+
+    def release(self, key: int, owner: Optional[str] = None) -> bool:
+        """Remove the key's lease; True iff this call removed it.  With
+        ``owner`` given, remove ONLY a lease that owner holds (a lease
+        re-acquired by a peer after a reclaim survives the original
+        owner's late release).  An unreadable/ownerless lease record
+        yields to the release — existence is the contract, the payload
+        is diagnostic."""
+        raise NotImplementedError
+
+    def heartbeat(self, key: int, owner: str) -> bool:
+        """Refresh the lease's liveness stamp.  True iff the lease still
+        exists AND is owned by ``owner``; False means the claim was
+        released, reclaimed, or stolen — the caller must stop treating
+        the key as held."""
+        raise NotImplementedError
+
+    def age_s(self, key: int, now=None) -> Optional[float]:
+        """Seconds since the last acquire/heartbeat stamp, clamped >= 0;
+        None when no lease exists."""
+        raise NotImplementedError
+
+    def break_stale(self, key: int, ttl_s: float, now=None) -> bool:
+        """Reclaim the key's lease iff its age exceeds ``ttl_s +
+        skew_tolerance_s``; True iff this call removed it."""
+        raise NotImplementedError
+
+    def owner_of(self, key: int) -> Optional[str]:
+        """The lease's recorded owner, None when no lease exists (or
+        the payload is unreadable — the lease itself may still exist;
+        probe with ``age_s``)."""
+        raise NotImplementedError
+
+    def list_keys(self) -> List[int]:
+        """Every key with a live lease record, any owner (leak audit)."""
+        raise NotImplementedError
+
+    def lease_names(self) -> List[str]:
+        """Audit spelling of every live lease (the shared-dir backend
+        returns real file paths; others synthesize the same naming)."""
+        return [f"lease_{fingerprint_hex(k)}{LEASE_SUFFIX}"
+                for k in sorted(self.list_keys())]
+
+    def close(self) -> None:
+        """Release backend resources (sockets); leases are NOT touched —
+        a closing process's held leases reclaim through the TTL."""
+
+
+class SharedDirBackend(LeaseBackend):
+    """Lease files in one shared directory — the pre-ISSUE-16 protocol
+    behind the trait, byte-compatible (``lease_<hex>.lease``, O_EXCL
+    create, mtime staleness).  Single-host-N-process scope: it trusts
+    one filesystem's atomic create and one wall clock.
+
+    ``release``/``heartbeat`` owner checks are read-then-act (the
+    filesystem has no conditional delete); the TOCTOU window is
+    microseconds against a reclaim that already took the TTL to open,
+    honest for this backend's scope — the CAS backend closes the same
+    race by version."""
+
+    name = "shared-dir"
+
+    def __init__(self, root: str, skew_tolerance_s: float = 0.0):
+        self.root = str(root)
+        self.skew_tolerance_s = float(skew_tolerance_s)
+
+    def _path(self, key: int) -> str:
+        return os.path.join(self.root,
+                            f"lease_{fingerprint_hex(key)}{LEASE_SUFFIX}")
+
+    def _resolve(self, key: int) -> str:
+        """The canonical (zero-padded) path, or an EXISTING alternate
+        hex spelling of the same key — pre-trait sweeps globbed the
+        directory and acted on whatever file was there, so the sweep
+        path must still find e.g. ``lease_feedbeef.lease`` even though
+        new claims always write the padded form."""
+        path = self._path(key)
+        if os.path.exists(path):
+            return path
+        for cand in glob.glob(os.path.join(
+                self.root, f"lease_*{LEASE_SUFFIX}")):
+            stem = os.path.basename(cand)[len("lease_"):-len(LEASE_SUFFIX)]
+            try:
+                if key_from_hex(stem) == int(key):
+                    return cand
+            except ValueError:
+                continue
+        return path
+
+    def try_acquire(self, key: int, owner: str) -> bool:
+        return acquire_lease(self._path(key), owner=owner)
+
+    def release(self, key: int, owner: Optional[str] = None) -> bool:
+        path = self._path(key)
+        if owner is not None:
+            rec = read_lease(path)
+            if rec is None:
+                return False
+            holder = rec.get("owner")
+            if holder is not None and holder != str(owner):
+                return False     # a peer re-acquired it: not ours to drop
+        return release_lease(path)
+
+    def heartbeat(self, key: int, owner: str) -> bool:
+        path = self._path(key)
+        rec = read_lease(path)
+        if rec is None:
+            return False         # released/reclaimed: we no longer hold it
+        holder = rec.get("owner")
+        if holder is not None and holder != str(owner):
+            return False         # reclaimed AND re-acquired by a peer
+        try:
+            os.utime(path)
+        except OSError:
+            return False         # vanished between read and touch
+        return True
+
+    def age_s(self, key: int, now=None) -> Optional[float]:
+        return lease_age_s(self._resolve(key), now=now)
+
+    def break_stale(self, key: int, ttl_s: float, now=None) -> bool:
+        return break_stale_lease(self._resolve(key), ttl_s, now=now,
+                                 tolerance_s=self.skew_tolerance_s)
+
+    def owner_of(self, key: int) -> Optional[str]:
+        rec = read_lease(self._resolve(key))
+        return None if rec is None else rec.get("owner")
+
+    def list_keys(self) -> List[int]:
+        out = []
+        for path in glob.glob(os.path.join(
+                self.root, f"lease_*{LEASE_SUFFIX}")):
+            stem = os.path.basename(path)[len("lease_"):-len(LEASE_SUFFIX)]
+            try:
+                out.append(key_from_hex(stem))
+            except ValueError:
+                continue         # foreign file matching the glob: not ours
+        return sorted(out)
+
+    def lease_names(self) -> List[str]:
+        # real paths, sorted — the pre-trait ``lease_files()`` spelling
+        return sorted(glob.glob(os.path.join(
+            self.root, f"lease_*{LEASE_SUFFIX}")))
+
+
+class _Rec:
+    """One CAS lease record: owner + liveness stamp + version (the
+    conditional-put token)."""
+
+    __slots__ = ("owner", "stamp", "version")
+
+    def __init__(self, owner: str, stamp: float):
+        self.owner = owner
+        self.stamp = stamp
+        self.version = 1
+
+
+class MemoryCASBackend(LeaseBackend):
+    """Object-store conditional-put semantics over an in-memory dict:
+
+    * acquire  = put-if-absent (one writer wins, the CAS primitive);
+    * heartbeat = read; if owner matches, bump stamp AND version;
+    * reclaim  = read (stamp, version); if stale, delete-if-version —
+      a heartbeat that lands between the read and the delete bumps the
+      version and the delete is REFUSED, so a live owner can never lose
+      its lease to a reclaimer that raced its beat (the race the
+      shared-dir backend can only shrink, closed exactly here).
+
+    ``clock`` is injectable for deterministic staleness tests; the
+    default is the wall clock (leases coordinate processes)."""
+
+    name = "memory-cas"
+
+    def __init__(self, clock=None, skew_tolerance_s: float = 0.0):
+        self._recs: Dict[int, _Rec] = {}
+        self._lock = threading.Lock()
+        self._clock = clock if clock is not None else time.time
+        self.skew_tolerance_s = float(skew_tolerance_s)
+
+    def try_acquire(self, key: int, owner: str) -> bool:
+        key = int(key)
+        with self._lock:
+            if key in self._recs:
+                return False
+            self._recs[key] = _Rec(str(owner), float(self._clock()))
+            return True
+
+    def release(self, key: int, owner: Optional[str] = None) -> bool:
+        key = int(key)
+        with self._lock:
+            rec = self._recs.get(key)
+            if rec is None:
+                return False
+            if (owner is not None and rec.owner is not None
+                    and rec.owner != str(owner)):
+                return False
+            del self._recs[key]
+            return True
+
+    def heartbeat(self, key: int, owner: str) -> bool:
+        key = int(key)
+        with self._lock:
+            rec = self._recs.get(key)
+            if rec is None or rec.owner != str(owner):
+                return False
+            rec.stamp = float(self._clock())
+            rec.version += 1
+            return True
+
+    def age_s(self, key: int, now=None) -> Optional[float]:
+        key = int(key)
+        with self._lock:
+            rec = self._recs.get(key)
+            if rec is None:
+                return None
+            now = float(self._clock()) if now is None else float(now)
+            return max(0.0, now - rec.stamp)
+
+    def break_stale(self, key: int, ttl_s: float, now=None) -> bool:
+        key = int(key)
+        with self._lock:
+            rec = self._recs.get(key)
+            if rec is None:
+                return False
+            now_v = float(self._clock()) if now is None else float(now)
+            age = max(0.0, now_v - rec.stamp)
+            if age <= float(ttl_s) + self.skew_tolerance_s:
+                return False
+            version = rec.version
+            # delete-if-version: under this lock the re-read is trivially
+            # current, but the shape is the remote-CAS contract — a beat
+            # between the staleness read and the delete MUST refuse it
+            cur = self._recs.get(key)
+            if cur is None or cur.version != version:
+                return False
+            del self._recs[key]
+            return True
+
+    def owner_of(self, key: int) -> Optional[str]:
+        with self._lock:
+            rec = self._recs.get(int(key))
+            return None if rec is None else rec.owner
+
+    def list_keys(self) -> List[int]:
+        with self._lock:
+            return sorted(self._recs)
+
+    # -- test hook ----------------------------------------------------------
+
+    def backdate(self, key: int, dt_s: float) -> None:
+        """Age one lease by ``dt_s`` (conformance-suite staleness hook —
+        the dict analogue of ``os.utime`` backdating a lease file)."""
+        with self._lock:
+            rec = self._recs.get(int(key))
+            if rec is not None:
+                rec.stamp -= float(dt_s)
+
+
+# -- the loopback CAS: same semantics, across real processes ----------------
+
+_CAS_OPS = {"try_acquire", "release", "heartbeat", "age_s",
+            "break_stale", "owner_of", "list_keys", "backdate", "ping"}
+
+
+class _CASHandler(socketserver.StreamRequestHandler):
+    """One connection, many line-JSON requests: ``{"op": ..., ...}`` in,
+    ``{"r": <result>}`` (or ``{"err": ...}``) out.  Every op executes
+    under the wrapped backend's lock, so each request is atomic — the
+    server IS the serialization point, exactly the role an object
+    store's conditional-put API plays."""
+
+    def handle(self):
+        backend: MemoryCASBackend = self.server.backend
+        for line in self.rfile:
+            try:
+                req = json.loads(line.decode("utf-8"))
+                op = req.pop("op")
+                if op not in _CAS_OPS:
+                    raise ValueError(f"unknown CAS op {op!r}")
+                r = (True if op == "ping"
+                     else getattr(backend, op)(**req))
+                resp = {"r": r}
+            except Exception as e:   # a bad request must not kill the server
+                resp = {"err": f"{type(e).__name__}: {e}"}
+            try:
+                self.wfile.write((json.dumps(resp) + "\n").encode("utf-8"))
+            except OSError:
+                return
+
+
+class _CASTCPServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class CASServer:
+    """A ``MemoryCASBackend`` served over loopback TCP so separate
+    processes share one CAS authority.  ``address`` is ``host:port``
+    (ephemeral port when constructed with ``port=0``)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 clock=None, skew_tolerance_s: float = 0.0):
+        self.backend = MemoryCASBackend(
+            clock=clock, skew_tolerance_s=skew_tolerance_s)
+        self._srv = _CASTCPServer((host, int(port)), _CASHandler)
+        self._srv.backend = self.backend
+        self.host, self.port = self._srv.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "CASServer":
+        # poll_interval bounds how long ``shutdown()`` blocks (the
+        # default 0.5 s charges every short-lived server a teardown tax)
+        self._thread = threading.Thread(
+            target=lambda: self._srv.serve_forever(poll_interval=0.05),
+            name="cas-server", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+
+    def __enter__(self) -> "CASServer":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()
+
+
+class LoopbackCASBackend(LeaseBackend):
+    """Client half of ``CASServer``: every trait op is one line-JSON
+    round trip on a persistent per-backend connection (re-dialed on
+    failure).  Substrate failures surface as ``ConnectionError`` — the
+    store's ``LEASE_BACKEND_FAULT`` degrade path owns them."""
+
+    name = "loopback-cas"
+
+    def __init__(self, address: str, timeout_s: float = 10.0):
+        host, _, port = str(address).rpartition(":")
+        self.address = str(address)
+        self._host, self._port = host or "127.0.0.1", int(port)
+        self._timeout_s = float(timeout_s)
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+
+    def _call(self, op: str, **kw):
+        with self._lock:
+            for attempt in (0, 1):   # one re-dial on a dropped connection
+                try:
+                    if self._sock is None:
+                        self._sock = socket.create_connection(
+                            (self._host, self._port),
+                            timeout=self._timeout_s)
+                        self._rfile = self._sock.makefile("rb")
+                    self._sock.sendall(
+                        (json.dumps(dict(kw, op=op)) + "\n").encode())
+                    line = self._rfile.readline()
+                    if line:
+                        break
+                    raise ConnectionError("CAS server closed connection")
+                except (OSError, ConnectionError):
+                    self._close_locked()
+                    if attempt:
+                        raise
+            resp = json.loads(line.decode("utf-8"))
+        if "err" in resp:
+            raise ConnectionError(f"CAS backend error: {resp['err']}")
+        return resp["r"]
+
+    def _close_locked(self) -> None:
+        if self._rfile is not None:
+            try:
+                self._rfile.close()
+            except OSError:
+                pass
+            self._rfile = None
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def try_acquire(self, key: int, owner: str) -> bool:
+        return bool(self._call("try_acquire", key=int(key),
+                               owner=str(owner)))
+
+    def release(self, key: int, owner: Optional[str] = None) -> bool:
+        return bool(self._call("release", key=int(key), owner=owner))
+
+    def heartbeat(self, key: int, owner: str) -> bool:
+        return bool(self._call("heartbeat", key=int(key),
+                               owner=str(owner)))
+
+    def age_s(self, key: int, now=None) -> Optional[float]:
+        return self._call("age_s", key=int(key), now=now)
+
+    def break_stale(self, key: int, ttl_s: float, now=None) -> bool:
+        return bool(self._call("break_stale", key=int(key),
+                               ttl_s=float(ttl_s), now=now))
+
+    def owner_of(self, key: int) -> Optional[str]:
+        return self._call("owner_of", key=int(key))
+
+    def list_keys(self) -> List[int]:
+        return [int(k) for k in self._call("list_keys")]
+
+    def backdate(self, key: int, dt_s: float) -> None:
+        self._call("backdate", key=int(key), dt_s=float(dt_s))
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()
+
+
+def make_backend(spec: str, root: Optional[str] = None,
+                 skew_tolerance_s: float = 0.0) -> LeaseBackend:
+    """Backend from a CLI spelling: ``dir`` (shared-directory default;
+    needs ``root``), ``cas:<host>:<port>`` (loopback CAS client), or
+    ``memory`` (single-process CAS, tests)."""
+    spec = str(spec)
+    if spec == "dir":
+        if root is None:
+            raise ValueError("lease backend 'dir' requires a store root")
+        return SharedDirBackend(root, skew_tolerance_s=skew_tolerance_s)
+    if spec.startswith("cas:"):
+        return LoopbackCASBackend(spec[len("cas:"):])
+    if spec == "memory":
+        return MemoryCASBackend(skew_tolerance_s=skew_tolerance_s)
+    raise ValueError(
+        f"unknown lease backend {spec!r} (expected 'dir', 'memory', or "
+        "'cas:<host>:<port>')")

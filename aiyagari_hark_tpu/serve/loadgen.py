@@ -355,6 +355,11 @@ class FleetReport(NamedTuple):
     value_divergence: int       # keys whose served VALUE fields ever
     #                             disagreed across responses (MUST be 0:
     #                             loser-serves-winner bit-identity)
+    chaos: Optional[dict] = None  # the chaos campaign's ledger when a
+    #                             ChaosPlan ran (ISSUE 16): per-drill
+    #                             records, injected/detected counts,
+    #                             drilled dedup ratio, availability and
+    #                             churn/hedge accounting
 
 
 def generate_fleet_arrivals(spec: FleetSpec, worker: int) -> list:
@@ -377,61 +382,77 @@ def generate_fleet_arrivals(spec: FleetSpec, worker: int) -> list:
     return out
 
 
-def _spawn_fleet(spec: FleetSpec, store_dir: str,
-                 journal_paths: list, ready_timeout_s: float):
-    """Start ``n_workers`` ``serve.fleet`` worker processes over one
-    shared store; returns ``(procs, urls)`` once every worker printed
-    FLEET_READY."""
+def _spawn_worker(spec: FleetSpec, store_dir: str, journal_path: str,
+                  owner: str, chaos: bool = False):
+    """Start ONE ``serve.fleet`` worker process over the shared store
+    (does not wait for readiness — pair with ``_await_ready``)."""
     import json as _json
     import subprocess
     import sys
 
-    procs, urls = [], []
-    cells_json = _json.dumps([list(c) for c in spec.cells])
-    for i in range(spec.n_workers):
-        cmd = [sys.executable, "-m", "aiyagari_hark_tpu.serve.fleet",
-               "--store", store_dir, "--owner", f"w{i}",
-               "--kwargs", _json.dumps(spec.model_kwargs),
-               "--scenario", spec.scenario,
-               "--lease-ttl", str(spec.lease_ttl_s),
-               "--max-batch", str(spec.max_batch),
-               "--journal", journal_paths[i],
-               "--max-seconds", "600"]
-        if spec.prefetch_k > 0:
-            cmd += ["--prefetch-k", str(spec.prefetch_k),
-                    "--prefetch-cells", cells_json]
-        procs.append(subprocess.Popen(
-            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-            text=True))
+    cmd = [sys.executable, "-m", "aiyagari_hark_tpu.serve.fleet",
+           "--store", store_dir, "--owner", owner,
+           "--kwargs", _json.dumps(spec.model_kwargs),
+           "--scenario", spec.scenario,
+           "--lease-ttl", str(spec.lease_ttl_s),
+           "--max-batch", str(spec.max_batch),
+           "--journal", journal_path,
+           "--max-seconds", "600"]
+    if spec.prefetch_k > 0:
+        cmd += ["--prefetch-k", str(spec.prefetch_k),
+                "--prefetch-cells",
+                _json.dumps([list(c) for c in spec.cells])]
+    if chaos:
+        cmd += ["--chaos"]
+    return subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True)
+
+
+def _await_ready(proc, label, watch: Stopwatch,
+                 ready_timeout_s: float) -> int:
+    """Block until one worker prints FLEET_READY; returns its port.
+    ``watch`` carries the shared budget across a whole pool spawn."""
     import selectors
 
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stdout, selectors.EVENT_READ)
+    try:
+        while True:
+            # the timeout must bound the BLOCKED wait too: a
+            # silent-but-alive worker (hung before its READY
+            # print) would otherwise defeat it — readline alone
+            # only returns on a line or on process exit
+            left = ready_timeout_s - watch.elapsed()
+            if left <= 0 or not sel.select(timeout=left):
+                raise RuntimeError(
+                    f"fleet worker {label} not ready in "
+                    f"{ready_timeout_s:g}s")
+            line = proc.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    f"fleet worker {label} exited before "
+                    f"FLEET_READY (rc={proc.poll()})")
+            if line.startswith("FLEET_READY"):
+                return int(line.split("port=")[1].split()[0])
+    finally:
+        sel.close()
+
+
+def _spawn_fleet(spec: FleetSpec, store_dir: str,
+                 journal_paths: list, ready_timeout_s: float,
+                 chaos: bool = False):
+    """Start ``n_workers`` ``serve.fleet`` worker processes over one
+    shared store; returns ``(procs, urls)`` once every worker printed
+    FLEET_READY."""
+    procs, urls = [], []
+    for i in range(spec.n_workers):
+        procs.append(_spawn_worker(spec, store_dir, journal_paths[i],
+                                   f"w{i}", chaos=chaos))
     watch = Stopwatch()
     try:
         for i, proc in enumerate(procs):
-            port = None
-            sel = selectors.DefaultSelector()
-            sel.register(proc.stdout, selectors.EVENT_READ)
-            try:
-                while True:
-                    # the timeout must bound the BLOCKED wait too: a
-                    # silent-but-alive worker (hung before its READY
-                    # print) would otherwise defeat it — readline alone
-                    # only returns on a line or on process exit
-                    left = ready_timeout_s - watch.elapsed()
-                    if left <= 0 or not sel.select(timeout=left):
-                        raise RuntimeError(
-                            f"fleet worker {i} not ready in "
-                            f"{ready_timeout_s:g}s")
-                    line = proc.stdout.readline()
-                    if not line:
-                        raise RuntimeError(
-                            f"fleet worker {i} exited before "
-                            f"FLEET_READY (rc={proc.poll()})")
-                    if line.startswith("FLEET_READY"):
-                        port = int(line.split("port=")[1].split()[0])
-                        break
-            finally:
-                sel.close()
+            port = _await_ready(proc, i, watch, ready_timeout_s)
             urls.append(f"http://127.0.0.1:{port}")
     except BaseException:
         for p in procs:
@@ -440,9 +461,92 @@ def _spawn_fleet(spec: FleetSpec, store_dir: str,
     return procs, urls
 
 
+class FleetCtl:
+    """Live handle on a spawned worker pool: the interface the chaos
+    drills (``serve.chaos.run_drills``) consume.  Everything goes
+    through public surfaces — HTTP endpoints, process state, journal
+    files — never through harness-private flags, so a drill's detection
+    evidence is exactly what a postmortem would read."""
+
+    def __init__(self, spec: FleetSpec, procs: list, urls: list,
+                 journal_paths: list, store_dir: str,
+                 timeout_s: float = 300.0):
+        from .fleet import FleetClient
+
+        self._spec = spec
+        self.procs = procs
+        self.urls = urls
+        self.journal_paths = journal_paths
+        self.store_dir = store_dir
+        self.lease_ttl_s = float(spec.lease_ttl_s)
+        # a BARE client (no retry/hedge): a drill's query must reach
+        # exactly the worker it targets, with only connection failover
+        self._client = FleetClient(list(urls), timeout=timeout_s)
+        self._client.urls = urls   # live alias: joins become visible
+
+    def alive(self, i: int) -> bool:
+        return self.procs[i].poll() is None
+
+    def returncode(self, i: int):
+        return self.procs[i].poll()
+
+    def kill(self, i: int, sig) -> None:
+        self.procs[i].send_signal(sig)
+
+    def two_live_workers(self):
+        live = [i for i in range(len(self.procs)) if self.alive(i)]
+        if len(live) < 2:
+            from .chaos import DrillError
+
+            raise DrillError(
+                f"drill needs two live workers, have {len(live)}")
+        return live[0], live[1]
+
+    def query(self, cell, prefer=None) -> dict:
+        return self._client.query(cell, self._spec.model_kwargs,
+                                  scenario=self._spec.scenario,
+                                  prefer=prefer)
+
+    def post(self, worker: int, path: str, body: dict) -> dict:
+        from urllib import request as _urlrequest
+
+        data = json.dumps(body).encode("utf-8")
+        req = _urlrequest.Request(
+            self.urls[worker] + path, data=data,
+            headers={"Content-Type": "application/json"})
+        with _urlrequest.urlopen(req, timeout=30.0) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    def fleet_info(self, worker: int):
+        """The worker's ``/fleet`` introspection dict, or None when it
+        is dead/unreachable (a drill polling a dying victim)."""
+        if not self.alive(worker):
+            return None
+        try:
+            return self._client.get(self.urls[worker], "/fleet")
+        except Exception:
+            return None
+
+
+def _publish_counts(journal_paths: list) -> dict:
+    """FLEET_PUBLISH count per key across the pool's journals — the
+    before/after ledger of the chaos recovery phase."""
+    from ..obs.journal import read_journal
+
+    counts: dict = {}
+    for jp in list(journal_paths):
+        if not os.path.exists(jp):
+            continue
+        for ev in read_journal(jp, event="FLEET_PUBLISH"):
+            k = int(ev["key"])
+            counts[k] = counts.get(k, 0) + 1
+    return counts
+
+
 def run_fleet_load(spec: FleetSpec, store_dir: str,
                    ready_timeout_s: float = 180.0,
-                   client_timeout_s: float = 300.0) -> FleetReport:
+                   client_timeout_s: float = 300.0,
+                   chaos=None) -> FleetReport:
     """Replay one fleet scenario against a freshly spawned worker pool
     sharing ``store_dir`` and aggregate the fleet-wide record.
 
@@ -456,18 +560,40 @@ def run_fleet_load(spec: FleetSpec, store_dir: str,
     Dedup accounting comes from the workers' event journals (one
     FLEET_PUBLISH per completed claim, key attached) — journals survive
     the drilled worker's death, so the killed worker's solves still
-    count."""
+    count.
+
+    ``chaos`` (ISSUE 16): a ``serve.chaos.ChaosPlan``.  Workers spawn
+    with ``--chaos`` (the arm endpoint), the plan's churn schedule
+    (join/leave) runs DURING the replay, the client gains the typed
+    retry + hedging policies, and after the replay every drill runs
+    sequentially against the live pool, followed by a recovery phase
+    whose duplicate publishes are ledgered.  The campaign's record
+    lands in ``FleetReport.chaos``; the headline ``dedup_ratio`` then
+    covers NON-drill keys only (drill keys carry their own accounting,
+    expected duplicates separated from violations)."""
     import signal
 
     from ..obs.journal import read_journal
-    from .fleet import FleetClient, FleetHTTPError
+    from .fleet import FleetClient, FleetHTTPError, HedgePolicy, RetryPolicy
 
     os.makedirs(store_dir, exist_ok=True)
     journal_paths = [os.path.join(store_dir, f"journal_w{i}.jsonl")
                      for i in range(spec.n_workers)]
     procs, urls = _spawn_fleet(spec, store_dir, journal_paths,
-                               ready_timeout_s)
-    client = FleetClient(urls, timeout=client_timeout_s)
+                               ready_timeout_s, chaos=chaos is not None)
+    harness_obs = None
+    if chaos is not None:
+        from ..obs.runtime import ObsConfig, build_obs
+
+        harness_obs = build_obs(ObsConfig(
+            enabled=True,
+            journal_path=os.path.join(store_dir,
+                                      "journal_harness.jsonl")))
+    client = (FleetClient(urls, timeout=client_timeout_s)
+              if chaos is None else
+              FleetClient(urls, timeout=client_timeout_s,
+                          retry=RetryPolicy(), hedge=HedgePolicy(),
+                          obs=harness_obs))
     traces = [generate_fleet_arrivals(spec, i)
               for i in range(spec.n_workers)]
     trace_digest = hashlib.blake2b(
@@ -481,18 +607,24 @@ def run_fleet_load(spec: FleetSpec, store_dir: str,
                            scenario=spec.scenario, prefer=0)
         warm_keys.add(int(res["key"]))
 
+    if chaos is not None:
+        client.urls = urls   # live alias: churn joins become visible
+
     outcomes_by_worker = [[] for _ in range(spec.n_workers)]
     walls_by_path: dict = {}
     hit_keys: set = set()
     served_values: dict = {}
     value_divergence = 0
     unresolved = 0
+    dispatched = 0
     lock = threading.Lock()
     drill_fired = threading.Event()
 
     def _client_loop(i: int) -> None:
-        nonlocal unresolved, value_divergence
+        nonlocal unresolved, value_divergence, dispatched
         for k, (cell, priority) in enumerate(traces[i]):
+            with lock:
+                dispatched += 1
             if (spec.sigterm_worker is not None
                     and i == spec.sigterm_worker
                     and k == spec.sigterm_after
@@ -548,6 +680,58 @@ def run_fleet_load(spec: FleetSpec, store_dir: str,
             with lock:
                 outcomes_by_worker[i].append(outcome)
 
+    # elasticity schedule (ISSUE 16): scripted joins/leaves applied
+    # while the replay is live, keyed on the fleet-wide dispatch count.
+    # A leave SIGTERMs (graceful, exit 75, leases TTL-reclaimed); a
+    # join spawns a fresh --chaos worker into the pool (reachable via
+    # failover and hedges).  Both are journaled to the harness journal.
+    churn_counts = {"joins": 0, "leaves": 0}
+    churn_left: set = set()
+    churn_stop = threading.Event()
+    churn_thread = None
+
+    def _churn_loop() -> None:
+        import time as _time
+
+        for after, action, widx in sorted(chaos.churn):
+            while not churn_stop.is_set():
+                with lock:
+                    if dispatched >= int(after):
+                        break
+                _time.sleep(0.02)
+            else:
+                return   # replay over before this event came due
+            if action == "leave":
+                w = widx if widx is not None else len(procs) - 1
+                if procs[w].poll() is None:
+                    churn_left.add(w)
+                    procs[w].send_signal(signal.SIGTERM)
+                    churn_counts["leaves"] += 1
+                    harness_obs.event("WORKER_LEAVE", worker=w,
+                                      owner=f"w{w}", after=int(after))
+            elif action == "join":
+                idx = len(procs)
+                jp = os.path.join(store_dir, f"journal_w{idx}.jsonl")
+                proc = _spawn_worker(spec, store_dir, jp, f"w{idx}",
+                                     chaos=True)
+                try:
+                    port = _await_ready(proc, idx, Stopwatch(),
+                                        ready_timeout_s)
+                except Exception:
+                    proc.kill()
+                    raise
+                journal_paths.append(jp)
+                procs.append(proc)
+                urls.append(f"http://127.0.0.1:{port}")
+                churn_counts["joins"] += 1
+                harness_obs.event("WORKER_JOIN", worker=idx,
+                                  owner=f"w{idx}", after=int(after))
+
+    if chaos is not None and chaos.churn:
+        churn_thread = threading.Thread(target=_churn_loop,
+                                        name="fleet-churn", daemon=True)
+        churn_thread.start()
+
     threads = [threading.Thread(target=_client_loop, args=(i,),
                                 name=f"fleet-client-{i}")
                for i in range(spec.n_workers)]
@@ -557,6 +741,41 @@ def run_fleet_load(spec: FleetSpec, store_dir: str,
         t.join(client_timeout_s + 60.0)
         if t.is_alive():
             unresolved += 1
+    churn_stop.set()
+    if churn_thread is not None:
+        churn_thread.join(ready_timeout_s)
+
+    # chaos campaign (ISSUE 16): every drill sequentially against the
+    # live pool, then a recovery phase whose duplicate publishes are
+    # ledgered (a re-publish of an already-published key after the
+    # drills is an exactly-once violation, not noise)
+    drill_info = None
+    recovery_served = recovery_errors = recovery_dup = 0
+    if chaos is not None:
+        from .chaos import run_drills
+
+        ctl = FleetCtl(spec, procs, urls, list(journal_paths) + [
+            os.path.join(store_dir, "journal_harness.jsonl")],
+            store_dir, timeout_s=client_timeout_s)
+        try:
+            drill_info = run_drills(chaos, ctl)
+            pubs_before = _publish_counts(journal_paths)
+            for k in range(int(chaos.recovery_queries)):
+                cell = spec.cells[k % len(spec.cells)]
+                try:
+                    ctl.query(cell)
+                    recovery_served += 1
+                except Exception:
+                    recovery_errors += 1
+            pubs_after = _publish_counts(journal_paths)
+            recovery_dup = sum(
+                pubs_after[k] - n for k, n in pubs_before.items()
+                if pubs_after.get(k, 0) > n)
+        except BaseException:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            raise
 
     # final snapshots from live workers, then graceful shutdown
     worker_snapshots = []
@@ -569,11 +788,13 @@ def run_fleet_load(spec: FleetSpec, store_dir: str,
             pass
     rcs: dict = {}
     for i, proc in enumerate(procs):
-        # the drilled worker already received its SIGTERM; a second one
-        # landing after its preemption_guard exited (handlers restored)
-        # would kill it mid-cleanup with the default action
-        if proc.poll() is None and not (drill_fired.is_set()
-                                        and i == spec.sigterm_worker):
+        # the drilled worker already received its SIGTERM (so did any
+        # churn-departed worker); a second one landing after its
+        # preemption_guard exited (handlers restored) would kill it
+        # mid-cleanup with the default action
+        if proc.poll() is None and i not in churn_left \
+                and not (drill_fired.is_set()
+                         and i == spec.sigterm_worker):
             proc.send_signal(signal.SIGTERM)
     for i, proc in enumerate(procs):
         try:
@@ -638,7 +859,15 @@ def run_fleet_load(spec: FleetSpec, store_dir: str,
         for o in seq:
             counts[o] = counts.get(o, 0) + 1
     arrivals = sum(len(s) for s in outcomes_by_worker)
-    distinct = len(set(publishes))
+    # headline dedup stays the CLEAN ledger: when a chaos campaign ran,
+    # its drill keys (which legitimately re-publish under torn-entry /
+    # stalled-winner / skewed-election faults) get their own accounting
+    # below — mixing them in would make the exactly-once invariant
+    # unfalsifiable
+    drill_keys = (set() if drill_info is None
+                  else set(drill_info["drill_keys"]))
+    main_pubs = [k for k in publishes if k not in drill_keys]
+    distinct = len(set(main_pubs))
     converted = len({k for k in spec_published
                      if k in hit_keys and k not in warm_keys})
 
@@ -653,18 +882,53 @@ def run_fleet_load(spec: FleetSpec, store_dir: str,
                       for s in worker_snapshots)
     claims_lost = sum(int(s.get("fleet_claims_lost", 0))
                       for s in worker_snapshots)
+    all_walls = [w for v in walls_by_path.values() for w in v]
+    p50_ms = {p: _pctl(v, 50) for p, v in walls_by_path.items()}
+    p99_ms = {p: _pctl(v, 99) for p, v in walls_by_path.items()}
+    p50_ms["all"] = _pctl(all_walls, 50)
+    p99_ms["all"] = _pctl(all_walls, 99)
+
+    chaos_rec = None
+    if drill_info is not None:
+        served = sum(v for o, v in counts.items()
+                     if o.startswith("served:"))
+        # the DRILLED dedup ratio: every publish except the drills'
+        # EXPECTED duplicates must still be exactly-once — what remains
+        # above 1.0 is a real protocol violation
+        expected = set(drill_info["expected_dup_keys"])
+        honest = [k for k in publishes if k not in expected]
+        chaos_rec = {
+            "drills": drill_info["drills"],
+            "injected": int(drill_info["injected"]),
+            "detected": int(drill_info["detected"]),
+            "dedup_ratio": (None if not honest else
+                            round(len(honest) / len(set(honest)), 4)),
+            "recovery_dup_publishes": int(recovery_dup),
+            "recovery_served": int(recovery_served),
+            "recovery_errors": int(recovery_errors),
+            "availability": (None if arrivals == 0
+                             else round(served / arrivals, 4)),
+            "churn_p99_ms": p99_ms["all"],
+            "joins": churn_counts["joins"],
+            "leaves": churn_counts["leaves"],
+            "kills": sum(1 for p in procs
+                         if p.poll() == -int(signal.SIGKILL)),
+            "hedges": client.hedge_counts(),
+        }
+    if harness_obs is not None:
+        harness_obs.close()
     return FleetReport(
         workers=spec.n_workers, arrivals=arrivals, counts=counts,
         outcomes_by_worker=outcomes_by_worker, unresolved=unresolved,
-        p50_ms={p: _pctl(v, 50) for p, v in walls_by_path.items()},
-        p99_ms={p: _pctl(v, 99) for p, v in walls_by_path.items()},
-        cold_solves=len(publishes), distinct_published=distinct,
+        p50_ms=p50_ms, p99_ms=p99_ms,
+        cold_solves=len(main_pubs), distinct_published=distinct,
         dedup_ratio=(None if distinct == 0
-                     else round(len(publishes) / distinct, 4)),
+                     else round(len(main_pubs) / distinct, 4)),
         prefetch_issued=prefetch_issued, prefetch_converted=converted,
         remote_hits=remote_hits, claims_won=claims_won,
         claims_lost=claims_lost, lease_reclaims=reclaims,
         leases_leaked=leaked, interrupted_rcs=rcs,
         interrupted_journaled=interrupted_journaled,
         trace_digest=trace_digest, worker_snapshots=worker_snapshots,
-        served_values=served_values, value_divergence=value_divergence)
+        served_values=served_values, value_divergence=value_divergence,
+        chaos=chaos_rec)

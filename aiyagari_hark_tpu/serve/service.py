@@ -1683,6 +1683,13 @@ class EquilibriumService:
         if self._fleet and not interrupt_requested():
             for key in self.store.held_leases():
                 self.store.release(key)
+        # heartbeat hygiene (ISSUE 16): stop the store's lease-heartbeat
+        # thread deterministically — no thread may outlive the service
+        # that owns the store.  Leases were returned above on the clean
+        # path; on the interrupted path close(release_leases=False)
+        # leaves them for the TTL reclaim, by design.
+        if self._fleet and hasattr(self.store, "close"):
+            self.store.close(release_leases=False)
         # observability run-end (ISSUE 7): mirror the metrics snapshot
         # into the registry, then flush trace/journal iff this service
         # owns the bundle (an ObsConfig was passed; a shared Obs belongs

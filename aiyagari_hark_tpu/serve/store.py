@@ -58,7 +58,17 @@ fingerprint: leases older than ``lease_ttl_s`` are BROKEN by any
 claimant (``FLEET_LEASE_RECLAIM`` journaled) and the reclaimer solves.
 ``get`` under ``shared`` additionally probes the disk directory for
 keys the in-memory index has never seen — a peer's publish after this
-process's index load must become servable without a restart."""
+process's index load must become servable without a restart.
+
+Coordination backend (ISSUE 16, DESIGN §14): the claim/heartbeat/
+reclaim mechanics live behind the ``serve.lease.LeaseBackend`` trait —
+``SharedDirBackend`` (lease files over this directory; the default,
+byte-compatible with pre-trait stores) or any conformant peer (the
+in-memory/loopback CAS backend models object-store conditional-put).
+Backend substrate faults degrade typed (``LEASE_BACKEND_FAULT``
+journaled, the operation fails SAFE); the backend decides who solves,
+never what a solve produces — entry bytes and fingerprints are backend-
+independent."""
 
 from __future__ import annotations
 
@@ -76,14 +86,11 @@ from ..solver_health import is_failure
 from ..utils.checkpoint import (
     CORRUPT_NPZ_ERRORS,
     LEASE_SUFFIX,
-    acquire_lease,
-    break_stale_lease,
-    lease_age_s,
     load_pytree,
-    release_lease,
     save_pytree,
 )
 from ..utils.fingerprint import fingerprint_hex, packed_row_checksum
+from .lease import LeaseBackend, SharedDirBackend
 
 # verify.certificate.UNCERTIFIED, inlined to keep this module's imports
 # host-cheap (the certificate module is imported lazily by the service);
@@ -194,7 +201,8 @@ class SolutionStore:
                  disk_path: Optional[str] = None,
                  donor_cutoff: float = float("inf"), obs=None,
                  shared: bool = False, lease_ttl_s: float = 30.0,
-                 owner: str = ""):
+                 owner: str = "",
+                 lease_backend: Optional[LeaseBackend] = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         if shared and disk_path is None:
@@ -206,22 +214,53 @@ class SolutionStore:
         # fleet tier (ISSUE 15): shared enables the claim/lease protocol
         # and the unknown-key disk probe; lease_ttl_s is the stale-lease
         # reclaim horizon; owner is a diagnostic worker id stamped into
-        # lease payloads (election correctness never reads it)
+        # lease payloads (election correctness never reads it).
+        # lease_backend (ISSUE 16) is the pluggable coordination
+        # authority behind the protocol — default the shared-dir
+        # backend over the store directory (the pre-trait behavior,
+        # byte-compatible); backend choice never enters solution
+        # fingerprints or served bytes.
         self.shared = bool(shared)
         self.lease_ttl_s = float(lease_ttl_s)
         self.owner = str(owner)
+        if lease_backend is not None and not shared:
+            raise ValueError(
+                "lease_backend requires SolutionStore(shared=True): "
+                "the claim protocol only exists on the shared tier")
+        if isinstance(lease_backend, str):
+            # accept the worker-flag spec spelling ("dir"/"cas:host:port"/
+            # "memory") directly — a raw string would otherwise fail only
+            # at the FIRST claim, deep inside _backend_call
+            from .lease import make_backend
+            lease_backend = make_backend(lease_backend, root=disk_path)
+        self.lease_backend: Optional[LeaseBackend] = (
+            (lease_backend if lease_backend is not None
+             else SharedDirBackend(disk_path)) if shared else None)
         self._held: set = set()          # keys whose lease WE hold
         self._published_keys: list = []  # keys this store published
         self._fleet = {"fleet_claims_won": 0, "fleet_claims_lost": 0,
-                       "fleet_publishes": 0, "fleet_lease_reclaims": 0}
-        # lease HEARTBEAT (ISSUE 15): a lease's mtime is refreshed every
-        # ttl/4 while its owner lives, so staleness means "the owner
-        # stopped beating" (crashed/killed), never "the solve is slower
-        # than the TTL" — without it, a first cold solve's compile wall
-        # outlives a short TTL and a LIVE winner gets its claim stolen
-        # (a measured double-solve, dedup ratio 1.5, in this PR's drill
-        # trials).  The daemon thread runs only while leases are held.
+                       "fleet_publishes": 0, "fleet_lease_reclaims": 0,
+                       "fleet_backend_faults": 0}
+        # lease HEARTBEAT (ISSUE 15): a lease's liveness stamp is
+        # refreshed every ttl/4 while its owner lives, so staleness
+        # means "the owner stopped beating" (crashed/killed), never
+        # "the solve is slower than the TTL" — without it, a first cold
+        # solve's compile wall outlives a short TTL and a LIVE winner
+        # gets its claim stolen (a measured double-solve, dedup ratio
+        # 1.5, in this PR's drill trials).  The daemon runs only while
+        # leases are held, and stops DETERMINISTICALLY (ISSUE 16
+        # satellite) on the last release, on ``close``, and on
+        # ``__del__`` — ``_hb_wake`` is the wake-now event those paths
+        # set so no thread outlives the store.
         self._hb_thread = None
+        self._hb_wake = threading.Event()
+        self._hb_beats = 0       # completed refresh rounds
+        self._hb_lost = 0        # held leases found released/stolen
+        self._closed = False
+        # chaos seams (ISSUE 16): an armable ``serve.chaos.ChaosAgent``
+        # consulted at publish / heartbeat / disk-read / staleness
+        # seams; None (the default) costs one attribute check
+        self._chaos = None
         # normalized-distance radius beyond which nominate() declines: a
         # donor across the whole lattice proposes a junk target (safe —
         # in-program verification falls back to cold — but an honest
@@ -428,6 +467,15 @@ class SolutionStore:
                         and os.path.exists(self._file(key))):
                     return None
             path = self._file(key)
+            if self._chaos is not None and self._chaos.read_fault(key):
+                # injected store partition (ISSUE 16): a TRANSIENT read
+                # failure degrades to a miss WITHOUT evicting — the
+                # bytes on disk are healthy, and deleting them would
+                # turn a partition window into a permanent cache loss
+                self._backend_fault(
+                    "disk_read", "injected partition read fault",
+                    key=key)
+                return None
             try:
                 sol = load_pytree(path, _template())
             except CORRUPT_NPZ_ERRORS as e:
@@ -490,6 +538,43 @@ class SolutionStore:
                 f"{what} requires SolutionStore(shared=True): the "
                 "claim/lease protocol only exists on the shared tier")
 
+    def _backend_call(self, op: str, default, *args, **kw):
+        """One lease-backend operation with the typed degrade: a
+        substrate fault (socket drop, I/O error) journals
+        ``LEASE_BACKEND_FAULT`` and returns ``default`` — chosen per
+        call site so a transient fault fails SAFE (an acquire fault
+        reads as "lost", a heartbeat fault keeps the claim, a reclaim
+        fault reclaims nothing)."""
+        try:
+            return getattr(self.lease_backend, op)(*args, **kw)
+        except (OSError, ConnectionError) as e:
+            self._backend_fault(op, e)
+            return default
+
+    def _backend_fault(self, op: str, detail, key=None) -> None:
+        """The lease-backend fault seam (ISSUE 16; covered by
+        ``check_obs_events``): journal + count every degraded backend
+        operation — partitions and lost leases must leave the same
+        machine-readable trail as every other typed failure."""
+        with self._lock:
+            self._fleet["fleet_backend_faults"] += 1
+        if isinstance(detail, BaseException):
+            detail = f"{type(detail).__name__}: {detail}"
+        self._obs_scope().event(
+            "LEASE_BACKEND_FAULT", op=str(op), owner=self.owner,
+            key=None if key is None else int(key), detail=str(detail))
+
+    def _chaos_now(self):
+        """The staleness clock's ``now`` override: None normally; a
+        chaos-armed skew returns a shifted wall (the duplicated-
+        election drill's injected fault)."""
+        return None if self._chaos is None else self._chaos.skew_now()
+
+    def set_chaos(self, agent) -> None:
+        """Attach a ``serve.chaos.ChaosAgent`` (fault-injection drills;
+        ``--chaos`` workers only).  None detaches."""
+        self._chaos = agent
+
     def claim(self, key: int) -> str:
         """Elect a solver for ``key`` fleet-wide.  Returns:
 
@@ -507,11 +592,10 @@ class SolutionStore:
         winner never wedges its fingerprint."""
         self._require_shared("claim")
         key = int(key)
-        lease = self._lease_file(key)
         for _ in range(2):      # once, plus once after a stale break
             if os.path.exists(self._file(key)):
                 return "published"
-            if acquire_lease(lease, owner=self.owner):
+            if self._backend_call("try_acquire", False, key, self.owner):
                 with self._lock:
                     self._held.add(key)
                     self._fleet["fleet_claims_won"] += 1
@@ -525,7 +609,8 @@ class SolutionStore:
                     self.release(key)
                     return "published"
                 return "won"
-            if break_stale_lease(lease, self.lease_ttl_s):
+            if self._backend_call("break_stale", False, key,
+                                  self.lease_ttl_s, now=self._chaos_now()):
                 with self._lock:
                     self._fleet["fleet_lease_reclaims"] += 1
                 self._obs_scope().event("FLEET_LEASE_RECLAIM", key=key,
@@ -549,6 +634,14 @@ class SolutionStore:
         ever saw (prefetch, a drilled worker's in-flight reply)."""
         self._require_shared("publish")
         key = int(sol.key)
+        if self._chaos is not None:
+            # chaos seam: an armed publish delay holds the lease
+            # mid-"solve" — the kill/stall drills' deterministic window
+            delay = self._chaos.publish_delay_s(sol.cell)
+            if delay > 0.0:
+                import time
+
+                time.sleep(delay)
         self.put(sol)
         with self._lock:
             self._fleet["fleet_publishes"] += 1
@@ -564,50 +657,119 @@ class SolutionStore:
         """Give up a held lease WITHOUT publishing (failed solve, cert
         failure, abandoned batch): the fingerprint becomes claimable
         again immediately.  Idempotent; a no-op for leases this store
-        never held."""
+        never held.  OWNER-CHECKED at the backend (ISSUE 16): a release
+        landing after a TTL reclaim + peer re-acquire must not delete
+        the peer's fresh lease.  The LAST release wakes the heartbeat
+        daemon so it exits deterministically instead of on its next
+        tick."""
         key = int(key)
         with self._lock:
             held = key in self._held
             self._held.discard(key)
+            if held and not self._held:
+                self._hb_wake.set()
         if held:
-            release_lease(self._lease_file(key))
+            self._backend_call("release", False, key, owner=self.owner)
 
     def _ensure_heartbeat_locked(self) -> None:
         """Start the lease-heartbeat daemon if it is not running
-        (``_lock`` held).  It exits on its own once nothing is held, so
-        a store that stops claiming stops threading."""
+        (``_lock`` held).  It exits on its own once nothing is held (or
+        the store closed), so a store that stops claiming stops
+        threading."""
+        if self._closed:
+            return
         if self._hb_thread is not None and self._hb_thread.is_alive():
             return
+        self._hb_wake.clear()
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, name="lease-heartbeat",
             daemon=True)
         self._hb_thread.start()
 
     def _heartbeat_loop(self) -> None:
-        import time
-
         interval = max(0.05, self.lease_ttl_s / 4.0)
         while True:
-            time.sleep(interval)
+            self._hb_wake.wait(interval)
             with self._lock:
-                held = list(self._held)
-                if not held:
+                self._hb_wake.clear()
+                if self._closed or not self._held:
                     self._hb_thread = None
                     return
+                held = list(self._held)
+            chaos = self._chaos
+            if chaos is not None and chaos.heartbeat_stalled():
+                continue     # the zombie-winner drill: alive, not beating
+            lost = []
             for key in held:
-                try:
-                    os.utime(self._lease_file(key))
-                except OSError:
-                    pass    # released/reclaimed concurrently
+                # default True: a TRANSIENT backend fault must not drop
+                # the claim (the fault itself is journaled); only a
+                # definitive "you no longer hold this" does
+                if not self._backend_call("heartbeat", True, key,
+                                          self.owner):
+                    lost.append(key)
+            with self._lock:
+                self._hb_beats += 1
+                for key in lost:
+                    self._held.discard(key)
+                    self._hb_lost += 1
+            for key in lost:
+                self._backend_fault(
+                    "heartbeat",
+                    "held lease no longer ours (released, TTL-reclaimed,"
+                    " or re-acquired by a peer) — claim dropped",
+                    key=key)
+
+    def close(self, release_leases: bool = False) -> None:
+        """Deterministically stop the heartbeat daemon (ISSUE 16
+        satellite): after ``close`` returns no store thread is running.
+        Held leases are left for TTL reclaim by default — the crashed-
+        winner protocol, and the right semantics for a dying worker —
+        or released first with ``release_leases=True`` (an orderly
+        shutdown that will not publish).  Idempotent; entries and the
+        disk tier are untouched."""
+        if release_leases:
+            for key in self.held_leases():
+                self.release(key)
+        with self._lock:
+            self._closed = True
+            t = self._hb_thread
+            self._hb_wake.set()
+        if t is not None and t is not threading.current_thread():
+            t.join(max(1.0, self.lease_ttl_s))
+        if self.lease_backend is not None:
+            self.lease_backend.close()
+
+    def __del__(self):   # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def heartbeat_health(self) -> dict:
+        """Heartbeat/lease liveness for ``/healthz`` and ``/fleet``
+        (ISSUE 16): completed refresh rounds, held-lease count, leases
+        found lost/stolen by the beat, backend identity, and whether
+        the daemon is currently running."""
+        with self._lock:
+            return {
+                "thread_alive": (self._hb_thread is not None
+                                 and self._hb_thread.is_alive()),
+                "held": len(self._held),
+                "beats": self._hb_beats,
+                "lost_leases": self._hb_lost,
+                "backend": (None if self.lease_backend is None
+                            else self.lease_backend.name),
+                "closed": self._closed,
+            }
 
     def lease_present(self, key: int) -> bool:
         self._require_shared("lease_present")
-        return os.path.exists(self._lease_file(int(key)))
+        return self._backend_call("age_s", None, int(key)) is not None
 
     def lease_stale(self, key: int) -> bool:
         """True iff the key's lease exists and is past the TTL."""
         self._require_shared("lease_stale")
-        age = lease_age_s(self._lease_file(int(key)))
+        age = self._backend_call("age_s", None, int(key))
         return age is not None and age > self.lease_ttl_s
 
     def reclaim_if_stale(self, key: int) -> bool:
@@ -615,7 +777,8 @@ class SolutionStore:
         the waiter path); True iff this call removed it."""
         self._require_shared("reclaim_if_stale")
         key = int(key)
-        if break_stale_lease(self._lease_file(key), self.lease_ttl_s):
+        if self._backend_call("break_stale", False, key,
+                              self.lease_ttl_s, now=self._chaos_now()):
             with self._lock:
                 self._fleet["fleet_lease_reclaims"] += 1
             self._obs_scope().event("FLEET_LEASE_RECLAIM", key=key,
@@ -629,26 +792,27 @@ class SolutionStore:
             return sorted(self._held)
 
     def lease_files(self) -> list:
-        """Every lease file present in the shared directory (all owners)
-        — the leak audit."""
+        """Every live lease, all owners — the leak audit.  The
+        shared-dir backend returns real file paths (the pre-trait
+        spelling); other backends synthesize the same naming."""
         self._require_shared("lease_files")
-        return sorted(glob.glob(os.path.join(
-            self.disk_path, f"lease_*{LEASE_SUFFIX}")))
+        return self._backend_call("lease_names", [])
 
     def gc_stale_leases(self) -> int:
-        """Sweep every stale lease in the directory (end-of-run leak
+        """Sweep every stale lease the backend knows (end-of-run leak
         reclaim; counts + journals each).  Returns how many were
         removed."""
         self._require_shared("gc_stale_leases")
         removed = 0
-        for path in self.lease_files():
-            if break_stale_lease(path, self.lease_ttl_s):
+        for key in self._backend_call("list_keys", []):
+            if self._backend_call("break_stale", False, key,
+                                  self.lease_ttl_s):
                 removed += 1
                 with self._lock:
                     self._fleet["fleet_lease_reclaims"] += 1
                 self._obs_scope().event(
-                    "FLEET_LEASE_RECLAIM", key=None, owner=self.owner,
-                    file=os.path.basename(path))
+                    "FLEET_LEASE_RECLAIM", key=int(key),
+                    owner=self.owner, swept=True)
         return removed
 
     def contains(self, key: int) -> bool:

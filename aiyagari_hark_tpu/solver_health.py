@@ -77,10 +77,16 @@ LOAD_SHED = 7
 # breaker after repeated solve/certification failures; fast-failed
 # without touching the queue (``serve.CircuitOpen``).
 CIRCUIT_OPEN = 8
+# BACKEND_FAULT (ISSUE 16): a fleet lease-backend/store substrate
+# operation failed or degraded (partitioned read, dropped CAS
+# connection, a held lease found lost) — the distributed-robustness
+# tier's process-level code, journaled as ``LEASE_BACKEND_FAULT``.  No
+# numbers were produced: uncertified, failure side of ``is_failure``.
+BACKEND_FAULT = 9
 
 STATUS_NAMES = ("CONVERGED", "STALLED", "MAX_ITER", "NONFINITE",
                 "INTERRUPTED", "DEADLINE_EXCEEDED", "OVERLOADED",
-                "LOAD_SHED", "CIRCUIT_OPEN")
+                "LOAD_SHED", "CIRCUIT_OPEN", "BACKEND_FAULT")
 
 # NOTE marker, not a status code (it never enters ``combine_status``): a
 # mixed-precision ladder's DESCENT phase exited NONFINITE or STALLED and

@@ -184,14 +184,19 @@ def lease_age_s(path: str, now=None):
     is missing.  Wall-clock (``time.time``): leases coordinate
     PROCESSES, which share the host's wall clock — the injectable
     monotonic clocks the serving layer uses elsewhere do not cross a
-    fork."""
+    fork.
+
+    Clamped at zero (ISSUE 16 satellite): a wall clock stepped BACKWARD
+    (NTP slew, VM migration) makes ``now - mtime`` negative; a negative
+    age must read as "fresh", never poison a staleness comparison
+    downstream."""
     import time
 
     try:
         mtime = os.path.getmtime(path)
     except OSError:
         return None
-    return (time.time() if now is None else float(now)) - mtime
+    return max(0.0, (time.time() if now is None else float(now)) - mtime)
 
 
 def release_lease(path: str) -> bool:
@@ -204,14 +209,23 @@ def release_lease(path: str) -> bool:
         return False
 
 
-def break_stale_lease(path: str, ttl_s: float, now=None) -> bool:
+def break_stale_lease(path: str, ttl_s: float, now=None,
+                      tolerance_s: float = 0.0) -> bool:
     """Reclaim a lease whose age exceeds ``ttl_s`` (a crashed owner must
     not wedge its fingerprint forever): remove-if-stale, True iff this
     call removed it.  A concurrent remove (another reclaimer, or the
     owner's own release racing the reclaim) reads as False — the caller
-    re-runs its acquire either way, so double reclaim is harmless."""
+    re-runs its acquire either way, so double reclaim is harmless.
+
+    ``tolerance_s`` (ISSUE 16 satellite) widens the staleness threshold
+    to ``ttl_s + tolerance_s``: a reclaimer whose wall clock runs AHEAD
+    of the owner's sees inflated ages, and the tolerance absorbs skew up
+    to that bound before a live owner's lease can be stolen.  Backward
+    steps are already harmless — ``lease_age_s`` clamps negative ages to
+    zero, so a fresh lease can never look stale under a clock that
+    jumped back."""
     age = lease_age_s(path, now=now)
-    if age is None or age <= float(ttl_s):
+    if age is None or age <= float(ttl_s) + max(0.0, float(tolerance_s)):
         return False
     return release_lease(path)
 

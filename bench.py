@@ -2519,6 +2519,50 @@ FLEET_SMOKE_CELLS = tuple((s, r, 0.2) for s in (1.0, 3.0, 5.0)
                           for r in (0.0, 0.3, 0.6, 0.9))
 
 
+def _served_vs_reference(served_values: dict, kw: dict):
+    """Bit-identity leg shared by the fleet and chaos smokes — the PR
+    4/11 contract, replayed through one local single-process service: a
+    served result equals a batch-of-1 ``reference_solve`` WITH THE SAME
+    SEED, bit for bit.  The harness captured each solved fingerprint's
+    ``bracket_init`` from the solving worker's response (the JSON hop is
+    bit-exact: floats serialize via repr round-trip), so seeded keys
+    compare on EVERY value field including the warm-seed-dependent
+    capital; keys whose solving response was lost (a prefetch solve
+    nobody queried before hitting, or a killed worker's in-flight reply)
+    compare on the seed-independent fields — r* (PR 2's verified-bracket
+    contract pins the root bits warm or cold), labor, status.  Returns
+    ``(mismatches, seeded_compares)``."""
+    from aiyagari_hark_tpu.serve import make_query
+    from aiyagari_hark_tpu.serve.service import EquilibriumService
+
+    ref_svc = EquilibriumService(start_worker=False, max_batch=4,
+                                 ladder=(1, 2, 4))
+    mismatches = 0
+    seeded = 0
+    try:
+        for _key, vals in sorted(served_values.items()):
+            c = vals["cell"]
+            q = make_query(c[0], c[1], labor_sd=c[2], **kw)
+            seed = vals.get("bracket_init")
+            if seed is not None:
+                seeded += 1
+                ref = ref_svc.reference_solve(q, bracket_init=tuple(seed))
+                same = (vals["r_star"] == ref.r_star
+                        and vals["capital"] == ref.capital
+                        and vals["labor"] == ref.labor
+                        and vals["status"] == ref.status)
+            else:
+                ref = ref_svc.reference_solve(q)
+                same = (vals["r_star"] == ref.r_star
+                        and vals["labor"] == ref.labor
+                        and vals["status"] == ref.status)
+            if not same:
+                mismatches += 1
+    finally:
+        ref_svc.close()
+    return mismatches, seeded
+
+
 def _fleet_smoke() -> dict:
     """The ``--fleet-smoke`` acceptance run (ISSUE 15, DESIGN §14): 4
     worker PROCESSES over one shared disk store replay deterministic
@@ -2541,9 +2585,7 @@ def _fleet_smoke() -> dict:
         evaluate_history,
         load_bench_history,
     )
-    from aiyagari_hark_tpu.serve import make_query
     from aiyagari_hark_tpu.serve.loadgen import FleetSpec, run_fleet_load
-    from aiyagari_hark_tpu.serve.service import EquilibriumService
 
     kw = dict(SERVE_SMOKE_KWARGS)
     spec = FleetSpec(cells=FLEET_SMOKE_CELLS, model_kwargs=kw,
@@ -2556,40 +2598,7 @@ def _fleet_smoke() -> dict:
         rep = run_fleet_load(spec, store_dir=os.path.join(td, "store"))
     wall = time.perf_counter() - t0
 
-    # bit-identity leg — the PR 4/11 contract, replayed through one
-    # local single-process service: a served result equals a batch-of-1
-    # reference_solve WITH THE SAME SEED, bit for bit.  The harness
-    # captured each solved fingerprint's ``bracket_init`` from the
-    # solving worker's response (the JSON hop is bit-exact: floats
-    # serialize via repr round-trip), so seeded keys compare on EVERY
-    # value field including the warm-seed-dependent capital; keys whose
-    # solving response was lost (a prefetch solve nobody queried before
-    # hitting, or the drilled worker's in-flight reply) compare on the
-    # seed-independent fields — r* (PR 2's verified-bracket contract
-    # pins the root bits warm or cold), labor, status.
-    ref_svc = EquilibriumService(start_worker=False, max_batch=4,
-                                 ladder=(1, 2, 4))
-    mismatches = 0
-    seeded = 0
-    for key, vals in sorted(rep.served_values.items()):
-        c = vals["cell"]
-        q = make_query(c[0], c[1], labor_sd=c[2], **kw)
-        seed = vals.get("bracket_init")
-        if seed is not None:
-            seeded += 1
-            ref = ref_svc.reference_solve(q, bracket_init=tuple(seed))
-            same = (vals["r_star"] == ref.r_star
-                    and vals["capital"] == ref.capital
-                    and vals["labor"] == ref.labor
-                    and vals["status"] == ref.status)
-        else:
-            ref = ref_svc.reference_solve(q)
-            same = (vals["r_star"] == ref.r_star
-                    and vals["labor"] == ref.labor
-                    and vals["status"] == ref.status)
-        if not same:
-            mismatches += 1
-    ref_svc.close()
+    mismatches, seeded = _served_vs_reference(rep.served_values, kw)
 
     served = sum(n for o, n in rep.counts.items()
                  if o.startswith("served:"))
@@ -2667,6 +2676,147 @@ def _fleet_smoke() -> dict:
     if not ok:
         print("[bench] fleet smoke: ACCEPTANCE FAILED — see the "
               "fleet_* fields above", file=sys.stderr)
+    return record
+
+
+# Chaos smoke (ISSUE 16): five drill cells DISJOINT from the traffic
+# lattice (labor_sd 0.25 vs the lattice's 0.2), one per drill, so the
+# drills' expected duplicate publishes never contaminate the clean
+# traffic dedup ledger.
+CHAOS_DRILL_CELLS = tuple((s, r, 0.25) for (s, r) in
+                          ((1.0, 0.0), (3.0, 0.3), (5.0, 0.6),
+                           (1.0, 0.9), (3.0, 0.0)))
+
+
+def _chaos_smoke() -> dict:
+    """The ``--chaos-smoke`` acceptance run (ISSUE 16, DESIGN §14): 4
+    worker processes (CPU) over one shared store replay the 12-cell
+    golden lattice through the RESILIENT client (typed retry + hedged
+    reads) while the elasticity schedule churns the pool (one worker
+    leaves mid-load, a fresh one joins), then every chaos drill runs
+    sequentially — torn publish, store partition, SIGKILL mid-solve,
+    heartbeat stall, skewed-clock double election.  Measured
+    acceptance: every drill detected from public artifacts
+    (detected == injected), the drilled dedup ratio back at 1.0 with
+    the drills' EXPECTED duplicates separated out, zero leaked leases
+    and zero unresolved arrivals, served values bit-identical to
+    same-seed ``reference_solve``, availability and churn-p99 recorded
+    as sentinel-graded ``chaos_*`` fields."""
+    import tempfile
+
+    from aiyagari_hark_tpu.obs.regress import (
+        SEVERITY_NAMES,
+        evaluate_history,
+        load_bench_history,
+    )
+    from aiyagari_hark_tpu.serve.chaos import ChaosPlan
+    from aiyagari_hark_tpu.serve.loadgen import FleetSpec, run_fleet_load
+
+    kw = dict(SERVE_SMOKE_KWARGS)
+    spec = FleetSpec(cells=FLEET_SMOKE_CELLS, model_kwargs=kw,
+                     n_workers=4, queries_per_worker=30,
+                     seed=20260806, zipf_s=0.8, prefetch_k=0,
+                     lease_ttl_s=2.0, warm_count=0)
+    plan = ChaosPlan(drill_cells=CHAOS_DRILL_CELLS,
+                     churn=((40, "leave", 2), (60, "join", None)),
+                     slow_publish_s=8.0, partition_reads=2,
+                     recovery_queries=6, settle_timeout_s=60.0)
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory() as td:
+        rep = run_fleet_load(spec, store_dir=os.path.join(td, "store"),
+                             chaos=plan)
+    wall = time.perf_counter() - t0
+    ch = rep.chaos
+    assert ch is not None, "run_fleet_load(chaos=...) returned no ledger"
+
+    mismatches, seeded = _served_vs_reference(rep.served_values, kw)
+    served = sum(n for o, n in rep.counts.items()
+                 if o.startswith("served:"))
+    drills_ok = all(r["detected"] == r["injected"]
+                    for r in ch["drills"])
+    record = {
+        "metric": "chaos_smoke",
+        "backend": __import__("jax").default_backend(),
+        "chaos_workers": rep.workers,
+        "chaos_arrivals": rep.arrivals,
+        "chaos_wall_s": round(wall, 3),
+        "chaos_served": served,
+        # acceptance: availability under churn + drills (served /
+        # submitted through the retrying client)
+        "chaos_availability": ch["availability"],
+        "chaos_unresolved": rep.unresolved,
+        # acceptance: every drill's fault detected from journals /
+        # process state (the ledger counts FIRINGS, not armings)
+        "chaos_drills_injected": ch["injected"],
+        "chaos_drills_detected": ch["detected"],
+        "chaos_detect_all": drills_ok,
+        # one flat field per drill (nested dicts flatten into dotted
+        # keys the direction table can't resolve; the "detected" affix
+        # rule grades these NEUTRAL)
+        **{f"chaos_detected_{r['drill']}": int(r["detected"])
+           for r in ch["drills"]},
+        # acceptance: exactly-once after recovery — expected drill
+        # duplicates separated, everything else published once, and the
+        # recovery phase re-published NOTHING already published
+        "chaos_dedup_ratio": ch["dedup_ratio"],
+        "chaos_dedup_exact": ch["dedup_ratio"] == 1.0,
+        "chaos_traffic_dedup_exact": rep.dedup_ratio == 1.0,
+        "chaos_recovery_dup_publishes": ch["recovery_dup_publishes"],
+        "chaos_recovery_served": ch["recovery_served"],
+        "chaos_recovery_errors": ch["recovery_errors"],
+        # acceptance: no leaked leases after the TTL sweep
+        "chaos_leases_leaked": rep.leases_leaked,
+        "chaos_reclaims": rep.lease_reclaims,
+        # elasticity schedule accounting
+        "chaos_joins": ch["joins"],
+        "chaos_leaves": ch["leaves"],
+        "chaos_kills": ch["kills"],
+        # hedged reads (known-published fingerprints only)
+        "chaos_hedges_issued": ch["hedges"]["issued"],
+        "chaos_hedges_won": ch["hedges"]["won"],
+        # acceptance: bit-identity against same-seed reference solves
+        "chaos_bit_identical": (mismatches == 0
+                                and rep.value_divergence == 0),
+        "chaos_value_mismatches": mismatches,
+        "chaos_value_divergence": rep.value_divergence,
+        "chaos_seeded_compares": seeded,
+        # latency under churn (real wall, HTTP hop + retries included)
+        "chaos_churn_p99_ms": ch["churn_p99_ms"],
+        "chaos_hit_p50_ms": rep.p50_ms.get("hit"),
+        "chaos_hit_p99_ms": rep.p99_ms.get("hit"),
+    }
+    history = load_bench_history(_repo_dir()) + [("chaos_smoke", record)]
+    report = evaluate_history(history)
+    chaos_regressed = [f.metric for f in report.regressed()
+                       if f.metric.startswith("chaos_")]
+    record["chaos_sentinel_clean"] = not chaos_regressed
+    record["chaos_sentinel_worst"] = SEVERITY_NAMES[report.worst]
+
+    print(f"[bench] chaos smoke: {rep.workers} workers "
+          f"(+{ch['joins']} joined, -{ch['leaves']} left, "
+          f"{ch['kills']} killed), {rep.arrivals} arrivals -> "
+          f"{served} served (availability {ch['availability']}), "
+          f"drills {ch['detected']}/{ch['injected']} detected "
+          f"{dict((r['drill'], r['detected']) for r in ch['drills'])}, "
+          f"dedup drilled={ch['dedup_ratio']} "
+          f"traffic={rep.dedup_ratio} recovery_dup="
+          f"{ch['recovery_dup_publishes']}, hedges "
+          f"{ch['hedges']['issued']} issued / {ch['hedges']['won']} "
+          f"won, bit-identical="
+          f"{'OK' if record['chaos_bit_identical'] else 'MISMATCH'}, "
+          f"leaked={rep.leases_leaked} unresolved={rep.unresolved} "
+          f"churn p99={ch['churn_p99_ms']}ms",
+          file=sys.stderr)
+    ok = (drills_ok and ch["dedup_ratio"] == 1.0
+          and rep.dedup_ratio == 1.0
+          and ch["recovery_dup_publishes"] == 0
+          and rep.leases_leaked == 0 and rep.unresolved == 0
+          and record["chaos_bit_identical"]
+          and ch["joins"] >= 1 and ch["leaves"] >= 1
+          and ch["kills"] >= 1)
+    if not ok:
+        print("[bench] chaos smoke: ACCEPTANCE FAILED — see the "
+              "chaos_* fields above", file=sys.stderr)
     return record
 
 
@@ -2862,7 +3012,14 @@ def main(argv=None):
     replay over HTTP, dedup ratio 1.0 via the claim/lease election,
     served values bit-identical to ``reference_solve``, speculative
     prefetch conversion, SIGTERM drill with typed ``Interrupted`` and
-    zero leaked leases) and emits the ``fleet_*`` record."""
+    zero leaked leases) and emits the ``fleet_*`` record;
+    ``--chaos-smoke`` runs the chaos-hardening acceptance (ISSUE 16: 4
+    workers under scripted churn replay the golden lattice through the
+    retrying/hedging client while every fault drill fires — SIGKILL
+    mid-solve, heartbeat stall, torn publish, store partition, skewed
+    double election — asserting detected == injected, dedup back to
+    1.0, zero leaked leases, bit-identical served values) and emits
+    the ``chaos_*`` record."""
     import argparse
 
     from aiyagari_hark_tpu.utils.resilience import (
@@ -2920,6 +3077,17 @@ def main(argv=None):
                          "conversion, SIGTERM drill with typed "
                          "Interrupted and zero leaked leases) and emit "
                          "the fleet_* record instead of the full bench")
+    ap.add_argument("--chaos-smoke", action="store_true",
+                    help="run the chaos-hardening smoke (ISSUE 16: 4 "
+                         "workers under scripted churn replay the "
+                         "12-cell golden lattice through the retrying/"
+                         "hedging client while every fault drill fires "
+                         "— SIGKILL mid-solve, heartbeat stall, torn "
+                         "publish, store partition, skewed double "
+                         "election — asserting detected == injected, "
+                         "dedup ratio back to 1.0, zero leaked leases, "
+                         "bit-identical served values) and emit the "
+                         "chaos_* record instead of the full bench")
     ap.add_argument("--chips-scaling", action="store_true",
                     help="run the multi-chip scaling smoke (ISSUE 11: "
                          "the balanced 24-cell sweep dispatched through "
@@ -2959,13 +3127,14 @@ def main(argv=None):
             or args.load_smoke or args.scenario_smoke
             or args.profile_smoke or args.chips_scaling
             or args.compaction_smoke or args.kernel_smoke
-            or args.fleet_smoke):
+            or args.fleet_smoke or args.chaos_smoke):
         from aiyagari_hark_tpu.utils.backend import (
             enable_compilation_cache,
         )
 
         enable_compilation_cache()
-        smoke = (_fleet_smoke if args.fleet_smoke
+        smoke = (_chaos_smoke if args.chaos_smoke
+                 else _fleet_smoke if args.fleet_smoke
                  else _kernel_smoke if args.kernel_smoke
                  else _compaction_smoke if args.compaction_smoke
                  else _chips_scaling if args.chips_scaling

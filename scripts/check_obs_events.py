@@ -72,8 +72,15 @@ EMIT_NAMES = {"emit", "emit_event", "event", "_record_eviction"}
 # FLIGHT_RECORD_DUMP next to the artifact it writes) and the
 # bench-regression sentinel's grading loop (must journal
 # REGRESSION_FLAGGED for every REGRESSED finding).
+# ISSUE 16 additions: the store's lease-backend degrade path
+# (``_backend_fault`` must journal LEASE_BACKEND_FAULT — it recovers
+# with a fail-safe default instead of raising) and the chaos agent's
+# fault-firing site (``fire`` must journal FLEET_CHAOS_INJECT — the
+# detection ledger's injected side is only falsifiable if every actual
+# firing leaves a typed trail).
 SEAM_DEFS = {"_evict_corrupt", "_record_eviction", "retry_transient",
-             "_run_sweep_impl", "dump_flight", "evaluate_history"}
+             "_run_sweep_impl", "dump_flight", "evaluate_history",
+             "_backend_fault", "fire"}
 
 
 def _call_name(node: ast.Call):

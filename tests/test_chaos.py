@@ -1,0 +1,252 @@
+"""Worker-side chaos seams (ISSUE 16): the ``ChaosAgent`` fault surface
+and the shared store's behavior under each injected fault — a partition
+read degrades to a miss WITHOUT evicting healthy bytes, a stalled
+heartbeat loses its lease to a TTL reclaim and the loss is detected and
+counted, a skewed staleness clock forces the duplicated election, and
+the heartbeat daemon provably stops on close / last release (no thread
+outlives the store).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.scenarios.aiyagari import AIYAGARI_SCHEMA
+from aiyagari_hark_tpu.serve.chaos import ChaosAgent
+from aiyagari_hark_tpu.serve.store import SolutionStore, make_solution
+from aiyagari_hark_tpu.utils.checkpoint import (
+    acquire_lease,
+    break_stale_lease,
+    lease_age_s,
+)
+
+
+class _RecObs:
+    """Event recorder standing in for an obs scope."""
+
+    def __init__(self):
+        self.events = []
+
+    def event(self, etype, **fields):
+        self.events.append((etype, dict(fields)))
+
+    def of(self, etype):
+        return [f for t, f in self.events if t == etype]
+
+
+def _row(key):
+    rng = np.random.default_rng(key)
+    row = rng.standard_normal(len(AIYAGARI_SCHEMA.fields))
+    row[AIYAGARI_SCHEMA.idx(AIYAGARI_SCHEMA.status)] = 0.0
+    row[AIYAGARI_SCHEMA.idx(AIYAGARI_SCHEMA.root)] = 0.01 + key * 1e-4
+    return row
+
+
+def _store(tmp_path, owner, ttl=30.0, chaos=None, capacity=8):
+    s = SolutionStore(disk_path=str(tmp_path / "shared"), shared=True,
+                      lease_ttl_s=ttl, owner=owner, capacity=capacity)
+    if chaos is not None:
+        s.set_chaos(chaos)
+    return s
+
+
+# -- ChaosAgent unit behavior ------------------------------------------------
+
+def test_arm_is_partial_and_explicit_zero_disarms():
+    a = ChaosAgent()
+    st = a.arm({"slow_publish_s": 2.0, "slow_cells": [(1.0, 0.0, 0.2)]})
+    assert st["slow_publish_s"] == 2.0
+    st = a.arm({"heartbeat_stall": True})      # untouched keys persist
+    assert st["slow_publish_s"] == 2.0 and st["heartbeat_stall"]
+    st = a.arm({"slow_publish_s": 0.0, "heartbeat_stall": False})
+    assert st["slow_publish_s"] == 0.0 and not st["heartbeat_stall"]
+
+
+def test_publish_delay_fires_only_for_armed_cells():
+    obs = _RecObs()
+    a = ChaosAgent(obs=obs, owner="w0")
+    a.arm({"slow_publish_s": 1.5, "slow_cells": [(1.0, 0.0, 0.2)]})
+    assert a.publish_delay_s((3.0, 0.3, 0.2)) == 0.0   # not armed
+    assert obs.of("FLEET_CHAOS_INJECT") == []          # no phantom firing
+    assert a.publish_delay_s((1.0, 0.0, 0.2)) == 1.5
+    fired = obs.of("FLEET_CHAOS_INJECT")
+    assert len(fired) == 1 and fired[0]["drill"] == "slow_publish"
+    assert a.armed()["fired"] == 1
+
+
+def test_heartbeat_stall_fires_once_stays_stalled():
+    obs = _RecObs()
+    a = ChaosAgent(obs=obs)
+    assert a.heartbeat_stalled() is False
+    a.arm({"heartbeat_stall": True})
+    assert a.heartbeat_stalled() is True
+    assert a.heartbeat_stalled() is True       # still stalled...
+    assert len(obs.of("FLEET_CHAOS_INJECT")) == 1   # ...journaled ONCE
+    a.arm({"heartbeat_stall": False})
+    assert a.heartbeat_stalled() is False
+
+
+def test_partition_reads_count_down():
+    obs = _RecObs()
+    a = ChaosAgent(obs=obs)
+    a.arm({"partition_reads": 2})
+    assert [a.read_fault(7), a.read_fault(7), a.read_fault(7)] == [
+        True, True, False]
+    assert len(obs.of("FLEET_CHAOS_INJECT")) == 2
+
+
+def test_skew_now_shifts_the_wall_and_fires_once():
+    obs = _RecObs()
+    a = ChaosAgent(obs=obs)
+    assert a.skew_now() is None
+    a.arm({"lease_skew_s": 120.0})
+    now = a.skew_now()
+    assert now is not None and now - time.time() > 100.0
+    a.skew_now()
+    assert len(obs.of("FLEET_CHAOS_INJECT")) == 1
+    a.arm({"lease_skew_s": 0.0})
+    assert a.skew_now() is None
+
+
+# -- the store under each fault ---------------------------------------------
+
+def test_partition_read_degrades_to_miss_without_eviction(tmp_path):
+    key = 42
+    writer = _store(tmp_path, "w0")
+    assert writer.claim(key) == "won"
+    writer.publish(make_solution((1.0 + key, 0.5, 0.2), _row(key),
+                                 group=777, key=key))
+    writer.close()
+
+    agent = ChaosAgent(owner="w1")
+    agent.arm({"partition_reads": 1})
+    reader = _store(tmp_path, "w1", chaos=agent)
+    assert reader.get(key) is None             # the partitioned window
+    assert reader.fleet_counts()["fleet_backend_faults"] == 1
+    # transient is NOT corrupt: nothing evicted, bytes intact, and the
+    # very next read serves the exact published row
+    assert reader.integrity_counts()["store_corrupt_evictions"] == 0
+    got = reader.get(key)
+    assert got is not None
+    assert np.array_equal(np.asarray(got.packed), _row(key))
+    reader.close()
+
+
+def test_heartbeat_stall_loses_the_lease_and_is_detected(tmp_path):
+    key = 9
+    agent = ChaosAgent(owner="w0")
+    agent.arm({"heartbeat_stall": True})       # stalled from the start
+    zombie = _store(tmp_path, "w0", ttl=0.4, chaos=agent)
+    assert zombie.claim(key) == "won"
+    time.sleep(0.7)                            # age past the TTL, unbeaten
+    peer = _store(tmp_path, "w1", ttl=0.4)
+    assert peer.reclaim_if_stale(key) is True
+    assert peer.claim(key) == "won"            # the re-election
+    agent.arm({"heartbeat_stall": False})      # the zombie wakes...
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if zombie.heartbeat_health()["lost_leases"] >= 1:
+            break
+        time.sleep(0.05)
+    health = zombie.heartbeat_health()
+    assert health["lost_leases"] == 1          # ...and DETECTS the theft
+    assert zombie.held_leases() == []
+    # its late release is owner-checked away: the heir keeps the lease
+    zombie.release(key)
+    assert peer.lease_present(key)
+    peer.release(key)
+    zombie.close()
+    peer.close()
+
+
+def test_skewed_clock_forces_duplicated_election(tmp_path):
+    key = 5
+    holder = _store(tmp_path, "w0", ttl=30.0)
+    assert holder.claim(key) == "won"          # fresh, beating, TTL 30
+    obs = _RecObs()
+    agent = ChaosAgent(obs=obs, owner="w1")
+    agent.arm({"lease_skew_s": 200.0})         # reclaimer runs ttl*6 ahead
+    skewed = _store(tmp_path, "w1", ttl=30.0, chaos=agent)
+    assert skewed.claim(key) == "won"          # stole the FRESH lease
+    assert skewed.fleet_counts()["fleet_lease_reclaims"] == 1
+    assert [f["drill"] for f in obs.of("FLEET_CHAOS_INJECT")] == [
+        "clock_skew"]
+    holder.close()
+    skewed.close()
+
+
+# -- heartbeat-thread lifecycle (ISSUE 16 satellite) -------------------------
+
+def _hb_threads():
+    return [t for t in threading.enumerate()
+            if t.name == "lease-heartbeat" and t.is_alive()]
+
+
+def test_close_while_held_stops_the_thread_keeps_the_lease(tmp_path):
+    s = _store(tmp_path, "w0", ttl=0.5)
+    assert s.claim(11) == "won"
+    assert s.heartbeat_health()["thread_alive"]
+    s.close()
+    assert s.heartbeat_health()["thread_alive"] is False
+    assert s.heartbeat_health()["closed"] is True
+    assert _hb_threads() == []                 # no thread outlives close
+    # the held lease is LEFT for TTL reclaim (crashed-winner protocol)
+    audit = _store(tmp_path, "audit", ttl=0.5)
+    assert audit.lease_present(11)
+    s.close()                                  # idempotent
+    audit.close()
+
+
+def test_close_release_leases_true_releases_first(tmp_path):
+    s = _store(tmp_path, "w0")
+    assert s.claim(12) == "won"
+    s.close(release_leases=True)
+    assert _hb_threads() == []
+    audit = _store(tmp_path, "audit")
+    assert not audit.lease_present(12)
+    audit.close()
+
+
+def test_last_release_stops_the_heartbeat_thread(tmp_path):
+    s = _store(tmp_path, "w0", ttl=0.4)
+    assert s.claim(13) == "won"
+    assert s.claim(14) == "won"
+    assert s.heartbeat_health()["thread_alive"]
+    s.release(13)
+    assert s.heartbeat_health()["held"] == 1   # still one held: thread on
+    s.release(14)                              # the LAST release
+    deadline = time.time() + 5.0
+    while time.time() < deadline and s.heartbeat_health()["thread_alive"]:
+        time.sleep(0.05)
+    assert s.heartbeat_health()["thread_alive"] is False
+    assert _hb_threads() == []
+    s.close()
+
+
+# -- clock-skew hardening at the checkpoint layer ---------------------------
+
+def test_lease_age_clamps_a_backwards_clock(tmp_path):
+    # regression (ISSUE 16 satellite): mtime AHEAD of the wall (clock
+    # stepped back after the acquire) must clamp to age 0, and a
+    # backwards ``now`` must never let the staleness breaker fire
+    p = str(tmp_path / "x.lease")
+    assert acquire_lease(p, owner="a")
+    future = time.time() + 500.0
+    os.utime(p, (future, future))
+    assert lease_age_s(p) == 0.0
+    assert break_stale_lease(p, 0.01) is False
+    assert break_stale_lease(p, 0.01, now=time.time() - 3600.0) is False
+    assert os.path.exists(p)
+
+
+def test_break_stale_tolerance_window(tmp_path):
+    p = str(tmp_path / "y.lease")
+    assert acquire_lease(p, owner="a")
+    now = time.time()
+    assert break_stale_lease(p, 1.0, now=now + 3.0,
+                             tolerance_s=5.0) is False   # inside window
+    assert break_stale_lease(p, 1.0, now=now + 60.0,
+                             tolerance_s=5.0) is True    # beyond it

@@ -418,7 +418,11 @@ def test_http_query_roundtrip_bit_exact(front_svc):
 def test_http_metrics_fleet_and_healthz(front_svc):
     svc, front = front_svc
     client = FleetClient([front.url])
-    assert client.get(front.url, "/healthz") == {"ok": True}
+    health = client.get(front.url, "/healthz")
+    assert health["ok"] is True
+    # ISSUE 16: liveness now carries heartbeat/lease health
+    assert set(health["heartbeat"]) >= {"thread_alive", "held", "beats",
+                                        "lost_leases", "backend"}
     snap = client.get(front.url, "/metrics")
     assert snap["serve_requests"] >= 1
     fleet = client.get(front.url, "/fleet")
@@ -518,3 +522,73 @@ def test_direction_covers_fleet_smoke_record():
                evaluate_history(hist[:-1] + [("r99", worse)]).regressed()]
     assert "fleet_dedup_ratio" in flagged
     assert "fleet_hit_p99_ms" in flagged
+
+
+def test_direction_covers_chaos_smoke_record():
+    """Every scalar the ``--chaos-smoke`` record emits resolves in the
+    direction table (ISSUE 16 CI satellite), availability degradation
+    and duplicate recovery publishes grade as regressions, and the new
+    fleet events are in the journal vocabulary."""
+    from aiyagari_hark_tpu.obs.journal import EVENT_TYPES
+    from aiyagari_hark_tpu.obs.regress import (
+        DOWN,
+        NEUTRAL,
+        OK,
+        UP,
+        direction_of_goodness,
+        evaluate_history,
+        flatten_record,
+    )
+
+    record = {
+        "metric": "chaos_smoke", "backend": "cpu",
+        "chaos_workers": 4, "chaos_arrivals": 120,
+        "chaos_wall_s": 200.0, "chaos_served": 118,
+        "chaos_availability": 0.983, "chaos_unresolved": 0,
+        "chaos_drills_injected": 5, "chaos_drills_detected": 5,
+        "chaos_detect_all": True,
+        "chaos_detected_torn_publish": 1, "chaos_detected_partition": 1,
+        "chaos_detected_worker_kill": 1,
+        "chaos_detected_heartbeat_stall": 1,
+        "chaos_detected_clock_skew": 1,
+        "chaos_dedup_ratio": 1.0, "chaos_dedup_exact": True,
+        "chaos_traffic_dedup_exact": True,
+        "chaos_recovery_dup_publishes": 0, "chaos_recovery_served": 6,
+        "chaos_recovery_errors": 0, "chaos_leases_leaked": 0,
+        "chaos_reclaims": 2, "chaos_joins": 1, "chaos_leaves": 1,
+        "chaos_kills": 1, "chaos_hedges_issued": 3,
+        "chaos_hedges_won": 1, "chaos_bit_identical": True,
+        "chaos_value_mismatches": 0, "chaos_value_divergence": 0,
+        "chaos_seeded_compares": 7, "chaos_churn_p99_ms": 9000.0,
+        "chaos_hit_p50_ms": 4.0, "chaos_hit_p99_ms": 40.0,
+        "chaos_sentinel_clean": True, "chaos_sentinel_worst": "OK",
+    }
+    for field in flatten_record(record):
+        assert direction_of_goodness(field, strict=True) in (
+            UP, DOWN, NEUTRAL), field
+    assert direction_of_goodness("chaos_availability") == UP
+    assert direction_of_goodness("chaos_dedup_ratio") == DOWN
+    assert direction_of_goodness("chaos_recovery_dup_publishes") == DOWN
+    assert direction_of_goodness("chaos_leases_leaked") == DOWN
+    assert direction_of_goodness("chaos_churn_p99_ms") == DOWN
+    # availability collapse and a churn-p99 blow-up grade REGRESSED; a
+    # duplicate recovery publish on an all-zero history flags as NOISE
+    # (zero baseline has no relative move, but it still leaves OK)
+    hist = [(f"r{i:02d}", dict(record)) for i in range(4)]
+    assert evaluate_history(hist).worst == OK
+    worse = dict(record)
+    worse["chaos_availability"] = 0.5
+    worse["chaos_churn_p99_ms"] = 30000.0
+    worse["chaos_recovery_dup_publishes"] = 3
+    rep = evaluate_history(hist[:-1] + [("r99", worse)])
+    flagged = [f.metric for f in rep.regressed()]
+    assert "chaos_availability" in flagged
+    assert "chaos_churn_p99_ms" in flagged
+    dup = [f for f in rep.findings
+           if f.metric == "chaos_recovery_dup_publishes"]
+    assert dup and dup[0].severity > OK
+    # the ISSUE 16 journal vocabulary is exported
+    for ev in ("FLEET_CHAOS_INJECT", "FLEET_HEDGE_ISSUED",
+               "FLEET_HEDGE_WON", "WORKER_JOIN", "WORKER_LEAVE",
+               "LEASE_BACKEND_FAULT"):
+        assert ev in EVENT_TYPES, ev

@@ -1,0 +1,321 @@
+"""FleetClient typed resilience (ISSUE 16): bounded deterministic
+retry/backoff on an injectable clock, the 503 ``Retry-After``
+header==payload repr pin across a REAL HTTP hop, per-request deadlines
+raising typed ``DeadlineExceeded`` instead of sleeping past the budget,
+and hedged reads — legal only for known-published fingerprints, first
+answer wins, counted and journaled.
+"""
+
+import threading
+import time
+
+import pytest
+
+from aiyagari_hark_tpu.serve.fleet import (
+    FleetClient,
+    FleetFront,
+    FleetHTTPError,
+    HedgePolicy,
+    RetryPolicy,
+)
+from aiyagari_hark_tpu.serve.service import DeadlineExceeded, Overloaded
+
+KW = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-4,
+          max_bisect=16)
+CELL = (3.0, 0.6, 0.2)
+
+
+# -- deterministic clock/sleep ----------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.t += s
+
+
+def _client(script, retry=None, hedge=None, clock=None, obs=None,
+            urls=("http://stub-a", "http://stub-b")):
+    """A FleetClient whose pool sweep is replaced by a scripted stub:
+    each call pops the next entry — an exception instance to raise or a
+    dict to return."""
+    clock = clock if clock is not None else FakeClock()
+    c = FleetClient(list(urls), retry=retry, hedge=hedge,
+                    clock=clock, sleep=clock.sleep, obs=obs)
+    calls = []
+
+    def _scripted(payload, start):
+        calls.append(start)
+        step = script.pop(0)
+        if isinstance(step, BaseException):
+            raise step
+        return dict(step)
+
+    c._query_once = _scripted
+    return c, clock, calls
+
+
+def _err503(retry_after=None):
+    payload = {"error": "Overloaded", "message": "queue full"}
+    if retry_after is not None:
+        payload["retry_after_s"] = retry_after
+    return FleetHTTPError(503, payload, retry_after_s=retry_after)
+
+
+# -- RetryPolicy schedule ----------------------------------------------------
+
+def test_backoff_schedule_is_deterministic():
+    p = RetryPolicy(max_attempts=4, base_s=0.05, multiplier=2.0,
+                    max_backoff_s=2.0)
+    assert [p.backoff_s(k) for k in range(4)] == [0.05, 0.1, 0.2, 0.4]
+    # the server's Retry-After raises the wait but never beats the cap
+    assert p.backoff_s(0, retry_after_s=0.7) == 0.7
+    assert p.backoff_s(4, retry_after_s=0.7) == 0.8
+    assert p.backoff_s(0, retry_after_s=10.0) == 2.0
+    assert p.backoff_s(10) == 2.0
+
+
+def test_retry_on_503_honors_retry_after():
+    c, clock, _ = _client(
+        [_err503(0.7), _err503(0.7), {"path": "hit"}],
+        retry=RetryPolicy(max_attempts=4, base_s=0.05))
+    res = c.query(CELL, KW)
+    assert res == {"path": "hit"}
+    # both waits raised to the server's estimate (0.05/0.1 < 0.7)
+    assert clock.sleeps == [0.7, 0.7]
+
+
+def test_retry_uses_own_schedule_without_retry_after():
+    c, clock, _ = _client(
+        [_err503(), _err503(), {"path": "hit"}],
+        retry=RetryPolicy(max_attempts=4, base_s=0.05))
+    assert c.query(CELL, KW) == {"path": "hit"}
+    assert clock.sleeps == [0.05, 0.1]
+
+
+def test_non_503_is_never_retried():
+    c, clock, _ = _client(
+        [FleetHTTPError(400, {"error": "BadRequest", "message": "x"})],
+        retry=RetryPolicy())
+    with pytest.raises(FleetHTTPError) as exc:
+        c.query(CELL, KW)
+    assert exc.value.code == 400
+    assert clock.sleeps == []
+
+
+def test_retry_exhaustion_raises_the_last_error():
+    c, clock, _ = _client([_err503(), _err503(), _err503()],
+                          retry=RetryPolicy(max_attempts=3, base_s=0.05))
+    with pytest.raises(FleetHTTPError) as exc:
+        c.query(CELL, KW)
+    assert exc.value.code == 503
+    assert clock.sleeps == [0.05, 0.1]         # attempts-1 waits
+
+
+def test_connection_errors_retried_then_propagate():
+    c, clock, _ = _client(
+        [ConnectionError("down"), ConnectionError("down"),
+         {"path": "hit"}],
+        retry=RetryPolicy(max_attempts=4, base_s=0.05))
+    assert c.query(CELL, KW) == {"path": "hit"}
+    assert clock.sleeps == [0.05, 0.1]
+
+    c2, clock2, _ = _client([ConnectionError("down")] * 2,
+                            retry=RetryPolicy(max_attempts=2, base_s=0.05))
+    with pytest.raises(ConnectionError):
+        c2.query(CELL, KW)
+    assert clock2.sleeps == [0.05]
+
+
+def test_without_retry_policy_behavior_is_unchanged():
+    c, clock, _ = _client([_err503(1.0)])
+    with pytest.raises(FleetHTTPError):
+        c.query(CELL, KW)
+    assert clock.sleeps == []
+
+
+def test_deadline_raises_typed_instead_of_oversleeping():
+    # the budget cannot cover the next wait: typed DeadlineExceeded, on
+    # the INJECTED clock, without sleeping past the limit
+    c, clock, _ = _client([_err503()] * 4,
+                          retry=RetryPolicy(max_attempts=4, base_s=1.0))
+    with pytest.raises(DeadlineExceeded):
+        c.query(CELL, KW, deadline_s=0.5)
+    assert clock.sleeps == []                  # never slept past the budget
+
+    # a budget that covers one wait retries once, then raises typed
+    c2, clock2, _ = _client([_err503()] * 4,
+                            retry=RetryPolicy(max_attempts=4, base_s=1.0,
+                                              multiplier=2.0))
+    with pytest.raises(DeadlineExceeded):
+        c2.query(CELL, KW, deadline_s=1.5)
+    assert clock2.sleeps == [1.0]
+
+
+# -- hedged reads ------------------------------------------------------------
+
+class _RecObs:
+    def __init__(self):
+        self.events = []
+
+    def event(self, etype, **fields):
+        self.events.append((etype, dict(fields)))
+
+    def of(self, etype):
+        return [f for t, f in self.events if t == etype]
+
+
+def test_cold_miss_never_hedges():
+    # the fingerprint was never seen answered: even with a hedge policy
+    # attached the query runs the plain single sweep
+    obs = _RecObs()
+    c, _, calls = _client([{"path": "cold"}],
+                          hedge=HedgePolicy(delay_s=0.001), obs=obs)
+    assert c.query(CELL, KW) == {"path": "cold"}
+    assert calls == [0]                        # one sweep, no hedge thread
+    assert c.hedge_counts() == {"issued": 0, "won": 0}
+    assert obs.of("FLEET_HEDGE_ISSUED") == []
+
+
+def test_hedge_issued_after_delay_and_hedge_wins():
+    obs = _RecObs()
+    release = threading.Event()
+
+    def slow_primary(payload, start):
+        release.wait(5.0)                      # the sick worker
+        return {"path": "hit", "who": "primary"}
+
+    def fast_hedge(payload, start):
+        return {"path": "hit", "who": "hedge"}
+
+    c = FleetClient(["http://a", "http://b"],
+                    hedge=HedgePolicy(delay_s=0.02), obs=obs)
+    calls = []
+
+    def _scripted(payload, start):
+        calls.append(start)
+        return (slow_primary if start == 0 else fast_hedge)(payload,
+                                                            start)
+
+    c._query_once = _scripted
+    c.note_published("aiyagari", CELL)         # hedge-legal
+    res = c.query(CELL, KW)
+    assert res["who"] == "hedge"               # first answer won
+    assert c.hedge_counts() == {"issued": 1, "won": 1}
+    assert len(obs.of("FLEET_HEDGE_ISSUED")) == 1
+    assert len(obs.of("FLEET_HEDGE_WON")) == 1
+    assert sorted(calls) == [0, 1]             # primary + hedge, distinct
+    release.set()
+
+
+def test_fast_primary_wins_without_hedging():
+    obs = _RecObs()
+    c, _, calls = _client([{"path": "hit"}],
+                          hedge=HedgePolicy(delay_s=5.0), obs=obs)
+    c.note_published("aiyagari", CELL)
+    assert c.query(CELL, KW) == {"path": "hit"}
+    assert c.hedge_counts() == {"issued": 0, "won": 0}
+    assert obs.of("FLEET_HEDGE_ISSUED") == []
+
+
+def test_hedge_requires_two_workers():
+    c, _, calls = _client([{"path": "hit"}],
+                          hedge=HedgePolicy(delay_s=0.0),
+                          urls=("http://only",))
+    c.note_published("aiyagari", CELL)
+    assert c.query(CELL, KW) == {"path": "hit"}
+    assert c.hedge_counts() == {"issued": 0, "won": 0}
+
+
+def test_hedge_delay_derives_from_p99():
+    c = FleetClient(["http://a", "http://b"],
+                    hedge=HedgePolicy(min_delay_s=0.01))
+    assert c._hedge_delay_s() == 0.01          # no history: the floor
+    c._lat_s = [0.001 * k for k in range(1, 101)]
+    assert c._hedge_delay_s() == pytest.approx(0.099)  # ~p99 of history
+    c._lat_s = [0.0001]
+    assert c._hedge_delay_s() == 0.01          # floored
+
+
+# -- the Retry-After pin across a REAL HTTP hop -----------------------------
+
+class _ImmediateFuture:
+    def __init__(self, res):
+        self._res = res
+
+    def result(self, timeout=None):
+        return self._res
+
+
+class _OverloadedService:
+    """Minimal service stub for FleetFront: every submit refuses with a
+    fractional retry-after, exercising the 503 + Retry-After path."""
+
+    def __init__(self, est_wait_s):
+        self.est_wait_s = est_wait_s
+
+    def submit(self, q, deadline=None):
+        raise Overloaded(cell=(q.crra, q.labor_ar, q.labor_sd), key=0,
+                         depth=3, max_queue=3,
+                         est_wait_s=self.est_wait_s, reason="queue_full")
+
+
+def test_retry_after_header_equals_payload_bit_exactly():
+    # a fractional, repr-unfriendly float: 0.1 + 0.2 = 0.30000000000000004
+    est = 0.1 + 0.2
+    front = FleetFront(_OverloadedService(est)).start()
+    try:
+        import urllib.error
+        import urllib.request
+        import json as _json
+
+        body = _json.dumps({"cell": list(CELL), "kwargs": KW}).encode()
+        req = urllib.request.Request(
+            front.url + "/query", data=body,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=30.0)
+        e = exc.value
+        assert e.code == 503
+        header = e.headers.get("Retry-After")
+        payload = _json.loads(e.read().decode("utf-8"))
+        assert payload["error"] == "Overloaded"
+        # the pin: header string IS the repr of the payload float, so a
+        # client honoring either sees the SAME wait, bit-exactly
+        assert header == repr(est)
+        assert float(header) == payload["retry_after_s"] == est
+
+        # and the typed client surfaces it on the error object
+        client = FleetClient([front.url])
+        with pytest.raises(FleetHTTPError) as cexc:
+            client.query(CELL, KW)
+        assert cexc.value.code == 503
+        assert cexc.value.retry_after_s == est
+        assert cexc.value.payload["retry_after_s"] == est
+    finally:
+        front.stop()
+
+
+def test_client_retries_through_a_real_503_front():
+    # one REAL front that always refuses: the retrying client consumes
+    # its schedule (waits raised to the server's Retry-After) and then
+    # surfaces the typed 503
+    front = FleetFront(_OverloadedService(0.01)).start()
+    try:
+        clock = FakeClock()
+        client = FleetClient([front.url],
+                             retry=RetryPolicy(max_attempts=3,
+                                               base_s=0.005),
+                             clock=clock, sleep=clock.sleep)
+        with pytest.raises(FleetHTTPError) as exc:
+            client.query(CELL, KW)
+        assert exc.value.code == 503
+        assert clock.sleeps == [0.01, 0.01]    # Retry-After > base sched
+    finally:
+        front.stop()

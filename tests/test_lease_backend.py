@@ -1,0 +1,311 @@
+"""Lease-backend conformance suite (ISSUE 16 tentpole): ONE set of
+election-semantics tests parameterized over every ``LeaseBackend``
+implementation — the shared-directory default, the in-memory CAS model,
+and the CAS served over loopback TCP — so "what a lease means" is pinned
+by the suite, not by whatever one substrate happens to do.
+
+Covered per backend: exactly-once election (sequential, threaded burst,
+and — for the two backends real processes can share — a two-interpreter
+concurrent-claim race), heartbeat keeping a live winner alive, TTL
+reclaim of a dead one with re-election afterwards, the
+reclaim-vs-heartbeat race (a beat that lands before the reclaim refuses
+it), owner-checked release/heartbeat (a late waker can't delete a peer's
+fresh lease), backward-clock clamping (negative age reads fresh), and
+the skew-tolerance window on staleness.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from aiyagari_hark_tpu.serve.lease import (
+    CASServer,
+    LoopbackCASBackend,
+    MemoryCASBackend,
+    SharedDirBackend,
+    key_from_hex,
+    make_backend,
+)
+from aiyagari_hark_tpu.utils.fingerprint import fingerprint_hex
+
+BACKENDS = ("shared-dir", "memory-cas", "loopback-cas")
+
+
+class _Harness:
+    """One backend under test plus the substrate-specific aging hook
+    (``backdate``) the conformance suite needs to drive staleness
+    deterministically."""
+
+    def __init__(self, backend, backdate, cleanup=()):
+        self.backend = backend
+        self.backdate = backdate
+        self._cleanup = list(cleanup)
+
+    def close(self):
+        self.backend.close()
+        for fn in self._cleanup:
+            fn()
+
+
+def _make_harness(kind, tmp_path, skew_tolerance_s=0.0):
+    if kind == "shared-dir":
+        root = str(tmp_path / "leases")
+        os.makedirs(root, exist_ok=True)
+        b = SharedDirBackend(root, skew_tolerance_s=skew_tolerance_s)
+
+        def backdate(key, dt_s):
+            path = b._path(key)
+            t = os.path.getmtime(path) - float(dt_s)
+            os.utime(path, (t, t))
+
+        return _Harness(b, backdate)
+    if kind == "memory-cas":
+        b = MemoryCASBackend(skew_tolerance_s=skew_tolerance_s)
+        return _Harness(b, b.backdate)
+    if kind == "loopback-cas":
+        srv = CASServer(skew_tolerance_s=skew_tolerance_s).start()
+        b = LoopbackCASBackend(srv.address)
+        return _Harness(b, b.backdate, cleanup=[srv.stop])
+    raise AssertionError(kind)
+
+
+@pytest.fixture(params=BACKENDS)
+def harness(request, tmp_path):
+    h = _make_harness(request.param, tmp_path)
+    yield h
+    h.close()
+
+
+KEY = -7_654_321_987            # negative: exercises the two's-complement
+#                                 hex spelling round trip on disk names
+
+
+def test_election_exactly_once_sequential(harness):
+    b = harness.backend
+    assert b.try_acquire(KEY, "a") is True
+    assert b.try_acquire(KEY, "b") is False     # held by a peer
+    assert b.try_acquire(KEY, "a") is False     # not reentrant either
+    assert b.owner_of(KEY) == "a"
+    assert b.list_keys() == [KEY]
+    assert b.release(KEY, owner="a") is True
+    assert b.list_keys() == []
+
+
+def test_election_exactly_once_threaded_burst(harness):
+    b = harness.backend
+    wins = []
+    barrier = threading.Barrier(8)
+
+    def racer(i):
+        barrier.wait()
+        if b.try_acquire(KEY, f"w{i}"):
+            wins.append(i)
+
+    threads = [threading.Thread(target=racer, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1, f"election won {len(wins)} times: {wins}"
+    assert b.owner_of(KEY) == f"w{wins[0]}"
+
+
+def test_heartbeat_keeps_live_winner(harness):
+    b = harness.backend
+    assert b.try_acquire(KEY, "a")
+    harness.backdate(KEY, 30.0)
+    assert b.age_s(KEY) >= 29.0                  # visibly stale pre-beat
+    assert b.heartbeat(KEY, "a") is True         # the owner is alive
+    assert b.age_s(KEY) < 5.0                    # stamp refreshed
+    assert b.break_stale(KEY, ttl_s=10.0) is False
+    assert b.owner_of(KEY) == "a"
+
+
+def test_ttl_reclaims_dead_owner_then_reelection(harness):
+    b = harness.backend
+    assert b.try_acquire(KEY, "dead")
+    assert b.break_stale(KEY, ttl_s=10.0) is False   # fresh: refused
+    harness.backdate(KEY, 30.0)
+    assert b.break_stale(KEY, ttl_s=10.0) is True    # stale: reclaimed
+    assert b.list_keys() == []
+    assert b.try_acquire(KEY, "heir") is True        # re-election works
+    assert b.owner_of(KEY) == "heir"
+
+
+def test_reclaim_vs_heartbeat_race(harness):
+    # A reclaimer that OBSERVED staleness but whose delete lands after
+    # the owner's beat must be refused: acquire, age past the TTL (the
+    # reclaimer's staleness read), then beat — the subsequent reclaim
+    # attempt finds a refreshed lease and backs off.
+    b = harness.backend
+    assert b.try_acquire(KEY, "a")
+    harness.backdate(KEY, 30.0)
+    assert b.age_s(KEY) > 10.0          # the reclaimer's staleness read
+    assert b.heartbeat(KEY, "a") is True
+    assert b.break_stale(KEY, ttl_s=10.0) is False
+    assert b.owner_of(KEY) == "a"
+
+
+def test_release_and_heartbeat_are_owner_checked(harness):
+    b = harness.backend
+    assert b.try_acquire(KEY, "a")
+    assert b.release(KEY, owner="b") is False    # not yours to drop
+    assert b.heartbeat(KEY, "b") is False        # you don't hold this
+    assert b.owner_of(KEY) == "a"
+    assert b.release(KEY, owner="a") is True
+    # ownerless release is unconditional (the audit/GC spelling)
+    assert b.try_acquire(KEY, "c")
+    assert b.release(KEY) is True
+
+
+def test_late_release_after_reclaim_spares_the_heir(harness):
+    # The stalled-winner bug the owner check exists for: a's lease is
+    # TTL-reclaimed and re-acquired by b; when a finally wakes, its
+    # release must NOT delete b's fresh lease and its heartbeat must
+    # report the loss.
+    b = harness.backend
+    assert b.try_acquire(KEY, "a")
+    harness.backdate(KEY, 30.0)
+    assert b.break_stale(KEY, ttl_s=10.0) is True
+    assert b.try_acquire(KEY, "b") is True
+    assert b.release(KEY, owner="a") is False
+    assert b.heartbeat(KEY, "a") is False
+    assert b.owner_of(KEY) == "b"
+
+
+def test_backwards_clock_reads_fresh(harness):
+    # ISSUE 16 satellite regression: a wall clock stepped BACKWARD must
+    # clamp to age zero, never poison staleness.
+    b = harness.backend
+    assert b.try_acquire(KEY, "a")
+    past = time.time() - 3600.0
+    assert b.age_s(KEY, now=past) == 0.0
+    assert b.break_stale(KEY, ttl_s=0.001, now=past) is False
+    assert b.owner_of(KEY) == "a"
+
+
+@pytest.mark.parametrize("kind", BACKENDS)
+def test_skew_tolerance_widens_staleness(kind, tmp_path):
+    # A reclaimer running AHEAD by less than the tolerance cannot steal
+    # from a live owner; beyond ttl + tolerance the reclaim goes through.
+    h = _make_harness(kind, tmp_path, skew_tolerance_s=5.0)
+    try:
+        b = h.backend
+        assert b.try_acquire(KEY, "a")
+        now = time.time()
+        assert b.break_stale(KEY, ttl_s=1.0, now=now + 1.0 + 3.0) is False
+        assert b.owner_of(KEY) == "a"
+        assert b.break_stale(KEY, ttl_s=1.0, now=now + 1.0 + 60.0) is True
+        assert b.list_keys() == []
+    finally:
+        h.close()
+
+
+def test_absent_key_semantics(harness):
+    b = harness.backend
+    assert b.age_s(KEY) is None
+    assert b.owner_of(KEY) is None
+    assert b.release(KEY) is False
+    assert b.heartbeat(KEY, "a") is False
+    assert b.break_stale(KEY, ttl_s=0.0) is False
+    assert b.list_keys() == []
+
+
+def test_lease_names_share_the_disk_spelling(harness):
+    b = harness.backend
+    assert b.try_acquire(KEY, "a")
+    names = [os.path.basename(n) for n in b.lease_names()]
+    assert names == [f"lease_{fingerprint_hex(KEY)}.lease"]
+    assert key_from_hex(fingerprint_hex(KEY)) == KEY
+
+
+def test_shared_dir_sweeps_unpadded_legacy_spelling(tmp_path):
+    """Pre-trait sweeps globbed the directory and acted on the paths
+    found there; a lease file with an UNPADDED hex stem (e.g. a
+    handcrafted ``lease_feedbeef.lease``) must still be listed, read,
+    and TTL-broken even though canonical claims write the zero-padded
+    form."""
+    from aiyagari_hark_tpu.utils.checkpoint import acquire_lease
+
+    b = make_backend("dir", root=str(tmp_path))
+    legacy = os.path.join(str(tmp_path), "lease_feedbeef.lease")
+    assert acquire_lease(legacy, owner="dead")
+    key = key_from_hex("feedbeef")
+    assert b.list_keys() == [key]
+    assert b.owner_of(key) == "dead"
+    old = time.time() - 10.0
+    os.utime(legacy, (old, old))
+    assert b.break_stale(key, ttl_s=1.0) is True
+    assert not os.path.exists(legacy)
+    assert b.list_keys() == []
+
+
+def test_make_backend_spellings(tmp_path):
+    assert isinstance(make_backend("dir", root=str(tmp_path)),
+                      SharedDirBackend)
+    assert isinstance(make_backend("memory"), MemoryCASBackend)
+    cas = make_backend("cas:127.0.0.1:1")
+    assert isinstance(cas, LoopbackCASBackend)
+    cas.close()
+    with pytest.raises(ValueError):
+        make_backend("dir")               # needs a root
+    with pytest.raises(ValueError):
+        make_backend("zookeeper:foo")
+
+
+# -- two REAL processes race the same election ------------------------------
+#
+# O_EXCL (shared-dir) and the server-side lock (loopback CAS) are only
+# meaningful against another PROCESS; the in-memory backend is excluded
+# by construction (it is a dict).
+
+_CHILD = r"""
+import json, sys
+from aiyagari_hark_tpu.serve.lease import make_backend
+
+spec, root, owner, n_keys, out = sys.argv[1:6]
+b = make_backend(spec, root=root if root != "-" else None)
+wins = [k for k in range(1, int(n_keys) + 1) if b.try_acquire(k, owner)]
+b.close()
+with open(out, "w") as f:   # atomic-ok: test child's private result file
+    json.dump({"wins": wins}, f)
+"""
+
+
+def _race_two_processes(spec, root, tmp_path, n_keys=24):
+    outs = [str(tmp_path / f"race{i}.json") for i in range(2)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _CHILD, spec, root, f"w{i}",
+         str(n_keys), outs[i]],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        text=True) for i in range(2)]
+    results = []
+    for i, p in enumerate(procs):
+        _, err = p.communicate(timeout=120)
+        assert p.returncode == 0, f"child {i} failed:\n{err}"
+        with open(outs[i]) as f:
+            results.append(json.load(f)["wins"])
+    all_wins = results[0] + results[1]
+    # exactly-once fleet-wide: every key elected one winner, no key two
+    assert len(all_wins) == len(set(all_wins)), (
+        f"duplicate election wins across processes: {sorted(all_wins)}")
+    assert sorted(all_wins) == list(range(1, n_keys + 1))
+
+
+def test_two_process_claim_race_shared_dir(tmp_path):
+    root = str(tmp_path / "leases")
+    os.makedirs(root)
+    _race_two_processes("dir", root, tmp_path)
+    assert sorted(SharedDirBackend(root).list_keys()) == list(range(1, 25))
+
+
+def test_two_process_claim_race_loopback_cas(tmp_path):
+    with CASServer() as srv:
+        _race_two_processes(f"cas:{srv.address}", "-", tmp_path)
+        assert sorted(srv.backend.list_keys()) == list(range(1, 25))
