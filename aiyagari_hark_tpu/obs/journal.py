@@ -82,6 +82,17 @@ EVENT_TYPES = (
     # memory high-water mark growing
     "PROFILE_SNAPSHOT", "REGRESSION_FLAGGED",
     "FLIGHT_RECORD_DUMP", "DEVICE_MEM_HIGH_WATER",
+    # surrogate tier (ISSUE 17, serve.{service,store,surrogate,
+    # cellindex}): an off-lattice query answered by the certified
+    # local-linear surrogate (bound + donors attached), a surrogate-
+    # eligible query escalated to a real solve (too few / too far
+    # donors, bound over budget, or the seeded audit draw), an
+    # escalated solve published as a parameter-space refinement point
+    # (audit escalations carry the a-posteriori bound check), and the
+    # store's cell index (re)built from the metadata tier (restart,
+    # scale change, or occupancy-driven rewidth)
+    "SURROGATE_SERVED", "SURROGATE_ESCALATED", "LATTICE_REFINED",
+    "INDEX_REBUILD",
 )
 
 

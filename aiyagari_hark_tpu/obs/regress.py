@@ -179,6 +179,42 @@ DIRECTION_EXPLICIT: Dict[str, str] = {
     "serve_prefetch_issued": NEUTRAL,
     "serve_prefetch_converted": UP,
     "serve_prefetch_suppressed": NEUTRAL,
+    # surrogate tier + cell index (ISSUE 17, bench --surrogate-smoke;
+    # the surrogate_* snapshot counters also ride every serve record via
+    # ``ServeMetrics.snapshot``).  HIT RATE is the tier earning its keep
+    # (answers served without a solve), UP; the ESCALATION RATE is the
+    # fraction of surrogate-eligible queries that fell back to a cold
+    # solve, DOWN — together with the bound percentiles (the tier's own
+    # claimed error, DOWN: a tighter model is a better model) they are
+    # the headline numbers.  Audit failures are answers outside their
+    # own certified bound, DOWN from record one.  Audits and lattice
+    # refinements are policy-driven facts, NEUTRAL.  INDEX speedups are
+    # the sublinear store index's whole point, UP (the scale-suffixed
+    # names defeat the ``_speedup`` suffix rule, same as chips_*);
+    # linear-scan timings are the baseline side, NEUTRAL.
+    "surrogate_hit_rate": UP,
+    "surrogate_escalation_rate": DOWN,
+    "surrogate_escalations": DOWN,
+    "surrogate_audits": NEUTRAL,
+    "surrogate_audit_failures": DOWN,
+    "surrogate_refinements": NEUTRAL,
+    "surrogate_bound_p50": DOWN,
+    "surrogate_bound_p95": DOWN,
+    "surrogate_bound_max": DOWN,
+    "surrogate_err_max": DOWN,
+    "surrogate_queries": NEUTRAL,
+    "surrogate_served": UP,
+    "surrogate_refined_published": NEUTRAL,
+    "surrogate_events_served": NEUTRAL,
+    "surrogate_events_escalated": NEUTRAL,
+    "index_entries": NEUTRAL,
+    "index_rebuilds": NEUTRAL,
+    "index_speedup_1e4": UP,
+    "index_speedup_5e4": UP,
+    "index_grid_ms_1e4": DOWN,
+    "index_grid_ms_5e4": DOWN,
+    "index_linear_ms_1e4": NEUTRAL,
+    "index_linear_ms_5e4": NEUTRAL,
 }
 
 # Suffix/affix rules, first match wins.  Kept coarse on purpose: bench

@@ -186,6 +186,16 @@ class CellSpace:
             raise ScenarioError(
                 f"perturb_axis {self.perturb_axis} out of range")
 
+    def normalize(self, cell) -> Tuple[float, ...]:
+        """``cell`` in normalized (scale-free) coordinates — THE
+        normalization rule the serving tier's neighbor machinery
+        operates in (ISSUE 17): ``serve.cellindex.CellIndex`` buckets
+        by these units, ``parallel.sweep.neighbor_distance`` is the L1
+        norm over them, and the surrogate tier's local fit regresses on
+        offsets in them.  One rule, owned here per scenario."""
+        return tuple(float(c) / float(s)
+                     for c, s in zip(cell, self.scale))
+
 
 @dataclass(frozen=True)
 class BracketWarmStart:

@@ -15,6 +15,10 @@ from .batcher import (  # noqa: F401
     default_ladder,
     shard_ladder,
 )
+from .cellindex import (  # noqa: F401
+    CellIndex,
+    linear_nearest_k,
+)
 from .chaos import (  # noqa: F401
     ChaosAgent,
     ChaosPlan,
@@ -80,4 +84,9 @@ from .store import (  # noqa: F401
     SolutionStore,
     StoredSolution,
     make_solution,
+)
+from .surrogate import (  # noqa: F401
+    SurrogateFit,
+    SurrogatePolicy,
+    fit_surrogate,
 )

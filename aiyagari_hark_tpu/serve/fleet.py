@@ -138,6 +138,13 @@ def result_to_json(res) -> dict:
                          else [float(res.bracket_init[0]),
                                float(res.bracket_init[1]),
                                int(res.bracket_init[2])]),
+        # surrogate tier (ISSUE 17): the tag travels with the answer —
+        # a surrogate response is never mistakable for an exact one
+        "surrogate_error_bound": (
+            None if res.surrogate_error_bound is None
+            else float(res.surrogate_error_bound)),
+        "donor_keys": (None if res.donor_keys is None
+                       else [int(k) for k in res.donor_keys]),
     }
 
 
@@ -227,6 +234,7 @@ class _FleetHandler(BaseHTTPRequestHandler):
                 priority=int(req.get("priority", 0)),
                 degraded_ok=bool(req.get("degraded_ok", False)),
                 scenario=str(req.get("scenario", "aiyagari")),
+                surrogate_ok=bool(req.get("surrogate_ok", True)),
                 **req.get("kwargs", {}))
         except Exception as e:   # malformed request: client error
             self._send(400, {"error": "BadRequest", "message": str(e)})
@@ -639,6 +647,9 @@ def worker_main(argv=None) -> int:
                     help="enable the POST /chaos fault-injection "
                          "endpoint (ISSUE 16 drills; never on by "
                          "default)")
+    ap.add_argument("--surrogate", default=None,
+                    help="SurrogatePolicy fields, JSON (ISSUE 17; "
+                         "omit: no surrogate tier)")
     args = ap.parse_args(argv)
 
     from ..obs.runtime import NULL_OBS, ObsConfig, build_obs
@@ -665,12 +676,18 @@ def worker_main(argv=None) -> int:
 
         chaos = ChaosAgent(obs=obs, owner=args.owner)
         store.set_chaos(chaos)
+    surrogate = None
+    if args.surrogate:
+        from .surrogate import SurrogatePolicy
+
+        surrogate = SurrogatePolicy(**json.loads(args.surrogate))
     svc = EquilibriumService(
         store=store, max_batch=args.max_batch,
         ladder=tuple(int(s) for s in args.ladder.split(",")),
         admission=admission, obs=obs,
         certify_before_cache=bool(args.certify),
-        prefetch_k=args.prefetch_k, prefetch_cells=prefetch_cells)
+        prefetch_k=args.prefetch_k, prefetch_cells=prefetch_cells,
+        surrogate=surrogate)
     front = FleetFront(svc, host=args.host, port=args.port,
                        chaos=chaos).start()
     print(f"FLEET_READY port={front.port} pid={os.getpid()} "

@@ -98,6 +98,12 @@ class LoadSpec(NamedTuple):
     degraded_frac: float = 0.0
     batch_service_s: float = 0.01
     warm_frac: float = 0.0
+    # continuous-parameter mix (ISSUE 17): this fraction of arrivals
+    # queries a seeded uniform draw over the lattice's bounding hull
+    # instead of a lattice point — the surrogate tier's traffic model.
+    # The extra rng draws happen ONLY when the fraction is positive, so
+    # every pre-surrogate spec's digest is bit-identical.
+    offlattice_frac: float = 0.0
 
 
 class LoadReport(NamedTuple):
@@ -130,11 +136,22 @@ def generate_arrivals(spec: LoadSpec) -> List[Arrival]:
     p /= p.sum()
     mix = np.asarray(spec.priority_mix, dtype=np.float64)
     mix = mix / mix.sum()
+    # continuous-parameter hull (ISSUE 17): off-lattice arrivals sample
+    # the lattice's axis-aligned bounding box.  Computed only when the
+    # fraction is positive — the frac=0 stream must draw EXACTLY the
+    # pre-surrogate sequence (digest bit-identity).
+    off = float(spec.offlattice_frac)
+    if off > 0.0:
+        hull = np.asarray(spec.cells, dtype=np.float64)
+        lo, hi = hull.min(axis=0), hull.max(axis=0)
     out = []
     t = 0.0
     for _ in range(int(spec.n_queries)):
         t += float(rng.exponential(1.0 / spec.rate))
         cell = spec.cells[int(rng.choice(n_cells, p=p))]
+        if off > 0.0 and rng.random() < off:
+            cell = tuple(float(c) for c in
+                         lo + rng.random(lo.shape[0]) * (hi - lo))
         priority = int(rng.choice(len(mix), p=mix))
         deadline = (float(spec.deadline_s)
                     if rng.random() < spec.deadline_frac else None)
@@ -184,7 +201,8 @@ def _drain(svc: EquilibriumService, clk: ManualClock, busy_until: float,
 def run_load(spec: LoadSpec, admission=None, obs=None,
              max_batch: int = 4, ladder: Optional[tuple] = (1, 2, 4),
              max_queue: int = 256, max_wait_s: float = 0.005,
-             measure_hit_wall: bool = False) -> LoadReport:
+             measure_hit_wall: bool = False,
+             surrogate=None) -> LoadReport:
     """Replay one load scenario against a fresh manual-mode service and
     classify every arrival into a typed outcome.
 
@@ -203,12 +221,22 @@ def run_load(spec: LoadSpec, admission=None, obs=None,
     svc = EquilibriumService(start_worker=False, clock=clk,
                              admission=admission, obs=obs,
                              max_batch=max_batch, ladder=ladder,
-                             max_queue=max_queue, max_wait_s=max_wait_s)
+                             max_queue=max_queue, max_wait_s=max_wait_s,
+                             surrogate=surrogate)
     try:
         n_warm = int(round(spec.warm_frac * len(spec.cells)))
         for cell in spec.cells[:n_warm]:
-            svc.query(cell[0], cell[1], labor_sd=cell[2],
-                      **spec.model_kwargs)
+            # warmup MUST solve (surrogate_ok=False): with a surrogate
+            # policy the later lattice cells would otherwise be answered
+            # by interpolation over the first few instead of populating
+            # the store the run is warming
+            fut = svc.submit(make_query(cell[0], cell[1],
+                                        labor_sd=cell[2],
+                                        surrogate_ok=False,
+                                        **spec.model_kwargs))
+            if not fut.done():
+                svc.flush()
+            fut.result()
         arrivals = generate_arrivals(spec)
         busy_until = clk.t
         slots: list = [None] * len(arrivals)
@@ -324,6 +352,14 @@ class FleetSpec(NamedTuple):
     max_batch: int = 4
     sigterm_worker: Optional[int] = None
     sigterm_after: Optional[int] = None
+    # ISSUE 17: fraction of arrivals redrawn uniformly inside the
+    # lattice's bounding hull (continuous-parameter queries for the
+    # surrogate tier).  Extra RNG draws happen ONLY when positive, so
+    # frac=0 traces stay bit-identical to pre-surrogate fleets.
+    offlattice_frac: float = 0.0
+    # SurrogatePolicy field overrides forwarded to every worker's
+    # ``--surrogate`` flag (None = workers serve without a surrogate).
+    surrogate: Optional[dict] = None
 
 
 class FleetReport(NamedTuple):
@@ -374,9 +410,16 @@ def generate_fleet_arrivals(spec: FleetSpec, worker: int) -> list:
     p /= p.sum()
     mix = np.asarray(spec.priority_mix, dtype=np.float64)
     mix = mix / mix.sum()
+    off = float(spec.offlattice_frac)
+    if off > 0.0:
+        hull = np.asarray(spec.cells, dtype=np.float64)
+        lo, hi = hull.min(axis=0), hull.max(axis=0)
     out = []
     for _ in range(int(spec.queries_per_worker)):
         cell = spec.cells[int(rng.choice(n, p=p))]
+        if off > 0.0 and rng.random() < off:
+            cell = tuple(float(c)
+                         for c in lo + rng.random(lo.shape[0]) * (hi - lo))
         priority = int(rng.choice(len(mix), p=mix))
         out.append((tuple(float(c) for c in cell), priority))
     return out
@@ -402,6 +445,8 @@ def _spawn_worker(spec: FleetSpec, store_dir: str, journal_path: str,
         cmd += ["--prefetch-k", str(spec.prefetch_k),
                 "--prefetch-cells",
                 _json.dumps([list(c) for c in spec.cells])]
+    if spec.surrogate is not None:
+        cmd += ["--surrogate", _json.dumps(spec.surrogate)]
     if chaos:
         cmd += ["--chaos"]
     return subprocess.Popen(

@@ -18,7 +18,10 @@ from ..utils.timing import CompileCounter
 # Served-request paths, in cache-goodness order.  "degraded" is the
 # overload brown-out path (ISSUE 8): a nearest-neighbor answer served
 # from the store under pressure, tagged ``quality="degraded_neighbor"``.
-PATHS = ("hit", "near", "cold", "degraded")
+# "surrogate" is the continuous-parameter interpolation tier (ISSUE 17):
+# an off-lattice answer fit over the k nearest certified stored
+# solutions, tagged ``quality="surrogate"`` with its error bound.
+PATHS = ("hit", "near", "cold", "degraded", "surrogate")
 
 
 class LatencyHistogram:
@@ -119,6 +122,18 @@ class ServeMetrics:
         self.prefetch_issued = 0
         self.prefetch_converted = 0
         self.prefetch_suppressed = 0
+        # surrogate tier (ISSUE 17): interpolated answers served, the
+        # per-reason escalation counts (too_few_donors / donor_too_far /
+        # bound_exceeded / audit), seeded-audit outcomes (an audit
+        # failure = the real solve landed OUTSIDE the surrogate's own
+        # reported bound — loud by design), lattice refinement points
+        # published from escalated solves, and the reported error bound
+        # distribution (reusing the latency-histogram percentiles)
+        self.surrogate_escalations: dict = {}
+        self.audits = 0
+        self.audit_failures = 0
+        self.lattice_refinements = 0
+        self.surrogate_bounds = LatencyHistogram()
         # fleet tier (ISSUE 15): exact hits served from a PEER worker's
         # publish (discovered at the claim gate or the waiter poll)
         self.fleet_remote_hits = 0
@@ -207,6 +222,33 @@ class ServeMetrics:
         """One exact hit served from a peer worker's publish (fleet)."""
         with self._lock:
             self.fleet_remote_hits += 1
+
+    def record_surrogate_bound(self, bound: float) -> None:
+        """One surrogate answer's reported error bound (r* units)."""
+        with self._lock:
+            self.surrogate_bounds.add(float(bound))
+
+    def record_surrogate_escalated(self, reason: str) -> None:
+        """One surrogate-eligible query escalated to a real solve."""
+        with self._lock:
+            self.surrogate_escalations[str(reason)] = (
+                self.surrogate_escalations.get(str(reason), 0) + 1)
+
+    def record_audit(self, ok: bool) -> None:
+        """One seeded-audit escalation resolved: the real solve landed
+        inside (ok) or outside (FAILED — loud) the surrogate's own
+        reported error bound."""
+        with self._lock:
+            self.audits += 1
+            if not ok:
+                self.audit_failures += 1
+
+    def record_lattice_refined(self) -> None:
+        """One escalated solve was published as a parameter-space
+        refinement point (the lattice densified where the surrogate
+        failed)."""
+        with self._lock:
+            self.lattice_refinements += 1
 
     def _store_evictions(self) -> int:
         total = self._retired_evictions
@@ -316,6 +358,12 @@ class ServeMetrics:
                                                          (int, float)):
                 continue
             registry.gauge(f"aiyagari_{name}").set(float(value))
+        # per-quality served gauges (ISSUE 17): one gauge per serving
+        # path so a scrape splits the answer-quality mix directly
+        with self._lock:
+            served = dict(self.served)
+        for path, n in served.items():
+            registry.gauge(f"aiyagari_serve_served_{path}").set(float(n))
         # per-scenario disaggregation (ISSUE 9 satellite): one gauge per
         # (scenario, path) so prometheus_text() splits the traffic mix
         # by model family
@@ -379,6 +427,30 @@ class ServeMetrics:
                 "serve_marginal_certificates": self.certificates["marginal"],
                 "serve_failed_certificates": self.certificates["failed"],
                 "store_corrupt_evictions": self._store_evictions(),
+                # surrogate tier (ISSUE 17): hit rate over ALL requests
+                # (UP is better — interpolation displacing cold solves),
+                # escalation rate over surrogate-ELIGIBLE requests
+                # (DOWN), seeded-audit outcomes, refinement publishes,
+                # and the reported error-bound percentiles (r* units,
+                # NOT milliseconds — DOWN is better)
+                "surrogate_hit_rate": round(
+                    self.served["surrogate"] / total, 4),
+                "surrogate_escalation_rate": round(
+                    sum(self.surrogate_escalations.values())
+                    / max(self.served["surrogate"]
+                          + sum(self.surrogate_escalations.values()),
+                          1), 4),
+                "surrogate_escalations": sum(
+                    self.surrogate_escalations.values()),
+                "surrogate_audits": self.audits,
+                "surrogate_audit_failures": self.audit_failures,
+                "surrogate_refinements": self.lattice_refinements,
+                "surrogate_bound_p50": self.surrogate_bounds.percentile(50),
+                "surrogate_bound_p95": self.surrogate_bounds.percentile(95),
+                "surrogate_p50_ms": self._ms(
+                    self.latency["surrogate"].percentile(50)),
+                "surrogate_p95_ms": self._ms(
+                    self.latency["surrogate"].percentile(95)),
                 # speculative prefetch + fleet tier (ISSUE 15)
                 "serve_prefetch_issued": self.prefetch_issued,
                 "serve_prefetch_converted": self.prefetch_converted,

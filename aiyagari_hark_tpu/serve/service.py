@@ -43,6 +43,7 @@ the typed ``Interrupted`` instead of leaving callers hung.
 
 from __future__ import annotations
 
+import functools
 import threading
 import time
 from concurrent.futures import Future
@@ -75,6 +76,7 @@ from .batcher import MicroBatcher, ServeQueueFull  # noqa: F401  (re-export)
 from .metrics import ServeMetrics
 from .overload import CircuitBreaker, Priority, predicted_work
 from .store import UNCERTIFIED, SolutionStore, make_solution
+from .surrogate import SurrogatePolicy, fit_surrogate
 
 # Queue-depth histogram buckets for the obs registry (ISSUE 8 satellite):
 # powers of two spanning "empty" to the default max_queue.
@@ -253,24 +255,43 @@ class EquilibriumQuery(NamedTuple):
     # Aiyagari spellings; for another family read them as the scenario's
     # first/second/third cell coordinates.
     scenario: str = "aiyagari"
+    # surrogate opt-out (ISSUE 17): ``False`` forces a genuine solve on
+    # a service running with a SurrogatePolicy — lattice warmup and
+    # golden replays must not be answered by interpolation over the
+    # cells they are trying to solve.  Never enters key()/group().
+    surrogate_ok: bool = True
 
     def cell(self) -> Tuple[float, float, float]:
         return (self.crra, self.labor_ar, self.labor_sd)
 
     def key(self) -> int:
-        return solution_fingerprint(self.crra, self.labor_ar,
-                                    self.labor_sd, self.kwargs, self.dtype,
-                                    scenario=self.scenario)
+        return _query_key(self.crra, self.labor_ar, self.labor_sd,
+                          self.kwargs, self.dtype, self.scenario)
 
     def group(self) -> int:
-        return work_fingerprint(self.kwargs, self.dtype,
-                                scenario=self.scenario)
+        return _query_group(self.kwargs, self.dtype, self.scenario)
+
+
+@functools.lru_cache(maxsize=65536)
+def _query_key(crra, labor_ar, labor_sd, kwargs, dtype, scenario) -> int:
+    """Memoized ``EquilibriumQuery.key()``: the fingerprint is a pure
+    function of hashable fields, and the serve path asks for it several
+    times per submit (store probe, surrogate tag, journal attrs) — on
+    the sub-millisecond surrogate tier the recomputes are measurable."""
+    return solution_fingerprint(crra, labor_ar, labor_sd, kwargs, dtype,
+                                scenario=scenario)
+
+
+@functools.lru_cache(maxsize=4096)
+def _query_group(kwargs, dtype, scenario) -> int:
+    return work_fingerprint(kwargs, dtype, scenario=scenario)
 
 
 def make_query(crra: float, labor_ar: float, labor_sd: float = 0.2,
                dtype=None, fault_iter: Optional[int] = None,
                priority: int = Priority.INTERACTIVE,
                degraded_ok: bool = False, scenario: str = "aiyagari",
+               surrogate_ok: bool = True,
                **model_kwargs) -> EquilibriumQuery:
     """Canonicalize one request: dtype to the concrete compute dtype
     (``dtype=None`` and the explicit default address the same solution),
@@ -303,7 +324,7 @@ def make_query(crra: float, labor_ar: float, labor_sd: float = 0.2,
         kwargs=hashable_kwargs(model_kwargs),
         fault_iter=None if fault_iter is None else int(fault_iter),
         priority=priority, degraded_ok=bool(degraded_ok),
-        scenario=scn.name)
+        scenario=scn.name, surrogate_ok=bool(surrogate_ok))
 
 
 class ServedResult(NamedTuple):
@@ -348,6 +369,13 @@ class ServedResult(NamedTuple):
     scenario: str = "aiyagari"
     fields: tuple = ()
     values: tuple = ()
+    # surrogate tier (ISSUE 17, DESIGN §15): an off-lattice answer
+    # interpolated over the k nearest certified stored solutions is
+    # ALWAYS tagged ``quality="surrogate"`` with its model-implied
+    # |error| bound (r* units) and the donor fingerprints — never
+    # cached, never served untagged
+    surrogate_error_bound: Optional[float] = None
+    donor_keys: Optional[tuple] = None
 
     def value(self, name: str) -> float:
         """One named packed-row field of the answering scenario."""
@@ -390,6 +418,9 @@ class _Pending(NamedTuple):
     weight: float = 0.0                # predicted-work occupancy units
     region: Optional[tuple] = None     # breaker region (admission on)
     probe: bool = False                # this pending IS a half-open probe
+    refine: str = ""                   # surrogate-escalation reason
+    #   (ISSUE 17): non-empty marks this cold solve as a parameter-space
+    #   refinement point — journaled LATTICE_REFINED after publish
 
 
 class EquilibriumService:
@@ -445,7 +476,8 @@ class EquilibriumService:
                  obs=None, admission=None,
                  mesh=None, mesh_axis: str = "cells",
                  prefetch_k: int = 0, prefetch_cells=None,
-                 fleet_poll_s: float = 0.005):
+                 fleet_poll_s: float = 0.005,
+                 surrogate=None):
         # Multi-chip mesh contract FIRST (ISSUE 11): resolve_mesh raises
         # typed on a mesh without the lane axis, and that must happen
         # before this constructor acquires anything that needs closing
@@ -498,6 +530,36 @@ class EquilibriumService:
         self._prefetch_lock = threading.Lock()
         self._prefetch_issued_keys: set = set()
         self._prefetch_stored: set = set()
+        # Lattice-neighbor enumeration rides the SAME CellIndex seam the
+        # store's donor search uses (ISSUE 17): the prefetch lattice is
+        # indexed once here, and _maybe_prefetch asks it for the nearest
+        # ring instead of re-ranking the whole lattice per miss.
+        self._prefetch_index = None
+        if self._prefetch_cells:
+            from .cellindex import CellIndex
+
+            self._prefetch_index = CellIndex()
+            for i, c in enumerate(self._prefetch_cells):
+                self._prefetch_index.add(i, c, group=0, r_star=0.0,
+                                         cert_level=UNCERTIFIED)
+        # Surrogate tier (ISSUE 17, DESIGN §15): a SurrogatePolicy
+        # answers off-lattice misses by local interpolation over the k
+        # nearest CERTIFIED stored solutions; None (default) disables
+        # the tier — behavior and served bits identical to the
+        # pre-surrogate engine.  The audit rng is the policy's seeded
+        # escalation sampler; _audit_pending maps an escalated key to
+        # the surrogate prediction the real solve must be checked
+        # against (resolved in _launch_impl, a-posteriori).
+        if surrogate is not None and not isinstance(surrogate,
+                                                    SurrogatePolicy):
+            raise TypeError(
+                f"surrogate must be a serve.SurrogatePolicy or None, "
+                f"got {type(surrogate).__name__}")
+        self._surrogate = surrogate
+        self._audit_lock = threading.Lock()
+        self._audit_rng = (np.random.default_rng(surrogate.audit_seed)
+                           if surrogate is not None else None)
+        self._audit_pending: dict = {}
         self._certify = bool(certify_before_cache)
         self._cert_thresholds = cert_thresholds
         self._corrupt_lane = (dict(inject_corrupt_lane)
@@ -609,6 +671,20 @@ class EquilibriumService:
                 "queries rejected at submit on an expired or "
                 "unmeetable deadline").inc()
             raise DeadlineExceeded(q.cell(), q.key(), 0.0)
+        # Surrogate tier (ISSUE 17): a miss with a SurrogatePolicy is
+        # answered by local interpolation over stored certified
+        # neighbors — microseconds, before the overload gauntlet, like
+        # the exact hit above.  A None return with a reason ESCALATES:
+        # the query falls through to a genuine cold solve whose publish
+        # is journaled as a lattice refinement point.
+        esc_reason = ""
+        if (self._surrogate is not None and q.surrogate_ok
+                and not _prefetch and q.fault_iter is None
+                and q.priority != Priority.SPECULATIVE):
+            res, esc_reason = self._surrogate_answer(q, t0)
+            if res is not None:
+                fut.set_result(res)
+                return fut
         adm = self._admission
         region = None
         probe = False
@@ -671,7 +747,8 @@ class EquilibriumService:
                 acquired = True
             expiry = None if deadline is None else t0 + float(deadline)
             pending = _Pending(q, fut, t0, expiry, weight=weight,
-                               region=region, probe=probe)
+                               region=region, probe=probe,
+                               refine=esc_reason)
             # Enqueue under the gate: without it a close() between the
             # closed-check above and the offer could run its final drain
             # first, stranding this future.  The worker drains the
@@ -725,24 +802,24 @@ class EquilibriumService:
         suppresses the issue (counted) and NEVER surfaces to the
         triggering caller — and SPECULATIVE pendings are the first shed
         under pressure, so prefetch cannot displace interactive work."""
-        import numpy as np
-
-        from ..parallel.sweep import neighbor_distance
-
         scn = _scenario_of(q.scenario)
-        cand = [c for c in self._prefetch_cells if c != q.cell()]
-        if not cand:
+        if self._prefetch_index is None:
             return
-        # distances first (one vectorized pass), queries/fingerprints
-        # LAZILY and only for the nearest few: hashing a key per lattice
+        # nearest ring from the CellIndex (ISSUE 17) — the same seam
+        # the store's donor search answers through, so prefetch stops
+        # re-ranking the whole lattice per miss; keys/fingerprints stay
+        # LAZY and only for the nearest few (hashing a key per lattice
         # cell per miss would make prefetch O(lattice) on the serving
-        # path, which a million-cell lattice cannot afford
-        d = neighbor_distance(q.cell(), np.asarray(cand),
-                              scale=scn.cells.scale)
+        # path).  Ties and ordering are bitwise the old linear scan's:
+        # (normalized distance, lattice insertion order), with the
+        # query's own cell skipped post-hoc.
         attempts = 0
         scanned = 0
         scan_cap = max(4 * self._prefetch_k, 16)
-        for i in np.argsort(d, kind="stable"):
+        with self._prefetch_lock:
+            near = self._prefetch_index.nearest_k(
+                q.cell(), 0, scan_cap + 1, scale=scn.cells.scale)
+        for idx, dist in near:
             # K bounds ATTEMPTS, not successes: under pressure the
             # admission layer rejects the speculative class wholesale,
             # and probing the entire lattice about it helps nobody.
@@ -750,8 +827,10 @@ class EquilibriumService:
             # — past the nearest handful, cells are not "neighbors".
             if attempts >= self._prefetch_k or scanned >= scan_cap:
                 break
+            cell = self._prefetch_cells[int(idx)]
+            if cell == q.cell():
+                continue
             scanned += 1
-            cell = cand[int(i)]
             nq = q._replace(crra=cell[0], labor_ar=cell[1],
                             labor_sd=cell[2],
                             priority=Priority.SPECULATIVE,
@@ -780,7 +859,7 @@ class EquilibriumService:
             self._obs.event("PREFETCH_ISSUED", cell=list(cell),
                             scenario=q.scenario, key=key,
                             parent_cell=list(q.cell()),
-                            distance=round(float(d[int(i)]), 6))
+                            distance=round(float(dist), 6))
             self._obs.counter(
                 "aiyagari_serve_prefetch_issued_total",
                 "speculative neighbor queries issued around "
@@ -905,6 +984,131 @@ class EquilibriumService:
         self._obs.record_span("serve/query", latency, path="degraded",
                               cell=q.cell(), scenario=scn.name)
         return res
+
+    # -- surrogate tier (ISSUE 17, DESIGN §15) ------------------------------
+
+    def _surrogate_escalate(self, q: EquilibriumQuery, reason: str,
+                            **attrs) -> str:
+        """The surrogate-escalation seam (covered by
+        ``check_obs_events``): a surrogate-eligible query falls through
+        to a genuine cold solve — too few / too distant donors, an
+        error bound over budget, or the seeded audit draw.  Returns the
+        reason so ``submit`` can mark the pending as a refinement
+        point."""
+        self.metrics.record_surrogate_escalated(reason)
+        self._obs.event("SURROGATE_ESCALATED", cell=q.cell(),
+                        key=q.key(), scenario=q.scenario,
+                        reason=reason, **attrs)
+        self._obs.counter(
+            "aiyagari_serve_surrogate_escalations_total",
+            "surrogate-eligible queries escalated to a real "
+            "solve").inc()
+        return reason
+
+    def _surrogate_answer(self, q: EquilibriumQuery, t0: float):
+        """Answer a miss by a distance-weighted local-linear fit over
+        the k nearest CERTIFIED stored solutions in normalized CellSpace
+        coordinates (``surrogate.fit_surrogate`` — the ``donor_margin``
+        machinery generalized to k donors).  Returns ``(result, "")``
+        on a served surrogate, ``(None, reason)`` on an escalation, and
+        ``(None, "")`` when the group holds nothing interpolable (a
+        plain cold miss, not an escalation).
+
+        The answer is ALWAYS tagged ``quality="surrogate"`` with its
+        model-implied error bound and donor fingerprints, and is NEVER
+        cached: the store continues to hold only genuinely solved
+        rows.  Solver-effort counters are zeroed (no solve ran) and the
+        status column is the nearest donor's — only value columns are
+        interpolated through the equivalent kernel."""
+        pol = self._surrogate
+        scn = _scenario_of(q.scenario)
+        neigh = self.store.neighbors(
+            q.cell(), q.group(), k=pol.k,
+            require_certified=pol.require_certified,
+            scale=scn.cells.scale)
+        if not neigh:
+            return None, ""
+        if len(neigh) < pol.min_donors:
+            return None, self._surrogate_escalate(
+                q, "too_few_donors", donors=len(neigh))
+        d0 = float(neigh[0][2])
+        if d0 > pol.max_distance:
+            return None, self._surrogate_escalate(
+                q, "donor_too_far", distance=round(d0, 6))
+        # fetch donor rows through get() — the checksum chain re-runs,
+        # so a corrupt donor drops out (and may demote this answer to
+        # an escalation) instead of poisoning the fit
+        schema_ck = scn.schema.checksum()
+        donors = []
+        for key, meta, dist in neigh:
+            sol = self.store.get(key, schema_ck=schema_ck)
+            if sol is not None:
+                donors.append((int(key),
+                               np.asarray(sol.packed, dtype=np.float64),
+                               float(dist), tuple(meta.cell)))
+        if len(donors) < pol.min_donors:
+            return None, self._surrogate_escalate(
+                q, "too_few_donors", donors=len(donors))
+        floor = 0.0
+        if scn.warm is not None:
+            floor = 64.0 * float(
+                scn.warm.host_r_tol(dict(q.kwargs), q.dtype))
+        schema = scn.schema
+        rows = np.stack([r for _, r, _, _ in donors])
+        fit = fit_surrogate(
+            q.cell(), [c for _, _, _, c in donors],
+            rows[:, schema.idx(schema.root)],
+            [d for _, _, d, _ in donors],
+            scn.cells.scale, floor=floor,
+            inflation=pol.bound_inflation)
+        donor_keys = tuple(k for k, _, _, _ in donors)
+        if fit.bound > pol.max_error_bound:
+            return None, self._surrogate_escalate(
+                q, "bound_exceeded", bound=float(fit.bound),
+                budget=float(pol.max_error_bound))
+        if pol.audit_fraction > 0.0:
+            # seeded a-posteriori audit: escalate to a REAL solve and
+            # remember the prediction; _launch_impl checks the solved
+            # r* against the surrogate's own reported bound
+            with self._audit_lock:
+                audited = (float(self._audit_rng.random())
+                           < pol.audit_fraction)
+                if audited:
+                    self._audit_pending[q.key()] = (
+                        float(fit.r_star), float(fit.bound), donor_keys)
+            if audited:
+                return None, self._surrogate_escalate(
+                    q, "audit", bound=float(fit.bound))
+        row = fit.kernel @ rows
+        # interpolated solver-effort counters are fiction — no solve
+        # ran; status is taken from the nearest donor (donors are all
+        # healthy stored rows, so ties in status are the norm)
+        for name in tuple(schema.counters) + tuple(schema.phases or ()):
+            if schema.has(name):
+                row[schema.idx(name)] = 0.0
+        row[schema.idx(schema.status)] = donors[0][1][
+            schema.idx(schema.status)]
+        res = _result_from_row(schema, row, "surrogate", None, q.key(),
+                               cert_level=None, scenario=scn.name)
+        res = res._replace(quality="surrogate",
+                           surrogate_error_bound=float(fit.bound),
+                           donor_keys=donor_keys)
+        latency = self._clock() - t0
+        self.metrics.record_served("surrogate", latency,
+                                   scenario=scn.name)
+        self.metrics.record_surrogate_bound(fit.bound)
+        self._obs.event("SURROGATE_SERVED", cell=q.cell(), key=q.key(),
+                        scenario=scn.name,
+                        bound=float(fit.bound), donors=len(donors),
+                        distance=round(d0, 6),
+                        linear=bool(fit.linear))
+        self._obs.counter(
+            "aiyagari_serve_surrogate_total",
+            "off-lattice queries answered by the certified "
+            "surrogate tier").inc()
+        self._obs.record_span("serve/query", latency, path="surrogate",
+                              cell=q.cell(), scenario=scn.name)
+        return res, ""
 
     # -- occupancy accounting (admission enabled) ---------------------------
 
@@ -1369,6 +1573,7 @@ class EquilibriumService:
                                    for d in ps]
             self._abort_probes(pendings)
             for p in pendings:
+                self._audit_forget(p)
                 if not p.future.done():
                     p.future.set_exception(e)
                 self.metrics.record_failure(self._clock() - p.t_submit)
@@ -1446,6 +1651,7 @@ class EquilibriumService:
                                            for d in ps]
                     self._abort_probes(pendings)
                     for p in pendings:
+                        self._audit_forget(p)
                         if not p.future.done():
                             p.future.set_exception(e)
                         self.metrics.record_failure(
@@ -1471,6 +1677,7 @@ class EquilibriumService:
                     self.store.release(p.query.key())
                 exc = EquilibriumSolveFailed(
                     p.query.cell(), status, p.query.key())
+                self._audit_forget(p)
                 for pp in (p,) + tuple(lane_dups):
                     self._breaker_note(pp, ok=False, now=now)
                     pp.future.set_exception(exc)
@@ -1489,6 +1696,7 @@ class EquilibriumService:
                         self.store.release(p.query.key())
                     exc = CertificationFailed(
                         p.query.cell(), p.query.key(), cert)
+                    self._audit_forget(p)
                     for pp in (p,) + tuple(lane_dups):
                         self._breaker_note(pp, ok=False, now=now)
                         pp.future.set_exception(exc)
@@ -1522,6 +1730,8 @@ class EquilibriumService:
                     self.store.put(entry)
                 if p.query.priority == Priority.SPECULATIVE:
                     self._note_prefetch_stored(p.query.key())
+                if p.refine:
+                    self._note_refinement(p, res, lvl, now)
             for pp in (p,) + tuple(lane_dups):
                 pp.future.set_result(res)
                 self.metrics.record_served(path, now - pp.t_submit,
@@ -1531,6 +1741,47 @@ class EquilibriumService:
                                       scenario=scn.name)
             self.metrics.record_phases(res.descent_steps, res.polish_steps,
                                        res.precision_escalations)
+
+    def _note_refinement(self, p: _Pending, res: ServedResult, lvl,
+                         now: float) -> None:
+        """An escalated surrogate query's real solve was published
+        (ISSUE 17): journal the parameter-space refinement point —
+        the lattice densified exactly where the surrogate failed — and
+        resolve a pending seeded audit: the solved r* must land inside
+        the surrogate's own reported error bound, or the audit fails
+        loudly in metrics and on the LATTICE_REFINED event."""
+        pol = self._surrogate
+        attrs: dict = {"reason": p.refine}
+        with self._audit_lock:
+            audit = self._audit_pending.pop(p.query.key(), None)
+        if audit is not None:
+            r_hat, bound, donor_keys = audit
+            err = abs(float(res.r_star) - r_hat)
+            ok = bool(err <= bound)
+            self.metrics.record_audit(ok)
+            attrs.update(audit_ok=ok, surrogate_err=err,
+                         surrogate_bound=bound,
+                         donors=[int(k) for k in donor_keys])
+        if pol is not None and pol.refine:
+            self.metrics.record_lattice_refined()
+            self._obs.event("LATTICE_REFINED", cell=p.query.cell(),
+                            key=p.query.key(),
+                            scenario=p.query.scenario,
+                            cert_level=lvl, **attrs)
+            self._obs.counter(
+                "aiyagari_serve_lattice_refinements_total",
+                "escalated solves published as parameter-space "
+                "refinement points").inc()
+
+    def _audit_forget(self, p: _Pending) -> None:
+        """A pending marked for a surrogate audit left the system
+        without a published solve (solver failure, launch error): drop
+        the stashed prediction so a LATER same-key solve cannot resolve
+        a stale audit."""
+        if self._surrogate is None or not p.refine:
+            return
+        with self._audit_lock:
+            self._audit_pending.pop(p.query.key(), None)
 
     # -- pumping / lifecycle ------------------------------------------------
 

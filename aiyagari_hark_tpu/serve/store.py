@@ -90,6 +90,7 @@ from ..utils.checkpoint import (
     save_pytree,
 )
 from ..utils.fingerprint import fingerprint_hex, packed_row_checksum
+from .cellindex import CellIndex
 from .lease import LeaseBackend, SharedDirBackend
 
 # verify.certificate.UNCERTIFIED, inlined to keep this module's imports
@@ -202,9 +203,13 @@ class SolutionStore:
                  donor_cutoff: float = float("inf"), obs=None,
                  shared: bool = False, lease_ttl_s: float = 30.0,
                  owner: str = "",
-                 lease_backend: Optional[LeaseBackend] = None):
+                 lease_backend: Optional[LeaseBackend] = None,
+                 index: str = "grid"):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if index not in ("grid", "linear"):
+            raise ValueError(
+                f"index must be 'grid' or 'linear', got {index!r}")
         if shared and disk_path is None:
             raise ValueError(
                 "SolutionStore(shared=True) requires a disk_path: the "
@@ -270,6 +275,16 @@ class SolutionStore:
         self._lock = threading.RLock()
         self._mem: OrderedDict = OrderedDict()   # key -> StoredSolution
         self._meta: dict = {}                    # key -> _Meta
+        # neighbor-lookup acceleration (ISSUE 17): the grid-bucket
+        # CellIndex is the default; "linear" keeps the scan as the
+        # pinned fallback.  Both tie-break by _meta insertion order, so
+        # every mutation of _meta MUST go through _meta_set/_meta_del —
+        # the index mirror and the per-group matrix cache stay exact.
+        self._index: Optional[CellIndex] = (
+            CellIndex(on_rebuild=self._index_rebuilt)
+            if index == "grid" else None)
+        self.index_kind = index
+        self._group_cache: dict = {}    # group -> (rows, cell matrix)
         # keys whose CURRENT in-memory residency has been checksum-
         # verified (ISSUE 15 satellite): membership is dropped whenever
         # the memory copy changes hands (insert, promote, evict), so
@@ -314,6 +329,46 @@ class SolutionStore:
     def _obs_scope(self):
         return self._obs if self._obs is not NULL_OBS else active_obs()
 
+    # -- metadata index maintenance (ISSUE 17) ------------------------------
+
+    def _meta_set(self, key: int, meta: _Meta) -> None:
+        """The ONLY writer of ``_meta`` rows (lock held): mirrors every
+        insert/refresh into the CellIndex and invalidates the group's
+        cached cell matrix, so the neighbor seam can never observe a
+        stale view."""
+        key = int(key)
+        prior = self._meta.get(key)
+        if prior is not None and prior.group != meta.group:
+            # defensive: a key's group is fingerprint-derived and never
+            # changes in practice, but a mismatch must not strand the
+            # old group's mirror entry
+            self._group_cache.pop(prior.group, None)
+            if self._index is not None:
+                self._index.remove(key, prior.group)
+        self._meta[key] = meta
+        self._group_cache.pop(meta.group, None)
+        if self._index is not None:
+            self._index.add(key, meta.cell, meta.group, meta.r_star,
+                            meta.cert_level)
+
+    def _meta_del(self, key: int) -> Optional[_Meta]:
+        """The ONLY remover of ``_meta`` rows (lock held)."""
+        meta = self._meta.pop(int(key), None)
+        if meta is not None:
+            self._group_cache.pop(meta.group, None)
+            if self._index is not None:
+                self._index.remove(int(key), meta.group)
+        return meta
+
+    def _index_rebuilt(self, group, entries, reason: str) -> None:
+        """The index-rebuild seam (ISSUE 17; covered by
+        ``check_obs_events``): every CellIndex (re)build — restart
+        reload, scenario scale change, growth re-width — leaves a
+        journal trail with its size and cause."""
+        self._obs_scope().event(
+            "INDEX_REBUILD", group=None if group is None else int(group),
+            entries=int(entries), reason=str(reason))
+
     def _record_eviction(self, reason: str, tier: str, path: str,
                          key=None, message=None,
                          stacklevel: int = 4) -> None:
@@ -351,7 +406,7 @@ class SolutionStore:
         servable."""
         if key is not None:
             self._mem.pop(int(key), None)
-            self._meta.pop(int(key), None)
+            self._meta_del(int(key))
             self._verified_mem.discard(int(key))
         self._record_eviction(reason, "disk", path, key=key)
         try:
@@ -384,12 +439,16 @@ class SolutionStore:
                 self._evict_corrupt(path, "checksum mismatch",
                                     key=sol.key)
                 continue
-            self._meta[int(sol.key)] = _Meta(
+            self._meta_set(int(sol.key), _Meta(
                 cell=tuple(np.asarray(sol.cell, dtype=np.float64)),
                 group=int(sol.group),
                 r_star=float(sol.root), on_disk=True,
                 cert_level=int(sol.cert_level),
-                schema_ck=int(sol.schema_ck))
+                schema_ck=int(sol.schema_ck)))
+        if self._index is not None and self._meta:
+            # restart rebuild of the neighbor index from the metadata
+            # tier (ISSUE 17) — journaled through the rebuild seam
+            self._index_rebuilt(None, len(self._meta), "restart")
 
     # -- core ops -----------------------------------------------------------
 
@@ -416,7 +475,7 @@ class SolutionStore:
             if (sol is not None and schema_ck is not None
                     and int(sol.schema_ck) != int(schema_ck)):
                 self._mem.pop(key, None)
-                self._meta.pop(key, None)
+                self._meta_del(key)
                 self._verified_mem.discard(key)
                 self._record_eviction("stale row schema", "memory", "",
                                       key=key, stacklevel=3)
@@ -450,7 +509,7 @@ class SolutionStore:
                                else "")),
                         stacklevel=3)
                     if not on_disk:
-                        self._meta.pop(key, None)
+                        self._meta_del(key)
                         return None
                 else:
                     self._verified_mem.add(key)
@@ -491,12 +550,12 @@ class SolutionStore:
             # a verified disk load begins a verified residency; a
             # probe-discovered peer publish also earns an index row so
             # donor nomination sees it from now on
-            self._meta[key] = _Meta(
+            self._meta_set(key, _Meta(
                 cell=tuple(np.asarray(sol.cell, dtype=np.float64)),
                 group=int(sol.group),
                 r_star=float(sol.root), on_disk=True,
                 cert_level=int(sol.cert_level),
-                schema_ck=int(sol.schema_ck))
+                schema_ck=int(sol.schema_ck)))
             self._insert(key, sol)
             self._verified_mem.add(key)
             return sol
@@ -522,12 +581,12 @@ class SolutionStore:
             prior = self._meta.get(key)
             if prior is not None and prior.on_disk:
                 on_disk = True
-            self._meta[key] = _Meta(
+            self._meta_set(key, _Meta(
                 cell=tuple(np.asarray(sol.cell, dtype=np.float64)),
                 group=int(sol.group),
                 r_star=float(sol.root), on_disk=on_disk,
                 cert_level=int(sol.cert_level),
-                schema_ck=int(sol.schema_ck))
+                schema_ck=int(sol.schema_ck)))
             self._insert(key, sol)
 
     # -- fleet claim / publish (ISSUE 15, DESIGN §14) -----------------------
@@ -849,9 +908,66 @@ class SolutionStore:
                 # memory-only tier: eviction forgets the entry entirely
                 # (bounded memory is the contract); with a disk tier the
                 # index row stays so the entry remains addressable
-                del self._meta[old_key]
+                self._meta_del(old_key)
 
     # -- donor nomination ---------------------------------------------------
+
+    def _group_rows_locked(self, group: int):
+        """Cached per-group donor rows for the LINEAR path (ISSUE 17
+        satellite; lock held): the finite-r* rows of ``group`` in
+        metadata insertion order plus their prebuilt cell matrix —
+        ``nominate``/``nearest`` previously re-materialized the matrix
+        on EVERY call.  Invalidated by ``_meta_set``/``_meta_del``."""
+        cached = self._group_cache.get(group)
+        if cached is None:
+            rows = [(k, m) for k, m in self._meta.items()
+                    if m.group == group and np.isfinite(m.r_star)]
+            mat = (np.asarray([m.cell for _, m in rows])
+                   if rows else None)
+            cached = (rows, mat)
+            self._group_cache[group] = cached
+        return cached
+
+    def neighbors(self, cell, group: int, k: Optional[int],
+                  require_certified: bool = False, scale=None):
+        """THE neighbor-selection seam (ISSUE 17, DESIGN §15): donor
+        nomination, degraded-answer selection and the surrogate tier's
+        k-NN all route through here.  Returns up to ``k`` entries
+        ``[(key, _Meta, distance), ...]`` ordered by (normalized-L1
+        distance, metadata insertion order) — ``k=None`` ranks the whole
+        group.  The grid-bucket ``CellIndex`` answers by default; the
+        linear scan (over the cached per-group cell matrix) is the
+        pinned fallback, and the two are property-tested bitwise
+        identical, ties included."""
+        from ..parallel.sweep import NEIGHBOR_CELL_SCALE, neighbor_distance
+
+        if scale is None:
+            scale = NEIGHBOR_CELL_SCALE
+        group = int(group)
+        with self._lock:
+            if self._index is not None:
+                hits = self._index.nearest_k(
+                    cell, group, k, scale=scale,
+                    require_certified=require_certified)
+                return [(kk, self._meta[kk], dd) for kk, dd in hits]
+            rows, mat = self._group_rows_locked(group)
+        if require_certified and rows:
+            sel = [i for i, (_, m) in enumerate(rows)
+                   if m.cert_level >= 0]
+            rows = [rows[i] for i in sel]
+            mat = mat[sel] if sel else None
+        if not rows:
+            return []
+        d = neighbor_distance(cell, mat, scale=scale)
+        if k == 1:
+            # first-minimum == stable-argsort[0]; O(n) beats the sort
+            i = int(np.argmin(d))
+            return [(int(rows[i][0]), rows[i][1], float(d[i]))]
+        order = np.argsort(d, kind="stable")
+        if k is not None:
+            order = order[:k]
+        return [(int(rows[int(i)][0]), rows[int(i)][1], float(d[int(i)]))
+                for i in order]
 
     def nominate(self, cell, group: int, width: float,
                  r_tol: float, scale=None) -> Optional[Donation]:
@@ -866,28 +982,17 @@ class SolutionStore:
         the querying scenario's ``CellSpace.scale`` (None = the Aiyagari
         lattice normalization).  None when the group holds no donors (or
         none inside ``donor_cutoff``)."""
-        from ..parallel.sweep import (
-            NEIGHBOR_CELL_SCALE,
-            donor_margin,
-            neighbor_distance,
-        )
+        from ..parallel.sweep import donor_margin
 
-        if scale is None:
-            scale = NEIGHBOR_CELL_SCALE
-        with self._lock:
-            rows = [(k, m) for k, m in self._meta.items()
-                    if m.group == int(group) and np.isfinite(m.r_star)]
-        if not rows:
+        near = self.neighbors(cell, group, k=2, scale=scale)
+        if not near:
             return None
-        d = neighbor_distance(cell, np.asarray([m.cell for _, m in rows]),
-                              scale=scale)
-        order = np.argsort(d, kind="stable")
-        if float(d[order[0]]) > self.donor_cutoff:
+        k0, m0, d0 = near[0]
+        if d0 > self.donor_cutoff:
             return None
-        k0, m0 = rows[int(order[0])]
         target = float(m0.r_star)
-        spread = (abs(target - float(rows[int(order[1])][1].r_star))
-                  if len(rows) > 1 else None)
+        spread = (abs(target - float(near[1][1].r_star))
+                  if len(near) > 1 else None)
         return Donation(target=target,
                         margin=donor_margin(spread, width, r_tol),
                         donor_key=int(k0))
@@ -907,23 +1012,12 @@ class SolutionStore:
         ``require_certified`` only donors carrying a CERTIFIED/MARGINAL
         ``verify`` certificate qualify (an UNCERTIFIED entry from a
         service running without ``certify_before_cache`` is skipped)."""
-        from ..parallel.sweep import (
-            NEIGHBOR_CELL_SCALE,
-            neighbor_distance,
-        )
-
-        if scale is None:
-            scale = NEIGHBOR_CELL_SCALE
-        with self._lock:
-            rows = [(k, m) for k, m in self._meta.items()
-                    if m.group == int(group) and np.isfinite(m.r_star)
-                    and (not require_certified or m.cert_level >= 0)]
-        if not rows:
-            return None
-        d = neighbor_distance(cell, np.asarray([m.cell for _, m in rows]),
+        near = self.neighbors(cell, group, k=1,
+                              require_certified=require_certified,
                               scale=scale)
-        i = int(np.argmin(d))
-        return int(rows[i][0]), float(d[i])
+        if not near:
+            return None
+        return int(near[0][0]), float(near[0][2])
 
     # -- introspection ------------------------------------------------------
 
@@ -949,3 +1043,16 @@ class SolutionStore:
         checksum/format verification and were evicted (+ file deleted)."""
         with self._lock:
             return {"store_corrupt_evictions": self._corrupt_evictions}
+
+    def index_stats(self) -> dict:
+        """Neighbor-index introspection (ISSUE 17): which path answers
+        ``neighbors`` and how often the grid index was (re)built."""
+        with self._lock:
+            return {
+                "index_kind": self.index_kind,
+                "index_entries": (len(self._index)
+                                  if self._index is not None
+                                  else len(self._meta)),
+                "index_rebuilds": (self._index.rebuilds
+                                   if self._index is not None else 0),
+            }

@@ -2973,6 +2973,213 @@ def _chips_scaling() -> dict:
     return record
 
 
+def _index_bench(space) -> dict:
+    """Measured ``CellIndex``-vs-linear-scan microbench (ISSUE 17
+    acceptance: >= 10x nearest-query speedup at 10^4+ synthetic stored
+    entries, answers bitwise identical to the linear scan).  Pure numpy
+    — synthetic cells drawn in normalized units and mapped back through
+    the scenario's ``CellSpace.normalize`` contract, no solves."""
+    import numpy as np
+
+    from aiyagari_hark_tpu.serve import CellIndex, linear_nearest_k
+
+    scale = np.asarray(space.scale, dtype=np.float64)
+    out = {}
+    for n, tag in ((10_000, "1e4"), (50_000, "5e4")):
+        rng = np.random.default_rng(n)
+        z = rng.uniform(0.0, 8.0, size=(n, scale.shape[0]))
+        cells = z * scale      # entries at ~uniform normalized density
+        idx = CellIndex()
+        for i, c in enumerate(cells):
+            idx.add(i, tuple(c), group=0, r_star=float(i % 97),
+                    cert_level=0)
+        queries = [tuple(q) for q in
+                   rng.uniform(0.0, 8.0, size=(200, scale.shape[0]))
+                   * scale]
+        seqs = np.arange(n)
+        idx.nearest_k(queries[0], 0, 2, scale=space.scale)  # build once
+        t0 = time.perf_counter()
+        grid = [idx.nearest_k(q, 0, 2, scale=space.scale)
+                for q in queries]
+        t_grid = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        lin = [linear_nearest_k(q, cells, seqs, 2, space.scale)
+               for q in queries]
+        t_lin = time.perf_counter() - t0
+        # keys were inserted as row indices in insertion order, so the
+        # grid answer must equal the scan's bitwise — keys, distances
+        # and tie order included (round-trip sanity: the normalization
+        # the grid bucketed by is the CellSpace's own)
+        assert space.normalize(queries[0]) == tuple(queries[0] / scale)
+        out[f"index_speedup_{tag}"] = round(t_lin / max(t_grid, 1e-12),
+                                            2)
+        out[f"index_grid_ms_{tag}"] = round(t_grid / len(queries) * 1e3,
+                                            4)
+        out[f"index_linear_ms_{tag}"] = round(
+            t_lin / len(queries) * 1e3, 4)
+        out[f"index_bitwise_ok_{tag}"] = bool(grid == lin)
+        out["index_entries"] = n
+        out["index_rebuilds"] = idx.rebuilds
+        print(f"[bench] cell index @ {n}: grid "
+              f"{out[f'index_grid_ms_{tag}']}ms vs linear "
+              f"{out[f'index_linear_ms_{tag}']}ms per query -> "
+              f"{out[f'index_speedup_{tag}']}x, bitwise="
+              f"{'OK' if grid == lin else 'MISMATCH'}", file=sys.stderr)
+    return out
+
+
+def _surrogate_smoke() -> dict:
+    """The ``--surrogate-smoke`` acceptance run (ISSUE 17, DESIGN §15):
+    the 12-cell golden lattice is solved and CERTIFIED into the store
+    (``surrogate_ok=False`` forces the real solves that become donors),
+    then a seeded off-lattice query wave hits the surrogate tier —
+    sub-millisecond local-linear answers tagged ``quality="surrogate"``
+    with their model-implied error bound, NEVER cached; far/audited
+    queries escalate to real solves that publish as LATTICE_REFINED
+    refinement points, and every seeded audit's real r* must land
+    inside the surrogate's own reported bound.  The ``CellIndex``
+    microbench rides along (>= 10x vs the linear scan at 10^4+
+    entries, bitwise identical).  Emits the sentinel-graded
+    ``surrogate_*``/``index_*`` record."""
+    import tempfile
+
+    import numpy as np
+
+    from aiyagari_hark_tpu.obs import ObsConfig, read_journal
+    from aiyagari_hark_tpu.obs.regress import (
+        SEVERITY_NAMES,
+        evaluate_history,
+        load_bench_history,
+    )
+    from aiyagari_hark_tpu.scenarios import get_scenario
+    from aiyagari_hark_tpu.serve import (
+        EquilibriumService,
+        SurrogatePolicy,
+        make_query,
+    )
+
+    import jax
+
+    backend = jax.default_backend()
+    record = {"metric": "surrogate_smoke", "backend": backend}
+    record.update(_index_bench(get_scenario("aiyagari").cells))
+
+    kw = dict(SERVE_SMOKE_KWARGS)
+    cells = [(s, r) for s in (1.0, 3.0, 5.0) for r in (0.0, 0.3, 0.6, 0.9)]
+    pol = SurrogatePolicy(k=6, max_error_bound=0.1, max_distance=0.6,
+                          min_donors=4, audit_fraction=0.25,
+                          audit_seed=20260806)
+    with tempfile.TemporaryDirectory() as td:
+        journal = os.path.join(td, "events.jsonl")
+        svc = EquilibriumService(start_worker=False, max_batch=4,
+                                 ladder=(1, 2, 4),
+                                 certify_before_cache=True,
+                                 surrogate=pol,
+                                 obs=ObsConfig(enabled=True,
+                                               journal_path=journal))
+        t0 = time.perf_counter()
+        futs = [svc.submit(make_query(s, r, surrogate_ok=False, **kw))
+                for s, r in cells]
+        svc.flush()
+        for f in futs:
+            f.result(0)
+        warm_wall = time.perf_counter() - t0
+        print(f"[bench] surrogate smoke: lattice warmed+certified "
+              f"({len(cells)} cells) in {warm_wall:.1f}s",
+              file=sys.stderr)
+
+        # seeded off-lattice wave; the far probe guarantees one
+        # donor_too_far escalation, the seeded audit draw the rest
+        rng = np.random.default_rng(20260806)
+        wave = [(float(rng.uniform(1.2, 4.8)),
+                 float(rng.uniform(0.05, 0.85))) for _ in range(20)]
+        wave.append((8.0, 0.5))
+        lat, bounds = [], []
+        served = escalated = 0
+        tagged = never_cached = escalated_certified = True
+        for s, r in wave:
+            q = make_query(s, r, **kw)
+            t1 = time.perf_counter()
+            fut = svc.submit(q)
+            if fut.done():
+                res = fut.result(0)
+                lat.append(time.perf_counter() - t1)
+                served += 1
+                tagged &= (res.quality == "surrogate"
+                           and res.surrogate_error_bound is not None
+                           and bool(res.donor_keys))
+                never_cached &= not svc.store.contains(q.key())
+                bounds.append(float(res.surrogate_error_bound or 0.0))
+            else:
+                svc.flush()
+                res = fut.result(0)
+                escalated += 1
+                # an escalated solve is a REAL solve: certified and
+                # published as a lattice refinement point
+                escalated_certified &= (res.quality == "exact"
+                                        and res.cert_level in (0, 1)
+                                        and svc.store.contains(q.key()))
+        p50_ms = (float(np.median(lat)) * 1e3 if lat else None)
+        snap = svc.metrics.snapshot()
+        store_stats = svc.store.index_stats()
+        svc.close()
+        events = read_journal(journal)
+    n_ev = {t: sum(1 for e in events if e["event"] == t)
+            for t in ("SURROGATE_SERVED", "SURROGATE_ESCALATED",
+                      "LATTICE_REFINED", "INDEX_REBUILD")}
+    audits = [e for e in events
+              if e["event"] == "LATTICE_REFINED" and "audit_ok" in e]
+    audits_within = all(e["audit_ok"] for e in audits)
+
+    record.update({k: v for k, v in snap.items()
+                   if k.startswith("surrogate_")})
+    record.update({
+        "surrogate_queries": len(wave),
+        "surrogate_served": served,
+        "surrogate_p50_ms": (None if p50_ms is None
+                             else round(p50_ms, 4)),
+        "surrogate_sub_ms": bool(p50_ms is not None and p50_ms < 1.0),
+        "surrogate_bound_max": (round(max(bounds), 6) if bounds
+                                else None),
+        "surrogate_tagged": bool(tagged),
+        "surrogate_never_cached": bool(never_cached),
+        "surrogate_escalated_certified": bool(escalated_certified),
+        "surrogate_audits_within_bound": bool(audits_within),
+        "surrogate_refined_published": n_ev["LATTICE_REFINED"],
+        "surrogate_events_served": n_ev["SURROGATE_SERVED"],
+        "surrogate_events_escalated": n_ev["SURROGATE_ESCALATED"],
+        "surrogate_index_kind": store_stats["index_kind"],
+        "surrogate_warm_wall_s": round(warm_wall, 3),
+    })
+    history = load_bench_history(_repo_dir()) + [("surrogate_smoke",
+                                                  record)]
+    report = evaluate_history(history)
+    regressed = [f.metric for f in report.regressed()
+                 if f.metric.startswith(("surrogate_", "index_"))]
+    record["surrogate_sentinel_clean"] = not regressed
+    record["surrogate_sentinel_worst"] = SEVERITY_NAMES[report.worst]
+
+    print(f"[bench] surrogate smoke: {served}/{len(wave)} served "
+          f"(p50 {record['surrogate_p50_ms']}ms, hit rate "
+          f"{snap['surrogate_hit_rate']}), {escalated} escalated "
+          f"(rate {snap['surrogate_escalation_rate']}), "
+          f"{len(audits)} audits "
+          f"{'within' if audits_within else 'OUTSIDE'} bound, "
+          f"{n_ev['LATTICE_REFINED']} refinement points, index "
+          f"{record['index_speedup_5e4']}x @ 5e4", file=sys.stderr)
+    ok = (served >= 1 and escalated >= 1
+          and record["surrogate_sub_ms"] and tagged and never_cached
+          and escalated_certified and audits_within and len(audits) >= 1
+          and record["index_bitwise_ok_1e4"]
+          and record["index_bitwise_ok_5e4"]
+          and record["index_speedup_5e4"] >= 10.0
+          and n_ev["LATTICE_REFINED"] == escalated)
+    if not ok:
+        print("[bench] surrogate smoke: ACCEPTANCE FAILED — see the "
+              "surrogate_*/index_* fields above", file=sys.stderr)
+    return record
+
+
 def main(argv=None):
     """CLI wrapper: the preemption-tolerant run layer (ISSUE 3) around the
     measurement body.  ``--resume PATH`` gives the headline sweep a
@@ -3019,7 +3226,13 @@ def main(argv=None):
     mid-solve, heartbeat stall, torn publish, store partition, skewed
     double election — asserting detected == injected, dedup back to
     1.0, zero leaked leases, bit-identical served values) and emits
-    the ``chaos_*`` record."""
+    the ``chaos_*`` record; ``--surrogate-smoke`` runs the surrogate
+    serving-tier acceptance (ISSUE 17: the certified 12-cell lattice
+    warmed, then a seeded off-lattice query wave answered sub-ms by the
+    local-linear surrogate with its model-implied bound, audited
+    escalations publishing LATTICE_REFINED refinement points, and the
+    CellIndex >= 10x-vs-linear-scan microbench) and emits the
+    ``surrogate_*``/``index_*`` record."""
     import argparse
 
     from aiyagari_hark_tpu.utils.resilience import (
@@ -3088,6 +3301,18 @@ def main(argv=None):
                          "dedup ratio back to 1.0, zero leaked leases, "
                          "bit-identical served values) and emit the "
                          "chaos_* record instead of the full bench")
+    ap.add_argument("--surrogate-smoke", action="store_true",
+                    help="run the surrogate serving-tier smoke (ISSUE "
+                         "17: certified 12-cell lattice warmed, seeded "
+                         "off-lattice query wave answered "
+                         "sub-millisecond by the local-linear surrogate "
+                         "with model-implied error bounds — never "
+                         "cached, never untagged — audited escalations "
+                         "published as LATTICE_REFINED refinement "
+                         "points, CellIndex bitwise==linear-scan with "
+                         ">=10x measured speedup at 10^4+ entries) and "
+                         "emit the surrogate_*/index_* record instead "
+                         "of the full bench")
     ap.add_argument("--chips-scaling", action="store_true",
                     help="run the multi-chip scaling smoke (ISSUE 11: "
                          "the balanced 24-cell sweep dispatched through "
@@ -3127,13 +3352,15 @@ def main(argv=None):
             or args.load_smoke or args.scenario_smoke
             or args.profile_smoke or args.chips_scaling
             or args.compaction_smoke or args.kernel_smoke
-            or args.fleet_smoke or args.chaos_smoke):
+            or args.fleet_smoke or args.chaos_smoke
+            or args.surrogate_smoke):
         from aiyagari_hark_tpu.utils.backend import (
             enable_compilation_cache,
         )
 
         enable_compilation_cache()
-        smoke = (_chaos_smoke if args.chaos_smoke
+        smoke = (_surrogate_smoke if args.surrogate_smoke
+                 else _chaos_smoke if args.chaos_smoke
                  else _fleet_smoke if args.fleet_smoke
                  else _kernel_smoke if args.kernel_smoke
                  else _compaction_smoke if args.compaction_smoke

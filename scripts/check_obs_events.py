@@ -78,9 +78,16 @@ EMIT_NAMES = {"emit", "emit_event", "event", "_record_eviction"}
 # fault-firing site (``fire`` must journal FLEET_CHAOS_INJECT — the
 # detection ledger's injected side is only falsifiable if every actual
 # firing leaves a typed trail).
+# ISSUE 17 additions: the store's cell-index rebuild site
+# (``_index_rebuilt`` must journal INDEX_REBUILD — a rebuild is a
+# recovery/maintenance action, nothing raises) and the service's
+# surrogate-escalation seam (``_surrogate_escalate`` must journal
+# SURROGATE_ESCALATED — the query recovers by falling through to a real
+# solve, so the construction rule cannot see it).
 SEAM_DEFS = {"_evict_corrupt", "_record_eviction", "retry_transient",
              "_run_sweep_impl", "dump_flight", "evaluate_history",
-             "_backend_fault", "fire"}
+             "_backend_fault", "fire",
+             "_index_rebuilt", "_surrogate_escalate"}
 
 
 def _call_name(node: ast.Call):

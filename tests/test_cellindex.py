@@ -1,0 +1,264 @@
+"""CellIndex bitwise contract + the store's neighbor seam (ISSUE 17).
+
+The grid-bucket index is an OPTIMIZATION, never a semantics change: it
+must return EXACTLY what the linear scan returns — same keys, same
+float64 distances, same tie order (metadata-dict insertion order) —
+across puts, value refreshes, removals, evictions, restart rebuilds,
+and every registered scenario's CellSpace normalization.  The reference
+model here is deliberately dumb: a plain insertion-ordered list ranked
+by ``linear_nearest_k``, the same comparator ``bench.py`` speed-grades
+the index against.
+"""
+
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.obs import ObsConfig, build_obs, read_journal
+from aiyagari_hark_tpu.scenarios import get_scenario, scenario_names
+from aiyagari_hark_tpu.serve import (
+    CellIndex,
+    SolutionStore,
+    linear_nearest_k,
+    make_solution,
+)
+from aiyagari_hark_tpu.solver_health import CONVERGED
+
+GROUP = 7
+
+
+def entry(key, cell=(3.0, 0.6, 0.2), r_star=0.035, group=GROUP,
+          cert_level=-1):
+    packed = np.asarray([r_star, 5.0, 0.9, 11.0, 500.0, 4000.0,
+                         float(CONVERGED), 0.0, 4500.0, 0.0])
+    return make_solution(cell, packed, group, key, cert_level=cert_level)
+
+
+# ---------------------------------------------------------------------------
+# Reference model: an insertion-ordered item list + the linear comparator.
+# ---------------------------------------------------------------------------
+
+class _Model:
+    """Mirror of the metadata-dict insertion-order semantics CellIndex
+    pins: a value refresh of a live key at the SAME cell keeps its
+    position (dict update); a changed cell or a remove + re-add moves
+    the key to the tail (re-insertion)."""
+
+    def __init__(self):
+        self.items = []          # [key, cell, r_star, cert]
+
+    def add(self, key, cell, r_star, cert):
+        for it in self.items:
+            if it[0] == key:
+                if it[1] == cell:
+                    it[2], it[3] = r_star, cert
+                    return
+                self.items.remove(it)
+                break
+        self.items.append([key, cell, r_star, cert])
+
+    def remove(self, key):
+        self.items = [it for it in self.items if it[0] != key]
+
+    def nearest_k(self, cell, k, scale, require_certified=False):
+        rows = [(key, c) for key, c, r, cert in self.items
+                if np.isfinite(r) and (not require_certified
+                                       or cert >= 0)]
+        if not rows:
+            return []
+        mat = np.asarray([c for _, c in rows], dtype=np.float64)
+        hits = linear_nearest_k(cell, mat, np.arange(len(rows)), k, scale)
+        return [(rows[i][0], d) for i, d in hits]
+
+
+def _lattice_cell(rng, scale, n_ticks=5, tick=0.5):
+    """Cells snapped to a coarse lattice IN NORMALIZED UNITS so exact
+    L1-distance ties are common — the tie-order contract must actually
+    be exercised, not dodged by generic floats."""
+    return tuple(float(rng.integers(0, n_ticks)) * tick * s
+                 for s in scale)
+
+
+@pytest.mark.parametrize("scenario", sorted(scenario_names()))
+def test_index_bitwise_matches_linear_scan(scenario):
+    space = get_scenario(scenario).cells
+    scale = space.scale
+    rng = np.random.default_rng(sum(map(ord, scenario)))
+    idx = CellIndex()
+    model = _Model()
+    keypool = list(range(40))
+    for step in range(400):
+        if rng.random() < 0.75 or not model.items:
+            key = int(rng.choice(keypool))
+            cell = _lattice_cell(rng, scale)
+            r = [0.03, 0.041, float("nan")][int(rng.integers(0, 3))
+                                            if rng.random() < 0.15 else
+                                            int(rng.integers(0, 2))]
+            cert = int(rng.integers(-1, 2))
+            idx.add(key, cell, GROUP, r, cert)
+            model.add(key, cell, r, cert)
+        else:
+            key = model.items[int(rng.integers(0, len(model.items)))][0]
+            idx.remove(key, GROUP)
+            model.remove(key)
+        if step % 5 == 0:
+            q = (_lattice_cell(rng, scale) if rng.random() < 0.5
+                 else tuple(float(rng.uniform(0.0, 2.5)) * s
+                            for s in scale))
+            for k in (1, 2, 6, len(model.items) + 3, None):
+                for rc in (False, True):
+                    got = idx.nearest_k(q, GROUP, k, scale=scale,
+                                        require_certified=rc)
+                    want = model.nearest_k(q, k, scale, rc)
+                    assert got == want, (scenario, step, k, rc)
+    assert len(idx) == len(model.items)
+    assert idx.group_size(GROUP) == len(model.items)
+
+
+def test_index_empty_and_unknown_group():
+    idx = CellIndex()
+    scale = (1.0, 1.0, 1.0)
+    assert idx.nearest_k((0.0, 0.0, 0.0), 3, 1, scale=scale) == []
+    idx.add(1, (0.5, 0.5, 0.5), 3, 0.03, 0)
+    idx.remove(1, 3)
+    assert idx.nearest_k((0.0, 0.0, 0.0), 3, 1, scale=scale) == []
+    assert len(idx) == 0
+
+
+def test_index_rebuild_reasons_and_counter():
+    """first_query on the lazy build; rewidth after 4x growth;
+    scale_change when a different normalization arrives — each invokes
+    on_rebuild so the store can journal INDEX_REBUILD."""
+    seen = []
+    idx = CellIndex(on_rebuild=lambda g, n, reason: seen.append(reason))
+    rng = np.random.default_rng(11)
+    scale = (1.0, 1.0, 1.0)
+    for i in range(70):
+        idx.add(i, tuple(rng.uniform(0.0, 4.0, 3)), 0, 0.03, 0)
+    idx.nearest_k((1.0, 1.0, 1.0), 0, 2, scale=scale)
+    assert seen == ["first_query"]
+    for i in range(70, 70 + 70 * 4 + 8):
+        idx.add(i, tuple(rng.uniform(0.0, 4.0, 3)), 0, 0.03, 0)
+    idx.nearest_k((1.0, 1.0, 1.0), 0, 2, scale=scale)
+    assert seen == ["first_query", "rewidth"]
+    idx.nearest_k((1.0, 1.0, 1.0), 0, 2, scale=(2.0, 1.0, 1.0))
+    assert seen == ["first_query", "rewidth", "scale_change"]
+    assert idx.rebuilds == 3
+
+
+# ---------------------------------------------------------------------------
+# The store seam: grid-indexed and linear stores answer identically.
+# ---------------------------------------------------------------------------
+
+def _tie_cells():
+    """A donor set with exact normalized-L1 ties around (3.0, 0.6, 0.2)
+    under the default Aiyagari scale — plus far and off-axis points."""
+    return [
+        (3.0, 0.6, 0.2),
+        (3.5, 0.6, 0.2), (2.5, 0.6, 0.2),       # tie pair (d = 0.1)
+        (3.0, 0.65, 0.2), (3.0, 0.55, 0.2),     # tie pair (d = 0.1)
+        (4.0, 0.9, 0.2), (1.5, 0.0, 0.2),
+        (3.5, 0.65, 0.2),
+    ]
+
+
+def _pair_stores(**kw):
+    return (SolutionStore(index="grid", **kw),
+            SolutionStore(index="linear", **kw))
+
+
+def _strip(hits):
+    return [(k, d) for k, _, d in hits]
+
+
+def test_store_neighbors_grid_equals_linear():
+    g, lin = _pair_stores(capacity=32)
+    for i, c in enumerate(_tie_cells()):
+        cert = 0 if i % 2 == 0 else -1
+        for s in (g, lin):
+            s.put(entry(100 + i, cell=c, cert_level=cert))
+    queries = [(3.0, 0.6, 0.2), (3.1, 0.62, 0.2), (0.0, 0.0, 0.0),
+               (3.25, 0.6, 0.2)]
+    for q in queries:
+        for k in (1, 2, 5, None):
+            for rc in (False, True):
+                assert (_strip(g.neighbors(q, GROUP, k,
+                                           require_certified=rc))
+                        == _strip(lin.neighbors(q, GROUP, k,
+                                                require_certified=rc)))
+        assert g.nominate(q, GROUP, 0.14, 1e-5) \
+            == lin.nominate(q, GROUP, 0.14, 1e-5)
+        assert g.nearest(q, GROUP) == lin.nearest(q, GROUP)
+        assert g.nearest(q, GROUP, require_certified=True) \
+            == lin.nearest(q, GROUP, require_certified=True)
+
+
+def test_store_neighbors_agree_through_eviction():
+    """Memory-only eviction forgets entries; the index must track the
+    deletions and keep answering exactly like the linear fallback."""
+    g, lin = _pair_stores(capacity=3)
+    for i, c in enumerate(_tie_cells()):             # 8 puts, 3 survive
+        for s in (g, lin):
+            s.put(entry(200 + i, cell=c))
+    q = (3.0, 0.6, 0.2)
+    got = _strip(g.neighbors(q, GROUP, None))
+    assert got == _strip(lin.neighbors(q, GROUP, None))
+    assert len(got) == 3
+    assert g.index_stats()["index_entries"] == 3
+    assert lin.index_stats()["index_kind"] == "linear"
+
+
+def test_store_index_kind_validated():
+    with pytest.raises(ValueError):
+        SolutionStore(capacity=4, index="btree")
+
+
+def test_group_matrix_cache_is_behavior_identical():
+    """ISSUE 17 satellite: the linear path's cached per-group cell
+    matrix must never change an answer — a long-lived store (cache warm
+    across puts/evictions/refreshes) answers exactly like a fresh store
+    replaying the same mutation sequence cold."""
+    live = SolutionStore(capacity=3, index="linear")
+    history = []
+    q = (3.0, 0.6, 0.2)
+    for i, c in enumerate(_tie_cells()):
+        live.put(entry(300 + i, cell=c))
+        history.append((300 + i, c, 0.035))
+        if i == 4:                                   # refresh key 302
+            live.put(entry(302, cell=_tie_cells()[2], r_star=0.05))
+            history.append((302, _tie_cells()[2], 0.05))
+        # query NOW so the cache is built, then mutated, repeatedly
+        fresh = SolutionStore(capacity=3, index="linear")
+        for kk, cc, rr in history:
+            fresh.put(entry(kk, cell=cc, r_star=rr))
+        for k in (1, 2, None):
+            assert _strip(live.neighbors(q, GROUP, k)) \
+                == _strip(fresh.neighbors(q, GROUP, k))
+        assert live.nominate(q, GROUP, 0.14, 1e-5) \
+            == fresh.nominate(q, GROUP, 0.14, 1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Restart: the reborn store's index rebuild is journaled and bitwise.
+# ---------------------------------------------------------------------------
+
+def test_restart_rebuild_bitwise_and_journaled(tmp_path):
+    d = str(tmp_path / "tier")
+    jp = str(tmp_path / "events.jsonl")
+    first = SolutionStore(capacity=8, disk_path=d)
+    for i, c in enumerate(_tie_cells()):
+        first.put(entry(400 + i, cell=c, cert_level=0 if i < 4 else -1))
+    obs = build_obs(ObsConfig(enabled=True, journal_path=jp))
+    reborn_g = SolutionStore(capacity=8, disk_path=d, obs=obs)
+    reborn_l = SolutionStore(capacity=8, disk_path=d, index="linear")
+    for q in [(3.0, 0.6, 0.2), (3.1, 0.62, 0.2), (2.75, 0.6, 0.2)]:
+        for k in (1, 3, None):
+            for rc in (False, True):
+                assert (_strip(reborn_g.neighbors(
+                            q, GROUP, k, require_certified=rc))
+                        == _strip(reborn_l.neighbors(
+                            q, GROUP, k, require_certified=rc)))
+    assert reborn_g.index_stats()["index_entries"] == len(_tie_cells())
+    obs.close()
+    ev = read_journal(jp, event="INDEX_REBUILD")
+    assert ev and ev[0]["reason"] == "restart"
+    assert ev[0]["entries"] == len(_tie_cells())

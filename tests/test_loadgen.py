@@ -125,3 +125,32 @@ def test_load_hit_path_stays_fast_under_saturation():
     assert rep.hit_wall_ms, "spec must produce exact hits"
     p50 = float(np.median(rep.hit_wall_ms))
     assert p50 < 50.0                        # µs-class op, ms-class bound
+
+
+# ---------------------------------------------------------------------------
+# Off-lattice arrivals (ISSUE 17): continuous-parameter traffic.
+# ---------------------------------------------------------------------------
+
+def test_offlattice_frac_zero_is_bit_identical():
+    """The default spec and an explicit frac=0.0 draw the SAME trace as
+    the pre-surrogate generator: extra RNG draws happen only when the
+    mix is positive, so every committed digest stays valid."""
+    assert generate_arrivals(SPEC) \
+        == generate_arrivals(SPEC._replace(offlattice_frac=0.0))
+
+
+def test_offlattice_mix_samples_inside_hull():
+    spec = SPEC._replace(offlattice_frac=0.5, n_queries=200)
+    a1 = generate_arrivals(spec)
+    assert a1 == generate_arrivals(spec)     # still seeded-reproducible
+    lattice = set(CELLS)
+    off = [a.cell for a in a1 if a.cell not in lattice]
+    on = [a.cell for a in a1 if a.cell in lattice]
+    assert off and on                        # genuinely a mix
+    lo = np.min(np.asarray(CELLS), axis=0)
+    hi = np.max(np.asarray(CELLS), axis=0)
+    for cell in off:
+        assert all(float(l) <= c <= float(h)
+                   for c, l, h in zip(cell, lo, hi))
+    # a different frac is a different trace (the digest covers it)
+    assert a1 != generate_arrivals(spec._replace(offlattice_frac=0.9))

@@ -124,3 +124,24 @@ def test_lint_fires_on_silent_seam_function():
         "def retry_transient(fn, policy):\n"
         "    emit_event('RETRY_TRANSIENT', label='x')\n"
         "    return fn()\n", "fake.py") == []
+
+
+def test_lint_requires_emit_in_index_and_surrogate_seams():
+    """The ISSUE 17 seams — index rebuilds and surrogate escalations —
+    are journal-bearing: stripping their event emit must be a lint
+    failure, structurally."""
+    mod, _ = _load_lint()
+    assert "_index_rebuilt" in mod.SEAM_DEFS
+    assert "_surrogate_escalate" in mod.SEAM_DEFS
+    findings = mod.scan_source(
+        "def _index_rebuilt(self, group, entries, reason):\n"
+        "    self.rebuilds += 1\n", "fixture.py")
+    assert len(findings) == 1 and "seam function" in findings[0][2]
+    findings = mod.scan_source(
+        "def _surrogate_escalate(self, q, reason):\n"
+        "    return reason\n", "fixture.py")
+    assert len(findings) == 1 and "seam function" in findings[0][2]
+    assert mod.scan_source(
+        "def _surrogate_escalate(self, q, reason):\n"
+        "    self._obs.event('SURROGATE_ESCALATED', reason=reason)\n"
+        "    return reason\n", "fixture.py") == []

@@ -329,3 +329,57 @@ def test_flatten_record_skips_non_scalars():
     flat = flatten_record({"a": 1, "b": True, "c": "x", "d": [1, 2],
                            "e": {"f": 2.5}, "g": None})
     assert flat == {"a": 1.0, "e.f": 2.5}
+
+
+def test_direction_covers_surrogate_smoke_record():
+    """The ``--surrogate-smoke`` leg's scalar fields (ISSUE 17) resolve
+    strictly — the sentinel grades the surrogate/index record from its
+    FIRST committed round — and a synthetic history grades clean, with
+    a hit-rate drop / index slowdown flagging in the declared (UP)
+    directions."""
+    surrogate_record = {
+        "metric": "surrogate_smoke", "backend": "cpu",
+        "index_speedup_1e4": 4.3, "index_grid_ms_1e4": 0.22,
+        "index_linear_ms_1e4": 0.93, "index_bitwise_ok_1e4": True,
+        "index_speedup_5e4": 14.0, "index_grid_ms_5e4": 0.39,
+        "index_linear_ms_5e4": 5.52, "index_bitwise_ok_5e4": True,
+        "index_entries": 50_000, "index_rebuilds": 2,
+        "surrogate_hit_rate": 0.5152, "surrogate_escalation_rate": 0.15,
+        "surrogate_escalations": 3, "surrogate_audits": 2,
+        "surrogate_audit_failures": 0, "surrogate_refinements": 3,
+        "surrogate_bound_p50": 0.004, "surrogate_bound_p95": 0.02,
+        "surrogate_p50_ms": 0.4, "surrogate_p95_ms": 0.9,
+        "surrogate_queries": 21, "surrogate_served": 17,
+        "surrogate_sub_ms": True, "surrogate_bound_max": 0.05,
+        "surrogate_tagged": True, "surrogate_never_cached": True,
+        "surrogate_escalated_certified": True,
+        "surrogate_audits_within_bound": True,
+        "surrogate_refined_published": 3,
+        "surrogate_events_served": 17, "surrogate_events_escalated": 3,
+        "surrogate_index_kind": "grid",
+        "surrogate_warm_wall_s": 60.0,
+        "surrogate_sentinel_clean": True,
+        "surrogate_sentinel_worst": "OK",
+    }
+    for field in flatten_record(surrogate_record):
+        direction = direction_of_goodness(field, strict=True)
+        assert direction in (UP, DOWN, NEUTRAL), field
+    assert direction_of_goodness("surrogate_hit_rate") == UP
+    assert direction_of_goodness("surrogate_escalation_rate") == DOWN
+    assert direction_of_goodness("surrogate_audit_failures") == DOWN
+    assert direction_of_goodness("surrogate_bound_p95") == DOWN
+    assert direction_of_goodness("index_speedup_5e4") == UP
+    assert direction_of_goodness("index_grid_ms_5e4") == DOWN
+    assert direction_of_goodness("index_linear_ms_5e4") == NEUTRAL
+    # a stable synthetic history grades clean; a hit-rate collapse and
+    # an index slowdown both flag REGRESSED in the declared directions
+    hist = [(f"r{i:02d}", dict(surrogate_record)) for i in range(4)]
+    assert evaluate_history(hist).worst == OK
+    worse = dict(surrogate_record)
+    worse["surrogate_hit_rate"] = 0.1
+    worse["index_speedup_5e4"] = 1.2
+    flagged = [f.metric
+               for f in evaluate_history(hist[:-1]
+                                         + [("r99", worse)]).regressed()]
+    assert "surrogate_hit_rate" in flagged
+    assert "index_speedup_5e4" in flagged

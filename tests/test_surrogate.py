@@ -1,0 +1,293 @@
+"""Surrogate serving tier contract (ISSUE 17, DESIGN §15).
+
+The invariants the tier must never break, with donors INJECTED into the
+store (``make_solution(cert_level=0)``) so the interpolation path is
+exercised without any real solve:
+
+* a surrogate answer is ALWAYS tagged ``quality="surrogate"`` with its
+  model-implied error bound and donor fingerprints, and is NEVER cached
+  — the store holds only genuinely solved rows;
+* too few / too distant donors, a bound over budget, and the seeded
+  audit draw all ESCALATE (journaled ``SURROGATE_ESCALATED`` with the
+  reason) to a genuine solve; an empty donor group is a plain cold
+  miss, not an escalation;
+* an audited escalation resolves through the real solve: the audit
+  verdict (was the prediction inside its own bound?) and the
+  ``LATTICE_REFINED`` refinement point are journaled;
+* ``surrogate=None`` — and ``surrogate_ok=False`` per query — are
+  bit-identical to the pre-surrogate engine.
+"""
+
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.obs import ObsConfig, read_journal
+from aiyagari_hark_tpu.scenarios import get_scenario
+from aiyagari_hark_tpu.serve import (
+    EquilibriumService,
+    SurrogatePolicy,
+    fit_surrogate,
+    make_query,
+    make_solution,
+)
+from aiyagari_hark_tpu.solver_health import CONVERGED
+
+KW = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-4,
+          max_bisect=16)
+QUERY_CELL = (3.05, 0.55, 0.2)
+
+# an exactly-linear r* surface over (σ, ρ): the local fit must recover
+# it exactly, so the bound collapses to the solver-tolerance floor
+def _plane(cell):
+    return 0.02 + 0.004 * cell[0] + 0.01 * cell[1]
+
+
+DONOR_CELLS = [(s, r, 0.2)
+               for s in (2.8, 3.0, 3.2, 3.4)
+               for r in (0.45, 0.65)]
+
+POL = SurrogatePolicy(k=6, max_error_bound=0.02, max_distance=1.0,
+                      min_donors=4)
+
+
+def seed_donors(svc, group, cells=DONOR_CELLS, r_fn=_plane,
+                cert_level=0, base_key=10_000):
+    for i, c in enumerate(cells):
+        packed = np.asarray([r_fn(c), 5.0, 0.9, 11.0, 500.0, 4000.0,
+                             float(CONVERGED), 0.0, 4500.0, 0.0])
+        svc.store.put(make_solution(c, packed, group, base_key + i,
+                                    cert_level=cert_level))
+
+
+def _svc(tmp_path=None, pol=POL):
+    obs = None
+    if tmp_path is not None:
+        obs = ObsConfig(enabled=True,
+                        journal_path=str(tmp_path / "events.jsonl"))
+    return EquilibriumService(start_worker=False, max_batch=4,
+                              ladder=(1, 2, 4), surrogate=pol, obs=obs)
+
+
+# ---------------------------------------------------------------------------
+# fit_surrogate unit properties.
+# ---------------------------------------------------------------------------
+
+def _fit(cells, r_fn, query=QUERY_CELL, floor=0.0, scale=None):
+    scale = scale or get_scenario("aiyagari").cells.scale
+    z = np.abs(np.asarray(cells) / np.asarray(scale)
+               - np.asarray(query) / np.asarray(scale))
+    return fit_surrogate(query, cells, [r_fn(c) for c in cells],
+                         z.sum(axis=1), scale, floor=floor)
+
+
+def test_fit_recovers_exact_plane_to_the_floor():
+    fit = _fit(DONOR_CELLS, _plane, floor=1e-5)
+    assert fit.linear
+    assert fit.r_star == pytest.approx(_plane(QUERY_CELL), abs=1e-9)
+    assert fit.bound == pytest.approx(1e-5)       # resid ~ulp < floor
+    assert fit.kernel.sum() == pytest.approx(1.0)
+
+
+def test_fit_drops_unspanned_columns():
+    # DONOR_CELLS hold sd fixed: the sd offset column has zero ptp and
+    # must be dropped, not degrade the whole fit to the weighted mean
+    fit = _fit(DONOR_CELLS, _plane)
+    assert fit.linear
+
+
+def test_fit_curvature_inflates_bound():
+    fit = _fit(DONOR_CELLS, lambda c: _plane(c) + 0.5 * (c[0] - 3.0) ** 2)
+    assert fit.bound >= 2.0 * fit.resid > 0.0
+
+
+def test_fit_mean_fallback_bills_spread():
+    # 3 donors < dim_eff + 2: weighted-mean fallback, spread-based bound
+    fit = _fit(DONOR_CELLS[:3], _plane)
+    assert not fit.linear
+    assert fit.kernel.sum() == pytest.approx(1.0)
+    assert fit.bound > 0.0
+    assert fit.spread == pytest.approx(
+        max(_plane(c) for c in DONOR_CELLS[:3])
+        - min(_plane(c) for c in DONOR_CELLS[:3]))
+
+
+def test_fit_empty_donor_set_is_none():
+    scale = get_scenario("aiyagari").cells.scale
+    assert fit_surrogate(QUERY_CELL, [], [], [], scale) is None
+
+
+# ---------------------------------------------------------------------------
+# Serving: tagged, bounded, never cached.
+# ---------------------------------------------------------------------------
+
+def test_surrogate_served_tagged_and_never_cached(tmp_path):
+    svc = _svc(tmp_path)
+    q = make_query(*QUERY_CELL[:2], labor_sd=QUERY_CELL[2], **KW)
+    seed_donors(svc, q.group())
+    fut = svc.submit(q)
+    assert fut.done()                     # answered at submit, no solve
+    res = fut.result(0)
+    assert res.quality == "surrogate"
+    assert res.path == "surrogate"
+    assert res.surrogate_error_bound is not None
+    assert res.surrogate_error_bound <= POL.max_error_bound
+    assert res.donor_keys and set(res.donor_keys) <= set(
+        range(10_000, 10_000 + len(DONOR_CELLS)))
+    # the donor surface is an exact plane: the fit serves it exactly
+    assert res.r_star == pytest.approx(_plane(QUERY_CELL), abs=1e-9)
+    # solver-effort counters are fiction and must read zero
+    assert res.value("egm_iters") == 0.0
+    # NEVER cached: the store still only holds the donors, and a
+    # resubmit is served by the surrogate again — never as a cache hit
+    assert svc.store.get(q.key()) is None
+    assert svc.store.known() == len(DONOR_CELLS)
+    res2 = svc.submit(q).result(0)
+    assert res2.quality == "surrogate"
+    snap = svc.metrics.snapshot()
+    assert svc.metrics.served["surrogate"] == 2
+    assert snap["surrogate_hit_rate"] == 1.0
+    assert snap["surrogate_bound_p95"] <= POL.max_error_bound
+    svc.close()
+    ev = read_journal(str(tmp_path / "events.jsonl"),
+                      event="SURROGATE_SERVED")
+    assert len(ev) == 2 and ev[0]["donors"] == POL.k
+
+
+def test_uncertified_donors_are_invisible_by_default():
+    """require_certified=True (the default): a store full of
+    UNCERTIFIED entries serves nothing — plain cold miss, no event —
+    while require_certified=False accepts the same donors."""
+    svc = _svc()
+    q = make_query(*QUERY_CELL[:2], labor_sd=QUERY_CELL[2], **KW)
+    seed_donors(svc, q.group(), cert_level=-1)
+    fut = svc.submit(q)
+    assert not fut.done()
+    snap = svc.metrics.snapshot()
+    assert snap["surrogate_escalations"] == 0
+    svc.close(drain=False)
+
+    svc2 = _svc(pol=POL.replace(require_certified=False))
+    seed_donors(svc2, q.group(), cert_level=-1)
+    assert svc2.submit(q).result(0).quality == "surrogate"
+    svc2.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# Escalations: table-driven, journaled with the reason.
+# ---------------------------------------------------------------------------
+
+def _far_donors(svc, group):
+    seed_donors(svc, group,
+                cells=[(s, r, 0.2) for s in (7.0, 7.5)
+                       for r in (0.1, 0.3, 0.5)])
+
+
+def _bad_donors(svc, group):
+    # one wildly-off donor row: huge residual -> bound over budget
+    seed_donors(svc, group)
+    packed = np.asarray([0.5, 5.0, 0.9, 11.0, 500.0, 4000.0,
+                         float(CONVERGED), 0.0, 4500.0, 0.0])
+    svc.store.put(make_solution((3.1, 0.5, 0.2), packed, group, 10_099,
+                                cert_level=0))
+
+
+@pytest.mark.parametrize("pol,seeder,reason", [
+    (POL.replace(min_donors=10), seed_donors, "too_few_donors"),
+    (POL.replace(max_distance=0.3), _far_donors, "donor_too_far"),
+    (POL, _bad_donors, "bound_exceeded"),
+    (POL.replace(audit_fraction=1.0, audit_seed=7), seed_donors,
+     "audit"),
+])
+def test_surrogate_escalates_with_reason(tmp_path, pol, seeder, reason):
+    svc = _svc(tmp_path, pol=pol)
+    q = make_query(*QUERY_CELL[:2], labor_sd=QUERY_CELL[2], **KW)
+    seeder(svc, q.group())
+    fut = svc.submit(q)
+    assert not fut.done()                 # fell through to a real solve
+    snap = svc.metrics.snapshot()
+    assert snap["surrogate_escalations"] == 1
+    assert snap["surrogate_escalation_rate"] == 1.0
+    svc.close(drain=False)
+    ev = read_journal(str(tmp_path / "events.jsonl"),
+                      event="SURROGATE_ESCALATED")
+    assert len(ev) == 1 and ev[0]["reason"] == reason
+
+
+# ---------------------------------------------------------------------------
+# The audited escalation resolves through a REAL solve (one solve).
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_audit_resolves_and_refines_lattice(tmp_path):
+    pol = POL.replace(audit_fraction=1.0, audit_seed=7)
+    svc = _svc(tmp_path, pol=pol)
+    q = make_query(*QUERY_CELL[:2], labor_sd=QUERY_CELL[2], **KW)
+    seed_donors(svc, q.group())
+    fut = svc.submit(q)
+    assert not fut.done()
+    svc.flush()
+    res = fut.result(120)
+    # the real solve is served exact and PUBLISHED — the lattice
+    # densified exactly where the surrogate was audited
+    assert res.quality == "exact"
+    assert svc.store.get(q.key()) is not None
+    snap = svc.metrics.snapshot()
+    assert snap["surrogate_audits"] == 1
+    assert snap["surrogate_refinements"] == 1
+    svc.close()
+    jp = str(tmp_path / "events.jsonl")
+    refined = read_journal(jp, event="LATTICE_REFINED")
+    assert len(refined) == 1
+    ev = refined[0]
+    assert isinstance(ev["audit_ok"], bool)
+    assert ev["surrogate_bound"] == pytest.approx(
+        read_journal(jp, event="SURROGATE_ESCALATED")[0]["bound"])
+    assert ev["audit_ok"] == (ev["surrogate_err"]
+                              <= ev["surrogate_bound"])
+    assert snap["surrogate_audit_failures"] == (0 if ev["audit_ok"]
+                                                else 1)
+
+
+# ---------------------------------------------------------------------------
+# Off switches are bit-identical to the pre-surrogate engine.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_surrogate_none_and_optout_bit_identical(tmp_path):
+    cell = (3.0, 0.6)
+
+    def solve(svc, **qkw):
+        q = make_query(*cell, **KW, **qkw)
+        fut = svc.submit(q)
+        if not fut.done():
+            svc.flush()
+        return fut.result(120)
+
+    # empty store: a policy-carrying service cold-misses identically
+    plain = EquilibriumService(start_worker=False, max_batch=4,
+                               ladder=(1, 2, 4))
+    res_a = solve(plain)
+    withpol = _svc(tmp_path)
+    res_b = solve(withpol)
+    # donor-filled store: surrogate_ok=False bypasses the tier and the
+    # warm path answers exactly like a policy-free service's warm path
+    donors = EquilibriumService(start_worker=False, max_batch=4,
+                                ladder=(1, 2, 4))
+    seed_donors(donors, make_query(*cell, **KW).group())
+    res_c = solve(donors)
+    withpol2 = _svc(pol=POL)
+    seed_donors(withpol2, make_query(*cell, **KW).group())
+    res_d = solve(withpol2, surrogate_ok=False)
+    for got, want in ((res_b, res_a), (res_d, res_c)):
+        assert got.quality == "exact"
+        assert got.r_star == want.r_star          # bitwise
+        assert got.values == want.values
+        assert got.path == want.path
+    # the opted-out query never touched the surrogate tier
+    snap = withpol2.metrics.snapshot()
+    assert withpol2.metrics.served["surrogate"] == 0
+    assert snap["surrogate_escalations"] == 0
+    for svc in (plain, withpol, donors, withpol2):
+        svc.close()
+    assert read_journal(str(tmp_path / "events.jsonl"),
+                        event="SURROGATE_SERVED") == []
