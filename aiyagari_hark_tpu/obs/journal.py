@@ -93,6 +93,18 @@ EVENT_TYPES = (
     # scale change, or occupancy-driven rewidth)
     "SURROGATE_SERVED", "SURROGATE_ESCALATED", "LATTICE_REFINED",
     "INDEX_REBUILD",
+    # durability / disaster-recovery tier (ISSUE 18, serve.{wal,
+    # replicated,store} + utils.checkpoint): a CAS replica recovering
+    # its version map from WAL+snapshot at start (torn tails and
+    # applied-record counts attached), a snapshot compaction truncating
+    # the WAL, anti-entropy repair pushing a rejoined/stale replica
+    # back to the quorum's state, a replicated backend losing its
+    # majority (typed CoordinationUnavailable at the caller), the
+    # solution store degrading to memory-only after a failed disk
+    # publish, and a disk write failing typed (injected ENOSPC/EIO or
+    # a real full/failing disk)
+    "WAL_REPLAY", "SNAPSHOT_COMPACT", "REPLICA_RESYNC", "QUORUM_LOST",
+    "STORE_DEGRADED", "DISK_FAULT",
 )
 
 

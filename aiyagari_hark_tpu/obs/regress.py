@@ -176,6 +176,31 @@ DIRECTION_EXPLICIT: Dict[str, str] = {
     "chaos_seeded_compares": NEUTRAL,
     "chaos_recovery_served": NEUTRAL,
     "chaos_backend_faults": NEUTRAL,  # injected partitions land here
+    # disaster-recovery smoke (ISSUE 18, bench --dr-smoke): the
+    # replicated-CAS fleet's full-fleet-SIGKILL drill.  The dedup ratio
+    # (expected drill duplicates excluded) must stay 1.0 — any rise is
+    # an exactly-once regression, DOWN.  Leaked leases, unresolved
+    # arrivals, value mismatches/divergence, and per-replica recovered-
+    # state mismatches are protocol violations, DOWN from record one.
+    # WAL replays / compactions / reclaims / recovered-key counts are
+    # facts of the script, NEUTRAL; injected/detected counts resolve
+    # NEUTRAL via the affix rules and are pinned equal by the
+    # acceptance gate; the recovery wall resolves DOWN via ``_wall_s``.
+    "dr_replicas": NEUTRAL,
+    "dr_workers": NEUTRAL,
+    "dr_arrivals": NEUTRAL,
+    "dr_served": UP,
+    "dr_dedup_ratio": DOWN,
+    "dr_unresolved": DOWN,
+    "dr_leases_leaked": DOWN,
+    "dr_value_mismatches": DOWN,
+    "dr_value_divergence": DOWN,
+    "dr_seeded_compares": NEUTRAL,
+    "dr_state_mismatches": DOWN,
+    "dr_recovered_keys": NEUTRAL,
+    "dr_wal_replays": NEUTRAL,
+    "dr_snapshot_compacts": NEUTRAL,
+    "dr_reclaims": NEUTRAL,
     "serve_prefetch_issued": NEUTRAL,
     "serve_prefetch_converted": UP,
     "serve_prefetch_suppressed": NEUTRAL,

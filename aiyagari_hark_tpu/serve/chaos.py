@@ -100,6 +100,21 @@ class ChaosAgent:
             if "lease_skew_s" in cfg:
                 self._lease_skew_s = float(cfg["lease_skew_s"])
                 self._skew_fired = False
+            if "disk_fault" in cfg:
+                # ISSUE 18: arm the checkpoint writers' deterministic
+                # ENOSPC/EIO seam inside THIS worker process (the
+                # disk-full-publish drill); falsy disarms.
+                from ..utils.checkpoint import (arm_disk_fault,
+                                                disarm_disk_faults)
+
+                df = cfg["disk_fault"]
+                if df:
+                    arm_disk_fault(df["op"],
+                                   kind=df.get("kind", "ENOSPC"),
+                                   count=int(df.get("count", 1)),
+                                   match=df.get("match", ""))
+                else:
+                    disarm_disk_faults()
             return self.armed()
 
     def armed(self) -> dict:
@@ -279,7 +294,7 @@ def _disarm(ctl, worker: int) -> None:
         ctl.post(worker, "/chaos", {
             "slow_publish_s": 0.0, "slow_cells": [],
             "heartbeat_stall": False, "partition_reads": 0,
-            "lease_skew_s": 0.0})
+            "lease_skew_s": 0.0, "disk_fault": None})
 
 
 def _drill_torn_publish(plan: ChaosPlan, ctl, cell) -> dict:
@@ -448,6 +463,253 @@ def _drill_clock_skew(plan: ChaosPlan, ctl, cell) -> dict:
             "key": key, "reclaimed": bool(reclaims),
             "skew_fired": bool(injects), "bits_equal": bits_equal,
             "expected_dup": True}
+
+
+# -- disaster-recovery drills (ISSUE 18, DESIGN §16) ------------------------
+#
+# The ISSUE 16 drills above attack the WORKERS; these attack the
+# COORDINATION SUBSTRATE itself — the replicated CAS quorum the fleet's
+# exactly-once election now rides on.  Same contract: injection through
+# a public surface (signals, the replica wire protocol, the /chaos
+# endpoint), detection ONLY from public artifacts (journals, /fleet,
+# process return codes, served bits).
+
+DR_DRILLS = ("replica_kill", "torn_wal_tail", "snapshot_mid_write",
+             "minority_partition", "disk_full_publish")
+
+
+class DRPlan(NamedTuple):
+    """One disaster-recovery campaign over a live fleet + its replica
+    set.  ``drill_cells`` must be disjoint from the traffic lattice
+    (drill re-publishes carry their own accounting); each drill that
+    needs a SECOND fresh fingerprint derives it by perturbing its cell's
+    labor-sd, staying off-lattice.  ``mutation_budget`` bounds the
+    synthetic lease traffic the snapshot drill drives to force a
+    compaction."""
+
+    drills: Tuple[str, ...] = DR_DRILLS
+    drill_cells: Tuple[Tuple[float, float, float], ...] = ()
+    settle_timeout_s: float = 60.0
+    mutation_budget: int = 160
+
+
+def run_dr_drills(plan: DRPlan, ctl, replicas) -> dict:
+    """Execute every DR drill against the live fleet behind ``ctl``
+    (loadgen ``FleetCtl``) coordinated by ``replicas`` (a
+    ``serve.replicated.ReplicaSet``); returns the same ledger shape as
+    ``run_drills``."""
+    if len(plan.drill_cells) < len(plan.drills):
+        raise ValueError(
+            f"DRPlan needs one drill cell per drill "
+            f"({len(plan.drills)} drills, {len(plan.drill_cells)} cells)")
+    runners = {"replica_kill": _dr_replica_kill,
+               "torn_wal_tail": _dr_torn_wal_tail,
+               "snapshot_mid_write": _dr_snapshot_mid_write,
+               "minority_partition": _dr_minority_partition,
+               "disk_full_publish": _dr_disk_full_publish}
+    records = []
+    expected_dup: list = []
+    drill_keys: list = []
+    for i, name in enumerate(plan.drills):
+        if name not in runners:
+            raise ValueError(f"unknown DR drill {name!r} "
+                             f"(known: {', '.join(DR_DRILLS)})")
+        rec = runners[name](plan, ctl, replicas, plan.drill_cells[i])
+        rec["drill"] = name
+        records.append(rec)
+        for k in rec.get("keys", ()):
+            drill_keys.append(int(k))
+            if rec.get("expected_dup"):
+                expected_dup.append(int(k))
+    return {"drills": records,
+            "injected": sum(r["injected"] for r in records),
+            "detected": sum(r["detected"] for r in records),
+            "expected_dup_keys": expected_dup,
+            "drill_keys": drill_keys}
+
+
+def _replica_events(replicas, event: str, i: Optional[int] = None) -> list:
+    """Journal events from the replica processes' own journals (the
+    coordination substrate's public artifact trail)."""
+    from ..obs.journal import read_journal
+
+    paths = (replicas.journals if i is None else [replicas.journals[i]])
+    out = []
+    for jp in paths:
+        if os.path.exists(jp):
+            out.extend(read_journal(jp, event=event))
+    return out
+
+
+def _second_cell(cell) -> tuple:
+    """A fresh off-lattice fingerprint adjacent to ``cell`` (drills that
+    need two never-queried cells)."""
+    return (float(cell[0]), float(cell[1]), float(cell[2]) + 1e-3)
+
+
+def _dr_replica_kill(plan: DRPlan, ctl, replicas, cell) -> dict:
+    """SIGKILL one replica: a 3-replica quorum keeps electing with 2,
+    and the restarted replica recovers its exact map from WAL+snapshot
+    (``WAL_REPLAY`` in its own journal)."""
+    victim = replicas.n - 1
+    replays_before = len(_replica_events(replicas, "WAL_REPLAY",
+                                         i=victim))
+    replicas.kill(victim, signal.SIGKILL)
+    rc_ok = _poll_until(
+        lambda: replicas.returncode(victim) == -int(signal.SIGKILL),
+        plan.settle_timeout_s)
+    res = ctl.query(cell)            # cold election on a 2/3 quorum
+    key = int(res["key"])
+    replicas.restart(victim)
+    replayed = len(_replica_events(replicas, "WAL_REPLAY",
+                                   i=victim)) > replays_before
+    return {"injected": 1,
+            "detected": int(rc_ok and replayed),
+            "keys": [key], "victim_rc": replicas.returncode(victim),
+            "answered": True, "replayed": replayed,
+            "expected_dup": False}
+
+
+def _dr_torn_wal_tail(plan: DRPlan, ctl, replicas, cell) -> dict:
+    """Hard-kill a replica and tear its WAL tail (the partial final
+    record a crash mid-append leaves): recovery must skip EXACTLY that
+    record, loudly (``WAL_REPLAY`` with ``torn_skipped >= 1``), and
+    serve every earlier acknowledged mutation."""
+    victim = replicas.n - 1
+    res0 = ctl.query(cell)           # ensure there is real CAS history
+    key = int(res0["key"])
+    replicas.kill(victim, signal.SIGKILL)
+    _poll_until(lambda: replicas.returncode(victim) is not None,
+                plan.settle_timeout_s)
+    wal = os.path.join(replicas.data_dirs[victim], "cas.wal")
+    with open(wal, "ab") as f:   # atomic-ok: the drill WRITES a torn tail
+        f.write(b'{"seq":999999999,"k":1,"o":"torn')
+    replicas.restart(victim)
+    torn = [ev for ev in _replica_events(replicas, "WAL_REPLAY",
+                                         i=victim)
+            if ev.get("torn_skipped", 0) >= 1]
+    res1 = ctl.query(_second_cell(cell))   # quorum still elects
+    return {"injected": 1,
+            "detected": int(bool(torn)),
+            "keys": [key, int(res1["key"])],
+            "torn_detected": bool(torn), "answered": True,
+            "expected_dup": False}
+
+
+def _dr_snapshot_mid_write(plan: DRPlan, ctl, replicas, cell) -> dict:
+    """ENOSPC exactly at a replica's snapshot write (armed over the
+    wire through the replica's own ``inject_fault`` op, fired by real
+    compaction pressure): the replica journals ``DISK_FAULT``, keeps
+    serving from memory + WAL, and the next compaction window retries."""
+    from .lease import LoopbackCASBackend
+
+    victim = 0
+    cli = LoopbackCASBackend(f"127.0.0.1:{replicas.ports[victim]}")
+    base = 0x5D15C000_00000000   # synthetic drill keys, off any lattice
+    try:
+        cli.inject_fault("atomic_write_json", kind="ENOSPC", count=1,
+                         match="cas.snapshot")
+        fired = False
+        for i in range(int(plan.mutation_budget)):
+            cli.try_acquire(base + i, "dr-snapshot-drill")
+            cli.release(base + i, owner="dr-snapshot-drill")
+            fired = bool(_replica_events(replicas, "DISK_FAULT",
+                                         i=victim))
+            if fired:
+                break
+        still_serving = cli.try_acquire(base + 999_999,
+                                        "dr-snapshot-drill")
+        cli.release(base + 999_999, owner="dr-snapshot-drill")
+    finally:
+        cli.close()
+    res = ctl.query(cell)
+    return {"injected": 1,
+            "detected": int(fired and still_serving),
+            "keys": [int(res["key"])], "fault_journaled": fired,
+            "replica_served_after": bool(still_serving),
+            "answered": True, "expected_dup": False}
+
+
+def _dr_minority_partition(plan: DRPlan, ctl, replicas, cell) -> dict:
+    """Client-side partition in two acts.  Minority unreachable: the
+    worker keeps electing (quorum holds).  Majority unreachable: the
+    worker's claims degrade TYPED (``QUORUM_LOST`` +
+    ``LEASE_BACKEND_FAULT`` journaled, query parked); healing the
+    partition lets the parked election win, and first contact with the
+    returning replicas anti-entropy-resyncs them
+    (``REPLICA_RESYNC``)."""
+    worker, _ = ctl.two_live_workers()
+    n = replicas.n
+    # act 1: minority gone — still serves
+    _arm(ctl, worker, {"partition_replicas": [n - 1]})
+    res1 = ctl.query(cell, prefer=worker)
+    key1 = int(res1["key"])
+    # act 2: majority gone — typed degrade, then heal.  Counts are
+    # taken before/after: earlier drills' replica restarts already left
+    # resync events, and detection must be THIS drill's evidence.
+    lost0 = len(_journal_events(ctl, "QUORUM_LOST"))
+    resync0 = len(_journal_events(ctl, "REPLICA_RESYNC"))
+    _arm(ctl, worker, {"partition_replicas": list(range(1, n))})
+    cell2 = _second_cell(cell)
+    result: dict = {}
+
+    def _ask():
+        try:
+            result["res"] = ctl.query(cell2, prefer=worker)
+        except Exception as e:
+            result["err"] = e
+
+    t = threading.Thread(target=_ask, name="dr-partition-client")
+    t.start()
+    try:
+        lost = _poll_until(
+            lambda: len(_journal_events(ctl, "QUORUM_LOST")) > lost0,
+            plan.settle_timeout_s)
+    finally:
+        _arm(ctl, worker, {"partition_replicas": []})
+    t.join(plan.settle_timeout_s)
+    res2 = result.get("res")
+    faults = [ev for ev in _journal_events(ctl, "LEASE_BACKEND_FAULT")
+              if "CoordinationUnavailable" in str(ev.get("detail", ""))]
+    resynced = len(_journal_events(ctl, "REPLICA_RESYNC")) > resync0
+    keys = [key1] + ([] if res2 is None else [int(res2["key"])])
+    return {"injected": 1,
+            "detected": int(lost and bool(faults) and res2 is not None
+                            and resynced),
+            "keys": keys, "answered_minority": True,
+            "quorum_lost_journaled": lost,
+            "typed_degrades": len(faults),
+            "answered_after_heal": res2 is not None,
+            "resynced": resynced, "expected_dup": False}
+
+
+def _dr_disk_full_publish(plan: DRPlan, ctl, replicas, cell) -> dict:
+    """ENOSPC at a worker's store publish: the entry degrades to
+    memory-only (``STORE_DEGRADED`` journaled), the query is still
+    answered, and a peer re-solves the key onto healthy disk with
+    bit-identical values."""
+    victim, peer = ctl.two_live_workers()
+    _arm(ctl, victim, {"disk_fault": {"op": "save_pytree",
+                                      "kind": "ENOSPC", "count": 1,
+                                      "match": "sol_"}})
+    try:
+        res0 = ctl.query(cell, prefer=victim)
+    finally:
+        _disarm(ctl, victim)
+    key = int(res0["key"])
+    degraded = bool(_journal_events(ctl, "STORE_DEGRADED", key=key))
+    res1 = ctl.query(cell, prefer=peer)    # peer re-solves onto disk
+    bits_equal = _value_fields(res0) == _value_fields(res1)
+    republished = len(_journal_events(ctl, "FLEET_PUBLISH",
+                                      key=key)) >= 2
+    survives = os.path.exists(os.path.join(
+        ctl.store_dir, f"sol_{_hex(key)}.npz"))
+    return {"injected": 1,
+            "detected": int(degraded and bits_equal and republished
+                            and survives),
+            "keys": [key], "degraded_journaled": degraded,
+            "bits_equal": bits_equal, "republished": republished,
+            "entry_on_disk_after": survives, "expected_dup": True}
 
 
 def _hex(key: int) -> str:

@@ -110,7 +110,11 @@ _ERROR_STATUS = {
 }
 # Interrupted is resolved lazily (importing the resilience layer here
 # would be needless at module scope for a transport table).
-_EXTRA_STATUS = {"Interrupted": (503, False)}
+# CoordinationUnavailable (ISSUE 18): the worker's replicated CAS lost
+# its quorum — retryable 503, the client backs off and the majority
+# side of the partition keeps serving.
+_EXTRA_STATUS = {"Interrupted": (503, False),
+                 "CoordinationUnavailable": (503, True)}
 
 
 def result_to_json(res) -> dict:
@@ -272,6 +276,18 @@ class _FleetHandler(BaseHTTPRequestHandler):
             n = int(self.headers.get("Content-Length", "0"))
             cfg = (json.loads(self.rfile.read(n).decode("utf-8"))
                    if n else {})
+            # ISSUE 18: ``partition_replicas`` is a COORDINATION fault,
+            # not a solve fault — it routes to the replicated lease
+            # backend (which replicas this worker may reach), not to the
+            # ChaosAgent's solve-path seams.  [] heals the partition.
+            part = cfg.pop("partition_replicas", None)
+            if part is not None:
+                backend = self.server.service.store.lease_backend
+                if not hasattr(backend, "set_partition"):
+                    raise ValueError(
+                        "partition_replicas needs a replicated lease "
+                        f"backend (got {type(backend).__name__})")
+                backend.set_partition(part)
             armed = agent.arm(cfg)
         except Exception as e:
             self._send(400, {"error": "BadRequest", "message": str(e)})
@@ -641,8 +657,10 @@ def worker_main(argv=None) -> int:
                     help="safety exit after this long (tests)")
     ap.add_argument("--lease-backend", default="dir",
                     help="coordination backend spec: 'dir' (shared-dir "
-                         "leases, the default) or 'cas:HOST:PORT' (the "
-                         "loopback CAS authority, serve.lease)")
+                         "leases, the default), 'cas:HOST:PORT' (the "
+                         "loopback CAS authority, serve.lease), or "
+                         "'replicated:H:P,H:P,...' (quorum over an odd "
+                         "replica set, serve.replicated)")
     ap.add_argument("--chaos", action="store_true",
                     help="enable the POST /chaos fault-injection "
                          "endpoint (ISSUE 16 drills; never on by "

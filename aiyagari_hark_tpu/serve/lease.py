@@ -77,6 +77,16 @@ def key_from_hex(hex_str: str) -> int:
     return v - (1 << 64) if v >= (1 << 63) else v
 
 
+class CoordinationUnavailable(ConnectionError):
+    """The replicated coordination tier cannot reach a MAJORITY of its
+    replicas (ISSUE 18): conditional writes and quorum reads are
+    refused rather than answered from a minority view.  A subclass of
+    ``ConnectionError`` on purpose — the store's ``_backend_call``
+    degrade path (typed ``LEASE_BACKEND_FAULT``, fail-safe defaults,
+    PR 15 partition semantics) owns it without a new catch site, and
+    the fleet HTTP tier maps it to a retryable 503."""
+
+
 class LeaseBackend:
     """The coordination trait: per-fingerprint lease election with
     heartbeat liveness and TTL reclaim.  Keys are signed int64 solution
@@ -241,14 +251,20 @@ class SharedDirBackend(LeaseBackend):
 
 class _Rec:
     """One CAS lease record: owner + liveness stamp + version (the
-    conditional-put token)."""
+    conditional-put token).  ``owner is None`` is a TOMBSTONE (ISSUE
+    18): a released/reclaimed lease keeps its record with the version
+    bumped, so per-key versions are MONOTONIC forever — the property
+    quorum replication and read-repair need to order a deletion against
+    a re-acquire ("highest version wins" is only sound when a delete
+    carries a version instead of erasing one)."""
 
     __slots__ = ("owner", "stamp", "version")
 
-    def __init__(self, owner: str, stamp: float):
+    def __init__(self, owner: Optional[str], stamp: float,
+                 version: int = 1):
         self.owner = owner
         self.stamp = stamp
-        self.version = 1
+        self.version = version
 
 
 class MemoryCASBackend(LeaseBackend):
@@ -256,11 +272,16 @@ class MemoryCASBackend(LeaseBackend):
 
     * acquire  = put-if-absent (one writer wins, the CAS primitive);
     * heartbeat = read; if owner matches, bump stamp AND version;
-    * reclaim  = read (stamp, version); if stale, delete-if-version —
+    * reclaim  = read (stamp, version); if stale, tombstone-if-version —
       a heartbeat that lands between the read and the delete bumps the
       version and the delete is REFUSED, so a live owner can never lose
       its lease to a reclaimer that raced its beat (the race the
       shared-dir backend can only shrink, closed exactly here).
+
+    Deletions are tombstones (see ``_Rec``): invisible through the
+    trait (``age_s``/``owner_of`` read None, ``list_keys`` skips them,
+    acquire treats them as absent) but version-ordered for the
+    replication tier's ``get``/``put_rec``/``dump`` primitives.
 
     ``clock`` is injectable for deterministic staleness tests; the
     default is the wall clock (leases coordinate processes)."""
@@ -269,28 +290,36 @@ class MemoryCASBackend(LeaseBackend):
 
     def __init__(self, clock=None, skew_tolerance_s: float = 0.0):
         self._recs: Dict[int, _Rec] = {}
-        self._lock = threading.Lock()
+        # reentrant: the durable subclass logs WAL records from inside
+        # the mutators' critical sections (serve.wal)
+        self._lock = threading.RLock()
         self._clock = clock if clock is not None else time.time
         self.skew_tolerance_s = float(skew_tolerance_s)
 
     def try_acquire(self, key: int, owner: str) -> bool:
         key = int(key)
         with self._lock:
-            if key in self._recs:
+            rec = self._recs.get(key)
+            if rec is not None and rec.owner is not None:
                 return False
-            self._recs[key] = _Rec(str(owner), float(self._clock()))
+            version = 1 if rec is None else rec.version + 1
+            self._recs[key] = _Rec(str(owner), float(self._clock()),
+                                   version)
+            self._mutated(key)
             return True
 
     def release(self, key: int, owner: Optional[str] = None) -> bool:
         key = int(key)
         with self._lock:
             rec = self._recs.get(key)
-            if rec is None:
+            if rec is None or rec.owner is None:
                 return False
-            if (owner is not None and rec.owner is not None
-                    and rec.owner != str(owner)):
+            if owner is not None and rec.owner != str(owner):
                 return False
-            del self._recs[key]
+            rec.owner = None
+            rec.stamp = float(self._clock())
+            rec.version += 1
+            self._mutated(key)
             return True
 
     def heartbeat(self, key: int, owner: str) -> bool:
@@ -301,13 +330,14 @@ class MemoryCASBackend(LeaseBackend):
                 return False
             rec.stamp = float(self._clock())
             rec.version += 1
+            self._mutated(key)
             return True
 
     def age_s(self, key: int, now=None) -> Optional[float]:
         key = int(key)
         with self._lock:
             rec = self._recs.get(key)
-            if rec is None:
+            if rec is None or rec.owner is None:
                 return None
             now = float(self._clock()) if now is None else float(now)
             return max(0.0, now - rec.stamp)
@@ -316,20 +346,24 @@ class MemoryCASBackend(LeaseBackend):
         key = int(key)
         with self._lock:
             rec = self._recs.get(key)
-            if rec is None:
+            if rec is None or rec.owner is None:
                 return False
             now_v = float(self._clock()) if now is None else float(now)
             age = max(0.0, now_v - rec.stamp)
             if age <= float(ttl_s) + self.skew_tolerance_s:
                 return False
             version = rec.version
-            # delete-if-version: under this lock the re-read is trivially
-            # current, but the shape is the remote-CAS contract — a beat
-            # between the staleness read and the delete MUST refuse it
+            # tombstone-if-version: under this lock the re-read is
+            # trivially current, but the shape is the remote-CAS
+            # contract — a beat between the staleness read and the
+            # delete MUST refuse it
             cur = self._recs.get(key)
             if cur is None or cur.version != version:
                 return False
-            del self._recs[key]
+            cur.owner = None
+            cur.stamp = float(self._clock())
+            cur.version += 1
+            self._mutated(key)
             return True
 
     def owner_of(self, key: int) -> Optional[str]:
@@ -339,9 +373,60 @@ class MemoryCASBackend(LeaseBackend):
 
     def list_keys(self) -> List[int]:
         with self._lock:
-            return sorted(self._recs)
+            return sorted(k for k, rec in self._recs.items()
+                          if rec.owner is not None)
 
-    # -- test hook ----------------------------------------------------------
+    # -- replication primitives (ISSUE 18, serve.replicated) ----------------
+
+    def get(self, key: int, now=None) -> Optional[dict]:
+        """The versioned read: the key's full record — tombstones
+        included — or None when the key was never seen.  ``age`` is
+        computed HERE, against this replica's clock (stamps never cross
+        clocks) unless the caller supplies its own ``now`` (the trait's
+        single-clock affordance, forwarded by the quorum client so
+        ``age_s(key, now=...)`` means the same thing on every backend);
+        None for a tombstone."""
+        with self._lock:
+            rec = self._recs.get(int(key))
+            if rec is None:
+                return None
+            now_v = float(self._clock()) if now is None else float(now)
+            age = (None if rec.owner is None
+                   else max(0.0, now_v - rec.stamp))
+            return {"owner": rec.owner, "stamp": rec.stamp,
+                    "version": rec.version, "age": age}
+
+    def put_rec(self, key: int, owner: Optional[str], stamp: float,
+                version: int) -> bool:
+        """Conditional versioned write — the quorum-CAS primitive:
+        apply iff ``version`` is STRICTLY newer than the stored one
+        (absent = 0).  Each replica therefore acks at most one writer
+        per version number, which is what makes a majority of acks an
+        election.  Also the anti-entropy repair op (push a winner to a
+        stale replica)."""
+        key, version = int(key), int(version)
+        with self._lock:
+            cur = self._recs.get(key)
+            if cur is not None and cur.version >= version:
+                return False
+            self._recs[key] = _Rec(
+                None if owner is None else str(owner),
+                float(stamp), version)
+            self._mutated(key)
+            return True
+
+    def dump(self) -> list:
+        """Every record (tombstones included) as ``[key, owner, stamp,
+        version]`` rows — the anti-entropy transfer format."""
+        with self._lock:
+            return [[k, rec.owner, rec.stamp, rec.version]
+                    for k, rec in sorted(self._recs.items())]
+
+    def _mutated(self, key: int) -> None:
+        """Post-mutation hook (lock held); the durable subclass appends
+        the key's new record to its WAL here.  A no-op in memory."""
+
+    # -- test hooks ---------------------------------------------------------
 
     def backdate(self, key: int, dt_s: float) -> None:
         """Age one lease by ``dt_s`` (conformance-suite staleness hook —
@@ -350,12 +435,28 @@ class MemoryCASBackend(LeaseBackend):
             rec = self._recs.get(int(key))
             if rec is not None:
                 rec.stamp -= float(dt_s)
+                self._mutated(int(key))
+
+    def inject_fault(self, writer: str, kind: str = "ENOSPC",
+                     count: int = 1, match: str = "") -> bool:
+        """Arm a deterministic disk fault in THIS process (drill hook —
+        reaching a replica's ``utils.checkpoint`` injector over the
+        wire is how the snapshot-mid-write drill works).  ``writer`` is
+        the blessed-writer name (``op`` is taken by the wire dispatch)."""
+        from ..utils.checkpoint import arm_disk_fault
+
+        arm_disk_fault(writer, kind=kind, count=count, match=match)
+        return True
 
 
 # -- the loopback CAS: same semantics, across real processes ----------------
 
 _CAS_OPS = {"try_acquire", "release", "heartbeat", "age_s",
-            "break_stale", "owner_of", "list_keys", "backdate", "ping"}
+            "break_stale", "owner_of", "list_keys", "backdate", "ping",
+            # replication / durability tier (ISSUE 18): versioned read,
+            # conditional versioned write, anti-entropy transfer, and
+            # the drill hook arming a disk fault inside the replica
+            "get", "put_rec", "dump", "inject_fault"}
 
 
 class _CASHandler(socketserver.StreamRequestHandler):
@@ -392,12 +493,28 @@ class _CASTCPServer(socketserver.ThreadingTCPServer):
 class CASServer:
     """A ``MemoryCASBackend`` served over loopback TCP so separate
     processes share one CAS authority.  ``address`` is ``host:port``
-    (ephemeral port when constructed with ``port=0``)."""
+    (ephemeral port when constructed with ``port=0``).
+
+    ``data_dir`` (ISSUE 18) makes the server CRASH-DURABLE: the backend
+    becomes a ``serve.wal.DurableCASBackend`` that write-ahead-logs
+    every mutation (checksummed, fsynced) and compacts to an atomic
+    snapshot every ``snapshot_every`` mutations, so a SIGKILLed replica
+    restarted over the same directory recovers its exact version map."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 clock=None, skew_tolerance_s: float = 0.0):
-        self.backend = MemoryCASBackend(
-            clock=clock, skew_tolerance_s=skew_tolerance_s)
+                 clock=None, skew_tolerance_s: float = 0.0,
+                 data_dir: Optional[str] = None,
+                 snapshot_every: int = 256, obs=None):
+        if data_dir is not None:
+            from .wal import DurableCASBackend
+
+            self.backend: MemoryCASBackend = DurableCASBackend(
+                data_dir, clock=clock,
+                skew_tolerance_s=skew_tolerance_s,
+                snapshot_every=snapshot_every, obs=obs)
+        else:
+            self.backend = MemoryCASBackend(
+                clock=clock, skew_tolerance_s=skew_tolerance_s)
         self._srv = _CASTCPServer((host, int(port)), _CASHandler)
         self._srv.backend = self.backend
         self.host, self.port = self._srv.server_address[:2]
@@ -512,6 +629,28 @@ class LoopbackCASBackend(LeaseBackend):
     def backdate(self, key: int, dt_s: float) -> None:
         self._call("backdate", key=int(key), dt_s=float(dt_s))
 
+    # replication / durability primitives (ISSUE 18)
+
+    def get(self, key: int, now=None) -> Optional[dict]:
+        return self._call("get", key=int(key), now=now)
+
+    def put_rec(self, key: int, owner: Optional[str], stamp: float,
+                version: int) -> bool:
+        return bool(self._call("put_rec", key=int(key), owner=owner,
+                               stamp=float(stamp), version=int(version)))
+
+    def dump(self) -> list:
+        return self._call("dump")
+
+    def inject_fault(self, writer: str, kind: str = "ENOSPC",
+                     count: int = 1, match: str = "") -> bool:
+        return bool(self._call("inject_fault", writer=str(writer),
+                               kind=str(kind), count=int(count),
+                               match=str(match)))
+
+    def ping(self) -> bool:
+        return bool(self._call("ping"))
+
     def close(self) -> None:
         with self._lock:
             self._close_locked()
@@ -520,17 +659,87 @@ class LoopbackCASBackend(LeaseBackend):
 def make_backend(spec: str, root: Optional[str] = None,
                  skew_tolerance_s: float = 0.0) -> LeaseBackend:
     """Backend from a CLI spelling: ``dir`` (shared-directory default;
-    needs ``root``), ``cas:<host>:<port>`` (loopback CAS client), or
-    ``memory`` (single-process CAS, tests)."""
+    needs ``root``), ``cas:<host>:<port>`` (loopback CAS client),
+    ``replicated:<host>:<port>,...`` (quorum client over 2f+1 CAS
+    replicas, ISSUE 18), or ``memory`` (single-process CAS, tests)."""
     spec = str(spec)
     if spec == "dir":
         if root is None:
             raise ValueError("lease backend 'dir' requires a store root")
         return SharedDirBackend(root, skew_tolerance_s=skew_tolerance_s)
+    if spec.startswith("replicated:"):
+        from .replicated import ReplicatedCASBackend
+
+        addrs = [a.strip() for a in spec[len("replicated:"):].split(",")
+                 if a.strip()]
+        return ReplicatedCASBackend(addrs,
+                                    skew_tolerance_s=skew_tolerance_s)
     if spec.startswith("cas:"):
         return LoopbackCASBackend(spec[len("cas:"):])
     if spec == "memory":
         return MemoryCASBackend(skew_tolerance_s=skew_tolerance_s)
     raise ValueError(
-        f"unknown lease backend {spec!r} (expected 'dir', 'memory', or "
-        "'cas:<host>:<port>')")
+        f"unknown lease backend {spec!r} (expected 'dir', 'memory', "
+        "'cas:<host>:<port>', or 'replicated:<h>:<p>,<h>:<p>,...')")
+
+
+# -- replica process entry point (ISSUE 18) ----------------------------------
+
+
+def replica_main(argv=None) -> int:
+    """Run one CAS replica as a standalone process:
+
+        python -m aiyagari_hark_tpu.serve.lease \\
+            --port 0 --data-dir /path/to/replica0 --journal j.jsonl
+
+    Prints ``CAS_READY port=<p> pid=<pid>`` once serving (the spawn
+    harness parses it), recovers the version map from WAL+snapshot when
+    ``--data-dir`` holds a prior life's state, and exits 0 on
+    SIGTERM/SIGINT.  SIGKILL is the drill case: the WAL is the
+    contract."""
+    import argparse
+    import signal
+    import sys
+
+    p = argparse.ArgumentParser(prog="aiyagari-cas-replica")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--data-dir", default=None,
+                   help="WAL+snapshot directory (durable mode)")
+    p.add_argument("--journal", default=None,
+                   help="append lifecycle events (WAL_REPLAY, "
+                        "SNAPSHOT_COMPACT, DISK_FAULT) to this JSONL")
+    p.add_argument("--snapshot-every", type=int, default=256)
+    p.add_argument("--skew-tolerance-s", type=float, default=0.0)
+    args = p.parse_args(argv)
+
+    obs = None
+    if args.journal is not None:
+        from ..obs.runtime import ObsConfig, build_obs
+
+        obs = build_obs(ObsConfig(enabled=True,
+                                  journal_path=args.journal))
+    srv = CASServer(host=args.host, port=args.port,
+                    skew_tolerance_s=args.skew_tolerance_s,
+                    data_dir=args.data_dir,
+                    snapshot_every=args.snapshot_every, obs=obs)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    srv.start()
+    print(f"CAS_READY port={srv.port} pid={os.getpid()}", flush=True)
+    sys.stdout.flush()
+    try:
+        while not stop.is_set():
+            stop.wait(0.2)
+    finally:
+        srv.stop()
+        if obs is not None:
+            obs.close()
+    return 0
+
+
+if __name__ == "__main__":   # pragma: no cover - subprocess entry
+    import sys
+
+    sys.exit(replica_main())

@@ -360,6 +360,11 @@ class FleetSpec(NamedTuple):
     # SurrogatePolicy field overrides forwarded to every worker's
     # ``--surrogate`` flag (None = workers serve without a surrogate).
     surrogate: Optional[dict] = None
+    # ISSUE 18: coordination backend spec forwarded to every worker's
+    # ``--lease-backend`` flag (None = the shared-dir default).  The
+    # harness's own lease audit uses the SAME spec, so a CAS-backed
+    # fleet is audited against the CAS authority, not an empty dir.
+    lease_backend: Optional[str] = None
 
 
 class FleetReport(NamedTuple):
@@ -447,6 +452,8 @@ def _spawn_worker(spec: FleetSpec, store_dir: str, journal_path: str,
                 _json.dumps([list(c) for c in spec.cells])]
     if spec.surrogate is not None:
         cmd += ["--surrogate", _json.dumps(spec.surrogate)]
+    if spec.lease_backend is not None:
+        cmd += ["--lease-backend", spec.lease_backend]
     if chaos:
         cmd += ["--chaos"]
     return subprocess.Popen(
@@ -882,8 +889,17 @@ def run_fleet_load(spec: FleetSpec, store_dir: str,
 
     from .store import SolutionStore
 
+    # the audit MUST interrogate the same coordination authority the
+    # workers used (ISSUE 18): auditing a CAS-backed fleet against the
+    # shared directory would vacuously find zero leases
+    audit_backend = None
+    if spec.lease_backend is not None:
+        from .lease import make_backend
+
+        audit_backend = make_backend(spec.lease_backend, root=store_dir)
     audit = SolutionStore(disk_path=store_dir, shared=True,
-                          lease_ttl_s=spec.lease_ttl_s, owner="audit")
+                          lease_ttl_s=spec.lease_ttl_s, owner="audit",
+                          lease_backend=audit_backend)
     deadline = Stopwatch()
     while (audit.lease_files()
            and deadline.elapsed() < spec.lease_ttl_s + 10.0):
@@ -891,6 +907,7 @@ def run_fleet_load(spec: FleetSpec, store_dir: str,
         if audit.lease_files():
             _time.sleep(0.2)
     leaked = len(audit.lease_files())
+    audit.close()
 
     # every published solve's exact seed came through its journal, so
     # keys whose solving RESPONSE no client saw (prefetch solves, a

@@ -245,7 +245,8 @@ class SolutionStore:
         self._published_keys: list = []  # keys this store published
         self._fleet = {"fleet_claims_won": 0, "fleet_claims_lost": 0,
                        "fleet_publishes": 0, "fleet_lease_reclaims": 0,
-                       "fleet_backend_faults": 0}
+                       "fleet_backend_faults": 0,
+                       "fleet_store_degraded": 0}
         # lease HEARTBEAT (ISSUE 15): a lease's liveness stamp is
         # refreshed every ttl/4 while its owner lives, so staleness
         # means "the owner stopped beating" (crashed/killed), never
@@ -301,6 +302,11 @@ class SolutionStore:
         # the obs bundle must be adopted BEFORE the disk index loads:
         # restart-time evictions are exactly the ones worth journaling
         self._obs = obs if obs is not None else NULL_OBS
+        # the replicated backend journals its own seams (QUORUM_LOST,
+        # REPLICA_RESYNC); adopt it into this store's scope (ISSUE 18)
+        if (self.lease_backend is not None and self._obs is not NULL_OBS
+                and hasattr(self.lease_backend, "attach_obs")):
+            self.lease_backend.attach_obs(self._obs)
         if disk_path is not None:
             os.makedirs(disk_path, exist_ok=True)
             self._load_disk_index()
@@ -325,6 +331,9 @@ class SolutionStore:
         inside someone else's run."""
         if self._obs is NULL_OBS and obs is not None:
             self._obs = obs
+            if (self.lease_backend is not None
+                    and hasattr(self.lease_backend, "attach_obs")):
+                self.lease_backend.attach_obs(obs)
 
     def _obs_scope(self):
         return self._obs if self._obs is not NULL_OBS else active_obs()
@@ -576,8 +585,7 @@ class SolutionStore:
                     save_pytree(self._file(key), sol)
                     on_disk = True
                 except OSError as e:
-                    warnings.warn(f"solution store: could not persist entry "
-                                  f"{key}: {e}", stacklevel=2)
+                    self._degrade_memory_only(key, e)
             prior = self._meta.get(key)
             if prior is not None and prior.on_disk:
                 on_disk = True
@@ -588,6 +596,24 @@ class SolutionStore:
                 cert_level=int(sol.cert_level),
                 schema_ck=int(sol.schema_ck)))
             self._insert(key, sol)
+
+    def _degrade_memory_only(self, key: int, error) -> None:
+        """The failed-disk-publish seam (ISSUE 18; covered by
+        ``check_obs_events``; ``_lock`` held): a full/failing disk
+        (ENOSPC/EIO — real or injected via ``utils.checkpoint
+        .arm_disk_fault``) must degrade the store to MEMORY-ONLY for
+        this entry — journaled ``STORE_DEGRADED``, counted, warned —
+        never crash the solve or tear the disk tier.  This process
+        keeps serving the solution from memory; peers re-solve (the
+        atomic writer guarantees they never read a torn file)."""
+        self._fleet["fleet_store_degraded"] += 1
+        self._obs_scope().event(
+            "STORE_DEGRADED", key=int(key), tier="disk",
+            error=f"{type(error).__name__}: {error}")
+        warnings.warn(
+            f"solution store: could not persist entry {int(key)} "
+            f"({error}); serving it memory-only — peers will re-solve "
+            "until the disk recovers", stacklevel=3)
 
     # -- fleet claim / publish (ISSUE 15, DESIGN §14) -----------------------
 
@@ -778,16 +804,37 @@ class SolutionStore:
                     " or re-acquired by a peer) — claim dropped",
                     key=key)
 
-    def close(self, release_leases: bool = False) -> None:
+    def close(self, release_leases: bool = False,
+              timeout_s: float = 5.0) -> None:
         """Deterministically stop the heartbeat daemon (ISSUE 16
         satellite): after ``close`` returns no store thread is running.
         Held leases are left for TTL reclaim by default — the crashed-
         winner protocol, and the right semantics for a dying worker —
         or released first with ``release_leases=True`` (an orderly
         shutdown that will not publish).  Idempotent; entries and the
-        disk tier are untouched."""
+        disk tier are untouched.
+
+        The release pass is BOUNDED by ``timeout_s`` (ISSUE 18
+        satellite): against an unreachable/partitioned backend each
+        release already degrades typed (``_backend_call``), but N keys
+        x a dial timeout could wedge a dying worker for minutes — once
+        the budget is spent the remaining leases are LEFT FOR TTL
+        RECLAIM with one more typed journal line, and ``close`` keeps
+        its promise to return."""
         if release_leases:
-            for key in self.held_leases():
+            import time as _time
+
+            deadline = _time.monotonic() + max(0.0, float(timeout_s))
+            held = self.held_leases()
+            for idx, key in enumerate(held):
+                if _time.monotonic() >= deadline:
+                    self._backend_fault(
+                        "close_release",
+                        f"close(release_leases=True) exceeded its "
+                        f"{float(timeout_s):.1f}s budget with "
+                        f"{len(held) - idx} lease(s) unreleased — "
+                        "left for TTL reclaim")
+                    break
                 self.release(key)
         with self._lock:
             self._closed = True
@@ -796,7 +843,10 @@ class SolutionStore:
         if t is not None and t is not threading.current_thread():
             t.join(max(1.0, self.lease_ttl_s))
         if self.lease_backend is not None:
-            self.lease_backend.close()
+            try:
+                self.lease_backend.close()
+            except (OSError, ConnectionError) as e:
+                self._backend_fault("close", e)
 
     def __del__(self):   # pragma: no cover - GC timing
         try:

@@ -10,8 +10,10 @@ Krusell-Smith fixed point and long sweeps are resumable.
 
 from __future__ import annotations
 
+import errno
 import os
 import tempfile
+import threading
 import zipfile
 from typing import NamedTuple
 
@@ -25,6 +27,87 @@ import numpy as np
 CORRUPT_NPZ_ERRORS = (OSError, ValueError, EOFError, zipfile.BadZipFile)
 
 
+# -- deterministic disk-fault injection (ISSUE 18) ---------------------------
+#
+# Every writer in this module consults ``_maybe_disk_fault(op, path)``
+# before touching the disk.  Unarmed (the default, and the only state
+# outside drills/tests) that is one dict truth-test.  Armed via
+# ``arm_disk_fault``, the next ``count`` matching writes raise the real
+# ``OSError`` a full/failing disk would produce (ENOSPC/EIO, with the
+# target path attached) — so every degrade path upstream (store
+# memory-only fallback, ledger flush skip, WAL append/snapshot degrade)
+# is exercised against the exact exception shape of the real fault,
+# deterministically, without filling a filesystem.  Ops are the writer
+# family's names: ``save_pytree``, ``atomic_write_text``,
+# ``atomic_write_json``, ``append_jsonl``.
+
+_DISK_FAULTS: dict = {}          # op -> {"errno", "count", "match"}
+_DISK_FAULT_LOCK = threading.Lock()
+_DISK_FAULT_TLS = threading.local()
+
+
+def arm_disk_fault(op: str, kind: str = "ENOSPC", count: int = 1,
+                   match: str = "") -> None:
+    """Arm the next ``count`` ``op`` writes (optionally only on paths
+    containing ``match``) to raise ``OSError(errno.<kind>)``."""
+    code = getattr(errno, str(kind).upper(), None)
+    if code is None:
+        raise ValueError(f"unknown errno name {kind!r}")
+    with _DISK_FAULT_LOCK:
+        _DISK_FAULTS[str(op)] = {"errno": int(code),
+                                 "count": max(0, int(count)),
+                                 "match": str(match)}
+
+
+def disarm_disk_faults() -> None:
+    """Drop every armed fault (drill teardown; idempotent)."""
+    with _DISK_FAULT_LOCK:
+        _DISK_FAULTS.clear()
+
+
+def _fire_disk_fault(op: str, path: str, code: int) -> None:
+    """The injection seam (covered by ``check_obs_events``): journal
+    ``DISK_FAULT`` for the detection ledger, then raise the fault —
+    callers see exactly what a real full/failing disk throws."""
+    kind = errno.errorcode.get(code, str(code))
+    _DISK_FAULT_TLS.active = True     # the event append must not re-fault
+    try:
+        from ..obs.runtime import emit_event
+
+        emit_event("DISK_FAULT", op=str(op), path=str(path),
+                   errno=int(code), kind=kind, injected=True)
+    finally:
+        _DISK_FAULT_TLS.active = False
+    raise OSError(code, f"injected disk fault ({kind})", str(path))
+
+
+def _maybe_disk_fault(op: str, path: str) -> None:
+    if not _DISK_FAULTS or getattr(_DISK_FAULT_TLS, "active", False):
+        return
+    with _DISK_FAULT_LOCK:
+        plan = _DISK_FAULTS.get(op)
+        if (plan is None or plan["count"] <= 0
+                or plan["match"] not in str(path)):
+            return
+        plan["count"] -= 1
+        code = plan["errno"]
+    _fire_disk_fault(op, path, code)
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the directory holding ``path`` so a rename/create itself
+    survives power loss (the second half of a durable write)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return      # e.g. a platform that cannot open directories
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 class CheckpointMismatchError(ValueError):
     """A checkpoint was written by a different run (seed or config
     fingerprint mismatch) and resuming from it is refused.  A typed
@@ -34,10 +117,13 @@ class CheckpointMismatchError(ValueError):
     raise."""
 
 
-def save_pytree(path: str, tree) -> None:
+def save_pytree(path: str, tree, durable: bool = False) -> None:
     """Write a pytree of arrays/scalars to ``path`` (npz, atomic rename).
     The treedef repr rides along so a load against the wrong template is a
-    hard error, not a silent leaf reinterpretation."""
+    hard error, not a silent leaf reinterpretation.  ``durable=True``
+    additionally fsyncs the bytes and the directory entry (ISSUE 18) —
+    crash-consistency against POWER LOSS, not just process death."""
+    _maybe_disk_fault("save_pytree", path)
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     arrays = {f"leaf_{i:06d}": np.asarray(leaf)
               for i, leaf in enumerate(leaves)}
@@ -48,41 +134,55 @@ def save_pytree(path: str, tree) -> None:
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **arrays)
+            if durable:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, path)
+        if durable:
+            _fsync_dir(path)
     except BaseException:
         if os.path.exists(tmp):
             os.remove(tmp)
         raise
 
 
-def _atomic_write_text(path: str, text: str, suffix: str) -> None:
+def _atomic_write_text(path: str, text: str, suffix: str,
+                       durable: bool = False) -> None:
     """tmp + ``os.replace`` in the target's directory — the same
     crash-consistency discipline as ``save_pytree``: a kill at any point
-    leaves either the old file or the new one, never a truncated hybrid."""
+    leaves either the old file or the new one, never a truncated hybrid.
+    ``durable=True`` fsyncs file + directory (power-loss durability)."""
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=suffix)
     try:
         with os.fdopen(fd, "w") as f:   # atomic-ok: the blessed writer
             f.write(text)
+            if durable:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, path)
+        if durable:
+            _fsync_dir(path)
     except BaseException:
         if os.path.exists(tmp):
             os.remove(tmp)
         raise
 
 
-def atomic_write_text(path: str, text: str) -> None:
+def atomic_write_text(path: str, text: str, durable: bool = False) -> None:
     """Crash-consistent replacement for ``open(path, "w").write(text)``
     on artifact paths (sentinels, runtime summaries): see
     ``atomic_write_json`` for why bare writes are banned
     (``scripts/check_atomic_writes.py`` enforces it)."""
-    _atomic_write_text(path, text, suffix=".txt.tmp")
+    _maybe_disk_fault("atomic_write_text", path)
+    _atomic_write_text(path, text, suffix=".txt.tmp", durable=durable)
 
 
 def atomic_write_json(path: str, obj, indent: int = 2,
                       sort_keys: bool = False,
-                      trailing_newline: bool = True) -> None:
+                      trailing_newline: bool = True,
+                      durable: bool = False) -> None:
     """Crash-consistent JSON artifact write (tmp + ``os.replace``).
 
     Entry points used to write records with bare ``open(path, "w")`` +
@@ -94,12 +194,13 @@ def atomic_write_json(path: str, obj, indent: int = 2,
     in."""
     import json
 
+    _maybe_disk_fault("atomic_write_json", path)
     text = json.dumps(obj, indent=indent, sort_keys=sort_keys)
     _atomic_write_text(path, text + ("\n" if trailing_newline else ""),
-                       suffix=".json.tmp")
+                       suffix=".json.tmp", durable=durable)
 
 
-def append_jsonl(path: str, lines) -> None:
+def append_jsonl(path: str, lines, durable: bool = False) -> None:
     """Append-safe JSONL writer — the APPEND member of the atomic-writer
     family (the ``atomic_write_*`` functions replace whole files; a
     journal/bench record stream must instead grow without rewriting its
@@ -114,17 +215,28 @@ def append_jsonl(path: str, lines) -> None:
     file) never interleave bytes within a line.  Bare append-mode
     ``open`` is banned by ``scripts/check_atomic_writes.py`` for the
     same reason bare ``"w"`` is: a buffered handle flushes a long line
-    in chunks, and a SIGTERM between chunks tears mid-record."""
+    in chunks, and a SIGTERM between chunks tears mid-record.
+
+    ``durable=True`` (ISSUE 18) fsyncs the descriptor after the batch —
+    and the directory entry when this call CREATED the file — so a
+    write-ahead log's acknowledged records survive power loss, not just
+    process death."""
+    _maybe_disk_fault("append_jsonl", path)
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
+    created = durable and not os.path.exists(path)
     fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
     try:
         for line in lines:
             if not line.endswith("\n"):
                 line += "\n"
             os.write(fd, line.encode("utf-8"))
+        if durable:
+            os.fsync(fd)
     finally:
         os.close(fd)
+    if created:
+        _fsync_dir(path)
 
 
 # -- fleet leases (ISSUE 15, DESIGN §14) ------------------------------------

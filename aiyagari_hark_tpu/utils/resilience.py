@@ -566,11 +566,25 @@ class LedgerState:
         self.checksums[cell] = packed_row_checksum(row)
 
     def flush(self) -> None:
-        save_pytree(self.path, SweepLedger(
-            packed=self.packed, solved=self.solved, bucket=self.bucket,
-            pred=self.pred, retries=self.retries, retried=self.retried,
-            checksums=self.checksums,
-            fingerprint=np.asarray(self.fingerprint, np.int64)))
+        """Persist the ledger.  A disk fault (ENOSPC/EIO — injected or
+        real, ISSUE 18) SKIPS the flush loudly instead of killing the
+        sweep: the in-memory ledger stays authoritative, the solve
+        continues, and only resume-after-crash coverage is degraded
+        until the next flush succeeds."""
+        try:
+            save_pytree(self.path, SweepLedger(
+                packed=self.packed, solved=self.solved, bucket=self.bucket,
+                pred=self.pred, retries=self.retries, retried=self.retried,
+                checksums=self.checksums,
+                fingerprint=np.asarray(self.fingerprint, np.int64)))
+        except OSError as e:
+            emit_event("DISK_FAULT", op="ledger_flush", path=self.path,
+                       error=str(e), injected=False)
+            warnings.warn(
+                f"sweep ledger flush to {self.path} failed ({e}); "
+                "skipping this flush — the sweep continues from memory "
+                "and resume coverage lags until a flush lands",
+                stacklevel=3)
 
     def complete(self) -> None:
         try:

@@ -2820,6 +2820,322 @@ def _chaos_smoke() -> dict:
     return record
 
 
+# DR smoke (ISSUE 18): five drill cells disjoint from BOTH the traffic
+# lattice (labor_sd 0.2) and the chaos drill cells (0.25), one per
+# disaster-recovery drill; the mid-solve kill uses a sixth.
+DR_DRILL_CELLS = tuple((s, r, 0.3) for (s, r) in
+                       ((1.0, 0.0), (3.0, 0.3), (5.0, 0.6),
+                        (1.0, 0.9), (3.0, 0.9)))
+DR_KILL_CELL = (5.0, 0.0, 0.3)
+
+
+def _dr_smoke() -> dict:
+    """The ``--dr-smoke`` acceptance run (ISSUE 18, DESIGN §16): 4
+    worker processes coordinate through a 3-replica WAL-backed quorum
+    CAS (real processes, real sockets) while serving the 12-cell golden
+    lattice; the disaster-recovery drills attack the substrate —
+    replica SIGKILL, torn WAL tail, ENOSPC at a snapshot write, a
+    minority-then-majority client partition, ENOSPC at a store publish
+    — and then the WHOLE fleet (workers and replicas, one of them
+    holding a live mid-solve lease) is SIGKILLed.  Measured acceptance:
+    every replica restarts to a BIT-identical CAS record map (WAL +
+    snapshot replay, compared over the public ``dump`` op), the
+    orphaned lease TTL-reclaims through the recovered state, the
+    restarted fleet re-serves every lattice cell bit-identically with
+    dedup 1.0 (drill duplicates separated), zero leaked leases / hung
+    arrivals, and every injected fault detected from public artifacts."""
+    import signal
+    import tempfile
+    import threading
+
+    from aiyagari_hark_tpu.obs.journal import read_journal
+    from aiyagari_hark_tpu.obs.regress import (
+        SEVERITY_NAMES,
+        evaluate_history,
+        load_bench_history,
+    )
+    from aiyagari_hark_tpu.serve.chaos import (DRPlan, _poll_until,
+                                               run_dr_drills)
+    from aiyagari_hark_tpu.serve.lease import (LoopbackCASBackend,
+                                               make_backend)
+    from aiyagari_hark_tpu.serve.loadgen import (FleetCtl, FleetSpec,
+                                                 _spawn_fleet)
+    from aiyagari_hark_tpu.serve.replicated import ReplicaSet
+    from aiyagari_hark_tpu.serve.store import SolutionStore
+
+    kw = dict(SERVE_SMOKE_KWARGS)
+    served_values: dict = {}
+    divergence = 0
+    served = arrivals = errors = 0
+
+    def _note(cell, res) -> None:
+        nonlocal divergence, served
+        served += 1
+        key = int(res["key"])
+        vals = {"cell": tuple(float(c) for c in cell),
+                "r_star": res["r_star"], "capital": res["capital"],
+                "labor": res["labor"], "status": res["status"],
+                "bracket_init": res.get("bracket_init")}
+        prior = served_values.get(key)
+        if prior is not None and (prior["r_star"], prior["labor"],
+                                  prior["status"]) != (
+                vals["r_star"], vals["labor"], vals["status"]):
+            divergence += 1
+        served_values.setdefault(key, vals)
+
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory() as td:
+        store_dir = os.path.join(td, "store")
+        os.makedirs(store_dir)
+        with ReplicaSet(os.path.join(td, "replicas"), n=3,
+                        snapshot_every=16) as replicas:
+            spec = FleetSpec(cells=FLEET_SMOKE_CELLS, model_kwargs=kw,
+                             n_workers=4, queries_per_worker=0,
+                             seed=20260807, lease_ttl_s=2.0,
+                             lease_backend=replicas.spec)
+            journals1 = [os.path.join(store_dir, f"journal_w{i}.jsonl")
+                         for i in range(spec.n_workers)]
+            procs, urls = _spawn_fleet(spec, store_dir, journals1,
+                                       ready_timeout_s=180.0, chaos=True)
+            ctl = FleetCtl(spec, procs, urls, journals1, store_dir,
+                           timeout_s=120.0)
+            drill_info = None
+            kill_parked = False
+            try:
+                # phase 1: every lattice cell cold, then again as hits
+                # from a different worker (quorum election + remote
+                # serves, all through the replicated CAS)
+                for rnd in range(2):
+                    for j, cell in enumerate(FLEET_SMOKE_CELLS):
+                        arrivals += 1
+                        try:
+                            _note(cell, ctl.query(
+                                cell, prefer=(j + rnd) % spec.n_workers))
+                        except Exception:
+                            errors += 1
+
+                # the DR drill campaign against the live substrate
+                plan = DRPlan(drill_cells=DR_DRILL_CELLS,
+                              settle_timeout_s=60.0)
+                drill_info = run_dr_drills(plan, ctl, replicas)
+
+                # full-fleet SIGKILL with a LIVE lease in flight: worker
+                # 0 holds DR_KILL_CELL's lease inside an armed publish
+                # delay when everything dies at once
+                ctl.post(0, "/chaos", {"slow_publish_s": 12.0,
+                                       "slow_cells": [list(DR_KILL_CELL)]})
+                parked: dict = {}
+
+                def _park():
+                    try:
+                        parked["res"] = ctl.query(DR_KILL_CELL, prefer=0)
+                    except Exception as e:
+                        parked["err"] = e
+
+                pt = threading.Thread(target=_park, name="dr-park")
+                pt.start()
+                kill_parked = _poll_until(
+                    lambda: ctl.fleet_info(0) is not None
+                    and len(ctl.fleet_info(0)["held_leases"]) > 0, 10.0)
+                for p in procs:
+                    p.send_signal(signal.SIGKILL)
+                for p in procs:
+                    p.wait(30.0)
+                pt.join(60.0)
+            except BaseException:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                raise
+
+            # the coordination tier's own disaster: capture each
+            # replica's record map over the public wire, SIGKILL all
+            # replicas, restart, and demand BIT-identical recovery
+            dumps_before = []
+            for port in replicas.ports:
+                cli = LoopbackCASBackend(f"127.0.0.1:{port}")
+                dumps_before.append(sorted(map(tuple, cli.dump())))
+                cli.close()
+            replicas.kill_all(signal.SIGKILL)
+            t_rec0 = time.perf_counter()
+            for i in range(replicas.n):
+                replicas.restart(i)
+            state_mismatches = 0
+            recovered_keys = 0
+            for i, port in enumerate(replicas.ports):
+                cli = LoopbackCASBackend(f"127.0.0.1:{port}")
+                after = sorted(map(tuple, cli.dump()))
+                cli.close()
+                recovered_keys = max(recovered_keys, len(after))
+                if after != dumps_before[i]:
+                    state_mismatches += 1
+
+            # phase 2: a fresh worker pool over the SAME store + the
+            # recovered quorum — the orphaned mid-solve lease must
+            # TTL-reclaim through the recovered record, and every
+            # lattice cell must re-serve bit-identically
+            journals2 = [os.path.join(store_dir, f"journal_r{i}.jsonl")
+                         for i in range(spec.n_workers)]
+            procs2, urls2 = _spawn_fleet(spec, store_dir, journals2,
+                                         ready_timeout_s=180.0)
+            ctl2 = FleetCtl(spec, procs2, urls2, journals1 + journals2,
+                            store_dir, timeout_s=120.0)
+            try:
+                arrivals += 1
+                res_orphan = ctl2.query(DR_KILL_CELL)
+                _note(DR_KILL_CELL, res_orphan)
+                orphan_key = int(res_orphan["key"])
+                orphan_reclaimed = any(
+                    ev.get("key") == orphan_key
+                    for jp in journals2 if os.path.exists(jp)
+                    for ev in read_journal(jp,
+                                           event="FLEET_LEASE_RECLAIM"))
+                for j, cell in enumerate(FLEET_SMOKE_CELLS):
+                    arrivals += 1
+                    try:
+                        _note(cell, ctl2.query(
+                            cell, prefer=j % spec.n_workers))
+                    except Exception:
+                        errors += 1
+                recovery_wall = time.perf_counter() - t_rec0
+            except BaseException:
+                for p in procs2:
+                    if p.poll() is None:
+                        p.kill()
+                raise
+
+            # lease-leak audit against the SAME recovered quorum the
+            # workers used, then graceful shutdown
+            for p in procs2:
+                p.send_signal(signal.SIGTERM)
+            for p in procs2:
+                try:
+                    p.wait(60.0)
+                except Exception:
+                    p.kill()
+                    p.wait()
+            audit = SolutionStore(
+                disk_path=store_dir, shared=True, lease_ttl_s=2.0,
+                owner="dr-audit",
+                lease_backend=make_backend(replicas.spec,
+                                           root=store_dir))
+            deadline0 = time.perf_counter()
+            while (audit.lease_files()
+                   and time.perf_counter() - deadline0 < 12.0):
+                audit.gc_stale_leases()
+                if audit.lease_files():
+                    time.sleep(0.2)
+            leaked = len(audit.lease_files())
+            reclaims = audit.fleet_counts().get("fleet_lease_reclaims", 0)
+            audit.close()
+
+            # substrate event accounting from the replica journals
+            wal_replays = snapshot_compacts = 0
+            for jp in replicas.journals:
+                if os.path.exists(jp):
+                    wal_replays += len(read_journal(jp,
+                                                    event="WAL_REPLAY"))
+                    snapshot_compacts += len(read_journal(
+                        jp, event="SNAPSHOT_COMPACT"))
+
+            # publish ledger for the dedup accounting — read BEFORE the
+            # temp dir (and the journals in it) evaporates
+            pub_counts: dict = {}
+            for jp in journals1 + journals2:
+                if not os.path.exists(jp):
+                    continue
+                for ev in read_journal(jp, event="FLEET_PUBLISH"):
+                    k = int(ev["key"])
+                    pub_counts[k] = pub_counts.get(k, 0) + 1
+    wall = time.perf_counter() - t0
+
+    mismatches, seeded = _served_vs_reference(served_values, kw)
+
+    # dedup over the CLEAN ledger: drill keys (whose expected
+    # duplicates are the drills' own doing) get their own accounting
+    drill_keys = set(drill_info["drill_keys"])
+    expected_dup = set(drill_info["expected_dup_keys"])
+    clean = {k: n for k, n in pub_counts.items() if k not in drill_keys}
+    dedup_ratio = (round(sum(clean.values()) / len(clean), 6)
+                   if clean else None)
+    drill_violations = sum(
+        n - 1 for k, n in pub_counts.items()
+        if k in drill_keys and k not in expected_dup and n > 1)
+
+    drills_ok = all(r["detected"] == r["injected"]
+                    for r in drill_info["drills"])
+    unresolved = errors   # every arrival either returned or raised typed
+    record = {
+        "metric": "dr_smoke",
+        "backend": __import__("jax").default_backend(),
+        "dr_replicas": 3,
+        "dr_workers": spec.n_workers,
+        "dr_arrivals": arrivals,
+        "dr_wall_s": round(wall, 3),
+        "dr_served": served,
+        "dr_unresolved": unresolved,
+        # acceptance: every DR fault detected from public artifacts
+        "dr_drills_injected": drill_info["injected"],
+        "dr_drills_detected": drill_info["detected"],
+        "dr_detect_all": drills_ok,
+        **{f"dr_detected_{r['drill']}": int(r["detected"])
+           for r in drill_info["drills"]},
+        # acceptance: the full-fleet kill recovered — every replica's
+        # record map BIT-identical over the public dump op, the
+        # orphaned mid-solve lease reclaimed through recovered state
+        "dr_state_mismatches": state_mismatches,
+        "dr_state_reference_equal": state_mismatches == 0,
+        "dr_recovered_keys": recovered_keys,
+        "dr_kill_lease_observed": kill_parked,
+        "dr_orphan_reclaimed": orphan_reclaimed,
+        "dr_recovery_wall_s": round(recovery_wall, 3),
+        "dr_wal_replays": wal_replays,
+        "dr_snapshot_compacts": snapshot_compacts,
+        # acceptance: exactly-once across the disaster (clean ledger)
+        "dr_dedup_ratio": dedup_ratio,
+        "dr_dedup_exact": dedup_ratio == 1.0,
+        "dr_drill_dup_violations": drill_violations,
+        # acceptance: zero leaked leases against the recovered quorum
+        "dr_leases_leaked": leaked,
+        "dr_reclaims": reclaims,
+        # acceptance: bit-identity against same-seed reference solves
+        "dr_bit_identical": mismatches == 0 and divergence == 0,
+        "dr_value_mismatches": mismatches,
+        "dr_value_divergence": divergence,
+        "dr_seeded_compares": seeded,
+    }
+    history = load_bench_history(_repo_dir()) + [("dr_smoke", record)]
+    report = evaluate_history(history)
+    dr_regressed = [f.metric for f in report.regressed()
+                    if f.metric.startswith("dr_")]
+    record["dr_sentinel_clean"] = not dr_regressed
+    record["dr_sentinel_worst"] = SEVERITY_NAMES[report.worst]
+
+    print(f"[bench] dr smoke: 3 replicas / {spec.n_workers} workers, "
+          f"{arrivals} arrivals -> {served} served, drills "
+          f"{drill_info['detected']}/{drill_info['injected']} detected "
+          f"{dict((r['drill'], r['detected']) for r in drill_info['drills'])}, "
+          f"full-fleet kill: state_equal="
+          f"{'OK' if state_mismatches == 0 else 'MISMATCH'} "
+          f"({recovered_keys} keys, {wal_replays} replays, "
+          f"{snapshot_compacts} compactions), orphan_reclaimed="
+          f"{orphan_reclaimed}, recovery {record['dr_recovery_wall_s']}s,"
+          f" dedup {dedup_ratio} (violations {drill_violations}), "
+          f"bit-identical="
+          f"{'OK' if record['dr_bit_identical'] else 'MISMATCH'}, "
+          f"leaked={leaked} unresolved={unresolved}",
+          file=sys.stderr)
+    ok = (drills_ok and state_mismatches == 0 and kill_parked
+          and orphan_reclaimed and dedup_ratio == 1.0
+          and drill_violations == 0 and leaked == 0 and unresolved == 0
+          and record["dr_bit_identical"] and wal_replays >= 3
+          and snapshot_compacts >= 1)
+    if not ok:
+        print("[bench] dr smoke: ACCEPTANCE FAILED — see the dr_* "
+              "fields above", file=sys.stderr)
+    return record
+
+
 # Chips-scaling smoke (ISSUE 11): the multi-chip tentpole, measured — the
 # same balanced sweep dispatched through the shard_map launcher at mesh
 # sizes 1/2/4/8 ('cells' axis), on real chips when an accelerator answers
@@ -3301,6 +3617,21 @@ def main(argv=None):
                          "dedup ratio back to 1.0, zero leaked leases, "
                          "bit-identical served values) and emit the "
                          "chaos_* record instead of the full bench")
+    ap.add_argument("--dr-smoke", action="store_true",
+                    help="run the disaster-recovery smoke (ISSUE 18: 4 "
+                         "workers coordinate through a 3-replica "
+                         "WAL-backed quorum CAS serving the 12-cell "
+                         "golden lattice while the DR drills fire — "
+                         "replica SIGKILL, torn WAL tail, ENOSPC at a "
+                         "snapshot write, minority/majority partition, "
+                         "disk-full publish — then the FULL fleet is "
+                         "SIGKILLed with a live lease in flight; every "
+                         "replica must restart bit-identical from "
+                         "WAL+snapshot, the orphaned lease TTL-reclaim, "
+                         "every cell re-serve bit-identically with "
+                         "dedup 1.0 and zero leaked leases, detected == "
+                         "injected from public artifacts) and emit the "
+                         "dr_* record instead of the full bench")
     ap.add_argument("--surrogate-smoke", action="store_true",
                     help="run the surrogate serving-tier smoke (ISSUE "
                          "17: certified 12-cell lattice warmed, seeded "
@@ -3353,13 +3684,14 @@ def main(argv=None):
             or args.profile_smoke or args.chips_scaling
             or args.compaction_smoke or args.kernel_smoke
             or args.fleet_smoke or args.chaos_smoke
-            or args.surrogate_smoke):
+            or args.dr_smoke or args.surrogate_smoke):
         from aiyagari_hark_tpu.utils.backend import (
             enable_compilation_cache,
         )
 
         enable_compilation_cache()
         smoke = (_surrogate_smoke if args.surrogate_smoke
+                 else _dr_smoke if args.dr_smoke
                  else _chaos_smoke if args.chaos_smoke
                  else _fleet_smoke if args.fleet_smoke
                  else _kernel_smoke if args.kernel_smoke

@@ -72,6 +72,13 @@ _SAVEZ = re.compile(r"\bnp\.savez(?:_compressed)?\s*\(\s*(?!f\b)")
 # anything else needs a ``# atomic-ok`` waiver stating why it is safe.
 _OS_OPEN_W = re.compile(
     r"\bos\.open\s*\([^)]*\bO_(?:WRONLY|RDWR|CREAT|APPEND|TRUNC)\b")
+# Raw fsync (ISSUE 18): durability is the blessed writers' job — their
+# ``durable=True`` path fsyncs the file AND its parent directory in the
+# one order that survives a crash (data, rename, directory).  A raw
+# ``os.fsync`` elsewhere is either redundant or, worse, a half-durable
+# write that LOOKS safe in review; route it through the writers or waive
+# with '# atomic-ok' stating why the bare sync is correct.
+_OS_FSYNC = re.compile(r"\bos\.fsync\s*\(")
 
 
 def scan_file(path: str, rel: str) -> list:
@@ -103,6 +110,13 @@ def scan_file(path: str, rel: str) -> list:
                      "utils.checkpoint writers (append_jsonl, "
                      "acquire_lease, atomic_write_*), or waive with "
                      "'# atomic-ok'"))
+            elif _OS_FSYNC.search(line):
+                findings.append(
+                    (rel, lineno,
+                     "raw os.fsync — pass durable=True to the blessed "
+                     "utils.checkpoint writers (they sync file AND "
+                     "parent directory in crash-safe order), or waive "
+                     "with '# atomic-ok'"))
     return findings
 
 

@@ -55,14 +55,21 @@ TYPED_ERRORS = {
     "Overloaded",
     "LoadShed",
     "CircuitOpen",
+    # coordination family (ISSUE 18): quorum loss on the replicated CAS
+    # — a raise without its QUORUM_LOST trail would make every
+    # partition drill's detection ledger unfalsifiable
+    "CoordinationUnavailable",
 }
 
 # Calls that count as journal-emission evidence in the enclosing
 # function: the module-level hook (``obs.runtime.emit_event``), a
-# bundle/journal method (``obs.event`` / ``journal.emit``), and the
+# bundle/journal method (``obs.event`` / ``journal.emit``), the
 # store's emission wrapper (``_record_eviction`` — itself in SEAM_DEFS,
-# so its own emit cannot silently disappear).
-EMIT_NAMES = {"emit", "emit_event", "event", "_record_eviction"}
+# so its own emit cannot silently disappear), and the durable/replicated
+# CAS backends' wrapper (``_emit``, ISSUE 18 — routes to the attached
+# obs bundle or the module hook; its body calls ``event``/``emit_event``
+# directly, so the lint still sees through it).
+EMIT_NAMES = {"emit", "emit_event", "event", "_record_eviction", "_emit"}
 
 # Recovering seams (no error escapes, so the construction rule cannot
 # see them) that must emit anyway: quarantine/retry/evict sites.  The
@@ -84,10 +91,21 @@ EMIT_NAMES = {"emit", "emit_event", "event", "_record_eviction"}
 # surrogate-escalation seam (``_surrogate_escalate`` must journal
 # SURROGATE_ESCALATED — the query recovers by falling through to a real
 # solve, so the construction rule cannot see it).
+# ISSUE 18 additions — durability/DR seams that recover instead of
+# raising: the disk-fault injector's firing site (``_fire_disk_fault``
+# must journal DISK_FAULT — the drills' injected side), WAL replay and
+# snapshot compaction (``_recover_state`` → WAL_REPLAY, ``_compact`` →
+# SNAPSHOT_COMPACT), the replicated backend's quorum-loss and
+# convergence seams (``_quorum_lost`` → QUORUM_LOST, ``_read_repair`` /
+# ``_resync_replica`` → REPLICA_RESYNC), and the store's memory-only
+# degrade path (``_degrade_memory_only`` → STORE_DEGRADED).
 SEAM_DEFS = {"_evict_corrupt", "_record_eviction", "retry_transient",
              "_run_sweep_impl", "dump_flight", "evaluate_history",
              "_backend_fault", "fire",
-             "_index_rebuilt", "_surrogate_escalate"}
+             "_index_rebuilt", "_surrogate_escalate",
+             "_fire_disk_fault", "_recover_state", "_compact",
+             "_quorum_lost", "_read_repair", "_resync_replica",
+             "_degrade_memory_only"}
 
 
 def _call_name(node: ast.Call):

@@ -264,6 +264,23 @@ def test_check_atomic_writes_lint_catches_raw_os_open(tmp_path):
     assert [line for _, line, _ in findings] == [1, 2, 3]
 
 
+def test_check_atomic_writes_lint_catches_raw_fsync(tmp_path):
+    """ISSUE 18 satellite: raw ``os.fsync`` joined the ban — durability
+    belongs to the blessed writers' ``durable=True`` path (file AND
+    parent directory, in crash-safe order); a bare fsync elsewhere is a
+    half-durable write that looks safe in review."""
+    mod, _ = _load_lint()
+    bad = tmp_path / "syncer.py"
+    bad.write_text(
+        'os.fsync(fd)\n'
+        'os.fsync(f.fileno())  # atomic-ok: test-only barrier\n'
+        # the read spelling must NOT fire
+        'os.fstat(fd)\n')
+    findings = mod.scan_file(str(bad), "syncer.py")
+    assert [line for _, line, _ in findings] == [1]
+    assert "durable=True" in findings[0][2]
+
+
 def test_check_atomic_writes_covers_fleet_modules():
     """ISSUE 15 satellite: the fleet tier's modules (lease/publish
     writers, the HTTP worker) are inside the lint's scope — pinned

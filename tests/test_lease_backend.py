@@ -31,9 +31,12 @@ from aiyagari_hark_tpu.serve.lease import (
     key_from_hex,
     make_backend,
 )
+from aiyagari_hark_tpu.serve.replicated import ReplicatedCASBackend
 from aiyagari_hark_tpu.utils.fingerprint import fingerprint_hex
 
-BACKENDS = ("shared-dir", "memory-cas", "loopback-cas")
+# ISSUE 18 adds the quorum client over three loopback replicas: the SAME
+# election semantics must hold when "the backend" is a majority vote.
+BACKENDS = ("shared-dir", "memory-cas", "loopback-cas", "replicated-cas")
 
 
 class _Harness:
@@ -71,6 +74,12 @@ def _make_harness(kind, tmp_path, skew_tolerance_s=0.0):
         srv = CASServer(skew_tolerance_s=skew_tolerance_s).start()
         b = LoopbackCASBackend(srv.address)
         return _Harness(b, b.backdate, cleanup=[srv.stop])
+    if kind == "replicated-cas":
+        srvs = [CASServer(skew_tolerance_s=skew_tolerance_s).start()
+                for _ in range(3)]
+        b = ReplicatedCASBackend([s.address for s in srvs],
+                                 skew_tolerance_s=skew_tolerance_s)
+        return _Harness(b, b.backdate, cleanup=[s.stop for s in srvs])
     raise AssertionError(kind)
 
 
@@ -252,10 +261,15 @@ def test_make_backend_spellings(tmp_path):
     cas = make_backend("cas:127.0.0.1:1")
     assert isinstance(cas, LoopbackCASBackend)
     cas.close()
+    rep = make_backend("replicated:127.0.0.1:1,127.0.0.1:2,127.0.0.1:3")
+    assert isinstance(rep, ReplicatedCASBackend)
+    rep.close()
     with pytest.raises(ValueError):
         make_backend("dir")               # needs a root
     with pytest.raises(ValueError):
         make_backend("zookeeper:foo")
+    with pytest.raises(ValueError):
+        make_backend("replicated:127.0.0.1:1,127.0.0.1:2")  # even count
 
 
 # -- two REAL processes race the same election ------------------------------
@@ -309,3 +323,18 @@ def test_two_process_claim_race_loopback_cas(tmp_path):
     with CASServer() as srv:
         _race_two_processes(f"cas:{srv.address}", "-", tmp_path)
         assert sorted(srv.backend.list_keys()) == list(range(1, 25))
+
+
+def test_two_process_claim_race_replicated_cas(tmp_path):
+    # Exactly-once must survive TWO quorum clients in different
+    # interpreters racing the same 3-replica set: the decision point is
+    # each replica's server-side conditional write, majority-voted.
+    srvs = [CASServer().start() for _ in range(3)]
+    try:
+        spec = "replicated:" + ",".join(s.address for s in srvs)
+        _race_two_processes(spec, "-", tmp_path)
+        for s in srvs:
+            assert sorted(s.backend.list_keys()) == list(range(1, 25))
+    finally:
+        for s in srvs:
+            s.stop()

@@ -145,3 +145,41 @@ def test_lint_requires_emit_in_index_and_surrogate_seams():
         "def _surrogate_escalate(self, q, reason):\n"
         "    self._obs.event('SURROGATE_ESCALATED', reason=reason)\n"
         "    return reason\n", "fixture.py") == []
+
+
+def test_lint_requires_emit_in_durability_seams():
+    """The ISSUE 18 seams — WAL replay, snapshot compaction, quorum
+    loss, resync, disk-fault firing, store degrade — are the DR drills'
+    detection evidence: stripping any of their emits must be a lint
+    failure, structurally."""
+    mod, _ = _load_lint()
+    for seam in ("_fire_disk_fault", "_recover_state", "_compact",
+                 "_quorum_lost", "_read_repair", "_resync_replica",
+                 "_degrade_memory_only"):
+        assert seam in mod.SEAM_DEFS, seam
+    findings = mod.scan_source(
+        "def _recover_state(self):\n"
+        "    self._seq = 7\n", "fixture.py")
+    assert len(findings) == 1 and "seam function" in findings[0][2]
+    # the backends' ``_emit`` wrapper counts as emission evidence
+    assert mod.scan_source(
+        "def _compact(self):\n"
+        "    self._emit('SNAPSHOT_COMPACT', seq=self._seq)\n",
+        "fixture.py") == []
+
+
+def test_lint_fires_on_unjournaled_coordination_unavailable():
+    """``CoordinationUnavailable`` joined TYPED_ERRORS: constructing it
+    without a journal event is a finding (the partition drills' ledger
+    would otherwise be unfalsifiable)."""
+    mod, _ = _load_lint()
+    assert "CoordinationUnavailable" in mod.TYPED_ERRORS
+    findings = mod.scan_source(
+        "def read(self):\n"
+        "    raise CoordinationUnavailable('no quorum')\n", "fake.py")
+    assert [line for _, line, _ in findings] == [2]
+    assert mod.scan_source(
+        "def read(self):\n"
+        "    self._emit('QUORUM_LOST', reachable=1)\n"
+        "    raise CoordinationUnavailable('no quorum')\n",
+        "fake.py") == []
