@@ -28,7 +28,8 @@ from ..solver_health import (
     NONFINITE,
     combine_status,
 )
-from ..utils.config import resolve_grid, resolve_kernel, resolve_precision
+from ..utils.config import (resolve_grid, resolve_kernel, resolve_precision,
+                            resolve_state)
 from .household import (
     R_DESCENT_WIDTH_SCALE,
     HouseholdPolicy,
@@ -90,6 +91,7 @@ def household_capital_supply(r, model: SimpleModel, disc_fac, crra,
                              precision: str = "reference",
                              grid="reference",
                              kernel="reference",
+                             state="replicated",
                              descent_fault_iter: int | None = None,
                              descent_fault_mode: str = "nan",
                              ) -> SupplyEval:
@@ -134,12 +136,20 @@ def household_capital_supply(r, model: SimpleModel, disc_fac, crra,
     are then moot and ignored; the coarse-to-fine grid ladder is an
     XLA-path feature, so a compact ``grid`` runs tail-closed without
     it); under a two-phase policy the ladders gain the bf16 descent
-    rung instead (threaded through both inner solvers)."""
+    rung instead (threaded through both inner solvers).
+
+    ``state`` (ISSUE 20, DESIGN §6b) threads the state-sharding policy
+    into both inner solvers; ``state="sharded"`` disables the fused
+    megakernel (a single-device VMEM program by construction — the
+    row-block contraction is what actually shards) and routes the
+    distribution loop through the sharded push-forward."""
     k_to_l = firm.k_to_l_from_r(r, cap_share, depr_fac, prod)
     W = firm.wage_rate(k_to_l, cap_share, prod)
     R = 1.0 + r
     kspec = resolve_kernel(kernel)
-    use_fused = kspec.fused and not resolve_precision(precision).two_phase
+    sharded_state = resolve_state(state).sharded
+    use_fused = (kspec.fused and not resolve_precision(precision).two_phase
+                 and not sharded_state)
     if use_fused and jax.default_backend() in ("tpu", "axon"):
         # the probe gate the policy promises: a Mosaic lowering gap in
         # the fused kernel must degrade to the launch-per-loop XLA
@@ -173,11 +183,11 @@ def household_capital_supply(r, model: SimpleModel, disc_fac, crra,
     policy, egm_it, _, egm_status, egm_ph = solve_household(
         R, W, model, disc_fac, crra, tol=egm_tol, init_policy=init_policy,
         method=egm_method, precision=precision, grid=grid, kernel=kernel,
-        return_phases=True, **egm_kw)
+        state=state, return_phases=True, **egm_kw)
     dist, dist_it, _, dist_status, dist_ph = stationary_wealth(
         policy, R, W, model, tol=dist_tol, init_dist=init_dist,
         method=dist_method, precision=precision, kernel=kernel,
-        return_phases=True, **egm_kw)
+        state=state, return_phases=True, **egm_kw)
     it_dtype = jnp.asarray(egm_it).dtype
     return SupplyEval(aggregate_capital(dist, model), policy, dist, W,
                       k_to_l, egm_it, dist_it,
@@ -284,7 +294,8 @@ def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
                                 dist_tol: float | None = None,
                                 precision: str = "reference",
                                 grid="reference",
-                                kernel="reference") -> EquilibriumResult:
+                                kernel="reference",
+                                state="replicated") -> EquilibriumResult:
     """Bisect r until the capital market clears.
 
     Fully jit-able/vmappable: a fixed-trip ``while_loop`` whose body solves
@@ -301,7 +312,8 @@ def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
         supply = household_capital_supply(
             r, model, disc_fac, crra, cap_share, depr_fac, prod,
             egm_tol=egm_tol, dist_tol=dist_tol,
-            precision=precision, grid=grid, kernel=kernel).supply
+            precision=precision, grid=grid, kernel=kernel,
+            state=state).supply
         demand = firm.k_to_l_from_r(r, cap_share, depr_fac, prod) * labor
         return supply - demand
 
@@ -311,7 +323,7 @@ def solve_bisection_equilibrium(model: SimpleModel, disc_fac, crra,
     ev = household_capital_supply(
         r_star, model, disc_fac, crra, cap_share, depr_fac, prod,
         egm_tol=egm_tol, dist_tol=dist_tol, precision=precision,
-        grid=grid, kernel=kernel)
+        grid=grid, kernel=kernel, state=state)
     supply, wage, k_to_l = ev.supply, ev.wage, ev.k_to_l
     demand = k_to_l * labor
     output = prod * supply ** cap_share * labor ** (1.0 - cap_share)
@@ -364,6 +376,7 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
                            precision: str = "reference",
                            grid="reference",
                            kernel="reference",
+                           state="replicated",
                            fault_iter=None,
                            fault_mode: str = "nan",
                            descent_fault_iter: int | None = None,
@@ -480,7 +493,7 @@ def solve_equilibrium_lean(model: SimpleModel, disc_fac, crra,
                 egm_tol=egm_tol, dist_tol=dist_tol,
                 init_policy=pol, init_dist=dist, dist_method=dist_method,
                 egm_method=egm_method, accel_every=accel_every,
-                precision=prec, grid=grid, kernel=kernel,
+                precision=prec, grid=grid, kernel=kernel, state=state,
                 descent_fault_iter=descent_fault_iter,
                 descent_fault_mode=descent_fault_mode)
         return eval_at

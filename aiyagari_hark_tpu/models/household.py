@@ -782,6 +782,7 @@ def solve_household(R, W, model: SimpleModel, disc_fac, crra,
                     precision: str = "reference",
                     grid="reference",
                     kernel="reference",
+                    state="replicated",
                     return_phases: bool = False,
                     descent_fault_iter: int | None = None,
                     descent_fault_mode: str = "nan"):
@@ -851,12 +852,22 @@ def solve_household(R, W, model: SimpleModel, disc_fac, crra,
     two-phase policy the descent ladder gains the bf16 rung
     (``bf16_rung_active`` — TPU-only, FOC inversion pinned f32, failed
     rung escalates into the same ``escalated`` slot).
+
+    ``state`` (ISSUE 20, ``utils.config.STATE_POLICIES``): validated and
+    threaded for the end-to-end policy contract, but the POLICY iterate
+    itself stays replicated in both layouts — its footprint is
+    O(N·A), dominated ~D²/A-fold by the wealth operator the
+    DISTRIBUTION loop shards (``stationary_wealth(state=)``), so
+    sharding it would add collectives to every EGM step for no memory
+    relief (the partition-rule table reserves the ``policy`` rule for
+    the day a family's policy object outgrows a device).
     """
-    from ..utils.config import resolve_kernel
+    from ..utils.config import resolve_kernel, resolve_state
 
     spec = resolve_precision(precision)
     gspec = resolve_grid(grid)
     kspec = resolve_kernel(kernel)
+    resolve_state(state)   # validate; policy iterate stays replicated
     tail = gspec.compact
     if tail and method in ("pallas", "auto"):
         method = "xla"
@@ -1297,7 +1308,7 @@ def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
                       tol: float = 1e-11, max_iter: int = 20000,
                       init_dist=None, accel_every: int = 64,
                       method: str = "auto", precision: str = "reference",
-                      kernel="reference",
+                      kernel="reference", state="replicated",
                       return_phases: bool = False,
                       descent_fault_iter: int | None = None,
                       descent_fault_mode: str = "nan"):
@@ -1365,15 +1376,49 @@ def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
     on TPU with "dense"/"scatter" fallback; under a two-phase policy the
     ladder gains the bf16 descent rung (TPU-only,
     ``bf16_rung_active``).
+
+    ``state`` (ISSUE 20, DESIGN §6b): "replicated" (default) keeps
+    today's layout, bit-identical.  "sharded" — when a state mesh with
+    ``state`` axis > 1 is ACTIVE (``parallel.mesh.active_state_mesh``;
+    without one the policy degrades to the replicated layout) — routes
+    EVERY push-forward form (scatter, dense, pallas) through the
+    row-block-sharded contraction (``ops.markov.
+    sharded_wealth_push_forward``): the distribution's wealth rows and
+    the dense operator's source blocks live 1/M per device, the fixed
+    point iterates on sharded residents, and one all-reduce per step is
+    the only cross-device traffic.  The wealth grid ``D`` must divide
+    the shard count (typed error otherwise — no silent demotion).  NOT
+    bit-identical to replicated (the row-block reduction order — the
+    ``tiled_wealth_push_forward`` carve-out); quarantine rungs force
+    "replicated".
     """
-    from ..utils.config import resolve_kernel
+    from ..utils.config import resolve_kernel, resolve_state
 
     spec = resolve_precision(precision)
     kspec = resolve_kernel(kernel)
+    sspec = resolve_state(state)
     trans = wealth_transition(policy, R, W, model)
     dist0 = initial_distribution(model) if init_dist is None else init_dist
     d_size = model.dist_grid.shape[0]
     n = model.labor_levels.shape[0]
+    state_mesh_active = None
+    if sspec.sharded:
+        from ..parallel.mesh import (STATE_AXIS, constrain_state,
+                                     current_state_mesh, mesh_axis_size)
+
+        smesh = current_state_mesh()
+        n_state = mesh_axis_size(smesh, STATE_AXIS)
+        if n_state > 1:
+            if d_size % n_state:
+                raise ValueError(
+                    f"state='sharded' needs the wealth grid divisible by "
+                    f"the state axis: D={d_size} rows across {n_state} "
+                    f"state shards (pad the grid or change state_shards)")
+            state_mesh_active = smesh
+            # every engine routes through the ONE sharded contraction:
+            # the scatter form serializes under a sharded carry and the
+            # VMEM kernel is a single-device program by construction
+            method = "dense"
     if kspec.fused and not spec.two_phase and method == "auto":
         from ..ops.pallas_kernels import probe_kernel
         on_tpu = jax.default_backend() in ("tpu", "axon")
@@ -1433,7 +1478,15 @@ def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
         return _with_phases(out, return_phases)
     if method == "dense":
         S = dense_wealth_operator(trans, d_size)
-        push = lambda d: _push_forward_dense(d, S, model.transition)  # noqa: E731
+        if state_mesh_active is not None:
+            from ..ops.markov import sharded_wealth_push_forward
+
+            smesh = state_mesh_active
+            dist0 = constrain_state(dist0, smesh, "distribution")
+            push = lambda d: sharded_wealth_push_forward(  # noqa: E731
+                d, S, model.transition, smesh)
+        else:
+            push = lambda d: _push_forward_dense(d, S, model.transition)  # noqa: E731
     elif method == "scatter":
         push = lambda d: _push_forward(d, trans, model.transition)  # noqa: E731
     else:
@@ -1448,7 +1501,14 @@ def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
     # -- mixed / fast: the two-phase ladder (DESIGN §5) --------------------
     cheap = descent_dtype(dist0.dtype)
     P_c = model.transition.astype(cheap)
-    if method == "dense":
+    if method == "dense" and state_mesh_active is not None:
+        from ..ops.markov import sharded_wealth_push_forward
+
+        S_c = S.astype(cheap)
+        push_cheap = lambda d: sharded_wealth_push_forward(  # noqa: E731
+            d, S_c, P_c, state_mesh_active,
+            matmul_precision=DESCENT_MATMUL_PRECISION)
+    elif method == "dense":
         S_c = S.astype(cheap)
         push_cheap = lambda d: _push_forward_dense(  # noqa: E731
             d, S_c, P_c, matmul_precision=DESCENT_MATMUL_PRECISION)
@@ -1462,7 +1522,14 @@ def stationary_wealth(policy: HouseholdPolicy, R, W, model: SimpleModel,
     if bf16_rung_active(kspec):
         bf16 = jnp.bfloat16   # dtype-ok: the bf16 rung's solver seam
         P_b = model.transition.astype(bf16)
-        if method == "dense":
+        if method == "dense" and state_mesh_active is not None:
+            from ..ops.markov import sharded_wealth_push_forward
+
+            S_b = S.astype(bf16)
+            push_bf16 = lambda d: sharded_wealth_push_forward(  # noqa: E731
+                d, S_b, P_b, state_mesh_active,
+                matmul_precision=DESCENT_MATMUL_PRECISION)
+        elif method == "dense":
             S_b = S.astype(bf16)
             push_bf16 = lambda d: _push_forward_dense(  # noqa: E731
                 d, S_b, P_b, matmul_precision=DESCENT_MATMUL_PRECISION)

@@ -75,6 +75,23 @@ DIRECTION_EXPLICIT: Dict[str, str] = {
     "chips_speedup_4dev": UP,
     "chips_speedup_8dev": UP,
     "chips_mem_stats_devices": NEUTRAL,
+    # state-axis sharding leg (ISSUE 20, bench --state-scaling): the
+    # shard-count-suffixed throughputs defeat the _per_sec suffix rule
+    # (they end in _Mshard), so they are declared here, UP.  The
+    # per-device RESIDENT RATIO (sharded/replicated model resident at
+    # the largest grid, ~1/M) is the tentpole's whole point — DOWN,
+    # overriding the neutral _ratio suffix rule; likewise the ledger-
+    # sourced sharding overhead share overrides the neutral _frac rule,
+    # DOWN.  The drill's grid size and the device-with-memory-stats
+    # count are facts, NEUTRAL; drift resolves DOWN via the _bp suffix
+    # and residents/budget NEUTRAL via _bytes.
+    "state_gridpoints_per_sec_1shard": UP,
+    "state_gridpoints_per_sec_2shard": UP,
+    "state_gridpoints_per_sec_4shard": UP,
+    "state_resident_ratio": DOWN,
+    "state_collective_share_frac": DOWN,
+    "state_overflow_grid": NEUTRAL,
+    "state_mem_stats_devices": NEUTRAL,
     # grid-compaction leg (ISSUE 12, bench --compaction-smoke): the
     # sentinel grades the grid_* record from its first committed round —
     # gridpoints DOWN is good (the compaction's whole point), reductions

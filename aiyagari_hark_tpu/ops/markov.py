@@ -189,6 +189,54 @@ def tiled_wealth_push_forward(dist, S_t, P,
                       preferred_element_type=dist.dtype)
 
 
+# ---------------------------------------------------------------------------
+# State-sharded push-forward (ISSUE 20, DESIGN §6b).
+# ---------------------------------------------------------------------------
+
+def sharded_wealth_push_forward(dist, S, P, mesh,
+                                matmul_precision=jax.lax.Precision.HIGHEST):
+    """One distribution step as a ROW-BLOCK-SHARDED contraction over the
+    state mesh axis (ISSUE 20): each device holds 1/M of the resident
+    distribution's wealth rows (``P("state", None)``) and 1/M of the
+    dense operator's SOURCE-wealth blocks (``P(None, None, "state")``),
+    computes its partial
+
+        moved[d, n] = sum_{k in my block} S[n, d, k] · dist[k, n],
+
+    and GSPMD places the ONE all-reduce per step that the contraction
+    over the sharded ``k`` axis requires; re-constraining the output to
+    row-sharded lets it fuse into a reduce-scatter.  The labor-mixing
+    matmul ``[D, N] × [N, N]`` contracts over the REPLICATED labor axis,
+    so it stays row-sharded with zero communication.  The fixed point
+    therefore iterates on sharded residents — no gather until the solved
+    distribution leaves the loop.
+
+    NOT bit-identical to ``models.household._push_forward_dense``: the
+    row-block contraction reorders the wealth-axis reduction (the same
+    carve-out as ``tiled_wealth_push_forward``), so it runs only under
+    ``state="sharded"`` and the replicated layout stays the default.
+
+    Sharding constraints come from ``parallel.mesh.constrain_state`` (the
+    one seam, per ``scripts/check_mesh_discipline.py``); with ``mesh``
+    None or a degenerate state axis every constraint is a literal no-op
+    and this IS the dense reference contraction.
+
+    Args: ``dist [D, N]``, ``S [N, D, D]``
+    (``models.household.dense_wealth_operator``), ``P [N, N]``.  Returns
+    the next distribution ``[D, N]``."""
+    from ..parallel.mesh import constrain_state
+
+    dist = constrain_state(dist, mesh, "distribution")
+    S = constrain_state(S, mesh, "wealth_operator")
+    moved = jnp.einsum("ndk,kn->dn", S, dist,
+                       precision=matmul_precision,
+                       preferred_element_type=dist.dtype)
+    moved = constrain_state(moved, mesh, "distribution")
+    out = jnp.matmul(moved, P, precision=matmul_precision,
+                     preferred_element_type=dist.dtype)
+    return constrain_state(out, mesh, "distribution")
+
+
 def aggregate_markov_matrix(dur_mean_b: float, dur_mean_g: float,
                             dtype=None) -> jnp.ndarray:
     """2x2 aggregate (Bad/Good) transition matrix from mean state durations

@@ -27,12 +27,22 @@ and through the driver's ``dryrun_multichip``.
 
 from __future__ import annotations
 
+import re
+import threading
+from contextlib import contextmanager
 from functools import lru_cache
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+#: The second, orthogonal mesh axis (ISSUE 20): partitions one cell's
+#: STATE (distribution rows, wealth-operator row blocks) across devices,
+#: where "cells" partitions the sweep lattice.  The 1-D ``cells_mesh``
+#: is the degenerate ``state=1`` case — every pre-existing call site is
+#: bit-identical by construction.
+STATE_AXIS = "state"
 
 
 def make_mesh(axis_names: Sequence[str] = ("cells",),
@@ -42,7 +52,11 @@ def make_mesh(axis_names: Sequence[str] = ("cells",),
 
     With ``axis_sizes=None`` all devices land on the first axis and the rest
     get size 1.  ``axis_sizes`` may leave one entry ``-1`` to absorb the
-    remaining devices (numpy-reshape style).
+    remaining devices (numpy-reshape style).  An impossible grid — more
+    than one ``-1``, or a device count not divisible by the known sizes —
+    raises a ``ValueError`` naming both the requested grid and the device
+    count (ISSUE 20 satellite; previously the multi-``-1`` path fell
+    through to an inscrutable numpy reshape error).
     """
     if devices is None:
         devices = jax.devices()
@@ -50,16 +64,22 @@ def make_mesh(axis_names: Sequence[str] = ("cells",),
     if axis_sizes is None:
         axis_sizes = [n] + [1] * (len(axis_names) - 1)
     axis_sizes = list(axis_sizes)
+    requested = dict(zip(tuple(axis_names), tuple(axis_sizes)))
+    if axis_sizes.count(-1) > 1:
+        raise ValueError(
+            f"mesh {requested} leaves more than one axis -1; at most one "
+            f"axis may absorb the remaining devices")
     if -1 in axis_sizes:
         known = int(np.prod([s for s in axis_sizes if s != -1]))
-        if n % known:
+        if known <= 0 or n % known:
             raise ValueError(
-                f"cannot infer -1 axis: {n} devices not divisible by the "
-                f"known axis sizes (product {known})")
+                f"cannot build mesh {requested} from {n} devices: the "
+                f"device count is not divisible by the known axis sizes "
+                f"(product {known})")
         axis_sizes[axis_sizes.index(-1)] = n // known
     total = int(np.prod(axis_sizes))
     if total > n:
-        raise ValueError(f"mesh {tuple(axis_sizes)} needs {total} devices, "
+        raise ValueError(f"mesh {requested} needs {total} devices, "
                          f"have {n}")
     grid = np.asarray(devices[:total]).reshape(axis_sizes)
     return Mesh(grid, tuple(axis_names))
@@ -73,6 +93,24 @@ def cells_mesh(devices=None, axis: str = "cells") -> Mesh:
     stand-ins.  ``cells_mesh()`` on a 1-device host is a valid (trivial)
     mesh, so callers can pass it unconditionally."""
     return make_mesh((axis,), devices=devices)
+
+
+def state_mesh(state_shards: int, devices=None,
+               axis: str = "cells") -> Optional[Mesh]:
+    """The 2-D ``(cells × state)`` mesh (ISSUE 20 tentpole): all local
+    devices factored into ``n_devices // state_shards`` lane groups of
+    ``state_shards`` state shards each.  ``state_shards=1`` returns the
+    plain 1-D lane mesh (``None`` on a 1-device host) so every existing
+    call site sees exactly the geometry it saw before; a device count not
+    divisible by ``state_shards`` raises the typed ``make_mesh`` error
+    naming both shapes."""
+    state_shards = int(state_shards)
+    if state_shards < 1:
+        raise ValueError(f"state_shards must be >= 1, got {state_shards}")
+    if state_shards == 1:
+        return resolve_mesh("auto", axis=axis)
+    return make_mesh((axis, STATE_AXIS), (-1, state_shards),
+                     devices=devices)
 
 
 def sharding(mesh: Mesh, *spec) -> NamedSharding:
@@ -198,6 +236,95 @@ def balanced_lane_order(work, n_shards: int) -> np.ndarray:
         bins[b].append(int(lane))
         totals[b] += work[lane]
     return np.concatenate([np.asarray(b, dtype=np.int64) for b in bins])
+
+
+# -- state-axis partition rules (ISSUE 20, DESIGN §6b) -----------------------
+#
+# The SNIPPETS [1] ``match_partition_rules`` pattern, scoped to the one
+# tensor family this program needs: NAME the per-cell state tensors, match
+# each name against a regex table, and let GSPMD place the collectives
+# from ``with_sharding_constraint`` annotations.  Shapes (DESIGN §4):
+#
+#   distribution       [D, N]      wealth rows × labor states
+#   wealth_operator    [N, D, D]   S[n, dest, src] — src is the
+#                                  push-forward's contraction axis
+#   policy             [..., K]    consumption knots, asset axis LAST
+#
+# Sharding the operator's SRC axis and the distribution's wealth rows
+# the same way makes the einsum  "ndk,kn->dn"  a row-block contraction:
+# each device holds 1/M of the operator and of the resident distribution
+# and contributes a partial [D, N] product; the ONE all-reduce per step
+# (psum / reduce-scatter, placed by GSPMD) restores the row-sharded
+# iterate.  The labor-mixing matmul [D, N] × [N, N] stays row-sharded
+# with no communication at all.
+
+STATE_PARTITION_RULES = (
+    (r"(^|/)distribution($|/)", PartitionSpec(STATE_AXIS, None)),
+    (r"(^|/)wealth_operator($|/)", PartitionSpec(None, None, STATE_AXIS)),
+    (r"(^|/)policy($|/)", PartitionSpec(None, STATE_AXIS)),
+)
+
+
+def match_partition_rules(name: str) -> PartitionSpec:
+    """``PartitionSpec`` for a named state tensor — first
+    ``STATE_PARTITION_RULES`` regex wins; an unknown name raises typed so
+    a misspelled tensor cannot silently run replicated while the caller
+    believes it is sharded."""
+    for pattern, spec in STATE_PARTITION_RULES:
+        if re.search(pattern, name):
+            return spec
+    known = tuple(p for p, _ in STATE_PARTITION_RULES)
+    raise ValueError(
+        f"no state partition rule matches {name!r}; rules: {known}")
+
+
+def state_sharding(mesh: Mesh, name: str) -> NamedSharding:
+    """``NamedSharding`` for a named state tensor on a state-axis mesh."""
+    return NamedSharding(mesh, match_partition_rules(name))
+
+
+def constrain_state(x, mesh: Optional[Mesh], name: str):
+    """``with_sharding_constraint`` per the partition-rule table — the ONE
+    way solver code pins a state tensor's layout (ISSUE 20).  A no-op
+    (returns ``x`` untouched, zero trace difference) when there is no
+    mesh or the mesh has no state axis of size > 1, which is what keeps
+    the ``"replicated"`` path bit-identical by construction.  Must be
+    applied INSIDE jitted code (the push closures) so the constraint
+    propagates through ``lax.while_loop`` carries."""
+    if mesh is None or mesh_axis_size(mesh, STATE_AXIS) <= 1:
+        return x
+    return jax.lax.with_sharding_constraint(x, state_sharding(mesh, name))
+
+
+# The active state mesh rides a module-level context, not the kwarg
+# plumbing: a ``Mesh`` is unhashable by ``utils.fingerprint.
+# hashable_kwargs`` design (fingerprints hash the POLICY name plus the
+# ledger's ``state_shards`` geometry instead), and threading a mesh
+# through every solver signature would put device objects inside jit
+# cache keys.  Thread-local so fleet workers / serve executors with
+# different meshes cannot race each other's geometry.
+_ACTIVE_STATE = threading.local()
+
+
+@contextmanager
+def active_state_mesh(mesh: Optional[Mesh]):
+    """Activate ``mesh`` as the state-sharding geometry for the dynamic
+    extent of the block (``None`` deactivates).  Solvers running
+    ``state="sharded"`` read it via ``current_state_mesh()``; with no
+    active mesh the sharded policy degrades to the replicated layout
+    (``constrain_state`` no-ops)."""
+    prev = getattr(_ACTIVE_STATE, "mesh", None)
+    _ACTIVE_STATE.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _ACTIVE_STATE.mesh = prev
+
+
+def current_state_mesh() -> Optional[Mesh]:
+    """The mesh installed by the innermost ``active_state_mesh`` block
+    (``None`` outside any block)."""
+    return getattr(_ACTIVE_STATE, "mesh", None)
 
 
 def pad_to_multiple(x, multiple: int, axis: int = 0):

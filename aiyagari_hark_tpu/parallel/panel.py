@@ -13,7 +13,11 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+# The P(...) below are shard_map leaf LAYOUTS for this manual-SPMD
+# region (per-argument specs, not ambient geometry); the Mesh name is
+# only a type annotation — every mesh arrives already built by
+# parallel.mesh.
+from jax.sharding import Mesh, PartitionSpec as P  # mesh-ok: see above
 
 from ..models.ks_model import KSCalibration, KSPolicy
 from ..models.simulate import PanelState, initial_panel, simulate_panel

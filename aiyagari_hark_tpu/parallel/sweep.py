@@ -63,12 +63,16 @@ from ..utils.resilience import (
     retry_transient,
 )
 from .mesh import (
+    STATE_AXIS,
+    active_state_mesh,
     balanced_lane_order,
+    current_state_mesh,
     mesh_axis_size,
     pad_to_multiple,
     resolve_mesh,
     sharded_launcher,
     sharding,
+    state_mesh,
 )
 
 
@@ -216,8 +220,38 @@ def _canonical_dtype(dtype):
         np.float64 if dtype is None else np.dtype(dtype))
 
 
-@lru_cache(maxsize=None)
+def _state_geometry_token(kwargs_items):
+    """Memo-key component capturing the active state-mesh geometry.
+
+    The state mesh rides a thread-local (``active_state_mesh``) and is read
+    at TRACE time, so it is invisible to ``_batched_solver``'s memo key on
+    its own: the same ``(dtype, kwargs_items)`` under state_shards=2 and
+    state_shards=4 would otherwise reuse one executable with the first
+    geometry baked in (ISSUE 20).  The token is the Mesh itself (hashable:
+    device grid + axis names) — but ONLY when the program would actually
+    consult it, i.e. ``state="sharded"`` is in the kwargs AND a >1-shard
+    state mesh is active.  Replicated programs keep a ``None`` token so the
+    pre-existing cache behaviour (and entry count) is unchanged.
+    """
+    if dict(kwargs_items).get("state", "replicated") == "replicated":
+        return None
+    smesh = current_state_mesh()
+    if smesh is None or mesh_axis_size(smesh, STATE_AXIS) <= 1:
+        return None
+    return smesh
+
+
 def _batched_solver(dtype, kwargs_items=(), fault_mode=None, warm=False):
+    """See ``_batched_solver_impl``.  This thin wrapper folds the active
+    state-mesh geometry into the memo key (``_state_geometry_token``) —
+    everything else passes through unchanged."""
+    return _batched_solver_impl(dtype, kwargs_items, fault_mode, warm,
+                                _state_geometry_token(kwargs_items))
+
+
+@lru_cache(maxsize=None)
+def _batched_solver_impl(dtype, kwargs_items=(), fault_mode=None,
+                         warm=False, state_geometry=None):
     """Jitted vmapped cell solver, memoized so repeated sweeps (benchmarks,
     resumed runs, every bucket of a scheduled sweep) hit the jit cache
     instead of rebuilding the closure.  Cached entries (jitted closures)
@@ -292,6 +326,12 @@ def _batched_solver(dtype, kwargs_items=(), fault_mode=None, warm=False):
     return jax.jit(jax.vmap(solve_one))
 
 
+# Keep the public memo-management surface on the wrapper: bench harnesses
+# and tests call ``_batched_solver.cache_clear()`` between legs.
+_batched_solver.cache_clear = _batched_solver_impl.cache_clear
+_batched_solver.cache_info = _batched_solver_impl.cache_info
+
+
 # Quarantine retry ladder (bounded, host-side, in escalation order): each
 # rung re-runs a failed cell serially with progressively safer settings —
 # pure bisection (no Illinois secant jumps), an ALTERNATE distribution
@@ -336,6 +376,13 @@ def _retry_ladder(model_kwargs: dict) -> tuple:
     # re-solve on the one engine the goldens certify.
     if model_kwargs.get("kernel", "reference") != "reference":
         rungs = tuple({**r, "kernel": "reference"} for r in rungs)
+    # And for a non-default STATE policy (ISSUE 20, DESIGN §6b):
+    # quarantine escalates to the REPLICATED layout — a sharded-contraction
+    # pathology (collective placement, row-block reduction order) is
+    # invisible to the replicated path, and the rungs must re-solve on the
+    # one layout the goldens certify.
+    if model_kwargs.get("state", "replicated") != "replicated":
+        rungs = tuple({**r, "state": "replicated"} for r in rungs)
     return rungs
 
 
@@ -1063,8 +1110,15 @@ def _run_sweep_shell(scn, sweep, cells, mesh, axis, dtype, timer, perturb,
     # above any warn inside the impl (user -> entry -> shell -> impl) —
     # every stacklevel-tuned warnings.warn below counts on it.
     obs, owned = resolve_obs(obs if obs is not None else sweep.obs)
+    # SweepConfig.state_shards (ISSUE 20, DESIGN §6b): M > 1 builds the
+    # 2-D (cells × state) mesh here and ACTIVATES it for the whole run —
+    # the solvers read geometry from parallel.mesh.current_state_mesh,
+    # never from a kwarg (Mesh objects must not enter fingerprint/jit
+    # keys).  M = 1 activates None: a literal no-op.
+    smesh = (state_mesh(sweep.state_shards, axis=axis)
+             if sweep.state_shards > 1 else None)
     try:
-        with obs.activate(), obs.span(
+        with obs.activate(), active_state_mesh(smesh), obs.span(
                 "sweep/run", schedule=sweep.schedule,
                 cells=len(cells), scenario=scn.name) as sp:
             res = _run_sweep_impl(
@@ -1123,6 +1177,16 @@ def _run_sweep_impl(scn, sweep, cells_nom, mesh, axis, dtype, timer,
     # reference ones for free)
     if sweep.kernel != "reference":
         model_kwargs.setdefault("kernel", sweep.kernel)
+    # SweepConfig.state_shards (ISSUE 20, DESIGN §6b): the same model-kwarg
+    # DEFAULT rule — an explicit run_sweep(..., state=...) kwarg wins.  The
+    # 2-D mesh itself was activated by the shell (active_state_mesh); lane
+    # dispatch demotes to unsharded because shard_map's manual-SPMD regions
+    # and GSPMD state constraints cannot nest — state sharding exists for
+    # the regime where ONE cell's state exceeds a device, where replicating
+    # it per lane is unaffordable anyway.
+    if sweep.state_shards > 1:
+        model_kwargs.setdefault("state", "sharded")
+        mesh = None
     # family-level sweep kwarg defaults (e.g. Aiyagari's backend-aware
     # dist_method/egm_method selection) applied IN PLACE; the returned
     # metadata records what actually runs
@@ -1175,7 +1239,8 @@ def _run_sweep_impl(scn, sweep, cells_nom, mesh, axis, dtype, timer,
             sweep.n_buckets, sweep.warm_brackets, sweep.warm_margin,
             fault_mode, fault_iters, max_retries, quarantine, side,
             scenario=scn.name, row_fields=schema.fields,
-            mesh_shards=mesh_axis_size(mesh, axis))
+            mesh_shards=mesh_axis_size(mesh, axis),
+            state_shards=mesh_axis_size(current_state_mesh(), STATE_AXIS))
         ledger = LedgerState.resume(resume_path, ledger_fp, n_orig,
                                     width=schema.width)
 

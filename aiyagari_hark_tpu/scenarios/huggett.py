@@ -71,6 +71,7 @@ def solve_huggett_lean(model, disc_fac, crra, r_tol=None,
                        precision: str = "reference",
                        grid="reference",
                        kernel="reference",
+                       state="replicated",
                        bracket_init=None, fault_iter=None,
                        fault_mode=None) -> HuggettLean:
     """Bisect the bond rate until the credit market clears (E[a] = 0),
@@ -129,11 +130,11 @@ def solve_huggett_lean(model, disc_fac, crra, r_tol=None,
         policy, e_it, _, e_st = solve_household(
             1.0 + r, 1.0, model, disc_fac, crra, tol=egm_tol,
             init_policy=pol_in, accel_every=accel_every,
-            precision=precision, grid=grid, kernel=kernel)
+            precision=precision, grid=grid, kernel=kernel, state=state)
         dist, d_it, _, d_st = stationary_wealth(
             policy, 1.0 + r, 1.0, model, tol=dist_tol,
             init_dist=dist_in, method=dist_method, precision=precision,
-            kernel=kernel)
+            kernel=kernel, state=state)
         ex = aggregate_capital(dist, model)
         st = combine_status(e_st, d_st,
                             jnp.where(jnp.isfinite(ex), CONVERGED,

@@ -458,7 +458,14 @@ class EquilibriumService:
     sweep buckets, so served answers match the 1-device path (bitwise on
     root/status/counters; the aggregate contraction to reduction-order
     noise, DESIGN §6b) and exact replay still performs zero new XLA
-    compiles."""
+    compiles.
+
+    State-axis sharding (ISSUE 20): ``state_shards > 1`` activates a 2-D
+    state mesh around every cold-miss flush, so queries whose kwargs
+    carry ``state="sharded"`` solve with the per-cell wealth state
+    partitioned across devices (DESIGN §6b).  Mutually exclusive with a
+    multi-lane ``mesh`` — the two dispatch mechanisms cannot nest, and
+    an explicit argument is refused rather than silently ignored."""
 
     def __init__(self, store: Optional[SolutionStore] = None,
                  capacity: int = 256, disk_path: Optional[str] = None,
@@ -475,6 +482,7 @@ class EquilibriumService:
                  inject_corrupt_lane: Optional[dict] = None,
                  obs=None, admission=None,
                  mesh=None, mesh_axis: str = "cells",
+                 state_shards: int = 1,
                  prefetch_k: int = 0, prefetch_cells=None,
                  fleet_poll_s: float = 0.005,
                  surrogate=None):
@@ -483,11 +491,29 @@ class EquilibriumService:
         # before this constructor acquires anything that needs closing
         # (an owned obs bundle, the store's disk handle) — a rejected
         # misconfiguration must not leak resources.
-        from ..parallel.mesh import mesh_axis_size, resolve_mesh
+        from ..parallel.mesh import mesh_axis_size, resolve_mesh, state_mesh
 
         self._mesh = resolve_mesh(mesh, str(mesh_axis))
         self._mesh_axis = str(mesh_axis)
         self._mesh_shards = mesh_axis_size(self._mesh, self._mesh_axis)
+        # State-axis sharding (ISSUE 20, DESIGN §6b): with
+        # ``state_shards > 1`` every cold-miss solve partitions the
+        # per-cell wealth state across devices (queries should carry
+        # ``state="sharded"`` in their kwargs to route the push-forward
+        # through the sharded contraction).  Lane shard_map dispatch and
+        # GSPMD state constraints cannot nest, and ``state_shards`` is an
+        # EXPLICIT argument — silently ignoring one of the two would hide
+        # a misconfiguration, so the combination is refused up front
+        # (same pre-resource placement as the lane-mesh contract above).
+        if int(state_shards) > 1 and self._mesh_shards > 1:
+            raise ValueError(
+                f"state_shards={int(state_shards)} cannot combine with a "
+                f"multi-lane mesh ({self._mesh_shards} '{self._mesh_axis}' "
+                f"shards): shard_map lane dispatch and state-axis GSPMD "
+                f"constraints cannot nest — drop the lane mesh (mesh=None) "
+                f"or serve with state_shards=1")
+        self._state_mesh = (state_mesh(int(state_shards))
+                            if int(state_shards) > 1 else None)
         # Observability (ISSUE 7, DESIGN §10): an ObsConfig builds a
         # bundle owned (and closed) by this service; a shared Obs
         # correlates serving with a caller's wider run.  The store
@@ -1512,74 +1538,84 @@ class EquilibriumService:
             fault = [(-1 if pendings[i].query.fault_iter is None
                       else pendings[i].query.fault_iter) for i in lanes]
             args.append(jnp.asarray(np.asarray(fault, dtype=np.int32)))
-        fn = scn.batched_solver(dtype, kwargs_items, self._fault_mode,
-                                host is not None)
-        if self._mesh_shards > 1:
-            # multi-chip flush (ISSUE 11): the ladder shape divides the
-            # mesh (shard_ladder rounding), so one shard_map-wrapped
-            # launch of the shared executable dispatches the batch
-            # across every device — same wrapper, same memoization, as
-            # the sweep's bucket launches
-            import jax
+        # State-axis sharding (ISSUE 20): the state mesh rides a
+        # thread-local read at solver-factory AND trace time, and this
+        # runs on the worker thread — the context must wrap the factory
+        # call (memo-key geometry token), the ledger's lowering capture,
+        # and the launch (cold-call tracing).  ``None`` deactivates: the
+        # replicated path is untouched.
+        from ..parallel.mesh import active_state_mesh
 
-            from ..parallel.mesh import sharded_launcher, sharding
+        with active_state_mesh(self._state_mesh):
+            fn = scn.batched_solver(dtype, kwargs_items, self._fault_mode,
+                                    host is not None)
+            if self._mesh_shards > 1:
+                # multi-chip flush (ISSUE 11): the ladder shape divides
+                # the mesh (shard_ladder rounding), so one
+                # shard_map-wrapped launch of the shared executable
+                # dispatches the batch across every device — same
+                # wrapper, same memoization, as the sweep's bucket
+                # launches
+                import jax
 
-            fn = sharded_launcher(fn, self._mesh, self._mesh_axis)
-            shard = sharding(self._mesh, self._mesh_axis)
-            args = [jax.device_put(a, shard) for a in args]
+                from ..parallel.mesh import sharded_launcher, sharding
 
-        # measured cost attribution (ISSUE 10): same compile-cache
-        # keying as the sweep's ledger — a warmed service owns one
-        # executable per (scenario, flavor, ladder shape), so the
-        # ledger's entry count IS the executable-ladder audit
-        prof = self._obs.cost_ledger
-        prof_key = None
-        if prof is not None:
-            flavor = "warm" if host is not None else "cold"
-            prof_key = ("serve", scn.name,
-                        work_fingerprint(kwargs_items, dtype,
-                                         scenario=scn.name),
-                        flavor, shape, self._fault_mode,
-                        self._mesh_shards)
-            prof.capture(prof_key, fn, args,
-                         label=f"serve/{scn.name}/{flavor}{shape}"
-                               + (f"x{self._mesh_shards}"
-                                  if self._mesh_shards > 1 else ""))
+                fn = sharded_launcher(fn, self._mesh, self._mesh_axis)
+                shard = sharding(self._mesh, self._mesh_axis)
+                args = [jax.device_put(a, shard) for a in args]
 
-        t_launch = self._clock()
-        try:
-            with self._launch_lock, self.metrics.compile, \
-                    self._obs.span("serve/batch_flush", lanes=n,
-                                   shape=shape, scenario=scn.name,
-                                   device_profile=True) as bsp:
-                packed = retry_transient(
-                    lambda: np.asarray(fn(*args)), self._retry,
-                    label=f"serve batch [{shape}]")
-                # phase split from the returned counters (no tracing
-                # inside jit): real lanes only — padding duplicates
-                # would double-count
-                if schema.phases is not None:
-                    bsp.subdivide(
-                        {"descent": float(
-                            packed[:n, schema.idx(schema.phases[0])]
-                            .sum()),
-                         "polish": float(
-                             packed[:n, schema.idx(schema.phases[1])]
-                             .sum())},
-                        prefix="serve/phase/")
-        except BaseException as e:
-            self._fleet_release_claims(pendings)
-            pendings = pendings + [d for ps in dups.values()
-                                   for d in ps]
-            self._abort_probes(pendings)
-            for p in pendings:
-                self._audit_forget(p)
-                if not p.future.done():
-                    p.future.set_exception(e)
-                self.metrics.record_failure(self._clock() - p.t_submit)
-            if isinstance(e, Interrupted):
-                raise
-            return
+            # measured cost attribution (ISSUE 10): same compile-cache
+            # keying as the sweep's ledger — a warmed service owns one
+            # executable per (scenario, flavor, ladder shape), so the
+            # ledger's entry count IS the executable-ladder audit
+            prof = self._obs.cost_ledger
+            prof_key = None
+            if prof is not None:
+                flavor = "warm" if host is not None else "cold"
+                prof_key = ("serve", scn.name,
+                            work_fingerprint(kwargs_items, dtype,
+                                             scenario=scn.name),
+                            flavor, shape, self._fault_mode,
+                            self._mesh_shards)
+                prof.capture(prof_key, fn, args,
+                             label=f"serve/{scn.name}/{flavor}{shape}"
+                                   + (f"x{self._mesh_shards}"
+                                      if self._mesh_shards > 1 else ""))
+
+            t_launch = self._clock()
+            try:
+                with self._launch_lock, self.metrics.compile, \
+                        self._obs.span("serve/batch_flush", lanes=n,
+                                       shape=shape, scenario=scn.name,
+                                       device_profile=True) as bsp:
+                    packed = retry_transient(
+                        lambda: np.asarray(fn(*args)), self._retry,
+                        label=f"serve batch [{shape}]")
+                    # phase split from the returned counters (no tracing
+                    # inside jit): real lanes only — padding duplicates
+                    # would double-count
+                    if schema.phases is not None:
+                        bsp.subdivide(
+                            {"descent": float(
+                                packed[:n, schema.idx(schema.phases[0])]
+                                .sum()),
+                             "polish": float(
+                                 packed[:n, schema.idx(schema.phases[1])]
+                                 .sum())},
+                            prefix="serve/phase/")
+            except BaseException as e:
+                self._fleet_release_claims(pendings)
+                pendings = pendings + [d for ps in dups.values()
+                                       for d in ps]
+                self._abort_probes(pendings)
+                for p in pendings:
+                    self._audit_forget(p)
+                    if not p.future.done():
+                        p.future.set_exception(e)
+                    self.metrics.record_failure(self._clock() - p.t_submit)
+                if isinstance(e, Interrupted):
+                    raise
+                return
         # recent-batch-latency EWMA (clock units): the estimated-wait
         # model behind Overloaded retry-after and deadline-aware
         # admission (policy est_batch_s, when set, takes precedence)
@@ -1968,16 +2004,22 @@ class EquilibriumService:
         served result's ``bracket_init`` reproduces its bits."""
         import jax.numpy as jnp
 
+        from ..parallel.mesh import active_state_mesh
+
         scn = _scenario_of(q.scenario)
         warm = bracket_init is not None
-        fn = scn.batched_solver(q.dtype, q.kwargs, None, warm)
-        args = [jnp.asarray([q.crra], dtype=q.dtype),
-                jnp.asarray([q.labor_ar], dtype=q.dtype),
-                jnp.asarray([q.labor_sd], dtype=q.dtype)]
-        if warm:
-            args += [jnp.asarray([bracket_init[0]], dtype=q.dtype),
-                     jnp.asarray([bracket_init[1]], dtype=q.dtype),
-                     jnp.asarray([bracket_init[2]], dtype=np.int32)]
-        row = np.asarray(fn(*args), dtype=np.float64)[0]
+        # same state-mesh context as the flush path (ISSUE 20): the
+        # reference must trace against the SAME geometry serving used,
+        # or its bits would come from a differently-placed contraction
+        with active_state_mesh(self._state_mesh):
+            fn = scn.batched_solver(q.dtype, q.kwargs, None, warm)
+            args = [jnp.asarray([q.crra], dtype=q.dtype),
+                    jnp.asarray([q.labor_ar], dtype=q.dtype),
+                    jnp.asarray([q.labor_sd], dtype=q.dtype)]
+            if warm:
+                args += [jnp.asarray([bracket_init[0]], dtype=q.dtype),
+                         jnp.asarray([bracket_init[1]], dtype=q.dtype),
+                         jnp.asarray([bracket_init[2]], dtype=np.int32)]
+            row = np.asarray(fn(*args), dtype=np.float64)[0]
         return _result_from_row(scn.schema, row, "reference",
                                 bracket_init, q.key(), scenario=scn.name)

@@ -315,6 +315,65 @@ def resolve_kernel(policy) -> KernelSpec:
             f"got {policy!r}") from None
 
 
+# -- state-sharding policy (ISSUE 20, DESIGN §6b) ----------------------------
+#
+# Every scaling lever through PR 18 parallelizes over sweep CELLS; the
+# per-cell state — the distribution [D, N] and the dense wealth-transition
+# operator [N, D, D] — is replicated and must fit one device, which caps
+# asset-grid resolution.  The STATE policy partitions those tensors along
+# the wealth axis across a second, orthogonal mesh axis ("state",
+# ``parallel.mesh.STATE_AXIS``):
+#
+# * ``"replicated"`` (default) — today's layout, bit-identical: no state
+#   mesh consulted, no sharding constraints emitted.
+# * ``"sharded"`` — distribution rows and operator row-blocks placed per
+#   the partition-rule table (``parallel.mesh.STATE_PARTITION_RULES``);
+#   the push-forward becomes a row-block contraction with ONE all-reduce
+#   per step (GSPMD places it from the constraints).  NOT bit-identical
+#   to replicated — the sharded contraction reorders the wealth-axis
+#   reduction — but r* agrees to <0.1bp (the acceptance gate
+#   ``bench.py --state-scaling`` measures).  Quarantine rungs force
+#   ``"replicated"`` (the certified configuration).
+
+STATE_POLICIES = ("replicated", "sharded")
+
+
+@dataclass(frozen=True)
+class StateSpec:
+    """Resolved knobs for one state-sharding policy (ISSUE 20, DESIGN §6b).
+
+    ``sharded`` — place distribution rows / operator row-blocks on the
+    "state" mesh axis and run the push-forward as a row-block contraction.
+    Inert without an ACTIVE state mesh of size > 1
+    (``parallel.mesh.active_state_mesh``): policy resolution is pure
+    config, geometry comes from the mesh seam."""
+
+    policy: str
+    sharded: bool
+
+
+_STATE_SPECS = {
+    "replicated": StateSpec("replicated", sharded=False),
+    "sharded": StateSpec("sharded", sharded=True),
+}
+
+
+def resolve_state(policy) -> StateSpec:
+    """Validate a state-sharding policy name (or pass a spec through) —
+    the ONE validation surface, mirrored on ``resolve_precision``/
+    ``resolve_grid``/``resolve_kernel``: an unknown policy raises here,
+    before it can alias a real one in any cache key
+    (``utils.fingerprint.hashable_kwargs`` routes through this)."""
+    if isinstance(policy, StateSpec):
+        return policy
+    try:
+        return _STATE_SPECS[policy]
+    except (KeyError, TypeError):
+        raise ValueError(
+            f"state policy must be one of {STATE_POLICIES}, "
+            f"got {policy!r}") from None
+
+
 # Packed device-row layout of the AIYAGARI batched cell solver: ONE
 # stacked float row per cell means ONE device->host transfer per launch
 # (the round-5 packing rationale, ``parallel.sweep._batched_solver``).
@@ -522,6 +581,20 @@ class SweepConfig:
       ``hashable_kwargs``.  Quarantine rungs force
       ``kernel="reference"`` (the launch-per-loop escalation).
 
+    State-sharding knob (ISSUE 20, DESIGN §6b):
+
+    * ``state_shards`` — how many ways each cell's STATE (distribution
+      rows, wealth-operator row blocks) is partitioned across the
+      second mesh axis ("state").  1 (default) keeps today's replicated
+      layout bit-identical; M > 1 builds a 2-D (cells × state) mesh,
+      activates it around the sweep (``parallel.mesh.active_state_mesh``)
+      and applies ``state="sharded"`` as a model-kwarg default exactly
+      like ``grid``/``kernel`` — an explicit ``run_sweep(..., state=...)``
+      kwarg wins — so the policy rides every fingerprint through
+      ``hashable_kwargs`` and the ledger fingerprint hashes BOTH mesh
+      axes (an N×M ledger refuses to resume under N'×M').  Quarantine
+      rungs force ``state="replicated"`` (the certified layout).
+
     Observability knob (ISSUE 7, DESIGN §10):
 
     * ``obs`` — an ``obs.ObsConfig``: run-scoped tracing spans
@@ -549,6 +622,7 @@ class SweepConfig:
     certify: bool = False
     grid: str = "reference"
     kernel: str = "reference"
+    state_shards: int = 1
     obs: Optional[ObsConfig] = None
 
     def replace(self, **kwargs) -> "SweepConfig":

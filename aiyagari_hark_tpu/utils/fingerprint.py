@@ -26,7 +26,8 @@ import json
 
 import numpy as np
 
-from .config import resolve_grid, resolve_kernel, resolve_precision
+from .config import (resolve_grid, resolve_kernel, resolve_precision,
+                     resolve_state)
 
 
 class IntegrityError(RuntimeError):
@@ -166,7 +167,14 @@ def hashable_kwargs(model_kwargs: dict) -> tuple:
     sidecars, ledgers, and store entries (the CostLedger's
     ``work_fingerprint`` keying therefore attributes cost per FUSED
     executable for free), unknown policies raise via
-    ``resolve_kernel``."""
+    ``resolve_kernel``.
+
+    State-policy normalization (ISSUE 20, DESIGN §6b): the same rule a
+    fourth time for ``state`` — explicit "replicated" dropped (the
+    default, bit-identical by construction), "sharded" hashed by
+    canonical name so state-sharded solves key their own executables,
+    sidecars, ledgers, and store entries; unknown policies raise via
+    ``resolve_state``."""
     items = []
     for k, v in sorted(model_kwargs.items()):
         if k == "precision":
@@ -186,6 +194,11 @@ def hashable_kwargs(model_kwargs: dict) -> tuple:
             # same authority pattern again (ISSUE 13, DESIGN §4c)
             v = resolve_kernel(v).policy
             if v == "reference":
+                continue
+        if k == "state":
+            # same authority pattern a fourth time (ISSUE 20, DESIGN §6b)
+            v = resolve_state(v).policy
+            if v == "replicated":
                 continue
         if isinstance(v, (list, np.ndarray)):
             arr = np.asarray(v)
@@ -252,7 +265,8 @@ def ledger_fingerprint(cells, kwargs_items: tuple, dtype,
                        warm_margin: float, fault_mode, fault_iters,
                        max_retries: int, quarantine: bool,
                        sidecar, scenario: str = DEFAULT_SCENARIO,
-                       row_fields=None, mesh_shards: int = 1) -> int:
+                       row_fields=None, mesh_shards: int = 1,
+                       state_shards: int = 1) -> int:
     """Validity key of the sweep resume ledger (``resilience.SweepLedger``):
     everything that shapes the result bits — the scenario, cells (perturb
     included; a ``[C, k]`` array), solver kwargs, dtype, schedule knobs,
@@ -269,7 +283,13 @@ def ledger_fingerprint(cells, kwargs_items: tuple, dtype,
     but the bucket padding and lane layout are not, so a ledger written
     on an N-device mesh refuses-to-resume (typed warn + recompute) under
     an M-device mesh instead of silently restoring rows whose launch
-    geometry the restarted run cannot reproduce."""
+    geometry the restarted run cannot reproduce.
+
+    ``state_shards`` extends that guard to the SECOND mesh axis
+    (ISSUE 20): state-sharded solve bits depend on the row-block
+    reduction order, so a ledger written under (cells=N, state=M)
+    geometry refuses to resume under any other (N', M') and the restarted
+    run recomputes bit-identically under its own geometry."""
     if row_fields is None:
         from ..scenarios.registry import get_scenario
 
@@ -281,5 +301,6 @@ def ledger_fingerprint(cells, kwargs_items: tuple, dtype,
         schedule, int(n_buckets), bool(warm_brackets),
         float(warm_margin), str(fault_mode),
         "none" if fault_iters is None else fault_iters,
-        int(max_retries), bool(quarantine), int(mesh_shards),
+        int(max_retries), bool(quarantine),
+        (int(mesh_shards), int(state_shards)),
         *(tuple(sidecar) if sidecar is not None else ("no-sidecar",)))

@@ -3289,6 +3289,211 @@ def _chips_scaling() -> dict:
     return record
 
 
+# State-scaling smoke (ISSUE 20): the state-axis tentpole, measured — the
+# same 4-cell sweep at wealth-grid sizes that grow PAST the nominal
+# single-device resident budget, solved replicated and with the per-cell
+# state partitioned across 2 and 4 devices (DESIGN §6b).  The chips leg's
+# protocol (probe -> forced host -> warm-up -> timed perturbed run) at a
+# reduced lattice: state sharding is a per-cell memory play, so a big
+# cell count only dilutes the signal.  dist_method is pinned to "dense"
+# on EVERY leg — the sharded path forces dense internally, and the
+# replicated baseline must run the same contraction or the comparison
+# would measure scatter-vs-dense, not sharding.
+STATE_SHARD_SIZES = (1, 2, 4)
+STATE_GRID_SIZES = (128, 256, 512)
+STATE_SMOKE_KWARGS = dict(a_count=10, labor_states=3, r_tol=1e-5,
+                          max_bisect=24, dist_method="dense")
+# Nominal per-device resident budget for the forced-host drill: host CPU
+# "devices" share one RAM pool and report no memory_stats(), so the
+# grid-exceeds-one-device acceptance is defined against this explicit
+# budget applied to the MODEL resident (operator + distribution shards,
+# exact arithmetic below); on real chips the measured DeviceTelemetry
+# gauges ride alongside.  4 MiB puts the largest grid's replicated
+# operator (3*512^2*8 B ~ 6.3 MB) over budget while its 2- and 4-way
+# shards fit — the smallest drill that exercises the claim.
+STATE_NOMINAL_DEVICE_BUDGET = 4 * 1024 * 1024
+
+
+def _state_model_resident_bytes(n_labor: int, d: int, shards: int) -> int:
+    """Per-device resident of the dense push-forward under ``shards``-way
+    state partitioning (f64): the wealth operator's row block
+    ``[N, D, D/M]`` plus the distribution and its pushed copy
+    ``2 x [D/M, N]`` — the terms the partition-rule table shards; the
+    policy iterate (O(N*A)) is replicated by design and negligible."""
+    rows = d // shards
+    return 8 * (n_labor * d * rows + 2 * rows * n_labor)
+
+
+def _state_scaling() -> dict:
+    """The ``--state-scaling`` acceptance run (ISSUE 20): distribution
+    gridpoints/sec for a 4-cell sweep at wealth grids 128/256/512, each
+    solved at state shards 1/2/4 on the CPU mesh (real chips when an
+    accelerator answers the probe), with (a) r* drift of every sharded
+    run vs the replicated run at the same grid (< 0.1 bp acceptance),
+    (b) per-device resident accounting — measured ``DeviceTelemetry``
+    gauges where the backend reports memory_stats(), the exact model
+    resident everywhere — showing the operator shrinking ~1/M, (c) the
+    largest grid exceeding the nominal single-device budget yet solving
+    under state_shards>1 with its per-device resident back under it, and
+    (d) the sharding overhead share from the CostLedger's launch walls
+    (an upper bound on collective time: forced-host CPU has no per-op
+    collective timer, so the leg records wall overhead vs the replicated
+    run of the same grid and says so).  Scalar ``state_*`` fields are
+    graded by the bench-regression sentinel
+    (``obs.regress.DIRECTION_EXPLICIT`` knows them)."""
+    import numpy as np
+
+    ambient = _probe_default_backend()
+    forced_host = ambient is None or ambient == "cpu"
+    if forced_host:
+        from aiyagari_hark_tpu.utils.backend import force_cpu_platform
+
+        force_cpu_platform(max(STATE_SHARD_SIZES))
+
+    import jax
+
+    if forced_host:
+        jax.config.update("jax_enable_x64", True)
+
+    from aiyagari_hark_tpu.obs import ObsConfig, build_obs
+    from aiyagari_hark_tpu.parallel.sweep import (_batched_solver,
+                                                  run_table2_sweep)
+    from aiyagari_hark_tpu.utils.config import SweepConfig
+
+    backend = jax.default_backend()
+    devices = jax.devices()
+    shard_sizes = [m for m in STATE_SHARD_SIZES if m <= len(devices)]
+    n_labor = int(STATE_SMOKE_KWARGS["labor_states"])
+    cfg = SweepConfig(crra_values=(1.0, 3.0), rho_values=(0.3, 0.6))
+    n_cells = len(cfg.cells())
+    print(f"[bench] state scaling: backend={backend} "
+          f"devices={len(devices)} "
+          f"({'forced host' if forced_host else 'real chips'}), "
+          f"state shards {shard_sizes}, grids {list(STATE_GRID_SIZES)}, "
+          f"{n_cells} cells", file=sys.stderr)
+
+    entries = []
+    drift_max_bp = 0.0
+    status_equal = True
+    mem_devices = 0
+    mem_peak = None
+    walls = {}          # (d, m) -> ledger launch-wall total
+    gps = {}            # (d, m) -> dist gridpoints/sec
+    for d in STATE_GRID_SIZES:
+        base = None
+        for m in shard_sizes:
+            run_cfg = cfg.replace(state_shards=m)
+            kw = dict(STATE_SMOKE_KWARGS, dist_count=d)
+            # fresh executables per (grid, shards): the memoized solver
+            # keys on the state-mesh geometry (ISSUE 20), but clearing
+            # keeps each leg's ledger from inheriting launch walls
+            _batched_solver.cache_clear()
+            obs = build_obs(ObsConfig(enabled=True, profile=True))
+            run_table2_sweep(run_cfg, obs=obs, **kw)    # compile+warm
+            res = run_table2_sweep(run_cfg, perturb=PERTURB, obs=obs,
+                                   **kw)
+            mem_devices = max(mem_devices,
+                              obs.sample_devices(where=f"state{d}x{m}"))
+            reg = obs.registry.snapshot()
+            peaks = [e["value"] for name, e in reg.items()
+                     if name.endswith("_mem_peak_bytes_in_use")]
+            if peaks:
+                mem_peak = max(mem_peak or 0.0, max(peaks))
+            ledger = obs.cost_ledger
+            walls[(d, m)] = (sum(e.launch_wall_s for e in ledger.entries())
+                             if ledger is not None else res.wall_seconds)
+            obs.close()
+            # distribution-push throughput: wealth-grid points touched
+            # per push step, summed over every distribution iteration of
+            # every bisection midpoint — the work state sharding splits
+            gps[(d, m)] = (float(res.dist_iters.sum()) * d * n_labor
+                           / res.wall_seconds)
+            model_bytes = _state_model_resident_bytes(n_labor, d, m)
+            entries.append({
+                "dist_count": d,
+                "state_shards": m,
+                "wall_s": round(res.wall_seconds, 4),
+                "gridpoints_per_sec": round(gps[(d, m)]),
+                "model_resident_bytes_per_dev": model_bytes,
+                "over_nominal_budget": bool(
+                    model_bytes > STATE_NOMINAL_DEVICE_BUDGET),
+            })
+            if m == shard_sizes[0]:
+                base = res
+            else:
+                drift_bp = float(np.abs(
+                    np.asarray(res.r_star_pct)
+                    - np.asarray(base.r_star_pct)).max()) * 100.0
+                drift_max_bp = max(drift_max_bp, drift_bp)
+                status_equal = status_equal and bool(np.array_equal(
+                    np.asarray(res.status), np.asarray(base.status)))
+            print(f"[bench] state D={d} M={m}: "
+                  f"wall={res.wall_seconds:.3f}s -> "
+                  f"{gps[(d, m)]:.0f} gridpoints/s, "
+                  f"resident/dev {model_bytes} B", file=sys.stderr)
+
+    d_top = STATE_GRID_SIZES[-1]
+    m_top = shard_sizes[-1]
+    repl_top = _state_model_resident_bytes(n_labor, d_top, 1)
+    shard_top = _state_model_resident_bytes(n_labor, d_top, m_top)
+    # the overflow drill: the largest grid's replicated resident exceeds
+    # the nominal per-device budget, every sharded solve of it converged
+    # with the same statuses, and its per-device shard fits back under
+    overflow_solved = bool(
+        repl_top > STATE_NOMINAL_DEVICE_BUDGET
+        and shard_top <= STATE_NOMINAL_DEVICE_BUDGET
+        and status_equal and m_top > 1)
+    # sharding overhead share at the top (grid, shards) point, from the
+    # ledger's launch walls: wall overhead vs the replicated run — an
+    # UPPER bound on collective time (no per-op collective timer here)
+    w1, wm = walls.get((d_top, 1)), walls.get((d_top, m_top))
+    collective_share = (max(0.0, round((wm - w1) / wm, 4))
+                        if w1 and wm and wm > 0 else None)
+
+    record = {
+        "metric": "state_scaling",
+        "backend": backend,
+        "state_forced_host": bool(forced_host),
+        "state_smoke_cells": n_cells,
+        "state_scaling": entries,
+        "state_r_star_drift_bp": round(drift_max_bp, 6),
+        "state_drift_ok": bool(drift_max_bp < 0.1),
+        "state_status_equal": status_equal,
+        "state_budget_bytes": STATE_NOMINAL_DEVICE_BUDGET,
+        "state_overflow_grid": d_top,
+        "state_overflow_grid_solved": overflow_solved,
+        "state_model_resident_replicated_bytes": repl_top,
+        "state_model_resident_sharded_bytes": shard_top,
+        "state_resident_ratio": round(shard_top / repl_top, 4),
+        "state_collective_share_frac": collective_share,
+        "state_mem_stats_devices": mem_devices,
+        "state_mem_peak_bytes": mem_peak,
+    }
+    for m in shard_sizes:
+        record[f"state_gridpoints_per_sec_{m}shard"] = round(gps[(d_top, m)])
+    from aiyagari_hark_tpu.obs.regress import (SEVERITY_NAMES,
+                                               evaluate_history,
+                                               load_bench_history)
+
+    history = load_bench_history(_repo_dir()) + [("state_smoke", record)]
+    report = evaluate_history(history)
+    state_regressed = [f.metric for f in report.regressed()
+                       if f.metric.startswith("state_")]
+    record["state_sentinel_clean"] = not state_regressed
+    record["state_sentinel_worst"] = SEVERITY_NAMES[report.worst]
+    print(f"[bench] state scaling: "
+          + " ".join(f"{m}sh={gps[(d_top, m)]:.0f}gp/s"
+                     for m in shard_sizes)
+          + f" drift={drift_max_bp:.4f}bp "
+          f"overflow_grid_solved={'OK' if overflow_solved else 'FAILED'} "
+          f"resident {repl_top}->{shard_top} B/dev "
+          f"collective_share={collective_share}", file=sys.stderr)
+    if not record["state_drift_ok"] or not overflow_solved:
+        print("[bench] state scaling: ACCEPTANCE FAILED — see the "
+              "state_* fields above", file=sys.stderr)
+    return record
+
+
 def _index_bench(space) -> dict:
     """Measured ``CellIndex``-vs-linear-scan microbench (ISSUE 17
     acceptance: >= 10x nearest-query speedup at 10^4+ synthetic stored
@@ -3519,7 +3724,12 @@ def main(argv=None):
     ``profile_*`` record (ISSUE 10); ``--chips-scaling`` runs the
     multi-chip scaling acceptance (shard_map-dispatched sweep at mesh
     sizes 1/2/4/8 with bit-identity, work-skew, and memory telemetry)
-    and emits the ``chips_*`` record (ISSUE 11); ``--compaction-smoke``
+    and emits the ``chips_*`` record (ISSUE 11); ``--state-scaling``
+    runs the state-axis sharding acceptance (ISSUE 20: wealth grids past
+    the nominal single-device resident budget solved at state shards
+    1/2/4 with sub-0.1bp r* drift, ~1/M per-device residents, and a
+    ledger-sourced overhead share) and emits the ``state_*`` record;
+    ``--compaction-smoke``
     runs the grid-compaction acceptance (12-cell golden sweep under
     ``grid="compact"``: all cells CERTIFIED, r* within 0.1bp of the
     committed goldens, measured gridpoint/step/wall reductions,
@@ -3653,6 +3863,15 @@ def main(argv=None):
                          "bit-identity vs the 1-device mesh, per-device "
                          "work skew, and memory gauges) and emit the "
                          "chips_* record instead of the full bench")
+    ap.add_argument("--state-scaling", action="store_true",
+                    help="run the state-sharding smoke (ISSUE 20: a "
+                         "4-cell sweep at wealth grids 128/256/512 under "
+                         "state shards 1/2/4 — the largest grid exceeds "
+                         "the nominal single-device resident budget and "
+                         "solves sharded with r* within 0.1bp of the "
+                         "replicated run, per-device resident ~1/M, "
+                         "ledger-sourced overhead share) and emit the "
+                         "state_* record instead of the full bench")
     ap.add_argument("--compaction-smoke", action="store_true",
                     help="run the grid-compaction smoke (ISSUE 12: the "
                          "12-cell golden CPU sweep under grid='compact' "
@@ -3682,6 +3901,7 @@ def main(argv=None):
     if (args.serve_smoke or args.integrity_smoke or args.obs_smoke
             or args.load_smoke or args.scenario_smoke
             or args.profile_smoke or args.chips_scaling
+            or args.state_scaling
             or args.compaction_smoke or args.kernel_smoke
             or args.fleet_smoke or args.chaos_smoke
             or args.dr_smoke or args.surrogate_smoke):
@@ -3697,6 +3917,7 @@ def main(argv=None):
                  else _kernel_smoke if args.kernel_smoke
                  else _compaction_smoke if args.compaction_smoke
                  else _chips_scaling if args.chips_scaling
+                 else _state_scaling if args.state_scaling
                  else _profile_smoke if args.profile_smoke
                  else _scenario_smoke if args.scenario_smoke
                  else _load_smoke if args.load_smoke

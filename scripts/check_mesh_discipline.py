@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Static lint: device meshes and shardings are built through
+``parallel/mesh.py``, never constructed raw in the hot paths (ISSUE 20).
+
+The multi-chip layer (DESIGN §6b) only works if every mesh and every
+sharding in the solver/serving paths routes through ``parallel.mesh`` —
+the ONE seam that owns axis naming (``"cells"``/``"state"``), device
+selection, the divisibility contract, the partition-rule table, and the
+fingerprinted geometry.  A hot path that calls
+``jax.sharding.Mesh``/``NamedSharding``/``PartitionSpec`` directly mints
+a parallel geometry the seam never sees: its axis names can drift from
+the partition rules, its device order from ``balanced_lane_order``, and
+its shape from the geometry every resume fingerprint downstream hashed.
+This lint bans direct CONSTRUCTION of (or ``from``-import naming)
+``Mesh`` / ``NamedSharding`` / ``PartitionSpec`` in the hot directories
+(``models/``, ``parallel/``, ``serve/``, ``scenarios/``, ``verify/``,
+``ops/``):
+
+any such call or import there must carry an explicit ``# mesh-ok``
+waiver on its line stating why the raw construction is correct.
+
+``parallel/mesh.py`` IS the seam and is exempt, as are tests (pinning
+construction behavior is a test's job).  Run standalone (exits 1 on
+findings) or via tier-1 (``tests/test_mesh_discipline.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The hot directories: everywhere a mesh or sharding can sit on a
+# sweep/serve/certify path.  ops/ is IN scope here (unlike the grid
+# lint, where ops/ is the seam): ops kernels consume shardings through
+# ``constrain_state``, they never mint geometry.
+SCAN_DIRS = (
+    os.path.join("aiyagari_hark_tpu", "models"),
+    os.path.join("aiyagari_hark_tpu", "parallel"),
+    os.path.join("aiyagari_hark_tpu", "serve"),
+    os.path.join("aiyagari_hark_tpu", "scenarios"),
+    os.path.join("aiyagari_hark_tpu", "verify"),
+    os.path.join("aiyagari_hark_tpu", "ops"),
+)
+
+BANNED = {"Mesh", "NamedSharding", "PartitionSpec"}
+WAIVER = "# mesh-ok"
+# The seam itself (repo-relative): the one file allowed to construct.
+EXEMPT = (os.path.join("aiyagari_hark_tpu", "parallel", "mesh.py"),)
+
+
+def scan_source(src: str, rel: str) -> list:
+    """Findings for one file's source text (exposed for fixture tests)."""
+    if rel.replace("/", os.sep) in EXEMPT:
+        return []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [(rel, e.lineno or 0, f"unparseable: {e.msg}")]
+    lines = src.splitlines()
+    findings = []
+
+    def _flag(lineno: int, what: str) -> None:
+        line = lines[lineno - 1] if lineno <= len(lines) else ""
+        if WAIVER in line:
+            return
+        findings.append(
+            (rel, lineno,
+             f"raw {what} in a mesh-consuming hot path — build meshes "
+             "and shardings through the parallel.mesh seam (make_mesh / "
+             "state_mesh / sharding / state_sharding / "
+             "match_partition_rules), or waive with '# mesh-ok'"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name in BANNED:
+                    _flag(node.lineno, f"import of {alias.name}")
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            name = (fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute)
+                    else None)
+            if name in BANNED:
+                _flag(node.lineno, f"construction of {name}")
+    return findings
+
+
+def scan_targets(repo: str = REPO) -> list:
+    """The files the lint covers, absolute paths — exposed so the lint's
+    own test can assert coverage instead of trusting the list silently."""
+    targets = []
+    for root in SCAN_DIRS:
+        base = os.path.join(repo, root)
+        for dirpath, _, names in os.walk(base):
+            if "__pycache__" in dirpath:
+                continue
+            targets += [os.path.join(dirpath, n) for n in sorted(names)
+                        if n.endswith(".py")]
+    return targets
+
+
+def scan(repo: str = REPO) -> list:
+    findings = []
+    for path in scan_targets(repo):
+        if os.path.exists(path):
+            with open(path) as fh:
+                findings += scan_source(fh.read(),
+                                        os.path.relpath(path, repo))
+    return findings
+
+
+def main() -> int:
+    findings = scan()
+    for rel, lineno, msg in findings:
+        print(f"{rel}:{lineno}: {msg}")
+    if findings:
+        print(f"{len(findings)} mesh-discipline violation(s); see "
+              f"scripts/check_mesh_discipline.py docstring")
+        return 1
+    print("mesh-discipline lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

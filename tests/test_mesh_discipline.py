@@ -1,0 +1,73 @@
+"""Mesh-discipline lint (ISSUE 20 satellite): hot paths build meshes and
+shardings through the ``parallel.mesh`` seam, never raw
+``Mesh``/``NamedSharding``/``PartitionSpec`` construction."""
+
+import importlib.util
+import os
+
+import pytest
+
+repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+spec = importlib.util.spec_from_file_location(
+    "check_mesh_discipline",
+    os.path.join(repo, "scripts", "check_mesh_discipline.py"))
+lint = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(lint)
+
+
+def test_mesh_discipline_lint_is_clean():
+    findings = lint.scan()
+    assert not findings, "\n".join(
+        f"{rel}:{line}: {msg}" for rel, line, msg in findings)
+
+
+def test_mesh_discipline_covers_the_hot_dirs():
+    rels = {os.path.relpath(p, repo).replace(os.sep, "/")
+            for p in lint.scan_targets()}
+    # the seam's consumers are in scope — including ops/, which consumes
+    # shardings through constrain_state but must never mint geometry ...
+    assert "aiyagari_hark_tpu/parallel/sweep.py" in rels
+    assert "aiyagari_hark_tpu/parallel/panel.py" in rels
+    assert "aiyagari_hark_tpu/ops/markov.py" in rels
+    assert "aiyagari_hark_tpu/models/household.py" in rels
+    assert any(r.startswith("aiyagari_hark_tpu/serve/") for r in rels)
+    # ... and the seam file itself is walked but exempt from findings
+    assert "aiyagari_hark_tpu/parallel/mesh.py" in rels
+    assert not lint.scan_source(
+        "from jax.sharding import Mesh\nm = Mesh((), ())\n",
+        "aiyagari_hark_tpu/parallel/mesh.py")
+
+
+@pytest.mark.parametrize("src,n_expected", [
+    # a bare construction is a finding
+    ("from jax.sharding import Mesh\n"
+     "m = Mesh(devs, ('cells',))\n", 2),
+    # attribute-form construction too
+    ("import jax\n"
+     "s = jax.sharding.NamedSharding(m, spec)\n", 1),
+    # PartitionSpec minting is a finding
+    ("from jax.sharding import PartitionSpec\n"
+     "p = PartitionSpec('state', None)\n", 2),
+    # a waived line is not
+    ("from jax.sharding import Mesh  # mesh-ok: fixture\n"
+     "m = Mesh(devs, ('cells',))  # mesh-ok: fixture\n", 0),
+    # seam calls are never banned
+    ("from ..parallel.mesh import state_mesh, state_sharding\n"
+     "m = state_mesh(4)\n"
+     "s = state_sharding(m, 'distribution')\n", 0),
+])
+def test_mesh_discipline_fixtures(src, n_expected):
+    findings = lint.scan_source(src, "aiyagari_hark_tpu/models/x.py")
+    assert len(findings) == n_expected, findings
+
+
+def test_mesh_discipline_script_exit_codes():
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "scripts", "check_mesh_discipline.py")],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "clean" in out.stdout

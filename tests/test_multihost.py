@@ -100,3 +100,94 @@ def test_initialize_refuses_silent_duplicate_jobs(monkeypatch):
     monkeypatch.delenv("MEGASCALE_COORDINATOR_ADDRESS", raising=False)
     with pytest.raises(ValueError, match="refusing"):
         multihost.initialize(num_processes=4)
+
+
+# -- unit tests of the resolution contract (ISSUE 20 satellite): no pod,
+# -- no subprocess — jax.distributed.initialize is captured, never run.
+
+@pytest.fixture
+def captured_init(monkeypatch):
+    """Monkeypatch ``jax.distributed.initialize`` to record its kwargs;
+    also scrub every env var ``multihost.initialize`` consults so each
+    test states its own environment explicitly."""
+    import jax
+
+    calls = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda **kw: calls.append(kw))
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID", "TPU_WORKER_HOSTNAMES",
+                "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(var, raising=False)
+    return calls
+
+
+def test_initialize_single_process_noop_touches_nothing(captured_init):
+    """No coordinator anywhere -> False, and the distributed runtime is
+    never contacted (the recorded call list stays empty)."""
+    from aiyagari_hark_tpu.parallel import multihost
+
+    assert multihost.initialize() is False
+    assert captured_init == []
+
+
+def test_initialize_env_var_resolution(captured_init, monkeypatch):
+    """The documented order: the JAX_* env vars fill unset arguments
+    (ints parsed, not passed as strings)."""
+    from aiyagari_hark_tpu.parallel import multihost
+
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "envhost:1234")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "3")
+    monkeypatch.setenv("JAX_PROCESS_ID", "2")
+    assert multihost.initialize() is True
+    assert captured_init == [{"coordinator_address": "envhost:1234",
+                              "num_processes": 3, "process_id": 2}]
+
+
+def test_initialize_explicit_args_beat_env_vars(captured_init,
+                                                monkeypatch):
+    """Explicit parameters win over the env vars, per argument — an env
+    var only fills an argument the caller left unset."""
+    from aiyagari_hark_tpu.parallel import multihost
+
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "envhost:1234")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "3")
+    monkeypatch.setenv("JAX_PROCESS_ID", "2")
+    assert multihost.initialize("arghost:9", process_id=0) is True
+    assert captured_init == [{"coordinator_address": "arghost:9",
+                              "num_processes": 3, "process_id": 0}]
+
+
+def test_initialize_pod_runtime_autodetection(captured_init, monkeypatch):
+    """A pod runtime marker (TPU_WORKER_HOSTNAMES) hands everything to
+    the platform's own autodetection: initialize() is called with only
+    None arguments and the function reports True."""
+    from aiyagari_hark_tpu.parallel import multihost
+
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "w0,w1")
+    assert multihost.initialize() is True
+    assert captured_init == [{"coordinator_address": None,
+                              "num_processes": None, "process_id": None}]
+
+
+def test_refusal_names_the_duplicate_job_count(captured_init):
+    """The refusal is typed AND actionable: the message names the
+    requested process count, and the runtime was never touched."""
+    from aiyagari_hark_tpu.parallel import multihost
+
+    with pytest.raises(ValueError, match="4 independent duplicate"):
+        multihost.initialize(num_processes=4, process_id=0)
+    assert captured_init == []
+
+
+def test_is_coordinator_guard(monkeypatch):
+    """is_coordinator() is exactly the process-0 guard."""
+    import jax
+
+    from aiyagari_hark_tpu.parallel import multihost
+
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    assert multihost.is_coordinator() is True
+    monkeypatch.setattr(jax, "process_index", lambda: 3)
+    assert multihost.is_coordinator() is False

@@ -1,0 +1,445 @@
+"""State-axis sharding (ISSUE 20, DESIGN §6b).
+
+The contract under test:
+
+* ``state="replicated"`` (the default) is BIT-identical to an
+  unspecified state policy — the explicit spelling shares the
+  fingerprints, the executable cache entries, and the bits.
+* ``state="sharded"`` under an active 2-D state mesh partitions the
+  per-cell wealth state across devices and keeps r* within 0.1 bp of
+  the replicated run, with identical statuses (the contraction is NOT
+  bit-identical — one all-reduce reorders the row-block sums).
+* geometry is typed everywhere: ``make_mesh`` names impossible grids,
+  ``state_mesh`` rejects shard counts < 1, an indivisible wealth grid
+  refuses loudly, and the resume ledger fingerprints the full
+  (cells, state) geometry — a ledger written under one geometry warns
+  ("different run") and recomputes bit-identically under another.
+* quarantine rungs force ``state="replicated"`` so a sharded-contraction
+  pathology can never poison its own retry ladder.
+* the serving engine activates the state mesh around every flush;
+  ``state_shards`` and a multi-lane mesh are mutually exclusive (typed).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from aiyagari_hark_tpu.models.equilibrium import household_capital_supply
+from aiyagari_hark_tpu.models.household import build_simple_model
+from aiyagari_hark_tpu.parallel.mesh import (
+    STATE_AXIS,
+    active_state_mesh,
+    balanced_lane_order,
+    constrain_state,
+    current_state_mesh,
+    make_mesh,
+    match_partition_rules,
+    mesh_axis_size,
+    pad_to_multiple,
+    resolve_mesh,
+    state_mesh,
+    state_sharding,
+)
+from aiyagari_hark_tpu.parallel.sweep import run_table2_sweep
+from aiyagari_hark_tpu.solver_health import is_failure
+from aiyagari_hark_tpu.utils.config import (
+    STATE_POLICIES,
+    SweepConfig,
+    resolve_state,
+)
+from aiyagari_hark_tpu.utils.fingerprint import (
+    hashable_kwargs,
+    ledger_fingerprint,
+    work_fingerprint,
+)
+from aiyagari_hark_tpu.utils.resilience import Interrupted, preemption_guard
+
+# The tier-1 sweep workload shared with tests/test_precision.py — same
+# lru/jit cache keys, so this module rides the same warm compiles.
+KW = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-5,
+          max_bisect=24)
+SMALL = SweepConfig(crra_values=(1.0, 5.0), rho_values=(0.0, 0.9),
+                    schedule="balanced", n_buckets=2)
+# 4-cell lattice for the sweep-level numerics — the policy contract is
+# config-agnostic, and the full 12-cell lattice would push tier-1 past
+# its wall budget (the bench leg sweeps the full lattice instead)
+CFG = SweepConfig(crra_values=(1.0, 3.0), rho_values=(0.3, 0.6))
+
+
+# ---------------------------------------------------------------------------
+# The policy seam.
+# ---------------------------------------------------------------------------
+
+def test_resolve_state_policies():
+    assert STATE_POLICIES == ("replicated", "sharded")
+    assert resolve_state("replicated").sharded is False
+    assert resolve_state("sharded").sharded is True
+    spec = resolve_state("sharded")
+    assert resolve_state(spec) is spec          # spec passes through
+    with pytest.raises(ValueError, match="state policy must be one of"):
+        resolve_state("bogus")
+    with pytest.raises(ValueError):
+        resolve_state(None)
+
+
+# ---------------------------------------------------------------------------
+# Mesh geometry: construction, typed errors, the partition-rule table.
+# ---------------------------------------------------------------------------
+
+def test_state_mesh_geometry():
+    n = len(jax.devices())
+    assert n == 8, "tier-1 runs on 8 forced-host devices (conftest)"
+    sm = state_mesh(4)
+    assert mesh_axis_size(sm, STATE_AXIS) == 4
+    assert mesh_axis_size(sm, "cells") == n // 4
+    # the degenerate case is EXACTLY the pre-existing 1-D lane geometry
+    assert state_mesh(1).shape == resolve_mesh("auto").shape
+    with pytest.raises(ValueError, match="state_shards must be >= 1"):
+        state_mesh(0)
+
+
+def test_make_mesh_typed_errors():
+    devs = jax.devices()
+    # more than one -1 names the grid instead of dying in numpy reshape
+    with pytest.raises(ValueError, match="at most one"):
+        make_mesh(("cells", "state"), (-1, -1), devices=devs)
+    # a device count not divisible by the known sizes names BOTH shapes
+    with pytest.raises(ValueError) as ei:
+        make_mesh(("cells", "state"), (-1, 3), devices=devs)
+    assert "'state': 3" in str(ei.value) and "8 devices" in str(ei.value)
+
+
+def test_partition_rule_table():
+    from jax.sharding import PartitionSpec as P   # mesh-ok: expectations
+
+    assert match_partition_rules("distribution") == P(STATE_AXIS, None)
+    assert match_partition_rules("wealth_operator") == P(None, None,
+                                                         STATE_AXIS)
+    assert match_partition_rules("policy") == P(None, STATE_AXIS)
+    # rules match path-style names too (first regex wins)
+    assert match_partition_rules("household/distribution") == P(
+        STATE_AXIS, None)
+    with pytest.raises(ValueError, match="no state partition rule"):
+        match_partition_rules("nope")
+
+
+def test_constrain_state_noop_degeneracies():
+    x = np.ones((8, 3))
+    assert constrain_state(x, None, "distribution") is x
+    assert constrain_state(x, state_mesh(1), "distribution") is x
+    sm = state_mesh(2)
+    y = constrain_state(jax.numpy.asarray(x), sm, "distribution")
+    assert np.array_equal(np.asarray(y), x)
+    # the sharding the constraint requested is the table's
+    assert state_sharding(sm, "distribution").spec == \
+        match_partition_rules("distribution")
+
+
+def test_active_state_mesh_context():
+    assert current_state_mesh() is None
+    sm = state_mesh(2)
+    with active_state_mesh(sm):
+        assert current_state_mesh() is sm
+        with active_state_mesh(None):      # nested deactivation restores
+            assert current_state_mesh() is None
+        assert current_state_mesh() is sm
+    assert current_state_mesh() is None
+
+
+# ---------------------------------------------------------------------------
+# Mesh-helper property tests (ISSUE 20 satellite).
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,multiple,axis", [
+    (5, 4, 0), (8, 4, 0), (5, 1, 0), (7, 3, 1), (4, 4, 1),
+])
+def test_pad_to_multiple_properties(n, multiple, axis):
+    shape = [3, 3]
+    shape[axis] = n
+    rng = np.random.default_rng(n * 10 + multiple)
+    x = rng.normal(size=shape)
+    padded, orig = pad_to_multiple(x, multiple, axis=axis)
+    assert orig == n
+    assert padded.shape[axis] % multiple == 0
+    assert padded.shape[axis] - n < multiple          # minimal padding
+    # original content is untouched, padding edge-replicates
+    sl = [slice(None)] * x.ndim
+    sl[axis] = slice(0, n)
+    assert np.array_equal(padded[tuple(sl)], x)
+    if padded.shape[axis] > n:
+        edge = [slice(None)] * x.ndim
+        edge[axis] = slice(n - 1, n)
+        pad = [slice(None)] * x.ndim
+        pad[axis] = slice(n, None)
+        assert np.array_equal(
+            padded[tuple(pad)],
+            np.repeat(x[tuple(edge)], padded.shape[axis] - n, axis=axis))
+    # multiple=1 and aligned sizes are exact no-ops
+    if multiple == 1 or n % multiple == 0:
+        assert padded.shape[axis] == n
+
+
+@pytest.mark.parametrize("work,n_shards", [
+    ([1.0] * 8, 4),                 # full ties
+    ([3.0, 3.0, 1.0, 1.0], 2),     # paired ties
+    ([5.0, 1.0, 1.0, 1.0, 4.0, 2.0, 2.0, 2.0], 2),
+])
+def test_balanced_lane_order_is_a_valid_permutation(work, n_shards):
+    perm = balanced_lane_order(np.asarray(work), n_shards)
+    assert sorted(perm.tolist()) == list(range(len(work)))
+    # every shard gets exactly len/n_shards lanes (contiguous blocks)
+    per = len(work) // n_shards
+    loads = [sum(np.asarray(work)[perm[i * per:(i + 1) * per]])
+             for i in range(n_shards)]
+    # LPT guarantee: max load within 4/3 of the uniform bound + one lane
+    assert max(loads) <= (4.0 / 3.0) * (sum(work) / n_shards) + max(work)
+
+
+def test_resolve_mesh_rejects_missing_axis():
+    sm = state_mesh(2)     # axes ("cells", "state")
+    with pytest.raises(ValueError, match="do not define"):
+        resolve_mesh(sm, "lanes")
+    with pytest.raises(ValueError, match="'auto'"):
+        resolve_mesh("never", "cells")
+
+
+# ---------------------------------------------------------------------------
+# Fingerprints: drop-explicit-default, cross-policy inequality, the 2-D
+# ledger geometry.
+# ---------------------------------------------------------------------------
+
+def test_hashable_kwargs_state_canonicalization():
+    base = hashable_kwargs({"a_count": 10})
+    assert hashable_kwargs({"a_count": 10, "state": "replicated"}) == base
+    sharded = hashable_kwargs({"a_count": 10, "state": "sharded"})
+    assert sharded != base
+    assert ("state", "sharded") in sharded
+    with pytest.raises(ValueError):
+        hashable_kwargs({"state": "bogus"})
+
+
+def test_work_fingerprint_separates_state_policies():
+    base = work_fingerprint(hashable_kwargs(KW), np.float64)
+    expl = work_fingerprint(
+        hashable_kwargs({**KW, "state": "replicated"}), np.float64)
+    shrd = work_fingerprint(
+        hashable_kwargs({**KW, "state": "sharded"}), np.float64)
+    assert base == expl                  # the no-drift pin
+    assert shrd != base                  # sharded keys its own programs
+
+
+def test_ledger_fingerprint_hashes_2d_geometry():
+    cells = [(1.0, 0.3, 0.2)]
+    args = dict(cells=cells, kwargs_items=hashable_kwargs(KW),
+                dtype=np.float64, schedule="balanced", n_buckets=2,
+                warm_brackets=False, warm_margin=0.0, fault_mode=None,
+                fault_iters=None, max_retries=1, quarantine=False,
+                sidecar=None)
+    base = ledger_fingerprint(**args)
+    assert ledger_fingerprint(**args, state_shards=1) == base  # default
+    assert ledger_fingerprint(**args, state_shards=2) != base
+    assert ledger_fingerprint(**args, mesh_shards=8) != \
+        ledger_fingerprint(**args, mesh_shards=4, state_shards=2)
+
+
+# ---------------------------------------------------------------------------
+# Numerics: replicated bit-identity, sharded drift, typed divisibility.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def sweeps():
+    ref = run_table2_sweep(CFG, **KW)
+    sh2 = run_table2_sweep(CFG.replace(state_shards=2), **KW)
+    return ref, sh2
+
+
+def test_replicated_default_and_explicit_are_bit_identical(sweeps):
+    ref, _ = sweeps
+    expl = run_table2_sweep(CFG, state="replicated", **KW)
+    for field in ("r_star_pct", "saving_rate_pct", "capital", "excess",
+                  "bisect_iters", "egm_iters", "dist_iters", "status"):
+        assert np.array_equal(np.asarray(getattr(ref, field)),
+                              np.asarray(getattr(expl, field)),
+                              equal_nan=True), field
+
+
+@pytest.mark.parametrize("shards", [2, 4])
+def test_sharded_sweep_r_star_within_a_tenth_bp(sweeps, shards):
+    ref, sh2 = sweeps
+    sh = (sh2 if shards == 2
+          else run_table2_sweep(CFG.replace(state_shards=4), **KW))
+    drift_bp = float(np.abs(np.asarray(sh.r_star_pct)
+                            - np.asarray(ref.r_star_pct)).max()) * 100.0
+    assert drift_bp < 0.1, f"r* drift {drift_bp} bp at {shards} shards"
+    assert np.array_equal(np.asarray(sh.status), np.asarray(ref.status))
+
+
+def test_sharded_supply_matches_replicated_supply():
+    m = build_simple_model(labor_states=3, a_count=12, dist_count=64)
+    ref = household_capital_supply(0.02, m, 0.96, 2.0, 0.36, 0.08)
+    with active_state_mesh(state_mesh(4)):
+        sh = household_capital_supply(0.02, m, 0.96, 2.0, 0.36, 0.08,
+                                      state="sharded")
+    assert abs(float(ref.supply) - float(sh.supply)) < 1e-9
+    # without an active mesh the sharded policy degrades to replicated
+    # bits by construction (constrain_state no-ops on mesh None)
+    off = household_capital_supply(0.02, m, 0.96, 2.0, 0.36, 0.08,
+                                   state="sharded")
+    assert float(off.supply) == float(ref.supply)
+
+
+def test_indivisible_wealth_grid_refuses_loudly():
+    m = build_simple_model(labor_states=3, a_count=12, dist_count=66)
+    with active_state_mesh(state_mesh(4)):
+        with pytest.raises(ValueError, match="divisible by the state"):
+            household_capital_supply(0.02, m, 0.96, 2.0, 0.36, 0.08,
+                                     state="sharded")
+
+
+def test_quarantine_rungs_force_replicated(sweeps):
+    """A NaN-injected cell under a sharded sweep recovers through the
+    ladder: every rung re-solves ``state="replicated"`` (the certified
+    layout), so the fault cannot chase the sharded contraction."""
+    ref, _ = sweeps
+    res = run_table2_sweep(CFG.replace(state_shards=2),
+                           inject_fault={"cell": 1, "at_iter": 1,
+                                         "mode": "nan"},
+                           max_retries=2, **KW)
+    assert int(res.retries[1]) >= 1
+    assert not is_failure(int(res.status[1]))
+    # the rung's replicated re-solve reproduces the replicated root
+    assert abs(float(res.r_star_pct[1]) - float(ref.r_star_pct[1])) \
+        * 100.0 < 0.1
+
+
+# ---------------------------------------------------------------------------
+# Resume: the ledger refuses a different (cells, state) geometry and
+# recomputes bit-identically.
+# ---------------------------------------------------------------------------
+
+def test_state_geometry_refuses_resume_and_recomputes(tmp_path):
+    # looser solver knobs than KW: the geometry guard is about ledger
+    # bits, not root precision, and this test pays for three sweeps
+    rkw = dict(KW, r_tol=1e-4, max_bisect=16)
+    clean = run_table2_sweep(SMALL, **rkw)    # replicated reference
+    ledger = str(tmp_path / "state2_ledger.npz")
+    with preemption_guard():
+        with pytest.raises(Interrupted):
+            run_table2_sweep(
+                SMALL.replace(state_shards=2), resume_path=ledger,
+                inject_preempt={"after_bucket": 0, "mode": "flag"}, **rkw)
+    import os
+
+    assert os.path.exists(ledger)
+    # resumed WITHOUT state sharding: the 2-D geometry in the ledger
+    # fingerprint mismatches, the sweep warns typed and recomputes — and
+    # the recomputed result is bit-identical to an uninterrupted
+    # replicated run (a silent resume would have smuggled in rows from
+    # a differently-reduced contraction)
+    with pytest.warns(UserWarning, match="different run"):
+        res = run_table2_sweep(SMALL, resume_path=ledger, **rkw)
+    assert not os.path.exists(ledger)
+    for f in ("r_star_pct", "capital", "status", "bisect_iters",
+              "egm_iters", "dist_iters"):
+        assert np.array_equal(np.asarray(getattr(res, f)),
+                              np.asarray(getattr(clean, f)),
+                              equal_nan=True), f
+
+
+# ---------------------------------------------------------------------------
+# Serving: the state mesh wraps flushes; lane mesh + state shards refuse.
+# ---------------------------------------------------------------------------
+
+def test_service_state_shards_and_lane_mesh_are_exclusive():
+    from aiyagari_hark_tpu.serve import EquilibriumService
+
+    with pytest.raises(ValueError, match="cannot combine"):
+        EquilibriumService(mesh="auto", state_shards=2,
+                           start_worker=False)
+
+
+def test_served_sharded_state_matches_replicated_to_solver_noise():
+    from aiyagari_hark_tpu.serve import EquilibriumService, make_query
+    from aiyagari_hark_tpu.utils.timing import CompileCounter
+
+    # test_serve.py's KW spelling (r_tol=1e-4, max_bisect=16) so the
+    # replicated reference service rides its warmed executables; dense
+    # pinned because the sharded contraction forces it
+    skw = dict(a_count=10, dist_count=32, labor_states=3, r_tol=1e-4,
+               max_bisect=16, dist_method="dense")
+    with EquilibriumService(start_worker=False, max_batch=4,
+                            max_wait_s=60.0, ladder=(1, 2, 4)) as ref_svc:
+        ref = ref_svc.query(3.0, 0.6, **skw)
+    with EquilibriumService(start_worker=False, max_batch=4,
+                            max_wait_s=60.0, ladder=(1, 2, 4),
+                            state_shards=2) as svc:
+        res = svc.query(3.0, 0.6, state="sharded", **skw)
+        assert res.path == "cold"
+        drift_bp = abs(res.r_star - ref.r_star) * 100.0 * 100.0
+        assert drift_bp < 0.1
+        assert res.status == ref.status
+        # exact replay: a store hit, zero new XLA compiles
+        with CompileCounter() as c:
+            hit = svc.query(3.0, 0.6, state="sharded", **skw)
+        assert hit.path == "hit" and c.compile_events == 0
+        # the reference path rides the SAME state-mesh context, so its
+        # bits agree with the served cold lane's
+        q = make_query(3.0, 0.6, state="sharded", **skw)
+        refsolve = svc.reference_solve(q, bracket_init=res.bracket_init)
+        assert (res.r_star, res.capital, res.status) == \
+            (refsolve.r_star, refsolve.capital, refsolve.status)
+
+
+# ---------------------------------------------------------------------------
+# The regression sentinel knows every state_* bench field (satellite).
+# ---------------------------------------------------------------------------
+
+def test_regress_directions_cover_the_state_record():
+    from aiyagari_hark_tpu.obs.regress import (
+        DOWN,
+        UP,
+        direction_of_goodness,
+    )
+
+    record = {
+        "state_smoke_cells": 4,
+        "state_r_star_drift_bp": 0.0,
+        "state_budget_bytes": 4 << 20,
+        "state_overflow_grid": 512,
+        "state_model_resident_replicated_bytes": 6316032,
+        "state_model_resident_sharded_bytes": 1579008,
+        "state_resident_ratio": 0.25,
+        "state_collective_share_frac": 0.22,
+        "state_mem_stats_devices": 0,
+        "state_mem_peak_bytes": 1.0,
+        "state_gridpoints_per_sec_1shard": 3.4e6,
+        "state_gridpoints_per_sec_2shard": 2.4e6,
+        "state_gridpoints_per_sec_4shard": 2.7e6,
+    }
+    for field in record:                      # strict: no unclassified
+        direction_of_goodness(field, strict=True)
+    assert direction_of_goodness("state_gridpoints_per_sec_4shard") == UP
+    assert direction_of_goodness("state_r_star_drift_bp") == DOWN
+    assert direction_of_goodness("state_resident_ratio") == DOWN
+    assert direction_of_goodness("state_collective_share_frac") == DOWN
+
+
+def test_regress_grades_a_state_history():
+    from aiyagari_hark_tpu.obs.regress import (
+        REGRESSED,
+        evaluate_history,
+    )
+
+    base = {"metric": "state_scaling",
+            "state_gridpoints_per_sec_4shard": 1000.0,
+            "state_r_star_drift_bp": 0.001}
+    prior2 = dict(base, state_gridpoints_per_sec_4shard=1050.0)
+    good = dict(base, state_gridpoints_per_sec_4shard=1100.0)
+    bad = dict(base, state_gridpoints_per_sec_4shard=400.0,
+               state_r_star_drift_bp=0.09)
+    history = [("r1", base), ("r2", prior2)]
+    assert evaluate_history(history + [("r3", good)]).worst < REGRESSED
+    report = evaluate_history(history + [("r3", bad)])
+    assert report.worst == REGRESSED
+    names = {f.metric for f in report.regressed()}
+    assert "state_gridpoints_per_sec_4shard" in names
